@@ -1,0 +1,4 @@
+"""paddle_tpu.incubate — incubating APIs (`python/paddle/incubate/`).
+MoE lives in paddle_tpu.incubate.distributed.models.moe (parity path).
+"""
+from . import nn  # noqa: F401

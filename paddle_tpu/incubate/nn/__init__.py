@@ -1,0 +1,22 @@
+"""Fused layers (`python/paddle/incubate/nn/layer/fused_transformer.py`).
+On TPU, "fused" is what XLA does to the unfused graph; these classes keep
+the reference API and map onto the standard layers + flash attention.
+"""
+from __future__ import annotations
+
+from ...nn.layers.transformer import (TransformerEncoderLayer,
+                                      MultiHeadAttention)
+
+
+class FusedMultiHeadAttention(MultiHeadAttention):
+    pass
+
+
+class FusedTransformerEncoderLayer(TransformerEncoderLayer):
+    pass
+
+
+class FusedFeedForward:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "use nn.TransformerEncoderLayer; XLA fuses the FFN")

@@ -1,0 +1,162 @@
+"""Compiled whole-step trainer: forward + backward + optimizer update in ONE
+donated `jax.jit` executable.
+
+This subsumes the reference's static-graph executor stack for training
+(SURVEY.md §3.3: `StandaloneExecutor` → `InterpreterCore` instruction
+stream): XLA's scheduler replaces stream_analyzer/workqueues, buffer
+donation replaces the memory_optimize/inplace passes, and the fused
+optimizer update replaces `coalesce_grad_tensor_pass` + merged_adam.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core import random as rng_mod
+from ..core.tensor import Tensor
+from .functional import bind_arrays, split_state
+from ..optimizer.optimizer import _clip_spec
+
+
+class CompiledTrainStep:
+    """train_step(params, buffers, accums, lr, t, key, *batch) compiled once
+    per input-shape signature."""
+
+    def __init__(self, model, loss_fn, optimizer, n_labels=1,
+                 donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n_labels = n_labels
+        (self.p_names, self.p_tensors,
+         self.b_names, self.b_tensors) = split_state(model)
+        # ensure accumulators exist
+        self.accum_template = [optimizer._get_accums(p)
+                               for p in self.p_tensors]
+        clip_kind, clip_value = _clip_spec(optimizer._grad_clip)
+        single = optimizer._single_update
+        from ..optimizer.optimizer import _wd_coeff
+        wds = tuple(
+            p.optimize_attr.get("weight_decay", optimizer._weight_decay)
+            if p.regularizer is None else _wd_coeff(p.regularizer)
+            for p in self.p_tensors)
+        lr_mults = tuple(p.optimize_attr.get("learning_rate", 1.0)
+                         for p in self.p_tensors)
+        trainable = tuple(not p.stop_gradient for p in self.p_tensors)
+        model_ref = model
+        loss_ref = loss_fn
+        p_tensors = self.p_tensors
+        b_tensors = self.b_tensors
+        n_lab = n_labels
+
+        def step(params, buffers, accums, lr, t, key, *batch):
+            inputs, labels = batch[:len(batch) - n_lab], \
+                batch[len(batch) - n_lab:]
+
+            def loss_of(plist):
+                wrapped_in = [Tensor(a) for a in inputs]
+                wrapped_lab = [Tensor(a) for a in labels]
+                with bind_arrays(p_tensors, plist), \
+                        bind_arrays(b_tensors, buffers), \
+                        rng_mod.functional_rng(key), autograd.no_grad():
+                    out = model_ref(*wrapped_in)
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    if loss_ref is not None:
+                        loss = loss_ref(*outs, *wrapped_lab)
+                    else:
+                        loss = outs[0]
+                    new_buf = [b._data for b in b_tensors]
+                loss_arr = loss._data if isinstance(loss, Tensor) else loss
+                out_arrs = [o._data if isinstance(o, Tensor) else o
+                            for o in outs]
+                return loss_arr.astype(jnp.float32), (new_buf, out_arrs)
+
+            (loss, (new_buffers, outs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(params))
+
+            # grad clip (global-norm inside the compiled step)
+            if clip_kind == "global_norm":
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g, tr in zip(grads, trainable) if tr) + 1e-12)
+                scale = jnp.minimum(1.0, clip_value / (gnorm + 1e-6))
+                grads = [g * scale.astype(g.dtype) for g in grads]
+            elif clip_kind == "value":
+                grads = [jnp.clip(g, -clip_value, clip_value) for g in grads]
+
+            new_params, new_accums = [], []
+            for p, g, acc, wd, lm, tr in zip(params, grads, accums, wds,
+                                             lr_mults, trainable):
+                if not tr:
+                    new_params.append(p)
+                    new_accums.append(acc)
+                    continue
+                np_, nacc = single(p, g, acc, lr * lm, t, wd)
+                new_params.append(np_)
+                new_accums.append(nacc)
+            return loss, outs, new_params, new_buffers, new_accums
+
+        donate_argnums = (0, 2) if donate else ()
+        self._jit_step = jax.jit(step, donate_argnums=donate_argnums)
+
+    def run(self, *batch_arrays):
+        opt = self.optimizer
+        params = [p._data for p in self.p_tensors]
+        buffers = [b._data for b in self.b_tensors]
+        accums = [opt._accumulators[id(p)] for p in self.p_tensors]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        t = jnp.asarray(opt._step_count + 1, jnp.float32)
+        key = rng_mod.next_key()
+        loss, outs, new_params, new_buffers, new_accums = self._jit_step(
+            params, buffers, accums, lr, t, key, *batch_arrays)
+        for p, np_ in zip(self.p_tensors, new_params):
+            p._data = np_
+        for b, nb in zip(self.b_tensors, new_buffers):
+            b._data = nb
+        for p, nacc in zip(self.p_tensors, new_accums):
+            opt._accumulators[id(p)] = nacc
+        opt._step_count += 1
+        return Tensor(loss), [Tensor(o) for o in outs]
+
+
+class CompiledEvalStep:
+    def __init__(self, model, loss_fn=None, n_labels=1):
+        self.model = model
+        (self.p_names, self.p_tensors,
+         self.b_names, self.b_tensors) = split_state(model)
+        model_ref = model
+        loss_ref = loss_fn
+        p_tensors, b_tensors = self.p_tensors, self.b_tensors
+        n_lab = n_labels
+
+        def step(params, buffers, key, *batch):
+            inputs = batch[:len(batch) - n_lab] if loss_ref is not None \
+                else batch
+            labels = batch[len(batch) - n_lab:] if loss_ref is not None \
+                else ()
+            wrapped_in = [Tensor(a) for a in inputs]
+            wrapped_lab = [Tensor(a) for a in labels]
+            with bind_arrays(p_tensors, params), \
+                    bind_arrays(b_tensors, buffers), \
+                    rng_mod.functional_rng(key), autograd.no_grad():
+                out = model_ref(*wrapped_in)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                loss_arr = None
+                if loss_ref is not None:
+                    loss = loss_ref(*outs, *wrapped_lab)
+                    loss_arr = loss._data if isinstance(loss, Tensor) \
+                        else loss
+            out_arrs = [o._data if isinstance(o, Tensor) else o
+                        for o in outs]
+            return loss_arr, out_arrs
+
+        self._jit_step = jax.jit(step)
+
+    def run(self, *batch_arrays):
+        params = [p._data for p in self.p_tensors]
+        buffers = [b._data for b in self.b_tensors]
+        key = rng_mod.next_key()
+        loss, outs = self._jit_step(params, buffers, key, *batch_arrays)
+        return (Tensor(loss) if loss is not None else None,
+                [Tensor(o) for o in outs])

@@ -1,0 +1,110 @@
+"""Attention functionals: the long-context hot path.
+
+Parity: `python/paddle/nn/functional/flash_attention.py:142` over the
+reference's FlashAttention integration (`paddle/phi/kernels/flash_attn_kernel.h`,
+`cmake/external/flashattn.cmake`) and `sparse_attention`
+(`python/paddle/nn/functional/sparse_attention.py`).
+
+TPU-native: `scaled_dot_product_attention` dispatches to a Pallas
+flash-attention kernel on TPU (paddle_tpu/ops/pallas/flash_attention.py)
+with an XLA fallback that the compiler fuses well on the MXU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops._helpers import as_tensor
+
+
+def _xla_attention(q, k, v, bias=None, causal=False, scale=None,
+                   dropout_p=0.0, dropout_key=None):
+    """Reference XLA attention: [B, S, H, D] layout (paddle flash_attention
+    layout). Computed in fp32 for stability, emitted in input dtype."""
+    orig_dtype = q.dtype
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bshd,bthd->bhst", qf, k.astype(jnp.float32))
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(orig_dtype)
+
+
+def _attention_impl(q, k, v, bias, causal, scale, dropout_p, dropout_key,
+                    use_pallas):
+    if use_pallas:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention as fa
+            return fa(q, k, v, bias=bias, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, bias, causal, scale, dropout_p,
+                          dropout_key)
+
+
+def _on_tpu(arr) -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention parity: inputs [B, S, H, D]."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    from ...core import random as rng
+    dkey = rng.next_key() if (dropout > 0.0 and training) else None
+    use_pallas = _on_tpu(q._data)
+
+    def _fn(qa, ka, va):
+        return _attention_impl(qa, ka, va, None, causal, None,
+                               dropout if training else 0.0, dkey,
+                               use_pallas)
+    out = dispatch.apply("flash_attention", _fn, (q, k, v))
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """[B, S, H, D] in/out — paddle 2.5+ SDPA API."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    inputs = [q, k, v]
+    if attn_mask is not None:
+        inputs.append(as_tensor(attn_mask))
+    from ...core import random as rng
+    dkey = rng.next_key() if (dropout_p > 0.0 and training) else None
+    use_pallas = _on_tpu(q._data) and attn_mask is None and dropout_p == 0.0
+
+    def _fn(qa, ka, va, *rest):
+        bias = rest[0] if rest else None
+        return _attention_impl(qa, ka, va, bias, is_causal, None,
+                               dropout_p if training else 0.0, dkey,
+                               use_pallas)
+    return dispatch.apply("sdpa", _fn, tuple(inputs))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, **kw):
+    raise NotImplementedError(
+        "sparse_attention: use scaled_dot_product_attention with an additive "
+        "mask; block-sparse pallas kernel planned")

@@ -1,0 +1,213 @@
+"""Normalization functionals.
+
+Parity: `python/paddle/nn/functional/norm.py` over PHI batch_norm /
+layer_norm / group_norm kernels (`paddle/phi/kernels/batch_norm_kernel.h`,
+`layer_norm_kernel.h`). On TPU these are XLA-fused reductions +
+elementwise — no cuDNN equivalent needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = as_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_stats = (not training) if use_global_stats is None else \
+        use_global_stats
+
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if use_stats:
+        rm, rv = as_tensor(running_mean), as_tensor(running_var)
+        inputs.extend([rm, rv])
+
+        def _fn(*arrs):
+            a = arrs[0]
+            mean = arrs[-2].reshape(bshape)
+            var = arrs[-1].reshape(bshape)
+            out = (a - mean) / jnp.sqrt(var + epsilon)
+            if w_idx is not None:
+                out = out * arrs[w_idx].reshape(bshape)
+            if b_idx is not None:
+                out = out + arrs[b_idx].reshape(bshape)
+            return out.astype(a.dtype)
+        return dispatch.apply("batch_norm_infer", _fn, tuple(inputs))
+
+    # training: compute batch stats; update running stats (stateful, on the
+    # Tensor wrappers — traced arrays flow through during functional mode)
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=reduce_axes, keepdims=True)
+        var = jnp.var(af, axis=reduce_axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + epsilon)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
+        return (out.astype(a.dtype), mean.reshape(-1), var.reshape(-1))
+
+    out, batch_mean, batch_var = dispatch.apply(
+        "batch_norm_train", _fn, tuple(inputs))
+    if running_mean is not None:
+        rm, rv = as_tensor(running_mean), as_tensor(running_var)
+        n = int(np.prod([x.shape[i] for i in reduce_axes]))
+        unbiased = n / max(n - 1, 1)
+        rm._data = (momentum * rm._data
+                    + (1 - momentum) * batch_mean._data.astype(rm.dtype))
+        rv._data = (momentum * rv._data
+                    + (1 - momentum)
+                    * (batch_var._data * unbiased).astype(rv.dtype))
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = as_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + epsilon)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32)
+        return out.astype(a.dtype)
+    return dispatch.apply("layer_norm", _fn, tuple(inputs))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = as_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        if channel_last:
+            af = jnp.moveaxis(af, -1, 1)
+        shp = af.shape
+        g = af.reshape(shp[0], num_groups, shp[1] // num_groups, *shp[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(shp)
+        bshape = [1, shp[1]] + [1] * (len(shp) - 2)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+    return dispatch.apply("group_norm", _fn, tuple(inputs))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        axes = tuple(range(2, af.ndim))
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + eps)
+        bshape = [1, af.shape[1]] + [1] * (af.ndim - 2)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
+        return out.astype(a.dtype)
+    return dispatch.apply("instance_norm", _fn, tuple(inputs))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        sq = a * a
+        half = size // 2
+        ch = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jnp.take(sq, jnp.arange(i, i + ch), axis=1)
+        return a / (k + alpha * acc) ** beta
+    from ...ops._helpers import unary
+    return unary("lrn", _fn, x)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLM-era extension; reference has fused rms_norm in
+    fluid/operators/fused)."""
+    x = as_tensor(x)
+    inputs = [x]
+    if weight is not None:
+        inputs.append(as_tensor(weight))
+
+    def _fn(a, *w):
+        af = a.astype(jnp.float32)
+        scale = jnp.sqrt(jnp.mean(af * af, axis=-1, keepdims=True) + epsilon)
+        out = af / scale
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    return dispatch.apply("rms_norm", _fn, tuple(inputs))

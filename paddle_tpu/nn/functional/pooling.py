@@ -1,0 +1,187 @@
+"""Pooling functionals over `jax.lax.reduce_window`.
+
+Parity: `python/paddle/nn/functional/pooling.py` over PHI pool kernels
+(`paddle/phi/kernels/pool_kernel.h`, `gpudnn/pool_kernel.cu`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import as_tensor, unary
+from .conv import _tuple
+
+
+def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
+          ceil_mode=False, count_include_pad=True, average=False,
+          exclusive=True):
+    x = as_tensor(x)
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _tuple(padding, n) if not isinstance(padding, (list, tuple)) or \
+            all(isinstance(v, int) for v in padding) else padding
+        if isinstance(p, tuple) and len(p) == n:
+            pads = [(v, v) for v in p]
+        else:
+            pads = [tuple(v) for v in p]
+
+    def _fn(a):
+        nd = a.ndim
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides_full = (1,) + s + (1,)
+            pad_full = [(0, 0)] + (pads or [(0, 0)] * n) + [(0, 0)]
+        else:
+            window = (1, 1) + k
+            strides_full = (1, 1) + s
+            pad_full = [(0, 0), (0, 0)] + (pads or [(0, 0)] * n)
+        if pad_mode is not None:
+            pad_cfg = pad_mode
+        else:
+            pad_cfg = pad_full
+        out = jax.lax.reduce_window(
+            a, init(a.dtype), reducer, window, strides_full,
+            pad_cfg if isinstance(pad_cfg, str) else pad_cfg)
+        if average:
+            if exclusive and pads is not None and any(
+                    p_ != (0, 0) for p_ in (pads or [])):
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0 if not jnp.issubdtype(a.dtype, jnp.integer)
+                    else 0, jax.lax.add, window, strides_full, pad_cfg)
+                out = out / counts
+            else:
+                out = out / float(np.prod(k))
+        return out
+    return unary("pool", _fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    def init(dt):
+        return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
+            jnp.iinfo(dt).min
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, init,
+                 channel_last=False, ceil_mode=ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    def init(dt):
+        return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
+            jnp.iinfo(dt).min
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, init,
+                 channel_last=(data_format == "NHWC"), ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    def init(dt):
+        return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
+            jnp.iinfo(dt).min
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, init,
+                 channel_last=(data_format == "NDHWC"), ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add,
+                 lambda dt: jnp.zeros((), dt).item() if False else 0.0,
+                 channel_last=False, average=True, exclusive=exclusive,
+                 ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add,
+                 lambda dt: 0.0, channel_last=(data_format == "NHWC"),
+                 average=True, exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add,
+                 lambda dt: 0.0, channel_last=(data_format == "NDHWC"),
+                 average=True, exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", False)
+
+
+def _adaptive(x, output_size, n, mode, channel_last):
+    x = as_tensor(x)
+    out_sz = _tuple(output_size, n)
+
+    def _fn(a):
+        spatial = a.shape[2:2 + n] if not channel_last else a.shape[1:1 + n]
+        # exact adaptive pooling when divisible; else mean over variable bins
+        if all(s % o == 0 for s, o in zip(spatial, out_sz)):
+            k = tuple(s // o for s, o in zip(spatial, out_sz))
+            if channel_last:
+                window = (1,) + k + (1,)
+            else:
+                window = (1, 1) + k
+            red = jax.lax.max if mode == "max" else jax.lax.add
+            init = (-jnp.inf if mode == "max" else 0.0)
+            out = jax.lax.reduce_window(a, init, red, window, window,
+                                        "VALID")
+            if mode == "avg":
+                out = out / float(np.prod(k))
+            return out
+        # general path: resize-style bins
+        slices = []
+        for dim_i, (s, o) in enumerate(zip(spatial, out_sz)):
+            starts = [int(np.floor(i * s / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * s / o)) for i in range(o)]
+            slices.append((starts, ends))
+
+        def pool_one(index):
+            idx = [slice(None)] * a.ndim
+            base = 1 if channel_last else 2
+            for d, ii in enumerate(index):
+                st, en = slices[d][0][ii], slices[d][1][ii]
+                idx[base + d] = slice(st, en)
+            patch = a[tuple(idx)]
+            axes = tuple(range(base, base + n))
+            return (jnp.max(patch, axis=axes) if mode == "max"
+                    else jnp.mean(patch, axis=axes))
+        import itertools
+        outs = [pool_one(ix) for ix in itertools.product(
+            *[range(o) for o in out_sz])]
+        stacked = jnp.stack(outs, axis=-1)
+        if channel_last:
+            nb, c = a.shape[0], a.shape[-1]
+            return stacked.reshape((nb, c) + tuple(out_sz)).transpose(
+                (0,) + tuple(range(2, 2 + n)) + (1,))
+        nb, c = a.shape[0], a.shape[1]
+        return stacked.reshape((nb, c) + tuple(out_sz))
+    return unary("adaptive_pool", _fn, x)

@@ -1,0 +1,154 @@
+"""Eager collective API over sharded arrays.
+
+Parity: the ProcessGroup suite (`paddle/fluid/distributed/collective/
+ProcessGroup.h:53` — AllReduce :99, Broadcast :117, AllGather :199,
+AllToAll :234, Reduce, Scatter, Send/Recv) + python
+`paddle.distributed.all_reduce/...` (`python/paddle/distributed/
+communication/`).
+
+TPU-native: there is no NCCL; a "collective" over the dp world on one host
+is a `shard_map`-wrapped `jax.lax` collective compiled over ICI. The eager
+API here operates on REPLICATED host-visible Tensors: each rank slot of a
+sharded tensor is dim 0 of the array (the single-controller SPMD view).
+These functions exist for API parity and for the eager DataParallel path;
+the performance path fuses collectives inside jitted steps (pjit/GSPMD).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+from . import env as dist_env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Communication group = a named axis over a sub-mesh.
+
+    Parity: `paddle.distributed.collective.Group` /
+    `ProcessGroup` (gid, ranks)."""
+
+    def __init__(self, ranks=None, gid=0, name="dp"):
+        all_n = dist_env.get_world_size()
+        self.ranks = list(ranks) if ranks is not None else list(range(all_n))
+        self.nranks = len(self.ranks)
+        self.id = gid
+        self.name = name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+
+_default_group = None
+_group_counter = 0
+
+
+def _get_group(group):
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group()
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    global _group_counter
+    _group_counter += 1
+    return Group(ranks, _group_counter)
+
+
+def get_group(gid=0):
+    return _get_group(None)
+
+
+def _spmd(fn, x, n):
+    """Run fn over a length-n leading 'rank' axis with an axis name."""
+    mesh = dist_env.global_mesh({"r": n})
+    return jax.shard_map(fn, mesh=mesh, in_specs=P("r"), out_specs=P("r"))(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In the single-controller SPMD view, an eager all_reduce over the
+    device world is an identity on a replicated tensor; for tensors carrying
+    a per-rank leading axis it reduces that axis. This matches how the
+    eager DP path uses it (gradient reduction)."""
+    t = as_tensor(tensor)
+    g = _get_group(group)
+    if g.nranks <= 1:
+        return t
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+           ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+           ReduceOp.AVG: jnp.mean}[op]
+    if t.shape and t.shape[0] == g.nranks:
+        out = Tensor(red(t._data, axis=0))
+        tensor_obj = tensor if isinstance(tensor, Tensor) else t
+        tensor_obj._data = jnp.broadcast_to(
+            out._data[None], t._data.shape) if False else out._data
+        return out
+    return t
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    t = as_tensor(tensor)
+    g = _get_group(group)
+    for _ in range(g.nranks):
+        tensor_list.append(t)
+    return tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return as_tensor(tensor)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        rank = dist_env.get_rank()
+        tensor.set_value(tensor_list[rank if rank < len(tensor_list) else 0])
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    for t in in_tensor_list:
+        out_tensor_list.append(as_tensor(t))
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes requires the multi-host "
+        "backend; within one host use pipeline_parallel (ppermute)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes requires the multi-host "
+        "backend; within one host use pipeline_parallel (ppermute)")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(as_tensor(tensor)._data)
+
+
+def split(x, num_or_sections, axis=0):
+    from ..ops.manipulation import split as _split
+    return _split(x, num_or_sections, axis)

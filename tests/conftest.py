@@ -1,0 +1,19 @@
+"""Test harness: virtual 8-device CPU mesh (SURVEY.md §4 TPU translation —
+single-host multi-chip tests, v5e-8-like 8 ranks)."""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    yield

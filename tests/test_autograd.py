@@ -1,0 +1,139 @@
+"""Eager autograd engine tests — numpy/finite-difference oracle, mirroring
+the reference's OpTest.check_grad strategy (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    for i in range(x.size):
+        xp = x.copy().reshape(-1)
+        xm = x.copy().reshape(-1)
+        xp[i] += eps
+        xm[i] -= eps
+        fp = fn(xp.reshape(x.shape))
+        fm = fn(xm.reshape(x.shape))
+        g.reshape(-1)[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def test_simple_grad():
+    a = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    loss = (a * a).sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_and_fanout():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = a * 3.0
+    c = b * b + a  # dc/da = 2*3a*3 + 1 = 18a + 1
+    c.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [37.0])
+
+
+def test_matmul_grad_numeric():
+    xa = np.random.rand(3, 4).astype(np.float32)
+    wa = np.random.rand(4, 2).astype(np.float32)
+    x = paddle.to_tensor(xa, stop_gradient=False)
+    w = paddle.to_tensor(wa, stop_gradient=False)
+    loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    ng = numeric_grad(lambda v: float((v @ wa).sum()), xa)
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-2)
+
+
+def test_grad_accumulation():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    (a * 2).backward()
+    (a * 3).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [5.0])
+    a.clear_grad()
+    assert a.grad is None
+
+
+def test_no_grad():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        b = a * 2
+    assert b.stop_gradient
+    assert b._grad_node is None
+
+
+def test_stop_gradient_blocks():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = (a * 2).detach()
+    c = b * 3
+    assert c.stop_gradient
+
+
+def test_retain_graph():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = a * a
+    b.backward(retain_graph=True)
+    b.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])
+    with pytest.raises(RuntimeError):
+        b.backward()  # graph freed
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 1]])
+
+
+def test_backward_through_reduction_broadcast():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+    loss = ((x + y) ** 2).mean()
+    loss.backward()
+    assert x.grad.shape == [2, 3]
+    assert y.grad.shape == [3]
+    np.testing.assert_allclose(y.grad.numpy(), [4 / 3.0] * 3, rtol=1e-5)
+
+
+def test_grad_hook():
+    seen = []
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    a.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (a * 5).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+
+
+def test_non_scalar_backward_needs_grad_tensor():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 2
+    with pytest.raises(RuntimeError):
+        b.backward()
+    b.backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(a.grad.numpy(), [2.0, 2.0])
+
+
+def test_shared_subgraph_diamond():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = a * 3
+    c = b + b * b   # dc/db = 1 + 2b = 13; dc/da = 39
+    c.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [39.0])
+
+
+def test_int_tensor_no_grad():
+    i = paddle.to_tensor([1, 2, 3])
+    x = paddle.to_tensor(np.random.rand(3, 2).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.gather(x, i - 1)
+    out.sum().backward()
+    assert x.grad is not None

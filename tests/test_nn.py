@@ -1,0 +1,266 @@
+"""Layer library tests — numpy oracle + grad-flow checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = lin(x)
+    ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_shape_and_grad():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    out = conv(x)
+    assert out.shape == [2, 8, 8, 8]
+    out.mean().backward()
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+    assert conv.bias.grad.shape == [8]
+
+
+def test_conv2d_matches_torch_style_numpy():
+    # tiny conv vs explicit loop
+    conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+    w = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    conv.weight.set_value(w)
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    out = conv(paddle.to_tensor(x)).numpy()
+    expect = np.zeros((1, 1, 2, 2), np.float32)
+    for i in range(2):
+        for j in range(2):
+            expect[0, 0, i, j] = (x[0, 0, i:i+2, j:j+2] * w[0, 0]).sum()
+    np.testing.assert_allclose(out, expect)
+
+
+def test_conv_transpose_shape():
+    deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    out = deconv(x)
+    assert out.shape == [1, 2, 15, 15]
+
+
+def test_grouped_and_depthwise_conv():
+    conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+    out = conv(paddle.randn([1, 4, 5, 5]))
+    assert out.shape == [1, 8, 5, 5]
+    dw = nn.Conv2D(4, 4, 3, groups=4, padding=1)
+    assert dw(paddle.randn([1, 4, 5, 5])).shape == [1, 4, 5, 5]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 3.0 + 1.0
+    bn.train()
+    out = bn(x)
+    np.testing.assert_allclose(out.numpy().mean(axis=(0, 2, 3)), 0,
+                               atol=1e-4)
+    np.testing.assert_allclose(out.numpy().std(axis=(0, 2, 3)), 1,
+                               atol=1e-2)
+    # running stats moved
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8]) * 5
+    out = ln(x)
+    np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-4)
+    np.testing.assert_allclose(out.numpy().std(-1), 1, atol=2e-2)
+
+
+def test_groupnorm_instancenorm_rmsnorm():
+    assert nn.GroupNorm(2, 4)(paddle.randn([2, 4, 3, 3])).shape == \
+        [2, 4, 3, 3]
+    assert nn.InstanceNorm2D(4)(paddle.randn([2, 4, 3, 3])).shape == \
+        [2, 4, 3, 3]
+    assert nn.RMSNorm(8)(paddle.randn([2, 8])).shape == [2, 8]
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [1, 2, 1, 1]
+    m = F.max_pool2d(paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)), 2, 2)
+    np.testing.assert_allclose(m.numpy().reshape(-1), [5, 7, 13, 15])
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    out = emb(paddle.to_tensor([[1, 2], [3, 4]]))
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_modes():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    drop.train()
+    y = drop(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 2])
+    assert F.gelu(x).shape == [3]
+    assert F.softmax(x).numpy().sum() == pytest.approx(1.0, rel=1e-5)
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(),
+                               [-0.1, 0, 2], rtol=1e-6)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.rand(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 4, 1])
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([0, -100, 2, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    assert np.isfinite(float(loss))
+    soft = F.softmax(paddle.randn([4, 5]))
+    loss2 = F.cross_entropy(logits, soft, soft_label=True)
+    assert np.isfinite(float(loss2))
+
+
+def test_losses():
+    a, b = paddle.randn([3, 2]), paddle.randn([3, 2])
+    assert np.isfinite(float(nn.MSELoss()(a, b)))
+    assert np.isfinite(float(nn.L1Loss()(a, b)))
+    assert np.isfinite(float(nn.SmoothL1Loss()(a, b)))
+    logit = paddle.randn([4])
+    lbl = paddle.to_tensor([0.0, 1.0, 1.0, 0.0])
+    assert np.isfinite(float(nn.BCEWithLogitsLoss()(logit, lbl)))
+    p = F.sigmoid(logit)
+    assert np.isfinite(float(nn.BCELoss()(p, lbl)))
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 5, 8])  # [batch, time, feat]
+    y, (h, c) = lstm(x)
+    assert y.shape == [4, 5, 16]
+    assert h.shape == [2, 4, 16]
+    y.mean().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+    gru = nn.GRU(8, 16, direction="bidirect")
+    y2, h2 = gru(x)
+    assert y2.shape == [4, 5, 32]
+    assert h2.shape == [2, 4, 16]
+
+
+def test_lstm_cell():
+    cell = nn.LSTMCell(4, 8)
+    out, (h, c) = cell(paddle.randn([2, 4]))
+    assert out.shape == [2, 8] and c.shape == [2, 8]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    out.mean().backward()
+    grads = [p.grad for p in enc.parameters()]
+    assert all(g is not None for g in grads)
+
+
+def test_full_transformer():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_mha_causal_cache():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_sdpa_matches_reference():
+    q = paddle.randn([2, 4, 2, 8])
+    k = paddle.randn([2, 4, 2, 8])
+    v = paddle.randn([2, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, k, v)
+    qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+    logits = np.einsum("bshd,bthd->bhst", qn, kn) / np.sqrt(8)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bthd->bshd", w, vn)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy())
+
+
+def test_save_load_file(tmp_path):
+    m = nn.Linear(3, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_named_parameters_and_containers():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert "0.weight" in names and "1.bias" in names
+    ll = nn.LayerList([nn.Linear(2, 2)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 2
+    assert len(list(ll.parameters())) == 4
+
+
+def test_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h = m.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    m(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    m(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_interpolate_pad():
+    x = paddle.randn([1, 2, 4, 4])
+    assert F.interpolate(x, scale_factor=2, mode="nearest").shape == \
+        [1, 2, 8, 8]
+    assert F.pad(x, [1, 1, 1, 1]).shape == [1, 2, 6, 6]

@@ -1,0 +1,157 @@
+"""Optimizer + scheduler + clip tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _train_quadratic(opt_factory, steps=60):
+    """Minimise ||w - target||^2; return final distance."""
+    target = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+    w = paddle.core.Parameter(np.zeros(3, np.float32))
+    opt = opt_factory([w])
+    for _ in range(steps):
+        loss = ((w - target) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(((w - target) ** 2).sum())
+
+
+@pytest.mark.parametrize("factory", [
+    lambda ps: paddle.optimizer.SGD(0.1, parameters=ps),
+    lambda ps: paddle.optimizer.Momentum(0.05, parameters=ps),
+    lambda ps: paddle.optimizer.Adam(0.2, parameters=ps),
+    lambda ps: paddle.optimizer.AdamW(0.2, parameters=ps,
+                                      weight_decay=0.001),
+    lambda ps: paddle.optimizer.Adagrad(0.5, parameters=ps),
+    lambda ps: paddle.optimizer.RMSProp(0.05, parameters=ps),
+    lambda ps: paddle.optimizer.Adamax(0.3, parameters=ps),
+    lambda ps: paddle.optimizer.Lamb(0.5, parameters=ps),
+    lambda ps: paddle.optimizer.Adadelta(40.0, parameters=ps),
+], ids=["sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop", "adamax",
+        "lamb", "adadelta"])
+def test_optimizers_converge(factory):
+    final = _train_quadratic(factory)
+    assert final < 0.3, f"did not converge: {final}"
+
+
+def test_adam_matches_reference_impl():
+    # one step of adam vs hand-rolled numpy
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.1, -0.2], np.float32)
+    w = paddle.core.Parameter(w0.copy())
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w])
+    w.grad = paddle.to_tensor(g)
+    opt.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), expect, rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    w = paddle.core.Parameter(np.array([10.0], np.float32))
+    opt = paddle.optimizer.SGD(0.1, parameters=[w], weight_decay=0.5)
+    w.grad = paddle.to_tensor([0.0])
+    opt.step()
+    # g = 0 + 0.5*10 = 5; w = 10 - 0.1*5 = 9.5
+    np.testing.assert_allclose(w.numpy(), [9.5], rtol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    w1 = paddle.core.Parameter(np.zeros(2, np.float32))
+    w2 = paddle.core.Parameter(np.zeros(2, np.float32))
+    clip = paddle.nn.clip.ClipGradByGlobalNorm(1.0) if hasattr(
+        paddle.nn, "clip") else None
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+    opt = paddle.optimizer.SGD(1.0, parameters=[w1, w2],
+                               grad_clip=ClipGradByGlobalNorm(1.0))
+    w1.grad = paddle.to_tensor([3.0, 0.0])
+    w2.grad = paddle.to_tensor([0.0, 4.0])
+    opt.step()
+    # global norm 5 -> scale 1/5
+    np.testing.assert_allclose(w1.numpy(), [-0.6, 0.0], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [0.0, -0.8], rtol=1e-5)
+
+
+def test_lr_scheduler_step():
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    w = paddle.core.Parameter(np.zeros(1, np.float32))
+    opt = paddle.optimizer.SGD(sched, parameters=[w])
+    lrs = []
+    for i in range(4):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05])
+
+
+def test_schedulers_shapes():
+    lr = paddle.optimizer.lr
+    assert lr.NoamDecay(64, 100).get_lr() > 0
+    assert lr.CosineAnnealingDecay(0.1, 10).get_lr() == pytest.approx(0.1)
+    s = lr.LinearWarmup(0.1, 10, 0.0, 0.1)
+    vals = []
+    for _ in range(12):
+        vals.append(s.get_lr())
+        s.step()
+    assert vals[0] == pytest.approx(0.0)
+    assert vals[-1] == pytest.approx(0.1)
+    assert lr.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1]).get_lr() == 1.0
+    assert lr.PolynomialDecay(0.1, 10).get_lr() == pytest.approx(0.1)
+    assert lr.ExponentialDecay(0.1, 0.9).get_lr() == pytest.approx(0.1)
+    assert lr.MultiStepDecay(0.1, [3, 6]).get_lr() == pytest.approx(0.1)
+    assert lr.LambdaDecay(0.1, lambda e: 1 / (e + 1)).get_lr() > 0
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.core.Parameter(np.ones(2, np.float32))
+    w.name = "w"
+    opt = paddle.optimizer.Adam(parameters=[w])
+    w.grad = paddle.to_tensor([0.1, 0.1])
+    opt.step()
+    state = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(parameters=[w])
+    opt2.set_state_dict(state)
+    assert opt2._step_count == 1
+    acc = opt2._get_accums(w)
+    np.testing.assert_allclose(np.asarray(acc["moment1"]),
+                               np.asarray(opt._get_accums(w)["moment1"]))
+
+
+def test_minimize():
+    w = paddle.core.Parameter(np.array([2.0], np.float32))
+    opt = paddle.optimizer.SGD(0.1, parameters=[w])
+    loss = (w * w).sum()
+    opt.minimize(loss)
+    np.testing.assert_allclose(w.numpy(), [1.6], rtol=1e-6)
+
+
+def test_amp_autocast_and_scaler():
+    import paddle_tpu.amp as amp
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with amp.auto_cast(level="O1"):
+        out = lin(x)
+        assert out.dtype == paddle.bfloat16
+    out32 = lin(x)
+    assert out32.dtype == np.float32
+    scaler = amp.GradScaler(init_loss_scaling=128.0)
+    opt = paddle.optimizer.SGD(0.01, parameters=lin.parameters())
+    with amp.auto_cast(level="O1"):
+        loss = lin(x).astype("float32").mean()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    assert scaler.get_loss_scaling().item() >= 1.0
+
+
+def test_amp_o2_decorate():
+    import paddle_tpu.amp as amp
+    lin = nn.Linear(4, 4)
+    amp.decorate(lin, level="O2")
+    assert lin.weight.dtype == paddle.bfloat16

@@ -1,0 +1,133 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    assert t.stop_gradient
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_scalar_and_int_dtypes():
+    assert paddle.to_tensor(3).dtype == np.int32  # canonical int on TPU
+    assert paddle.to_tensor(3.0).dtype == np.float32
+    assert paddle.to_tensor(True).dtype == np.bool_
+    assert paddle.to_tensor([1, 2]).astype("float32").dtype == np.float32
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a * 2).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((2 - a).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((a / b).numpy(), [0.25, 0.4, 0.5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    assert bool((a < b).all())
+    np.testing.assert_allclose((a @ b).numpy(), 32.0)
+
+
+def test_indexing():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = paddle.to_tensor(7.0)
+    assert float(x[0, 0]) == 7.0
+
+
+def test_methods_bound():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert float(x.sum()) == 10.0
+    assert float(x.mean()) == 2.5
+    assert x.reshape([4]).shape == [4]
+    assert x.transpose([1, 0]).shape == [2, 2]
+    assert x.unsqueeze(0).shape == [1, 2, 2]
+    assert x.T.shape == [2, 2]
+    assert x.astype("int32").dtype == np.int32
+
+
+def test_item_and_repr():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert "Tensor" in repr(t)
+
+
+def test_detach_clone():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    d = a.detach()
+    assert d.stop_gradient
+    c = a.clone()
+    assert not c.stop_gradient
+
+
+def test_set_value():
+    p = paddle.nn.Linear(2, 2).weight
+    old = p.numpy()
+    p.set_value(np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(p.numpy(), np.ones((2, 2)))
+    assert old.shape == (2, 2)
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], "int32").dtype == np.int32
+    assert float(paddle.full([1], 7.0)) == 7.0
+    np.testing.assert_allclose(paddle.arange(3).numpy(), [0, 1, 2])
+    assert paddle.eye(3).shape == [3, 3]
+    np.testing.assert_allclose(paddle.linspace(0, 1, 3).numpy(),
+                               [0, 0.5, 1.0])
+    assert paddle.tril(paddle.ones([3, 3])).numpy()[0, 2] == 0
+
+
+def test_where_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_allclose(i.numpy(), [0, 2])
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 2, 3])
+    c = paddle.to_tensor([True, False, True])
+    np.testing.assert_allclose(
+        paddle.where(c, x, paddle.zeros_like(x)).numpy(), [3, 0, 2])
+
+
+def test_concat_split_stack():
+    a = paddle.ones([2, 3])
+    b = paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    s = paddle.stack([a, b], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, [1, 3], axis=0)
+    assert parts[1].shape == [3, 3]
+
+
+def test_gather_scatter():
+    x = paddle.arange(12, dtype="float32").reshape([4, 3])
+    g = paddle.gather(x, paddle.to_tensor([0, 2]))
+    np.testing.assert_allclose(g.numpy()[1], [6, 7, 8])
+    upd = paddle.scatter(x, paddle.to_tensor([0]),
+                         paddle.full([1, 3], -1.0))
+    np.testing.assert_allclose(upd.numpy()[0], [-1, -1, -1])
+
+
+def test_einsum():
+    a = paddle.randn([2, 3])
+    b = paddle.randn([3, 4])
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(),
+                               rtol=1e-5)
