@@ -26,7 +26,7 @@ class Tensor:
 
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_grad_node", "_out_slot",
-        "name", "persistable", "_grad_hooks", "trainable",
+        "name", "persistable", "_grad_hooks", "trainable", "dist_spec",
     )
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
@@ -65,6 +65,7 @@ class Tensor:
         self.persistable = False
         self.trainable = not stop_gradient
         self._grad_hooks = []
+        self.dist_spec = None  # jax PartitionSpec for SPMD placement
 
     # -- basic metadata -------------------------------------------------
     @property

@@ -74,20 +74,46 @@ class Model:
         self._metrics = _to_list(metrics)
         self._train_step = None
         self._eval_step = None
+        self._dist_mesh = None
         from ..parallel import env as dist_env
         if dist_env.get_world_size() > 1:
             dist_env.init_parallel_env()
+            from ..parallel.topology import get_hybrid_communicate_group
+            from ..parallel.mp_layers import place_model_on_mesh
+            mesh = get_hybrid_communicate_group().mesh()
+            if mesh.size > 1:
+                self._dist_mesh = mesh
+                place_model_on_mesh(self.network, mesh)
         return self
 
     # ------------------------------------------------------------- batch
     def _n_labels(self):
         return max(len(self._labels), 1)
 
+    def _maybe_shard(self, arrays):
+        """Shard batch dim 0 over the dp mesh axis (DataParallel: the
+        EagerReducer capability folds into the compiled step's GSPMD grad
+        reduction)."""
+        if getattr(self, "_dist_mesh", None) is None:
+            return arrays
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._dist_mesh
+        dp = mesh.shape.get("dp", 1)
+        out = []
+        for a in arrays:
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] % dp == 0:
+                spec = P("dp", *([None] * (a.ndim - 1)))
+                out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+            else:
+                out.append(a)
+        return out
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        batch = _arrays(inputs) + _arrays(labels)
+        batch = self._maybe_shard(_arrays(inputs) + _arrays(labels))
         if self._jit_ok:
             try:
                 if self._train_step is None:
@@ -102,6 +128,10 @@ class Model:
                 warnings.warn(
                     f"compiled train step failed ({type(e).__name__}: {e}); "
                     "falling back to eager execution")
+                if self._train_step is not None:
+                    # undo the ZeRO flat accumulator layout so the eager
+                    # optimizer path sees logical shapes again
+                    self._train_step.restore_accums()
                 self._jit_ok = False
         # eager path (DynamicGraphAdapter.train_batch parity)
         outs = self.network(*[t if isinstance(t, Tensor) else Tensor(t)
@@ -120,7 +150,7 @@ class Model:
         self.network.eval()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        batch = _arrays(inputs) + _arrays(labels)
+        batch = self._maybe_shard(_arrays(inputs) + _arrays(labels))
         if self._eval_step is None:
             self._eval_step = CompiledEvalStep(
                 self.network, self._loss, n_labels=len(labels) or 1)

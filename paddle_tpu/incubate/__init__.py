@@ -2,3 +2,4 @@
 MoE lives in paddle_tpu.incubate.distributed.models.moe (parity path).
 """
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

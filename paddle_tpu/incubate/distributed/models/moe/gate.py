@@ -1,0 +1,100 @@
+"""MoE gates — parity: `python/paddle/incubate/distributed/models/moe/gate/`
+(naive_gate.py, gshard_gate.py, switch_gate.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer_base import Layer
+from .....nn.layers.common import Linear
+from .....core.tensor import Tensor
+from .....core import dispatch
+from .....ops._helpers import as_tensor
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.topk = topk
+        self.loss = None
+
+    def get_loss(self):
+        return self.loss
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no auxiliary loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.gate = Linear(d_model, self.tot_expert)
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        k = self.topk
+
+        def _fn(lg):
+            val, idx = jax.lax.top_k(lg, k)
+            return jax.nn.softmax(val, axis=-1), idx
+        val, idx = dispatch.apply("naive_gate", _fn, (as_tensor(logits),))
+        return val, idx
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch gate with load-balance aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_expert, world_size, 1)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.switch_eps = switch_eps
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        E = self.tot_expert
+
+        def _fn(lg):
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            idx = jnp.argmax(probs, axis=-1)
+            val = jnp.max(probs, axis=-1)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(idx, E, dtype=jnp.float32),
+                          axis=0)
+            aux = E * jnp.sum(me * ce)
+            return val[:, None], idx[:, None].astype(jnp.int32), aux
+        val, idx, aux = dispatch.apply("switch_gate", _fn,
+                                       (as_tensor(logits),))
+        self.loss = aux
+        return val, idx
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True):
+        super().__init__(d_model, num_expert, world_size, 2)
+        self.gate = Linear(d_model, self.tot_expert)
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        E = self.tot_expert
+
+        def _fn(lg):
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            val, idx = jax.lax.top_k(probs, 2)
+            top1 = idx[:, 0]
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32),
+                          axis=0)
+            aux = E * jnp.sum(me * ce)
+            return val / jnp.sum(val, -1, keepdims=True), \
+                idx.astype(jnp.int32), aux
+        val, idx, aux = dispatch.apply("gshard_gate", _fn,
+                                       (as_tensor(logits),))
+        self.loss = aux
+        return val, idx

@@ -1,0 +1,4 @@
+from .gpt import (  # noqa: F401
+    GPTModel, GPTForPretraining, GPTPretrainingCriterion, GPTDecoderLayer,
+    gpt_tiny, gpt2_small, gpt2_medium, gpt3_1p3b,
+)

@@ -1,0 +1,105 @@
+"""GPT model family (paddle-API, nn.Layer-based).
+
+Parity target: the PaddleNLP/fleetx GPT used in the reference's hybrid
+parallel examples (BASELINE config 4). For the performance/parallel path
+use `paddle_tpu.parallel.hybrid_gpt.HybridGPT` — this class is the
+user-facing eager/single-chip model.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from .. import ops
+from ..core.tensor import Tensor
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, d_model, n_heads, d_ff, dropout=0.0):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(d_model)
+        self.attn = nn.MultiHeadAttention(d_model, n_heads, dropout=dropout)
+        self.ln2 = nn.LayerNorm(d_model)
+        self.fc1 = nn.Linear(d_model, d_ff)
+        self.fc2 = nn.Linear(d_ff, d_model)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        h = self.ln1(x)
+        x = x + self.dropout(self.attn(h, h, h, attn_mask=mask))
+        h = self.ln2(x)
+        x = x + self.dropout(self.fc2(nn.functional.gelu(self.fc1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_attention_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, hidden_dropout_prob=0.0):
+        super().__init__()
+        d_ff = intermediate_size or 4 * hidden_size
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        self.layers = nn.LayerList([
+            GPTDecoderLayer(hidden_size, num_attention_heads, d_ff,
+                            hidden_dropout_prob)
+            for _ in range(num_layers)])
+        self.ln_f = nn.LayerNorm(hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(seq, dtype="int64")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        # causal mask: bool [S, S], True = attend
+        mask = ops.cast(ops.tril(ops.ones([seq, seq], "float32")), "bool")
+        for layer in self.layers:
+            x = layer(x, mask=mask)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    def __init__(self, gpt: GPTModel):
+        super().__init__()
+        self.gpt = gpt
+        self.lm_head = nn.Linear(gpt.hidden_size, gpt.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        return self.lm_head(hidden)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    def forward(self, prediction_scores, masked_lm_labels,
+                loss_mask=None):
+        loss = nn.functional.cross_entropy(
+            prediction_scores.reshape([-1, prediction_scores.shape[-1]]),
+            masked_lm_labels.reshape([-1]), reduction="mean")
+        return loss
+
+
+def gpt_tiny(**kw):
+    return GPTModel(vocab_size=1024, hidden_size=128, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=256,
+                    **kw)
+
+
+def gpt2_small(**kw):
+    return GPTModel(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_attention_heads=12, **kw)
+
+
+def gpt2_medium(**kw):
+    return GPTModel(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_attention_heads=16, **kw)
+
+
+def gpt3_1p3b(**kw):
+    return GPTModel(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_attention_heads=16, max_position_embeddings=2048,
+                    **kw)

@@ -172,12 +172,22 @@ class Optimizer:
     # ----------------------------------------------------------- state
     def state_dict(self):
         state = {"step_count": self._step_count}
+        zero_shapes = getattr(self, "_zero_accum_shapes", {})
         if self._parameter_list is not None:
             for i, p in enumerate(self._parameter_list):
                 acc = self._accumulators.get(id(p))
                 if acc:
+                    shapes = zero_shapes.get(id(p), {})
                     for name, arr in acc.items():
-                        state[f"{p.name or i}_{name}"] = np.asarray(arr)
+                        a = np.asarray(arr)
+                        if name in shapes and a.ndim == 1 and \
+                                tuple(a.shape) != tuple(shapes[name][0]):
+                            # ZeRO flat layout -> logical shape for the
+                            # checkpoint (portable across shardings)
+                            shape, dtype = shapes[name]
+                            n = int(np.prod(shape)) if shape else 1
+                            a = a[:n].reshape(shape).astype(dtype)
+                        state[f"{p.name or i}_{name}"] = a
         if isinstance(self._learning_rate, LRScheduler):
             state["LR_Scheduler"] = self._learning_rate.state_dict()
         return state
