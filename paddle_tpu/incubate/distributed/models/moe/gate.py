@@ -52,19 +52,27 @@ class SwitchGate(BaseGate):
         super().__init__(d_model, num_expert, world_size, 1)
         self.gate = Linear(d_model, self.tot_expert)
         self.switch_eps = switch_eps
+        self.capacity = capacity
 
     def forward(self, inp):
         logits = self.gate(inp)
         E = self.tot_expert
+        cap_factor = self.capacity[0] if self.training else self.capacity[1]
 
         def _fn(lg):
+            T = lg.shape[0]
+            cap = max(1, int(cap_factor * T / E))
             probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
             idx = jnp.argmax(probs, axis=-1)
             val = jnp.max(probs, axis=-1)
+            oh = jax.nn.one_hot(idx, E, dtype=jnp.int32)
             me = jnp.mean(probs, axis=0)
-            ce = jnp.mean(jax.nn.one_hot(idx, E, dtype=jnp.float32),
-                          axis=0)
+            ce = jnp.mean(oh.astype(jnp.float32), axis=0)
             aux = E * jnp.sum(me * ce)
+            # capacity: zero the gate of overflow tokens (reference
+            # prune_gate_by_capacity op)
+            pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)
+            val = jnp.where(pos < cap, val, 0.0)
             return val[:, None], idx[:, None].astype(jnp.int32), aux
         val, idx, aux = dispatch.apply("switch_gate", _fn,
                                        (as_tensor(logits),))
@@ -79,20 +87,30 @@ class GShardGate(BaseGate):
                  capacity=(1.2, 2.4), random_routing=True):
         super().__init__(d_model, num_expert, world_size, 2)
         self.gate = Linear(d_model, self.tot_expert)
+        self.capacity = capacity
+        self.random_routing = random_routing
 
     def forward(self, inp):
         logits = self.gate(inp)
         E = self.tot_expert
+        cap_factor = self.capacity[0] if self.training else self.capacity[1]
 
         def _fn(lg):
+            T = lg.shape[0]
+            cap = max(1, int(cap_factor * T / E))
             probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
             val, idx = jax.lax.top_k(probs, 2)
             top1 = idx[:, 0]
+            oh1 = jax.nn.one_hot(top1, E, dtype=jnp.int32)
             me = jnp.mean(probs, axis=0)
-            ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32),
-                          axis=0)
+            ce = jnp.mean(oh1.astype(jnp.float32), axis=0)
             aux = E * jnp.sum(me * ce)
-            return val / jnp.sum(val, -1, keepdims=True), \
+            # capacity-prune the primary expert (secondary experts keep
+            # their gate — GShard prunes them after dispatch)
+            pos = jnp.sum(jnp.cumsum(oh1, axis=0) * oh1 - oh1, axis=-1)
+            val = val.at[:, 0].set(jnp.where(pos < cap, val[:, 0], 0.0))
+            return val / jnp.maximum(
+                jnp.sum(val, -1, keepdims=True), 1e-12), \
                 idx.astype(jnp.int32), aux
         val, idx, aux = dispatch.apply("gshard_gate", _fn,
                                        (as_tensor(logits),))

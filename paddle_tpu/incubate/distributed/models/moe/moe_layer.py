@@ -44,7 +44,6 @@ class MoELayer(Layer):
         from ..... import ops
         x = ops.reshape(inp, [-1, d])  # [T, d]
         gate_val, gate_idx = self.gate(x)  # [T, k], [T, k]
-        k = gate_idx.shape[-1]
         E = self.num_expert
 
         # run every expert on all tokens, combine by gates (dense combine;

@@ -77,10 +77,16 @@ class GPTForPretraining(nn.Layer):
 class GPTPretrainingCriterion(nn.Layer):
     def forward(self, prediction_scores, masked_lm_labels,
                 loss_mask=None):
-        loss = nn.functional.cross_entropy(
+        per_tok = nn.functional.cross_entropy(
             prediction_scores.reshape([-1, prediction_scores.shape[-1]]),
-            masked_lm_labels.reshape([-1]), reduction="mean")
-        return loss
+            masked_lm_labels.reshape([-1]), reduction="none")
+        if loss_mask is not None:
+            mask = loss_mask.reshape([-1]).astype("float32")
+            from .. import ops
+            return ops.sum(per_tok * mask) / ops.maximum(
+                ops.sum(mask), ops.to_tensor(1e-8))
+        from .. import ops
+        return ops.mean(per_tok)
 
 
 def gpt_tiny(**kw):

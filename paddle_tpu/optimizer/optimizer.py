@@ -207,7 +207,18 @@ class Optimizer:
                         loaded[name] = jnp.asarray(state[k])
                 if loaded:
                     acc = self._get_accums(p)
-                    acc.update(loaded)
+                    for name, arr in loaded.items():
+                        cur = acc.get(name)
+                        if cur is not None and cur.ndim == 1 and \
+                                arr.shape != cur.shape:
+                            # live accums are in the ZeRO flat layout
+                            # (CompiledTrainStep); re-flatten the logical
+                            # checkpoint array to match
+                            flat = jnp.pad(
+                                arr.reshape(-1).astype(cur.dtype),
+                                (0, cur.shape[0] - arr.size))
+                            arr = jax.device_put(flat, cur.sharding)
+                        acc[name] = arr
 
     @property
     def _param_groups(self):

@@ -1,0 +1,9 @@
+"""paddle_tpu.ps — the native parameter-server / embedding engine
+(SURVEY.md §2.3 PS core + §7.7): C++ sharded hash tables with in-table SGD
+rules, dense tables, the out-of-core slot Dataset/DataFeed, and the
+PS-backed SparseEmbedding layer feeding TPU steps.
+"""
+from .table import (MemorySparseTable, MemoryDenseTable,  # noqa: F401
+                    InMemoryDataset)
+from .embedding import SparseEmbedding  # noqa: F401
+from .runtime import get_ps_runtime, PSRuntime  # noqa: F401

@@ -1,0 +1,460 @@
+// ps_core — native parameter-server engine for paddle_tpu.
+//
+// Reference parity (re-designed, not ported):
+//   - MemorySparseTable (paddle/fluid/distributed/ps/table/
+//     memory_sparse_table.h): shard-parallel hash tables keyed by uint64
+//     feature ids, values = accessor-defined float blocks.
+//   - Accessor + SGD rules (ps/table/ctr_accessor.h, sparse_sgd_rule.h):
+//     CTR-style value layout [show, click, slot, emb(dim), g2sum(dim)]
+//     with naive / adagrad / adam update applied IN the table on push
+//     (the HeterPS optimizer.cuh.h "SGD inside the table" capability,
+//     executed on host CPU feeding the TPU step).
+//   - MemoryDenseTable (ps/table/memory_dense_table.h): flat dense params.
+//   - DataFeed/Dataset channels (framework/data_feed.h, data_set.h:230
+//     LoadIntoMemory + shuffle): slot-file parser + in-memory record pool.
+//
+// Plain C ABI (loaded via ctypes; no pybind dependency). Thread-safe per
+// shard; bulk ops fan out over an internal thread pool.
+//
+// Build: g++ -O3 -march=native -std=c++17 -shared -fPIC ps_core.cpp -o libps_core.so -lpthread
+
+#include <atomic>
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShardBits = 6;
+constexpr int kShards = 1 << kShardBits;  // 64 shards
+
+enum SgdRule : int { kNaive = 0, kAdaGrad = 1, kAdam = 2 };
+
+struct TableConfig {
+  int dim = 8;             // embedding dim
+  int rule = kAdaGrad;
+  float lr = 0.05f;
+  float initial_range = 0.02f;
+  float initial_g2sum = 3.0f;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  float nonclk_coeff = 0.1f, clk_coeff = 1.0f;  // show/click score
+  float decay_rate = 0.98f;  // show/click decay on shrink
+};
+
+// value block layout (CtrCommonAccessor-flavoured):
+// [0] show  [1] click  [2] unseen_days  [3..3+dim) w
+// adagrad: [3+dim .. 3+2*dim) g2sum
+// adam:    [3+dim..3+2dim) m, [3+2dim..3+3dim) v, [3+3dim] beta1_pow,
+//          [3+3dim+1] beta2_pow
+struct SparseTable {
+  TableConfig cfg;
+  int value_len;
+  std::unordered_map<uint64_t, std::vector<float>> shards[kShards];
+  std::mutex locks[kShards];
+  std::mt19937 rngs[kShards];
+
+  explicit SparseTable(const TableConfig& c) : cfg(c) {
+    int extra = 0;
+    if (cfg.rule == kAdaGrad) extra = cfg.dim;
+    else if (cfg.rule == kAdam) extra = 3 * cfg.dim + 2;
+    value_len = 3 + cfg.dim + extra;
+    for (int i = 0; i < kShards; i++) rngs[i].seed(1234 + i);
+  }
+
+  static int shard_of(uint64_t key) {
+    // mix then take low bits
+    uint64_t h = key * 0x9E3779B97F4A7C15ull;
+    return static_cast<int>((h >> 32) & (kShards - 1));
+  }
+
+  std::vector<float>& get_or_init(uint64_t key, int s) {
+    auto it = shards[s].find(key);
+    if (it != shards[s].end()) return it->second;
+    std::vector<float> v(value_len, 0.0f);
+    std::uniform_real_distribution<float> dist(-cfg.initial_range,
+                                               cfg.initial_range);
+    for (int i = 0; i < cfg.dim; i++) v[3 + i] = dist(rngs[s]);
+    if (cfg.rule == kAdaGrad) {
+      for (int i = 0; i < cfg.dim; i++) v[3 + cfg.dim + i] =
+          cfg.initial_g2sum;
+    } else if (cfg.rule == kAdam) {
+      v[3 + 3 * cfg.dim] = 1.0f;      // beta1_pow
+      v[3 + 3 * cfg.dim + 1] = 1.0f;  // beta2_pow
+    }
+    return shards[s].emplace(key, std::move(v)).first->second;
+  }
+
+  void pull(const uint64_t* keys, int n, float* out) {
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      std::memcpy(out + (size_t)i * cfg.dim, v.data() + 3,
+                  sizeof(float) * cfg.dim);
+    });
+  }
+
+  void push(const uint64_t* keys, const float* grads, int n,
+            const float* shows, const float* clicks) {
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      if (shows) v[0] += shows[i];
+      if (clicks) v[1] += clicks[i];
+      v[2] = 0.0f;  // unseen_days reset
+      const float* grad = grads + (size_t)i * cfg.dim;
+      float* w = v.data() + 3;
+      switch (cfg.rule) {
+        case kNaive: {
+          for (int d = 0; d < cfg.dim; d++) w[d] -= cfg.lr * grad[d];
+          break;
+        }
+        case kAdaGrad: {  // SparseAdaGradSGDRule parity
+          float* g2 = v.data() + 3 + cfg.dim;
+          for (int d = 0; d < cfg.dim; d++) {
+            g2[d] += grad[d] * grad[d];
+            w[d] -= cfg.lr * grad[d] / std::sqrt(g2[d] + cfg.eps);
+          }
+          break;
+        }
+        case kAdam: {  // SparseAdamSGDRule parity
+          float* m = v.data() + 3 + cfg.dim;
+          float* vv = v.data() + 3 + 2 * cfg.dim;
+          float& b1p = v[3 + 3 * cfg.dim];
+          float& b2p = v[3 + 3 * cfg.dim + 1];
+          b1p *= cfg.beta1;
+          b2p *= cfg.beta2;
+          for (int d = 0; d < cfg.dim; d++) {
+            m[d] = cfg.beta1 * m[d] + (1 - cfg.beta1) * grad[d];
+            vv[d] = cfg.beta2 * vv[d] + (1 - cfg.beta2) * grad[d] * grad[d];
+            float mhat = m[d] / (1 - b1p);
+            float vhat = vv[d] / (1 - b2p);
+            w[d] -= cfg.lr * mhat / (std::sqrt(vhat) + cfg.eps);
+          }
+          break;
+        }
+      }
+    });
+  }
+
+  // one pass of day-level maintenance: decay show/click, age features,
+  // drop features whose score is below threshold (Table::Shrink parity)
+  int64_t shrink(float score_threshold, int max_unseen_days) {
+    std::atomic<int64_t> removed{0};
+    std::vector<std::thread> ts;
+    for (int s = 0; s < kShards; s++) {
+      ts.emplace_back([&, s]() {
+        std::lock_guard<std::mutex> g(locks[s]);
+        auto& mp = shards[s];
+        for (auto it = mp.begin(); it != mp.end();) {
+          auto& v = it->second;
+          v[0] *= cfg.decay_rate;
+          v[1] *= cfg.decay_rate;
+          v[2] += 1.0f;
+          float score = cfg.nonclk_coeff * (v[0] - v[1]) +
+                        cfg.clk_coeff * v[1];
+          if (score < score_threshold &&
+              v[2] > static_cast<float>(max_unseen_days)) {
+            it = mp.erase(it);
+            removed++;
+          } else {
+            ++it;
+          }
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+    return removed.load();
+  }
+
+  int64_t size() const {
+    int64_t n = 0;
+    for (int s = 0; s < kShards; s++) n += (int64_t)shards[s].size();
+    return n;
+  }
+
+  int save(const char* path) {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return -1;
+    int64_t total = size();
+    std::fwrite(&total, sizeof(total), 1, f);
+    std::fwrite(&value_len, sizeof(value_len), 1, f);
+    for (int s = 0; s < kShards; s++) {
+      for (auto& kv : shards[s]) {
+        std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
+        std::fwrite(kv.second.data(), sizeof(float), value_len, f);
+      }
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+  int load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    int64_t total = 0;
+    int vl = 0;
+    if (std::fread(&total, sizeof(total), 1, f) != 1 ||
+        std::fread(&vl, sizeof(vl), 1, f) != 1 || vl != value_len) {
+      std::fclose(f);
+      return -2;
+    }
+    for (int64_t i = 0; i < total; i++) {
+      uint64_t k;
+      std::vector<float> v(value_len);
+      if (std::fread(&k, sizeof(k), 1, f) != 1 ||
+          std::fread(v.data(), sizeof(float), value_len, f) !=
+              (size_t)value_len) {
+        std::fclose(f);
+        return -3;
+      }
+      int s = shard_of(k);
+      shards[s][k] = std::move(v);
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+  template <typename F>
+  static void parallel_for(int n, F&& fn) {
+    int nthreads = std::min<int>(std::thread::hardware_concurrency(),
+                                 std::max(1, n / 4096));
+    if (nthreads <= 1) {
+      for (int i = 0; i < n; i++) fn(i);
+      return;
+    }
+    std::vector<std::thread> ts;
+    std::atomic<int> next{0};
+    for (int t = 0; t < nthreads; t++) {
+      ts.emplace_back([&]() {
+        constexpr int kChunk = 1024;
+        while (true) {
+          int start = next.fetch_add(kChunk);
+          if (start >= n) break;
+          int end = std::min(n, start + kChunk);
+          for (int i = start; i < end; i++) fn(i);
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+  }
+};
+
+struct DenseTable {
+  std::vector<float> data;
+  std::vector<float> m, v;  // adam state
+  float lr = 0.01f;
+  int rule = kNaive;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  int64_t step = 0;
+  std::mutex lock;
+};
+
+// ------------------------------------------------------------ DataFeed
+// Slot-record text parser (MultiSlotDataFeed capability):
+// each line: "<label> <slot_id>:<feature_sign> <slot_id>:<feature_sign> ..."
+struct Record {
+  float label;
+  std::vector<std::pair<int, uint64_t>> feats;  // (slot, sign)
+};
+
+struct Dataset {
+  std::vector<Record> records;
+  std::mutex lock;
+  std::atomic<int64_t> cursor{0};
+
+  int load_file(const char* path) {
+    FILE* f = std::fopen(path, "r");
+    if (!f) return -1;
+    char line[1 << 16];
+    std::vector<Record> local;
+    while (std::fgets(line, sizeof(line), f)) {
+      Record r;
+      char* save = nullptr;
+      char* tok = strtok_r(line, " \t\n", &save);
+      if (!tok) continue;
+      r.label = std::strtof(tok, nullptr);
+      while ((tok = strtok_r(nullptr, " \t\n", &save))) {
+        char* colon = std::strchr(tok, ':');
+        if (!colon) continue;
+        *colon = 0;
+        int slot = std::atoi(tok);
+        uint64_t sign = std::strtoull(colon + 1, nullptr, 10);
+        r.feats.emplace_back(slot, sign);
+      }
+      // skip malformed lines that parsed no features (a bare token would
+      // otherwise become a label-0 empty record and pollute training)
+      if (r.feats.empty()) continue;
+      local.push_back(std::move(r));
+    }
+    std::fclose(f);
+    std::lock_guard<std::mutex> g(lock);
+    for (auto& r : local) records.push_back(std::move(r));
+    return 0;
+  }
+
+  void shuffle(uint64_t seed) {
+    std::lock_guard<std::mutex> g(lock);
+    std::mt19937_64 rng(seed);
+    std::shuffle(records.begin(), records.end(), rng);
+    cursor = 0;
+  }
+
+  // fixed-slot batch: out_keys [batch, n_slots, max_feats_per_slot]
+  // (0-padded), out_labels [batch]; returns #rows filled
+  int next_batch(int batch, const int* slot_ids, int n_slots,
+                 int max_per_slot, uint64_t* out_keys, float* out_labels) {
+    int64_t start = cursor.fetch_add(batch);
+    if (start >= (int64_t)records.size()) return 0;
+    int nrows = std::min<int64_t>(batch, records.size() - start);
+    std::memset(out_keys, 0,
+                sizeof(uint64_t) * (size_t)batch * n_slots * max_per_slot);
+    for (int i = 0; i < nrows; i++) {
+      const Record& r = records[start + i];
+      out_labels[i] = r.label;
+      std::vector<int> counts(n_slots, 0);
+      for (auto& f : r.feats) {
+        for (int sidx = 0; sidx < n_slots; sidx++) {
+          if (slot_ids[sidx] == f.first && counts[sidx] < max_per_slot) {
+            out_keys[((size_t)i * n_slots + sidx) * max_per_slot +
+                     counts[sidx]] = f.second;
+            counts[sidx]++;
+            break;
+          }
+        }
+      }
+    }
+    return nrows;
+  }
+};
+
+std::vector<SparseTable*> g_sparse;
+std::vector<DenseTable*> g_dense;
+std::vector<Dataset*> g_datasets;
+std::mutex g_reg_lock;
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------- sparse table
+int pscore_sparse_create(int dim, int rule, float lr, float initial_range) {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.rule = rule;
+  cfg.lr = lr;
+  cfg.initial_range = initial_range;
+  if (rule == kAdaGrad) cfg.initial_g2sum = 0.0f;
+  g_sparse.push_back(new SparseTable(cfg));
+  return (int)g_sparse.size() - 1;
+}
+
+void pscore_sparse_pull(int h, const uint64_t* keys, int n, float* out) {
+  g_sparse[h]->pull(keys, n, out);
+}
+
+void pscore_sparse_push(int h, const uint64_t* keys, const float* grads,
+                        int n, const float* shows, const float* clicks) {
+  g_sparse[h]->push(keys, grads, n, shows, clicks);
+}
+
+int64_t pscore_sparse_size(int h) { return g_sparse[h]->size(); }
+
+int64_t pscore_sparse_shrink(int h, float threshold, int max_unseen) {
+  return g_sparse[h]->shrink(threshold, max_unseen);
+}
+
+int pscore_sparse_save(int h, const char* path) {
+  return g_sparse[h]->save(path);
+}
+
+int pscore_sparse_load(int h, const char* path) {
+  return g_sparse[h]->load(path);
+}
+
+// ----------------------------------------------------------- dense table
+int pscore_dense_create(int64_t size, int rule, float lr) {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  auto* t = new DenseTable();
+  t->data.assign(size, 0.0f);
+  t->rule = rule;
+  t->lr = lr;
+  if (rule == kAdam) {
+    t->m.assign(size, 0.0f);
+    t->v.assign(size, 0.0f);
+  }
+  g_dense.push_back(t);
+  return (int)g_dense.size() - 1;
+}
+
+void pscore_dense_set(int h, const float* vals, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  std::memcpy(t->data.data(), vals, sizeof(float) * n);
+}
+
+void pscore_dense_pull(int h, float* out, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  std::memcpy(out, t->data.data(), sizeof(float) * n);
+}
+
+void pscore_dense_push(int h, const float* grads, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  t->step++;
+  if (t->rule == kAdam) {
+    float b1p = 1 - std::pow(t->beta1, (float)t->step);
+    float b2p = 1 - std::pow(t->beta2, (float)t->step);
+    for (int64_t i = 0; i < n; i++) {
+      t->m[i] = t->beta1 * t->m[i] + (1 - t->beta1) * grads[i];
+      t->v[i] = t->beta2 * t->v[i] + (1 - t->beta2) * grads[i] * grads[i];
+      t->data[i] -= t->lr * (t->m[i] / b1p) /
+                    (std::sqrt(t->v[i] / b2p) + t->eps);
+    }
+  } else {
+    for (int64_t i = 0; i < n; i++) t->data[i] -= t->lr * grads[i];
+  }
+}
+
+// -------------------------------------------------------------- dataset
+int pscore_dataset_create() {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  g_datasets.push_back(new Dataset());
+  return (int)g_datasets.size() - 1;
+}
+
+int pscore_dataset_load_file(int h, const char* path) {
+  return g_datasets[h]->load_file(path);
+}
+
+void pscore_dataset_shuffle(int h, uint64_t seed) {
+  g_datasets[h]->shuffle(seed);
+}
+
+int64_t pscore_dataset_size(int h) {
+  return (int64_t)g_datasets[h]->records.size();
+}
+
+void pscore_dataset_rewind(int h) { g_datasets[h]->cursor = 0; }
+
+int pscore_dataset_next_batch(int h, int batch, const int* slot_ids,
+                              int n_slots, int max_per_slot,
+                              uint64_t* out_keys, float* out_labels) {
+  return g_datasets[h]->next_batch(batch, slot_ids, n_slots, max_per_slot,
+                                   out_keys, out_labels);
+}
+
+}  // extern "C"

@@ -1,0 +1,77 @@
+"""PS runtime facade.
+
+Parity: `TheOnePSRuntime` (`python/paddle/distributed/ps/the_one_ps.py:921`
+— `_init_worker:1044`, `_init_server:1202`) and the brpc client/server
+pair (`BrpcPsClient`/`BrpcPsServer`).
+
+Round-1 scope: the in-process local PS (the reference's `ps_local_client.h`
+capability, used by its own single-process tests and HeterPS): tables live
+in this process's native engine; init_server/init_worker manage the table
+registry and persistence. The multi-host RPC transport (gRPC/TCP) is the
+next native milestone — the table/accessor engine below it is already the
+real one.
+"""
+from __future__ import annotations
+
+import os
+
+from .table import MemorySparseTable, MemoryDenseTable
+
+
+class PSRuntime:
+    def __init__(self):
+        self._tables = {}
+        self._running = False
+
+    # ---- table registry (the_one_ps table config parity) ----
+    def create_sparse_table(self, table_id, dim=8, sgd_rule="adagrad",
+                            learning_rate=0.05, initial_range=0.02):
+        if table_id not in self._tables:
+            self._tables[table_id] = MemorySparseTable(
+                dim, sgd_rule, learning_rate, initial_range)
+        return self._tables[table_id]
+
+    def create_dense_table(self, table_id, size, sgd_rule="adam",
+                           learning_rate=0.01):
+        if table_id not in self._tables:
+            self._tables[table_id] = MemoryDenseTable(size, sgd_rule,
+                                                      learning_rate)
+        return self._tables[table_id]
+
+    def get_table(self, table_id):
+        return self._tables[table_id]
+
+    # ---- lifecycle ----
+    def init_server(self, *a, **k):
+        self._running = True
+
+    def run_server(self):
+        self._running = True
+
+    def init_worker(self, *a, **k):
+        pass
+
+    def stop_worker(self):
+        self._running = False
+
+    def save_persistables(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        for tid, table in self._tables.items():
+            if isinstance(table, MemorySparseTable):
+                table.save(os.path.join(dirname, f"sparse_{tid}.bin"))
+
+    def load_persistables(self, dirname):
+        for tid, table in self._tables.items():
+            path = os.path.join(dirname, f"sparse_{tid}.bin")
+            if isinstance(table, MemorySparseTable) and os.path.exists(path):
+                table.load(path)
+
+
+_runtime = None
+
+
+def get_ps_runtime() -> PSRuntime:
+    global _runtime
+    if _runtime is None:
+        _runtime = PSRuntime()
+    return _runtime

@@ -1,0 +1,146 @@
+"""Python wrappers over the native PS tables.
+
+Parity surface: `Table`/`MemorySparseTable` (`paddle/fluid/distributed/ps/
+table/table.h:67`, `memory_sparse_table.h`) + `MemoryDenseTable`, with the
+accessor/SGD-rule semantics (`ctr_accessor.h`, `sparse_sgd_rule.h`)
+executing natively inside the table on push.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._native import get_lib, u64_ptr, f32_ptr, i32_ptr
+
+SGD_NAIVE = 0
+SGD_ADAGRAD = 1
+SGD_ADAM = 2
+
+_RULES = {"naive": SGD_NAIVE, "sgd": SGD_NAIVE, "adagrad": SGD_ADAGRAD,
+          "std_adagrad": SGD_ADAGRAD, "adam": SGD_ADAM}
+
+
+class MemorySparseTable:
+    def __init__(self, dim=8, sgd_rule="adagrad", learning_rate=0.05,
+                 initial_range=0.02):
+        self.dim = dim
+        self._lib = get_lib()
+        rule = _RULES[sgd_rule] if isinstance(sgd_rule, str) else sgd_rule
+        self._h = self._lib.pscore_sparse_create(
+            dim, rule, float(learning_rate), float(initial_range))
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """keys: uint64 [n] (any shape; flattened) -> float32 [*, dim]."""
+        shape = keys.shape
+        flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        out = np.empty((flat.size, self.dim), np.float32)
+        self._lib.pscore_sparse_pull(self._h, u64_ptr(flat), flat.size,
+                                     f32_ptr(out))
+        return out.reshape(*shape, self.dim)
+
+    def push(self, keys: np.ndarray, grads: np.ndarray, shows=None,
+             clicks=None):
+        flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        g = np.ascontiguousarray(grads.reshape(flat.size, self.dim),
+                                 dtype=np.float32)
+        sp = f32_ptr(np.ascontiguousarray(shows, np.float32)) \
+            if shows is not None else None
+        cp = f32_ptr(np.ascontiguousarray(clicks, np.float32)) \
+            if clicks is not None else None
+        self._lib.pscore_sparse_push(self._h, u64_ptr(flat), f32_ptr(g),
+                                     flat.size, sp, cp)
+
+    def __len__(self):
+        return int(self._lib.pscore_sparse_size(self._h))
+
+    def shrink(self, threshold=0.0, max_unseen_days=30):
+        return int(self._lib.pscore_sparse_shrink(
+            self._h, float(threshold), int(max_unseen_days)))
+
+    def save(self, path: str):
+        rc = self._lib.pscore_sparse_save(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"sparse table save failed ({rc}): {path}")
+
+    def load(self, path: str):
+        rc = self._lib.pscore_sparse_load(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"sparse table load failed ({rc}): {path}")
+
+
+class MemoryDenseTable:
+    def __init__(self, size, sgd_rule="adam", learning_rate=0.01):
+        self.size = int(size)
+        self._lib = get_lib()
+        rule = _RULES[sgd_rule] if isinstance(sgd_rule, str) else sgd_rule
+        self._h = self._lib.pscore_dense_create(self.size, rule,
+                                                float(learning_rate))
+
+    def set(self, values: np.ndarray):
+        v = np.ascontiguousarray(values.reshape(-1), np.float32)
+        self._lib.pscore_dense_set(self._h, f32_ptr(v), v.size)
+
+    def pull(self) -> np.ndarray:
+        out = np.empty(self.size, np.float32)
+        self._lib.pscore_dense_pull(self._h, f32_ptr(out), self.size)
+        return out
+
+    def push(self, grads: np.ndarray):
+        g = np.ascontiguousarray(grads.reshape(-1), np.float32)
+        self._lib.pscore_dense_push(self._h, f32_ptr(g), g.size)
+
+
+class InMemoryDataset:
+    """Parity: `paddle.distributed.InMemoryDataset`
+    (`python/paddle/distributed/fleet/dataset/dataset.py`, C++
+    `data_set.h:230 LoadIntoMemory`): slot-file loading, in-memory global
+    shuffle, fixed-slot batch iteration — all native."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        self._h = self._lib.pscore_dataset_create()
+        self._files = []
+        self.slots = []
+        self.batch_size = 32
+        self.max_per_slot = 1
+
+    def init(self, batch_size=32, use_var=None, slots=None,
+             max_per_slot=1, **kw):
+        self.batch_size = batch_size
+        if slots is not None:
+            self.slots = [int(s) for s in slots]
+        self.max_per_slot = max_per_slot
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        for f in self._files:
+            rc = self._lib.pscore_dataset_load_file(self._h, f.encode())
+            if rc != 0:
+                raise IOError(f"failed to load {f}")
+
+    def global_shuffle(self, fleet=None, seed=0):
+        self._lib.pscore_dataset_shuffle(self._h, seed)
+
+    local_shuffle = global_shuffle
+
+    def get_memory_data_size(self, fleet=None):
+        return int(self._lib.pscore_dataset_size(self._h))
+
+    def rewind(self):
+        self._lib.pscore_dataset_rewind(self._h)
+
+    def __iter__(self):
+        self.rewind()
+        n_slots = len(self.slots)
+        slot_arr = np.asarray(self.slots, np.int32)
+        while True:
+            keys = np.zeros((self.batch_size, n_slots, self.max_per_slot),
+                            np.uint64)
+            labels = np.zeros(self.batch_size, np.float32)
+            n = self._lib.pscore_dataset_next_batch(
+                self._h, self.batch_size, i32_ptr(slot_arr), n_slots,
+                self.max_per_slot, u64_ptr(keys), f32_ptr(labels))
+            if n <= 0:
+                return
+            yield keys[:n], labels[:n]

@@ -1,0 +1,103 @@
+"""Model family tests: GPT, BERT (+LAMB), ResNet AMP (BASELINE configs)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (gpt_tiny, GPTForPretraining,
+                               GPTPretrainingCriterion, bert_tiny,
+                               BertForPretraining, BertPretrainingCriterion)
+
+
+def test_gpt_forward_and_train():
+    model = GPTForPretraining(gpt_tiny())
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 1024, (2, 32))
+    model.train()
+    logits = model(paddle.to_tensor(tok))
+    assert logits.shape == [2, 32, 1024]
+    loss = crit(logits, paddle.to_tensor(tok))
+    l0 = float(loss)
+    for _ in range(3):
+        logits = model(paddle.to_tensor(tok))
+        loss = crit(logits, paddle.to_tensor(tok))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0
+
+
+def test_bert_pretrain_lamb():
+    """BERT pretrain objective + LAMB (BASELINE config 3 shape)."""
+    model = BertForPretraining(bert_tiny())
+    crit = BertPretrainingCriterion(1024)
+    opt = paddle.optimizer.Lamb(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    tok = rng.randint(1, 1024, (2, 16))
+    mlm_labels = rng.randint(0, 1024, (2, 16))
+    mlm_labels[:, ::2] = -1  # ignore unmasked positions
+    nsp = rng.randint(0, 2, (2,))
+    model.train()
+    losses = []
+    for _ in range(4):
+        pred, seq_rel = model(paddle.to_tensor(tok))
+        loss = crit(pred, seq_rel, paddle.to_tensor(mlm_labels),
+                    paddle.to_tensor(nsp))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_sequence_classification():
+    from paddle_tpu.models import BertForSequenceClassification
+    model = BertForSequenceClassification(bert_tiny(), num_classes=3)
+    model.eval()
+    tok = np.random.randint(1, 1024, (2, 16))
+    out = model(paddle.to_tensor(tok))
+    assert out.shape == [2, 3]
+
+
+def test_bert_attention_mask_padding():
+    model = bert_tiny()
+    model.eval()
+    tok = np.random.randint(1, 1024, (2, 16))
+    tok[:, 10:] = 0  # pad
+    seq_out, pooled = model(paddle.to_tensor(tok))
+    assert seq_out.shape == [2, 16, 128]
+    assert pooled.shape == [2, 128]
+
+
+def test_resnet18_amp_o2_trains():
+    """ResNet AMP O2 (bf16 params) smoke — BASELINE config 2 shape."""
+    import paddle_tpu.amp as amp
+    from paddle_tpu.vision.models import resnet18
+    net = resnet18(num_classes=4)
+    amp.decorate(net, level="O2")
+    assert net.conv1.weight.dtype == paddle.bfloat16
+    opt = paddle.optimizer.Momentum(0.01, parameters=net.parameters())
+    x = paddle.randn([2, 3, 32, 32]).astype("bfloat16")
+    y = paddle.to_tensor(np.random.randint(0, 4, (2,)))
+    net.train()
+    out = net(x)
+    loss = nn.functional.cross_entropy(out.astype("float32"), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+def test_gpt_compiled_model_fit():
+    """GPT through Model.fit (compiled path)."""
+    from paddle_tpu.io import TensorDataset
+    model_net = GPTForPretraining(gpt_tiny())
+    model = paddle.Model(model_net)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    model.prepare(opt, GPTPretrainingCriterion())
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 1024, (32, 32)).astype(np.int32)
+    model.fit(TensorDataset([tok, tok]), epochs=1, batch_size=8,
+              verbose=0)
+    assert model._jit_ok

@@ -1,0 +1,142 @@
+"""Native PS engine tests — parity with the reference's in-process PS
+tests (`paddle/fluid/distributed/test/memory_sparse_table_test.cc`,
+`sparse_sgd_rule_test.cc`, `ctr_accessor_test.cc`, brpc loopback tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ps import (MemorySparseTable, MemoryDenseTable,
+                           InMemoryDataset, SparseEmbedding)
+
+
+def test_sparse_pull_initializes():
+    t = MemorySparseTable(dim=8, sgd_rule="adagrad", initial_range=0.1)
+    keys = np.array([1, 2, 3, 1], np.uint64)
+    vals = t.pull(keys)
+    assert vals.shape == (4, 8)
+    # same key -> same value
+    np.testing.assert_allclose(vals[0], vals[3])
+    assert len(t) == 3
+    assert np.abs(vals).max() <= 0.1
+
+
+def test_sparse_push_naive_sgd():
+    t = MemorySparseTable(dim=4, sgd_rule="naive", learning_rate=0.5)
+    keys = np.array([7], np.uint64)
+    v0 = t.pull(keys)[0].copy()
+    g = np.ones((1, 4), np.float32)
+    t.push(keys, g)
+    v1 = t.pull(keys)[0]
+    np.testing.assert_allclose(v1, v0 - 0.5, rtol=1e-6)
+
+
+def test_sparse_adagrad_rule():
+    t = MemorySparseTable(dim=2, sgd_rule="adagrad", learning_rate=0.1)
+    keys = np.array([5], np.uint64)
+    v0 = t.pull(keys)[0].copy()
+    g = np.array([[2.0, 0.0]], np.float32)
+    t.push(keys, g)
+    v1 = t.pull(keys)[0]
+    # g2sum starts at 0 -> update = lr * g / sqrt(g^2 + eps) ~= lr * sign
+    assert v1[0] == pytest.approx(v0[0] - 0.1, abs=1e-4)
+    assert v1[1] == pytest.approx(v0[1])
+
+
+def test_sparse_adam_converges():
+    t = MemorySparseTable(dim=4, sgd_rule="adam", learning_rate=0.05)
+    keys = np.arange(10, dtype=np.uint64)
+    target = np.linspace(-1, 1, 40).reshape(10, 4).astype(np.float32)
+    for _ in range(200):
+        w = t.pull(keys)
+        t.push(keys, (w - target).astype(np.float32))
+    np.testing.assert_allclose(t.pull(keys), target, atol=0.05)
+
+
+def test_sparse_save_load_shrink(tmp_path):
+    t = MemorySparseTable(dim=4)
+    keys = np.arange(100, dtype=np.uint64)
+    t.pull(keys)
+    # mark some keys as "shown" so shrink keeps them
+    t.push(keys[:50], np.zeros((50, 4), np.float32),
+           shows=np.ones(50), clicks=np.ones(50))
+    path = str(tmp_path / "table.bin")
+    t.save(path)
+    t2 = MemorySparseTable(dim=4)
+    t2.load(path)
+    assert len(t2) == 100
+    np.testing.assert_allclose(t2.pull(keys[:5]), t.pull(keys[:5]))
+    removed = t2.shrink(threshold=0.5, max_unseen_days=0)
+    assert removed == 50
+    assert len(t2) == 50
+
+
+def test_dense_table():
+    t = MemoryDenseTable(16, sgd_rule="adam", learning_rate=0.1)
+    t.set(np.ones(16, np.float32))
+    target = np.zeros(16, np.float32)
+    for _ in range(100):
+        w = t.pull()
+        t.push(w - target)
+    np.testing.assert_allclose(t.pull(), target, atol=0.05)
+
+
+def test_dataset_feed(tmp_path):
+    # slot-record text files (MultiSlotDataFeed format)
+    f1 = tmp_path / "part-0.txt"
+    lines = []
+    rng = np.random.RandomState(0)
+    for i in range(100):
+        label = rng.randint(0, 2)
+        feats = " ".join(f"{s}:{rng.randint(0, 1000)}" for s in (1, 2, 3))
+        lines.append(f"{label} {feats}")
+    f1.write_text("\n".join(lines))
+    ds = InMemoryDataset()
+    ds.init(batch_size=32, slots=[1, 2, 3], max_per_slot=1)
+    ds.set_filelist([str(f1)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 100
+    ds.global_shuffle(seed=42)
+    batches = list(ds)
+    assert sum(b[0].shape[0] for b in batches) == 100
+    keys, labels = batches[0]
+    assert keys.shape == (32, 3, 1)
+    assert set(np.unique(labels)) <= {0.0, 1.0}
+
+
+def test_sparse_embedding_layer_trains():
+    """Wide&Deep-style: PS embedding + dense tower learns a keyed rule."""
+    import paddle_tpu.nn as nn
+
+    emb = SparseEmbedding(dim=8, sgd_rule="adagrad", learning_rate=0.2)
+    tower = nn.Sequential(nn.Linear(3 * 8, 16), nn.ReLU(),
+                          nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(1e-2, parameters=tower.parameters())
+    rng = np.random.RandomState(0)
+    keys_all = rng.randint(0, 50, (256, 3, 1)).astype(np.uint64)
+    # label depends on whether key sum is even (learnable via embeddings)
+    y_all = ((keys_all.sum(axis=(1, 2)) % 2) == 0).astype(np.float32)
+
+    losses = []
+    for epoch in range(60):
+        acts = emb(keys_all)                       # [256, 3, 1, 8]
+        h = acts.reshape([256, 24])
+        logits = tower(h).reshape([256])
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            logits, paddle.to_tensor(y_all))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < 0.3, f"did not learn: {losses[-1]}"
+    assert len(emb.table) == len(np.unique(keys_all))
+
+
+def test_ps_runtime_fleet_integration(tmp_path):
+    from paddle_tpu.ps.runtime import get_ps_runtime
+    rt = get_ps_runtime()
+    t = rt.create_sparse_table(0, dim=4)
+    t.pull(np.array([1, 2, 3], np.uint64))
+    rt.save_persistables(str(tmp_path / "ps_model"))
+    assert os.path.exists(str(tmp_path / "ps_model" / "sparse_0.bin"))
