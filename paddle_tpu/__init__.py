@@ -38,9 +38,21 @@ from .framework_io import save, load  # noqa
 from . import profiler  # noqa
 from . import incubate  # noqa
 from . import device  # noqa
+from . import distribution  # noqa
+from . import regularizer  # noqa
+from . import sparse  # noqa
+from . import fft  # noqa
+from .ops import linalg  # noqa — paddle.linalg namespace
+from . import models  # noqa
+from . import autograd_api as autograd  # noqa — paddle.autograd
+from . import onnx  # noqa
+from .flags import set_flags, get_flags  # noqa
+from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa
+                      ClipGradByGlobalNorm)
 
 import sys as _sys
 _sys.modules[__name__ + ".distributed"] = distributed
+_sys.modules[__name__ + ".autograd"] = autograd
 
 DataParallel = distributed.DataParallel
 

@@ -1,0 +1,263 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Parity: `python/paddle/distribution/` (Distribution, Normal, Uniform,
+Categorical, Bernoulli, Beta, Dirichlet, Exponential family bits,
+kl_divergence) over jax.random + jax.scipy.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as rng
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+from ..core import dispatch
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_tensor(loc, dtype="float32")
+        self.scale = as_tensor(scale, dtype="float32")
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        key = rng.next_key()
+        out_shape = shape + tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape))
+        eps = jax.random.normal(key, out_shape)
+        return Tensor(self.loc._data + eps * self.scale._data)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return dispatch.apply("normal_log_prob", _fn,
+                              (value, self.loc, self.scale))
+
+    def entropy(self):
+        def _fn(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return dispatch.apply("normal_entropy", _fn, (self.scale,))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = as_tensor(low, dtype="float32")
+        self.high = as_tensor(high, dtype="float32")
+        super().__init__(tuple(self.low.shape))
+
+    def sample(self, shape=(), seed=0):
+        key = rng.next_key()
+        out_shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.low._data.shape, self.high._data.shape))
+        u = jax.random.uniform(key, out_shape)
+        return Tensor(self.low._data + u * (self.high._data
+                                            - self.low._data))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return dispatch.apply("uniform_log_prob", _fn,
+                              (value, self.low, self.high))
+
+    def entropy(self):
+        def _fn(lo, hi):
+            return jnp.log(hi - lo)
+        return dispatch.apply("uniform_entropy", _fn,
+                              (self.low, self.high))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = as_tensor(logits, dtype="float32")
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        n = int(np.prod(shape)) if shape else 1
+        out = jax.random.categorical(
+            key, self.logits._data, shape=tuple(shape)
+            + tuple(self.logits.shape[:-1]))
+        return Tensor(out.astype(jnp.int64) if False else out)
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return dispatch.apply("categorical_log_prob", _fn,
+                              (value, self.logits))
+
+    def probs(self, value=None):
+        from ..nn import functional as F
+        p = F.softmax(self.logits)
+        if value is None:
+            return p
+        from .. import ops
+        return ops.take_along_axis(p, as_tensor(value).unsqueeze(-1),
+                                   axis=-1)
+
+    def entropy(self):
+        def _fn(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return dispatch.apply("categorical_entropy", _fn, (self.logits,))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = as_tensor(probs, dtype="float32")
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out_shape = tuple(shape) + tuple(self.probs_.shape)
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_._data, out_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return dispatch.apply("bernoulli_log_prob", _fn,
+                              (value, self.probs_))
+
+    def entropy(self):
+        def _fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return dispatch.apply("bernoulli_entropy", _fn, (self.probs_,))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = as_tensor(alpha, dtype="float32")
+        self.beta = as_tensor(beta, dtype="float32")
+        super().__init__(tuple(self.alpha.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out_shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.alpha._data.shape, self.beta._data.shape))
+        return Tensor(jax.random.beta(key, self.alpha._data,
+                                      self.beta._data, out_shape))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(a)
+                       + jax.scipy.special.gammaln(b)
+                       - jax.scipy.special.gammaln(a + b)))
+        return dispatch.apply("beta_log_prob", _fn,
+                              (value, self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = as_tensor(concentration, dtype="float32")
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.dirichlet(
+            key, self.concentration._data, tuple(shape)
+            + tuple(self.concentration.shape[:-1])))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), axis=-1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), axis=-1))
+        return dispatch.apply("dirichlet_log_prob", _fn,
+                              (value, self.concentration))
+
+
+def kl_divergence(p, q):
+    """paddle.distribution.kl_divergence parity for the common pairs."""
+    from .. import ops
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        def _fn(l1, s1, l2, s2):
+            var1, var2 = s1 * s1, s2 * s2
+            return (jnp.log(s2 / s1) + (var1 + (l1 - l2) ** 2)
+                    / (2 * var2) - 0.5)
+        return dispatch.apply("kl_normal", _fn,
+                              (p.loc, p.scale, q.loc, q.scale))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def _fn(lg1, lg2):
+            lp1 = jax.nn.log_softmax(lg1, -1)
+            lp2 = jax.nn.log_softmax(lg2, -1)
+            return jnp.sum(jnp.exp(lp1) * (lp1 - lp2), axis=-1)
+        return dispatch.apply("kl_categorical", _fn,
+                              (p.logits, q.logits))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        def _fn(lo1, hi1, lo2, hi2):
+            return jnp.log((hi2 - lo2) / (hi1 - lo1))
+        return dispatch.apply("kl_uniform", _fn,
+                              (p.low, p.high, q.low, q.high))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        def _fn(p1, p2):
+            p1 = jnp.clip(p1, 1e-7, 1 - 1e-7)
+            p2 = jnp.clip(p2, 1e-7, 1 - 1e-7)
+            return (p1 * (jnp.log(p1) - jnp.log(p2))
+                    + (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+        return dispatch.apply("kl_bernoulli", _fn, (p.probs_, q.probs_))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

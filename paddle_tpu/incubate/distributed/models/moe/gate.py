@@ -94,6 +94,9 @@ class GShardGate(BaseGate):
         logits = self.gate(inp)
         E = self.tot_expert
         cap_factor = self.capacity[0] if self.training else self.capacity[1]
+        do_random = self.random_routing and self.training
+        from .....core import random as rng
+        rkey = rng.next_key() if do_random else None
 
         def _fn(lg):
             T = lg.shape[0]
@@ -109,6 +112,12 @@ class GShardGate(BaseGate):
             # their gate — GShard prunes them after dispatch)
             pos = jnp.sum(jnp.cumsum(oh1, axis=0) * oh1 - oh1, axis=-1)
             val = val.at[:, 0].set(jnp.where(pos < cap, val[:, 0], 0.0))
+            if do_random:
+                # GShard random routing: keep the 2nd expert with
+                # probability proportional to its gate (2*g2), else drop
+                u = jax.random.uniform(rkey, (T,))
+                keep2 = u < 2.0 * val[:, 1]
+                val = val.at[:, 1].set(jnp.where(keep2, val[:, 1], 0.0))
             return val / jnp.maximum(
                 jnp.sum(val, -1, keepdims=True), 1e-12), \
                 idx.astype(jnp.int32), aux

@@ -120,7 +120,10 @@ class BertForPretraining(nn.Layer):
     def __init__(self, bert: BertModel):
         super().__init__()
         self.bert = bert
-        self.cls = BertPretrainingHeads(bert.hidden_size, bert.vocab_size)
+        # reference ties the MLM decoder to the word embedding table
+        self.cls = BertPretrainingHeads(
+            bert.hidden_size, bert.vocab_size,
+            embedding_weights=bert.embeddings.word_embeddings.weight)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):

@@ -132,12 +132,15 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
         raise NotImplementedError("flash_attention kernel: bias "
                                   "unsupported; use the XLA path")
     b, s, h, d = q.shape
-    if s % 128 != 0 or d % 128 != 0:
-        raise NotImplementedError(
-            f"flash_attention kernel needs seq%128==0 and head_dim%128==0 "
-            f"(got S={s}, D={d})")
     block_q = min(block_q, s)
     block_k = min(block_k, s)
+    if s % block_q != 0 or s % block_k != 0 or d % 128 != 0:
+        # grid/num_k floor-divide by the block size: a non-divisible seq
+        # would silently drop trailing queries/keys — refuse so the caller
+        # falls back to the XLA path
+        raise NotImplementedError(
+            f"flash_attention kernel needs seq divisible by block "
+            f"({block_q}/{block_k}) and head_dim%128==0 (got S={s}, D={d})")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 

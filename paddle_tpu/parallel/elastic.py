@@ -1,0 +1,92 @@
+"""Elastic training manager.
+
+Parity: `python/paddle/distributed/fleet/elastic/manager.py:127`
+(`ElasticManager`: etcd registration :229, watch/scale callbacks :244,
+fault-tolerant restart via the launcher).
+
+TPU-native scope: within a slice, chip failure kills the whole SPMD
+program — elasticity happens at the JOB level: a watchdog restarts the
+training process and the program resumes from the latest (orbax) sharded
+checkpoint. This manager implements that restart loop with a file-based
+heartbeat/KV (no etcd in-image); the etcd transport can be slotted in via
+the same Store interface.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class FileStore:
+    """KV + heartbeat store on a shared filesystem (etcd stand-in)."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, key, value):
+        with open(os.path.join(self.root, key), "w") as f:
+            json.dump(value, f)
+
+    def get(self, key, default=None):
+        p = os.path.join(self.root, key)
+        if not os.path.exists(p):
+            return default
+        with open(p) as f:
+            return json.load(f)
+
+    def heartbeat(self, node_id):
+        self.put(f"heartbeat_{node_id}", {"ts": time.time()})
+
+    def alive_nodes(self, timeout=30.0):
+        now = time.time()
+        out = []
+        for f in os.listdir(self.root):
+            if f.startswith("heartbeat_"):
+                hb = self.get(f)
+                if hb and now - hb["ts"] < timeout:
+                    out.append(f[len("heartbeat_"):])
+        return sorted(out)
+
+
+class ElasticManager:
+    def __init__(self, args=None, store_root=None, max_restarts=3,
+                 heartbeat_interval=5.0):
+        self.store = FileStore(store_root or
+                               os.environ.get("PADDLE_ELASTIC_STORE",
+                                              "/tmp/paddle_tpu_elastic"))
+        self.max_restarts = max_restarts
+        self.heartbeat_interval = heartbeat_interval
+        self.node_id = os.environ.get("PADDLE_NODE_RANK", "0")
+        self.restarts = 0
+
+    def register(self):
+        """manager.py:229 parity: announce this node."""
+        self.store.heartbeat(self.node_id)
+        self.store.put(f"node_{self.node_id}",
+                       {"pid": os.getpid(), "restarts": self.restarts})
+
+    def watch(self):
+        return self.store.alive_nodes(timeout=self.heartbeat_interval * 4)
+
+    def run(self, cmd):
+        """Supervise `cmd` (the training script); restart on failure up to
+        max_restarts (the launcher watchdog capability)."""
+        while True:
+            self.register()
+            proc = subprocess.Popen(cmd)
+            while proc.poll() is None:
+                self.store.heartbeat(self.node_id)
+                time.sleep(self.heartbeat_interval)
+            if proc.returncode == 0:
+                return 0
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                return proc.returncode
+            sys.stderr.write(
+                f"[elastic] training exited {proc.returncode}; "
+                f"restart {self.restarts}/{self.max_restarts}\n")

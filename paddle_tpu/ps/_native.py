@@ -35,7 +35,12 @@ def get_lib():
     if (not os.path.exists(_LIB)) or \
             os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
         _build()
-    lib = ctypes.CDLL(_LIB)
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        # stale/foreign binary (e.g. different arch): rebuild from source
+        _build()
+        lib = ctypes.CDLL(_LIB)
     u64p = ctypes.POINTER(ctypes.c_uint64)
     f32p = ctypes.POINTER(ctypes.c_float)
     i32p = ctypes.POINTER(ctypes.c_int)

@@ -42,9 +42,16 @@ class SparseEmbedding(Layer):
         t = Tensor(values, stop_gradient=not self.training)
         if self.training:
             table = self.table
+            # leaf hooks fire once per accumulated edge with the CUMULATIVE
+            # grad; push only the delta so multi-consumer graphs don't
+            # double-apply earlier contributions
+            state = {"pushed": None}
 
-            def push_hook(grad, _keys=keys_np, _table=table):
-                _table.push(_keys, grad.numpy())
+            def push_hook(grad, _keys=keys_np, _table=table, _s=state):
+                g = grad.numpy()
+                delta = g if _s["pushed"] is None else g - _s["pushed"]
+                _s["pushed"] = g.copy()
+                _table.push(_keys, delta)
             t.register_hook(push_hook)
         return t
 

@@ -55,16 +55,26 @@ class PSRuntime:
         self._running = False
 
     def save_persistables(self, dirname):
+        import numpy as np
         os.makedirs(dirname, exist_ok=True)
         for tid, table in self._tables.items():
             if isinstance(table, MemorySparseTable):
                 table.save(os.path.join(dirname, f"sparse_{tid}.bin"))
+            elif isinstance(table, MemoryDenseTable):
+                np.save(os.path.join(dirname, f"dense_{tid}.npy"),
+                        table.pull())
 
     def load_persistables(self, dirname):
+        import numpy as np
         for tid, table in self._tables.items():
-            path = os.path.join(dirname, f"sparse_{tid}.bin")
-            if isinstance(table, MemorySparseTable) and os.path.exists(path):
-                table.load(path)
+            if isinstance(table, MemorySparseTable):
+                path = os.path.join(dirname, f"sparse_{tid}.bin")
+                if os.path.exists(path):
+                    table.load(path)
+            elif isinstance(table, MemoryDenseTable):
+                path = os.path.join(dirname, f"dense_{tid}.npy")
+                if os.path.exists(path):
+                    table.set(np.load(path))
 
 
 _runtime = None

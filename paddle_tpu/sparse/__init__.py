@@ -1,0 +1,98 @@
+"""paddle_tpu.sparse — sparse tensors.
+
+Parity: `paddle.sparse` (`python/paddle/incubate/sparse/` in the snapshot:
+SparseCooTensor/SparseCsrTensor, `paddle/phi/core/sparse_coo_tensor.h`)
+over `jax.experimental.sparse` (BCOO — XLA-lowerable sparse ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+
+
+class SparseTensor(Tensor):
+    """Tensor whose _data is dense on demand; holds a BCOO internally."""
+
+    __slots__ = ("_bcoo",)
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        super().__init__(jnp.zeros((), jnp.float32),
+                         stop_gradient=stop_gradient)
+        self._data = None  # densified lazily
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: [ndim, nnz] (paddle layout)."""
+    idx = as_tensor(indices)._data
+    vals = as_tensor(values, dtype=dtype)._data
+    idx_t = jnp.swapaxes(idx, 0, 1).astype(jnp.int32)  # [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(i) for i in (idx.max(axis=1) + 1).tolist())
+    bcoo = jsparse.BCOO((vals, idx_t), shape=tuple(int(s) for s in shape))
+    return SparseTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    crows = np.asarray(as_tensor(crows).numpy())
+    cols = np.asarray(as_tensor(cols).numpy())
+    vals = as_tensor(values, dtype=dtype)._data
+    # expand crows to row indices -> BCOO
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     jnp.asarray(cols, jnp.int32)], axis=1)
+    bcoo = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def matmul(x, y):
+    """sparse @ dense."""
+    if isinstance(x, SparseTensor):
+        yd = as_tensor(y)._data
+        return Tensor(x._bcoo @ yd)
+    raise TypeError("sparse.matmul expects a SparseTensor lhs")
+
+
+def add(x, y):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return SparseTensor(jsparse.bcoo_add_batch_dim(x._bcoo)
+                            if False else (x._bcoo + y._bcoo))
+    raise TypeError("sparse.add expects SparseTensors")
+
+
+def is_sparse(x):
+    return isinstance(x, SparseTensor)

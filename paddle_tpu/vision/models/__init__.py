@@ -4,3 +4,7 @@ from .resnet import (  # noqa: F401
     resnet101, resnet152, wide_resnet50_2, wide_resnet101_2,
     resnext50_32x4d, resnext101_32x4d,
 )
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
+)

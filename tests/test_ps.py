@@ -133,6 +133,34 @@ def test_sparse_embedding_layer_trains():
     assert len(emb.table) == len(np.unique(keys_all))
 
 
+def test_sparse_embedding_multi_consumer_no_double_push():
+    """Regression: leaf hooks fire per accumulated edge with cumulative
+    grads; the push must apply each contribution exactly once."""
+    emb = SparseEmbedding(dim=2, sgd_rule="naive", learning_rate=1.0)
+    keys = np.array([[42]], np.uint64)
+    w0 = emb.table.pull(keys.reshape(-1)).copy()
+    acts = emb(keys)  # [1,1,2]
+    # two consumers of the same activation
+    a = acts.sum()
+    b = (acts * 2.0).sum()
+    (a + b).backward()
+    w1 = emb.table.pull(keys.reshape(-1))
+    # total grad per element = 1 + 2 = 3; lr=1 -> w1 = w0 - 3
+    np.testing.assert_allclose(w1, w0 - 3.0, rtol=1e-5)
+
+
+def test_dense_table_persistence(tmp_path):
+    from paddle_tpu.ps.runtime import PSRuntime
+    rt = PSRuntime()
+    d = rt.create_dense_table(1, 8, sgd_rule="naive", learning_rate=0.1)
+    d.set(np.arange(8, dtype=np.float32))
+    rt.save_persistables(str(tmp_path / "m"))
+    rt2 = PSRuntime()
+    d2 = rt2.create_dense_table(1, 8, sgd_rule="naive", learning_rate=0.1)
+    rt2.load_persistables(str(tmp_path / "m"))
+    np.testing.assert_allclose(d2.pull(), np.arange(8))
+
+
 def test_ps_runtime_fleet_integration(tmp_path):
     from paddle_tpu.ps.runtime import get_ps_runtime
     rt = get_ps_runtime()

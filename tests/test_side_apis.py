@@ -1,0 +1,84 @@
+"""Side APIs: sparse, fft, linalg, vision.ops, vision model zoo."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_sparse_coo_roundtrip():
+    idx = paddle.to_tensor([[0, 1, 2], [1, 2, 0]])
+    vals = paddle.to_tensor([1.0, 2.0, 3.0])
+    sp = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    dense = sp.to_dense().numpy()
+    assert dense[0, 1] == 1.0 and dense[1, 2] == 2.0 and dense[2, 0] == 3.0
+    assert sp.nnz() == 3
+    y = paddle.sparse.matmul(sp, paddle.ones([3, 2]))
+    np.testing.assert_allclose(y.numpy()[0], [1.0, 1.0])
+
+
+def test_sparse_csr():
+    sp = paddle.sparse.sparse_csr_tensor(
+        crows=[0, 1, 2], cols=[1, 0], values=[5.0, 6.0], shape=[2, 2])
+    d = sp.to_dense().numpy()
+    assert d[0, 1] == 5.0 and d[1, 0] == 6.0
+
+
+def test_fft():
+    x = paddle.to_tensor(np.sin(np.arange(64) * 2 * np.pi * 4 / 64)
+                         .astype(np.float32))
+    spec = paddle.fft.fft(x)
+    mag = np.abs(spec.numpy())
+    assert np.argmax(mag[:32]) == 4
+    back = paddle.fft.ifft(spec)
+    np.testing.assert_allclose(back.numpy().real, x.numpy(), atol=1e-4)
+
+
+def test_linalg_namespace():
+    a = paddle.to_tensor(np.array([[2.0, 0], [0, 3.0]], np.float32))
+    assert float(paddle.linalg.det(a)) == pytest.approx(6.0)
+    inv = paddle.linalg.inv(a)
+    np.testing.assert_allclose(inv.numpy(), [[0.5, 0], [0, 1 / 3]],
+                               rtol=1e-5)
+    u, s, vt = paddle.linalg.svd(a)
+    np.testing.assert_allclose(sorted(s.numpy()), [2.0, 3.0], rtol=1e-5)
+    l = paddle.linalg.cholesky(a)
+    np.testing.assert_allclose(l.numpy() @ l.numpy().T, a.numpy(),
+                               rtol=1e-5)
+
+
+def test_vision_nms_and_iou():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
+    scores = paddle.to_tensor([0.9, 0.8, 0.7])
+    keep = paddle.vision.ops.nms(boxes, 0.5, scores)
+    assert keep.tolist() == [0, 2]
+    iou = paddle.vision.ops.box_iou(boxes, boxes)
+    np.testing.assert_allclose(np.diag(iou.numpy()), 1.0, rtol=1e-5)
+
+
+def test_roi_align():
+    x = paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    out = paddle.vision.ops.roi_align(x, boxes, paddle.to_tensor([1]),
+                                      output_size=2)
+    assert out.shape == [1, 1, 2, 2]
+    assert np.isfinite(out.numpy()).all()
+
+
+@pytest.mark.parametrize("factory,ch", [
+    ("vgg11", 64), ("mobilenet_v1", None), ("mobilenet_v2", None)])
+def test_vision_model_zoo(factory, ch):
+    from paddle_tpu.vision import models as M
+    net = getattr(M, factory)(num_classes=4)
+    net.eval()
+    out = net(paddle.randn([1, 3, 64, 64]))
+    assert out.shape == [1, 4]
+
+
+def test_resnet50_forward():
+    from paddle_tpu.vision.models import resnet50
+    net = resnet50(num_classes=10)
+    net.eval()
+    out = net(paddle.randn([1, 3, 64, 64]))
+    assert out.shape == [1, 10]
