@@ -29,8 +29,8 @@ def main():
                         n_heads=16, n_layers=24, dp=1, pp=1, mp=1,
                         micro_batches=1, remat=True, zero_stage=0,
                         compute_dtype=jnp.bfloat16)
-        batch = 16
-        iters = 20
+        batch = 32   # best measured throughput on v5e (64 fails compile)
+        iters = 12
     else:  # CPU smoke mode
         cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128,
                         n_heads=4, n_layers=2, dp=1, pp=1, mp=1,

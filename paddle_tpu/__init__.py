@@ -46,6 +46,8 @@ from .ops import linalg  # noqa — paddle.linalg namespace
 from . import models  # noqa
 from . import autograd_api as autograd  # noqa — paddle.autograd
 from . import onnx  # noqa
+from . import inference  # noqa
+from . import hub  # noqa
 from .flags import set_flags, get_flags  # noqa
 from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa
                       ClipGradByGlobalNorm)

@@ -28,12 +28,12 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = tensors
 
-    @property
     def saved_tensor(self):
+        """Reference API: a METHOD returning the saved tuple
+        (python/paddle/autograd/py_layer.py)."""
         return self._saved
 
-    def saved_tensors(self):
-        return self._saved
+    saved_tensors = saved_tensor
 
 
 class PyLayerMeta(type):

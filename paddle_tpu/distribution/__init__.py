@@ -123,11 +123,11 @@ class Categorical(Distribution):
 
     def sample(self, shape=()):
         key = rng.next_key()
-        n = int(np.prod(shape)) if shape else 1
         out = jax.random.categorical(
             key, self.logits._data, shape=tuple(shape)
             + tuple(self.logits.shape[:-1]))
-        return Tensor(out.astype(jnp.int64) if False else out)
+        # reference returns int64; canonical int on TPU is int32
+        return Tensor(out.astype(jnp.int32))
 
     def log_prob(self, value):
         value = as_tensor(value)

@@ -189,3 +189,44 @@ def load(path, **configs):
 
 def not_to_static(fn=None):
     return fn
+
+
+# ------------------------------------------------------- control flow
+# Parity: the dy2static control-flow transformers
+# (`fluid/dygraph/dygraph_to_static/ast_transformer.py` ifelse/loop) and
+# static `paddle.static.nn.cond/while_loop` ops. Under tracing these map
+# straight to lax.cond / lax.while_loop; eagerly they just execute.
+
+
+def cond(pred, true_fn, false_fn, *operands):
+    import jax
+    from ..core.tensor import Tensor
+    p = pred._data if isinstance(pred, Tensor) else pred
+
+    def _wrap(fn):
+        def inner(ops_):
+            out = fn(*[Tensor(o) for o in ops_]) if ops_ else fn()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._data if isinstance(o, Tensor) else o for o in outs]
+        return inner
+    ops_ = [o._data if isinstance(o, Tensor) else o for o in operands]
+    res = jax.lax.cond(p, _wrap(true_fn), _wrap(false_fn), ops_)
+    res = [Tensor(r) for r in res]
+    return res[0] if len(res) == 1 else res
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    import jax
+    from ..core.tensor import Tensor
+    init = [v._data if isinstance(v, Tensor) else v for v in loop_vars]
+
+    def c(vs):
+        out = cond_fn(*[Tensor(v) for v in vs])
+        return out._data if isinstance(out, Tensor) else out
+
+    def b(vs):
+        out = body_fn(*[Tensor(v) for v in vs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o._data if isinstance(o, Tensor) else o for o in outs]
+    res = jax.lax.while_loop(c, b, init)
+    return [Tensor(r) for r in res]

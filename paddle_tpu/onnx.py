@@ -8,6 +8,16 @@ StableHLO is an ecosystem tool concern, not a framework one.
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
+    import os
+    import pickle
+
     from . import jit
     jit.save(layer, path, input_spec=input_spec)
-    return path + ".stablehlo"
+    artifact = path + ".stablehlo"
+    if not os.path.exists(artifact):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        raise RuntimeError(
+            "StableHLO export failed: "
+            f"{meta.get('export_error', 'no input_spec given')}")
+    return artifact

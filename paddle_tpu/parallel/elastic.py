@@ -29,8 +29,13 @@ class FileStore:
         os.makedirs(root, exist_ok=True)
 
     def put(self, key, value):
-        with open(os.path.join(self.root, key), "w") as f:
+        # atomic write: a concurrent alive_nodes() reader must never see a
+        # truncated file
+        path = os.path.join(self.root, key)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(value, f)
+        os.replace(tmp, path)
 
     def get(self, key, default=None):
         p = os.path.join(self.root, key)

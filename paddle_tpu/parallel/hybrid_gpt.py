@@ -180,7 +180,9 @@ def _attention(x, w_qkv, b_qkv, w_o, b_o, cfg: GPTConfig):
     q = q.reshape(B, S, h_loc, hd)
     k_ = k_.reshape(B, S, h_loc, hd)
     v = v.reshape(B, S, h_loc, hd)
-    logits = jnp.einsum("bshd,bthd->bhst", q, k_).astype(jnp.float32)
+    # bf16 operands, fp32 accumulation on the MXU
+    logits = jnp.einsum("bshd,bthd->bhst", q, k_,
+                        preferred_element_type=jnp.float32)
     logits = logits / math.sqrt(hd)
     mask = jnp.tril(jnp.ones((S, S), bool))
     logits = jnp.where(mask, logits, -1e30)
@@ -314,9 +316,16 @@ def _block(x, lp, cfg: GPTConfig):
 
 def _stage_forward(x, blocks_local, cfg: GPTConfig):
     """Run this pp rank's layers (scan over the stacked layer dim)."""
+    if cfg.remat:
+        # full per-block remat: recompute the whole block in backward.
+        # (The dots-saveable policy keeps the [B,H,S,S] attention logits
+        # per layer — ~1GB/layer at S=1024 — and OOMs a 16GB chip.)
+        block_fn = jax.checkpoint(lambda c, p: _block(c, p, cfg))
+    else:
+        block_fn = lambda c, p: _block(c, p, cfg)  # noqa: E731
+
     def body(carry, lp):
-        y, aux = _block(carry, lp, cfg) if not cfg.remat else \
-            jax.checkpoint(lambda c, p: _block(c, p, cfg))(carry, lp)
+        y, aux = block_fn(carry, lp)
         return y, aux
     x, auxs = jax.lax.scan(body, x, blocks_local)
     return x, jnp.sum(auxs)
@@ -341,8 +350,9 @@ def _vocab_parallel_ce(y, head_local, labels, cfg: GPTConfig):
     """c_softmax_with_cross_entropy parity. y [B,S,d] full seq; head_local
     [d, V/mp]; labels [B,S]. Returns mean loss (replicated over mp)."""
     V_loc = head_local.shape[1]
-    logits = jnp.einsum("bsd,dv->bsv", y.astype(jnp.float32),
-                        head_local.astype(jnp.float32))
+    logits = jnp.einsum("bsd,dv->bsv", y.astype(cfg.compute_dtype),
+                        head_local.astype(cfg.compute_dtype),
+                        preferred_element_type=jnp.float32)
     if cfg.mp == 1:
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, labels[..., None],

@@ -16,15 +16,28 @@ from ..ops._helpers import as_tensor
 
 
 class SparseTensor(Tensor):
-    """Tensor whose _data is dense on demand; holds a BCOO internally."""
+    """Tensor holding a BCOO; densifies lazily when a dense op touches it
+    (so inherited Tensor methods keep working — a dense fallback, like the
+    reference's coo→dense kernel fallbacks)."""
 
-    __slots__ = ("_bcoo",)
+    __slots__ = ("_bcoo", "_dense_cache")
 
     def __init__(self, bcoo, stop_gradient=True):
         self._bcoo = bcoo
+        self._dense_cache = None
         super().__init__(jnp.zeros((), jnp.float32),
                          stop_gradient=stop_gradient)
-        self._data = None  # densified lazily
+        self._dense_cache = None  # discard the placeholder written above
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._bcoo.todense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
 
     @property
     def shape(self):
@@ -89,8 +102,7 @@ def matmul(x, y):
 
 def add(x, y):
     if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
-        return SparseTensor(jsparse.bcoo_add_batch_dim(x._bcoo)
-                            if False else (x._bcoo + y._bcoo))
+        return SparseTensor(x._bcoo + y._bcoo)
     raise TypeError("sparse.add expects SparseTensors")
 
 

@@ -11,13 +11,7 @@ from ..ops._helpers import as_tensor
 from ..core import dispatch
 
 
-def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
-        categories=None, top_k=None):
-    """Greedy NMS (host loop — eager-only like the reference's CPU path).
-    boxes [N,4] (x1,y1,x2,y2); returns kept indices."""
-    b = as_tensor(boxes).numpy()
-    s = as_tensor(scores).numpy() if scores is not None else \
-        np.arange(len(b), 0, -1, dtype=np.float32)
+def _nms_single(b, s, iou_threshold):
     order = np.argsort(-s)
     keep = []
     suppressed = np.zeros(len(b), bool)
@@ -34,7 +28,31 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         iou = inter / np.maximum(areas[i] + areas - inter, 1e-9)
         suppressed |= iou > iou_threshold
         suppressed[i] = True
-    keep = np.asarray(keep, np.int64)
+    return keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (host loop — eager-only like the reference's CPU path).
+    boxes [N,4] (x1,y1,x2,y2); per-category when category_idxs given.
+    Returns kept indices sorted by score."""
+    b = as_tensor(boxes).numpy()
+    s = as_tensor(scores).numpy() if scores is not None else \
+        np.arange(len(b), 0, -1, dtype=np.float32)
+    if category_idxs is not None:
+        cats = as_tensor(category_idxs).numpy()
+        cat_list = (as_tensor(categories).numpy().tolist()
+                    if categories is not None else np.unique(cats).tolist())
+        keep = []
+        for c in cat_list:
+            idx = np.where(cats == c)[0]
+            if idx.size == 0:
+                continue
+            kept = _nms_single(b[idx], s[idx], iou_threshold)
+            keep.extend(idx[kept].tolist())
+    else:
+        keep = _nms_single(b, s, iou_threshold)
+    keep = np.asarray(sorted(keep, key=lambda i: -s[i]), np.int64)
     if top_k is not None:
         keep = keep[:top_k]
     return Tensor(keep)

@@ -1,0 +1,68 @@
+"""Inference/serving path: jit.save StableHLO export -> predictor; asp;
+hub; jit control flow; incubate.autograd."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_jit_save_load_predictor(tmp_path):
+    from paddle_tpu.hapi.model import InputSpec
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    x = np.random.rand(3, 4).astype(np.float32)
+    expect = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([3, 4], "float32")])
+    # TranslatedLayer path
+    loaded = paddle.jit.load(prefix)
+    out = loaded(x)
+    np.testing.assert_allclose(out[0].numpy(), expect, rtol=1e-5)
+    # predictor API path (AnalysisPredictor parity surface)
+    config = paddle.inference.Config(prefix)
+    pred = paddle.inference.create_predictor(config)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    outs = pred.run()
+    np.testing.assert_allclose(outs[0], expect, rtol=1e-5)
+    out_h = pred.get_output_handle("output_0")
+    np.testing.assert_allclose(out_h.copy_to_cpu(), expect, rtol=1e-5)
+
+
+def test_to_static_layer():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Tanh())
+    x = paddle.randn([2, 4])
+    eager_out = net(x).numpy()
+    paddle.jit.to_static(net)
+    static_out = net(x).numpy()
+    np.testing.assert_allclose(eager_out, static_out, rtol=1e-5)
+
+
+def test_asp_prune_and_decorate():
+    from paddle_tpu.incubate import asp
+    net = nn.Linear(16, 16)
+    asp.prune_model(net)
+    assert asp.check_sparsity(net.weight)
+    assert asp.calculate_density(net.weight) <= 0.5 + 1e-6
+    opt = asp.decorate(paddle.optimizer.SGD(0.1,
+                                            parameters=net.parameters()))
+    loss = net(paddle.randn([4, 16])).mean()
+    loss.backward()
+    opt.step()
+    assert asp.check_sparsity(net.weight)  # mask survives the update
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(width=4):\n"
+        "    'a tiny model'\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, width)\n")
+    models = paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny_model" in models
+    m = paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                        width=8)
+    assert m.weight.shape == [8, 8]
+    with pytest.raises(RuntimeError):
+        paddle.hub.list("user/repo", source="github")

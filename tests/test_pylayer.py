@@ -12,7 +12,7 @@ def test_pylayer_basic():
 
         @staticmethod
         def backward(ctx, grad):
-            (x,) = ctx.saved_tensor
+            (x,) = ctx.saved_tensor()
             return grad * 3.0 * x * x
 
     x = paddle.to_tensor([2.0], stop_gradient=False)
@@ -30,7 +30,7 @@ def test_pylayer_multi_output():
 
         @staticmethod
         def backward(ctx, g1, g2):
-            (x,) = ctx.saved_tensor
+            (x,) = ctx.saved_tensor()
             return g1 * 2 * x + g2
 
     a = paddle.to_tensor([3.0], stop_gradient=False)
