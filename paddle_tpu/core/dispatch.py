@@ -69,6 +69,19 @@ def apply(name, fn, inputs, differentiable=True):
                 [o.dtype for o in outs_t],
             )
 
+    # FLAGS_check_nan_inf parity (`framework/details/nan_inf_utils_detail`):
+    # scan every float output when the debug flag is on (forces a sync).
+    # Eager-only: traced values can't be concretised — compiled paths skip
+    # the scan, matching the reference where the scan wraps kernel launches.
+    from ..flags import check_nan_inf_enabled
+    if check_nan_inf_enabled():
+        for o in outs_t:
+            if _is_float(o.dtype) and not isinstance(o, jax.core.Tracer) \
+                    and not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}' "
+                    f"(shape {o.shape}, dtype {o.dtype})")
+
     results = []
     for i, o in enumerate(outs_t):
         t = Tensor(o, stop_gradient=not (need_grad and _is_float(o.dtype)))

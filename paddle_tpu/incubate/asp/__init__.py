@@ -27,6 +27,10 @@ def _mask_1d(weight, n=2, m=4):
 
 
 def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    if func_name not in ("mask_1d",):
+        raise NotImplementedError(
+            f"mask algorithm {func_name!r} not implemented yet; "
+            "mask_1d is available (mask_2d_greedy/mask_2d_best planned)")
     arr = weight.numpy() if isinstance(weight, Tensor) else \
         np.asarray(weight)
     pad = (-arr.size) % m

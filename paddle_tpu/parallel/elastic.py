@@ -30,9 +30,10 @@ class FileStore:
 
     def put(self, key, value):
         # atomic write: a concurrent alive_nodes() reader must never see a
-        # truncated file
+        # truncated file; the dot prefix keeps in-flight temps out of the
+        # heartbeat_* directory listing
         path = os.path.join(self.root, key)
-        tmp = path + f".tmp{os.getpid()}"
+        tmp = os.path.join(self.root, f".{key}.tmp{os.getpid()}")
         with open(tmp, "w") as f:
             json.dump(value, f)
         os.replace(tmp, path)
@@ -41,8 +42,11 @@ class FileStore:
         p = os.path.join(self.root, key)
         if not os.path.exists(p):
             return default
-        with open(p) as f:
-            return json.load(f)
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return default
 
     def heartbeat(self, node_id):
         self.put(f"heartbeat_{node_id}", {"ts": time.time()})
@@ -51,7 +55,7 @@ class FileStore:
         now = time.time()
         out = []
         for f in os.listdir(self.root):
-            if f.startswith("heartbeat_"):
+            if f.startswith("heartbeat_") and ".tmp" not in f:
                 hb = self.get(f)
                 if hb and now - hb["ts"] < timeout:
                     out.append(f[len("heartbeat_"):])

@@ -1,0 +1,287 @@
+"""PS RPC transport: TCP server/client over the native tables.
+
+Parity: the brpc PS service pair (`paddle/fluid/distributed/ps/service/
+brpc_ps_server.h` / `brpc_ps_client.h`, wire proto `sendrecv.proto`) and
+`PSClient`/`PSServer` (`ps_client.h:63`, `server.h:62`). The storage and
+the SGD rules are the native C++ engine (ps/csrc); this module is the
+wire: a length-prefixed binary protocol over TCP, one thread per
+connection (the brpc threading model scaled down). Shards-by-key routing
+across multiple servers matches the reference's table sharding
+(`MemorySparseTable` shard_num semantics).
+
+Message format: [u32 len][u8 op][u32 table_id][payload]
+ops: 0 PULL_SPARSE (payload: u32 n, u64*n keys) -> f32 n*dim
+     1 PUSH_SPARSE (payload: u32 n, u64*n keys, f32 n*dim grads) -> u8 ok
+     2 PULL_DENSE  (payload: -) -> u32 n, f32*n
+     3 PUSH_DENSE  (payload: u32 n, f32*n grads) -> u8 ok
+     4 SAVE        (payload: u16 len, path) -> u8 ok
+     5 BARRIER     -> u8 ok
+     6 STOP        -> u8 ok
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from .table import MemorySparseTable, MemoryDenseTable
+
+PULL_SPARSE, PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, SAVE, BARRIER, STOP = \
+    range(7)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+class PSServer:
+    """One PS shard server process. Tables registered by id."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._tables = {}
+        # count-based trainer rendezvous (BarrierTable parity): BARRIER
+        # carries the participant count; connections block until all arrive
+        self._barrier_cond = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_generation = 0
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    while True:
+                        msg = _recv_msg(sock)
+                        if not outer._handle(sock, msg):
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = None
+
+    def register_sparse_table(self, table_id, dim=8, sgd_rule="adagrad",
+                              learning_rate=0.05, initial_range=0.02):
+        t = MemorySparseTable(dim, sgd_rule, learning_rate, initial_range)
+        self._tables[table_id] = t
+        return t
+
+    def register_dense_table(self, table_id, size, sgd_rule="adam",
+                             learning_rate=0.01):
+        t = MemoryDenseTable(size, sgd_rule, learning_rate)
+        self._tables[table_id] = t
+        return t
+
+    def _handle(self, sock, msg) -> bool:
+        op, table_id = struct.unpack("<BI", msg[:5])
+        body = msg[5:]
+        if op == STOP:
+            _send_msg(sock, b"\x01")
+            threading.Thread(target=self._server.shutdown,
+                             daemon=True).start()
+            return False
+        if op == BARRIER:
+            (n_participants,) = struct.unpack("<I", body[:4]) if body \
+                else (1,)
+            with self._barrier_cond:
+                gen = self._barrier_generation
+                self._barrier_count += 1
+                if self._barrier_count >= n_participants:
+                    self._barrier_count = 0
+                    self._barrier_generation += 1
+                    self._barrier_cond.notify_all()
+                else:
+                    self._barrier_cond.wait_for(
+                        lambda: self._barrier_generation != gen,
+                        timeout=300)
+            _send_msg(sock, b"\x01")
+            return True
+        table = self._tables[table_id]
+        if op == PULL_SPARSE:
+            (n,) = struct.unpack("<I", body[:4])
+            keys = np.frombuffer(body[4:4 + 8 * n], np.uint64)
+            vals = table.pull(keys.copy())
+            _send_msg(sock, vals.astype(np.float32).tobytes())
+        elif op == PUSH_SPARSE:
+            (n,) = struct.unpack("<I", body[:4])
+            keys = np.frombuffer(body[4:4 + 8 * n], np.uint64)
+            grads = np.frombuffer(body[4 + 8 * n:], np.float32).reshape(
+                n, table.dim)
+            table.push(keys.copy(), grads.copy())
+            _send_msg(sock, b"\x01")
+        elif op == PULL_DENSE:
+            vals = table.pull()
+            _send_msg(sock, struct.pack("<I", vals.size)
+                      + vals.astype(np.float32).tobytes())
+        elif op == PUSH_DENSE:
+            (n,) = struct.unpack("<I", body[:4])
+            grads = np.frombuffer(body[4:4 + 4 * n], np.float32)
+            table.push(grads.copy())
+            _send_msg(sock, b"\x01")
+        elif op == SAVE:
+            (ln,) = struct.unpack("<H", body[:2])
+            path = body[2:2 + ln].decode()
+            table.save(path)
+            _send_msg(sock, b"\x01")
+        return True
+
+    def run(self, background=True):
+        if background:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+
+
+class PSClient:
+    """Client with key-sharded routing across servers (BrpcPsClient
+    capability: shard_of(key) -> server)."""
+
+    def __init__(self, endpoints):
+        self.endpoints = [(h, int(p)) for h, p in
+                          (e.split(":") for e in endpoints)]
+        self._socks = []
+        for host, port in self.endpoints:
+            s = socket.create_connection((host, port), timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+        self._lock = threading.Lock()
+
+    def _shard_of(self, keys):
+        n = len(self._socks)
+        return ((keys * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(48)) \
+            % np.uint64(n)
+
+    def pull_sparse(self, table_id, keys: np.ndarray, dim: int):
+        shape = keys.shape
+        flat = keys.reshape(-1).astype(np.uint64)
+        out = np.empty((flat.size, dim), np.float32)
+        assign = self._shard_of(flat)
+        with self._lock:
+            for si, sock in enumerate(self._socks):
+                idx = np.where(assign == si)[0]
+                if idx.size == 0:
+                    continue
+                sub = flat[idx]
+                payload = struct.pack("<BII", PULL_SPARSE, table_id,
+                                      sub.size) + sub.tobytes()
+                _send_msg(sock, payload)
+                resp = _recv_msg(sock)
+                out[idx] = np.frombuffer(resp, np.float32).reshape(
+                    sub.size, dim)
+        return out.reshape(*shape, dim)
+
+    def push_sparse(self, table_id, keys: np.ndarray, grads: np.ndarray,
+                    dim: int):
+        flat = keys.reshape(-1).astype(np.uint64)
+        g = grads.reshape(flat.size, dim).astype(np.float32)
+        assign = self._shard_of(flat)
+        with self._lock:
+            for si, sock in enumerate(self._socks):
+                idx = np.where(assign == si)[0]
+                if idx.size == 0:
+                    continue
+                sub = flat[idx]
+                payload = struct.pack("<BII", PUSH_SPARSE, table_id,
+                                      sub.size) + sub.tobytes() + \
+                    g[idx].tobytes()
+                _send_msg(sock, payload)
+                _recv_msg(sock)
+
+    def pull_dense(self, table_id, server=0):
+        with self._lock:
+            sock = self._socks[server]
+            _send_msg(sock, struct.pack("<BI", PULL_DENSE, table_id))
+            resp = _recv_msg(sock)
+        (n,) = struct.unpack("<I", resp[:4])
+        return np.frombuffer(resp[4:], np.float32)[:n]
+
+    def push_dense(self, table_id, grads: np.ndarray, server=0):
+        g = grads.reshape(-1).astype(np.float32)
+        with self._lock:
+            sock = self._socks[server]
+            _send_msg(sock, struct.pack("<BII", PUSH_DENSE, table_id,
+                                        g.size) + g.tobytes())
+            _recv_msg(sock)
+
+    def barrier(self, num_trainers=1):
+        """Block until `num_trainers` clients reach the barrier on each
+        server (count-based rendezvous)."""
+        with self._lock:
+            for sock in self._socks:
+                _send_msg(sock, struct.pack("<BII", BARRIER, 0,
+                                            num_trainers))
+                _recv_msg(sock)
+
+    def save(self, table_id, path):
+        with self._lock:
+            for i, sock in enumerate(self._socks):
+                p = f"{path}.shard{i}".encode()
+                _send_msg(sock, struct.pack("<BIH", SAVE, table_id,
+                                            len(p)) + p)
+                _recv_msg(sock)
+
+    def stop_server(self):
+        with self._lock:
+            for sock in self._socks:
+                try:
+                    _send_msg(sock, struct.pack("<BI", STOP, 0))
+                    _recv_msg(sock)
+                except (ConnectionError, OSError):
+                    pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class RemoteSparseTable:
+    """MemorySparseTable-compatible facade over PSClient (so
+    SparseEmbedding works transparently against remote servers — the
+    distributed_lookup_table capability)."""
+
+    def __init__(self, client: PSClient, table_id: int, dim: int):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+
+    def pull(self, keys):
+        return self.client.pull_sparse(self.table_id, np.asarray(keys),
+                                       self.dim)
+
+    def push(self, keys, grads, shows=None, clicks=None):
+        self.client.push_sparse(self.table_id, np.asarray(keys),
+                                np.asarray(grads), self.dim)
+
+    def __len__(self):
+        raise NotImplementedError("size query not in the wire protocol yet")

@@ -1,0 +1,117 @@
+"""Table-driven op sweep — the reference's OpTest workhorse pattern
+(SURVEY §4: `op_test.py` check_output vs numpy across dtypes +
+check_grad via finite differences), TPU-translated: numpy oracle sweeps
+over float32/bfloat16 + analytic-vs-numeric grad checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(7)
+
+# (name, paddle_fn, numpy_fn, input_maker, check_grad)
+UNARY_CASES = [
+    ("exp", paddle.exp, np.exp, lambda: RNG.randn(3, 4) * 0.5, True),
+    ("log", paddle.log, np.log, lambda: RNG.rand(3, 4) + 0.5, True),
+    ("sqrt", paddle.sqrt, np.sqrt, lambda: RNG.rand(3, 4) + 0.1, True),
+    ("tanh", paddle.tanh, np.tanh, lambda: RNG.randn(3, 4), True),
+    ("sigmoid", paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x)),
+     lambda: RNG.randn(3, 4), True),
+    ("abs", paddle.abs, np.abs,
+     lambda: (lambda z: np.sign(z) * (np.abs(z) + 0.3))(RNG.randn(3, 4)),
+     True),
+    ("sin", paddle.sin, np.sin, lambda: RNG.randn(3, 4), True),
+    ("cos", paddle.cos, np.cos, lambda: RNG.randn(3, 4), True),
+    ("floor", paddle.floor, np.floor, lambda: RNG.randn(3, 4) * 3, False),
+    ("ceil", paddle.ceil, np.ceil, lambda: RNG.randn(3, 4) * 3, False),
+    ("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x),
+     lambda: RNG.rand(3, 4) + 0.5, True),
+    ("erf", paddle.erf, None, lambda: RNG.randn(3, 4), True),
+    ("log1p", paddle.log1p, np.log1p, lambda: RNG.rand(3, 4), True),
+    ("square", paddle.square, np.square, lambda: RNG.randn(3, 4), True),
+]
+
+BINARY_CASES = [
+    ("add", paddle.add, np.add),
+    ("subtract", paddle.subtract, np.subtract),
+    ("multiply", paddle.multiply, np.multiply),
+    ("divide", paddle.divide, np.divide),
+    ("maximum", paddle.maximum, np.maximum),
+    ("minimum", paddle.minimum, np.minimum),
+    ("pow", paddle.pow, np.power),
+    ("atan2", paddle.atan2, np.arctan2),
+]
+
+REDUCE_CASES = [
+    ("sum", paddle.sum, np.sum),
+    ("mean", paddle.mean, np.mean),
+    ("max", paddle.max, np.max),
+    ("min", paddle.min, np.min),
+    ("prod", paddle.prod, np.prod),
+]
+
+
+@pytest.mark.parametrize("name,pfn,nfn,mk,check_grad", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_sweep(name, pfn, nfn, mk, check_grad):
+    x_np = mk().astype(np.float32)
+    # fp32 value check vs numpy oracle
+    out = pfn(paddle.to_tensor(x_np))
+    if nfn is not None:
+        np.testing.assert_allclose(out.numpy(), nfn(x_np), rtol=1e-5,
+                                   atol=1e-6)
+    # bf16 runs and is close
+    out_bf = pfn(paddle.to_tensor(x_np, dtype="bfloat16"))
+    if nfn is not None:
+        np.testing.assert_allclose(
+            out_bf.astype("float32").numpy(), nfn(x_np), rtol=3e-2,
+            atol=3e-2)
+    if not check_grad:
+        return
+    # numeric grad check (OpTest.check_grad translation)
+    t = paddle.to_tensor(x_np, stop_gradient=False)
+    pfn(t).sum().backward()
+    analytic = t.grad.numpy()
+    eps = 1e-3
+    numeric = np.zeros_like(x_np)
+    flat = x_np.reshape(-1)
+    for i in range(flat.size):
+        xp, xm = flat.copy(), flat.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        fp = float(pfn(paddle.to_tensor(xp.reshape(x_np.shape))).sum())
+        fm = float(pfn(paddle.to_tensor(xm.reshape(x_np.shape))).sum())
+        numeric.reshape(-1)[i] = (fp - fm) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=5e-2, atol=5e-3)
+
+
+@pytest.mark.parametrize("name,pfn,nfn", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_sweep(name, pfn, nfn):
+    x = (RNG.rand(3, 4) + 0.5).astype(np.float32)
+    y = (RNG.rand(3, 4) + 0.5).astype(np.float32)
+    out = pfn(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), nfn(x, y), rtol=1e-5)
+    # broadcasting
+    yb = (RNG.rand(4) + 0.5).astype(np.float32)
+    outb = pfn(paddle.to_tensor(x), paddle.to_tensor(yb))
+    np.testing.assert_allclose(outb.numpy(), nfn(x, yb), rtol=1e-5)
+    # grads flow to both inputs
+    tx = paddle.to_tensor(x, stop_gradient=False)
+    ty = paddle.to_tensor(y, stop_gradient=False)
+    pfn(tx, ty).sum().backward()
+    assert tx.grad is not None and ty.grad is not None
+
+
+@pytest.mark.parametrize("name,pfn,nfn", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+def test_reduce_sweep(name, pfn, nfn):
+    x = RNG.rand(3, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(float(pfn(paddle.to_tensor(x))),
+                               nfn(x), rtol=1e-4)
+    np.testing.assert_allclose(
+        pfn(paddle.to_tensor(x), axis=1).numpy(), nfn(x, axis=1),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        pfn(paddle.to_tensor(x), axis=[0, 2], keepdim=True).numpy(),
+        nfn(x, axis=(0, 2), keepdims=True), rtol=1e-4)
