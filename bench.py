@@ -29,7 +29,9 @@ def main():
                         n_heads=16, n_layers=24, dp=1, pp=1, mp=1,
                         micro_batches=1, remat=True, zero_stage=0,
                         compute_dtype=jnp.bfloat16)
-        batch = 32   # best measured throughput on v5e (64 fails compile)
+        # 16 and 32 measure within noise of each other with fused
+        # attention (~17.5-18.4k tokens/s); 64 fails to compile (OOM)
+        batch = 32
         iters = 12
     else:  # CPU smoke mode
         cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128,
@@ -47,20 +49,24 @@ def main():
     lab = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, cfg.seq_len)),
                       jnp.int32)
 
-    # warmup / compile
+    # warmup / compile (device_get, not block_until_ready — the latter can
+    # return early through the axon relay)
     params, opt, loss = trainer.train_step(params, opt, tok, lab,
                                            step_num=1)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
 
-    # NOTE: sync every step — on the axon relay, block_until_ready on the
-    # tail of a long donated chain has been observed to return early, so
-    # per-step device_get of the loss is the trustworthy timing barrier.
+    # Timing barrier: on the axon relay, block_until_ready can return
+    # early (bogus timings), but jax.device_get fetches real bytes and the
+    # final step's loss data-depends on every previous step — one fetch at
+    # the end is an honest barrier without the ~0.3s/step host round-trip
+    # a per-step fetch would add.
     t0 = time.perf_counter()
     for i in range(iters):
         params, opt, loss = trainer.train_step(params, opt, tok, lab,
                                                step_num=i + 2)
-        float(jax.device_get(loss))
+    final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     tokens_per_sec = batch * cfg.seq_len * iters / dt
     metric = ("gpt2_350m_train_tokens_per_sec_per_chip" if on_tpu

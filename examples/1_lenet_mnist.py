@@ -1,0 +1,18 @@
+"""BASELINE config 1: LeNet MNIST via Model.fit (hapi + compiled step)."""
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.datasets import MNIST
+
+
+def main():
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    model.fit(MNIST(mode="train"), epochs=2, batch_size=64,
+              verbose=2, drop_last=True)
+    print(model.evaluate(MNIST(mode="test"), batch_size=64, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

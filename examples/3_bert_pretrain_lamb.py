@@ -1,0 +1,39 @@
+"""BASELINE config 3: BERT pretraining objective (MLM+NSP) with LAMB."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (bert_base, bert_tiny, BertForPretraining,
+                               BertPretrainingCriterion)
+
+
+def synthetic_batch(rng, batch, seq, vocab):
+    tok = rng.randint(1, vocab, (batch, seq))
+    mlm = rng.randint(0, vocab, (batch, seq))
+    mlm[rng.rand(batch, seq) > 0.15] = -1  # only 15% masked positions
+    nsp = rng.randint(0, 2, (batch,))
+    return tok, mlm, nsp
+
+
+def main(steps=20, batch=8, seq=128, tiny=True):
+    bert = bert_tiny() if tiny else bert_base()
+    model = BertForPretraining(bert)
+    crit = BertPretrainingCriterion(bert.vocab_size)
+    opt = paddle.optimizer.Lamb(learning_rate=1e-3,
+                                lamb_weight_decay=0.01,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    model.train()
+    for step in range(steps):
+        tok, mlm, nsp = synthetic_batch(rng, batch, seq, bert.vocab_size)
+        pred, rel = model(paddle.to_tensor(tok))
+        loss = crit(pred, rel, paddle.to_tensor(mlm),
+                    paddle.to_tensor(nsp))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

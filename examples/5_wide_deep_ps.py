@@ -1,0 +1,74 @@
+"""BASELINE config 5: Wide&Deep on Criteo-style slot data with the native
+parameter-server engine (C++ tables + DataFeed; AUC metric).
+
+Single-process by default; set the PS env for true client/server mode:
+  TRAINING_ROLE=PSERVER PADDLE_PSERVERS_IP_PORT_LIST=... (server)
+  TRAINING_ROLE=TRAINER PADDLE_PSERVERS_IP_PORT_LIST=... (trainer)
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.ps import InMemoryDataset, SparseEmbedding
+from paddle_tpu.ps.runtime import get_ps_runtime
+
+
+def make_slot_files(path, n=20000, slots=(1, 2, 3, 4), vocab=10000):
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for _ in range(n):
+            feats = [rng.randint(0, vocab) for _ in slots]
+            label = int((feats[0] % 3 == 0) ^ (feats[1] % 2 == 0))
+            f.write(f"{label} " + " ".join(
+                f"{s}:{s * 100000 + v}" for s, v in zip(slots, feats))
+                + "\n")
+    return path
+
+
+def main(epochs=3, batch_size=512, dim=8):
+    tmp = tempfile.mkdtemp()
+    data = make_slot_files(os.path.join(tmp, "part-0.txt"))
+    slots = [1, 2, 3, 4]
+
+    ds = InMemoryDataset()
+    ds.init(batch_size=batch_size, slots=slots, max_per_slot=1)
+    ds.set_filelist([data])
+    ds.load_into_memory()
+    ds.global_shuffle(seed=42)
+    print("records:", ds.get_memory_data_size())
+
+    rt = get_ps_runtime()
+    table = rt.create_sparse_table(0, dim=dim, sgd_rule="adagrad",
+                                   learning_rate=0.1)
+    emb = SparseEmbedding(dim=dim, table=table)
+    deep = nn.Sequential(nn.Linear(len(slots) * dim, 64), nn.ReLU(),
+                         nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 1))
+    wide = nn.Linear(len(slots) * dim, 1)
+    opt = paddle.optimizer.Adam(
+        1e-3, parameters=deep.parameters() + wide.parameters())
+    auc = paddle.metric.Auc()
+
+    for epoch in range(epochs):
+        auc.reset()
+        for keys, labels in ds:
+            n = keys.shape[0]
+            acts = emb(keys).reshape([n, len(slots) * dim])
+            logits = (deep(acts) + wide(acts)).reshape([n])
+            loss = nn.functional.binary_cross_entropy_with_logits(
+                logits, paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            auc.update(1 / (1 + np.exp(-logits.numpy())), labels)
+        print(f"epoch {epoch}: loss {float(loss):.4f} "
+              f"auc {auc.accumulate():.4f} "
+              f"table {len(table)} features")
+    rt.save_persistables(os.path.join(tmp, "ps_model"))
+    print("saved to", os.path.join(tmp, "ps_model"))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,7 @@ def linear(x, weight, bias=None, name=None):
     """y = x @ W + b. Weight layout [in, out] (paddle nn.Linear)."""
     x, weight = as_tensor(x), as_tensor(weight)
     from ...ops.linalg import _amp_cast2
-    x, weight = _amp_cast2(x, weight)
+    x, weight = _amp_cast2(x, weight)  # O1 cast + O2 dtype harmonization
     if bias is not None:
         bias = as_tensor(bias)
         if bias.dtype != x.dtype and jnp.issubdtype(x.dtype, jnp.floating):

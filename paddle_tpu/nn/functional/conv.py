@@ -42,7 +42,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
           data_format, name):
     x, weight = as_tensor(x), as_tensor(weight)
     from ...ops.linalg import _amp_cast2
-    x, weight = _amp_cast2(x, weight)
+    x, weight = _amp_cast2(x, weight)  # O1 cast + O2 dtype harmonization
     strides = _tuple(stride, n)
     dilations = _tuple(dilation, n)
     pad = _conv_padding(padding, n)

@@ -18,8 +18,11 @@ from ._helpers import as_tensor, unary, binary
 
 
 def _amp_cast2(x, y):
-    """O1 auto-cast for matmul-class ops (white list in
-    `imperative/amp_auto_cast.cc`): cast fp32 inputs to bf16 under auto_cast."""
+    """AMP casts for matmul-class ops (white list in
+    `imperative/amp_auto_cast.cc`):
+    - O1 auto_cast: fp32 inputs -> the amp dtype (bf16)
+    - O2 decorate: weights already low-precision; harmonize a fp32 input
+      to the weight dtype so decorated layers accept fp32 pipelines."""
     from ..amp.auto_cast import _amp_enabled, _amp_level, _amp_dtype
     if _amp_enabled() and _amp_level() == "O1":
         dt = _amp_dtype()
@@ -27,6 +30,13 @@ def _amp_cast2(x, y):
             x = x.astype(dt)
         if y.dtype == jnp.float32:
             y = y.astype(dt)
+    if x.dtype != y.dtype and jnp.issubdtype(x.dtype, jnp.floating) \
+            and jnp.issubdtype(y.dtype, jnp.floating):
+        # cast toward the lower-precision side (the decorated weight)
+        if jnp.finfo(x.dtype).bits > jnp.finfo(y.dtype).bits:
+            x = x.astype(y.dtype)
+        else:
+            y = y.astype(x.dtype)
     return x, y
 
 
