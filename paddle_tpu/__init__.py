@@ -48,6 +48,9 @@ from . import autograd_api as autograd  # noqa — paddle.autograd
 from . import onnx  # noqa
 from . import inference  # noqa
 from . import hub  # noqa
+from . import quantization  # noqa
+from . import text  # noqa
+from . import utils  # noqa
 from .flags import set_flags, get_flags  # noqa
 from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa
                       ClipGradByGlobalNorm)

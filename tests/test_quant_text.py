@@ -1,0 +1,152 @@
+"""Quantization (QAT/PTQ/weight-only) + text (viterbi_decode)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_fake_quant_ste_grad():
+    from paddle_tpu.quantization import fake_quant
+    x = paddle.to_tensor([0.1, -0.5, 0.9], stop_gradient=False)
+    y = fake_quant(x, scale=1.0, bits=8)
+    # quant error bounded by scale/qmax
+    assert np.abs(y.numpy() - x.numpy()).max() <= 1.0 / 127 + 1e-6
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 1])  # STE
+
+
+def test_qat_swaps_linears_and_trains():
+    from paddle_tpu.quantization import QAT
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    QAT().quantize(net)
+    from paddle_tpu.quantization import QuantedLinear
+    assert isinstance(net[0], QuantedLinear)
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    x = paddle.randn([4, 8])
+    y = paddle.to_tensor(np.random.randint(0, 2, (4,)))
+    net.train()
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss))
+
+
+def test_weight_only_linear():
+    from paddle_tpu.quantization import weight_quantize, weight_only_linear
+    w = paddle.randn([16, 8])
+    x = paddle.randn([4, 16])
+    qw, scale = weight_quantize(w)
+    assert qw.dtype == np.int8
+    out = weight_only_linear(x, qw, scale)
+    ref = x.numpy() @ w.numpy()
+    # int8 weight quantization error
+    assert np.abs(out.numpy() - ref).max() < 0.2
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import viterbi_decode
+    # deterministic chain: tag 1 dominates everywhere
+    B, T, N = 2, 5, 3
+    pot = np.full((B, T, N), -1.0, np.float32)
+    pot[:, :, 1] = 2.0
+    trans = np.zeros((N + 2, N + 2), np.float32)
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans))
+    assert paths.shape == [B, T]
+    np.testing.assert_array_equal(paths.numpy(),
+                                  np.ones((B, T), np.int32))
+    assert float(scores[0]) == pytest.approx(2.0 * T, abs=1e-4)
+
+
+def test_viterbi_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    B, T, N = 1, 4, 3
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans),
+                                   include_bos_eos_tag=False)
+    # brute force
+    import itertools
+    best, best_path = -1e9, None
+    for path in itertools.product(range(N), repeat=T):
+        s = pot[0, 0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + pot[0, t, path[t]]
+        if s > best:
+            best, best_path = s, path
+    assert float(scores[0]) == pytest.approx(best, abs=1e-4)
+    np.testing.assert_array_equal(paths.numpy()[0], best_path)
+
+
+def test_viterbi_lengths_mask_padding():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(1)
+    N = 3
+    pot_short = rng.randn(1, 3, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    # same sequence padded to T=6 with garbage
+    pot_pad = np.concatenate(
+        [pot_short, 100 * rng.randn(1, 3, N).astype(np.float32)], axis=1)
+    s_ref, p_ref = viterbi_decode(
+        paddle.to_tensor(pot_short), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    s_pad, p_pad = viterbi_decode(
+        paddle.to_tensor(pot_pad), paddle.to_tensor(trans),
+        lengths=paddle.to_tensor(np.array([3], np.int32)),
+        include_bos_eos_tag=False)
+    assert float(s_pad) == pytest.approx(float(s_ref), abs=1e-4)
+    np.testing.assert_array_equal(p_pad.numpy()[0, :3], p_ref.numpy()[0])
+
+
+def test_quant_inplace_false_preserves_original():
+    from paddle_tpu.quantization import QAT, QuantedLinear
+    net = nn.Sequential(nn.Linear(4, 4))
+    q = QAT().quantize(net, inplace=False)
+    assert isinstance(q[0], QuantedLinear)
+    assert isinstance(net[0], nn.Linear)  # original untouched
+
+
+def test_ptq_calibration_flow():
+    from paddle_tpu.quantization import PTQ, QuantedLinear
+    net = nn.Sequential(nn.Linear(4, 4))
+    ptq = PTQ()
+    ptq.quantize(net)
+    net.eval()
+    for _ in range(3):
+        net(paddle.randn([2, 4]) * 5.0)  # calibration batches in eval
+    ptq.convert(net)
+    ql = net[0]
+    assert float(ql.act_scale) > 0  # scales observed during calibration
+    frozen = float(ql.act_scale)
+    net(paddle.randn([2, 4]) * 100.0)  # inference must not move scales
+    assert float(ql.act_scale) == pytest.approx(frozen)
+
+
+def test_qat_in_compiled_model_fit():
+    """QAT layers must work inside the compiled Model.fit step."""
+    from paddle_tpu.quantization import QAT
+    from paddle_tpu.io import TensorDataset
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    QAT().quantize(net)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    xs = np.random.rand(32, 8).astype(np.float32)
+    ys = np.random.randint(0, 2, (32, 1))
+    model.fit(TensorDataset([xs, ys]), epochs=1, batch_size=8, verbose=0)
+    assert model._jit_ok  # traced fake-quant + buffer observer update
+    assert float(net[0].act_scale) > 0
+
+
+def test_text_datasets():
+    from paddle_tpu.text import Imdb, UCIHousing
+    ds = Imdb(mode="train")
+    x, y = ds[0]
+    assert x.shape == (64,) and y.shape == (1,)
+    h = UCIHousing(mode="test")
+    assert len(h) == 102
