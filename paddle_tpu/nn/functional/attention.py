@@ -122,8 +122,56 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return dispatch.apply("sdpa", _fn, tuple(inputs))
 
 
-def sparse_attention(query, key, value, sparse_csr_offset=None,
-                     sparse_csr_columns=None, **kw):
-    raise NotImplementedError(
-        "sparse_attention: use scaled_dot_product_attention with an additive "
-        "mask; block-sparse pallas kernel planned")
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Parity: `python/paddle/nn/functional/sparse_attention.py` —
+    layout [B, H, S, D] with a per-(batch, head) CSR sparsity pattern.
+
+    TPU-native realisation: the CSR pattern densifies into an additive
+    mask consumed by the fused attention (XLA's flash-style kernel skips
+    fully-masked blocks); a Pallas block-sparse kernel is the perf
+    upgrade path.
+    """
+    q = as_tensor(query)
+    k = as_tensor(key)
+    v = as_tensor(value)
+    offs = as_tensor(sparse_csr_offset)
+    cols = as_tensor(sparse_csr_columns)
+    extra = []
+    kpm_idx = am_idx = None
+    if key_padding_mask is not None:
+        kpm_idx = len(extra)
+        extra.append(as_tensor(key_padding_mask))
+    if attn_mask is not None:
+        am_idx = len(extra)
+        extra.append(as_tensor(attn_mask))
+
+    def _fn(qa, ka, va, off, col, *rest):
+        B, H, S, D = qa.shape
+        # dense bool mask [B, H, S, S] from CSR rows (padded column
+        # entries map past the last offset and are dropped by jax's
+        # out-of-bounds scatter semantics)
+
+        def one_bh(off_bh, col_bh):
+            # positions of each nnz entry -> (row, col) scatter
+            rows = jnp.searchsorted(off_bh, jnp.arange(col_bh.shape[0]),
+                                    side="right") - 1
+            m = jnp.zeros((S, S), bool)
+            return m.at[rows, col_bh].set(True)
+        mask = jax.vmap(jax.vmap(one_bh))(off, col)
+        bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+        if kpm_idx is not None:
+            kpm = rest[kpm_idx]  # [B, S]: 0 masks the key position
+            bias = bias + jnp.where(kpm[:, None, None, :] > 0.5, 0.0,
+                                    -1e30)
+        if am_idx is not None:
+            bias = bias + rest[am_idx].astype(jnp.float32)
+        # to [B, S, H, D] for the fused kernel
+        qt = jnp.swapaxes(qa, 1, 2)
+        kt = jnp.swapaxes(ka, 1, 2)
+        vt = jnp.swapaxes(va, 1, 2)
+        out = _xla_attention(qt, kt, vt, bias=bias, causal=False)
+        return jnp.swapaxes(out, 1, 2)
+    return dispatch.apply("sparse_attention", _fn,
+                          (q, k, v, offs, cols, *extra))

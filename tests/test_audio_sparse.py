@@ -1,0 +1,70 @@
+"""Audio features + sparse_attention."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.RandomState(0)
+    B, H, S, D = 1, 2, 4, 8
+    q = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+    offs, cols = [], []
+    for h in range(H):
+        o, c = [0], []
+        for i in range(S):
+            row = [max(i - 1, 0), i] if i > 0 else [0]
+            c += row
+            o.append(len(c))
+        offs.append(o)
+        cols.append(c + [0] * ((2 * S - 1) - len(c)))
+    offsets = paddle.to_tensor(np.array([offs], np.int32))
+    columns = paddle.to_tensor(np.array([cols], np.int32))
+    out = F.sparse_attention(q, k, v, offsets, columns)
+    mask = np.full((B, H, S, S), -1e30, np.float32)
+    for h in range(H):
+        for i in range(S):
+            for j in ([max(i - 1, 0), i] if i > 0 else [0]):
+                mask[0, h, i, j] = 0.0
+    logits = np.einsum("bhsd,bhtd->bhst", q.numpy(),
+                       k.numpy()) / np.sqrt(D) + mask
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bhtd->bhsd", p, v.numpy())
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_mel_spectrogram_peak_bin():
+    from paddle_tpu.audio.features import MelSpectrogram
+    sr, freq = 16000, 1000.0
+    t = np.arange(8192) / sr
+    sig = paddle.to_tensor(np.sin(2 * np.pi * freq * t)
+                           .astype(np.float32).reshape(1, -1))
+    mel = MelSpectrogram(sr=sr, n_fft=512, n_mels=40, f_min=0.0)(sig)
+    assert mel.shape[1] == 40
+    # energy concentrated in one mel band
+    band_energy = mel.numpy()[0].mean(axis=1)
+    assert band_energy.max() > 10 * np.median(band_energy + 1e-9)
+
+
+def test_mfcc_and_logmel():
+    from paddle_tpu.audio.features import MFCC, LogMelSpectrogram
+    sig = paddle.to_tensor(np.random.randn(2, 4096).astype(np.float32))
+    lm = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=32)(sig)
+    assert np.isfinite(lm.numpy()).all()
+    mf = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=32)(sig)
+    assert mf.shape[0] == 2 and mf.shape[1] == 13
+
+
+def test_audio_functional():
+    from paddle_tpu.audio import functional as AF
+    assert AF.hz_to_mel(1000.0) == pytest.approx(15.0, rel=1e-3)
+    assert AF.mel_to_hz(AF.hz_to_mel(440.0)) == pytest.approx(440.0,
+                                                             rel=1e-4)
+    fb = AF.compute_fbank_matrix(16000, 512, 40)
+    assert fb.shape == [40, 257]
+    w = AF.get_window("hann", 400)
+    assert w.shape == [400]
