@@ -109,7 +109,10 @@ class Model:
                 out.append(a)
         return out
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def _train_batch_inner(self, inputs, labels, update=True):
+        """Returns ([loss_tensor], metrics) WITHOUT host synchronisation
+        (the fit loop materialises losses lazily at log points — a host
+        round-trip per step costs ~0.3s through the TPU relay)."""
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
@@ -122,8 +125,7 @@ class Model:
                         n_labels=len(labels) or 1)
                 loss, outs = self._train_step.run(*batch)
                 metrics = self._update_metrics(outs, labels)
-                return [loss.numpy()] if not metrics else \
-                    ([loss.numpy()], metrics)
+                return [loss], metrics
             except Exception as e:  # fall back to eager once
                 warnings.warn(
                     f"compiled train step failed ({type(e).__name__}: {e}); "
@@ -144,7 +146,12 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outs_l, labels)
-        return [loss.numpy()] if not metrics else ([loss.numpy()], metrics)
+        return [loss], metrics
+
+    def train_batch(self, inputs, labels=None, update=True):
+        losses, metrics = self._train_batch_inner(inputs, labels, update)
+        np_losses = [l.numpy() for l in losses]
+        return np_losses if not metrics else (np_losses, metrics)
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -203,14 +210,20 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
+            res = None
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 ins, lbs = self._split_batch(batch)
-                res = self.train_batch(ins, lbs)
-                logs = self._make_logs(res)
+                res = self._train_batch_inner(ins, lbs)
+                # lazy logging: only materialise the loss (device->host
+                # sync) at log points so steps pipeline on the device
+                if step % max(log_freq, 1) == 0:
+                    logs = self._make_logs(res)
                 cbks.on_train_batch_end(step, logs)
                 if num_iters is not None and step + 1 >= num_iters:
                     break
+            if res is not None:
+                logs = self._make_logs(res)
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,

@@ -7,3 +7,5 @@ from .table import (MemorySparseTable, MemoryDenseTable,  # noqa: F401
                     InMemoryDataset)
 from .embedding import SparseEmbedding  # noqa: F401
 from .runtime import get_ps_runtime, PSRuntime  # noqa: F401
+from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
+from .trainer import HogwildTrainer  # noqa: F401

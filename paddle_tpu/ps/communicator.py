@@ -1,0 +1,120 @@
+"""Async gradient communicator.
+
+Parity: `Communicator` (`paddle/fluid/distributed/ps/service/communicator/
+communicator.h:235`) — the a_sync PS mode: trainer threads enqueue sparse
+grads; background send threads MERGE grads by key (the reference's
+merge_add) and push batched updates to the tables/servers, decoupling the
+training loop from PS latency. flush() drains (the barrier before
+save/eval).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class AsyncCommunicator:
+    def __init__(self, send_queue_size=64, merge_size=4, num_threads=1):
+        self._q = queue.Queue(maxsize=send_queue_size)
+        self.merge_size = merge_size
+        self.num_threads = num_threads
+        self._threads = []
+        self._running = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        for _ in range(self.num_threads):
+            t = threading.Thread(target=self._send_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self.flush()
+        self._running = False
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+
+    def push_sparse(self, table, keys: np.ndarray, grads: np.ndarray):
+        """Non-blocking enqueue (blocks only when the send queue is full —
+        backpressure, like the reference's bounded send queue)."""
+        if not self._running:
+            raise RuntimeError(
+                "AsyncCommunicator is stopped; call start() before pushing")
+        with self._inflight_cv:
+            self._inflight += 1
+        self._q.put((table, keys.copy(), grads.copy()))
+
+    def flush(self):
+        """Barrier: wait until every enqueued push has been applied."""
+        with self._inflight_cv:
+            self._inflight_cv.wait_for(lambda: self._inflight == 0,
+                                       timeout=60)
+
+    def _send_loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch = [item]
+            # opportunistically merge up to merge_size pending requests
+            # for the same table (async merge_add)
+            while len(batch) < self.merge_size:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)
+                    break
+                if nxt[0] is not batch[0][0]:
+                    self._q.put(nxt)
+                    break
+                batch.append(nxt)
+            table = batch[0][0]
+            dim = batch[0][2].reshape(-1, batch[0][2].shape[-1]).shape[-1]
+            all_keys = np.concatenate(
+                [b[1].reshape(-1) for b in batch]).astype(np.uint64)
+            all_grads = np.concatenate(
+                [b[2].reshape(-1, dim) for b in batch])
+            # merge duplicate keys: sum grads per unique key
+            uniq, inv = np.unique(all_keys, return_inverse=True)
+            merged = np.zeros((uniq.size, dim), np.float32)
+            np.add.at(merged, inv, all_grads)
+            table.push(uniq, merged)
+            with self._inflight_cv:
+                self._inflight -= len(batch)
+                if self._inflight == 0:
+                    self._inflight_cv.notify_all()
+
+
+class GeoCommunicator(AsyncCommunicator):
+    """Geo-SGD dense mode sketch (communicator.h geo): dense deltas pushed
+    every k steps. Round-1: dense tables push synchronously; the geo delta
+    logic applies when dense params train locally."""
+
+    def __init__(self, k_steps=100, **kw):
+        super().__init__(**kw)
+        self.k_steps = k_steps
+        self._dense_shadow = {}
+        self._step = 0
+
+    def maybe_push_dense(self, table, params: np.ndarray):
+        """Push the delta vs the last synced snapshot every k steps."""
+        self._step += 1
+        tid = id(table)
+        if tid not in self._dense_shadow:
+            self._dense_shadow[tid] = params.copy()
+            return
+        if self._step % self.k_steps == 0:
+            delta = self._dense_shadow[tid] - params  # table.push applies -lr*g; lr=1 naive
+            table.push(delta)
+            self._dense_shadow[tid] = table.pull().copy()

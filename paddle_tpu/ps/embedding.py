@@ -24,12 +24,16 @@ from .table import MemorySparseTable
 
 class SparseEmbedding(Layer):
     def __init__(self, dim=8, sgd_rule="adagrad", learning_rate=0.05,
-                 initial_range=0.02, table=None, name=None):
+                 initial_range=0.02, table=None, communicator=None,
+                 name=None):
         super().__init__()
         self.dim = dim
         self.table = table if table is not None else MemorySparseTable(
             dim, sgd_rule, learning_rate, initial_range)
-        self._pending = []
+        # a_sync mode: pushes go through the background communicator
+        self.communicator = communicator
+        if communicator is not None:
+            communicator.start()
 
     def forward(self, keys):
         """keys: uint64/int ndarray or Tensor [batch, n_slots, per_slot]
@@ -47,11 +51,17 @@ class SparseEmbedding(Layer):
             # double-apply earlier contributions
             state = {"pushed": None}
 
-            def push_hook(grad, _keys=keys_np, _table=table, _s=state):
+            comm = self.communicator
+
+            def push_hook(grad, _keys=keys_np, _table=table, _s=state,
+                          _comm=comm):
                 g = grad.numpy()
                 delta = g if _s["pushed"] is None else g - _s["pushed"]
                 _s["pushed"] = g.copy()
-                _table.push(_keys, delta)
+                if _comm is not None:
+                    _comm.push_sparse(_table, _keys, delta)
+                else:
+                    _table.push(_keys, delta)
             t.register_hook(push_hook)
         return t
 

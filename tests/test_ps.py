@@ -161,6 +161,47 @@ def test_dense_table_persistence(tmp_path):
     np.testing.assert_allclose(d2.pull(), np.arange(8))
 
 
+def test_async_communicator_merges_and_flushes():
+    from paddle_tpu.ps import AsyncCommunicator
+    t = MemorySparseTable(dim=2, sgd_rule="naive", learning_rate=1.0)
+    keys = np.array([5, 9], np.uint64)
+    v0 = t.pull(keys).copy()
+    comm = AsyncCommunicator(merge_size=8)
+    comm.start()
+    # 10 async pushes of unit grads incl. duplicate keys to merge
+    for _ in range(10):
+        comm.push_sparse(t, keys, np.ones((2, 2), np.float32))
+    comm.flush()
+    v1 = t.pull(keys)
+    np.testing.assert_allclose(v1, v0 - 10.0, rtol=1e-5)
+    comm.stop()
+
+
+def test_async_embedding_trains():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.ps import AsyncCommunicator
+    comm = AsyncCommunicator()
+    emb = SparseEmbedding(dim=4, sgd_rule="adagrad", learning_rate=0.3,
+                          communicator=comm)
+    tower = nn.Linear(4, 1)
+    opt = paddle.optimizer.Adam(1e-2, parameters=tower.parameters())
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, 40, (128, 1, 1)).astype(np.uint64)
+    y = ((keys.reshape(-1) % 2) == 0).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        acts = emb(keys)
+        logits = tower(acts.reshape([128, 4])).reshape([128])
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            logits, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    comm.stop()
+    assert losses[-1] < losses[0]
+
+
 def test_ps_runtime_fleet_integration(tmp_path):
     from paddle_tpu.ps.runtime import get_ps_runtime
     rt = get_ps_runtime()
