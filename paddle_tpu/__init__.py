@@ -52,6 +52,7 @@ from . import quantization  # noqa
 from . import text  # noqa
 from . import utils  # noqa
 from . import audio  # noqa
+from . import geometric  # noqa
 from .flags import set_flags, get_flags  # noqa
 from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa
                       ClipGradByGlobalNorm)

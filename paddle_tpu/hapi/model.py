@@ -75,6 +75,17 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._dist_mesh = None
+        # amp_configs parity: {'level': 'O1'|'O2', 'dtype': ...} or 'O2'
+        if amp_configs:
+            from .. import amp as amp_mod
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            level = amp_configs.get("level", "O1")
+            dtype = amp_configs.get("dtype", "bfloat16")
+            if level == "O2":
+                amp_mod.decorate(self.network, level="O2", dtype=dtype)
+            self._amp_level = level
+            self._amp_dtype = dtype
         from ..parallel import env as dist_env
         if dist_env.get_world_size() > 1:
             dist_env.init_parallel_env()
@@ -89,6 +100,17 @@ class Model:
     # ------------------------------------------------------------- batch
     def _n_labels(self):
         return max(len(self._labels), 1)
+
+    def _amp_context(self):
+        """O1 auto_cast context from prepare(amp_configs=...) — must wrap
+        the forward (incl. the compiled step's tracing call)."""
+        if getattr(self, "_amp_level", None) == "O1":
+            from .. import amp as amp_mod
+            return amp_mod.auto_cast(level="O1",
+                                     dtype=getattr(self, "_amp_dtype",
+                                                   "bfloat16"))
+        import contextlib
+        return contextlib.nullcontext()
 
     def _maybe_shard(self, arrays):
         """Shard batch dim 0 over the dp mesh axis (DataParallel: the
@@ -117,13 +139,15 @@ class Model:
         inputs = _to_list(inputs)
         labels = _to_list(labels)
         batch = self._maybe_shard(_arrays(inputs) + _arrays(labels))
+        amp_ctx = self._amp_context()
         if self._jit_ok:
             try:
                 if self._train_step is None:
                     self._train_step = CompiledTrainStep(
                         self.network, self._loss, self._optimizer,
                         n_labels=len(labels) or 1)
-                loss, outs = self._train_step.run(*batch)
+                with amp_ctx:  # active during first-call tracing (O1)
+                    loss, outs = self._train_step.run(*batch)
                 metrics = self._update_metrics(outs, labels)
                 return [loss], metrics
             except Exception as e:  # fall back to eager once
@@ -136,11 +160,14 @@ class Model:
                     self._train_step.restore_accums()
                 self._jit_ok = False
         # eager path (DynamicGraphAdapter.train_batch parity)
-        outs = self.network(*[t if isinstance(t, Tensor) else Tensor(t)
-                              for t in inputs])
-        outs_l = _to_list(outs)
-        lbl = [t if isinstance(t, Tensor) else Tensor(t) for t in labels]
-        loss = self._loss(*outs_l, *lbl) if self._loss else outs_l[0]
+        with self._amp_context():
+            outs = self.network(*[t if isinstance(t, Tensor) else Tensor(t)
+                                  for t in inputs])
+            outs_l = _to_list(outs)
+            lbl = [t if isinstance(t, Tensor) else Tensor(t)
+                   for t in labels]
+            loss = self._loss(*outs_l, *lbl) if self._loss else outs_l[0]
+        loss = loss.astype("float32") if loss.dtype != np.float32 else loss
         loss.backward()
         if update:
             self._optimizer.step()
@@ -216,10 +243,14 @@ class Model:
                 ins, lbs = self._split_batch(batch)
                 res = self._train_batch_inner(ins, lbs)
                 # lazy logging: only materialise the loss (device->host
-                # sync) at log points so steps pipeline on the device
+                # sync) at log points so steps pipeline on the device;
+                # non-log steps hand callbacks an EMPTY dict rather than
+                # stale values (per-step consumers set log_freq=1)
                 if step % max(log_freq, 1) == 0:
                     logs = self._make_logs(res)
-                cbks.on_train_batch_end(step, logs)
+                    cbks.on_train_batch_end(step, logs)
+                else:
+                    cbks.on_train_batch_end(step, {})
                 if num_iters is not None and step + 1 >= num_iters:
                     break
             if res is not None:

@@ -24,6 +24,7 @@ class AsyncCommunicator:
         self._running = False
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        self._errors = []
 
     def start(self):
         if self._running:
@@ -54,46 +55,64 @@ class AsyncCommunicator:
         self._q.put((table, keys.copy(), grads.copy()))
 
     def flush(self):
-        """Barrier: wait until every enqueued push has been applied."""
+        """Barrier: wait until every enqueued push has been applied.
+        Raises the first send-thread error, if any (silently dropped
+        grads would otherwise masquerade as a completed flush)."""
         with self._inflight_cv:
-            self._inflight_cv.wait_for(lambda: self._inflight == 0,
-                                       timeout=60)
+            done = self._inflight_cv.wait_for(
+                lambda: self._inflight == 0 or self._errors, timeout=60)
+        if self._errors:
+            raise self._errors[0]
+        if not done:
+            raise TimeoutError("AsyncCommunicator.flush timed out")
 
     def _send_loop(self):
+        holdover = None  # different-table item deferred to next round
         while True:
-            item = self._q.get()
+            item = holdover if holdover is not None else self._q.get()
+            holdover = None
             if item is None:
                 return
             batch = [item]
             # opportunistically merge up to merge_size pending requests
-            # for the same table (async merge_add)
+            # for the same table (async merge_add). NOTE: never put items
+            # back into the bounded queue — this thread is its consumer
+            # and a blocking put would deadlock against producers.
+            stop_after = False
             while len(batch) < self.merge_size:
                 try:
                     nxt = self._q.get_nowait()
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._q.put(None)
+                    stop_after = True
                     break
                 if nxt[0] is not batch[0][0]:
-                    self._q.put(nxt)
+                    holdover = nxt
                     break
                 batch.append(nxt)
-            table = batch[0][0]
-            dim = batch[0][2].reshape(-1, batch[0][2].shape[-1]).shape[-1]
-            all_keys = np.concatenate(
-                [b[1].reshape(-1) for b in batch]).astype(np.uint64)
-            all_grads = np.concatenate(
-                [b[2].reshape(-1, dim) for b in batch])
-            # merge duplicate keys: sum grads per unique key
-            uniq, inv = np.unique(all_keys, return_inverse=True)
-            merged = np.zeros((uniq.size, dim), np.float32)
-            np.add.at(merged, inv, all_grads)
-            table.push(uniq, merged)
-            with self._inflight_cv:
-                self._inflight -= len(batch)
-                if self._inflight == 0:
-                    self._inflight_cv.notify_all()
+            try:
+                table = batch[0][0]
+                dim = batch[0][2].reshape(
+                    -1, batch[0][2].shape[-1]).shape[-1]
+                all_keys = np.concatenate(
+                    [b[1].reshape(-1) for b in batch]).astype(np.uint64)
+                all_grads = np.concatenate(
+                    [b[2].reshape(-1, dim) for b in batch])
+                # merge duplicate keys: sum grads per unique key
+                uniq, inv = np.unique(all_keys, return_inverse=True)
+                merged = np.zeros((uniq.size, dim), np.float32)
+                np.add.at(merged, inv, all_grads)
+                table.push(uniq, merged)
+            except Exception as e:  # noqa: BLE001
+                self._errors.append(e)
+            finally:
+                with self._inflight_cv:
+                    self._inflight -= len(batch)
+                    if self._inflight == 0 or self._errors:
+                        self._inflight_cv.notify_all()
+            if stop_after:
+                return
 
 
 class GeoCommunicator(AsyncCommunicator):
@@ -105,16 +124,18 @@ class GeoCommunicator(AsyncCommunicator):
         super().__init__(**kw)
         self.k_steps = k_steps
         self._dense_shadow = {}
-        self._step = 0
+        self._steps = {}  # per-table step counters
 
     def maybe_push_dense(self, table, params: np.ndarray):
-        """Push the delta vs the last synced snapshot every k steps."""
-        self._step += 1
+        """Push the delta vs the last synced snapshot every k steps (per
+        table)."""
         tid = id(table)
+        self._steps[tid] = self._steps.get(tid, 0) + 1
         if tid not in self._dense_shadow:
             self._dense_shadow[tid] = params.copy()
             return
-        if self._step % self.k_steps == 0:
-            delta = self._dense_shadow[tid] - params  # table.push applies -lr*g; lr=1 naive
+        if self._steps[tid] % self.k_steps == 0:
+            # table.push applies -lr*g with lr=1 naive rule
+            delta = self._dense_shadow[tid] - params
             table.push(delta)
             self._dense_shadow[tid] = table.pull().copy()

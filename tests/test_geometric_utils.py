@@ -1,0 +1,121 @@
+"""paddle.geometric segment ops + nn.utils."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6], [7, 8]],
+                                     np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+    np.testing.assert_allclose(
+        paddle.geometric.segment_sum(data, seg).numpy(),
+        [[4, 6], [12, 14]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_mean(data, seg).numpy(),
+        [[2, 3], [6, 7]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_max(data, seg).numpy(),
+        [[3, 4], [7, 8]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_min(data, seg).numpy(),
+        [[1, 2], [5, 6]])
+
+
+def test_send_u_recv_grad():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 0, 2], np.int32))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    assert out.shape == [3, 3]
+    out.sum().backward()
+    # per-element grad is 1 per outgoing message (x3 columns per row):
+    # node 0 sources 2 messages, nodes 1,2 one each
+    np.testing.assert_allclose(x.grad.numpy().sum(axis=1), [6, 3, 3])
+
+
+def test_send_ue_recv():
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    e = paddle.to_tensor(np.full((2, 2), 0.5, np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 1], np.int32))
+    out = paddle.geometric.send_ue_recv(x, e, src, dst, "add", "sum",
+                                        out_size=3)
+    np.testing.assert_allclose(out.numpy()[1], [3.0, 3.0])
+
+
+def test_parameters_vector_roundtrip():
+    from paddle_tpu.nn.utils import (parameters_to_vector,
+                                     vector_to_parameters)
+    net = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+    vec = parameters_to_vector(net.parameters())
+    assert vec.size == sum(p.size for p in net.parameters())
+    new_vec = paddle.ones_like(vec)
+    vector_to_parameters(new_vec, net.parameters())
+    np.testing.assert_allclose(net[0].weight.numpy(),
+                               np.ones((3, 4)))
+
+
+def test_clip_grad_norm():
+    from paddle_tpu.nn.utils import clip_grad_norm_
+    p = paddle.core.Parameter(np.zeros(4, np.float32))
+    p.grad = paddle.to_tensor([3.0, 0, 0, 4.0])
+    total = clip_grad_norm_([p], max_norm=1.0)
+    assert float(total) == pytest.approx(5.0)
+    np.testing.assert_allclose(np.linalg.norm(p.grad.numpy()), 1.0,
+                               rtol=1e-4)
+
+
+def test_weight_norm():
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+    lin = nn.Linear(4, 3)
+    w_before = lin.weight.numpy().copy()
+    weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert "weight_g" in names and "weight_v" in names
+    x = paddle.randn([2, 4])
+    out = lin(x)
+    np.testing.assert_allclose(out.numpy(),
+                               x.numpy() @ w_before + lin.bias.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # grads flow to g and v
+    out.sum().backward()
+    assert names["weight_g"].grad is not None
+    assert names["weight_v"].grad is not None
+    remove_weight_norm(lin)
+    assert "weight" in dict(lin.named_parameters())
+
+
+def test_model_prepare_amp_configs():
+    net = nn.Linear(4, 4)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    model.prepare(opt, nn.MSELoss(), amp_configs={"level": "O2"})
+    assert net.weight.dtype == paddle.bfloat16
+
+
+def test_model_amp_o1_casts_matmuls():
+    from paddle_tpu.io import TensorDataset
+    seen = {}
+
+    class Probe(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            out = self.fc(x)
+            seen["dtype"] = out.dtype
+            return out.astype("float32")
+
+    net = Probe()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  amp_configs={"level": "O1"})
+    xs = np.random.rand(8, 4).astype(np.float32)
+    ys = np.random.randint(0, 2, (8, 1))
+    model.fit(TensorDataset([xs, ys]), epochs=1, batch_size=4, verbose=0)
+    assert seen["dtype"] == paddle.bfloat16  # matmul ran in bf16 (O1)
