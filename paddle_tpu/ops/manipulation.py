@@ -514,3 +514,78 @@ def one_hot(x, num_classes, name=None):
                  lambda a: jax.nn.one_hot(a, num_classes,
                                           dtype=jnp.float32), x,
                  differentiable=False)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    sorted_sequence, values = as_tensor(sorted_sequence), as_tensor(values)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else dtype_mod.convert_dtype("int64")
+
+    def _fn(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(dt)
+        # batched: apply along last dim
+        return jax.vmap(
+            lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]),
+                v.reshape(-1, v.shape[-1])).reshape(v.shape).astype(dt)
+    return dispatch.apply("searchsorted", _fn,
+                          (sorted_sequence, values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = as_tensor(input)
+    arr = input._data
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo = float(jnp.min(arr))
+        hi = float(jnp.max(arr))
+    hist, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(dtype_mod.convert_dtype("int64")))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    arr = x._data
+    if arr.size and int(jnp.min(arr)) < 0:
+        raise ValueError("bincount requires non-negative inputs "
+                         "(reference semantics)")
+    n = builtins.max(int(jnp.max(arr)) + 1 if arr.size else 0,
+                     int(minlength))
+    w = as_tensor(weights)._data if weights is not None else None
+    return Tensor(jnp.bincount(arr, weights=w, length=n))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    idt = dtype_mod.convert_dtype(dtype)
+    arr = np.asarray(x.numpy())
+    if axis is None:
+        arr = arr.reshape(-1)
+    else:
+        arr = np.moveaxis(arr, int(axis), 0)
+    keep = np.ones(len(arr), bool)
+    keep[1:] = arr[1:] != arr[:-1] if arr.ndim == 1 else \
+        (arr[1:] != arr[:-1]).any(axis=tuple(range(1, arr.ndim)))
+    uniq = arr[keep]
+    if axis is not None:
+        uniq = np.moveaxis(uniq, 0, int(axis))
+    out = [Tensor(uniq)]
+    if return_inverse:
+        out.append(Tensor((np.cumsum(keep) - 1).astype(idt)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(arr)))
+        out.append(Tensor(counts.astype(idt)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError(
+        "as_strided has no XLA equivalent; use reshape/slice/gather")

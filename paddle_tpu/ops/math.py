@@ -319,3 +319,87 @@ def quantile(x, q, axis=None, keepdim=False, name=None):
     ax = norm_axis(axis)
     return unary("quantile",
                  lambda a: jnp.quantile(a, q, axis=ax, keepdims=keepdim), x)
+
+
+def logaddexp(x, y, name=None):
+    return binary("logaddexp", jnp.logaddexp, x, y)
+
+
+def heaviside(x, y, name=None):
+    # differentiable: dx = 0 a.e., dy = 1 where x == 0 (reference grads)
+    return binary("heaviside", jnp.heaviside, x, y)
+
+
+def frac(x, name=None):
+    return unary("frac", lambda a: a - jnp.trunc(a), as_tensor(x))
+
+
+def deg2rad(x, name=None):
+    return unary("deg2rad", jnp.deg2rad, as_tensor(x))
+
+
+def rad2deg(x, name=None):
+    return unary("rad2deg", jnp.rad2deg, as_tensor(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    pre = as_tensor(prepend)._data if prepend is not None else None
+    app = as_tensor(append)._data if append is not None else None
+    return unary("diff",
+                 lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                    append=app), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        xs = as_tensor(x)
+        from ..core import dispatch as _dispatch
+        return _dispatch.apply(
+            "trapezoid",
+            lambda ya, xa: jnp.trapezoid(ya, xa, axis=axis), (y, xs))
+    return unary("trapezoid",
+                 lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.cumlogsumexp(a, axis=ax)
+    return unary("logcumsumexp", _fn, x)
+
+
+def _cum_extreme(name, scan_fn, x, axis, dtype):
+    """Shared cummax/cummin: ONE dispatch returning (values, indices)."""
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype)
+    from ..core import dispatch as _dispatch
+
+    def _fn(a):
+        ax = 0 if axis is None else axis
+        arr = a.reshape(-1) if axis is None else a
+        vals = scan_fn(arr, axis=ax)
+        changed = arr == vals
+        idx = jnp.arange(arr.shape[ax])
+        shape = [1] * arr.ndim
+        shape[ax] = -1
+        idx = jnp.broadcast_to(idx.reshape(shape), arr.shape)
+        indices = jax.lax.cummax(jnp.where(changed, idx, 0),
+                                 axis=ax).astype(dt)
+        return vals, indices
+    return _dispatch.apply(name, _fn, (x,))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme("cummax", jax.lax.cummax, x, axis, dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme("cummin", jax.lax.cummin, x, axis, dtype)

@@ -1,0 +1,77 @@
+"""Newer op batch + incubate optimizers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_search_and_hist_ops():
+    seq = paddle.to_tensor([1.0, 3.0, 5.0])
+    vals = paddle.to_tensor([2.0, 4.0])
+    np.testing.assert_array_equal(
+        paddle.searchsorted(seq, vals).numpy(), [1, 2])
+    np.testing.assert_array_equal(
+        paddle.bucketize(vals, seq).numpy(), [1, 2])
+    np.testing.assert_array_equal(
+        paddle.histogram(paddle.to_tensor([0.1, 0.2, 0.8]),
+                         bins=2).numpy(), [2, 1])
+    np.testing.assert_array_equal(
+        paddle.bincount(paddle.to_tensor([0, 1, 1, 3])).numpy(),
+        [1, 2, 0, 1])
+
+
+def test_cummax_cummin_diff():
+    x = paddle.to_tensor([1.0, 3.0, 2.0, 5.0])
+    v, i = paddle.cummax(x)
+    np.testing.assert_allclose(v.numpy(), [1, 3, 3, 5])
+    np.testing.assert_array_equal(i.numpy(), [0, 1, 1, 3])
+    v2, i2 = paddle.cummin(x)
+    np.testing.assert_allclose(v2.numpy(), [1, 1, 1, 1])
+    np.testing.assert_allclose(
+        paddle.diff(paddle.to_tensor([1.0, 3.0, 6.0])).numpy(), [2, 3])
+
+
+def test_misc_math_ops():
+    assert float(paddle.logaddexp(paddle.to_tensor(1.0),
+                                  paddle.to_tensor(1.0))) == \
+        pytest.approx(np.logaddexp(1, 1))
+    np.testing.assert_allclose(
+        paddle.frac(paddle.to_tensor([1.5, -1.5])).numpy(), [0.5, -0.5])
+    assert float(paddle.deg2rad(paddle.to_tensor(180.0))) == \
+        pytest.approx(np.pi)
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(paddle.to_tensor([0.0, 0.0])).numpy(),
+        [0.0, np.log(2)], rtol=1e-6)
+    assert float(paddle.trapezoid(paddle.to_tensor([1.0, 1.0]))) == 1.0
+    uc, inv, cnt = paddle.unique_consecutive(
+        paddle.to_tensor([1, 1, 2, 3, 3]), return_inverse=True,
+        return_counts=True)
+    np.testing.assert_array_equal(uc.numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1, 2])
+
+
+def test_lookahead_optimizer():
+    from paddle_tpu.incubate.optimizer import LookAhead
+    target = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+    w = paddle.core.Parameter(np.zeros(2, np.float32))
+    inner = paddle.optimizer.SGD(0.3, parameters=[w])
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    for _ in range(30):
+        loss = ((w - target) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(((w - target) ** 2).sum()) < 0.1
+
+
+def test_model_average():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+    w = paddle.core.Parameter(np.zeros(1, np.float32))
+    ma = ModelAverage(parameters=[w])
+    for v in (1.0, 2.0, 3.0):
+        w.set_value(np.array([v], np.float32))
+        ma.step()
+    with ma.apply():
+        np.testing.assert_allclose(w.numpy(), [2.0])  # averaged
+    np.testing.assert_allclose(w.numpy(), [3.0])  # restored
