@@ -9,3 +9,5 @@ from .embedding import SparseEmbedding  # noqa: F401
 from .runtime import get_ps_runtime, PSRuntime  # noqa: F401
 from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
 from .trainer import HogwildTrainer  # noqa: F401
+from .pass_cache import PassCache, PassCacheEmbedding  # noqa: F401
+from .graph import GraphTable  # noqa: F401

@@ -458,3 +458,134 @@ int pscore_dataset_next_batch(int h, int batch, const int* slot_ids,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------ graph store
+// Parity: the fork's graph engine (`paddle/fluid/framework/fleet/heter_ps/
+// graph_gpu_ps_table.h`, `gpu_graph_node.h`, `graph_sampler_inl.h`;
+// distributed `ps/table/common_graph_table.h`): adjacency storage keyed by
+// uint64 node ids + random-walk / neighbor sampling for GNN training
+// (PGLBox-style). Host C++ here feeds slot/segment tensors to TPU steps.
+namespace {
+
+struct GraphTable {
+  std::unordered_map<uint64_t, std::vector<uint64_t>> adj[kShards];
+  std::mutex locks[kShards];
+  std::vector<uint64_t> nodes;  // insertion order, for sampling starts
+  std::mutex nodes_lock;
+  std::mt19937_64 rng{20240731ull};
+
+  static int shard_of(uint64_t key) {
+    return SparseTable::shard_of(key);
+  }
+
+  void add_edges(const uint64_t* src, const uint64_t* dst, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+      int s = shard_of(src[i]);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto it = adj[s].find(src[i]);
+      if (it == adj[s].end()) {
+        adj[s][src[i]] = {dst[i]};
+        std::lock_guard<std::mutex> g2(nodes_lock);
+        nodes.push_back(src[i]);
+      } else {
+        it->second.push_back(dst[i]);
+      }
+    }
+  }
+
+  // sample up to k neighbors per query node; pads with the node itself
+  // when degree < k (out: [n, k]); degree written to out_deg
+  void sample_neighbors(const uint64_t* q, int64_t n, int k,
+                        uint64_t* out, int* out_deg) {
+    std::uniform_int_distribution<uint64_t> u;
+    for (int64_t i = 0; i < n; i++) {
+      int s = shard_of(q[i]);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto it = adj[s].find(q[i]);
+      if (it == adj[s].end() || it->second.empty()) {
+        out_deg[i] = 0;
+        for (int j = 0; j < k; j++) out[i * k + j] = q[i];
+        continue;
+      }
+      auto& nb = it->second;
+      out_deg[i] = (int)std::min<size_t>(nb.size(), (size_t)k);
+      for (int j = 0; j < k; j++) {
+        if ((size_t)j < nb.size() && nb.size() <= (size_t)k) {
+          out[i * k + j] = nb[j];          // low degree: take all
+        } else {
+          out[i * k + j] = nb[(size_t)(u(rng) % nb.size())];
+        }
+      }
+    }
+  }
+
+  // random walks: for each start node, walk `walk_len` steps
+  // (out: [n, walk_len+1]); dead ends repeat the last node
+  void random_walk(const uint64_t* starts, int64_t n, int walk_len,
+                   uint64_t* out) {
+    std::uniform_int_distribution<uint64_t> u;
+    for (int64_t i = 0; i < n; i++) {
+      uint64_t cur = starts[i];
+      out[i * (walk_len + 1)] = cur;
+      for (int t = 1; t <= walk_len; t++) {
+        int s = shard_of(cur);
+        std::lock_guard<std::mutex> g(locks[s]);
+        auto it = adj[s].find(cur);
+        if (it == adj[s].end() || it->second.empty()) {
+          out[i * (walk_len + 1) + t] = cur;
+          continue;
+        }
+        cur = it->second[(size_t)(u(rng) % it->second.size())];
+        out[i * (walk_len + 1) + t] = cur;
+      }
+    }
+  }
+
+  int64_t num_nodes() {
+    std::lock_guard<std::mutex> g(nodes_lock);
+    return (int64_t)nodes.size();
+  }
+
+  void sample_nodes(int64_t n, uint64_t* out) {
+    std::lock_guard<std::mutex> g(nodes_lock);
+    std::uniform_int_distribution<uint64_t> u;
+    for (int64_t i = 0; i < n; i++) {
+      out[i] = nodes.empty() ? 0 : nodes[(size_t)(u(rng) % nodes.size())];
+    }
+  }
+};
+
+std::vector<GraphTable*> g_graphs;
+
+}  // namespace
+
+extern "C" {
+
+int pscore_graph_create() {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  g_graphs.push_back(new GraphTable());
+  return (int)g_graphs.size() - 1;
+}
+
+void pscore_graph_add_edges(int h, const uint64_t* src,
+                            const uint64_t* dst, int64_t n) {
+  g_graphs[h]->add_edges(src, dst, n);
+}
+
+void pscore_graph_sample_neighbors(int h, const uint64_t* q, int64_t n,
+                                   int k, uint64_t* out, int* out_deg) {
+  g_graphs[h]->sample_neighbors(q, n, k, out, out_deg);
+}
+
+void pscore_graph_random_walk(int h, const uint64_t* starts, int64_t n,
+                              int walk_len, uint64_t* out) {
+  g_graphs[h]->random_walk(starts, n, walk_len, out);
+}
+
+int64_t pscore_graph_num_nodes(int h) { return g_graphs[h]->num_nodes(); }
+
+void pscore_graph_sample_nodes(int h, int64_t n, uint64_t* out) {
+  g_graphs[h]->sample_nodes(n, out);
+}
+
+}  // extern "C"

@@ -1,0 +1,72 @@
+"""Graph table + samplers over the native engine.
+
+Parity: the fork-focus graph engine (`graph_gpu_ps_table.h`,
+`gpu_graph_node.h`, `graph_sampler_inl.h`; `ps/table/common_graph_table.h`)
+— adjacency storage keyed by uint64 node ids with random-walk and
+neighbor sampling feeding GNN training (paddle_tpu.geometric ops consume
+the sampled edges on the TPU).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ._native import get_lib, u64_ptr, i32_ptr
+
+
+def _bind_graph(lib):
+    if getattr(lib, "_graph_bound", False):
+        return lib
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int)
+    lib.pscore_graph_create.restype = ctypes.c_int
+    lib.pscore_graph_add_edges.argtypes = [ctypes.c_int, u64p, u64p,
+                                           ctypes.c_int64]
+    lib.pscore_graph_sample_neighbors.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int64, ctypes.c_int, u64p, i32p]
+    lib.pscore_graph_random_walk.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int64, ctypes.c_int, u64p]
+    lib.pscore_graph_num_nodes.argtypes = [ctypes.c_int]
+    lib.pscore_graph_num_nodes.restype = ctypes.c_int64
+    lib.pscore_graph_sample_nodes.argtypes = [ctypes.c_int,
+                                              ctypes.c_int64, u64p]
+    lib._graph_bound = True
+    return lib
+
+
+class GraphTable:
+    def __init__(self):
+        self._lib = _bind_graph(get_lib())
+        self._h = self._lib.pscore_graph_create()
+
+    def add_edges(self, src, dst):
+        src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.uint64)
+        dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.uint64)
+        assert src.size == dst.size
+        self._lib.pscore_graph_add_edges(self._h, u64_ptr(src),
+                                         u64_ptr(dst), src.size)
+
+    def sample_neighbors(self, nodes, k):
+        q = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.uint64)
+        out = np.empty((q.size, k), np.uint64)
+        deg = np.empty(q.size, np.int32)
+        self._lib.pscore_graph_sample_neighbors(
+            self._h, u64_ptr(q), q.size, k, u64_ptr(out), i32_ptr(deg))
+        return out, deg
+
+    def random_walk(self, starts, walk_len):
+        s = np.ascontiguousarray(np.asarray(starts).reshape(-1),
+                                 np.uint64)
+        out = np.empty((s.size, walk_len + 1), np.uint64)
+        self._lib.pscore_graph_random_walk(self._h, u64_ptr(s), s.size,
+                                           walk_len, u64_ptr(out))
+        return out
+
+    def num_nodes(self):
+        return int(self._lib.pscore_graph_num_nodes(self._h))
+
+    def sample_nodes(self, n):
+        out = np.empty(n, np.uint64)
+        self._lib.pscore_graph_sample_nodes(self._h, n, u64_ptr(out))
+        return out
