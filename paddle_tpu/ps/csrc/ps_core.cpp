@@ -472,7 +472,14 @@ struct GraphTable {
   std::mutex locks[kShards];
   std::vector<uint64_t> nodes;  // insertion order, for sampling starts
   std::mutex nodes_lock;
-  std::mt19937_64 rng{20240731ull};
+  // one RNG per shard, each only touched under its shard lock (same
+  // pattern as SparseTable) + one for node sampling under nodes_lock
+  std::mt19937_64 rngs[kShards];
+  std::mt19937_64 nodes_rng{20240731ull};
+
+  GraphTable() {
+    for (int i = 0; i < kShards; i++) rngs[i].seed(977 + i);
+  }
 
   static int shard_of(uint64_t key) {
     return SparseTable::shard_of(key);
@@ -493,8 +500,9 @@ struct GraphTable {
     }
   }
 
-  // sample up to k neighbors per query node; pads with the node itself
-  // when degree < k (out: [n, k]); degree written to out_deg
+  // sample up to k neighbors per query node (out: [n, k]); slots past
+  // the true degree pad with the node itself, so callers may mask either
+  // via out_deg or by out[i][j] == q[i]
   void sample_neighbors(const uint64_t* q, int64_t n, int k,
                         uint64_t* out, int* out_deg) {
     std::uniform_int_distribution<uint64_t> u;
@@ -508,12 +516,15 @@ struct GraphTable {
         continue;
       }
       auto& nb = it->second;
-      out_deg[i] = (int)std::min<size_t>(nb.size(), (size_t)k);
+      int deg = (int)std::min<size_t>(nb.size(), (size_t)k);
+      out_deg[i] = deg;
       for (int j = 0; j < k; j++) {
-        if ((size_t)j < nb.size() && nb.size() <= (size_t)k) {
-          out[i * k + j] = nb[j];          // low degree: take all
+        if (j < deg) {
+          out[i * k + j] = nb.size() <= (size_t)k
+              ? nb[j]                                  // take all
+              : nb[(size_t)(u(rngs[s]) % nb.size())];  // subsample
         } else {
-          out[i * k + j] = nb[(size_t)(u(rng) % nb.size())];
+          out[i * k + j] = q[i];                       // self-pad
         }
       }
     }
@@ -535,7 +546,7 @@ struct GraphTable {
           out[i * (walk_len + 1) + t] = cur;
           continue;
         }
-        cur = it->second[(size_t)(u(rng) % it->second.size())];
+        cur = it->second[(size_t)(u(rngs[s]) % it->second.size())];
         out[i * (walk_len + 1) + t] = cur;
       }
     }
@@ -550,7 +561,8 @@ struct GraphTable {
     std::lock_guard<std::mutex> g(nodes_lock);
     std::uniform_int_distribution<uint64_t> u;
     for (int64_t i = 0; i < n; i++) {
-      out[i] = nodes.empty() ? 0 : nodes[(size_t)(u(rng) % nodes.size())];
+      out[i] = nodes.empty() ? 0
+          : nodes[(size_t)(u(nodes_rng) % nodes.size())];
     }
   }
 };

@@ -14,7 +14,9 @@ def test_graph_build_and_sample():
     nbrs, deg = g.sample_neighbors([1, 2, 99], k=4)
     assert nbrs.shape == (3, 4)
     assert deg[0] == 2 and deg[1] == 1 and deg[2] == 0
-    assert set(nbrs[0]) <= {2, 4}
+    assert set(nbrs[0][:2]) == {2, 4}   # true neighbors first
+    assert (nbrs[0][2:] == 1).all()     # self-pad past the degree
+    assert (nbrs[1][1:] == 2).all()
     assert (nbrs[2] == 99).all()  # unknown node pads with itself
 
 
