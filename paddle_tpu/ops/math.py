@@ -153,9 +153,7 @@ def sigmoid(x, name=None):
 
 def increment(x, value=1.0, name=None):
     out = unary("increment", lambda a: a + value, as_tensor(x))
-    x._data = out._data
-    x._grad_node, x._out_slot = out._grad_node, out._out_slot
-    return x
+    return _rebind(x, out)
 
 
 # ------------------------------------------------------------- reductions
@@ -403,3 +401,64 @@ def cummax(x, axis=None, dtype="int64", name=None):
 
 def cummin(x, axis=None, dtype="int64", name=None):
     return _cum_extreme("cummin", jax.lax.cummin, x, axis, dtype)
+
+
+# ---------------------------------------------------- inplace variants
+# Parity: paddle's `op_` inplace APIs. TPU-native: functional compute +
+# wrapper rebind (version-counter semantics: the wrapper adopts the new
+# value/grad node; aliasing views are not mutated).
+
+
+def _rebind(x, out):
+    x._data = out._data
+    if out._grad_node is not None:
+        x._grad_node, x._out_slot = out._grad_node, out._out_slot
+    else:
+        x._grad_node, x._out_slot = None, 0
+    # NOTE: x.stop_gradient is preserved (paddle semantics — an in-place
+    # op under no_grad, or zero_/fill_, must not freeze a trainable
+    # tensor)
+    return x
+
+
+def add_(x, y, name=None):
+    return _rebind(x, add(x, y))
+
+
+def subtract_(x, y, name=None):
+    return _rebind(x, subtract(x, y))
+
+
+def multiply_(x, y, name=None):
+    return _rebind(x, multiply(x, y))
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    _scale_fn = globals()["scale"]
+    return _rebind(x, _scale_fn(x, scale, bias, bias_after_scale))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return _rebind(x, clip(x, min, max))
+
+
+def exp_(x, name=None):
+    return _rebind(x, exp(x))  # noqa: F821
+
+
+def sqrt_(x, name=None):
+    return _rebind(x, sqrt(x))  # noqa: F821
+
+
+def tanh_(x, name=None):
+    return _rebind(x, tanh(x))  # noqa: F821
+
+
+def zero_(x, name=None):
+    from .creation import zeros_like
+    return _rebind(x, zeros_like(x))
+
+
+def fill_(x, value, name=None):
+    from .creation import full_like
+    return _rebind(x, full_like(x, value))

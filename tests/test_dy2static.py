@@ -1,0 +1,74 @@
+"""Dygraph <-> compiled parity (SURVEY §4: `unittests/dygraph_to_static`
+whole-model comparisons)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _compare(net, *inputs, rtol=1e-5):
+    net.eval()
+    eager = net(*inputs)
+    eager_np = (eager[0] if isinstance(eager, (tuple, list))
+                else eager).numpy()
+    paddle.jit.to_static(net)
+    static = net(*inputs)
+    static_np = (static[0] if isinstance(static, (tuple, list))
+                 else static).numpy()
+    np.testing.assert_allclose(eager_np, static_np, rtol=rtol, atol=1e-5)
+
+
+def test_lenet_dy2static():
+    from paddle_tpu.vision.models import LeNet
+    _compare(LeNet(), paddle.randn([2, 1, 28, 28]))
+
+
+def test_bert_tiny_dy2static():
+    from paddle_tpu.models import bert_tiny
+    tok = paddle.to_tensor(np.random.randint(1, 1024, (2, 16)))
+    _compare(bert_tiny(), tok, rtol=1e-4)
+
+
+def test_gpt_tiny_dy2static():
+    from paddle_tpu.models import gpt_tiny
+    tok = paddle.to_tensor(np.random.randint(0, 1024, (2, 16)))
+    _compare(gpt_tiny(), tok, rtol=1e-4)
+
+
+def test_control_flow_via_lax():
+    """Models using jit.cond/while_loop trace into the compiled path."""
+    class Looper(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            i, acc = paddle.jit.while_loop(
+                lambda i, acc: i < 3,
+                lambda i, acc: (i + 1, self.fc(acc)),
+                [paddle.to_tensor(0), x])
+            return acc
+
+    net = Looper()
+    x = paddle.randn([2, 4])
+    eager = net(x).numpy()
+    paddle.jit.to_static(net)
+    np.testing.assert_allclose(net(x).numpy(), eager, rtol=1e-5)
+
+
+def test_python_control_flow_traces_or_falls_back():
+    """Static python branches trace fine; data-dependent branches keep
+    working via the eager fallback in Model.fit (separate test)."""
+    class Branchy(nn.Layer):
+        def __init__(self, use_double=True):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.use_double = use_double
+
+        def forward(self, x):
+            if self.use_double:  # static python condition: traces fine
+                x = x * 2
+            return self.fc(x)
+
+    _compare(Branchy(), paddle.randn([2, 4]))

@@ -75,3 +75,55 @@ def test_model_average():
     with ma.apply():
         np.testing.assert_allclose(w.numpy(), [2.0])  # averaged
     np.testing.assert_allclose(w.numpy(), [3.0])  # restored
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.multiply_(paddle.to_tensor(2.0))
+    np.testing.assert_allclose(x.numpy(), [4, 6])
+    x.clip_(max=5.0)
+    np.testing.assert_allclose(x.numpy(), [4, 5])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0, 0])
+    x.fill_(7.0)
+    np.testing.assert_allclose(x.numpy(), [7, 7])
+    # inplace keeps autograd: rebind carries the grad node
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = a * 3
+    b.add_(paddle.to_tensor([1.0]))
+    b.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [3.0])
+
+
+def test_fleet_global_auc():
+    from paddle_tpu.parallel.metrics import GlobalAuc
+    table = GlobalAuc.make_table(63)
+    w1 = GlobalAuc(63, table)
+    w2 = GlobalAuc(63, table)
+    rng = np.random.RandomState(0)
+    # two workers, each sees half the (separable) data
+    for w, seed in ((w1, 1), (w2, 2)):
+        r = np.random.RandomState(seed)
+        labels = r.randint(0, 2, 200)
+        preds = labels * 0.6 + r.rand(200) * 0.4
+        w.update(preds, labels)
+    w1.commit()
+    w2.commit()
+    global_auc = GlobalAuc(63, table).accumulate()
+    assert 0.8 < global_auc <= 1.0
+    # merged table holds BOTH workers' samples (400 total)
+    assert int(table.pull().sum()) == 400
+
+
+def test_inplace_preserves_stop_gradient():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        p.add_(paddle.to_tensor([1.0]))
+    assert not p.stop_gradient  # still trainable
+    p.zero_()
+    assert not p.stop_gradient
+    # keyword parity: paddle code calls scale_(scale=...)
+    p.scale_(scale=2.0)
+    np.testing.assert_allclose(p.numpy(), [0.0])
