@@ -17,6 +17,7 @@ from .topology import (  # noqa: F401
 )
 from .strategy import DistributedStrategy  # noqa: F401
 from .data_parallel import DataParallel, shard_batch  # noqa: F401
+from .recompute import recompute  # noqa: F401
 from . import fleet  # noqa: F401
 
 

@@ -8,3 +8,6 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
 )
+from .alexnet import (  # noqa: F401
+    AlexNet, SqueezeNet, alexnet, squeezenet1_1,
+)

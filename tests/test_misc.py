@@ -146,3 +146,26 @@ def test_rng_state_tracker():
     with get_rng_state_tracker().rng_state("local_seed"):
         d = paddle.randn([4])
     assert not np.allclose(c.numpy(), d.numpy())  # local: differs by rank
+
+
+def test_recompute_matches_direct():
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 4))
+    x = paddle.randn([2, 4])
+    x.stop_gradient = False
+    direct = net(x)
+    direct.sum().backward()
+    g = x.grad.numpy().copy()
+    x.clear_grad()
+    out = paddle.distributed.recompute(net, x)
+    np.testing.assert_allclose(out.numpy(), direct.numpy(), atol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), g, atol=1e-6)
+
+
+def test_alexnet_squeezenet():
+    from paddle_tpu.vision.models import alexnet, squeezenet1_1
+    for factory in (alexnet, squeezenet1_1):
+        net = factory(num_classes=3)
+        net.eval()
+        assert net(paddle.randn([1, 3, 224, 224])).shape == [1, 3]
