@@ -47,26 +47,27 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
     dilations = _tuple(dilation, n)
     pad = _conv_padding(padding, n)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    if channel_last:
-        spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
-                3: ("NDHWC", "OIDHW", "NDHWC")}[n]
-    else:
-        spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
-                3: ("NCDHW", "OIDHW", "NCDHW")}[n]
-    dn = jax.lax.conv_dimension_numbers(
-        tuple(x.shape), tuple(weight.shape), spec)
+    # layout autotune (imperative/layout_autotune.cc capability): TPU convs
+    # run ~20x faster channels-last, so compute internally in N...C and
+    # transpose at the facade edges (XLA cancels transposes between
+    # stacked channel-first layers)
+    spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
+            3: ("NDHWC", "OIDHW", "NDHWC")}[n]
 
     def _fn(a, w, *b):
+        if not channel_last:
+            a = jnp.moveaxis(a, 1, -1)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, spec)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad,
             rhs_dilation=dilations, dimension_numbers=dn,
             feature_group_count=groups,
             preferred_element_type=None)
         if b:
-            bias_shape = [1] * out.ndim
-            ch_axis = out.ndim - 1 if channel_last else 1
-            bias_shape[ch_axis] = b[0].size
-            out = out + b[0].reshape(bias_shape).astype(out.dtype)
+            out = out + b[0].reshape((1,) * (out.ndim - 1)
+                                     + (-1,)).astype(out.dtype)
+        if not channel_last:
+            out = jnp.moveaxis(out, -1, 1)
         return out
     if bias is not None:
         bias = as_tensor(bias)

@@ -8,6 +8,7 @@ elementwise — no cuDNN equivalent needed.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
@@ -54,18 +55,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return dispatch.apply("batch_norm_infer", _fn, tuple(inputs))
 
     # training: compute batch stats; update running stats (stateful, on the
-    # Tensor wrappers — traced arrays flow through during functional mode)
+    # Tensor wrappers — traced arrays flow through during functional mode).
+    # PERF: on the TPU backend, mixed-dtype (bf16 data + f32 stats)
+    # backward is pathologically slow (~35x, measured); for bf16 inputs we
+    # therefore keep the whole computation in bf16 (standard TPU practice
+    # — the var uses E[x^2]-E[x]^2 whose grads lower cleanly, unlike
+    # jnp.var's). fp32 inputs keep fp32 stats.
     def _fn(*arrs):
         a = arrs[0]
-        af = a.astype(jnp.float32)
+        cd = a.dtype if a.dtype == jnp.bfloat16 else jnp.float32
+        af = a.astype(cd)
         mean = jnp.mean(af, axis=reduce_axes, keepdims=True)
-        var = jnp.var(af, axis=reduce_axes, keepdims=True)
-        out = (af - mean) / jnp.sqrt(var + epsilon)
+        # centered two-pass variance: no E[x^2]-E[x]^2 cancellation (which
+        # goes negative -> NaN in bf16), grads stay mean-shaped (fast)
+        centered = af - mean
+        var = jnp.mean(jnp.square(centered), axis=reduce_axes,
+                       keepdims=True)
+        out = centered * jax.lax.rsqrt(var + epsilon)
         if w_idx is not None:
-            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+            out = out * arrs[w_idx].astype(cd).reshape(bshape)
         if b_idx is not None:
-            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
-        return (out.astype(a.dtype), mean.reshape(-1), var.reshape(-1))
+            out = out + arrs[b_idx].astype(cd).reshape(bshape)
+        return (out.astype(a.dtype),
+                mean.reshape(-1).astype(jnp.float32),
+                var.reshape(-1).astype(jnp.float32))
 
     out, batch_mean, batch_var = dispatch.apply(
         "batch_norm_train", _fn, tuple(inputs))

@@ -32,19 +32,14 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
             pads = [tuple(v) for v in p]
 
     def _fn(a):
-        nd = a.ndim
-        if channel_last:
-            window = (1,) + k + (1,)
-            strides_full = (1,) + s + (1,)
-            pad_full = [(0, 0)] + (pads or [(0, 0)] * n) + [(0, 0)]
-        else:
-            window = (1, 1) + k
-            strides_full = (1, 1) + s
-            pad_full = [(0, 0), (0, 0)] + (pads or [(0, 0)] * n)
-        if pad_mode is not None:
-            pad_cfg = pad_mode
-        else:
-            pad_cfg = pad_full
+        # channels-last internally (layout autotune; see conv.py)
+        to_cl = not channel_last
+        if to_cl:
+            a = jnp.moveaxis(a, 1, -1)
+        window = (1,) + k + (1,)
+        strides_full = (1,) + s + (1,)
+        pad_full = [(0, 0)] + (pads or [(0, 0)] * n) + [(0, 0)]
+        pad_cfg = pad_mode if pad_mode is not None else pad_full
         out = jax.lax.reduce_window(
             a, init(a.dtype), reducer, window, strides_full,
             pad_cfg if isinstance(pad_cfg, str) else pad_cfg)
@@ -58,6 +53,8 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
                 out = out / counts
             else:
                 out = out / float(np.prod(k))
+        if to_cl:
+            out = jnp.moveaxis(out, -1, 1)
         return out
     return unary("pool", _fn, x)
 
