@@ -16,6 +16,12 @@ from ..core.tensor import Tensor
 from .. import ops
 
 
+class OptimizerState:
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
 class GradScaler:
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
@@ -30,6 +36,17 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer (state, found_inf) machine, mirroring reference
+        # python/paddle/amp/grad_scaler.py:199 — a user's explicit
+        # unscale_() (grad-clip pattern) must not be repeated inside
+        # step(), and step() twice per update() is an error. found_inf is
+        # kept per-optimizer too: a later unscale_() of a second optimizer
+        # (e.g. GAN D/G) must not mask the first one's inf.
+        self._opt_states = {}
+
+    def _state(self, optimizer):
+        return self._opt_states.get(
+            id(optimizer), (OptimizerState.INIT, False))[0]
 
     def scale(self, var):
         if not self._enable:
@@ -39,14 +56,24 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        state = self._state(optimizer)
+        if state == OptimizerState.UNSCALED:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update().")
+        if state == OptimizerState.STEPPED:
+            raise RuntimeError("unscale_() is being called after step().")
         params = optimizer._params_with_grad()
-        self._found_inf = False
+        found_inf = False
         inv = 1.0 / self._scale
         for p in params:
             g = p.grad._data.astype(jnp.float32) * inv
             if not bool(jnp.isfinite(g).all()):
-                self._found_inf = True
+                found_inf = True
             p.grad._data = g.astype(p.grad._data.dtype)
+        self._found_inf = self._found_inf or found_inf
+        self._opt_states[id(optimizer)] = (OptimizerState.UNSCALED,
+                                           found_inf)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -57,14 +84,25 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        state = self._state(optimizer)
+        if state == OptimizerState.STEPPED:
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if state == OptimizerState.INIT:
+            self.unscale_(optimizer)
+        found_inf = self._opt_states[id(optimizer)][1]
+        if not found_inf:
             optimizer.step()
+        self._opt_states[id(optimizer)] = (OptimizerState.STEPPED,
+                                           found_inf)
 
     def update(self):
+        self._opt_states.clear()
+        found_inf = self._found_inf
+        self._found_inf = False  # next backward cycle starts clean
         if not self._enable or not self._dynamic:
             return
-        if self._found_inf:
+        if found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every_n:

@@ -98,10 +98,15 @@ class GradNode:
         return f"<GradNode {self.name}>"
 
 
-def _accumulate_leaf(tensor, grad_array):
+def _accumulate_leaf(tensor, grad_array, leaf_targets=None):
     from .tensor import Tensor
 
     if tensor.stop_gradient:
+        return
+    if leaf_targets is not None and id(tensor) not in leaf_targets:
+        # Partial backward (paddle.grad): only the requested inputs
+        # accumulate — other parameters' .grad must stay untouched
+        # (reference eager/general_grad.h restricts the same way).
         return
     g = grad_array
     if tensor.grad is None:
@@ -134,8 +139,16 @@ def _reachable_and_deps(root_nodes):
     return nodes, deps
 
 
-def run_backward(tensors, grad_tensors=None, retain_graph=False):
-    """Queue-based topological walk — `egr::RunBackward` parity."""
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 leaf_targets=None, capture=None):
+    """Queue-based topological walk — `egr::RunBackward` parity.
+
+    leaf_targets: optional set of id(Tensor); when given, only those leaves
+    accumulate into .grad (paddle.grad partial backward).
+    capture: optional dict keyed (id(GradNode), slot); filled with the total
+    cotangent that arrived at that producer slot — used to read gradients of
+    non-leaf tensors without touching .grad.
+    """
     from .tensor import Tensor
 
     if not isinstance(tensors, (list, tuple)):
@@ -159,7 +172,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._grad_node
         if node is None:
-            _accumulate_leaf(t, g_arr)
+            _accumulate_leaf(t, g_arr, leaf_targets)
             continue
         slot = t._out_slot
         buf = buffers[id(node)]
@@ -179,6 +192,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             continue
         processed.add(id(node))
         buf = buffers.pop(id(node), {})
+        if capture is not None:
+            for slot, g in buf.items():
+                if (id(node), slot) in capture:
+                    capture[(id(node), slot)] = g
         cotangents = []
         for i in range(node.n_outputs):
             if i in buf:
@@ -204,7 +221,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                         ready.append(e.node)
                 continue
             if e.kind == "leaf":
-                _accumulate_leaf(e.tensor, g)
+                _accumulate_leaf(e.tensor, g, leaf_targets)
                 continue
             pnode = e.node
             buf2 = buffers[id(pnode)]
@@ -226,6 +243,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             continue
         processed.add(id(node))
         buf = buffers.pop(id(node))
+        if capture is not None:
+            for slot, g in buf.items():
+                if (id(node), slot) in capture:
+                    capture[(id(node), slot)] = g
         cotangents = []
         for i in range(node.n_outputs):
             cotangents.append(
@@ -242,7 +263,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             if _is_float0(g):
                 continue
             if e.kind == "leaf":
-                _accumulate_leaf(e.tensor, g)
+                _accumulate_leaf(e.tensor, g, leaf_targets)
             elif e.kind == "node":
                 buf2 = buffers[id(e.node)]
                 buf2[e.slot] = buf2[e.slot] + g if e.slot in buf2 else g
@@ -258,28 +279,47 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     Runs a backward pass and collects grads for `inputs` without writing
     their `.grad` attributes.
     """
-    from .tensor import Tensor
+    from .tensor import Tensor as _T
 
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "paddle.grad(create_graph=True) (double backward) is not "
+            "supported yet; use paddle.incubate.autograd jvp/vjp "
+            "transforms for higher-order derivatives")
     if retain_graph is None:
         retain_graph = create_graph
 
-    # Temporarily stash and clear input grads; run full backward; read out.
-    stash = [t._grad for t in inputs]
+    # Leaf inputs accumulate via .grad (stashed + restricted so no other
+    # parameter's .grad is touched); non-leaf inputs are read from the
+    # cotangent buffer of their producer slot.
+    leaf_inputs = [t for t in inputs if t._grad_node is None]
+    leaf_targets = {id(t) for t in leaf_inputs}
+    capture = {}
     for t in inputs:
+        if t._grad_node is not None:
+            capture[(id(t._grad_node), t._out_slot)] = None
+
+    stash = [t._grad for t in leaf_inputs]
+    for t in leaf_inputs:
         t._grad = None
-    # ensure inputs are treated as requiring grad
-    prev_sg = [t.stop_gradient for t in inputs]
-    for t in inputs:
+    prev_sg = [t.stop_gradient for t in leaf_inputs]
+    for t in leaf_inputs:
         t.stop_gradient = False
     try:
-        run_backward(outputs, grad_outputs, retain_graph=retain_graph)
+        run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                     leaf_targets=leaf_targets, capture=capture)
         results = []
         for t in inputs:
-            if t._grad is None:
+            if t._grad_node is not None:
+                g = capture.get((id(t._grad_node), t._out_slot))
+                got = None if g is None else _T(g, stop_gradient=True)
+            else:
+                got = t._grad
+            if got is None:
                 if not allow_unused:
                     raise RuntimeError(
                         "one of the input tensors received no gradient; "
@@ -287,9 +327,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     )
                 results.append(None)
             else:
-                results.append(t._grad)
+                results.append(got)
         return results
     finally:
-        for t, g, sg in zip(inputs, stash, prev_sg):
+        for t, g, sg in zip(leaf_inputs, stash, prev_sg):
             t._grad = g
             t.stop_gradient = sg

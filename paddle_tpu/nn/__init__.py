@@ -4,6 +4,8 @@ from .param_attr import ParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
 from .container import Sequential, LayerList, ParameterList  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
 
 from .layers.common import (  # noqa: F401
     Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D,

@@ -7,7 +7,6 @@ elementwise — no cuDNN equivalent needed.
 """
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -84,13 +83,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         "batch_norm_train", _fn, tuple(inputs))
     if running_mean is not None:
         rm, rv = as_tensor(running_mean), as_tensor(running_var)
-        n = int(np.prod([x.shape[i] for i in reduce_axes]))
-        unbiased = n / max(n - 1, 1)
+        # The reference kernel updates running_var with the *biased*
+        # batch variance (paddle/phi/kernels/cpu/batch_norm_kernel.cc:125,
+        # 152) — no n/(n-1) correction — so checkpoints eval identically.
         rm._data = (momentum * rm._data
                     + (1 - momentum) * batch_mean._data.astype(rm.dtype))
         rv._data = (momentum * rv._data
-                    + (1 - momentum)
-                    * (batch_var._data * unbiased).astype(rv.dtype))
+                    + (1 - momentum) * batch_var._data.astype(rv.dtype))
     return out
 
 

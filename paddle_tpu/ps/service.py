@@ -171,6 +171,12 @@ class PSClient:
         for host, port in self.endpoints:
             s = socket.create_connection((host, port), timeout=30)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Connect quickly, but allow long replies: BARRIER legitimately
+            # parks the socket until the last participant arrives (server
+            # waits up to 300s), far beyond the 30s connect timeout this
+            # socket would otherwise inherit. Keep a bound (> the server's
+            # 300s barrier wait) so a dead server still errors out.
+            s.settimeout(330.0)
             self._socks.append(s)
         self._lock = threading.Lock()
 

@@ -137,3 +137,33 @@ def test_int_tensor_no_grad():
     out = paddle.gather(x, i - 1)
     out.sum().backward()
     assert x.grad is not None
+
+
+def test_grad_does_not_pollute_other_leaves():
+    # ADVICE r1: paddle.grad(loss, x) must leave other parameters' .grad
+    # untouched so a later backward() isn't double-counted.
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    w = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    loss = (x * w).sum()
+    (gx,) = paddle.grad(loss, x, retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [3.0, 4.0])
+    assert w.grad is None
+    assert x.grad is None
+    loss2 = (x * w).sum()
+    loss2.backward()
+    np.testing.assert_allclose(w.grad.numpy(), [1.0, 2.0])
+
+
+def test_grad_nonleaf_input():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3.0
+    z = (y * y).sum()  # dz/dy = 2y = 12
+    (gy,) = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_grad_create_graph_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y, x, create_graph=True)

@@ -155,3 +155,62 @@ def test_amp_o2_decorate():
     lin = nn.Linear(4, 4)
     amp.decorate(lin, level="O2")
     assert lin.weight.dtype == paddle.bfloat16
+
+
+def _one_weight_layer(value):
+    import jax.numpy as jnp
+    lin = nn.Linear(1, 1, bias_attr=False)
+    lin.weight._data = jnp.asarray([[float(value)]], jnp.float32)
+    return lin
+
+
+def test_grad_scaler_explicit_unscale_once():
+    # ADVICE r1: step() after an explicit unscale_() (grad-clip pattern)
+    # must not divide gradients by the scale a second time.
+    from paddle_tpu import amp
+
+    lin = _one_weight_layer(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=64.0)
+    w = lin.weight
+    loss = scaler.scale((w * w).sum())
+    loss.backward()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(w.grad.numpy(), [[2.0]], rtol=1e-6)
+    scaler.step(opt)  # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [[-1.0]], rtol=1e-6)
+
+
+def test_grad_scaler_double_step_raises():
+    from paddle_tpu import amp
+
+    lin = _one_weight_layer(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=8.0)
+    w = lin.weight
+    loss = scaler.scale((w * w).sum())
+    loss.backward()
+    scaler.step(opt)
+    with pytest.raises(RuntimeError):
+        scaler.step(opt)
+    scaler.update()  # resets the state machine
+    loss = scaler.scale((w * w).sum())
+    loss.backward()
+    scaler.step(opt)
+
+
+def test_group_sharded_offload_raises():
+    from paddle_tpu.parallel.sharding import group_sharded_parallel
+
+    lin = _one_weight_layer(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    class _M:
+        pass
+
+    with pytest.raises(NotImplementedError):
+        group_sharded_parallel(_M(), opt, level="os_g", offload=True)
