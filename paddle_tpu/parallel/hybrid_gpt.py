@@ -413,11 +413,29 @@ def _loss_fn(params, tokens, labels, cfg: GPTConfig):
             e = jax.lax.dynamic_slice_in_dim(e, rank * S_loc, S_loc, axis=1)
         return e
 
+    def head_loss(y, lab_t):
+        """Final LN + vocab head + CE — the O(B·S·d·V) matmul."""
+        yl = _layer_norm(y, params["ln_f_w"], params["ln_f_b"])
+        if sp:
+            yl = jax.lax.all_gather(yl, "mp", axis=1, tiled=True)
+        return _vocab_parallel_ce(yl, params["head"], lab_t, cfg)
+
     def tick(carry, xs):
         x_recv, loss_sum, aux_sum, n_done = carry
         tok_t, lab_t, t = xs
-        emb = embed(tok_t)
-        x_in = jnp.where(is_first, emb, x_recv) if pp > 1 else emb
+        if pp > 1:
+            # lax.cond (not where): the embedding psum and especially the
+            # [B,S,d]x[d,V] head matmul must only RUN on the stage that
+            # needs them — at pp=4 and real vocab sizes the discarded head
+            # matmuls would be a large pure-waste cost per tick. The
+            # predicates are uniform across each mp group (same pp stage,
+            # same tick), so the mp collectives inside the branches are
+            # deadlock-free.
+            x_in = jax.lax.cond(
+                is_first, lambda: embed(tok_t).astype(x_recv.dtype),
+                lambda: x_recv)
+        else:
+            x_in = embed(tok_t)
         y, aux = _stage_forward(x_in, params["blocks"], cfg)
         # this stage holds a REAL microbatch only for ticks in
         # [stage, stage+M); bubble ticks process padding and must not
@@ -431,13 +449,15 @@ def _loss_fn(params, tokens, labels, cfg: GPTConfig):
                 y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
         else:
             x_next = y
-        # last stage: head + CE when a real micro has arrived
-        yl = _layer_norm(y, params["ln_f_w"], params["ln_f_b"])
-        if sp:
-            yl = jax.lax.all_gather(yl, "mp", axis=1, tiled=True)
-        loss_t = _vocab_parallel_ce(yl, params["head"], lab_t, cfg)
-        valid = jnp.logical_and(is_last, t >= pp - 1) if pp > 1 \
-            else (t >= 0)
+        # last stage only: head + CE when a real micro has arrived
+        if pp > 1:
+            valid = jnp.logical_and(is_last, t >= pp - 1)
+            loss_t = jax.lax.cond(
+                valid, lambda: head_loss(y, lab_t),
+                lambda: jnp.zeros((), jnp.float32))
+        else:
+            valid = t >= 0
+            loss_t = head_loss(y, lab_t)
         loss_sum = loss_sum + jnp.where(valid, loss_t, 0.0)
         aux_sum = aux_sum + aux
         n_done = n_done + jnp.where(valid, 1.0, 0.0)

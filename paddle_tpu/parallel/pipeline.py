@@ -10,10 +10,11 @@ TPU-native execution model: under single-controller SPMD the pipeline
 schedule must live INSIDE a compiled step (lax.scan + ppermute over the pp
 mesh axis — parallel/hybrid_gpt.py is the flagship implementation). This
 module provides (a) the PipelineLayer partitioning API so reference model
-code ports, and (b) a PipelineParallel wrapper whose `train_batch`
-reproduces the 1F1B *math* (microbatch gradient accumulation: identical
-gradients to 1F1B, which only reorders microbatch execution) while the
-device-level pipelining is delegated to the compiled path.
+code ports, and (b) a PipelineParallel wrapper whose `train_batch` runs
+the REAL compiled pipeline (pipeline_schedule.CompiledPipeline: GPipe or
+true-1F1B tick schedule over ppermute) when the model compiles, falling
+back to eager microbatch gradient accumulation (identical gradients —
+1F1B only reorders microbatch execution) otherwise.
 """
 from __future__ import annotations
 
@@ -103,7 +104,7 @@ class PipelineParallel(Layer):
     accumulation (1F1B-equivalent gradients), then one optimizer step.
     """
 
-    def __init__(self, layers, hcg=None, strategy=None):
+    def __init__(self, layers, hcg=None, strategy=None, schedule="1f1b"):
         super().__init__()
         self._layers = layers
         self.add_sublayer("_layers", layers)
@@ -112,14 +113,71 @@ class PipelineParallel(Layer):
                 else {"accumulate_steps": 1, "micro_batch_size": 1})
         self.accumulate_steps = pcfg.get("accumulate_steps", 1)
         self.micro_batch_size = pcfg.get("micro_batch_size", 1)
+        self._schedule = schedule
+        self._runner = None
+        self._runner_failed = False
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    def _compiled_runner(self):
+        """Build the compiled pipeline (ppermute tick schedule) lazily;
+        None if the model can't run it (no loss_fn / not a PipelineLayer /
+        too few devices / untraceable)."""
+        if self._runner is not None:
+            return self._runner
+        if self._runner_failed:
+            return None
+        try:
+            from .pipeline_schedule import CompiledPipeline
+            self._runner = CompiledPipeline(
+                self._layers, micro_batches=self.accumulate_steps,
+                schedule=self._schedule)
+            return self._runner
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                "compiled pipeline unavailable, falling back to eager "
+                f"microbatch accumulation: {e!r}")
+            self._runner_failed = True
+            return None
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         inputs, labels = data
         inputs = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
         labels = labels if isinstance(labels, Tensor) else Tensor(labels)
+        if isinstance(self._layers, PipelineLayer) \
+                and self._layers._num_stages > 1 \
+                and getattr(self._layers, "_loss_fn", None) is not None:
+            runner = self._compiled_runner()
+            if runner is not None:
+                # Guard ONLY the compiled forward/backward: a failure
+                # there (trace/compile/shape) falls back to eager with
+                # .grad still untouched. Optimizer/scaler errors below
+                # are real user-facing errors and must propagate.
+                try:
+                    loss_arr, grads = runner.loss_and_grads(inputs,
+                                                            labels)
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        "compiled pipeline step failed, falling back to "
+                        f"eager microbatch accumulation: {e!r}")
+                    self._runner = None
+                    self._runner_failed = True  # eager fallback below
+                else:
+                    scaling = (float(scaler._scale) if scaler is not None
+                               and scaler.is_enable() else 1.0)
+                    runner.apply_grads(grads, scaling)
+                    if scaler is not None:
+                        scaler.step(optimizer)
+                        scaler.update()
+                    else:
+                        optimizer.step()
+                    optimizer.clear_grad()
+                    if lr_scheduler is not None:
+                        lr_scheduler.step()
+                    return Tensor(loss_arr)
         m = self.accumulate_steps
         bsz = inputs.shape[0]
         assert bsz % m == 0, "batch must divide accumulate_steps"

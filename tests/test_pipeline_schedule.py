@@ -1,0 +1,105 @@
+"""Compiled pipeline schedules (GPipe + true 1F1B) for arbitrary
+PipelineLayer models — loss AND grad parity vs the single-device eager
+reference (the reference's test_pipeline_* strategy: same model, pipelined
+vs plain, assert loss match)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.parallel.pipeline import PipelineLayer, LayerDesc
+from paddle_tpu.parallel.pipeline_schedule import CompiledPipeline
+
+
+def _build_model(seed=7):
+    paddle.seed(seed)
+    return PipelineLayer(
+        layers=[
+            LayerDesc(nn.Linear, 4, 8),
+            LayerDesc(nn.Tanh),
+            LayerDesc(nn.Linear, 8, 8),
+            LayerDesc(nn.Tanh),
+            LayerDesc(nn.Linear, 8, 8),
+            LayerDesc(nn.GELU),
+            LayerDesc(nn.Linear, 8, 8),
+        ],
+        num_stages=1,
+        loss_fn=nn.MSELoss())
+
+
+def _eager_loss_and_grads(model, x, y):
+    for p in model.parameters():
+        p.clear_grad() if hasattr(p, "clear_grad") else None
+        p._grad = None
+    out = model(paddle.to_tensor(x))
+    loss = model._loss_fn(out, paddle.to_tensor(y))
+    loss.backward()
+    return float(loss), {id(p): p.grad.numpy() for p in model.parameters()}
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("pp,micro", [(2, 2), (4, 4), (2, 4)])
+def test_pipeline_parity_mlp(schedule, pp, micro):
+    model = _build_model()
+    # re-partition into pp stages
+    model._num_stages = pp
+    n = len(model.run_function)
+    per = int(np.ceil(n / pp))
+    model.segment_parts = [min(i * per, n) for i in range(pp + 1)]
+    model.segment_parts[-1] = n
+
+    rng = np.random.RandomState(0)
+    B = 8
+    x = rng.rand(B, 4).astype(np.float32)
+    y = rng.rand(B, 8).astype(np.float32)
+
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+
+    runner = CompiledPipeline(model, micro_batches=micro,
+                              schedule=schedule)
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+
+def test_pipeline_train_batch_converges():
+    model = _build_model(seed=3)
+    model._num_stages = 2
+    n = len(model.run_function)
+    per = int(np.ceil(n / 2))
+    model.segment_parts = [0, per, n]
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 8).astype(np.float32)
+    opt = paddle.optimizer.SGD(0.2, parameters=model.parameters())
+    runner = CompiledPipeline(model, micro_batches=2, schedule="1f1b")
+    losses = [float(runner.train_batch(x, y, opt)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_mixed_activation_shapes(schedule):
+    # stages whose boundary activations differ in width (16 vs 4) and an
+    # empty final stage (uniform segmentation artifact) — transfers ride
+    # a padded buffer
+    paddle.seed(11)
+    model = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 4, 16), LayerDesc(nn.Tanh),
+                LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Tanh),
+                LayerDesc(nn.Linear, 16, 4)],
+        num_stages=4, loss_fn=nn.MSELoss())
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 4).astype(np.float32)
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+    runner = CompiledPipeline(model, micro_batches=2, schedule=schedule)
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
