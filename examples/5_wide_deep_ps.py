@@ -70,5 +70,47 @@ def main(epochs=3, batch_size=512, dim=8):
     print("saved to", os.path.join(tmp, "ps_model"))
 
 
+def run_bench(batch_size=512, dim=8, n=20000):
+    """bench.py hook: examples/sec through pull -> train -> push after one
+    warmup epoch (eager path with native C++ tables)."""
+    import time
+
+    tmp = tempfile.mkdtemp()
+    data = make_slot_files(os.path.join(tmp, "part-0.txt"), n=n)
+    slots = [1, 2, 3, 4]
+    ds = InMemoryDataset()
+    ds.init(batch_size=batch_size, slots=slots, max_per_slot=1)
+    ds.set_filelist([data])
+    ds.load_into_memory()
+    rt = get_ps_runtime()
+    table = rt.create_sparse_table(0, dim=dim, sgd_rule="adagrad",
+                                   learning_rate=0.1)
+    emb = SparseEmbedding(dim=dim, table=table)
+    deep = nn.Sequential(nn.Linear(len(slots) * dim, 64), nn.ReLU(),
+                         nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 1))
+    wide = nn.Linear(len(slots) * dim, 1)
+    opt = paddle.optimizer.Adam(
+        1e-3, parameters=deep.parameters() + wide.parameters())
+
+    def epoch():
+        seen = 0
+        for keys, labels in ds:
+            bsz = keys.shape[0]
+            acts = emb(keys).reshape([bsz, len(slots) * dim])
+            logits = (deep(acts) + wide(acts)).reshape([bsz])
+            loss = nn.functional.binary_cross_entropy_with_logits(
+                logits, paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            seen += bsz
+        return seen
+
+    epoch()  # warmup/compile
+    t0 = time.perf_counter()
+    seen = epoch()
+    return seen / (time.perf_counter() - t0)
+
+
 if __name__ == "__main__":
     main()

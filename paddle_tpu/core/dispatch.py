@@ -13,12 +13,21 @@ registry. Autograd recording (the `eager_gen.py` grad-node wiring) happens in
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import autograd
 from .autograd import Edge, GradNode
+
+
+def _nan_inf_callback(x, op_name):
+    if not np.isfinite(np.asarray(x)).all():
+        raise FloatingPointError(
+            f"NaN/Inf detected in output of op '{op_name}' "
+            f"(shape {getattr(x, 'shape', ())}) inside a compiled step")
 
 
 def _edge_for(t):
@@ -70,14 +79,21 @@ def apply(name, fn, inputs, differentiable=True):
             )
 
     # FLAGS_check_nan_inf parity (`framework/details/nan_inf_utils_detail`):
-    # scan every float output when the debug flag is on (forces a sync).
-    # Eager-only: traced values can't be concretised — compiled paths skip
-    # the scan, matching the reference where the scan wraps kernel launches.
+    # scan every float output when the debug flag is on. Eager values are
+    # checked synchronously; traced values (ops being compiled into a jit
+    # step, e.g. the whole-step trainer) get a `jax.debug.callback` baked
+    # into the executable so the scan runs at execution time with the op
+    # name attributed — the reference wraps every kernel launch the same
+    # way.
     from ..flags import check_nan_inf_enabled
     if check_nan_inf_enabled():
         for o in outs_t:
-            if _is_float(o.dtype) and not isinstance(o, jax.core.Tracer) \
-                    and not bool(jnp.isfinite(o).all()):
+            if not _is_float(o.dtype):
+                continue
+            if isinstance(o, jax.core.Tracer):
+                jax.debug.callback(
+                    functools.partial(_nan_inf_callback, op_name=name), o)
+            elif not bool(jnp.isfinite(o).all()):
                 raise FloatingPointError(
                     f"NaN/Inf detected in output of op '{name}' "
                     f"(shape {o.shape}, dtype {o.dtype})")

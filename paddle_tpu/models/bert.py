@@ -69,6 +69,7 @@ class BertModel(nn.Layer):
         self.pooler = BertPooler(hidden_size)
         self.hidden_size = hidden_size
         self.vocab_size = vocab_size
+        self.num_layers = num_hidden_layers
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):

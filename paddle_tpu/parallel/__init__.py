@@ -18,6 +18,9 @@ from .topology import (  # noqa: F401
 from .strategy import DistributedStrategy  # noqa: F401
 from .data_parallel import DataParallel, shard_batch  # noqa: F401
 from .recompute import recompute  # noqa: F401
+from .auto_tuner import (  # noqa: F401
+    ClusterSpec, CostModel, ModelSpec, Strategy, StrategyTuner,
+)
 from . import fleet  # noqa: F401
 
 

@@ -166,18 +166,11 @@ class PipelineParallel(Layer):
                     self._runner = None
                     self._runner_failed = True  # eager fallback below
                 else:
-                    scaling = (float(scaler._scale) if scaler is not None
-                               and scaler.is_enable() else 1.0)
-                    runner.apply_grads(grads, scaling)
-                    if scaler is not None:
-                        scaler.step(optimizer)
-                        scaler.update()
-                    else:
-                        optimizer.step()
-                    optimizer.clear_grad()
+                    loss = runner.finish_batch(loss_arr, grads, optimizer,
+                                               scaler)
                     if lr_scheduler is not None:
                         lr_scheduler.step()
-                    return Tensor(loss_arr)
+                    return loss
         m = self.accumulate_steps
         bsz = inputs.shape[0]
         assert bsz % m == 0, "batch must divide accumulate_steps"

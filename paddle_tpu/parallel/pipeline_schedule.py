@@ -70,11 +70,12 @@ def _stage_buffer_tensors(stage_layers):
     return out
 
 
-def _make_stage_fn(stage_layers, param_tensors, buffer_tensors,
-                   buffer_arrays):
-    """Pure fn (param_arrays, x_array, key) -> y_array."""
+def _make_stage_fn(stage_layers, param_tensors, buffer_tensors):
+    """Pure fn (param_arrays, buffer_arrays, x_array, key) -> y_array.
+    Buffers are call-time inputs (read-only) so state_dict loads after
+    construction are seen by the compiled executable."""
 
-    def fn(param_arrays, x, key):
+    def fn(param_arrays, buffer_arrays, x, key):
         with bind_arrays(param_tensors, list(param_arrays)), \
                 bind_arrays(buffer_tensors, list(buffer_arrays)), \
                 rng_mod.functional_rng(key), autograd.no_grad():
@@ -119,14 +120,27 @@ class CompiledPipeline:
         self._loss_arr = _make_loss_fn(loss_layer)
 
         self.stage_params = []     # list[list[Tensor]] per stage
+        self.stage_buffers = []    # list[list[Tensor]] per stage
         self._stage_fns = []
         for s in range(self.pp):
             sl = pipeline_layer.get_stage_layers(s)
             pts = _stage_param_tensors(sl)
             bts = _stage_buffer_tensors(sl)
-            barr = [b._data for b in bts]
+            if bts and any(getattr(l, "training", False) for l in sl
+                           if isinstance(l, Layer)):
+                # Buffer MUTATION (e.g. BN running stats) inside a stage
+                # would be traced and discarded — refuse instead of
+                # silently freezing stats; PipelineParallel falls back to
+                # eager accumulation. eval()-mode stages (read-only
+                # buffers) are fine.
+                raise ValueError(
+                    "pipelined stages with buffers (e.g. BatchNorm "
+                    "running stats) are only supported in eval() mode; "
+                    "train-mode buffer updates would be lost in the "
+                    "compiled schedule")
             self.stage_params.append(pts)
-            self._stage_fns.append(_make_stage_fn(sl, pts, bts, barr))
+            self.stage_buffers.append(bts)
+            self._stage_fns.append(_make_stage_fn(sl, pts, bts))
 
         devices = devices if devices is not None else jax.devices()
         if len(devices) < self.pp:
@@ -149,7 +163,10 @@ class CompiledPipeline:
         for s in range(self.pp):
             parr = [jax.ShapeDtypeStruct(p.shape, p._data.dtype)
                     for p in self.stage_params[s]]
-            out = jax.eval_shape(self._stage_fns[s], parr, aval, key)
+            barr = [jax.ShapeDtypeStruct(b.shape, b._data.dtype)
+                    for b in self.stage_buffers[s]]
+            out = jax.eval_shape(self._stage_fns[s], parr, barr, aval,
+                                 key)
             outs.append(out)
             aval = out
         ranks = {len(o.shape) for o in outs}
@@ -174,10 +191,6 @@ class CompiledPipeline:
         in_shapes = [xm_shape] + [o.shape for o in stage_outs[:-1]]
         stage_fns = self._stage_fns
         loss_arr = self._loss_arr
-        base_key = jax.random.PRNGKey(0)
-
-        def key_for(s, m):
-            return jax.random.fold_in(base_key, s * 4096 + m)
 
         def zeros_act():
             return jnp.zeros(act_shape, act_dtype)
@@ -190,12 +203,15 @@ class CompiledPipeline:
             return a[tuple(slice(0, s) for s in shape)]
 
         # ---------------------------------------------------- gpipe body
-        def gpipe_loss(all_params, data, labels):
+        def gpipe_loss(all_params, all_bufs, data, labels, base_key):
             """Per-device fn inside shard_map. data [M,Bm,...] replicated;
             forward-only GPipe scan, AD makes the reverse pipeline."""
             stage = jax.lax.axis_index("pp")
             is_last = stage == pp - 1
             T = M + pp - 1
+
+            def key_for(s, m):
+                return jax.random.fold_in(base_key, s * 4096 + m)
 
             def tick(carry, t):
                 x_recv, loss_sum = carry
@@ -210,8 +226,8 @@ class CompiledPipeline:
                                 data, m, keepdims=False)
                         else:
                             x = slice_act(x_recv, in_shapes[s])
-                        return pad_act(stage_fns[s](all_params[s], x,
-                                                    key_for(s, m)))
+                        return pad_act(stage_fns[s](
+                            all_params[s], all_bufs[s], x, key_for(s, m)))
                     return br
 
                 y = jax.lax.switch(stage, [mk_fwd(s) for s in range(pp)])
@@ -239,11 +255,15 @@ class CompiledPipeline:
             return loss
 
         # ----------------------------------------------------- 1f1b body
-        def f1b_loss_and_grads(all_params, data, labels):
+        def f1b_loss_and_grads(all_params, all_bufs, data, labels,
+                               base_key):
             """Per-device fn inside shard_map. Returns (loss, grads) with
             grads replicated (psum over pp at the end)."""
             stage = jax.lax.axis_index("pp")
             T = 2 * (M + pp - 1)
+
+            def key_for(s, m):
+                return jax.random.fold_in(base_key, s * 4096 + m)
             stash0 = jnp.zeros((pp,) + act_shape, act_dtype)
             grads0 = jax.tree.map(jnp.zeros_like, all_params)
 
@@ -264,7 +284,8 @@ class CompiledPipeline:
                                 # from the activation shape)
                                 x = jax.lax.dynamic_index_in_dim(
                                     data, m_f, keepdims=False)
-                                y = stage_fns[0](all_params[0], x,
+                                y = stage_fns[0](all_params[0],
+                                                 all_bufs[0], x,
                                                  key_for(0, m_f))
                                 return pad_act(y), stash
                             new_stash = jax.lax.dynamic_update_index_in_dim(
@@ -274,8 +295,8 @@ class CompiledPipeline:
                                 # slot next tick; nothing to send
                                 return zeros_act(), new_stash
                             x = slice_act(act_recv, in_shapes[s])
-                            y = stage_fns[s](all_params[s], x,
-                                             key_for(s, m_f))
+                            y = stage_fns[s](all_params[s], all_bufs[s],
+                                             x, key_for(s, m_f))
                             return pad_act(y), new_stash
                         return br
                     return jax.lax.switch(stage,
@@ -306,8 +327,8 @@ class CompiledPipeline:
                                     labels, m_b, keepdims=False)
 
                                 def f(ps, xx):
-                                    yy = stage_fns[s](ps, xx,
-                                                      key_for(s, m_b))
+                                    yy = stage_fns[s](ps, all_bufs[s],
+                                                      xx, key_for(s, m_b))
                                     return loss_arr(yy, lab)
 
                                 lval, vjp = jax.vjp(f, all_params[s], x)
@@ -316,7 +337,8 @@ class CompiledPipeline:
                             else:
                                 _, vjp = jax.vjp(
                                     lambda ps, xx: stage_fns[s](
-                                        ps, xx, key_for(s, m_b)),
+                                        ps, all_bufs[s], xx,
+                                        key_for(s, m_b)),
                                     all_params[s], x)
                                 cot = slice_act(cot_recv,
                                                 stage_outs[s].shape)
@@ -362,19 +384,21 @@ class CompiledPipeline:
         if self.schedule == "gpipe" or pp == 1:
             loss_sm = jax.shard_map(
                 gpipe_loss, mesh=self.mesh,
-                in_specs=(rep, rep, rep), out_specs=rep, check_vma=False)
+                in_specs=(rep, rep, rep, rep, rep), out_specs=rep,
+                check_vma=False)
 
-            def step(all_params, data, labels):
-                return jax.value_and_grad(loss_sm)(all_params, data,
-                                                   labels)
+            def step(all_params, all_bufs, data, labels, base_key):
+                return jax.value_and_grad(loss_sm)(
+                    all_params, all_bufs, data, labels, base_key)
         else:
             f1b_sm = jax.shard_map(
                 f1b_loss_and_grads, mesh=self.mesh,
-                in_specs=(rep, rep, rep), out_specs=(rep, rep),
-                check_vma=False)
+                in_specs=(rep, rep, rep, rep, rep),
+                out_specs=(rep, rep), check_vma=False)
 
-            def step(all_params, data, labels):
-                return f1b_sm(all_params, data, labels)
+            def step(all_params, all_bufs, data, labels, base_key):
+                return f1b_sm(all_params, all_bufs, data, labels,
+                              base_key)
 
         return jax.jit(step)
 
@@ -397,7 +421,13 @@ class CompiledPipeline:
                 x.shape, x.dtype, labels.shape, labels.dtype)
         all_params = tuple(
             [p._data for p in pts] for pts in self.stage_params)
-        loss, grads = self._compiled[sig](all_params, data, labs)
+        all_bufs = tuple(
+            [b._data for b in bts] for bts in self.stage_buffers)
+        # advance the global RNG per step so dropout masks differ across
+        # steps and honour paddle.seed (eager-path parity)
+        base_key = rng_mod.next_key()
+        loss, grads = self._compiled[sig](all_params, all_bufs, data,
+                                          labs, base_key)
         return loss, grads
 
     def apply_grads(self, grads, scale=1.0):
@@ -413,10 +443,9 @@ class CompiledPipeline:
                 else:
                     p._grad._data = p._grad._data + g
 
-    def train_batch(self, x, labels, optimizer, scaler=None):
-        """Full pipelined step: compiled loss+grads, then eager optimizer
-        step over the stage parameters (.grad assigned)."""
-        loss, grads = self.loss_and_grads(x, labels)
+    def finish_batch(self, loss, grads, optimizer, scaler=None):
+        """Epilogue shared by every pipelined caller: assign grads (scaled
+        so a GradScaler's unscale_ round-trips) and step."""
         scaling = (float(scaler._scale)
                    if scaler is not None and scaler.is_enable() else 1.0)
         self.apply_grads(grads, scaling)
@@ -427,3 +456,9 @@ class CompiledPipeline:
             optimizer.step()
         optimizer.clear_grad()
         return Tensor(loss)
+
+    def train_batch(self, x, labels, optimizer, scaler=None):
+        """Full pipelined step: compiled loss+grads, then eager optimizer
+        step over the stage parameters (.grad assigned)."""
+        loss, grads = self.loss_and_grads(x, labels)
+        return self.finish_batch(loss, grads, optimizer, scaler)

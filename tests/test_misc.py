@@ -169,3 +169,42 @@ def test_alexnet_squeezenet():
         net = factory(num_classes=3)
         net.eval()
         assert net(paddle.randn([1, 3, 224, 224])).shape == [1, 3]
+
+
+def test_check_nan_inf_compiled_path():
+    """FLAGS_check_nan_inf must also cover compiled (jit) steps: a NaN
+    produced mid-step surfaces with the producing op's name
+    (nan_inf_utils_detail parity for the XLA executor)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        class BadLoss(nn.Layer):
+            def forward(self, pred, label):
+                return paddle.sqrt(pred.sum() - 1e9).mean()
+
+        model = paddle.Model(nn.Sequential(nn.Linear(4, 4)))
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        model.prepare(opt, BadLoss())
+        with pytest.raises(Exception) as ei:
+            model.train_batch([np.ones((4, 4), np.float32)],
+                              [np.zeros((4, 1), np.float32)])
+            jax.effects_barrier()
+        assert "sqrt" in str(ei.value)
+        assert model._jit_ok, "must have run the compiled path"
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_eager_path():
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="sqrt"):
+            paddle.sqrt(paddle.to_tensor([-1.0]))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
