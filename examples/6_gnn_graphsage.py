@@ -1,0 +1,99 @@
+"""GraphSAGE node classification over the native graph engine.
+
+The PGLBox-style loop (`paddle/fluid/framework/fleet/heter_ps/
+graph_gpu_ps_table.h` + `graph_sampler_inl.h` reference capability):
+the C++ graph store holds adjacency (with edge weights), node features,
+and does neighbor sampling on host; the TPU step consumes dense
+[batch, k, feat] neighborhood tensors — sampling stays off-device,
+compute stays compiled.
+
+Synthetic task: two communities with distinct feature distributions and
+mostly intra-community (heavily weighted) edges; a 2-layer mean-aggregate
+GraphSAGE should separate them almost perfectly.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.ps.graph import GraphTable
+
+
+def build_graph(n_per=200, feat_dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    g = GraphTable()
+    n = 2 * n_per
+    labels = np.array([0] * n_per + [1] * n_per, np.int64)
+    feats = rng.randn(n, feat_dim).astype(np.float32) * 0.5
+    feats[:n_per, 0] += 1.0
+    feats[n_per:, 0] -= 1.0
+    nodes = np.arange(1, n + 1, dtype=np.uint64)  # ids are 1-based
+    g.set_node_feat(nodes, feats)
+    src, dst, w = [], [], []
+    for i in range(n):
+        for _ in range(6):
+            same = rng.rand() < 0.9
+            j = rng.randint(0, n_per) + (0 if (i < n_per) == same
+                                         else n_per)
+            src.append(nodes[i])
+            dst.append(nodes[j])
+            w.append(5.0 if same else 1.0)  # intra edges sampled 5x more
+    g.add_edges_weighted(np.array(src, np.uint64),
+                         np.array(dst, np.uint64),
+                         np.array(w, np.float32))
+    return g, nodes, labels, feat_dim
+
+
+class GraphSage(nn.Layer):
+    def __init__(self, feat_dim, hidden, n_classes=2):
+        super().__init__()
+        self.l1_self = nn.Linear(feat_dim, hidden)
+        self.l1_neigh = nn.Linear(feat_dim, hidden)
+        self.l2 = nn.Linear(hidden, n_classes)
+
+    def forward(self, x_self, x_neigh):
+        # x_self [B, F]; x_neigh [B, K, F] -> mean aggregate
+        h = self.l1_self(x_self) + self.l1_neigh(x_neigh.mean(axis=1))
+        return self.l2(nn.functional.relu(h))
+
+
+def main(epochs=30, batch=128, k=5):
+    g, nodes, labels, feat_dim = build_graph()
+    net = GraphSage(feat_dim, hidden=32)
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    id2idx = {int(v): i for i, v in enumerate(nodes)}
+
+    rng = np.random.RandomState(1)
+    for epoch in range(epochs):
+        perm = rng.permutation(nodes.size)
+        losses = []
+        for lo in range(0, nodes.size, batch):
+            bidx = perm[lo:lo + batch]
+            bn = nodes[bidx]
+            neigh, _deg = g.sample_neighbors(bn, k)  # host C++ sampling
+            x_self = g.get_node_feat(bn, feat_dim)
+            x_neigh = g.get_node_feat(neigh.reshape(-1), feat_dim) \
+                .reshape(bn.size, k, feat_dim)
+            y = labels[bidx].reshape(-1, 1)
+            logits = net(paddle.to_tensor(x_self),
+                         paddle.to_tensor(x_neigh))
+            loss = loss_fn(logits, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        if epoch % 10 == 0 or epoch == epochs - 1:
+            neigh, _ = g.sample_neighbors(nodes, k)
+            pred = net(paddle.to_tensor(g.get_node_feat(nodes, feat_dim)),
+                       paddle.to_tensor(g.get_node_feat(
+                           neigh.reshape(-1), feat_dim).reshape(
+                           nodes.size, k, feat_dim)))
+            acc = (pred.numpy().argmax(-1) == labels).mean()
+            print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
+                  f"acc {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    final = main()
+    assert final > 0.9, f"GraphSAGE failed to separate communities: {final}"

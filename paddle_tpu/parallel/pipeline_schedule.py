@@ -121,26 +121,17 @@ class CompiledPipeline:
 
         self.stage_params = []     # list[list[Tensor]] per stage
         self.stage_buffers = []    # list[list[Tensor]] per stage
+        self._stage_layers = []
         self._stage_fns = []
         for s in range(self.pp):
             sl = pipeline_layer.get_stage_layers(s)
             pts = _stage_param_tensors(sl)
             bts = _stage_buffer_tensors(sl)
-            if bts and any(getattr(l, "training", False) for l in sl
-                           if isinstance(l, Layer)):
-                # Buffer MUTATION (e.g. BN running stats) inside a stage
-                # would be traced and discarded — refuse instead of
-                # silently freezing stats; PipelineParallel falls back to
-                # eager accumulation. eval()-mode stages (read-only
-                # buffers) are fine.
-                raise ValueError(
-                    "pipelined stages with buffers (e.g. BatchNorm "
-                    "running stats) are only supported in eval() mode; "
-                    "train-mode buffer updates would be lost in the "
-                    "compiled schedule")
             self.stage_params.append(pts)
             self.stage_buffers.append(bts)
+            self._stage_layers.append(sl)
             self._stage_fns.append(_make_stage_fn(sl, pts, bts))
+        self._check_buffer_mutation()
 
         devices = devices if devices is not None else jax.devices()
         if len(devices) < self.pp:
@@ -149,6 +140,22 @@ class CompiledPipeline:
                 "devices")
         self.mesh = Mesh(np.array(devices[: self.pp]), ("pp",))
         self._compiled = {}
+
+    def _check_buffer_mutation(self):
+        """Buffer MUTATION (e.g. BN running stats) inside a stage would be
+        traced and discarded — refuse instead of silently freezing stats;
+        PipelineParallel falls back to eager accumulation. eval()-mode
+        stages (read-only buffers) are fine. Re-checked every
+        loss_and_grads call: the model may be toggled train()/eval()
+        after construction."""
+        for sl, bts in zip(self._stage_layers, self.stage_buffers):
+            if bts and any(getattr(l, "training", False) for l in sl
+                           if isinstance(l, Layer)):
+                raise ValueError(
+                    "pipelined stages with buffers (e.g. BatchNorm "
+                    "running stats) are only supported in eval() mode; "
+                    "train-mode buffer updates would be lost in the "
+                    "compiled schedule")
 
     # ------------------------------------------------------------ build
 
@@ -406,6 +413,7 @@ class CompiledPipeline:
 
     def loss_and_grads(self, x, labels):
         """Returns (loss: float, grads: per-stage lists of arrays)."""
+        self._check_buffer_mutation()
         x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         labels = labels._data if isinstance(labels, Tensor) \
             else jnp.asarray(labels)

@@ -54,6 +54,14 @@ def get_lib():
                                        ctypes.c_int, f32p, f32p]
     lib.pscore_sparse_size.argtypes = [ctypes.c_int]
     lib.pscore_sparse_size.restype = ctypes.c_int64
+    lib.pscore_sparse_enable_spill.argtypes = [ctypes.c_int,
+                                               ctypes.c_char_p,
+                                               ctypes.c_int64]
+    lib.pscore_sparse_enable_spill.restype = ctypes.c_int
+    lib.pscore_sparse_mem_size.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_mem_size.restype = ctypes.c_int64
+    lib.pscore_sparse_spill_size.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_spill_size.restype = ctypes.c_int64
     lib.pscore_sparse_shrink.argtypes = [ctypes.c_int, ctypes.c_float,
                                          ctypes.c_int]
     lib.pscore_sparse_shrink.restype = ctypes.c_int64
@@ -68,6 +76,7 @@ def get_lib():
     lib.pscore_dense_set.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
     lib.pscore_dense_pull.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
     lib.pscore_dense_push.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
+    lib.pscore_dense_add.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
 
     lib.pscore_dataset_create.restype = ctypes.c_int
     lib.pscore_dataset_load_file.argtypes = [ctypes.c_int, ctypes.c_char_p]

@@ -116,26 +116,67 @@ class AsyncCommunicator:
 
 
 class GeoCommunicator(AsyncCommunicator):
-    """Geo-SGD dense mode sketch (communicator.h geo): dense deltas pushed
-    every k steps. Round-1: dense tables push synchronously; the geo delta
-    logic applies when dense params train locally."""
+    """Geo-async dense mode (`communicator.h:235` GeoCommunicator): each
+    trainer optimizes a LOCAL copy of the dense params; every k_steps it
+    sends only the delta vs its last synced snapshot, the server MERGES
+    deltas additively (so concurrent trainers' progress composes instead
+    of overwriting), and the trainer rebases onto the merged params.
+
+    `table` is anything exposing add(delta) -> None + pull() -> params —
+    a local MemoryDenseTable — or a (PSClient, table_id) pair for the
+    remote path, which merges and pulls in one DENSE_ADD round trip.
+    """
 
     def __init__(self, k_steps=100, **kw):
         super().__init__(**kw)
         self.k_steps = k_steps
-        self._dense_shadow = {}
+        self._base = {}   # tid -> snapshot at last sync
         self._steps = {}  # per-table step counters
 
-    def maybe_push_dense(self, table, params: np.ndarray):
-        """Push the delta vs the last synced snapshot every k steps (per
-        table)."""
-        tid = id(table)
+    @staticmethod
+    def _tid(table):
+        return (id(table[0]), table[1]) if isinstance(table, tuple) \
+            else id(table)
+
+    @staticmethod
+    def _pull(table):
+        if isinstance(table, tuple):
+            client, table_id = table
+            return client.pull_dense(table_id)
+        return table.pull()
+
+    @staticmethod
+    def _add(table, delta):
+        if isinstance(table, tuple):
+            client, table_id = table
+            return client.push_dense_delta(table_id, delta)
+        table.add(delta)
+        return table.pull()
+
+    def register_dense(self, table, params: np.ndarray, is_chief=True):
+        """Start geo tracking. The chief seeds the server with its params
+        (as a delta vs whatever is there); non-chief trainers adopt the
+        server's. Returns the params the trainer should train from."""
+        if is_chief:
+            merged = self._add(table, params - self._pull(table))
+        else:
+            merged = self._pull(table)
+        self._base[self._tid(table)] = merged.copy()
+        return merged.copy()
+
+    def maybe_sync_dense(self, table, params: np.ndarray):
+        """Called each local step with the trainer's CURRENT local params.
+        Every k_steps: push delta, rebase onto the merged result.
+        Returns the params the trainer should continue from."""
+        tid = self._tid(table)
+        if tid not in self._base:
+            # implicit registration ADOPTS the server's params: only an
+            # explicit register_dense(..., is_chief=True) may seed, else a
+            # late-joining trainer would wipe the merged progress
+            return self.register_dense(table, params, is_chief=False)
         self._steps[tid] = self._steps.get(tid, 0) + 1
-        if tid not in self._dense_shadow:
-            self._dense_shadow[tid] = params.copy()
-            return
-        if self._steps[tid] % self.k_steps == 0:
-            # table.push applies -lr*g with lr=1 naive rule
-            delta = self._dense_shadow[tid] - params
-            table.push(delta)
-            self._dense_shadow[tid] = table.pull().copy()
+        if self._steps[tid] % self.k_steps != 0:
+            return params
+        merged = self._add(table, params - self._base[tid])
+        self._base[tid] = merged.copy()
+        return merged.copy()

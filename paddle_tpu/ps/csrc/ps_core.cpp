@@ -61,6 +61,18 @@ struct SparseTable {
   std::mutex locks[kShards];
   std::mt19937 rngs[kShards];
 
+  // Spill mode (SSDSparseTable capability, ssd_sparse_table.h parity
+  // re-designed: log-structured per-shard files instead of rocksdb).
+  // Values past the per-shard memory budget are appended to a shard file
+  // and indexed by offset; touching a spilled key promotes it back to
+  // memory (evicting another). The log holds stale copies of re-promoted
+  // keys; save()+load() compacts.
+  bool spill_enabled = false;
+  int64_t mem_budget_shard = 0;
+  std::string spill_dir;
+  std::unordered_map<uint64_t, int64_t> spill_idx[kShards];
+  FILE* spill_f[kShards] = {nullptr};
+
   explicit SparseTable(const TableConfig& c) : cfg(c) {
     int extra = 0;
     if (cfg.rule == kAdaGrad) extra = cfg.dim;
@@ -69,15 +81,90 @@ struct SparseTable {
     for (int i = 0; i < kShards; i++) rngs[i].seed(1234 + i);
   }
 
+  ~SparseTable() {
+    for (int s = 0; s < kShards; s++) {
+      if (spill_f[s]) std::fclose(spill_f[s]);
+    }
+  }
+
+  int enable_spill(const char* dir, int64_t max_mem_keys) {
+    if (spill_enabled) {
+      // already spilling: only adjust the budget — re-opening "wb+"
+      // would truncate logs that live spill_idx offsets point into
+      mem_budget_shard = std::max<int64_t>(1, max_mem_keys / kShards);
+      for (int s = 0; s < kShards; s++) {
+        std::lock_guard<std::mutex> g(locks[s]);
+        evict_to_budget(s, 0);
+      }
+      return 0;
+    }
+    // open all shard logs before flipping any state so a mid-loop
+    // failure leaves the table fully un-spilled
+    FILE* files[kShards] = {nullptr};
+    for (int s = 0; s < kShards; s++) {
+      std::string p = std::string(dir) + "/spill_" + std::to_string(s) +
+          ".bin";
+      files[s] = std::fopen(p.c_str(), "wb+");
+      if (!files[s]) {
+        for (int j = 0; j < s; j++) std::fclose(files[j]);
+        return -1;
+      }
+    }
+    spill_dir = dir;
+    mem_budget_shard = std::max<int64_t>(1, max_mem_keys / kShards);
+    for (int s = 0; s < kShards; s++) spill_f[s] = files[s];
+    spill_enabled = true;
+    for (int s = 0; s < kShards; s++) {
+      std::lock_guard<std::mutex> g(locks[s]);
+      evict_to_budget(s, 0);
+    }
+    return 0;
+  }
+
   static int shard_of(uint64_t key) {
     // mix then take low bits
     uint64_t h = key * 0x9E3779B97F4A7C15ull;
     return static_cast<int>((h >> 32) & (kShards - 1));
   }
 
+  // under shard lock. Evicts arbitrary (hash-order) residents until the
+  // shard fits its budget; `protect` is never evicted.
+  void evict_to_budget(int s, uint64_t protect) {
+    if (!spill_enabled) return;
+    auto& mp = shards[s];
+    while ((int64_t)mp.size() > mem_budget_shard) {
+      auto it = mp.begin();
+      if (it->first == protect) {
+        ++it;
+        if (it == mp.end()) break;
+      }
+      std::fseek(spill_f[s], 0, SEEK_END);
+      int64_t off = std::ftell(spill_f[s]);
+      std::fwrite(it->second.data(), sizeof(float), value_len,
+                  spill_f[s]);
+      spill_idx[s][it->first] = off;
+      mp.erase(it);
+    }
+  }
+
   std::vector<float>& get_or_init(uint64_t key, int s) {
     auto it = shards[s].find(key);
     if (it != shards[s].end()) return it->second;
+    if (spill_enabled) {
+      auto sit = spill_idx[s].find(key);
+      if (sit != spill_idx[s].end()) {
+        std::vector<float> v(value_len);
+        std::fseek(spill_f[s], sit->second, SEEK_SET);
+        if (std::fread(v.data(), sizeof(float), value_len, spill_f[s]) ==
+            (size_t)value_len) {
+          spill_idx[s].erase(sit);
+          auto& ref = shards[s].emplace(key, std::move(v)).first->second;
+          evict_to_budget(s, key);  // node-based map: ref stays valid
+          return ref;
+        }
+        spill_idx[s].erase(sit);  // corrupt entry: fall through to init
+      }
+    }
     std::vector<float> v(value_len, 0.0f);
     std::uniform_real_distribution<float> dist(-cfg.initial_range,
                                                cfg.initial_range);
@@ -89,7 +176,9 @@ struct SparseTable {
       v[3 + 3 * cfg.dim] = 1.0f;      // beta1_pow
       v[3 + 3 * cfg.dim + 1] = 1.0f;  // beta2_pow
     }
-    return shards[s].emplace(key, std::move(v)).first->second;
+    auto& ref = shards[s].emplace(key, std::move(v)).first->second;
+    evict_to_budget(s, key);
+    return ref;
   }
 
   void pull(const uint64_t* keys, int n, float* out) {
@@ -178,11 +267,19 @@ struct SparseTable {
     return removed.load();
   }
 
-  int64_t size() const {
+  int64_t mem_size() const {
     int64_t n = 0;
     for (int s = 0; s < kShards; s++) n += (int64_t)shards[s].size();
     return n;
   }
+
+  int64_t spill_size() const {
+    int64_t n = 0;
+    for (int s = 0; s < kShards; s++) n += (int64_t)spill_idx[s].size();
+    return n;
+  }
+
+  int64_t size() const { return mem_size() + spill_size(); }
 
   int save(const char* path) {
     FILE* f = std::fopen(path, "wb");
@@ -191,9 +288,23 @@ struct SparseTable {
     std::fwrite(&total, sizeof(total), 1, f);
     std::fwrite(&value_len, sizeof(value_len), 1, f);
     for (int s = 0; s < kShards; s++) {
+      std::lock_guard<std::mutex> g(locks[s]);
       for (auto& kv : shards[s]) {
         std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
         std::fwrite(kv.second.data(), sizeof(float), value_len, f);
+      }
+      // spilled entries stream out of the shard log (this is also the
+      // compaction point: a later load() rebuilds a dense log)
+      std::vector<float> v(value_len);
+      for (auto& kv : spill_idx[s]) {
+        std::fseek(spill_f[s], kv.second, SEEK_SET);
+        if (std::fread(v.data(), sizeof(float), value_len, spill_f[s]) !=
+            (size_t)value_len) {
+          std::fclose(f);
+          return -4;
+        }
+        std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
+        std::fwrite(v.data(), sizeof(float), value_len, f);
       }
     }
     std::fclose(f);
@@ -220,7 +331,10 @@ struct SparseTable {
         return -3;
       }
       int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
       shards[s][k] = std::move(v);
+      spill_idx[s].erase(k);
+      evict_to_budget(s, k);
     }
     std::fclose(f);
     return 0;
@@ -372,6 +486,17 @@ void pscore_sparse_push(int h, const uint64_t* keys, const float* grads,
 
 int64_t pscore_sparse_size(int h) { return g_sparse[h]->size(); }
 
+int pscore_sparse_enable_spill(int h, const char* dir,
+                               int64_t max_mem_keys) {
+  return g_sparse[h]->enable_spill(dir, max_mem_keys);
+}
+
+int64_t pscore_sparse_mem_size(int h) { return g_sparse[h]->mem_size(); }
+
+int64_t pscore_sparse_spill_size(int h) {
+  return g_sparse[h]->spill_size();
+}
+
 int64_t pscore_sparse_shrink(int h, float threshold, int max_unseen) {
   return g_sparse[h]->shrink(threshold, max_unseen);
 }
@@ -409,6 +534,14 @@ void pscore_dense_pull(int h, float* out, int64_t n) {
   auto* t = g_dense[h];
   std::lock_guard<std::mutex> g(t->lock);
   std::memcpy(out, t->data.data(), sizeof(float) * n);
+}
+
+// geo-async merge (MemorySparseGeoTable/geo dense mode capability): the
+// server adds trainer deltas instead of running an SGD rule
+void pscore_dense_add(int h, const float* delta, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  for (int64_t i = 0; i < n; i++) t->data[i] += delta[i];
 }
 
 void pscore_dense_push(int h, const float* grads, int64_t n) {
@@ -469,6 +602,13 @@ namespace {
 
 struct GraphTable {
   std::unordered_map<uint64_t, std::vector<uint64_t>> adj[kShards];
+  // per-edge weights, parallel to adj lists; only materialised for nodes
+  // that ever saw a weighted edge (graph_gpu_ps_table weighted-sampling
+  // capability)
+  std::unordered_map<uint64_t, std::vector<float>> wts[kShards];
+  // node feature vectors (common_graph_table.h Node::get_feature parity);
+  // the feature dim is caller-supplied per get call (Python tracks it)
+  std::unordered_map<uint64_t, std::vector<float>> feats[kShards];
   std::mutex locks[kShards];
   std::vector<uint64_t> nodes;  // insertion order, for sampling starts
   std::mutex nodes_lock;
@@ -485,19 +625,84 @@ struct GraphTable {
     return SparseTable::shard_of(key);
   }
 
+  void add_one(uint64_t src, uint64_t dst, float w, bool has_w) {
+    int s = shard_of(src);
+    std::lock_guard<std::mutex> g(locks[s]);
+    auto it = adj[s].find(src);
+    if (it == adj[s].end()) {
+      adj[s][src] = {dst};
+      if (has_w) wts[s][src] = {w};
+      std::lock_guard<std::mutex> g2(nodes_lock);
+      nodes.push_back(src);
+      return;
+    }
+    it->second.push_back(dst);
+    auto wit = wts[s].find(src);
+    if (has_w || wit != wts[s].end()) {
+      auto& wv = (wit != wts[s].end()) ? wit->second : wts[s][src];
+      // earlier unweighted edges on this node default to weight 1
+      while (wv.size() + 1 < it->second.size()) wv.push_back(1.0f);
+      wv.push_back(has_w ? w : 1.0f);
+    }
+  }
+
   void add_edges(const uint64_t* src, const uint64_t* dst, int64_t n) {
+    for (int64_t i = 0; i < n; i++) add_one(src[i], dst[i], 1.0f, false);
+  }
+
+  void add_edges_weighted(const uint64_t* src, const uint64_t* dst,
+                          const float* w, int64_t n) {
+    for (int64_t i = 0; i < n; i++) add_one(src[i], dst[i], w[i], true);
+  }
+
+  void set_node_feat(const uint64_t* keys, int64_t n, int dim,
+                     const float* vals) {
     for (int64_t i = 0; i < n; i++) {
-      int s = shard_of(src[i]);
+      int s = shard_of(keys[i]);
       std::lock_guard<std::mutex> g(locks[s]);
-      auto it = adj[s].find(src[i]);
-      if (it == adj[s].end()) {
-        adj[s][src[i]] = {dst[i]};
-        std::lock_guard<std::mutex> g2(nodes_lock);
-        nodes.push_back(src[i]);
+      feats[s][keys[i]].assign(vals + (size_t)i * dim,
+                               vals + (size_t)(i + 1) * dim);
+    }
+  }
+
+  void get_node_feat(const uint64_t* keys, int64_t n, int dim,
+                     float* out) {
+    for (int64_t i = 0; i < n; i++) {
+      int s = shard_of(keys[i]);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto it = feats[s].find(keys[i]);
+      float* dst = out + (size_t)i * dim;
+      if (it == feats[s].end() || (int)it->second.size() != dim) {
+        std::memset(dst, 0, sizeof(float) * dim);
       } else {
-        it->second.push_back(dst[i]);
+        std::memcpy(dst, it->second.data(), sizeof(float) * dim);
       }
     }
+  }
+
+  // pick an edge index from `nb`, weighted when this node has weights;
+  // call under shard lock
+  size_t choose_edge(int s, uint64_t node,
+                     const std::vector<uint64_t>& nb) {
+    auto wit = wts[s].find(node);
+    if (wit == wts[s].end() || wit->second.size() != nb.size()) {
+      std::uniform_int_distribution<uint64_t> u;
+      return (size_t)(u(rngs[s]) % nb.size());
+    }
+    const auto& wv = wit->second;
+    float total = 0.0f;
+    for (float w : wv) total += (w > 0 ? w : 0);
+    if (total <= 0.0f) {
+      std::uniform_int_distribution<uint64_t> u;
+      return (size_t)(u(rngs[s]) % nb.size());
+    }
+    std::uniform_real_distribution<float> ur(0.0f, total);
+    float r = ur(rngs[s]);
+    for (size_t j = 0; j < wv.size(); j++) {
+      r -= (wv[j] > 0 ? wv[j] : 0);
+      if (r <= 0) return j;
+    }
+    return wv.size() - 1;
   }
 
   // sample up to k neighbors per query node (out: [n, k]); slots past
@@ -521,10 +726,10 @@ struct GraphTable {
       for (int j = 0; j < k; j++) {
         if (j < deg) {
           out[i * k + j] = nb.size() <= (size_t)k
-              ? nb[j]                                  // take all
-              : nb[(size_t)(u(rngs[s]) % nb.size())];  // subsample
+              ? nb[j]                              // take all
+              : nb[choose_edge(s, q[i], nb)];      // (weighted) subsample
         } else {
-          out[i * k + j] = q[i];                       // self-pad
+          out[i * k + j] = q[i];                   // self-pad
         }
       }
     }
@@ -546,7 +751,7 @@ struct GraphTable {
           out[i * (walk_len + 1) + t] = cur;
           continue;
         }
-        cur = it->second[(size_t)(u(rngs[s]) % it->second.size())];
+        cur = it->second[choose_edge(s, cur, it->second)];
         out[i * (walk_len + 1) + t] = cur;
       }
     }
@@ -582,6 +787,22 @@ int pscore_graph_create() {
 void pscore_graph_add_edges(int h, const uint64_t* src,
                             const uint64_t* dst, int64_t n) {
   g_graphs[h]->add_edges(src, dst, n);
+}
+
+void pscore_graph_add_edges_weighted(int h, const uint64_t* src,
+                                     const uint64_t* dst, const float* w,
+                                     int64_t n) {
+  g_graphs[h]->add_edges_weighted(src, dst, w, n);
+}
+
+void pscore_graph_set_node_feat(int h, const uint64_t* keys, int64_t n,
+                                int dim, const float* vals) {
+  g_graphs[h]->set_node_feat(keys, n, dim, vals);
+}
+
+void pscore_graph_get_node_feat(int h, const uint64_t* keys, int64_t n,
+                                int dim, float* out) {
+  g_graphs[h]->get_node_feat(keys, n, dim, out);
 }
 
 void pscore_graph_sample_neighbors(int h, const uint64_t* q, int64_t n,

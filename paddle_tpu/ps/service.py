@@ -17,6 +17,13 @@ ops: 0 PULL_SPARSE (payload: u32 n, u64*n keys) -> f32 n*dim
      4 SAVE        (payload: u16 len, path) -> u8 ok
      5 BARRIER     -> u8 ok
      6 STOP        -> u8 ok
+     7 DENSE_ADD   (payload: u32 n, f32*n delta) -> u32 n, f32*n merged
+       (geo-async dense mode: server merges the trainer's delta and
+       returns the merged params in one round trip)
+
+Fault tolerance: the client transparently reconnects a broken server
+socket and retries the request ONCE (brpc_ps_client reconnect parity;
+pushes are at-least-once on retry, like the reference's async push).
 """
 from __future__ import annotations
 
@@ -29,8 +36,8 @@ import numpy as np
 
 from .table import MemorySparseTable, MemoryDenseTable
 
-PULL_SPARSE, PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, SAVE, BARRIER, STOP = \
-    range(7)
+(PULL_SPARSE, PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, SAVE, BARRIER, STOP,
+ DENSE_ADD) = range(8)
 
 
 def _recv_exact(sock, n):
@@ -141,6 +148,13 @@ class PSServer:
             grads = np.frombuffer(body[4:4 + 4 * n], np.float32)
             table.push(grads.copy())
             _send_msg(sock, b"\x01")
+        elif op == DENSE_ADD:
+            (n,) = struct.unpack("<I", body[:4])
+            delta = np.frombuffer(body[4:4 + 4 * n], np.float32)
+            table.add(delta.copy())
+            merged = table.pull()
+            _send_msg(sock, struct.pack("<I", merged.size)
+                      + merged.astype(np.float32).tobytes())
         elif op == SAVE:
             (ln,) = struct.unpack("<H", body[:2])
             path = body[2:2 + ln].decode()
@@ -167,18 +181,42 @@ class PSClient:
     def __init__(self, endpoints):
         self.endpoints = [(h, int(p)) for h, p in
                           (e.split(":") for e in endpoints)]
-        self._socks = []
-        for host, port in self.endpoints:
-            s = socket.create_connection((host, port), timeout=30)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            # Connect quickly, but allow long replies: BARRIER legitimately
-            # parks the socket until the last participant arrives (server
-            # waits up to 300s), far beyond the 30s connect timeout this
-            # socket would otherwise inherit. Keep a bound (> the server's
-            # 300s barrier wait) so a dead server still errors out.
-            s.settimeout(330.0)
-            self._socks.append(s)
+        self._socks = [self._connect(i)
+                       for i in range(len(self.endpoints))]
         self._lock = threading.Lock()
+
+    def _connect(self, si):
+        host, port = self.endpoints[si]
+        s = socket.create_connection((host, port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Connect quickly, but allow long replies: BARRIER legitimately
+        # parks the socket until the last participant arrives (server
+        # waits up to 300s), far beyond the 30s connect timeout this
+        # socket would otherwise inherit. Keep a bound (> the server's
+        # 300s barrier wait) so a dead server still errors out.
+        s.settimeout(330.0)
+        return s
+
+    def _request(self, si, payload: bytes, retry=True) -> bytes:
+        """Send + receive on server si, reconnecting and retrying ONCE on
+        a broken socket (brpc_ps_client reconnect capability). Retried
+        pushes are at-least-once, matching the reference's async push
+        semantics; non-idempotent ops (BARRIER: a double arrival would
+        release the rendezvous early) pass retry=False and surface the
+        error instead. Call with self._lock held."""
+        for attempt in (0, 1):
+            try:
+                _send_msg(self._socks[si], payload)
+                return _recv_msg(self._socks[si])
+            except (ConnectionError, OSError):
+                if attempt or not retry:
+                    raise
+                try:
+                    self._socks[si].close()
+                except OSError:
+                    pass
+                self._socks[si] = self._connect(si)
+        raise ConnectionError("unreachable")
 
     def _shard_of(self, keys):
         n = len(self._socks)
@@ -191,15 +229,14 @@ class PSClient:
         out = np.empty((flat.size, dim), np.float32)
         assign = self._shard_of(flat)
         with self._lock:
-            for si, sock in enumerate(self._socks):
+            for si in range(len(self._socks)):
                 idx = np.where(assign == si)[0]
                 if idx.size == 0:
                     continue
                 sub = flat[idx]
                 payload = struct.pack("<BII", PULL_SPARSE, table_id,
                                       sub.size) + sub.tobytes()
-                _send_msg(sock, payload)
-                resp = _recv_msg(sock)
+                resp = self._request(si, payload)
                 out[idx] = np.frombuffer(resp, np.float32).reshape(
                     sub.size, dim)
         return out.reshape(*shape, dim)
@@ -210,7 +247,7 @@ class PSClient:
         g = grads.reshape(flat.size, dim).astype(np.float32)
         assign = self._shard_of(flat)
         with self._lock:
-            for si, sock in enumerate(self._socks):
+            for si in range(len(self._socks)):
                 idx = np.where(assign == si)[0]
                 if idx.size == 0:
                     continue
@@ -218,41 +255,45 @@ class PSClient:
                 payload = struct.pack("<BII", PUSH_SPARSE, table_id,
                                       sub.size) + sub.tobytes() + \
                     g[idx].tobytes()
-                _send_msg(sock, payload)
-                _recv_msg(sock)
+                self._request(si, payload)
 
     def pull_dense(self, table_id, server=0):
         with self._lock:
-            sock = self._socks[server]
-            _send_msg(sock, struct.pack("<BI", PULL_DENSE, table_id))
-            resp = _recv_msg(sock)
+            resp = self._request(server, struct.pack("<BI", PULL_DENSE,
+                                                     table_id))
         (n,) = struct.unpack("<I", resp[:4])
         return np.frombuffer(resp[4:], np.float32)[:n]
 
     def push_dense(self, table_id, grads: np.ndarray, server=0):
         g = grads.reshape(-1).astype(np.float32)
         with self._lock:
-            sock = self._socks[server]
-            _send_msg(sock, struct.pack("<BII", PUSH_DENSE, table_id,
-                                        g.size) + g.tobytes())
-            _recv_msg(sock)
+            self._request(server, struct.pack(
+                "<BII", PUSH_DENSE, table_id, g.size) + g.tobytes())
+
+    def push_dense_delta(self, table_id, delta: np.ndarray, server=0):
+        """Geo-async dense: merge a local delta into the server's params;
+        returns the merged params (one round trip)."""
+        d = delta.reshape(-1).astype(np.float32)
+        with self._lock:
+            resp = self._request(server, struct.pack(
+                "<BII", DENSE_ADD, table_id, d.size) + d.tobytes())
+        (n,) = struct.unpack("<I", resp[:4])
+        return np.frombuffer(resp[4:], np.float32)[:n]
 
     def barrier(self, num_trainers=1):
         """Block until `num_trainers` clients reach the barrier on each
         server (count-based rendezvous)."""
         with self._lock:
-            for sock in self._socks:
-                _send_msg(sock, struct.pack("<BII", BARRIER, 0,
-                                            num_trainers))
-                _recv_msg(sock)
+            for si in range(len(self._socks)):
+                self._request(si, struct.pack("<BII", BARRIER, 0,
+                                              num_trainers), retry=False)
 
     def save(self, table_id, path):
         with self._lock:
-            for i, sock in enumerate(self._socks):
-                p = f"{path}.shard{i}".encode()
-                _send_msg(sock, struct.pack("<BIH", SAVE, table_id,
-                                            len(p)) + p)
-                _recv_msg(sock)
+            for si in range(len(self._socks)):
+                p = f"{path}.shard{si}".encode()
+                self._request(si, struct.pack("<BIH", SAVE, table_id,
+                                              len(p)) + p)
 
     def stop_server(self):
         with self._lock:

@@ -52,7 +52,28 @@ class MemorySparseTable:
     def __len__(self):
         return int(self._lib.pscore_sparse_size(self._h))
 
+    def enable_spill(self, directory: str, max_mem_keys: int):
+        """SSDSparseTable capability (`ps/table/ssd_sparse_table.h`,
+        re-designed as log-structured per-shard files instead of rocksdb):
+        keys beyond `max_mem_keys` spill to disk and are promoted back on
+        touch. save()+load() compacts the logs."""
+        import os
+        os.makedirs(directory, exist_ok=True)
+        rc = self._lib.pscore_sparse_enable_spill(
+            self._h, directory.encode(), int(max_mem_keys))
+        if rc != 0:
+            raise IOError(f"enable_spill failed ({rc}): {directory}")
+
+    def mem_size(self):
+        return int(self._lib.pscore_sparse_mem_size(self._h))
+
+    def spill_size(self):
+        return int(self._lib.pscore_sparse_spill_size(self._h))
+
     def shrink(self, threshold=0.0, max_unseen_days=30):
+        """Decay show/click + age + drop low-score features (Table::Shrink
+        parity). Spilled entries are not decayed in place; they age when
+        promoted back to memory."""
         return int(self._lib.pscore_sparse_shrink(
             self._h, float(threshold), int(max_unseen_days)))
 
@@ -87,6 +108,19 @@ class MemoryDenseTable:
     def push(self, grads: np.ndarray):
         g = np.ascontiguousarray(grads.reshape(-1), np.float32)
         self._lib.pscore_dense_push(self._h, f32_ptr(g), g.size)
+
+    def add(self, delta: np.ndarray):
+        """Geo-async merge: server adds a trainer's local delta instead of
+        applying an SGD rule (communicator.h geo dense mode)."""
+        d = np.ascontiguousarray(delta.reshape(-1), np.float32)
+        self._lib.pscore_dense_add(self._h, f32_ptr(d), d.size)
+
+    def save(self, path: str):
+        np.save(path if path.endswith(".npy") else path + ".npy",
+                self.pull())
+
+    def load(self, path: str):
+        self.set(np.load(path if path.endswith(".npy") else path + ".npy"))
 
 
 class InMemoryDataset:

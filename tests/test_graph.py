@@ -58,3 +58,63 @@ def test_graphsage_style_step():
     opt.step()
     assert np.isfinite(float(loss))
     assert len(emb.table) > 0  # embeddings touched/trained
+
+
+def test_node_features_roundtrip():
+    g = GraphTable()
+    g.add_edges([1, 2], [2, 1])
+    nodes = np.array([1, 2, 99], np.uint64)  # 99 has no features
+    feats = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    g.set_node_feat([1, 2], feats)
+    out = g.get_node_feat(nodes)
+    np.testing.assert_allclose(out[:2], feats)
+    np.testing.assert_allclose(out[2], [0.0, 0.0])
+
+
+def test_weighted_neighbor_sampling():
+    """Edge weights bias sampling: a 99:1 weighted pair should be picked
+    overwhelmingly often."""
+    g = GraphTable()
+    src = np.full(3, 7, np.uint64)
+    dst = np.array([100, 200, 300], np.uint64)
+    w = np.array([98.0, 1.0, 1.0], np.float32)
+    g.add_edges_weighted(src, dst, w)
+    counts = {100: 0, 200: 0, 300: 0}
+    for _ in range(300):
+        out, deg = g.sample_neighbors([7], 2)  # k < degree -> subsample
+        for v in out[0]:
+            counts[int(v)] += 1
+    total = sum(counts.values())
+    assert counts[100] / total > 0.8, counts
+
+
+def test_weighted_random_walk():
+    g = GraphTable()
+    # chain 1 -> {2 (w=100), 3 (w=0.0001)}; walks should go through 2
+    g.add_edges_weighted([1, 1], [2, 3], [100.0, 0.0001])
+    g.add_edges([2, 3], [4, 5])
+    walks = g.random_walk(np.full(50, 1, np.uint64), 2)
+    via_2 = np.sum(walks[:, 1] == 2)
+    assert via_2 >= 48, via_2
+
+
+def test_mixed_weighted_unweighted_edges():
+    g = GraphTable()
+    g.add_edges([9], [10])             # unweighted first (defaults w=1)
+    g.add_edges_weighted([9], [11], [1.0])
+    out, deg = g.sample_neighbors([9], 2)
+    assert deg[0] == 2 and set(map(int, out[0])) == {10, 11}
+
+
+def test_graphsage_example_trains():
+    """End-to-end GNN: C++ store (features + weighted sampling) feeding a
+    compiled-eager GraphSAGE — separates two communities."""
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "6_gnn_graphsage.py")
+    spec = importlib.util.spec_from_file_location("gnn_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    acc = mod.main(epochs=8, batch=128, k=5)
+    assert acc > 0.9, acc

@@ -209,3 +209,51 @@ def test_ps_runtime_fleet_integration(tmp_path):
     t.pull(np.array([1, 2, 3], np.uint64))
     rt.save_persistables(str(tmp_path / "ps_model"))
     assert os.path.exists(str(tmp_path / "ps_model" / "sparse_0.bin"))
+
+
+def test_sparse_spill_to_disk(tmp_path):
+    """SSDSparseTable capability: keys past the memory budget spill to
+    per-shard log files, values survive the round trip, save/load
+    compacts."""
+    t = MemorySparseTable(dim=4, sgd_rule="naive", learning_rate=0.5)
+    keys = np.arange(1, 2001, dtype=np.uint64)
+    first = t.pull(keys).copy()
+    t.enable_spill(str(tmp_path / "spill"), max_mem_keys=256)
+    assert t.mem_size() <= 320  # 64 shards x ceil budget
+    assert t.spill_size() > 0
+    assert len(t) == 2000
+    # spilled values promote back intact
+    again = t.pull(keys)
+    np.testing.assert_allclose(again, first)
+    # pushes against spilled keys update them
+    g = np.ones((keys.size, 4), np.float32)
+    t.push(keys, g)
+    np.testing.assert_allclose(t.pull(keys), first - 0.5, atol=1e-6)
+    # save compacts mem + spilled into one file; load round-trips
+    p = str(tmp_path / "table.bin")
+    t.save(p)
+    t2 = MemorySparseTable(dim=4, sgd_rule="naive", learning_rate=0.5)
+    t2.load(p)
+    assert len(t2) == 2000
+    np.testing.assert_allclose(t2.pull(keys), first - 0.5, atol=1e-6)
+
+
+def test_geo_communicator_merges_trainers():
+    """Geo-async dense mode: two trainers train local copies; deltas
+    merge additively on the server so both trainers' progress lands."""
+    from paddle_tpu.ps.communicator import GeoCommunicator
+
+    server = MemoryDenseTable(4, sgd_rule="naive", learning_rate=1.0)
+    geo_a = GeoCommunicator(k_steps=2)
+    geo_b = GeoCommunicator(k_steps=2)
+    init = np.zeros(4, np.float32)
+    pa = geo_a.register_dense(server, init, is_chief=True)
+    pb = geo_b.register_dense(server, init, is_chief=False)
+    # trainer A adds +1/step to slot 0; B adds +1/step to slot 1
+    for step in range(4):
+        pa = pa + np.array([1, 0, 0, 0], np.float32)
+        pa = geo_a.maybe_sync_dense(server, pa)
+        pb = pb + np.array([0, 1, 0, 0], np.float32)
+        pb = geo_b.maybe_sync_dense(server, pb)
+    merged = server.pull()
+    assert merged[0] == 4.0 and merged[1] == 4.0, merged
