@@ -50,6 +50,7 @@ from . import inference  # noqa
 from . import hub  # noqa
 from . import quantization  # noqa
 from . import text  # noqa
+from . import strings  # noqa
 from . import utils  # noqa
 from . import audio  # noqa
 from . import geometric  # noqa

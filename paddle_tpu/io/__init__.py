@@ -253,9 +253,110 @@ def default_collate_fn(batch):
     return batch
 
 
+# ---------------------------------------------------------------------
+# multiprocess workers (reference reader.py:275 + mmap_allocator shared
+# memory). Workers are forked processes pulling index batches from a
+# queue; collated numpy arrays return via SharedMemory segments (large
+# arrays bypass pickle — the mmap_allocator role) with an order-restoring
+# reorder buffer in the parent.
+
+_SHM_MIN_BYTES = 1 << 16
+
+
+def _strip_tensors(obj):
+    """Tensor -> numpy for IPC; structure preserved."""
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, (list, tuple)):
+        return [_strip_tensors(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _strip_tensors(v) for k, v in obj.items()}
+    return obj
+
+
+def _to_shm(obj, shms):
+    """Replace big ndarrays with ('__shm__', name, shape, dtype)."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
+        shms.append(shm)
+        return ("__shm__", shm.name, obj.shape, str(obj.dtype))
+    if isinstance(obj, list):
+        return [_to_shm(o, shms) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_shm(v, shms) for k, v in obj.items()}
+    return obj
+
+
+def _from_shm(obj):
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        _, name, shape, dtype = obj
+        shm = shared_memory.SharedMemory(name=name)
+        arr = np.array(np.ndarray(shape, dtype, buffer=shm.buf))
+        shm.close()
+        shm.unlink()
+        return Tensor(arr)
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, list):
+        return [_from_shm(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _from_shm(v) for k, v in obj.items()}
+    return obj
+
+
+def _release_shm(obj):
+    """Unlink shm descriptors in an undelivered payload."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        try:
+            shm = shared_memory.SharedMemory(name=obj[1])
+            shm.close()
+            shm.unlink()
+        except (FileNotFoundError, OSError):
+            pass
+        return
+    if isinstance(obj, list):
+        for o in obj:
+            _release_shm(o)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            _release_shm(o)
+
+
+def _mp_worker_loop(dataset, index_q, data_q, collate_fn,
+                    use_shared_memory, worker_init_fn, worker_id):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        bid, idxs = item
+        try:
+            batch = collate_fn([dataset[i] for i in idxs])
+            payload = _strip_tensors(batch)
+            if use_shared_memory:
+                shms = []
+                payload = _to_shm(payload, shms)
+                data_q.put((bid, payload, None))
+                for shm in shms:
+                    shm.close()  # parent owns unlink
+            else:
+                data_q.put((bid, payload, None))
+        except Exception as e:  # propagate into the parent iterator
+            data_q.put((bid, None, f"{type(e).__name__}: {e}"))
+
+
 class DataLoader:
-    """Parity: `python/paddle/fluid/reader.py:275`. Thread-prefetching
-    host loader; `num_workers` controls the prefetch depth."""
+    """Parity: `python/paddle/fluid/reader.py:275`. num_workers=0 runs
+    in-process (with thread prefetch when use_buffer_reader); num_workers
+    > 0 forks worker processes that collate index batches and ship the
+    arrays back through SharedMemory (the reference's multiprocess
+    reader + mmap_allocator path). IterableDataset always runs
+    in-process (worker sharding semantics are the map-style path's)."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -267,6 +368,9 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self.prefetch = max(2, prefetch_factor * max(num_workers, 1))
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
@@ -305,6 +409,19 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._gen_batches()
             return
+        if not self._iterable_mode:
+            # fall back ONLY on setup failure — once batches have been
+            # yielded, restarting on the thread path would silently
+            # duplicate the epoch's data
+            try:
+                mp_iter = self._start_multiprocess()
+            except (ImportError, OSError, ValueError) as e:
+                import warnings
+                warnings.warn(f"multiprocess DataLoader unavailable "
+                              f"({e!r}); using thread prefetch")
+            else:
+                yield from mp_iter
+                return
         q = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
 
@@ -321,6 +438,65 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+
+    def _start_multiprocess(self):
+        """Setup (may raise -> caller falls back), returning the draining
+        generator."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        index_q = ctx.Queue()
+        data_q = ctx.Queue(maxsize=self.prefetch)
+        workers = [
+            ctx.Process(
+                target=_mp_worker_loop,
+                args=(self.dataset, index_q, data_q, self.collate_fn,
+                      self.use_shared_memory, self.worker_init_fn, wid),
+                daemon=True)
+            for wid in range(self.num_workers)]
+        for w in workers:
+            w.start()
+        n_batches = 0
+        for bid, idxs in enumerate(self.batch_sampler):
+            index_q.put((bid, list(idxs)))
+            n_batches += 1
+        for _ in workers:
+            index_q.put(None)
+        return self._drain_multiprocess(workers, data_q, n_batches)
+
+    def _drain_multiprocess(self, workers, data_q, n_batches):
+        reorder = {}
+        try:
+            next_bid = 0
+            while next_bid < n_batches:
+                while next_bid not in reorder:
+                    bid, payload, err = data_q.get(
+                        timeout=self.timeout or 120)
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed on batch {bid}: "
+                            f"{err}")
+                    reorder[bid] = payload
+                yield _from_shm(reorder.pop(next_bid))
+                next_bid += 1
+        finally:
+            for w in workers:
+                if w.is_alive():
+                    w.terminate()
+            for w in workers:
+                w.join(timeout=5)
+            # unlink SharedMemory segments still queued or reordered —
+            # on early break / worker error they would otherwise leak
+            # in /dev/shm until interpreter exit
+            import queue as _q
+            while True:
+                try:
+                    _, payload, _err = data_q.get_nowait()
+                except (_q.Empty, OSError):
+                    break
+                _release_shm(payload)
+            for payload in reorder.values():
+                _release_shm(payload)
 
 
 def get_worker_info():

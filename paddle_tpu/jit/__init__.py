@@ -18,6 +18,15 @@ from ..core import autograd
 from ..core import random as rng_mod
 from .functional import bind_arrays, split_state
 from .trainer import CompiledTrainStep, CompiledEvalStep  # noqa: F401
+from . import dy2static  # noqa: F401
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool):
+    """ProgramTranslator().enable() parity: globally toggle the dy2static
+    AST rewrite inside to_static."""
+    _to_static_enabled[0] = bool(flag)
 
 
 class StaticFunction:
@@ -38,6 +47,12 @@ class StaticFunction:
     def _build(self):
         layer = self._layer
         fn = self._fn
+        if _to_static_enabled[0]:
+            # AST-rewrite data-dependent python control flow into
+            # lax.cond/while_loop calls (dy2static transformer parity);
+            # returns fn unchanged when there is nothing to rewrite or
+            # the source is unavailable
+            fn = dy2static.transform_function(fn)
         if layer is not None:
             p_names, p_tensors, b_names, b_tensors = split_state(layer)
 

@@ -1,0 +1,410 @@
+"""dy2static: AST transforms for data-dependent Python control flow.
+
+Parity: the reference's dygraph_to_static transformer stack
+(`fluid/dygraph/dygraph_to_static/ast_transformer.py` — IfElse / Loop /
+break-continue transformers feeding `program_translator.py:1001`).
+TPU-native re-design: instead of lowering to static-graph
+`cond`/`while_loop` *ops*, the rewritten source calls the runtime helpers
+below, which dispatch per call —
+
+  - concrete predicate (eager, or a trace-time constant): plain Python
+    branch/loop, zero overhead, side effects allowed;
+  - traced predicate (inside jax.jit): `lax.cond` / `lax.while_loop`, so
+    a model whose `if`/`while` depends on tensor VALUES still compiles
+    into one XLA program instead of falling back to eager.
+
+Supported subset (transformed): `if`/`elif`/`else` whose branches only
+assign; `while`; `for i in range(...)`; `if <cond>: break` as the first
+statement of a loop body (folded into the loop condition). Anything else
+(return inside a branch, general break/continue, try/with, …) is left as
+ordinary Python — static control flow still traces fine; genuinely
+data-dependent cases keep the documented eager fallback.
+
+Like `lax.cond` (and the reference's trace-both-branches behavior),
+Python side effects in both branches of a TRACED `if` execute at trace
+time.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class _Undef:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dy2static UNDEF>"
+
+
+UNDEF = _Undef()
+
+
+def _val(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _rewrap(arr):
+    return Tensor(arr)
+
+
+def cond(pred, true_fn, false_fn):
+    """Runtime for a transformed `if`: fns take no args (outer values are
+    captured as default args) and return the tuple of assigned names."""
+    p = _val(pred)
+    if not _is_tracer(p):
+        return true_fn() if bool(p) else false_fn()
+
+    def wrap(fn):
+        def inner(_):
+            out = fn()
+            vals = []
+            for o in out:
+                v = _val(o)
+                if isinstance(v, _Undef):
+                    raise ValueError(
+                        "dy2static: a variable assigned in only one "
+                        "branch of a traced `if` must be initialised "
+                        "before the `if`")
+                vals.append(v)
+            return tuple(vals)
+        return inner
+
+    res = jax.lax.cond(p, wrap(true_fn), wrap(false_fn), None)
+    return tuple(_rewrap(r) for r in res)
+
+
+def while_loop(cond_fn, body_fn, init_vals):
+    """Runtime for a transformed `while`/`for`: cond_fn/body_fn take the
+    loop vars positionally; body_fn returns the updated tuple."""
+    for v in init_vals:
+        if isinstance(v, _Undef):
+            raise ValueError(
+                "dy2static: loop variables must be initialised before a "
+                "transformed loop")
+    c0 = _val(cond_fn(*init_vals))
+    traced = _is_tracer(c0) or any(_is_tracer(_val(v)) for v in init_vals)
+    if not traced:
+        vals = tuple(init_vals)
+        while bool(_val(cond_fn(*vals))):
+            vals = tuple(body_fn(*vals))
+        return vals
+
+    init = tuple(jnp.asarray(_val(v)) for v in init_vals)
+
+    def c(arrs):
+        return _val(cond_fn(*[_rewrap(a) for a in arrs]))
+
+    def b(arrs):
+        out = body_fn(*[_rewrap(a) for a in arrs])
+        return tuple(jnp.asarray(_val(o)) for o in out)
+
+    res = jax.lax.while_loop(c, b, init)
+    return tuple(_rewrap(r) for r in res)
+
+
+def range_cond(i, stop, step):
+    """`for i in range(...)` continuation test, sign-aware on step."""
+    iv, sv, st = _val(i), _val(stop), _val(step)
+    out = jnp.where(st > 0, iv < sv, iv > sv)
+    return _rewrap(out) if (_is_tracer(out) or isinstance(out, Tensor)) \
+        else bool(out)
+
+
+def logical_and(a, b):
+    av, bv = _val(a), _val(b)
+    if not (_is_tracer(av) or _is_tracer(bv)):
+        return bool(av) and bool(bv)
+    return _rewrap(jnp.logical_and(av, bv))
+
+
+def logical_not(a):
+    av = _val(a)
+    if not _is_tracer(av):
+        return not bool(av)
+    return _rewrap(jnp.logical_not(av))
+
+
+def range3(*args):
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+# ------------------------------------------------------------ transforms
+
+_SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
+                 ast.If, ast.For, ast.While, ast.Pass)
+
+
+def _assigned_names(stmts):
+    """Names (re)bound anywhere in these statements, not descending into
+    nested function/class definitions."""
+    names = []
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id not in names:
+                names.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for s in stmts:
+        visit(s)
+    return names
+
+
+def _transformable(stmts):
+    return all(isinstance(s, _SIMPLE_STMTS) for s in stmts)
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name("_jst"), attr=fn_name,
+                         ctx=ast.Load())
+
+
+def _undef_preamble(var):
+    """try: v \n except NameError/UnboundLocalError: v = _jst.UNDEF"""
+    return ast.Try(
+        body=[ast.Expr(value=_name(var))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError"),
+                                 _name("UnboundLocalError")],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(targets=[_name(var, ast.Store())],
+                             value=_jst_attr("UNDEF"))])],
+        orelse=[], finalbody=[])
+
+
+def _ret_tuple(names):
+    return ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load()))
+
+
+def _assign_tuple(names, value):
+    return ast.Assign(
+        targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                           ctx=ast.Store())],
+        value=value)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _uid(self):
+        self._counter += 1
+        return self._counter
+
+    # -- don't descend into nested defs/lambdas: they run as plain python
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if not (_transformable(node.body)
+                and _transformable(node.orelse or [ast.Pass()])):
+            return node
+        outs = _assigned_names(node.body + node.orelse)
+        if not outs:
+            return node
+        uid = self._uid()
+        tname, fname = f"__dy2s_true_{uid}", f"__dy2s_false_{uid}"
+        # outer values captured via default args so aug-assigns/reads of
+        # the output vars resolve inside the generated functions
+        arg_defaults = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in outs],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[_name(n) for n in outs])
+        tdef = ast.FunctionDef(
+            name=tname, args=arg_defaults,
+            body=list(node.body) + [_ret_tuple(outs)],
+            decorator_list=[], returns=None)
+        fdef = ast.FunctionDef(
+            name=fname, args=arg_defaults,
+            body=list(node.orelse or [ast.Pass()]) + [_ret_tuple(outs)],
+            decorator_list=[], returns=None)
+        call = ast.Call(func=_jst_attr("cond"),
+                        args=[node.test, _name(tname), _name(fname)],
+                        keywords=[])
+        stmts = [_undef_preamble(n) for n in outs]
+        stmts += [tdef, fdef, _assign_tuple(outs, call)]
+        return stmts
+
+    def _loop_helpers(self, loop_vars, body_stmts, test_expr, uid):
+        cname, bname = f"__dy2s_cond_{uid}", f"__dy2s_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cdef = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=test_expr)],
+            decorator_list=[], returns=None)
+        bdef = ast.FunctionDef(
+            name=bname, args=args,
+            body=body_stmts + [_ret_tuple(loop_vars)],
+            decorator_list=[], returns=None)
+        call = ast.Call(
+            func=_jst_attr("while_loop"),
+            args=[_name(cname), _name(bname),
+                  ast.Tuple(elts=[_name(n) for n in loop_vars],
+                            ctx=ast.Load())],
+            keywords=[])
+        return [cdef, bdef, _assign_tuple(loop_vars, call)]
+
+    @staticmethod
+    def _fold_leading_break(body, test):
+        """`while c: if b: break; rest` == `while c and not b: rest`."""
+        if body and isinstance(body[0], ast.If) and not body[0].orelse \
+                and len(body[0].body) == 1 \
+                and isinstance(body[0].body[0], ast.Break):
+            # python `and`/`not` would force bool() on tracers — use the
+            # tracer-aware logical helpers
+            folded = ast.Call(
+                func=_jst_attr("logical_and"),
+                args=[test,
+                      ast.Call(func=_jst_attr("logical_not"),
+                               args=[body[0].test], keywords=[])],
+                keywords=[])
+            return body[1:], folded
+        return body, test
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        body, test = self._fold_leading_break(node.body, node.test)
+        if not _transformable(body):
+            return node
+        loop_vars = _assigned_names(body)
+        if not loop_vars:
+            return node
+        uid = self._uid()
+        stmts = [_undef_preamble(n) for n in loop_vars]
+        stmts += self._loop_helpers(loop_vars, body, test, uid)
+        return stmts
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            return node
+        uid = self._uid()
+        i = node.target.id
+        # internal counter `ctr` drives the loop; the USER's variable is
+        # assigned from it at body start, so after the loop it holds the
+        # last ITERATED value (python for semantics), not one past it
+        ctr = f"__dy2s_i_{uid}"
+        stop_v, step_v = f"__dy2s_stop_{uid}", f"__dy2s_step_{uid}"
+        start_assign = _assign_tuple(
+            [ctr, stop_v, step_v],
+            ast.Call(func=_jst_attr("range3"), args=list(it.args),
+                     keywords=[]))
+        test = ast.Call(func=_jst_attr("range_cond"),
+                        args=[_name(ctr), _name(stop_v), _name(step_v)],
+                        keywords=[])
+        body, test = self._fold_leading_break(node.body, test)
+        if not _transformable(body):
+            return node
+        set_user = ast.Assign(targets=[_name(i, ast.Store())],
+                              value=_name(ctr))
+        incr = ast.AugAssign(target=_name(ctr, ast.Store()),
+                             op=ast.Add(), value=_name(step_v))
+        body = [set_user] + body + [incr]
+        loop_vars = [ctr, i] + [n for n in _assigned_names(body)
+                                if n not in (ctr, i)]
+        stmts = [start_assign,
+                 # seed the user's var so the traced carry is defined even
+                 # for range(0) (python would NameError on a later read;
+                 # we leave it at start — documented approximation)
+                 ast.Assign(targets=[_name(i, ast.Store())],
+                            value=_name(ctr))]
+        stmts += [_undef_preamble(n) for n in loop_vars
+                  if n not in (ctr, i)]
+        stmts += self._loop_helpers(loop_vars, body, test, uid)
+        return stmts
+
+
+_cache = {}
+
+
+def transform_function(fn):
+    """Rewrite data-dependent control flow in `fn` (a function or bound
+    method) into _jst.cond/while_loop calls. Returns the original on any
+    failure (source unavailable, unsupported constructs, …)."""
+    if isinstance(fn, types.MethodType):
+        new = transform_function(fn.__func__)
+        return types.MethodType(new, fn.__self__)
+    if fn in _cache:
+        return _cache[fn]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            raise ValueError("not a function definition")
+        fdef.decorator_list = []
+        new_body = []
+        tr = _ControlFlowTransformer()
+        for stmt in fdef.body:
+            out = tr.visit(stmt)
+            new_body.extend(out if isinstance(out, list) else [out])
+        if tr._counter == 0:
+            _cache[fn] = fn  # nothing to rewrite
+            return fn
+        fdef.body = new_body
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        glb = dict(fn.__globals__)
+        # re-expose the original closure as globals (exec'd functions
+        # have no closure cells)
+        if fn.__closure__:
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    glb[name] = cell.cell_contents
+                except ValueError:
+                    pass
+        import paddle_tpu.jit.dy2static as _jst_mod
+        glb["_jst"] = _jst_mod
+        loc = {}
+        exec(code, glb, loc)
+        new_fn = loc[fdef.name]
+        new_fn = functools.wraps(fn)(new_fn)
+        _cache[fn] = new_fn
+        return new_fn
+    except Exception:
+        _cache[fn] = fn
+        return fn

@@ -18,6 +18,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -62,12 +63,106 @@ class FileStore:
         return sorted(out)
 
 
+class KVMasterServer:
+    """TCP KV master (the launcher master.py HTTP/etcd-server role): a
+    json-line protocol over one listening socket. Second Store transport
+    proving the FileStore seam is real."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        import socketserver
+        import threading
+
+        kv = {}
+        lock = threading.Lock()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                    except json.JSONDecodeError:
+                        break
+                    with lock:
+                        if req["op"] == "put":
+                            kv[req["key"]] = req["value"]
+                            resp = {"ok": True}
+                        elif req["op"] == "get":
+                            resp = {"ok": True,
+                                    "value": kv.get(req["key"])}
+                        elif req["op"] == "list":
+                            pfx = req.get("prefix", "")
+                            resp = {"ok": True,
+                                    "items": {k: v for k, v in kv.items()
+                                              if k.startswith(pfx)}}
+                        else:
+                            resp = {"ok": False}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+
+
+class TcpStore:
+    """Store client with the same interface as FileStore, over a
+    KVMasterServer (PADDLE_ELASTIC_STORE=tcp://host:port)."""
+
+    def __init__(self, host, port):
+        import socket
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=30)
+        self._rfile = self._sock.makefile("r")
+
+    def _call(self, req):
+        self._sock.sendall((json.dumps(req) + "\n").encode())
+        return json.loads(self._rfile.readline())
+
+    def put(self, key, value):
+        self._call({"op": "put", "key": key, "value": value})
+
+    def get(self, key, default=None):
+        resp = self._call({"op": "get", "key": key})
+        v = resp.get("value")
+        return default if v is None else v
+
+    def heartbeat(self, node_id):
+        self.put(f"heartbeat_{node_id}", {"ts": time.time()})
+
+    def alive_nodes(self, timeout=30.0):
+        now = time.time()
+        items = self._call({"op": "list",
+                            "prefix": "heartbeat_"}).get("items", {})
+        return sorted(k[len("heartbeat_"):] for k, v in items.items()
+                      if v and now - v["ts"] < timeout)
+
+
+def make_store(spec):
+    """'tcp://host:port' -> TcpStore; anything else -> FileStore root."""
+    if spec.startswith("tcp://"):
+        host, port = spec[len("tcp://"):].rsplit(":", 1)
+        return TcpStore(host, port)
+    return FileStore(spec)
+
+
 class ElasticManager:
     def __init__(self, args=None, store_root=None, max_restarts=3,
                  heartbeat_interval=5.0):
-        self.store = FileStore(store_root or
-                               os.environ.get("PADDLE_ELASTIC_STORE",
-                                              "/tmp/paddle_tpu_elastic"))
+        self.store = make_store(store_root or
+                                os.environ.get("PADDLE_ELASTIC_STORE",
+                                               "/tmp/paddle_tpu_elastic"))
         self.max_restarts = max_restarts
         self.heartbeat_interval = heartbeat_interval
         self.node_id = os.environ.get("PADDLE_NODE_RANK", "0")

@@ -56,6 +56,14 @@ class GPTConfig:
     moe_aux_weight: float = 0.01
     # memory / precision
     remat: bool = True
+    # None = full per-block recompute; else a jax.checkpoint_policies
+    # name (e.g. "dots_with_no_batch_dims_saveable") trading memory for
+    # fewer recomputed FLOPs
+    remat_policy: Any = None
+    # sequence chunks for the vocab CE: the [B,S,V] fp32 logits are the
+    # single largest buffer (6.6GB at B=32,S=1024,V=50k) — chunking the
+    # head+CE over S with per-chunk remat caps it at 1/N of that
+    ce_seq_chunks: int = 1
     compute_dtype: Any = jnp.bfloat16
     # optimizer
     learning_rate: float = 1e-4
@@ -313,10 +321,17 @@ def _block(x, lp, cfg: GPTConfig):
 def _stage_forward(x, blocks_local, cfg: GPTConfig):
     """Run this pp rank's layers (scan over the stacked layer dim)."""
     if cfg.remat:
-        # full per-block remat: recompute the whole block in backward.
-        # (The dots-saveable policy keeps the [B,H,S,S] attention logits
-        # per layer — ~1GB/layer at S=1024 — and OOMs a 16GB chip.)
-        block_fn = jax.checkpoint(lambda c, p: _block(c, p, cfg))
+        # default: full per-block remat — recompute the whole block in
+        # backward. (The plain dots-saveable policy keeps the [B,H,S,S]
+        # attention logits per layer — ~1GB/layer at S=1024 — and OOMs a
+        # 16GB chip; fused attention hides its internals from the policy,
+        # so named no-batch-dims policies are safe to try via
+        # cfg.remat_policy.)
+        policy = None
+        if cfg.remat_policy is not None:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+        block_fn = jax.checkpoint(lambda c, p: _block(c, p, cfg),
+                                  policy=policy)
     else:
         block_fn = lambda c, p: _block(c, p, cfg)  # noqa: E731
 
@@ -342,9 +357,8 @@ def _vocab_parallel_embed(tokens, tok_emb_local, cfg: GPTConfig):
     return jax.lax.psum(emb, "mp")
 
 
-def _vocab_parallel_ce(y, head_local, labels, cfg: GPTConfig):
-    """c_softmax_with_cross_entropy parity. y [B,S,d] full seq; head_local
-    [d, V/mp]; labels [B,S]. Returns mean loss (replicated over mp)."""
+def _ce_sum(y, head_local, labels, cfg: GPTConfig):
+    """Sum (not mean) of token CE over y [B,S',d]."""
     V_loc = head_local.shape[1]
     logits = jnp.einsum("bsd,dv->bsv", y.astype(cfg.compute_dtype),
                         head_local.astype(cfg.compute_dtype),
@@ -353,7 +367,7 @@ def _vocab_parallel_ce(y, head_local, labels, cfg: GPTConfig):
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, labels[..., None],
                                   axis=-1)[..., 0]
-        return jnp.mean(lse - tgt)
+        return jnp.sum(lse - tgt)
     rank = jax.lax.axis_index("mp")
     start = rank * V_loc
     # stable global logsumexp
@@ -367,7 +381,30 @@ def _vocab_parallel_ce(y, head_local, labels, cfg: GPTConfig):
     tgt_local = jnp.take_along_axis(
         logits, jnp.clip(local_lab, 0, V_loc - 1)[..., None], axis=-1)[..., 0]
     tgt = jax.lax.psum(jnp.where(ok, tgt_local, 0.0), "mp")
-    return jnp.mean(lse - tgt)
+    return jnp.sum(lse - tgt)
+
+
+def _vocab_parallel_ce(y, head_local, labels, cfg: GPTConfig):
+    """c_softmax_with_cross_entropy parity. y [B,S,d] full seq; head_local
+    [d, V/mp]; labels [B,S]. Returns mean loss (replicated over mp).
+
+    ce_seq_chunks > 1 streams the head matmul + CE over sequence chunks
+    (lax.map + per-chunk remat) so the fp32 [B,S,V] logits never fully
+    materialise — the backward recomputes each chunk's logits."""
+    B, S, _ = y.shape
+    C = max(1, cfg.ce_seq_chunks)
+    if C == 1 or S % C != 0:
+        return _ce_sum(y, head_local, labels, cfg) / (B * S)
+    Sc = S // C
+    yc = jnp.swapaxes(y.reshape(B, C, Sc, -1), 0, 1)      # [C,B,Sc,d]
+    lc = jnp.swapaxes(labels.reshape(B, C, Sc), 0, 1)     # [C,B,Sc]
+
+    def chunk(args):
+        yy, ll = args
+        return _ce_sum(yy, head_local, ll, cfg)
+
+    sums = jax.lax.map(jax.checkpoint(chunk), (yc, lc))
+    return jnp.sum(sums) / (B * S)
 
 
 # ------------------------------------------------------- pipeline + loss

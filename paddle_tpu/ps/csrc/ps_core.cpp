@@ -140,8 +140,13 @@ struct SparseTable {
       }
       std::fseek(spill_f[s], 0, SEEK_END);
       int64_t off = std::ftell(spill_f[s]);
-      std::fwrite(it->second.data(), sizeof(float), value_len,
-                  spill_f[s]);
+      if (std::fwrite(it->second.data(), sizeof(float), value_len,
+                      spill_f[s]) != (size_t)value_len) {
+        // short write (disk full): keep the entry in memory rather than
+        // indexing truncated data that would later read back "corrupt"
+        // and silently re-initialize trained weights
+        break;
+      }
       spill_idx[s][it->first] = off;
       mp.erase(it);
     }

@@ -272,11 +272,14 @@ class PSClient:
 
     def push_dense_delta(self, table_id, delta: np.ndarray, server=0):
         """Geo-async dense: merge a local delta into the server's params;
-        returns the merged params (one round trip)."""
+        returns the merged params (one round trip). Never retried: the
+        additive merge is not idempotent — a reconnect retry could apply
+        the delta twice and silently offset the shared params."""
         d = delta.reshape(-1).astype(np.float32)
         with self._lock:
             resp = self._request(server, struct.pack(
-                "<BII", DENSE_ADD, table_id, d.size) + d.tobytes())
+                "<BII", DENSE_ADD, table_id, d.size) + d.tobytes(),
+                retry=False)
         (n,) = struct.unpack("<I", resp[:4])
         return np.frombuffer(resp[4:], np.float32)[:n]
 

@@ -93,11 +93,30 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
 
 
 def matmul(x, y):
-    """sparse @ dense."""
+    """sparse @ dense — BCOO dot_general, no densification."""
     if isinstance(x, SparseTensor):
         yd = as_tensor(y)._data
         return Tensor(x._bcoo @ yd)
     raise TypeError("sparse.matmul expects a SparseTensor lhs")
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector."""
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated ONLY at `mask`'s nonzero positions
+    (reference sparse.masked_matmul / SDDMM): out is sparse with mask's
+    pattern. Computes a gathered row·col dot per nonzero — O(nnz·k), not
+    O(n·m·k)."""
+    xd = as_tensor(x)._data
+    yd = as_tensor(y)._data
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows = xd[idx[:, 0], :]          # [nnz, k]
+    cols = yd[:, idx[:, 1]].T        # [nnz, k]
+    vals = jnp.sum(rows * cols, axis=-1).astype(xd.dtype)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
 
 
 def add(x, y):
@@ -106,5 +125,112 @@ def add(x, y):
     raise TypeError("sparse.add expects SparseTensors")
 
 
+def _unary_on_values(fn, x: "SparseTensor") -> "SparseTensor":
+    """Value-space op: touches only the nnz values (real sparse compute,
+    like the reference's sparse unary kernels
+    `paddle/phi/kernels/sparse/unary_kernel.h`)."""
+    b = x._bcoo
+    return SparseTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                     shape=b.shape))
+
+
+def relu(x):
+    return _unary_on_values(lambda v: jnp.maximum(v, 0), x)
+
+
+def sin(x):
+    return _unary_on_values(jnp.sin, x)
+
+
+def tanh(x):
+    return _unary_on_values(jnp.tanh, x)
+
+
+def sqrt(x):
+    return _unary_on_values(jnp.sqrt, x)
+
+
+def abs(x):  # noqa: A001 - paddle API name
+    return _unary_on_values(jnp.abs, x)
+
+
+def neg(x):
+    return _unary_on_values(jnp.negative, x)
+
+
+def pow(x, factor):  # noqa: A001 - paddle API name
+    return _unary_on_values(lambda v: jnp.power(v, factor), x)
+
+
+def scale(x, scale_, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return _unary_on_values(lambda v: v * scale_ + bias, x)
+    return _unary_on_values(lambda v: (v + bias) * scale_, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtype as dtype_mod
+    b = x._bcoo
+    vals = b.data if value_dtype is None else \
+        b.data.astype(dtype_mod.convert_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(dtype_mod.convert_dtype(index_dtype))
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+def multiply(x, y):
+    """elementwise sparse*sparse (same pattern) or sparse*scalar."""
+    if isinstance(y, (int, float)):
+        return _unary_on_values(lambda v: v * y, x)
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return SparseTensor(jsparse.bcoo_multiply_sparse(x._bcoo,
+                                                         y._bcoo))
+    raise TypeError("sparse.multiply expects sparse operands or a scalar")
+
+
+def transpose(x, perm):
+    return SparseTensor(jsparse.bcoo_transpose(x._bcoo,
+                                               permutation=tuple(perm)))
+
+
+def coalesce(x):
+    """Sum duplicate coordinates (reference CoalesceKernel)."""
+    return SparseTensor(jsparse.bcoo_sum_duplicates(x._bcoo))
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over the SPARSE pattern only (2-D COO; the
+    reference's sparse softmax semantics: missing entries are -inf, i.e.
+    excluded), via segment max/sum over the row index — O(nnz)."""
+    b = x._bcoo
+    if len(b.shape) != 2 or axis not in (-1, 1):
+        raise NotImplementedError("sparse.softmax: 2-D, last axis only")
+    rows = b.indices[:, 0]
+    n_rows = b.shape[0]
+    rmax = jax.ops.segment_max(b.data, rows, num_segments=n_rows)
+    e = jnp.exp(b.data - rmax[rows])
+    rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    return SparseTensor(jsparse.BCOO((e / rsum[rows], b.indices),
+                                     shape=b.shape))
+
+
 def is_sparse(x):
     return isinstance(x, SparseTensor)
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _SparseSoftmax:
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        return softmax(x, self.axis)
+
+
+class nn:  # namespace shim: paddle.sparse.nn.ReLU()/Softmax()
+    ReLU = _SparseReLU
+    Softmax = _SparseSoftmax

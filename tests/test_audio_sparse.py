@@ -68,3 +68,58 @@ def test_audio_functional():
     assert fb.shape == [40, 257]
     w = AF.get_window("hann", 400)
     assert w.shape == [400]
+
+
+def test_sparse_value_space_ops():
+    """Real sparse compute: value-space unary ops touch only nnz values
+    (no densification), patterns preserved."""
+    import paddle_tpu.sparse as sp
+    idx = [[0, 1, 1], [2, 0, 2]]
+    vals = [3.0, -4.0, 0.25]
+    x = sp.sparse_coo_tensor(idx, vals, shape=[2, 3])
+    r = sp.relu(x)
+    assert r.nnz() == 3
+    np.testing.assert_allclose(r.values().numpy(), [3.0, 0.0, 0.25])
+    np.testing.assert_allclose(sp.neg(x).values().numpy(),
+                               [-3.0, 4.0, -0.25])
+    np.testing.assert_allclose(
+        sp.scale(x, 2.0, 1.0).values().numpy(), [7.0, -7.0, 1.5])
+    t = sp.transpose(x, [1, 0])
+    assert t.shape == [3, 2]
+    np.testing.assert_allclose(t.to_dense().numpy(),
+                               x.to_dense().numpy().T)
+
+
+def test_sparse_softmax_pattern_only():
+    import paddle_tpu.sparse as sp
+    x = sp.sparse_coo_tensor([[0, 0, 1], [0, 2, 1]], [1.0, 2.0, 5.0],
+                             shape=[2, 3])
+    s = sp.softmax(x)
+    v = s.values().numpy()
+    # row 0 has two entries softmaxed together; row 1 single entry -> 1.0
+    np.testing.assert_allclose(v[0] + v[1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+    # missing entries stay missing (excluded, not densified)
+    assert s.nnz() == 3
+
+
+def test_sparse_masked_matmul():
+    import paddle_tpu.sparse as sp
+    rng = np.random.RandomState(0)
+    a = rng.rand(4, 5).astype(np.float32)
+    b = rng.rand(5, 3).astype(np.float32)
+    mask = sp.sparse_coo_tensor([[0, 2, 3], [1, 0, 2]], [1.0, 1.0, 1.0],
+                                shape=[4, 3])
+    out = sp.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+    dense = a @ b
+    np.testing.assert_allclose(
+        out.values().numpy(),
+        [dense[0, 1], dense[2, 0], dense[3, 2]], rtol=1e-5)
+
+
+def test_sparse_multiply_and_coalesce():
+    import paddle_tpu.sparse as sp
+    x = sp.sparse_coo_tensor([[0, 0], [1, 1]], [2.0, 3.0], shape=[2, 2])
+    c = sp.coalesce(x)  # duplicate (0,1) entries sum
+    assert c.nnz() <= 2
+    np.testing.assert_allclose(c.to_dense().numpy(), [[0, 5], [0, 0]])

@@ -150,3 +150,30 @@ def test_text_datasets():
     assert x.shape == (64,) and y.shape == (1,)
     h = UCIHousing(mode="test")
     assert len(h) == 102
+
+
+def test_string_tensor_and_kernels():
+    """phi::StringTensor + strings_lower/upper kernel parity."""
+    st = paddle.strings.to_string_tensor([["Hello World", "ÄÖÜ"],
+                                          ["MiXeD", "déjà VU"]])
+    assert st.shape == [2, 2]
+    low = paddle.strings.lower(st)
+    assert low.tolist() == [["hello world", "äöü"], ["mixed", "déjà vu"]]
+    up = paddle.strings.upper(st)
+    assert up.tolist()[0][0] == "HELLO WORLD"
+
+
+def test_faster_tokenizer():
+    """faster_tokenizer capability: StringTensor -> padded int32 ids."""
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+             "hello": 4, "world": 5, "deep": 6, "##er": 7, "learn": 8,
+             "##ing": 9}
+    tok = paddle.strings.FasterTokenizer(vocab)
+    st = paddle.strings.to_string_tensor(
+        ["Hello world", "deeper learning wat"])
+    ids, lens = tok(st)
+    assert ids.shape == [2, 7]
+    np.testing.assert_array_equal(ids.numpy()[0], [2, 4, 5, 3, 0, 0, 0])
+    # "deeper" -> deep ##er ; "learning" -> learn ##ing ; "wat" -> UNK
+    np.testing.assert_array_equal(ids.numpy()[1], [2, 6, 7, 8, 9, 1, 3])
+    np.testing.assert_array_equal(lens.numpy(), [4, 7])

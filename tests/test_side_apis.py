@@ -82,3 +82,26 @@ def test_resnet50_forward():
     net.eval()
     out = net(paddle.randn([1, 3, 64, 64]))
     assert out.shape == [1, 10]
+
+
+def test_elastic_tcp_store_seam():
+    """The elastic Store seam has two real transports: FileStore and a
+    TCP KV master (the reference's etcd/HTTP master role)."""
+    import time
+    from paddle_tpu.parallel.elastic import (KVMasterServer, TcpStore,
+                                             make_store)
+
+    master = KVMasterServer(port=0).start()
+    try:
+        a = TcpStore("127.0.0.1", master.port)
+        b = make_store(f"tcp://127.0.0.1:{master.port}")
+        a.put("k", {"v": 1})
+        assert b.get("k") == {"v": 1}
+        a.heartbeat("node0")
+        b.heartbeat("node1")
+        assert b.alive_nodes(timeout=30) == ["node0", "node1"]
+        # stale heartbeat expires
+        a.put("heartbeat_node0", {"ts": time.time() - 1000})
+        assert b.alive_nodes(timeout=30) == ["node1"]
+    finally:
+        master.stop()
