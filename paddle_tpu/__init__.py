@@ -11,6 +11,8 @@ from .core.dtype import (
     finfo, iinfo,
 )
 from .core.tensor import Tensor, Parameter
+from .core.lod import (LoDTensor, create_lod_tensor,  # noqa: F401
+                       sequence_pool)
 from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled
 from .core.place import (
     CPUPlace, TPUPlace, CUDAPlace, set_device, get_device,

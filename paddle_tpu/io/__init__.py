@@ -264,10 +264,12 @@ _SHM_MIN_BYTES = 1 << 16
 
 
 def _strip_tensors(obj):
-    """Tensor -> numpy for IPC; structure preserved."""
+    """Tensor -> numpy for IPC; structure (incl. tuple-ness) preserved."""
     if isinstance(obj, Tensor):
         return np.asarray(obj.numpy())
-    if isinstance(obj, (list, tuple)):
+    if isinstance(obj, tuple):
+        return tuple(_strip_tensors(o) for o in obj)
+    if isinstance(obj, list):
         return [_strip_tensors(o) for o in obj]
     if isinstance(obj, dict):
         return {k: _strip_tensors(v) for k, v in obj.items()}
@@ -282,6 +284,9 @@ def _to_shm(obj, shms):
         np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
         shms.append(shm)
         return ("__shm__", shm.name, obj.shape, str(obj.dtype))
+    if isinstance(obj, tuple):
+        # wrap user tuples so they can't collide with the shm marker
+        return ("__tuple__", [_to_shm(o, shms) for o in obj])
     if isinstance(obj, list):
         return [_to_shm(o, shms) for o in obj]
     if isinstance(obj, dict):
@@ -298,6 +303,8 @@ def _from_shm(obj):
         shm.close()
         shm.unlink()
         return Tensor(arr)
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tuple__":
+        return tuple(_from_shm(o) for o in obj[1])
     if isinstance(obj, np.ndarray):
         return Tensor(obj)
     if isinstance(obj, list):
@@ -318,7 +325,7 @@ def _release_shm(obj):
         except (FileNotFoundError, OSError):
             pass
         return
-    if isinstance(obj, list):
+    if isinstance(obj, (list, tuple)):
         for o in obj:
             _release_shm(o)
     elif isinstance(obj, dict):

@@ -151,6 +151,22 @@ _SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
                  ast.If, ast.For, ast.While, ast.Pass)
 
 
+def _mark_generated(stmts):
+    for s in stmts:
+        s._dy2s_generated = True
+    return stmts
+
+
+class _RenameVar(ast.NodeTransformer):
+    def __init__(self, old, new):
+        self.old, self.new = old, new
+
+    def visit_Name(self, node):
+        if node.id == self.old and isinstance(node.ctx, ast.Load):
+            return ast.copy_location(_name(self.new), node)
+        return node
+
+
 def _assigned_names(stmts):
     """Names (re)bound anywhere in these statements, not descending into
     nested function/class definitions."""
@@ -172,7 +188,11 @@ def _assigned_names(stmts):
 
 
 def _transformable(stmts):
-    return all(isinstance(s, _SIMPLE_STMTS) for s in stmts)
+    # statements this transformer itself generated (UNDEF preambles,
+    # branch helper defs, _jst calls) are always acceptable — without
+    # this, an already-rewritten inner `elif` blocks the outer `if`
+    return all(isinstance(s, _SIMPLE_STMTS)
+               or getattr(s, "_dy2s_generated", False) for s in stmts)
 
 
 def _name(id_, ctx=None):
@@ -257,7 +277,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                         keywords=[])
         stmts = [_undef_preamble(n) for n in outs]
         stmts += [tdef, fdef, _assign_tuple(outs, call)]
-        return stmts
+        return _mark_generated(stmts)
 
     def _loop_helpers(self, loop_vars, body_stmts, test_expr, uid):
         cname, bname = f"__dy2s_cond_{uid}", f"__dy2s_body_{uid}"
@@ -311,7 +331,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         uid = self._uid()
         stmts = [_undef_preamble(n) for n in loop_vars]
         stmts += self._loop_helpers(loop_vars, body, test, uid)
-        return stmts
+        return _mark_generated(stmts)
 
     def visit_For(self, node):
         self.generic_visit(node)
@@ -336,6 +356,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                         args=[_name(ctr), _name(stop_v), _name(step_v)],
                         keywords=[])
         body, test = self._fold_leading_break(node.body, test)
+        # the folded break test runs in the loop CONDITION, where the
+        # user's variable still holds the previous iteration's value —
+        # the internal counter is the current one, so reads of the loop
+        # var inside the folded test must use the counter
+        test = _RenameVar(i, ctr).visit(test)
         if not _transformable(body):
             return node
         set_user = ast.Assign(targets=[_name(i, ast.Store())],
@@ -354,7 +379,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         stmts += [_undef_preamble(n) for n in loop_vars
                   if n not in (ctr, i)]
         stmts += self._loop_helpers(loop_vars, body, test, uid)
-        return stmts
+        return _mark_generated(stmts)
 
 
 _cache = {}

@@ -12,6 +12,7 @@ from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
+from .extras2 import *  # noqa: F401,F403
 
 from . import creation, math, logic, manipulation, linalg, random_ops  # noqa
 

@@ -235,3 +235,10 @@ def multi_dot(tensors, name=None):
     ts = [as_tensor(t) for t in tensors]
     return dispatch.apply(
         "multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(ts))
+
+
+# round-2 additions living in extras2 but belonging to paddle.linalg
+from .extras2 import (  # noqa: F401,E402
+    cholesky_solve, corrcoef, cov, eig, eigvals, lstsq, lu_unpack,
+    cond_number as cond,
+)

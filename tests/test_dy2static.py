@@ -196,3 +196,41 @@ def test_for_loop_var_keeps_python_semantics():
     eager = float(net(x))  # 1 + 2 (last iterated i)
     sf = paddle.jit.to_static(net.forward)
     assert abs(float(sf(x)) - eager) < 1e-6, (float(sf(x)), eager)
+
+
+def test_elif_chain_compiles():
+    class M(nn.Layer):
+        def forward(self, x):
+            y = x
+            if x.sum() > 0:
+                y = x * 2.0
+            elif x.sum() < -5.0:
+                y = x * 3.0
+            else:
+                y = x - 1.0
+            return y
+
+    net = M()
+    sf = paddle.jit.to_static(net.forward)
+    for v in (1.0, -10.0, -0.5):
+        x = paddle.to_tensor(np.full((2,), v, np.float32))
+        eager = net(x).numpy()
+        np.testing.assert_allclose(sf(x).numpy(), eager, rtol=1e-6)
+
+
+def test_for_break_reads_loop_var():
+    class M(nn.Layer):
+        def forward(self, x):
+            acc = x * 0.0
+            i = 0
+            for i in range(5):
+                if i > 2:  # reads the CURRENT i, python semantics
+                    break
+                acc = acc + x
+            return acc
+
+    net = M()
+    x = paddle.to_tensor([1.0])
+    assert float(net(x)) == 3.0  # eager python
+    sf = paddle.jit.to_static(net.forward)
+    assert float(sf(x)) == 3.0, float(sf(x))

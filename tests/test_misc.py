@@ -242,3 +242,24 @@ def test_multiprocess_dataloader_worker_error_propagates():
 
     with pytest.raises(RuntimeError, match="boom"):
         list(DataLoader(Bad(), batch_size=4, num_workers=2))
+
+
+def test_multiprocess_dataloader_tuple_collate():
+    """Batch structure (tuple-ness) must not depend on num_workers."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    xs = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+    ys = np.arange(32, dtype=np.int64).reshape(32, 1)
+    ds = TensorDataset([xs, ys])
+
+    def tuple_collate(batch):
+        from paddle_tpu.io import default_collate_fn
+        out = default_collate_fn(batch)
+        return tuple(out)
+
+    b0 = next(iter(DataLoader(ds, batch_size=8, num_workers=0,
+                              collate_fn=tuple_collate)))
+    b2 = next(iter(DataLoader(ds, batch_size=8, num_workers=2,
+                              collate_fn=tuple_collate)))
+    assert type(b0) is tuple and type(b2) is tuple
+    np.testing.assert_array_equal(b0[0].numpy(), b2[0].numpy())

@@ -1,5 +1,6 @@
 """Model family tests: GPT, BERT (+LAMB), ResNet AMP (BASELINE configs)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -101,3 +102,29 @@ def test_gpt_compiled_model_fit():
     model.fit(TensorDataset([tok, tok]), epochs=1, batch_size=8,
               verbose=0)
     assert model._jit_ok
+
+
+@pytest.mark.parametrize("ctor,size,nc", [
+    ("densenet121", 64, 10),
+    ("shufflenet_v2_x0_25", 64, 10),
+    ("googlenet", 96, 10),
+    ("inception_v3", 299, 10),
+    ("mobilenet_v3_small", 64, 10),
+])
+def test_new_vision_models_forward(ctor, size, nc):
+    import paddle_tpu.vision.models as M
+    net = getattr(M, ctor)(num_classes=nc)
+    net.eval()
+    x = paddle.randn([2, 3, size, size])
+    out = net(x)
+    out = out[0] if isinstance(out, (tuple, list)) else out
+    assert out.shape == [2, nc]
+
+
+def test_googlenet_train_aux_heads():
+    import paddle_tpu.vision.models as M
+    net = M.googlenet(num_classes=10)
+    net.train()
+    out, aux1, aux2 = net(paddle.randn([2, 3, 96, 96]))
+    assert out.shape == [2, 10] and aux1.shape == [2, 10] \
+        and aux2.shape == [2, 10]

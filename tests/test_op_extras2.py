@@ -1,0 +1,168 @@
+"""Round-2 op batch — numpy oracle (reference OpTest strategy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def test_elementwise_batch():
+    np.testing.assert_allclose(
+        paddle.lerp(t([0.0, 4.0]), t([10.0, 8.0]), 0.5).numpy(), [5, 6])
+    x = np.array([0.2, 0.8], np.float32)
+    np.testing.assert_allclose(paddle.logit(t(x)).numpy(),
+                               np.log(x / (1 - x)), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.stanh(t([0.5])).numpy(),
+        1.7159 * np.tanh(0.67 * 0.5), rtol=1e-6)
+    np.testing.assert_array_equal(
+        paddle.gcd(t([12, 18]), t([8, 24])).numpy(), [4, 6])
+    np.testing.assert_array_equal(
+        paddle.lcm(t([4, 6]), t([6, 8])).numpy(), [12, 24])
+    np.testing.assert_allclose(paddle.sgn(t([-2.0, 0.0, 5.0])).numpy(),
+                               [-1, 0, 1])
+
+
+def test_nan_aware():
+    x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+    np.testing.assert_allclose(paddle.nansum(t(x)).numpy(), 13.0)
+    np.testing.assert_allclose(paddle.nanmean(t(x), axis=1).numpy(),
+                               [2.0, 4.5])
+    np.testing.assert_allclose(paddle.nanmedian(t(x)).numpy(), 3.5)
+
+
+def test_complex_family():
+    c = paddle.complex(t([1.0]), t([2.0]))
+    assert paddle.is_complex(c)
+    np.testing.assert_allclose(paddle.real(c).numpy(), [1.0])
+    np.testing.assert_allclose(paddle.imag(c).numpy(), [2.0])
+    np.testing.assert_allclose(paddle.conj(c).numpy(), [1 - 2j])
+    np.testing.assert_allclose(paddle.angle(c).numpy(),
+                               [np.angle(1 + 2j)], rtol=1e-6)
+    ar = paddle.as_real(c)
+    np.testing.assert_allclose(ar.numpy(), [[1.0, 2.0]])
+    np.testing.assert_allclose(paddle.as_complex(ar).numpy(), [1 + 2j])
+    assert paddle.is_floating_point(t([1.0]))
+    assert paddle.is_integer(t([1]))
+    assert paddle.is_tensor(t([1]))
+    assert int(paddle.rank(t(np.zeros((2, 3))))) == 2
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_linalg_batch():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 2).astype(np.float32)
+    i = rng.rand(3, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.addmm(t(i), t(a), t(b), beta=0.5, alpha=2.0).numpy(),
+        0.5 * i + 2.0 * (a @ b), rtol=1e-5)
+    v = rng.rand(4).astype(np.float32)
+    np.testing.assert_allclose(paddle.mv(t(a), t(v)).numpy(), a @ v,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.tensordot(t(a), t(b), axes=1).numpy(), a @ b, rtol=1e-5)
+    x = rng.rand(3, 10).astype(np.float32)
+    np.testing.assert_allclose(paddle.linalg.cov(t(x)).numpy(),
+                               np.cov(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.corrcoef(t(x)).numpy(),
+                               np.corrcoef(x), rtol=1e-4)
+    m = rng.rand(4, 4).astype(np.float32)
+    w, vv = paddle.linalg.eig(t(m))
+    np.testing.assert_allclose(
+        np.sort(w.numpy().real), np.sort(np.linalg.eigvals(m).real),
+        rtol=1e-4)
+    spd = (m @ m.T + 4 * np.eye(4)).astype(np.float32)
+    chol = np.linalg.cholesky(spd).astype(np.float32)
+    rhs = rng.rand(4, 2).astype(np.float32)
+    got = paddle.linalg.cholesky_solve(t(rhs), t(chol)).numpy()
+    np.testing.assert_allclose(got, np.linalg.solve(spd, rhs), rtol=1e-3)
+    sol, _, _, _ = paddle.linalg.lstsq(t(a), t(i))
+    np.testing.assert_allclose(sol.numpy(),
+                               np.linalg.lstsq(a, i, rcond=None)[0],
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_selection_batch():
+    x = np.array([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], np.float32)
+    v, i = paddle.kthvalue(t(x), 2)
+    np.testing.assert_allclose(v.numpy(), [2.0, 8.0])
+    vals, idxs = paddle.mode(t(np.array([[1, 2, 2, 3]])))
+    np.testing.assert_array_equal(vals.numpy(), [2])
+    np.testing.assert_array_equal(idxs.numpy(), [2])
+    taken = paddle.take(t(x), t(np.array([[0, 5]])))
+    np.testing.assert_allclose(taken.numpy(), [[3.0, 8.0]])
+    out = paddle.index_add(t(np.zeros((3, 2), np.float32)),
+                           t(np.array([0, 2])), 0,
+                           t(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [[1, 1], [0, 0], [1, 1]])
+    cands = [t(np.full((2, 2), v, np.float32)) for v in (10.0, 20.0)]
+    sel = paddle.multiplex(cands, t(np.array([[1], [0]])))
+    np.testing.assert_allclose(sel.numpy(), [[20, 20], [10, 10]])
+
+
+def test_manipulation_batch():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    c = paddle.crop(t(x), shape=[2, 3], offsets=[1, 2])
+    np.testing.assert_allclose(c.numpy(), x[1:3, 2:5])
+    d = paddle.diagflat(t(np.array([1.0, 2.0])))
+    np.testing.assert_allclose(d.numpy(), np.diagflat([1.0, 2.0]))
+    filled = paddle.fill_diagonal_tensor(
+        t(np.zeros((3, 3), np.float32)), t(np.array([1.0, 2.0, 3.0])))
+    np.testing.assert_allclose(np.diag(filled.numpy()), [1, 2, 3])
+    parts = paddle.unstack(t(x), axis=0)
+    assert len(parts) == 4
+    np.testing.assert_allclose(parts[2].numpy(), x[2])
+    ti = paddle.tril_indices(3)
+    np.testing.assert_array_equal(ti.numpy(),
+                                  np.stack(np.tril_indices(3)))
+    r = paddle.renorm(t(np.array([[3.0, 4.0], [6.0, 8.0]])), p=2.0,
+                      axis=0, max_norm=5.0)
+    norms = np.linalg.norm(r.numpy(), axis=1)
+    assert norms[0] <= 5.01 and norms[1] <= 5.01
+
+
+def test_creation_and_array():
+    ls = paddle.logspace(0, 2, 3)
+    np.testing.assert_allclose(ls.numpy(), [1, 10, 100], rtol=1e-5)
+    g = paddle.gaussian([1000], mean=1.0, std=0.1)
+    assert abs(float(g.numpy().mean()) - 1.0) < 0.02
+    arr = paddle.create_array()
+    paddle.array_write(t([1.0]), 0, arr)
+    paddle.array_write(t([2.0]), 1, arr)
+    assert int(paddle.array_length(arr)) == 2
+    np.testing.assert_allclose(paddle.array_read(arr, 1).numpy(), [2.0])
+
+
+def test_grad_through_new_ops():
+    x = paddle.to_tensor([0.3, 0.6], stop_gradient=False)
+    y = paddle.lerp(x, paddle.to_tensor([1.0, 1.0]), 0.5).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.5, 0.5])
+
+
+def test_review_regressions():
+    # crop -1 means dims[i]-offsets[i]; shape=None keeps to-the-end
+    x = np.arange(10, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.crop(t(x), shape=[-1], offsets=[2]).numpy(), x[2:])
+    np.testing.assert_allclose(
+        paddle.crop(t(x), offsets=[3]).numpy(), x[3:])
+    # take raise-mode supports python-style negative indices
+    np.testing.assert_allclose(
+        paddle.take(t(np.array([1.0, 2.0, 3.0])),
+                    t(np.array([-1]))).numpy(), [3.0])
+    # lerp weight carries gradient
+    import paddle_tpu as p
+    w = p.to_tensor([0.5, 0.5], stop_gradient=False)
+    xx = p.to_tensor([0.0, 0.0])
+    yy = p.to_tensor([2.0, 4.0])
+    p.lerp(xx, yy, w).sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [2.0, 4.0])
+    # gaussian nonzero seed reproducible
+    a = paddle.gaussian([4], seed=42).numpy()
+    b = paddle.gaussian([4], seed=42).numpy()
+    np.testing.assert_array_equal(a, b)

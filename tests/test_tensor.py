@@ -131,3 +131,35 @@ def test_einsum():
     out = paddle.einsum("ij,jk->ik", a, b)
     np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(),
                                rtol=1e-5)
+
+
+def test_lod_tensor_roundtrip():
+    """lod_tensor.h parity: (data, offsets) <-> padded+mask; segment
+    reductions run the sequence_pool role."""
+    from paddle_tpu.core.lod import from_padded
+
+    data = np.arange(12, dtype=np.float32).reshape(6, 2)
+    t = paddle.create_lod_tensor(data, [[3, 1, 2]])
+    assert t.lod() == [[0, 3, 4, 6]]
+    assert t.recursive_sequence_lengths() == [[3, 1, 2]]
+    assert t.sequence_count() == 3
+    padded, lens = t.to_padded()
+    assert padded.shape == [3, 3, 2]
+    np.testing.assert_array_equal(lens.numpy(), [3, 1, 2])
+    np.testing.assert_allclose(padded.numpy()[1, 0], data[3])
+    np.testing.assert_allclose(padded.numpy()[1, 1], 0.0)
+    back = from_padded(padded, lens)
+    np.testing.assert_allclose(back.numpy(), data)
+    assert back.lod() == [[0, 3, 4, 6]]
+
+
+def test_lod_sequence_pool():
+    data = np.array([[1.0], [2.0], [3.0], [10.0], [4.0], [6.0]],
+                    np.float32)
+    t = paddle.create_lod_tensor(data, [[3, 1, 2]])
+    np.testing.assert_allclose(
+        paddle.sequence_pool(t, "sum").numpy(), [[6.0], [10.0], [10.0]])
+    np.testing.assert_allclose(
+        paddle.sequence_pool(t, "mean").numpy(), [[2.0], [10.0], [5.0]])
+    np.testing.assert_allclose(
+        paddle.sequence_pool(t, "max").numpy(), [[3.0], [10.0], [6.0]])
