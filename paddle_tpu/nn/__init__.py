@@ -41,6 +41,13 @@ from .layers.rnn import (  # noqa: F401
     RNNCellBase, LSTMCell, GRUCell, SimpleRNNCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,
 )
+from .layers.extras import (  # noqa: F401
+    Bilinear, CTCLoss, ChannelShuffle, Fold, Unfold, HSigmoidLoss,
+    LayerDict, MaxUnPool1D, MaxUnPool2D, MultiLabelSoftMarginLoss,
+    PairwiseDistance, PixelUnshuffle, RReLU, SoftMarginLoss, Softmax2D,
+    ThresholdedReLU, TripletMarginWithDistanceLoss,
+    UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
+)
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

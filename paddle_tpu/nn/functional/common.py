@@ -168,6 +168,40 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return unary("unfold", _fn, x)
 
 
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """col2im — inverse of unfold: overlapping patches scatter-ADD back
+    (`paddle/phi/kernels/funcs/im2col.h` col2im path)."""
+    x = as_tensor(x)
+
+    def _to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    o = _to2(output_sizes)
+    k, s, p, d = _to2(kernel_sizes), _to2(strides), _to2(paddings), \
+        _to2(dilations)
+
+    def _fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        out_h = (o[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        out_w = (o[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        col = a.reshape(n, c, k[0] * k[1], out_h, out_w)
+        out = jnp.zeros((n, c, o[0] + 2 * p[0], o[1] + 2 * p[1]),
+                        a.dtype)
+        pos = 0
+        for i in range(k[0]):
+            for j in range(k[1]):
+                di, dj = i * d[0], j * d[1]
+                out = out.at[:, :, di:di + out_h * s[0]:s[0],
+                             dj:dj + out_w * s[1]:s[1]].add(
+                    col[:, :, pos])
+                pos += 1
+        return out[:, :, p[0]:p[0] + o[0], p[1]:p[1] + o[1]]
+
+    return unary("fold", _fn, x)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):

@@ -253,7 +253,38 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (optax.ctc_loss wrapper)")
+    """CTC (warpctc kernel parity). log_probs [T,B,C] time-major
+    unnormalized logits (softmax applied internally, like warpctc);
+    labels [B,L]; lengths [B]. Alpha-recursion runs on device via
+    optax.ctc_loss."""
+    import optax
+    from ...core import dispatch
+
+    log_probs = as_tensor(log_probs)
+    labels = as_tensor(labels)
+    ilen = as_tensor(input_lengths)
+    llen = as_tensor(label_lengths)
+
+    def _fn(lp, lab, il, ll):
+        logits = jnp.swapaxes(lp, 0, 1)              # [B,T,C]
+        B, T, _ = logits.shape
+        L = lab.shape[1]
+        t_idx = jnp.arange(T)[None, :]
+        logit_pad = (t_idx >= il[:, None]).astype(jnp.float32)
+        l_idx = jnp.arange(L)[None, :]
+        label_pad = (l_idx >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                                 blank_id=blank)
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(il.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference semantics: each sequence's loss is normalized by
+            # its label length before averaging (warpctc convention)
+            per_seq = per_seq / jnp.maximum(ll.astype(jnp.float32), 1.0)
+        return _reduce_loss(per_seq, reduction)
+
+    return dispatch.apply("ctc_loss", _fn,
+                          (log_probs, labels, ilen, llen))
 
 
 def square_error_cost(input, label):

@@ -73,8 +73,83 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     def init(dt):
         return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
             jnp.iinfo(dt).min
-    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, init,
-                 channel_last=(data_format == "NHWC"), ceil_mode=ceil_mode)
+    if not return_mask:
+        return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+                     init, channel_last=(data_format == "NHWC"),
+                     ceil_mode=ceil_mode)
+    assert data_format == "NCHW" and not ceil_mode, \
+        "return_mask supports NCHW, ceil_mode=False"
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    p = _tuple(padding, 2)
+
+    def _pool_with_mask(a):
+        """One pass producing (pooled max, flat H*W argmax index) — the
+        MaxPoolWithIndex kernel role, feeding max_unpool2d."""
+        n, c, h, w = a.shape
+        av = jnp.pad(a.astype(jnp.float32),
+                     ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                     constant_values=-jnp.inf)
+        iv = jnp.pad(jnp.arange(h * w, dtype=jnp.int32
+                                ).reshape(1, 1, h, w),
+                     ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                     constant_values=-1)
+        oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+        ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+        pv, pi = [], []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                pv.append(av[:, :, i:i + oh * s[0]:s[0],
+                             j:j + ow * s[1]:s[1]])
+                pi.append(iv[:, :, i:i + oh * s[0]:s[0],
+                             j:j + ow * s[1]:s[1]])
+        stacked_v = jnp.stack(pv, axis=2)          # [N,C,K,oh,ow]
+        stacked_i = jnp.stack(pi, axis=2)          # [1,1,K,oh,ow]
+        out = jnp.max(stacked_v, axis=2).astype(a.dtype)
+        am = jnp.argmax(stacked_v, axis=2)[:, :, None]
+        bi = jnp.broadcast_to(stacked_i,
+                              (n, c) + stacked_i.shape[2:])
+        mask = jnp.take_along_axis(bi, am, axis=2)[:, :, 0]
+        return out, mask
+
+    from ...core import dispatch
+    return dispatch.apply("max_pool2d_with_mask", _pool_with_mask,
+                          (as_tensor(x),))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter values back to
+    their argmax positions (`paddle/phi/kernels/unpool_kernel.h`)."""
+    from ...core import dispatch
+    x = as_tensor(x)
+    indices = as_tensor(indices)
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    p = _tuple(padding, 2)
+    n, c, ih, iw = x.shape
+    if output_size is None:
+        if p[0] or p[1]:
+            # the mask addresses the ORIGINAL input plane; the padded
+            # default formula yields a smaller buffer and jax scatter
+            # would silently drop out-of-range maxima
+            raise ValueError(
+                "max_unpool2d with padding>0 needs explicit output_size "
+                "(the pooled-from input's spatial shape)")
+        oh = (ih - 1) * s[0] - 2 * p[0] + k[0]
+        ow = (iw - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        oh, ow = [int(v) for v in output_size[-2:]]
+
+    def _fn(a, idx):
+        flat_v = a.reshape(n * c, ih * iw)
+        flat_i = idx.reshape(n * c, ih * iw).astype(jnp.int32)
+        out = jnp.zeros((n * c, oh * ow), a.dtype)
+        rows = jnp.arange(n * c)[:, None]
+        out = out.at[rows, flat_i].set(flat_v)
+        return out.reshape(n, c, oh, ow)
+
+    return dispatch.apply("max_unpool2d", _fn, (x, indices))
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,

@@ -223,12 +223,20 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
     k = min(m, n)
     L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
     U = jnp.triu(lu[..., :k, :])
-    # pivots (1-based sequential row swaps) -> permutation matrix
-    perm = np.arange(m)
-    for i, p in enumerate(piv.reshape(-1)[:k]):
-        j = int(p) - 1
-        perm[i], perm[j] = perm[j], perm[i]
-    P = np.eye(m, dtype=np.float32)[perm].T
+    # pivots (1-based sequential row swaps) -> permutation matrix,
+    # per batch element
+    batch_shape = lu.shape[:-2]
+    piv2 = piv.reshape(-1, piv.shape[-1]) if batch_shape \
+        else piv.reshape(1, -1)
+    Ps = []
+    for row in piv2:
+        perm = np.arange(m)
+        for i, p in enumerate(row[:k]):
+            j = int(p) - 1
+            perm[i], perm[j] = perm[j], perm[i]
+        Ps.append(np.eye(m, dtype=np.float32)[perm].T)
+    P = np.stack(Ps).reshape(tuple(batch_shape) + (m, m)) \
+        if batch_shape else Ps[0]
     return Tensor(P), Tensor(L), Tensor(U)
 
 
@@ -252,18 +260,18 @@ def cond_number(x, p=None, name=None):
 
 
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
-    def fv(a):
-        s = jnp.sort(a, axis=axis)
-        v = jnp.take(s, k - 1, axis=axis)
-        return jnp.expand_dims(v, axis) if keepdim else v
+    from ..core import dispatch
 
-    def fi(a):
+    def f(a):
+        # one sort yields both: values gathered through argsort
         si = jnp.argsort(a, axis=axis)
         i = jnp.take(si, k - 1, axis=axis)
-        return jnp.expand_dims(i, axis) if keepdim else i
+        v = jnp.take_along_axis(
+            a, jnp.expand_dims(i, axis % a.ndim), axis=axis)
+        v = v if keepdim else jnp.squeeze(v, axis)
+        return v, (jnp.expand_dims(i, axis) if keepdim else i)
 
-    return unary("kthvalue", fv, x), unary("kthvalue_idx", fi, x,
-                                           differentiable=False)
+    return dispatch.apply("kthvalue", f, (as_tensor(x),))
 
 
 def mode(x, axis=-1, keepdim=False, name=None):

@@ -105,8 +105,9 @@ class MobileNetV3(nn.Layer):
             blocks.append(_Block(inp, e, o, k, stride, se, act))
             inp = o
         self.blocks = nn.Sequential(*blocks)
-        last_exp = _make_divisible(
-            (960 if config is _LARGE else 576) * scale)
+        # tail width = last block's expansion width (no identity check:
+        # callers may pass modified configs)
+        last_exp = _make_divisible(config[-1][1] * scale)
         self.tail = _cbn(inp, last_exp, 1, act="hardswish")
         self.with_pool = with_pool
         self.num_classes = num_classes

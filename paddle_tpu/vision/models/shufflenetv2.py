@@ -13,37 +13,38 @@ def _channel_shuffle(x, groups):
     return reshape(x, [n, c, h, w])
 
 
-def _conv_bn_relu(inp, oup, k, stride=1, groups=1, relu=True):
+def _conv_bn_relu(inp, oup, k, stride=1, groups=1, relu=True,
+                  act="relu"):
     pad = k // 2
     layers = [nn.Conv2D(inp, oup, k, stride=stride, padding=pad,
                         groups=groups, bias_attr=False),
               nn.BatchNorm2D(oup)]
     if relu:
-        layers.append(nn.ReLU())
+        layers.append(nn.Swish() if act == "swish" else nn.ReLU())
     return nn.Sequential(*layers)
 
 
 class _InvertedResidual(nn.Layer):
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = oup // 2
         if stride == 1:
             self.branch2 = nn.Sequential(
-                _conv_bn_relu(branch, branch, 1),
+                _conv_bn_relu(branch, branch, 1, act=act),
                 _conv_bn_relu(branch, branch, 3, stride, groups=branch,
                               relu=False),
-                _conv_bn_relu(branch, branch, 1))
+                _conv_bn_relu(branch, branch, 1, act=act))
         else:
             self.branch1 = nn.Sequential(
                 _conv_bn_relu(inp, inp, 3, stride, groups=inp,
                               relu=False),
-                _conv_bn_relu(inp, branch, 1))
+                _conv_bn_relu(inp, branch, 1, act=act))
             self.branch2 = nn.Sequential(
-                _conv_bn_relu(inp, branch, 1),
+                _conv_bn_relu(inp, branch, 1, act=act),
                 _conv_bn_relu(branch, branch, 3, stride, groups=branch,
                               relu=False),
-                _conv_bn_relu(branch, branch, 1))
+                _conv_bn_relu(branch, branch, 1, act=act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -71,19 +72,19 @@ class ShuffleNetV2(nn.Layer):
                  with_pool=True):
         super().__init__()
         chs = _STAGE_OUT[scale]
-        self.conv1 = _conv_bn_relu(3, chs[0], 3, stride=2)
+        self.conv1 = _conv_bn_relu(3, chs[0], 3, stride=2, act=act)
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         inp = chs[0]
         for stage_i, rep in enumerate(_REPEATS):
             oup = chs[stage_i + 1]
-            units = [_InvertedResidual(inp, oup, 2)]
-            units += [_InvertedResidual(oup, oup, 1)
+            units = [_InvertedResidual(inp, oup, 2, act=act)]
+            units += [_InvertedResidual(oup, oup, 1, act=act)
                       for _ in range(rep - 1)]
             stages.append(nn.Sequential(*units))
             inp = oup
         self.stages = nn.Sequential(*stages)
-        self.conv5 = _conv_bn_relu(inp, chs[4], 1)
+        self.conv5 = _conv_bn_relu(inp, chs[4], 1, act=act)
         self.with_pool = with_pool
         self.num_classes = num_classes
         if with_pool:

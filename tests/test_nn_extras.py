@@ -1,0 +1,181 @@
+"""Round-2 nn layer additions — numpy oracle."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def test_bilinear():
+    paddle.seed(0)
+    b = nn.Bilinear(3, 4, 2)
+    x1 = t(np.random.rand(5, 3).astype(np.float32))
+    x2 = t(np.random.rand(5, 4).astype(np.float32))
+    out = b(x1, x2)
+    ref = np.einsum("bi,oij,bj->bo", x1.numpy(), b.weight.numpy(),
+                    x2.numpy()) + b.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_ctc_loss_matches_torch_style_oracle():
+    """Two-frame, tiny-vocab case with a hand-computable answer."""
+    # T=2, B=1, C=3 (blank=0); label = [1]
+    # all paths of length 2 emitting "1": (1,1),(0,1),(1,0)
+    logits = np.log(np.array(
+        [[[0.6, 0.3, 0.1]],
+         [[0.5, 0.4, 0.1]]], np.float32))  # already log-probs-ish
+    lp = t(logits)
+    loss = F.ctc_loss(lp, t(np.array([[1]], np.int32)),
+                      t(np.array([2], np.int32)),
+                      t(np.array([1], np.int32)), reduction="none")
+    # oracle: softmax over our "logits" then sum path probs
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    prob = (p[0, 0, 1] * p[1, 0, 1] + p[0, 0, 0] * p[1, 0, 1]
+            + p[0, 0, 1] * p[1, 0, 0])
+    np.testing.assert_allclose(float(loss), -np.log(prob), rtol=1e-4)
+
+
+def test_ctc_loss_trains():
+    paddle.seed(1)
+    lin = nn.Linear(8, 5)
+    opt = paddle.optimizer.Adam(5e-2, parameters=lin.parameters())
+    rng = np.random.RandomState(0)
+    x = t(rng.rand(6, 2, 8).astype(np.float32))  # [T,B,F]
+    labels = t(np.array([[1, 2], [3, 4]], np.int32))
+    il = t(np.array([6, 6], np.int32))
+    ll = t(np.array([2, 2], np.int32))
+    crit = nn.CTCLoss(blank=0)
+    losses = []
+    for _ in range(30):
+        logits = lin(x)
+        loss = crit(logits, labels, il, ll)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_channel_shuffle_and_pixel_unshuffle():
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)
+    cs = nn.ChannelShuffle(2)(t(x))
+    ref = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(
+        1, 4, 2, 2)
+    np.testing.assert_allclose(cs.numpy(), ref)
+    y = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    pu = nn.PixelUnshuffle(2)(t(y))
+    assert pu.shape == [1, 4, 2, 2]
+    # roundtrip through PixelShuffle
+    ps = nn.PixelShuffle(2)(pu)
+    np.testing.assert_allclose(ps.numpy(), y)
+
+
+def test_fold_unfold_roundtrip():
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    col = F.unfold(t(x), 2, strides=2)
+    assert col.shape == [2, 12, 16]
+    back = F.fold(col, output_sizes=(8, 8), kernel_sizes=2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+def test_max_pool_mask_and_unpool():
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(t(x), 2, stride=2, return_mask=True)
+    assert out.shape == [2, 3, 4, 4] and mask.shape == [2, 3, 4, 4]
+    # mask indexes the flat 8x8 plane at the max position
+    flat = x.reshape(2, 3, 64)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, mask.numpy().reshape(2, 3, 16),
+                           axis=2).reshape(2, 3, 4, 4),
+        out.numpy())
+    un = nn.MaxUnPool2D(2, stride=2)(out, mask)
+    assert un.shape == [2, 3, 8, 8]
+    # unpooled keeps maxima at their original positions, zeros elsewhere
+    np.testing.assert_allclose(un.numpy().max(axis=(2, 3)),
+                               x.max(axis=(2, 3)), rtol=1e-6)
+    assert np.count_nonzero(un.numpy()) == 2 * 3 * 16
+
+
+def test_hsigmoid_loss_trains():
+    paddle.seed(0)
+    hs = nn.HSigmoidLoss(16, num_classes=8)
+    emb = nn.Linear(4, 16)
+    opt = paddle.optimizer.Adam(
+        5e-2, parameters=emb.parameters() + hs.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 4).astype(np.float32)
+    y = rng.randint(0, 8, (32, 1))
+    first = last = None
+    for _ in range(30):
+        loss = hs(emb(t(x)), t(y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first * 0.7, (first, last)
+
+
+def test_small_losses_and_activations():
+    x = t(np.array([[0.5, -1.0]], np.float32))
+    y = t(np.array([[1.0, -1.0]], np.float32))
+    sm = nn.SoftMarginLoss()(x, y)
+    ref = np.log1p(np.exp(-np.array([0.5, 1.0]))).mean()
+    np.testing.assert_allclose(float(sm), ref, rtol=1e-5)
+    ml = nn.MultiLabelSoftMarginLoss()(x, t(np.array([[1.0, 0.0]],
+                                                     np.float32)))
+    assert np.isfinite(float(ml))
+    pd = nn.PairwiseDistance()(t(np.array([[0.0, 0.0]], np.float32)),
+                               t(np.array([[3.0, 4.0]], np.float32)))
+    np.testing.assert_allclose(pd.numpy(), [5.0], rtol=1e-4)
+    tr = nn.ThresholdedReLU(1.0)(t(np.array([0.5, 1.5], np.float32)))
+    np.testing.assert_allclose(tr.numpy(), [0.0, 1.5])
+    s2 = nn.Softmax2D()(t(np.zeros((1, 3, 2, 2), np.float32)))
+    np.testing.assert_allclose(s2.numpy().sum(axis=1),
+                               np.ones((1, 2, 2)), rtol=1e-6)
+    # RReLU eval mode = mean slope
+    rr = nn.RReLU(0.25, 0.25)
+    rr.eval()
+    np.testing.assert_allclose(
+        rr(t(np.array([-4.0, 4.0], np.float32))).numpy(), [-1.0, 4.0])
+    tl = nn.TripletMarginWithDistanceLoss(margin=1.0)
+    a = t(np.zeros((2, 3), np.float32))
+    p = t(np.zeros((2, 3), np.float32))
+    n = t(np.ones((2, 3), np.float32) * 10)
+    assert float(tl(a, p, n)) == 0.0  # far negative -> zero loss
+
+
+def test_upsampling_and_zeropad():
+    x = t(np.ones((1, 1, 2, 2), np.float32))
+    up = nn.UpsamplingNearest2D(scale_factor=2)(x)
+    assert up.shape == [1, 1, 4, 4]
+    ub = nn.UpsamplingBilinear2D(size=[3, 3])(x)
+    assert ub.shape == [1, 1, 3, 3]
+    zp = nn.ZeroPad2D([1, 1, 1, 1])(x)
+    assert zp.shape == [1, 1, 4, 4]
+    assert float(zp.numpy()[0, 0, 0, 0]) == 0.0
+
+
+def test_layer_dict():
+    ld = nn.LayerDict({"a": nn.Linear(2, 2), "b": nn.ReLU()})
+    assert set(ld.keys()) == {"a", "b"}
+    assert len(ld) == 2
+    params = [p for _, p in ld.named_parameters()]
+    assert len(params) == 2  # linear weight+bias
+    del ld["a"]
+    assert len(ld) == 1
+
+
+def test_max_unpool1d():
+    x = np.random.rand(1, 2, 8).astype(np.float32)
+    out, mask = F.max_pool2d(
+        t(x.reshape(1, 2, 1, 8)), (1, 2), stride=(1, 2),
+        return_mask=True)
+    un = nn.MaxUnPool1D(2, stride=2)(
+        paddle.squeeze(out, 2), paddle.squeeze(mask, 2))
+    assert un.shape == [1, 2, 8]
