@@ -71,9 +71,17 @@ def main(epochs=3, batch_size=512, dim=8):
 
 
 def run_bench(batch_size=512, dim=8, n=20000):
-    """bench.py hook: examples/sec through pull -> train -> push after one
-    warmup epoch (eager path with native C++ tables)."""
+    """bench.py hook: examples/sec through pull -> COMPILED dense step ->
+    push after one warmup epoch. The dense model is the framework's own
+    nn stack compiled by jit.CompiledTrainStep (donated buffers, fused
+    Adam) with input_grads=True, whose extra output — the embedding-
+    activation gradient — is pushed back into the C++ tables: the PSGPU
+    pull/train/push cycle with the train leg on the accelerator."""
     import time
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit import CompiledTrainStep
 
     tmp = tempfile.mkdtemp()
     data = make_slot_files(os.path.join(tmp, "part-0.txt"), n=n)
@@ -85,25 +93,38 @@ def run_bench(batch_size=512, dim=8, n=20000):
     rt = get_ps_runtime()
     table = rt.create_sparse_table(0, dim=dim, sgd_rule="adagrad",
                                    learning_rate=0.1)
-    emb = SparseEmbedding(dim=dim, table=table)
-    deep = nn.Sequential(nn.Linear(len(slots) * dim, 64), nn.ReLU(),
-                         nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 1))
-    wide = nn.Linear(len(slots) * dim, 1)
-    opt = paddle.optimizer.Adam(
-        1e-3, parameters=deep.parameters() + wide.parameters())
+    feat = len(slots) * dim
+
+    class WideDeep(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.deep = nn.Sequential(
+                nn.Linear(feat, 64), nn.ReLU(), nn.Linear(64, 32),
+                nn.ReLU(), nn.Linear(32, 1))
+            self.wide = nn.Linear(feat, 1)
+
+        def forward(self, acts):
+            return (self.deep(acts) + self.wide(acts)).reshape([-1])
+
+    net = WideDeep()
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    step = CompiledTrainStep(
+        net, nn.functional.binary_cross_entropy_with_logits, opt,
+        n_labels=1, input_grads=True)
 
     def epoch():
         seen = 0
+        last_loss = None
         for keys, labels in ds:
             bsz = keys.shape[0]
-            acts = emb(keys).reshape([bsz, len(slots) * dim])
-            logits = (deep(acts) + wide(acts)).reshape([bsz])
-            loss = nn.functional.binary_cross_entropy_with_logits(
-                logits, paddle.to_tensor(labels))
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
+            acts = jnp.asarray(
+                table.pull(keys.astype(np.uint64)).reshape(bsz, feat))
+            lab = jnp.asarray(labels, jnp.float32)
+            last_loss, _, (acts_grad,) = step.run(acts, lab)
+            table.push(keys.astype(np.uint64),
+                       acts_grad.numpy().reshape(bsz, len(slots), 1, dim))
             seen += bsz
+        float(jax.device_get(last_loss._data))
         return seen
 
     epoch()  # warmup/compile
