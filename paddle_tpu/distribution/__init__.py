@@ -261,3 +261,216 @@ def kl_divergence(p, q):
         return dispatch.apply("kl_bernoulli", _fn, (p.probs_, q.probs_))
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions
+    (`python/paddle/distribution/exponential_family.py`): entropy via
+    Bregman divergence of the log-normalizer is available when
+    `_natural_parameters`/`_log_normalizer` are defined."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Multinomial(Distribution):
+    """`python/paddle/distribution/multinomial.py`: counts over k
+    categories from `total_count` draws."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = as_tensor(probs, dtype="float32")
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         (self.probs.shape[-1],))
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        key = rng.next_key()
+        draws = jax.random.categorical(
+            key, jnp.log(p), axis=-1,
+            shape=tuple(shape) + (self.total_count,)
+            + tuple(self.probs.shape[:-1]))
+        onehot = jax.nn.one_hot(draws, k)
+        # sum over the draw axis (first of the appended axes)
+        counts = onehot.sum(axis=len(tuple(shape)))
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = as_tensor(value, dtype="float32")._data
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        logc = (jax.scipy.special.gammaln(self.total_count + 1.0)
+                - jax.scipy.special.gammaln(v + 1.0).sum(-1))
+        return Tensor(logc + (v * jnp.log(p)).sum(-1))
+
+    @property
+    def mean(self):
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        return Tensor(self.total_count * p)
+
+    @property
+    def variance(self):
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        return Tensor(self.total_count * p * (1 - p))
+
+
+class Independent(Distribution):
+    """Reinterprets `reinterpreted_batch_rank` trailing batch dims as
+    event dims (`python/paddle/distribution/independent.py`)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        super().__init__(bshape[: len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        arr = lp._data
+        for _ in range(self.rank):
+            arr = arr.sum(-1)
+        return Tensor(arr)
+
+    def entropy(self):
+        e = self.base.entropy()
+        arr = e._data
+        for _ in range(self.rank):
+            arr = arr.sum(-1)
+        return Tensor(arr)
+
+
+# ------------------------------------------------------------ transforms
+
+
+class Transform:
+    """`python/paddle/distribution/transform.py` base: forward/inverse +
+    log|det J|."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self.forward_log_det_jacobian(
+            self.inverse(y))._data)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, dtype="float32")
+        self.scale = as_tensor(scale, dtype="float32")
+
+    def forward(self, x):
+        return Tensor(self.loc._data
+                      + self.scale._data * as_tensor(x)._data)
+
+    def inverse(self, y):
+        return Tensor((as_tensor(y)._data - self.loc._data)
+                      / self.scale._data)
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(jnp.abs(self.scale._data)),
+            as_tensor(x)._data.shape))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return Tensor(jnp.exp(as_tensor(x)._data))
+
+    def inverse(self, y):
+        return Tensor(jnp.log(as_tensor(y)._data))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(as_tensor(x)._data)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return Tensor(jax.nn.sigmoid(as_tensor(x)._data))
+
+    def inverse(self, y):
+        v = as_tensor(y)._data
+        return Tensor(jnp.log(v) - jnp.log1p(-v))
+
+    def forward_log_det_jacobian(self, x):
+        v = as_tensor(x)._data
+        return Tensor(-jax.nn.softplus(-v) - jax.nn.softplus(v))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return Tensor(jnp.tanh(as_tensor(x)._data))
+
+    def inverse(self, y):
+        return Tensor(jnp.arctanh(as_tensor(y)._data))
+
+    def forward_log_det_jacobian(self, x):
+        v = as_tensor(x)._data
+        return Tensor(2.0 * (jnp.log(2.0) - v - jax.nn.softplus(-2 * v)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)._data
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return Tensor(total)
+
+
+class TransformedDistribution(Distribution):
+    """`python/paddle/distribution/transformed_distribution.py`: push a
+    base distribution through a Transform; log_prob via change of
+    variables."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms))
+        super().__init__(tuple(base.batch_shape),
+                         tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)._data
+        ildj = self.transform.forward_log_det_jacobian(x)._data
+        return Tensor(base_lp - ildj)

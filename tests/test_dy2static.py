@@ -234,3 +234,30 @@ def test_for_break_reads_loop_var():
     assert float(net(x)) == 3.0  # eager python
     sf = paddle.jit.to_static(net.forward)
     assert float(sf(x)) == 3.0, float(sf(x))
+
+
+def test_model_fit_with_data_dependent_if_compiles():
+    """Model.fit's compiled trainer also gets the dy2static rewrite: a
+    data-dependent branch must not force the eager fallback."""
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            y = self.fc(x)
+            if y.mean() > 0:   # tensor-valued condition
+                y = y * 1.5
+            else:
+                y = y * 0.5
+            return y
+
+    model = paddle.Model(Branchy())
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    x = np.random.rand(16, 4).astype(np.float32)
+    y = np.random.randint(0, 2, (16, 1))
+    loss0 = model.train_batch([x], [y])
+    assert model._jit_ok, "data-dependent if forced eager fallback"
+    for _ in range(3):
+        model.train_batch([x], [y])

@@ -145,3 +145,23 @@ def test_moe_dispatch_no_dropped_tokens():
     norms = np.asarray(jnp.linalg.norm(out.reshape(B * S, d), axis=-1))
     assert (norms > 1e-6).all(), f"dropped tokens: {np.where(norms < 1e-6)}"
     assert np.isfinite(float(aux))
+
+
+def test_ce_seq_chunks_parity():
+    """Chunked vocab CE (memory knob) must be loss-exact vs unchunked."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 256, (4, 32)), jnp.int32)
+    lab = jnp.asarray(rng.randint(0, 256, (4, 32)), jnp.int32)
+    losses = {}
+    for C in (1, 4):
+        cfg = GPTConfig(vocab_size=256, seq_len=32, d_model=32, n_heads=4,
+                        n_layers=2, dp=1, pp=1, mp=1, micro_batches=1,
+                        remat=False, zero_stage=0,
+                        compute_dtype=jnp.float32, ce_seq_chunks=C)
+        tr = HybridGPT(cfg, devices=[jax.devices()[0]])
+        p, o = tr.init(jax.random.PRNGKey(0))
+        _, _, l = tr.train_step(p, o, tok, lab)
+        losses[C] = float(l)
+    assert abs(losses[1] - losses[4]) < 1e-5, losses

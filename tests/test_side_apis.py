@@ -105,3 +105,44 @@ def test_elastic_tcp_store_seam():
         assert b.alive_nodes(timeout=30) == ["node1"]
     finally:
         master.stop()
+
+
+def test_distribution_round2_additions():
+    import math
+    import paddle_tpu.distribution as D
+
+    # TransformedDistribution: Normal + exp == LogNormal
+    logn = D.TransformedDistribution(D.Normal(0.0, 1.0), D.ExpTransform())
+    v = 2.0
+    got = float(logn.log_prob(paddle.to_tensor([v])))
+    ref = -math.log(v) - 0.5 * math.log(2 * math.pi) \
+        - (math.log(v) ** 2) / 2
+    assert abs(got - ref) < 1e-4
+    s = logn.sample((100,))
+    assert float(s.numpy().min()) > 0  # support is positive
+
+    # Multinomial
+    m = D.Multinomial(10, paddle.to_tensor([0.2, 0.8]))
+    assert float(m.sample().numpy().sum()) == 10
+    lp = float(m.log_prob(paddle.to_tensor([2.0, 8.0])))
+    ref2 = math.log(math.comb(10, 2)) + 2 * math.log(0.2) \
+        + 8 * math.log(0.8)
+    assert abs(lp - ref2) < 1e-3
+    np.testing.assert_allclose(m.mean.numpy(), [2.0, 8.0], rtol=1e-6)
+
+    # Independent folds batch dims into the event
+    ind = D.Independent(
+        D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32)), 1)
+    lp3 = ind.log_prob(paddle.to_tensor([0.0, 0.0, 0.0]))
+    assert lp3.numpy().size == 1 or lp3.numpy().ndim == 0
+    assert abs(float(lp3) - 3 * (-0.5 * math.log(2 * math.pi))) < 1e-4
+
+    # transforms: chain + inverse round trip, tanh/sigmoid jacobians
+    ch = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                           D.SigmoidTransform()])
+    x = paddle.to_tensor([0.3])
+    np.testing.assert_allclose(
+        ch.inverse(ch.forward(x)).numpy(), x.numpy(), rtol=1e-5)
+    th = D.TanhTransform()
+    np.testing.assert_allclose(
+        th.inverse(th.forward(x)).numpy(), x.numpy(), rtol=1e-5)
