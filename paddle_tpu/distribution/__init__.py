@@ -265,9 +265,10 @@ def kl_divergence(p, q):
 
 class ExponentialFamily(Distribution):
     """Base for exponential-family distributions
-    (`python/paddle/distribution/exponential_family.py`): entropy via
-    Bregman divergence of the log-normalizer is available when
-    `_natural_parameters`/`_log_normalizer` are defined."""
+    (`python/paddle/distribution/exponential_family.py`): subclasses
+    defining `_natural_parameters`/`_log_normalizer` get entropy() for
+    free via the Bregman identity H = logZ - <eta, grad logZ> (+ mean
+    carrier measure, assumed 0 as in the reference)."""
 
     @property
     def _natural_parameters(self):
@@ -275,6 +276,21 @@ class ExponentialFamily(Distribution):
 
     def _log_normalizer(self, *natural_params):
         raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [as_tensor(p, dtype="float32")._data
+               for p in self._natural_parameters]
+        logZ, grads = jax.value_and_grad(
+            lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = -self._mean_carrier_measure + logZ
+        for eta, g in zip(nat, grads):
+            ent = ent - jnp.sum(eta * g)
+        return Tensor(ent)
 
 
 class Multinomial(Distribution):
@@ -301,11 +317,17 @@ class Multinomial(Distribution):
         return Tensor(counts)
 
     def log_prob(self, value):
-        v = as_tensor(value, dtype="float32")._data
-        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
-        logc = (jax.scipy.special.gammaln(self.total_count + 1.0)
-                - jax.scipy.special.gammaln(v + 1.0).sum(-1))
-        return Tensor(logc + (v * jnp.log(p)).sum(-1))
+        v = as_tensor(value, dtype="float32")
+        n = float(self.total_count)
+
+        def f(val, pr):
+            pn = pr / pr.sum(-1, keepdims=True)
+            logc = (jax.scipy.special.gammaln(n + 1.0)
+                    - jax.scipy.special.gammaln(val + 1.0).sum(-1))
+            # xlogy: count 0 with prob 0 contributes 0, not 0 * -inf
+            return logc + jax.scipy.special.xlogy(val, pn).sum(-1)
+
+        return dispatch.apply("multinomial_log_prob", f, (v, self.probs))
 
     @property
     def mean(self):
@@ -326,6 +348,10 @@ class Independent(Distribution):
         self.base = base
         self.rank = int(reinterpreted_batch_rank)
         bshape = tuple(base.batch_shape)
+        if not 0 <= self.rank <= len(bshape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} out of range for "
+                f"base batch_shape {bshape}")
         super().__init__(bshape[: len(bshape) - self.rank],
                          bshape[len(bshape) - self.rank:]
                          + tuple(base.event_shape))
@@ -334,18 +360,18 @@ class Independent(Distribution):
         return self.base.sample(shape)
 
     def log_prob(self, value):
+        from .. import ops
         lp = self.base.log_prob(value)
-        arr = lp._data
         for _ in range(self.rank):
-            arr = arr.sum(-1)
-        return Tensor(arr)
+            lp = ops.sum(lp, axis=-1)
+        return lp
 
     def entropy(self):
+        from .. import ops
         e = self.base.entropy()
-        arr = e._data
         for _ in range(self.rank):
-            arr = arr.sum(-1)
-        return Tensor(arr)
+            e = ops.sum(e, axis=-1)
+        return e
 
 
 # ------------------------------------------------------------ transforms
@@ -365,66 +391,78 @@ class Transform:
         raise NotImplementedError
 
     def inverse_log_det_jacobian(self, y):
-        return Tensor(-self.forward_log_det_jacobian(
-            self.inverse(y))._data)
+        return -self.forward_log_det_jacobian(self.inverse(y))
 
     def __call__(self, x):
         return self.forward(x)
 
 
 class AffineTransform(Transform):
+    """All transform math routes through dispatched ops so gradients flow
+    through the tape (MLE on transformed distributions needs d log_prob /
+    d params)."""
+
     def __init__(self, loc, scale):
         self.loc = as_tensor(loc, dtype="float32")
         self.scale = as_tensor(scale, dtype="float32")
 
     def forward(self, x):
-        return Tensor(self.loc._data
-                      + self.scale._data * as_tensor(x)._data)
+        return self.loc + self.scale * as_tensor(x)
 
     def inverse(self, y):
-        return Tensor((as_tensor(y)._data - self.loc._data)
-                      / self.scale._data)
+        return (as_tensor(y) - self.loc) / self.scale
 
     def forward_log_det_jacobian(self, x):
-        return Tensor(jnp.broadcast_to(
-            jnp.log(jnp.abs(self.scale._data)),
-            as_tensor(x)._data.shape))
+        from .. import ops
+        x = as_tensor(x)
+        return ops.log(ops.abs(self.scale)) + x * 0.0
 
 
 class ExpTransform(Transform):
     def forward(self, x):
-        return Tensor(jnp.exp(as_tensor(x)._data))
+        from .. import ops
+        return ops.exp(as_tensor(x))
 
     def inverse(self, y):
-        return Tensor(jnp.log(as_tensor(y)._data))
+        from .. import ops
+        return ops.log(as_tensor(y))
 
     def forward_log_det_jacobian(self, x):
-        return Tensor(as_tensor(x)._data)
+        return as_tensor(x)
 
 
 class SigmoidTransform(Transform):
     def forward(self, x):
-        return Tensor(jax.nn.sigmoid(as_tensor(x)._data))
+        x = as_tensor(x)
+        return dispatch.apply("sigmoid_t", jax.nn.sigmoid, (x,))
 
     def inverse(self, y):
-        v = as_tensor(y)._data
-        return Tensor(jnp.log(v) - jnp.log1p(-v))
+        y = as_tensor(y)
+        return dispatch.apply(
+            "logit_t", lambda v: jnp.log(v) - jnp.log1p(-v), (y,))
 
     def forward_log_det_jacobian(self, x):
-        v = as_tensor(x)._data
-        return Tensor(-jax.nn.softplus(-v) - jax.nn.softplus(v))
+        x = as_tensor(x)
+        return dispatch.apply(
+            "sigmoid_ldj",
+            lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), (x,))
 
 
 class TanhTransform(Transform):
     def forward(self, x):
-        return Tensor(jnp.tanh(as_tensor(x)._data))
+        x = as_tensor(x)
+        return dispatch.apply("tanh_t", jnp.tanh, (x,))
 
     def inverse(self, y):
-        return Tensor(jnp.arctanh(as_tensor(y)._data))
+        y = as_tensor(y)
+        return dispatch.apply("arctanh_t", jnp.arctanh, (y,))
 
     def forward_log_det_jacobian(self, x):
-        v = as_tensor(x)._data
-        return Tensor(2.0 * (jnp.log(2.0) - v - jax.nn.softplus(-2 * v)))
+        x = as_tensor(x)
+        return dispatch.apply(
+            "tanh_ldj",
+            lambda v: 2.0 * (jnp.log(2.0) - v - jax.nn.softplus(-2 * v)),
+            (x,))
 
 
 class ChainTransform(Transform):
@@ -444,10 +482,10 @@ class ChainTransform(Transform):
     def forward_log_det_jacobian(self, x):
         total = None
         for t in self.transforms:
-            j = t.forward_log_det_jacobian(x)._data
+            j = t.forward_log_det_jacobian(x)
             total = j if total is None else total + j
             x = t.forward(x)
-        return Tensor(total)
+        return total
 
 
 class TransformedDistribution(Distribution):
@@ -470,7 +508,12 @@ class TransformedDistribution(Distribution):
         return self.transform.forward(self.base.rsample(shape))
 
     def log_prob(self, value):
+        from .. import ops
         x = self.transform.inverse(value)
-        base_lp = self.base.log_prob(x)._data
-        ildj = self.transform.forward_log_det_jacobian(x)._data
-        return Tensor(base_lp - ildj)
+        base_lp = self.base.log_prob(x)
+        ildj = self.transform.forward_log_det_jacobian(x)
+        # elementwise transforms: reduce the per-element Jacobian over
+        # the base's event dims so it matches base_lp's shape
+        for _ in range(len(self.base.event_shape)):
+            ildj = ops.sum(ildj, axis=-1)
+        return base_lp - ildj
