@@ -284,12 +284,14 @@ class ExponentialFamily(Distribution):
     def entropy(self):
         nat = [as_tensor(p, dtype="float32")._data
                for p in self._natural_parameters]
-        logZ, grads = jax.value_and_grad(
+        # per-element grads via grad-of-sum; entropy stays batch-shaped
+        # (reference reduces nothing beyond the elementwise eta*grad)
+        grads = jax.grad(
             lambda *ns: jnp.sum(self._log_normalizer(*ns)),
             argnums=tuple(range(len(nat))))(*nat)
-        ent = -self._mean_carrier_measure + logZ
+        ent = -self._mean_carrier_measure + self._log_normalizer(*nat)
         for eta, g in zip(nat, grads):
-            ent = ent - jnp.sum(eta * g)
+            ent = ent - eta * g
         return Tensor(ent)
 
 
@@ -413,9 +415,11 @@ class AffineTransform(Transform):
         return (as_tensor(y) - self.loc) / self.scale
 
     def forward_log_det_jacobian(self, x):
-        from .. import ops
-        x = as_tensor(x)
-        return ops.log(ops.abs(self.scale)) + x * 0.0
+        shp = tuple(as_tensor(x).shape)
+        return dispatch.apply(
+            "affine_ldj",
+            lambda s: jnp.broadcast_to(jnp.log(jnp.abs(s)), shp),
+            (self.scale,))
 
 
 class ExpTransform(Transform):

@@ -281,6 +281,15 @@ def _to_shm(obj, shms):
     from multiprocessing import shared_memory
     if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
         shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        # ownership transfers to the parent (which unlinks after copy-out)
+        # — unregister from THIS process's resource tracker, or a worker
+        # exiting before the parent attaches would unlink the segment
+        # out from under it
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
         np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
         shms.append(shm)
         return ("__shm__", shm.name, obj.shape, str(obj.dtype))

@@ -284,3 +284,29 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)
         return self.base_lr + amp
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr = lr * lr_lambda(epoch) applied multiplicatively per epoch
+    (`python/paddle/optimizer/lr.py` MultiplicativeDecay parity)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self):
+        # incremental (reference behavior): one lambda call per step,
+        # not a re-walk of all past epochs. step(epoch=N) jumps recompute
+        # from scratch.
+        if self.last_epoch <= 0:
+            self._at_epoch = self.last_epoch
+            return self.base_lr
+        if getattr(self, "_at_epoch", None) == self.last_epoch - 1:
+            self._at_epoch = self.last_epoch
+            return self.last_lr * self.lr_lambda(self.last_epoch)
+        lr = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            lr = lr * self.lr_lambda(e)
+        self._at_epoch = self.last_epoch
+        return lr

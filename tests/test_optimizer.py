@@ -214,3 +214,13 @@ def test_group_sharded_offload_raises():
 
     with pytest.raises(NotImplementedError):
         group_sharded_parallel(_M(), opt, level="os_g", offload=True)
+
+
+def test_multiplicative_decay_and_new_transforms():
+    from paddle_tpu.optimizer.lr import MultiplicativeDecay
+    sch = MultiplicativeDecay(1.0, lambda e: 0.5)
+    vals = []
+    for _ in range(3):
+        vals.append(sch.get_lr())
+        sch.step()
+    np.testing.assert_allclose(vals, [1.0, 0.5, 0.25])

@@ -146,3 +146,30 @@ def test_distribution_round2_additions():
     th = D.TanhTransform()
     np.testing.assert_allclose(
         th.inverse(th.forward(x)).numpy(), x.numpy(), rtol=1e-5)
+
+
+def test_vision_transforms_round2():
+    import paddle_tpu.vision.transforms as T
+
+    np.random.seed(0)
+    img = np.random.rand(3, 32, 32).astype(np.float32)
+    assert T.Transpose((1, 2, 0))(img).shape == (32, 32, 3)
+    assert T.Pad(2)(img).shape == (3, 36, 36)
+    flipped = T.RandomVerticalFlip(1.0)(img)
+    np.testing.assert_allclose(np.asarray(flipped)[:, ::-1, :], img)
+    g = T.Grayscale(3)(img)
+    assert g.shape == (3, 32, 32)
+    np.testing.assert_allclose(g[0], g[1])
+    rrc = T.RandomResizedCrop(16)(img)
+    assert rrc.shape == (3, 16, 16)
+    rot = T.RandomRotation((90, 90))(img)  # exact 90-degree turn
+    assert rot.shape == (3, 32, 32)
+    er = T.RandomErasing(1.0, value=7.0)(img)
+    assert (np.asarray(er) == 7.0).any()
+    cj = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+    assert np.asarray(cj).shape == (3, 32, 32)
+    per = T.RandomPerspective(1.0, 0.3)(img)
+    assert np.asarray(per).shape == (3, 32, 32)
+    aff = T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                         shear=5)(img)
+    assert np.asarray(aff).shape == (3, 32, 32)
