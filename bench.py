@@ -213,6 +213,35 @@ def bench_wide_deep():
     return mod.run_bench(), None
 
 
+# -------------------------------------------------------------- decode
+
+
+def bench_decode():
+    """LLM serving decode: GPT2-350M-class FusedMultiTransformer stack,
+    weight-only int8, fixed-shape KV cache, compiled scan decode
+    (reference capability: `fused_multi_transformer_op.cu` + cache_kvs).
+    tokens/sec = generated tokens (prefill amortized in)."""
+    import jax
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTForGeneration
+
+    m = GPTForGeneration(vocab_size=50304, hidden_size=1024,
+                         num_layers=24, num_attention_heads=16,
+                         max_position_embeddings=2048,
+                         compute_dtype="bfloat16", weight_only=True)
+    m.eval()
+    B, P, T = 64, 128, 128
+    rng = np.random.RandomState(0)
+    ids = Tensor(rng.randint(0, 50304, (B, P)).astype(np.int32))
+    out, _ = m.generate(ids, max_new_tokens=T)  # compile + warm
+    np.asarray(out.numpy())
+    t0 = time.perf_counter()
+    out, _ = m.generate(ids, max_new_tokens=T)
+    np.asarray(out.numpy())
+    dt = time.perf_counter() - t0
+    return B * T / dt, None  # bandwidth-bound; MFU not meaningful
+
+
 def main():
     import jax
     dev = jax.devices()[0]
@@ -237,7 +266,9 @@ def main():
                  "seqs/sec"),
                 ("lenet_fit_steps_per_sec", bench_lenet, "steps/sec"),
                 ("wide_deep_ps_examples_per_sec", bench_wide_deep,
-                 "examples/sec")):
+                 "examples/sec"),
+                ("gpt2_350m_decode_tokens_per_sec_per_chip", bench_decode,
+                 "tokens/sec")):
             # drop the previous config's device buffers: trainers hold
             # reference cycles (mesh/jit closures), so HBM is only
             # reclaimed after a cycle collection

@@ -1,0 +1,325 @@
+"""Serving stack tests: FusedMultiTransformer prefill/decode parity,
+compile-once decode, weight-only int8, MoE, generate().
+
+Reference behavior being matched:
+`python/paddle/incubate/nn/layer/fused_transformer.py:1016` (cache_kvs +
+time_step decode protocol), `fused_multi_transformer_op.cu`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.incubate.nn import (
+    FusedMultiTransformer, FusedMultiTransformerWeightOnly,
+    FusedMultiTransformerMoe, FusedMoELayer, FusedFeedForward,
+    FusedMultiHeadAttention, FusedTransformerEncoderLayer,
+    FusedBiasDropoutResidualLayerNorm)
+from paddle_tpu.models.gpt import (GPTModel, GPTForPretraining,
+                                   GPTForGeneration, gpt_tiny)
+
+
+def _mt(L=2, D=32, H=4, F=64, **kw):
+    m = FusedMultiTransformer(D, H, F, num_layers=L, **kw)
+    m.eval()
+    return m
+
+
+class TestFusedMultiTransformer:
+    def test_forward_causal_shapes(self):
+        m = _mt()
+        x = paddle.randn([2, 8, 32])
+        out = m(x)
+        assert list(out.shape) == [2, 8, 32]
+
+    def test_prefill_then_decode_matches_full_forward(self):
+        """Decode over the fixed-shape cache must reproduce the causal
+        full-sequence forward position by position."""
+        m = _mt()
+        B, S = 2, 6
+        x = paddle.randn([B, S, 32])
+        full = m(x).numpy()
+
+        cache = m.gen_cache(B, max_seq_len=16)
+        # prefill on the first 3 positions
+        pre, cache = m(x[:, :3], caches=cache)
+        np.testing.assert_allclose(pre.numpy(), full[:, :3], rtol=2e-4,
+                                   atol=2e-4)
+        # decode positions 3..5 one token at a time
+        for t in range(3, S):
+            step_out, cache = m(x[:, t:t + 1], caches=cache,
+                                time_step=Tensor(np.int32(t)))
+            np.testing.assert_allclose(
+                step_out.numpy()[:, 0], full[:, t], rtol=2e-4, atol=2e-4)
+
+    def test_prefill_respects_seq_lens(self):
+        """Padded key positions must not influence valid queries."""
+        m = _mt()
+        B = 2
+        x = paddle.randn([B, 8, 32])
+        lens = Tensor(np.array([5, 8], np.int32))
+        cache = m.gen_cache(B, 16)
+        out_padded, _ = m(x, caches=cache, seq_lens=lens)
+        cache2 = m.gen_cache(B, 16)
+        out_short, _ = m(x[:, :5], caches=cache2)
+        np.testing.assert_allclose(out_padded.numpy()[0, :5],
+                                   out_short.numpy()[0], rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_decode_batched_positions(self):
+        """Per-row write positions (variable-length prompts)."""
+        m = _mt()
+        B = 2
+        x = paddle.randn([B, 8, 32])
+        lens = np.array([4, 6], np.int32)
+        cache = m.gen_cache(B, 16)
+        _, cache = m(x, caches=cache, seq_lens=Tensor(lens))
+        tok = paddle.randn([B, 1, 32])
+        out, cache = m(tok, caches=cache, time_step=Tensor(lens))
+        # row 0 attends over 5 positions, row 1 over 7: compare against
+        # scalar-step decodes of the matching unpadded prefixes
+        for b, ln in enumerate(lens):
+            c1 = m.gen_cache(1, 16)
+            _, c1 = m(x[b:b + 1, :int(ln)], caches=c1)
+            o1, _ = m(tok[b:b + 1], caches=c1,
+                      time_step=Tensor(np.int32(ln)))
+            np.testing.assert_allclose(out.numpy()[b], o1.numpy()[0],
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_long_prefill_chunked_attention_parity(self):
+        """S>=512 prefill takes the query-block-chunked path; must match
+        the plain causal forward."""
+        m = _mt(L=1, D=16, H=2, F=16)
+        B, S = 1, 512
+        x = paddle.randn([B, S, 16])
+        full = m(x).numpy()
+        cache = m.gen_cache(B, S + 128)
+        pre, _ = m(x, caches=cache)
+        np.testing.assert_allclose(pre.numpy(), full, rtol=3e-4,
+                                   atol=3e-4)
+
+    def test_train_mode_grads_flow(self):
+        m = _mt()
+        m.train()
+        x = paddle.randn([2, 4, 32])
+        x.stop_gradient = False
+        out = m(x)
+        loss = paddle.sum(out * out)
+        loss.backward()
+        assert m.qkv_weights.grad is not None
+        assert np.isfinite(m.qkv_weights.grad.numpy()).all()
+
+
+class TestCompileOnce:
+    def test_decode_traces_once_over_100_steps(self):
+        """The fixed-shape cache means a jitted decode step compiles
+        exactly once (VERDICT r2 #1 done-criterion)."""
+        import jax
+        import jax.numpy as jnp
+        m = _mt()
+        names, tensors, core = m.bind_core()
+        arrays = [t._data for t in tensors]
+        traces = []
+
+        @jax.jit
+        def decode(arrays, cache, x, step):
+            traces.append(1)
+            out, new_cache, _ = core(arrays, x, cache, "decode", step)
+            return out, new_cache
+
+        kc, vc = m.gen_cache(2, 128)
+        cache = (kc._data, vc._data)
+        x = jnp.ones((2, 1, 32), jnp.float32)
+        for t in range(100):
+            out, cache = decode(arrays, cache, x, jnp.int32(t))
+        assert len(traces) == 1
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestWeightOnly:
+    def test_from_float_close_and_int8_storage(self):
+        import jax.numpy as jnp
+        m = _mt()
+        q = FusedMultiTransformerWeightOnly.from_float(m)
+        q.eval()
+        assert q.qkv_weights._data.dtype == jnp.int8
+        x = paddle.randn([2, 6, 32])
+        np.testing.assert_allclose(q(x).numpy(), m(x).numpy(), rtol=0.1,
+                                   atol=0.12)
+
+    def test_weight_only_decode_path(self):
+        m = _mt()
+        q = FusedMultiTransformerWeightOnly.from_float(m)
+        q.eval()
+        cache = q.gen_cache(1, 8)
+        x = paddle.randn([1, 3, 32])
+        _, cache = q(x, caches=cache)
+        out, _ = q(paddle.randn([1, 1, 32]), caches=cache,
+                   time_step=Tensor(np.int32(3)))
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestMoe:
+    def test_moe_stack_runs_and_decodes(self):
+        m = FusedMultiTransformerMoe(32, 4, 64, num_layers=2,
+                                     num_expert=4, top_k=2)
+        m.eval()
+        x = paddle.randn([2, 6, 32])
+        out = m(x)
+        assert list(out.shape) == [2, 6, 32]
+        cache = m.gen_cache(2, 16)
+        _, cache = m(x, caches=cache)
+        step, _ = m(paddle.randn([2, 1, 32]), caches=cache,
+                    time_step=Tensor(np.int32(6)))
+        assert np.isfinite(step.numpy()).all()
+
+    def test_fused_moe_layer_top1_routes(self):
+        """With orthogonal inputs and a handcrafted gate, top-1 routing
+        must apply exactly the selected expert's FFN."""
+        layer = FusedMoELayer(8, 16, num_expert=2, top_k=1,
+                              capacity_factor=8.0)
+        layer.eval()
+        # gate: feature 0 -> expert 0, feature 1 -> expert 1
+        gw = np.zeros((8, 2), np.float32)
+        gw[0, 0] = 10.0
+        gw[1, 1] = 10.0
+        layer.gate_weight.set_value(gw)
+        x = np.zeros((4, 8), np.float32)
+        x[:2, 0] = 1.0
+        x[2:, 1] = 1.0
+        out = layer(Tensor(x)).numpy()
+        # expert applied manually
+        import jax.numpy as jnp
+        for i, e in [(0, 0), (2, 1)]:
+            w1 = layer.ffn1_weight.numpy()[e]
+            b1 = layer.ffn1_bias.numpy()[e]
+            w2 = layer.ffn2_weight.numpy()[e]
+            b2 = layer.ffn2_bias.numpy()[e]
+            from scipy.special import erf
+            h = x[i] @ w1 + b1
+            h = 0.5 * h * (1 + erf(h / np.sqrt(2)))
+            want = h @ w2 + b2
+            np.testing.assert_allclose(out[i], want, rtol=1e-4,
+                                       atol=1e-4)
+
+
+class TestSimpleFusedLayers:
+    def test_fused_attention_matches_unfused(self):
+        m = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                    attn_dropout_rate=0.0,
+                                    normalize_before=True)
+        m.eval()
+        x = paddle.randn([2, 5, 32])
+        out = m(x)
+        assert list(out.shape) == [2, 5, 32]
+
+    def test_fused_ffn_residual(self):
+        m = FusedFeedForward(16, 32, dropout_rate=0.0)
+        m.eval()
+        x = paddle.randn([2, 3, 16])
+        out = m(x)
+        assert list(out.shape) == [2, 3, 16]
+
+    def test_encoder_layer(self):
+        m = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        m.eval()
+        out = m(paddle.randn([2, 4, 16]))
+        assert list(out.shape) == [2, 4, 16]
+
+    def test_bias_dropout_residual_ln(self):
+        m = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        m.eval()
+        x = paddle.randn([2, 4, 16])
+        r = paddle.randn([2, 4, 16])
+        out = m(x, r)
+        assert list(out.shape) == [2, 4, 16]
+
+
+class TestGenerate:
+    def _model(self):
+        m = GPTForGeneration(vocab_size=97, hidden_size=32, num_layers=2,
+                             num_attention_heads=4,
+                             max_position_embeddings=128)
+        m.eval()
+        return m
+
+    def test_greedy_matches_eager_argmax_rollout(self):
+        """generate() (compiled prefill + scan decode) must equal an
+        eager greedy rollout through the full forward."""
+        m = self._model()
+        ids = np.array([[3, 14, 15, 9, 2]], np.int64)
+        out, _ = m.generate(Tensor(ids), max_new_tokens=6,
+                            decode_strategy="greedy", cache_dtype="float32")
+        out = out.numpy()
+
+        cur = ids.copy()
+        want = []
+        for _ in range(6):
+            logits = m(Tensor(cur)).numpy()
+            nxt = logits[:, -1].argmax(-1)
+            want.append(int(nxt[0]))
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        assert out[0].tolist() == want
+
+    def test_python_loop_equals_scan(self):
+        m = self._model()
+        ids = np.array([[5, 6, 7]], np.int64)
+        a, _ = m.generate(Tensor(ids), max_new_tokens=5, use_scan=True,
+                          cache_dtype="float32")
+        b, _ = m.generate(Tensor(ids), max_new_tokens=5, use_scan=False,
+                          cache_dtype="float32")
+        assert a.numpy().tolist() == b.numpy().tolist()
+
+    def test_eos_padding(self):
+        m = self._model()
+        ids = np.array([[1, 2]], np.int64)
+        out, _ = m.generate(Tensor(ids), max_new_tokens=8,
+                            eos_token_id=0, cache_dtype="float32")
+        o = out.numpy()[0]
+        assert len(o) == 8
+        hits = np.where(o == 0)[0]
+        if len(hits):
+            assert (o[hits[0]:] == 0).all()
+
+    def test_sampling_strategies_run(self):
+        m = self._model()
+        ids = np.array([[4, 5, 6]], np.int64)
+        for kw in (dict(decode_strategy="sampling", top_k=5),
+                   dict(decode_strategy="sampling", top_p=0.8),
+                   dict(decode_strategy="sampling", temperature=0.7,
+                        top_k=8, top_p=0.9)):
+            out, _ = m.generate(Tensor(ids), max_new_tokens=4, seed=7,
+                                cache_dtype="float32", **kw)
+            o = out.numpy()
+            assert o.shape == (1, 4)
+            assert (o >= 0).all() and (o < 97).all()
+
+    def test_from_pretraining_parity(self):
+        """Fused serving stack must reproduce the eager training model's
+        logits (layout repack correctness)."""
+        eager = GPTForPretraining(gpt_tiny())
+        eager.eval()
+        served = GPTForGeneration.from_pretraining(eager)
+        served.eval()
+        ids = Tensor(np.array([[3, 1, 4, 1, 5]], np.int64))
+        np.testing.assert_allclose(served(ids).numpy(),
+                                   eager(ids).numpy(), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_moe_weight_only_generate(self):
+        m = GPTForGeneration(vocab_size=64, hidden_size=32, num_layers=2,
+                             num_attention_heads=4, weight_only=True,
+                             moe=dict(num_expert=4, top_k=2))
+        m.eval()
+        out, _ = m.generate(Tensor(np.array([[1, 2, 3]], np.int64)),
+                            max_new_tokens=3)
+        assert out.numpy().shape == (1, 3)
+
+    def test_weight_only_generate(self):
+        m = GPTForGeneration(vocab_size=64, hidden_size=32, num_layers=2,
+                             num_attention_heads=4, weight_only=True)
+        m.eval()
+        out, _ = m.generate(Tensor(np.array([[1, 2, 3]], np.int64)),
+                            max_new_tokens=4)
+        assert out.numpy().shape == (1, 4)

@@ -197,6 +197,19 @@ def test_check_nan_inf_compiled_path():
         assert model._jit_ok, "must have run the compiled path"
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
+        # drain the poisoned effect token NOW — it re-raises on every
+        # block_until_ready, so without clearing it jax's atexit
+        # wait_for_tokens prints a traceback that masks real teardown
+        # errors
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        try:
+            from jax._src import dispatch as _jd
+            _jd.runtime_tokens.clear()
+        except Exception:
+            pass
 
 
 def test_check_nan_inf_eager_path():
