@@ -39,19 +39,16 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import functools
 import math
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ...core import dispatch
 from ...core import random as rng_mod
 from ...core.tensor import Tensor
 from ...nn.layer_base import Layer
-from ...nn.layers.common import Linear, Dropout
-from ...nn.layers.norm import LayerNorm
+from ...nn.layers.common import Linear
 from ...nn import functional as F
 from ...ops._helpers import as_tensor
 
@@ -760,6 +757,17 @@ class FusedMultiTransformer(Layer):
             mode = "decode" if time_step is not None else "prefill"
             inputs.append(Tensor(cache_arr[0]))
             inputs.append(Tensor(cache_arr[1]))
+        if mode == "decode":
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "attn_mask in decode mode: the cache mask is derived "
+                    "from positions — pass per-row positions via "
+                    "time_step/seq_lens instead")
+            if seq_lens is not None:
+                # reference cache_kvs protocol: per-row current lengths —
+                # use them as the per-row write/attend positions
+                time_step = seq_lens
+                seq_lens = None
         if seq_lens is not None:
             seq_lens = as_tensor(seq_lens)
             inputs.append(seq_lens)

@@ -19,7 +19,6 @@ TPU-native shape discipline — everything is compiled exactly once:
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp

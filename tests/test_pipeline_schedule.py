@@ -103,3 +103,170 @@ def test_pipeline_mixed_activation_shapes(schedule):
         for p, g in zip(pts, gs):
             np.testing.assert_allclose(
                 np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+
+@pytest.mark.parametrize("pp,v,micro", [(2, 2, 8), (2, 2, 2), (2, 3, 4)])
+def test_interleaved_1f1b_parity(pp, v, micro):
+    """PipelineParallelWithInterleave parity (`pipeline_parallel.py:464`):
+    pp devices, v virtual stages each -> pp*v non-contiguous chunks;
+    loss AND grads must match the single-device eager run."""
+    model = _build_model(seed=11)
+    C = pp * v
+    model._num_stages = C
+    n = len(model.run_function)
+    # C segment bounds over n layers (some chunks may be empty-ish but
+    # every chunk must hold >= 1 layer: spread evenly)
+    bounds = [round(i * n / C) for i in range(C + 1)]
+    model.segment_parts = bounds
+
+    rng = np.random.RandomState(2)
+    B = 8
+    x = rng.rand(B, 4).astype(np.float32)
+    y = rng.rand(B, 8).astype(np.float32)
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+
+    runner = CompiledPipeline(model, micro_batches=micro,
+                              schedule="1f1b", num_virtual_stages=v)
+    assert runner.pp == pp and runner.chunks == C
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+
+def test_interleaved_requires_divisible_micro():
+    model = _build_model(seed=5)
+    model._num_stages = 4
+    n = len(model.run_function)
+    model.segment_parts = [round(i * n / 4) for i in range(5)]
+    with pytest.raises(ValueError, match="divisible"):
+        CompiledPipeline(model, micro_batches=3, schedule="1f1b",
+                         num_virtual_stages=2)
+
+
+def test_stage_local_params_parity_and_memory():
+    """Stage-local mode: params sharded over the pp axis (P('pp') flat
+    segments — `pp_layers.py:211` partition semantics). Same loss/grads
+    as the replicated mode, per-device param bytes ~ total/pp."""
+    model = _build_model(seed=13)
+    pp = 2
+    model._num_stages = pp
+    n = len(model.run_function)
+    model.segment_parts = [0, int(np.ceil(n / pp)), n]
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 8).astype(np.float32)
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+
+    runner = CompiledPipeline(model, micro_batches=4, schedule="1f1b",
+                              stage_local_params=True)
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+    # memory contract on a model big enough that padding is noise
+    paddle.seed(29)
+    big = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 256, 256), LayerDesc(nn.Tanh)] * 4,
+        num_stages=4, loss_fn=nn.MSELoss())
+    big_runner = CompiledPipeline(big, micro_batches=4, schedule="1f1b",
+                                  stage_local_params=True)
+    total = sum(int(np.prod(p.shape)) * 4
+                for pts in big_runner.stage_params for p in pts)
+    per_dev = big_runner.per_device_param_bytes()
+    # each device holds its own segment (~1/pp of the model + pad)
+    assert per_dev <= total / 4 + 2 * 128 * 4, (per_dev, total)
+
+
+def test_stage_local_interleaved_combo():
+    model = _build_model(seed=17)
+    model._num_stages = 4
+    n = len(model.run_function)
+    model.segment_parts = [round(i * n / 4) for i in range(5)]
+    rng = np.random.RandomState(4)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 8).astype(np.float32)
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+    runner = CompiledPipeline(model, micro_batches=4, schedule="1f1b",
+                              num_virtual_stages=2,
+                              stage_local_params=True)
+    assert runner.pp == 2
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+
+def _bn_model(seed):
+    paddle.seed(seed)
+    return PipelineLayer(
+        layers=[
+            LayerDesc(nn.Linear, 4, 8),
+            LayerDesc(nn.BatchNorm1D, 8),
+            LayerDesc(nn.Tanh),
+            LayerDesc(nn.Linear, 8, 8),
+            LayerDesc(nn.BatchNorm1D, 8),
+            LayerDesc(nn.Linear, 8, 8),
+        ],
+        num_stages=2,
+        loss_fn=nn.MSELoss())
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_train_mode_buffers_update_and_match_micro_eager(schedule):
+    """BN-bearing model trains pipelined: running stats update per
+    microbatch (the reference PipelineParallel semantics) and match an
+    eager per-micro loop; grads match the same loop."""
+    M = 2
+    model = _bn_model(seed=23)
+    n = len(model.run_function)
+    model.segment_parts = [0, 3, n]
+    model.train()
+
+    rng = np.random.RandomState(5)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 8).astype(np.float32)
+
+    # eager per-micro reference on an identical twin
+    ref = _bn_model(seed=23)
+    ref.segment_parts = [0, 3, n]
+    ref.train()
+    for p in ref.parameters():
+        p._grad = None
+    losses = []
+    for m in range(M):
+        xm = paddle.to_tensor(x[m * 4:(m + 1) * 4])
+        ym = paddle.to_tensor(y[m * 4:(m + 1) * 4])
+        out = ref(xm)
+        loss_m = ref._loss_fn(out, ym) / M
+        loss_m.backward()
+        losses.append(float(loss_m))
+    ref_loss = sum(losses)
+    ref_state = {n_: b.numpy() for n_, b in ref.named_buffers()}
+    ref_grads = {id(p): p.grad.numpy() for p in ref.parameters()}
+
+    runner = CompiledPipeline(model, micro_batches=M, schedule=schedule)
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    # buffers updated in place on the pipelined model
+    name_map = dict(model.named_buffers())
+    for n_, want in ref_state.items():
+        got = name_map[n_].numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6,
+                                   err_msg=n_)
+    # align grads by parameter order of the twin models
+    flat_ref = [ref_grads[id(p)] for p in ref.parameters()]
+    got_by_id = {id(p): g
+                 for pts, gs in zip(runner.stage_params, grads)
+                 for p, g in zip(pts, gs)}
+    for p, want in zip(model.parameters(), flat_ref):
+        np.testing.assert_allclose(np.asarray(got_by_id[id(p)]), want,
+                                   rtol=2e-4, atol=2e-6)
