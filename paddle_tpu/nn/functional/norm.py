@@ -7,12 +7,75 @@ elementwise — no cuDNN equivalent needed.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
 from ...core.tensor import Tensor
 from ...ops._helpers import as_tensor
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_train_core(axes, eps, x, w, b):
+    """Affine train-mode batch norm with a hand-written backward.
+
+    jax AD of the naive form runs three separate reduction fusions over
+    the feature map (profiled at ~20% of a ResNet-50 train step); the
+    analytic backward needs exactly two passes — one fused quad-reduce
+    (sum dy, sum dy*xhat — both read (dy, x) once) and one elementwise
+    dx pass."""
+    return _bn_fwd_math(axes, eps, x, w, b)[0]
+
+
+def _bn_fwd_math(axes, eps, x, w, b):
+    af = x.astype(jnp.float32)
+    m1 = jnp.mean(af, axis=axes, keepdims=True)
+    m2 = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
+    var = jnp.maximum(m2 - jnp.square(m1), 0.0)
+    ivar = jax.lax.rsqrt(var + eps)
+    xhat = (af - m1) * ivar
+    bshape = m1.shape
+    out = xhat * w.astype(jnp.float32).reshape(bshape) \
+        + b.astype(jnp.float32).reshape(bshape)
+    return ((out.astype(x.dtype), m1.reshape(-1), var.reshape(-1)),
+            (x, m1, ivar, w))
+
+
+def _bn_train_fwd(axes, eps, x, w, b):
+    return _bn_fwd_math(axes, eps, x, w, b)
+
+
+def _bn_train_bwd(axes, eps, res, cots):
+    x, m1, ivar, w = res
+    dy, dm1_c, dvar_c = cots
+    n = 1
+    for ax in axes:
+        n *= x.shape[ax]
+    nf = jnp.float32(n)
+    af = x.astype(jnp.float32)
+    xhat = (af - m1) * ivar
+    dyf = dy.astype(jnp.float32)
+    bshape = m1.shape
+    # pass 1: both reductions read (dy, x) once (multi-output fusion)
+    s1 = jnp.sum(dyf, axis=axes, keepdims=True)          # = dbeta
+    s2 = jnp.sum(dyf * xhat, axis=axes, keepdims=True)   # = dgamma
+    wf = w.astype(jnp.float32).reshape(bshape)
+    # pass 2: elementwise dx (+ cotangents of the mean/var outputs,
+    # which feed running-stat updates: usually zero, kept for
+    # correctness — they are per-channel broadcasts, no extra pass)
+    dx = (wf * ivar / nf) * (nf * dyf - s1 - xhat * s2)
+    if dm1_c is not None:
+        dx = dx + dm1_c.reshape(bshape) / nf
+    if dvar_c is not None:
+        dx = dx + dvar_c.reshape(bshape) * 2.0 * (af - m1) / nf
+    dgamma = s2.reshape(-1).astype(w.dtype)
+    dbeta = s1.reshape(-1)
+    return (dx.astype(x.dtype), dgamma, dbeta.astype(w.dtype))
+
+
+_bn_train_core.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
@@ -62,22 +125,26 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     # jnp.var's). fp32 inputs keep fp32 stats.
     def _fn(*arrs):
         a = arrs[0]
-        cd = a.dtype if a.dtype == jnp.bfloat16 else jnp.float32
-        af = a.astype(cd)
-        mean = jnp.mean(af, axis=reduce_axes, keepdims=True)
-        # centered two-pass variance: no E[x^2]-E[x]^2 cancellation (which
-        # goes negative -> NaN in bf16), grads stay mean-shaped (fast)
-        centered = af - mean
-        var = jnp.mean(jnp.square(centered), axis=reduce_axes,
-                       keepdims=True)
-        out = centered * jax.lax.rsqrt(var + epsilon)
+        if w_idx is not None and b_idx is not None:
+            # affine hot path: single-pass f32 moments forward +
+            # analytic two-pass backward (see _bn_train_core)
+            return _bn_train_core(reduce_axes, epsilon, a,
+                                  arrs[w_idx], arrs[b_idx])
+        # generic path (no affine params): same math, jax AD backward.
+        # f32 accumulation keeps E[x^2]-E[x]^2 from cancelling (it was
+        # bf16 accumulation that produced negative variances).
+        af = a.astype(jnp.float32)
+        m1 = jnp.mean(af, axis=reduce_axes, keepdims=True)
+        m2 = jnp.mean(jnp.square(af), axis=reduce_axes, keepdims=True)
+        var = jnp.maximum(m2 - jnp.square(m1), 0.0)
+        out = (af - m1) * jax.lax.rsqrt(var + epsilon)
         if w_idx is not None:
-            out = out * arrs[w_idx].astype(cd).reshape(bshape)
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
         if b_idx is not None:
-            out = out + arrs[b_idx].astype(cd).reshape(bshape)
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
         return (out.astype(a.dtype),
-                mean.reshape(-1).astype(jnp.float32),
-                var.reshape(-1).astype(jnp.float32))
+                m1.reshape(-1),
+                var.reshape(-1))
 
     out, batch_mean, batch_var = dispatch.apply(
         "batch_norm_train", _fn, tuple(inputs))

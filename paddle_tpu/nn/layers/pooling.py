@@ -7,12 +7,13 @@ from .. import functional as F
 
 class _Pool(Layer):
     def __init__(self, kernel_size=None, stride=None, padding=0,
-                 ceil_mode=False, **kw):
+                 ceil_mode=False, data_format=None, **kw):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self._data_format = data_format
         self._kw = kw
 
 
@@ -25,7 +26,8 @@ class MaxPool1D(_Pool):
 class MaxPool2D(_Pool):
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._data_format or "NCHW")
 
 
 class MaxPool3D(_Pool):
@@ -43,7 +45,8 @@ class AvgPool1D(_Pool):
 class AvgPool2D(_Pool):
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._data_format or "NCHW")
 
 
 class AvgPool3D(_Pool):

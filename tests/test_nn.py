@@ -264,3 +264,38 @@ def test_interpolate_pad():
     assert F.interpolate(x, scale_factor=2, mode="nearest").shape == \
         [1, 2, 8, 8]
     assert F.pad(x, [1, 1, 1, 1]).shape == [1, 2, 6, 6]
+
+
+def test_layout_autotune_channels_last_parity():
+    """incubate.autotune.to_channels_last (layout_autotune.cc parity):
+    a conv-BN-relu-pool net flipped to NHWC must reproduce the NCHW
+    outputs given transposed inputs."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.autotune import to_channels_last
+
+    paddle.seed(7)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2D(3, 8, 3, padding=1)
+            self.bn = nn.BatchNorm2D(8)
+            self.pool = nn.MaxPool2D(2, 2)
+            self.head = nn.AdaptiveAvgPool2D((1, 1))
+
+        def forward(self, x):
+            x = nn.functional.relu(self.bn(self.c1(x)))
+            x = self.pool(x)
+            return self.head(x)
+
+    net = Net()
+    net.eval()
+    x = np.random.RandomState(0).rand(2, 3, 16, 16).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy().reshape(2, 8)
+
+    to_channels_last(net)
+    out = net(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+    np.testing.assert_allclose(out.reshape(2, 8), ref, rtol=2e-5,
+                               atol=2e-5)
