@@ -53,4 +53,13 @@ def set_config(config=None):
     config dict; layout autotune maps to `to_channels_last` (explicit —
     the implicit per-op rewrite doesn't exist here because XLA already
     owns kernel selection/fusion)."""
+    layout_cfg = config.get("layout") if isinstance(config, dict) else None
+    if isinstance(layout_cfg, dict) and layout_cfg.get("enable", False):
+        import warnings
+        warnings.warn(
+            "layout autotune via set_config is a no-op here: XLA owns "
+            "kernel selection, and the implicit per-op NCHW->NHWC rewrite "
+            "does not exist. Call "
+            "paddle.incubate.autotune.to_channels_last(model) explicitly "
+            "and feed channels-last inputs.", stacklevel=2)
     return None

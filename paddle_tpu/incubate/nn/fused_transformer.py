@@ -505,6 +505,12 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention does not implement the reference "
+                "cache_kv incremental-decode protocol; use "
+                "FusedMultiTransformer's caches/time_step protocol for "
+                "decode (incubate.nn.fused_transformer.FusedMultiTransformer)")
         from ...ops import manipulation as manip
         x = as_tensor(query)
         residual = x
@@ -646,6 +652,31 @@ class FusedMultiTransformer(Layer):
         if num_layers < 0 and isinstance(qkv_weight_attrs, (list, tuple)):
             num_layers = len(qkv_weight_attrs)
         assert num_layers > 0, "num_layers must be given"
+        _ignored_attrs = {
+            "ln_scale_attrs": ln_scale_attrs, "ln_bias_attrs": ln_bias_attrs,
+            "qkv_bias_attrs": qkv_bias_attrs,
+            "linear_weight_attrs": linear_weight_attrs,
+            "linear_bias_attrs": linear_bias_attrs,
+            "ffn_ln_scale_attrs": ffn_ln_scale_attrs,
+            "ffn_ln_bias_attrs": ffn_ln_bias_attrs,
+            "ffn1_weight_attrs": ffn1_weight_attrs,
+            "ffn1_bias_attrs": ffn1_bias_attrs,
+            "ffn2_weight_attrs": ffn2_weight_attrs,
+            "ffn2_bias_attrs": ffn2_bias_attrs}
+        _passed = [k for k, v in _ignored_attrs.items() if v is not None]
+        if qkv_weight_attrs is not None:
+            _passed.append("qkv_weight_attrs")
+        if _passed:
+            import warnings
+            warnings.warn(
+                "FusedMultiTransformer uses stacked [num_layers, ...] "
+                "parameters; per-layer attrs are not applied "
+                f"(ignored: {', '.join(sorted(_passed))}). The stacked "
+                "qkv layout is [L, D, 3*H*Dh] (the per-layer "
+                "trans_qkvw=False layout) regardless of `trans_qkvw`. "
+                "Load reference per-layer checkpoints through "
+                "GPTForGeneration.from_pretraining, or assign the stacked "
+                "parameters directly.", stacklevel=2)
         assert embed_dim % num_heads == 0
         # TP: local shard sizes (ref divides heads/ffn by nranks)
         assert num_heads % nranks == 0 and dim_feedforward % nranks == 0

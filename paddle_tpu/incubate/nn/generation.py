@@ -152,9 +152,13 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32,
                  decode_strategy="greedy", temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, seed=None, use_scan=True,
-                 cache_dtype=None):
+                 cache_dtype=None, seq_lens=None):
         """Returns (ids [B, max_new_tokens], scores=None). Greedy or
-        sampling; compiled prefill + compiled decode (see module doc)."""
+        sampling; compiled prefill + compiled decode (see module doc).
+
+        `seq_lens` [B] gives each row's true (unpadded) prompt length for
+        ragged right-padded batches; without it every row is assumed to
+        span the full prompt width (pad tokens would be attended)."""
         ids = as_tensor(input_ids)
         ids_np = np.asarray(ids.numpy(), np.int32)
         if ids_np.ndim == 1:
@@ -173,9 +177,21 @@ class GenerationMixin:
         sc = SamplingConfig("greedy" if decode_strategy == "greedy"
                             else "sampling", float(temperature),
                             int(top_k), float(top_p))
-        lens_np = (np.full((B,), S, np.int32)
-                   if not hasattr(self, "_seq_lens_of") else
-                   np.asarray(self._seq_lens_of(ids_np), np.int32))
+        if seq_lens is not None:
+            lens_np = np.asarray(
+                seq_lens.numpy() if isinstance(seq_lens, Tensor)
+                else seq_lens, np.int32).reshape(-1)
+            if lens_np.shape != (B,):
+                raise ValueError(
+                    f"seq_lens must have shape [{B}], got "
+                    f"{lens_np.shape}")
+            if (lens_np < 1).any() or (lens_np > S).any():
+                raise ValueError("seq_lens entries must lie in [1, "
+                                 f"{S}]")
+        elif hasattr(self, "_seq_lens_of"):
+            lens_np = np.asarray(self._seq_lens_of(ids_np), np.int32)
+        else:
+            lens_np = np.full((B,), S, np.int32)
         uniform = bool((lens_np == lens_np[0]).all())
         shape_key = (B, s_bucket, s_max, str(dt))
         fns = self._gen_fns(shape_key, sc, eos_token_id, max_new_tokens,

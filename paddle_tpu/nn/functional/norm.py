@@ -32,8 +32,10 @@ def _bn_train_core(axes, eps, x, w, b):
 def _bn_fwd_math(axes, eps, x, w, b):
     af = x.astype(jnp.float32)
     m1 = jnp.mean(af, axis=axes, keepdims=True)
-    m2 = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
-    var = jnp.maximum(m2 - jnp.square(m1), 0.0)
+    # Centered two-pass variance: E[(x-m)^2].  The single-pass
+    # E[x^2]-E[x]^2 form cancels catastrophically in f32 when
+    # |mean| >> std, silently collapsing var toward 0.
+    var = jnp.mean(jnp.square(af - m1), axis=axes, keepdims=True)
     ivar = jax.lax.rsqrt(var + eps)
     xhat = (af - m1) * ivar
     bshape = m1.shape

@@ -282,6 +282,34 @@ class TestGenerate:
         if len(hits):
             assert (o[hits[0]:] == 0).all()
 
+    def test_ragged_seq_lens_matches_per_row(self):
+        """Explicit seq_lens for a right-padded ragged batch must equal
+        generating each row alone (pad tokens must not be attended)."""
+        m = self._model()
+        rows = [[3, 14, 15, 9], [7, 8]]
+        S = max(len(r) for r in rows)
+        padded = np.zeros((2, S), np.int64)
+        for i, r in enumerate(rows):
+            padded[i, :len(r)] = r
+        lens = np.array([len(r) for r in rows], np.int32)
+        out, _ = m.generate(Tensor(padded), max_new_tokens=4,
+                            cache_dtype="float32", seq_lens=lens)
+        out = out.numpy()
+        for i, r in enumerate(rows):
+            solo, _ = m.generate(Tensor(np.array([r], np.int64)),
+                                 max_new_tokens=4, cache_dtype="float32")
+            assert out[i].tolist() == solo.numpy()[0].tolist()
+
+    def test_seq_lens_validation(self):
+        m = self._model()
+        ids = np.array([[1, 2, 3]], np.int64)
+        with pytest.raises(ValueError):
+            m.generate(Tensor(ids), max_new_tokens=2,
+                       seq_lens=np.array([4], np.int32))
+        with pytest.raises(ValueError):
+            m.generate(Tensor(ids), max_new_tokens=2,
+                       seq_lens=np.array([1, 2], np.int32))
+
     def test_sampling_strategies_run(self):
         m = self._model()
         ids = np.array([[4, 5, 6]], np.int64)

@@ -70,6 +70,22 @@ def test_batchnorm_train_eval():
     assert out2.shape == [4, 3, 5, 5]
 
 
+def test_batchnorm_large_mean_variance():
+    # regression: single-pass E[x^2]-E[x]^2 cancels catastrophically in
+    # f32 when |mean| >> std, collapsing var toward 0 and blowing up the
+    # normalized output; the centered two-pass form stays exact
+    bn = nn.BatchNorm2D(2)
+    rng = np.random.default_rng(0)
+    x_np = (rng.standard_normal((8, 2, 4, 4)) * 0.1 + 1000.0).astype(
+        np.float32)
+    bn.train()
+    out = bn(paddle.to_tensor(x_np)).numpy()
+    # single-pass var here ~ max(0, 1e6-ish cancellation) -> std wildly
+    # wrong; centered two-pass stays within f32 roundoff
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0, atol=2e-2)
+    np.testing.assert_allclose(out.std(axis=(0, 2, 3)), 1, atol=0.1)
+
+
 def test_layernorm():
     ln = nn.LayerNorm(8)
     x = paddle.randn([2, 4, 8]) * 5
