@@ -60,27 +60,35 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None,
 
 def _attention_impl(q, k, v, bias, causal, scale, dropout_p, dropout_key,
                     use_pallas):
-    if use_pallas:
-        try:
-            from ...ops.pallas.flash_attention import flash_attention as fa
-            return fa(q, k, v, bias=bias, causal=causal, scale=scale)
-        except Exception:
-            pass
+    if use_pallas and bias is None and dropout_p == 0.0 \
+            and q.shape[1] == k.shape[1]:
+        from ...ops.pallas.flash_attention import (splash_mha,
+                                                  splash_supported)
+        if splash_supported(q.shape[1], q.shape[-1]):
+            # [B, S, H, D] -> [B, H, S, D] kernel layout
+            out = splash_mha(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+            return jnp.swapaxes(out, 1, 2)
     return _xla_attention(q, k, v, bias, causal, scale, dropout_p,
                           dropout_key)
 
 
 def _on_tpu(arr) -> bool:
-    # The Pallas flash kernel is opt-in until it beats XLA's fused
-    # attention (measured 2026-07: XLA ~10x faster on v5e for S=1024;
-    # XLA's attention fusion is already flash-style on TPU).
+    # splash (Pallas flash, fused backward) is the default on TPU —
+    # trace-measured 2.1x faster fwd+bwd than XLA's fused attention at
+    # [32,16,1024,64] (docs/gpt_perf_analysis.md). Opt out with
+    # paddle.set_flags({"FLAGS_use_pallas_flash_attention": False}) or
+    # PADDLE_TPU_PALLAS_FLASH=0.
     import os
-    if os.environ.get("PADDLE_TPU_PALLAS_FLASH", "0") != "1":
+    if os.environ.get("PADDLE_TPU_PALLAS_FLASH", "1") != "1":
         return False
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
+    from ... import flags as _flags
+    if not _flags.get_flags("FLAGS_use_pallas_flash_attention")[
+            "FLAGS_use_pallas_flash_attention"]:
         return False
+    from ...ops.pallas.flash_attention import _on_tpu_backend
+    return _on_tpu_backend()
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

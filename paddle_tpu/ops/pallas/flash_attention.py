@@ -1,14 +1,21 @@
-"""Flash attention (forward) as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels.
 
 Parity: the reference's FlashAttention integration
 (`paddle/phi/kernels/flash_attn_kernel.h`, `cmake/external/flashattn.cmake`,
-`python/paddle/nn/functional/flash_attention.py:142`) — re-implemented as a
-TPU-native online-softmax kernel instead of the CUDA library.
+`python/paddle/nn/functional/flash_attention.py:142`) — re-implemented as
+TPU-native online-softmax kernels instead of the CUDA library.
 
-Layout [B, S, H, D] (paddle flash_attention layout). Forward runs the
-O(S) -memory streaming softmax in VMEM blocks on the MXU; the backward pass
-uses the standard recompute formulation in XLA via custom_vjp (fwd-speed is
-where the kernel matters; XLA's bwd fusion is already strong).
+Two tiers:
+
+* `splash_mha` — the production path: jax's Pallas *splash attention*
+  kernel (fwd + fused dkv/dq backward, causal block-skipping), tuned
+  block sizes for v5e. Trace-measured 2.1x faster fwd+bwd than XLA's
+  fused attention at [32,16,1024,64] and the engine behind the GPT
+  training headline (see docs/gpt_perf_analysis.md). Falls back to
+  XLA's `jax.nn.dot_product_attention` off-TPU (the CPU test mesh) or
+  for shapes the kernel doesn't tile.
+* `flash_attention` — the hand-written educational fwd kernel kept for
+  the paddle [B, S, H, D] API surface; backward recomputes in XLA.
 """
 from __future__ import annotations
 
@@ -22,6 +29,77 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
+
+
+# ---------------------------------------------------------------------------
+# splash attention (library Pallas kernel, fused backward) — production path
+# ---------------------------------------------------------------------------
+
+_SPLASH_CACHE = {}
+
+
+def _on_tpu_backend() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def splash_supported(seq_len: int, head_dim: int) -> bool:
+    """Static gate for the splash kernel: lane-aligned sequence and a
+    head_dim the kernel tiles without padding waste."""
+    return (_on_tpu_backend() and seq_len % 128 == 0
+            and head_dim % 64 == 0 and seq_len >= 128)
+
+
+def _splash_kernel(n_heads: int, seq_len: int, causal: bool):
+    """Build (and cache) a vmapped splash kernel for [B, H, S, D] inputs.
+
+    Block sizes: the largest power-of-two tile <= 1024 dividing S, with
+    the fused dkv backward — measured fastest on v5e at S=1024 (5.0
+    ms/layer fwd+bwd vs 10.6 for XLA's attention at [32,16,1024,64])."""
+    block = next(b for b in (1024, 512, 256, 128) if seq_len % b == 0)
+    key = (n_heads, seq_len, causal, block)
+    if key not in _SPLASH_CACHE:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk, splash_attention_mask as smask)
+        bs = sk.BlockSizes(
+            block_q=block, block_kv=block, block_kv_compute=block,
+            block_q_dkv=block, block_kv_dkv=block,
+            block_kv_dkv_compute=block,
+            use_fused_bwd_kernel=True)
+        m = (smask.CausalMask((seq_len, seq_len)) if causal
+             else smask.FullMask((seq_len, seq_len)))
+        mask = smask.MultiHeadMask([m] * n_heads)
+        _SPLASH_CACHE[key] = jax.vmap(
+            sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                               block_sizes=bs))
+    return _SPLASH_CACHE[key]
+
+
+def splash_mha(q, k, v, *, causal=True, scale=None):
+    """Multi-head self-attention on [B, H, S, D] tensors (q and k/v
+    must share S — causal alignment for a shorter decode-style q is a
+    different op; use the general masked path in
+    `nn.functional.scaled_dot_product_attention` for KV-cache decode).
+
+    TPU: splash Pallas kernel (fwd + fused backward). Off-TPU or for
+    non-tileable shapes: XLA's fused attention. Differentiable either
+    way."""
+    b, h, s, d = q.shape
+    if k.shape[2] != s or v.shape[2] != s:
+        raise ValueError(
+            f"splash_mha requires equal q/kv sequence lengths, got "
+            f"q S={s}, k S={k.shape[2]}, v S={v.shape[2]}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if splash_supported(s, d):
+        kern = _splash_kernel(h, s, causal)
+        return kern((q * scale).astype(q.dtype), k, v)
+    return jax.nn.dot_product_attention(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2), scale=scale,
+        is_causal=causal).transpose(0, 2, 1, 3)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
