@@ -238,10 +238,90 @@ class Model:
                 m.reset()
             logs = {}
             res = None
+            # Step grouping: with no metrics and a static learning rate,
+            # K consecutive steps run as ONE device dispatch (lax.scan
+            # in CompiledTrainStep.run_many) — dispatching through the
+            # TPU relay costs ~8 ms per call regardless of compute,
+            # which capped small models at ~65 steps/s. Groups never
+            # span a log point, so logged losses are exact for their
+            # step. Per-step LR schedulers disable grouping (each step
+            # must see its own lr); callback begin/end pairs fire in
+            # order at flush time (after the async dispatch — same
+            # visibility as the per-step path, whose device work has not
+            # finished at on_train_batch_end either).
+            pending = []       # [(step, batch_arrays)]
+            last_loss = [None]
+            group_ok = [True]
+
+            def flush():
+                if not pending:
+                    return
+                steps_, arrs_ = zip(*pending)
+                pending.clear()
+                try:
+                    with self._amp_context():  # O1 must wrap tracing
+                        losses = self._train_step.run_many(
+                            list(arrs_),
+                            mesh=getattr(self, "_dist_mesh", None))
+                except Exception as e:
+                    warnings.warn(
+                        f"grouped train steps failed ({type(e).__name__}:"
+                        f" {e}); replaying per-step and disabling "
+                        "grouping")
+                    group_ok[0] = False
+                    for s, arrs in zip(steps_, arrs_):
+                        cbks.on_train_batch_begin(s)
+                        n_in = len(arrs) - self._n_labels()
+                        res = self._train_batch_inner(
+                            list(arrs[:n_in]), list(arrs[n_in:]))
+                        last_loss[0] = ("plain", res[0][0])
+                        if s % max(log_freq, 1) == 0:
+                            cbks.on_train_batch_end(s,
+                                                    self._make_logs(res))
+                        else:
+                            cbks.on_train_batch_end(s, {})
+                    return
+                # keep the stacked losses; index lazily (an eager slice
+                # is a device dispatch — only pay it at log points)
+                last_loss[0] = ("stacked", losses)
+                for i, s in enumerate(steps_):
+                    cbks.on_train_batch_begin(s)
+                    if s % max(log_freq, 1) == 0:
+                        lg = self._make_logs(([losses[i]], []))
+                        cbks.on_train_batch_end(s, lg)
+                    else:
+                        cbks.on_train_batch_end(s, {})
+
+            group_max = 8
+            shapes = None
+            static_lr = not hasattr(
+                getattr(self._optimizer, "_learning_rate", 0.0), "step")
             for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
                 ins, lbs = self._split_batch(batch)
+                can_group = (group_ok[0] and self._jit_ok
+                             and not self._metrics and static_lr
+                             and self._train_step is not None
+                             and not self._train_step.input_grads)
+                if can_group:
+                    arrs = _arrays(ins) + _arrays(lbs)
+                    bshapes = tuple(getattr(a, "shape", ()) for a in arrs)
+                    if pending and bshapes != shapes:
+                        flush()
+                    shapes = bshapes
+                    pending.append((step, arrs))
+                    is_last = (num_iters is not None
+                               and step + 1 >= num_iters)
+                    next_is_log = (step + 1) % max(log_freq, 1) == 0
+                    if len(pending) >= group_max or next_is_log or \
+                            is_last:
+                        flush()
+                    if is_last:
+                        break
+                    continue
+                flush()
+                cbks.on_train_batch_begin(step)
                 res = self._train_batch_inner(ins, lbs)
+                last_loss[0] = ("plain", res[0][0])
                 # lazy logging: only materialise the loss (device->host
                 # sync) at log points so steps pipeline on the device;
                 # non-log steps hand callbacks an EMPTY dict rather than
@@ -253,8 +333,11 @@ class Model:
                     cbks.on_train_batch_end(step, {})
                 if num_iters is not None and step + 1 >= num_iters:
                     break
-            if res is not None:
-                logs = self._make_logs(res)
+            flush()
+            if last_loss[0] is not None:
+                kind, val = last_loss[0]
+                logs = self._make_logs(
+                    ([val[-1] if kind == "stacked" else val], []))
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,

@@ -61,7 +61,9 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None,
 def _attention_impl(q, k, v, bias, causal, scale, dropout_p, dropout_key,
                     use_pallas):
     if use_pallas and bias is None and dropout_p == 0.0 \
-            and q.shape[1] == k.shape[1]:
+            and q.shape[1] == k.shape[1] and q.shape[2] == k.shape[2]:
+        # equal head counts only: GQA/MQA q/kv head mismatch takes the
+        # XLA path (jax.nn.dot_product_attention broadcasts kv heads)
         from ...ops.pallas.flash_attention import (splash_mha,
                                                   splash_supported)
         if splash_supported(q.shape[1], q.shape[-1]):

@@ -91,6 +91,10 @@ def splash_mha(q, k, v, *, causal=True, scale=None):
         raise ValueError(
             f"splash_mha requires equal q/kv sequence lengths, got "
             f"q S={s}, k S={k.shape[2]}, v S={v.shape[2]}")
+    if k.shape[1] != h or v.shape[1] != h:
+        raise ValueError(
+            f"splash_mha requires equal q/kv head counts (no GQA/MQA), "
+            f"got q H={h}, k H={k.shape[1]}, v H={v.shape[1]}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if splash_supported(s, d):

@@ -20,6 +20,56 @@ def test_lenet_fit_converges():
     assert res["eval_acc"] > 0.5
 
 
+def test_lenet_fit_grouped_steps_converge():
+    """No metrics -> the fit loop groups K steps into one run_many
+    dispatch (lax.scan). The grouped path must train identically well
+    and report exact per-log-point losses."""
+    train_ds = MNIST(mode="train", synthetic_size=384)
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())   # no metrics
+
+    seen = []
+
+    class Grab(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if logs:
+                seen.append((step, logs.get("loss")))
+
+    model.fit(train_ds, epochs=3, batch_size=64, verbose=0,
+              drop_last=True, log_freq=3, callbacks=[Grab()])
+    assert model._jit_ok
+    assert model._train_step._jit_multi, "grouped path never used"
+    # log points land on exact steps with finite losses
+    assert seen and all(s % 3 == 0 for s, _ in seen)
+    assert all(np.isfinite(v) for _, v in seen)
+    # optimizer step count advanced once per actual step
+    steps_per_epoch = 384 // 64
+    assert model._optimizer._step_count == 3 * steps_per_epoch
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    res = model.evaluate(MNIST(mode="test", synthetic_size=128),
+                         batch_size=64, verbose=0)
+    assert res["eval_acc"] > 0.5
+
+
+def test_fit_per_step_lr_scheduler_disables_grouping():
+    """A per-step LR schedule must see a fresh lr every step, so the
+    grouped (single-lr) dispatch path stays off."""
+    train_ds = MNIST(mode="train", synthetic_size=256)
+    model = paddle.Model(LeNet())
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=1e-3,
+                                          step_size=2, gamma=0.5)
+    opt = paddle.optimizer.Adam(learning_rate=sched,
+                                parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    model.fit(train_ds, epochs=1, batch_size=64, verbose=0,
+              drop_last=True)
+    assert model._jit_ok
+    assert not model._train_step._jit_multi
+
+
 def test_model_save_load(tmp_path):
     model = paddle.Model(LeNet())
     opt = paddle.optimizer.Adam(parameters=model.parameters())
