@@ -64,6 +64,7 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._jit_ok = True
+        self._group_ok = [True]  # grouped-dispatch health (fit)
         self.stop_training = False
 
     # ------------------------------------------------------------ prepare
@@ -116,20 +117,8 @@ class Model:
         """Shard batch dim 0 over the dp mesh axis (DataParallel: the
         EagerReducer capability folds into the compiled step's GSPMD grad
         reduction)."""
-        if getattr(self, "_dist_mesh", None) is None:
-            return arrays
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        mesh = self._dist_mesh
-        dp = mesh.shape.get("dp", 1)
-        out = []
-        for a in arrays:
-            if getattr(a, "ndim", 0) >= 1 and a.shape[0] % dp == 0:
-                spec = P("dp", *([None] * (a.ndim - 1)))
-                out.append(jax.device_put(a, NamedSharding(mesh, spec)))
-            else:
-                out.append(a)
-        return out
+        from ..jit.trainer import shard_batch_dp
+        return shard_batch_dp(arrays, getattr(self, "_dist_mesh", None))
 
     def _train_batch_inner(self, inputs, labels, update=True):
         """Returns ([loss_tensor], metrics) WITHOUT host synchronisation
@@ -251,7 +240,7 @@ class Model:
             # finished at on_train_batch_end either).
             pending = []       # [(step, batch_arrays)]
             last_loss = [None]
-            group_ok = [True]
+            group_ok = self._group_ok   # persists across epochs
 
             def flush():
                 if not pending:

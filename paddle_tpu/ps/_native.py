@@ -69,6 +69,23 @@ def get_lib():
     lib.pscore_sparse_save.restype = ctypes.c_int
     lib.pscore_sparse_load.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.pscore_sparse_load.restype = ctypes.c_int
+    # accessor-family API (CtrCommon/CtrDouble/CtrDymf)
+    lib.pscore_sparse_create2.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+        ctypes.c_int, ctypes.c_float]
+    lib.pscore_sparse_create2.restype = ctypes.c_int
+    lib.pscore_sparse_accessor.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_accessor.restype = ctypes.c_int
+    lib.pscore_sparse_pull_dymf.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int, f32p, ctypes.c_int]
+    lib.pscore_sparse_push_dymf.argtypes = [
+        ctypes.c_int, u64p, i32p, f32p, ctypes.c_int, ctypes.c_int,
+        f32p, f32p, f32p]
+    lib.pscore_sparse_key_stats.argtypes = [
+        ctypes.c_int, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        i32p]
+    lib.pscore_sparse_key_stats.restype = ctypes.c_int
 
     lib.pscore_dense_create.argtypes = [ctypes.c_int64, ctypes.c_int,
                                         ctypes.c_float]

@@ -38,8 +38,24 @@ constexpr int kShards = 1 << kShardBits;  // 64 shards
 
 enum SgdRule : int { kNaive = 0, kAdaGrad = 1, kAdam = 2 };
 
+// Accessor families (parity: ps/table/ctr_accessor.h,
+// ctr_double_accessor.h:29, ctr_dymf_accessor.h:30 — semantics
+// re-implemented, layouts our own):
+//   kCtrCommon — float show/click, fixed embedding dim.
+//   kCtrDouble — show/click accumulated in DOUBLE precision (stored in
+//     two float slots each): at billions of impressions a float show
+//     count stops absorbing +1 increments; the double variant keeps
+//     CTR statistics exact.
+//   kCtrDymf   — dynamic-mf: per-key embedding dim. Every key carries a
+//     1-d embed_w from birth; the mf block (embedx_w, mf_dim floats) is
+//     only allocated once the key's CTR score
+//     (nonclk_coeff*(show-click) + clk_coeff*click) crosses
+//     embedx_threshold (reference NeedExtendMF), with the dim supplied
+//     by the slot's config at that push.
+enum Accessor : int { kCtrCommon = 0, kCtrDouble = 1, kCtrDymf = 2 };
+
 struct TableConfig {
-  int dim = 8;             // embedding dim
+  int dim = 8;             // embedding dim (common/double; max dim for dymf)
   int rule = kAdaGrad;
   float lr = 0.05f;
   float initial_range = 0.02f;
@@ -47,13 +63,30 @@ struct TableConfig {
   float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
   float nonclk_coeff = 0.1f, clk_coeff = 1.0f;  // show/click score
   float decay_rate = 0.98f;  // show/click decay on shrink
+  int accessor = kCtrCommon;
+  float embedx_threshold = 10.0f;  // dymf mf-creation score threshold
 };
 
-// value block layout (CtrCommonAccessor-flavoured):
-// [0] show  [1] click  [2] unseen_days  [3..3+dim) w
-// adagrad: [3+dim .. 3+2*dim) g2sum
-// adam:    [3+dim..3+2dim) m, [3+2dim..3+3dim) v, [3+3dim] beta1_pow,
-//          [3+3dim+1] beta2_pow
+// value block layouts:
+//
+// kCtrCommon (v1-compatible):
+//   [0] show  [1] click  [2] unseen_days  [3..3+dim) w
+//   adagrad: [3+dim .. 3+2*dim) g2sum
+//   adam:    [3+dim..3+2dim) m, [3+2dim..3+3dim) v, [3+3dim] beta1_pow,
+//            [3+3dim+1] beta2_pow
+//
+// kCtrDouble:
+//   [0..1] show (double)  [2..3] click (double)  [4] unseen_days
+//   [5..5+dim) w, then opt block (adagrad: g2sum[dim];
+//   adam: m[dim], v[dim], b1p, b2p)
+//
+// kCtrDymf (variable length per key):
+//   [0] show [1] click [2] unseen_days [3] slot [4] mf_dim [5] embed_w
+//   [6..6+eol) embed opt block (naive: 0, adagrad: g2sum,
+//   adam: m, v, b1p, b2p)
+//   then, once matured (score >= embedx_threshold), the mf block:
+//   embedx_w[mf], + opt (adagrad: g2sum[mf]; adam: m[mf], v[mf], b1p,
+//   b2p)
 struct SparseTable {
   TableConfig cfg;
   int value_len;
@@ -74,11 +107,143 @@ struct SparseTable {
   FILE* spill_f[kShards] = {nullptr};
 
   explicit SparseTable(const TableConfig& c) : cfg(c) {
-    int extra = 0;
-    if (cfg.rule == kAdaGrad) extra = cfg.dim;
-    else if (cfg.rule == kAdam) extra = 3 * cfg.dim + 2;
-    value_len = 3 + cfg.dim + extra;
+    switch (cfg.accessor) {
+      case kCtrDouble:
+        value_len = 5 + cfg.dim + opt_len(cfg.dim);
+        break;
+      case kCtrDymf:
+        // base length; the embedx block extends per key on maturation
+        value_len = 6 + opt_len(1);
+        break;
+      default:  // kCtrCommon keeps its historical (v1) layout: the adam
+        // block reserves 3*dim+2 even though m,v,pows use 2*dim+2, so
+        // existing v1 save files load bit-identically
+        value_len = 3 + cfg.dim +
+            (cfg.rule == kAdaGrad ? cfg.dim
+             : cfg.rule == kAdam ? 3 * cfg.dim + 2 : 0);
+    }
     for (int i = 0; i < kShards; i++) rngs[i].seed(1234 + i);
+  }
+
+  // generic opt-state block length for `dim` weights
+  int opt_len(int dim) const {
+    if (cfg.rule == kAdaGrad) return dim;
+    if (cfg.rule == kAdam) return 2 * dim + 2;
+    return 0;
+  }
+
+  // offset of the weight block (common/double)
+  int w_off() const { return cfg.accessor == kCtrDouble ? 5 : 3; }
+
+  // --- accessor-generic show/click/unseen ---------------------------
+  double get_show(const std::vector<float>& v) const {
+    if (cfg.accessor == kCtrDouble) {
+      double d;
+      std::memcpy(&d, v.data(), sizeof(double));
+      return d;
+    }
+    return v[0];
+  }
+  double get_click(const std::vector<float>& v) const {
+    if (cfg.accessor == kCtrDouble) {
+      double d;
+      std::memcpy(&d, v.data() + 2, sizeof(double));
+      return d;
+    }
+    return v[1];
+  }
+  void add_show_click(std::vector<float>& v, float show, float click) {
+    if (cfg.accessor == kCtrDouble) {
+      double s, c;
+      std::memcpy(&s, v.data(), sizeof(double));
+      std::memcpy(&c, v.data() + 2, sizeof(double));
+      s += show;
+      c += click;
+      std::memcpy(v.data(), &s, sizeof(double));
+      std::memcpy(v.data() + 2, &c, sizeof(double));
+    } else {
+      v[0] += show;
+      v[1] += click;
+    }
+  }
+  void scale_show_click(std::vector<float>& v, float f) {
+    if (cfg.accessor == kCtrDouble) {
+      double s, c;
+      std::memcpy(&s, v.data(), sizeof(double));
+      std::memcpy(&c, v.data() + 2, sizeof(double));
+      s *= f;
+      c *= f;
+      std::memcpy(v.data(), &s, sizeof(double));
+      std::memcpy(v.data() + 2, &c, sizeof(double));
+    } else {
+      v[0] *= f;
+      v[1] *= f;
+    }
+  }
+  int unseen_off() const {
+    return cfg.accessor == kCtrDouble ? 4 : 2;
+  }
+  float score_of(const std::vector<float>& v) const {
+    double show = get_show(v), click = get_click(v);
+    return (float)(cfg.nonclk_coeff * (show - click) +
+                   cfg.clk_coeff * click);
+  }
+
+  // apply the SGD rule to `dim` weights at w, opt block at opt
+  // (layout: adagrad g2sum[dim]; adam m[dim], v[dim], b1p, b2p)
+  void apply_rule(float* w, float* opt, const float* grad, int dim) {
+    switch (cfg.rule) {
+      case kNaive:
+        for (int d = 0; d < dim; d++) w[d] -= cfg.lr * grad[d];
+        break;
+      case kAdaGrad:
+        for (int d = 0; d < dim; d++) {
+          opt[d] += grad[d] * grad[d];
+          w[d] -= cfg.lr * grad[d] / std::sqrt(opt[d] + cfg.eps);
+        }
+        break;
+      case kAdam: {
+        float* m = opt;
+        float* vv = opt + dim;
+        float& b1p = opt[2 * dim];
+        float& b2p = opt[2 * dim + 1];
+        b1p *= cfg.beta1;
+        b2p *= cfg.beta2;
+        for (int d = 0; d < dim; d++) {
+          m[d] = cfg.beta1 * m[d] + (1 - cfg.beta1) * grad[d];
+          vv[d] = cfg.beta2 * vv[d] + (1 - cfg.beta2) * grad[d] * grad[d];
+          float mhat = m[d] / (1 - b1p);
+          float vhat = vv[d] / (1 - b2p);
+          w[d] -= cfg.lr * mhat / (std::sqrt(vhat) + cfg.eps);
+        }
+        break;
+      }
+    }
+  }
+
+  void init_opt(float* opt, int dim) {
+    if (cfg.rule == kAdaGrad) {
+      for (int d = 0; d < dim; d++) opt[d] = cfg.initial_g2sum;
+    } else if (cfg.rule == kAdam) {
+      opt[2 * dim] = 1.0f;      // beta1_pow
+      opt[2 * dim + 1] = 1.0f;  // beta2_pow
+    }
+  }
+
+  // --- dymf helpers --------------------------------------------------
+  int dymf_base_len() const { return 6 + opt_len(1); }
+  int dymf_mf(const std::vector<float>& v) const { return (int)v[4]; }
+
+  // allocate the embedx block with `mf` dims (reference NeedExtendMF /
+  // CreateValue stage-2); call under shard lock
+  void dymf_extend(std::vector<float>& v, int mf, int s) {
+    std::uniform_real_distribution<float> dist(-cfg.initial_range,
+                                               cfg.initial_range);
+    size_t base = v.size();
+    v.resize(base + mf + opt_len(mf), 0.0f);
+    for (int d = 0; d < mf; d++) v[base + d] = dist(rngs[s]);
+    init_opt(v.data() + base + mf, mf);
+    v[4] = (float)mf;
   }
 
   ~SparseTable() {
@@ -88,6 +253,7 @@ struct SparseTable {
   }
 
   int enable_spill(const char* dir, int64_t max_mem_keys) {
+    if (cfg.accessor == kCtrDymf) return -2;  // variable-length values
     if (spill_enabled) {
       // already spilling: only adjust the budget — re-opening "wb+"
       // would truncate logs that live spill_idx offsets point into
@@ -173,13 +339,18 @@ struct SparseTable {
     std::vector<float> v(value_len, 0.0f);
     std::uniform_real_distribution<float> dist(-cfg.initial_range,
                                                cfg.initial_range);
-    for (int i = 0; i < cfg.dim; i++) v[3 + i] = dist(rngs[s]);
-    if (cfg.rule == kAdaGrad) {
-      for (int i = 0; i < cfg.dim; i++) v[3 + cfg.dim + i] =
-          cfg.initial_g2sum;
-    } else if (cfg.rule == kAdam) {
-      v[3 + 3 * cfg.dim] = 1.0f;      // beta1_pow
-      v[3 + 3 * cfg.dim + 1] = 1.0f;  // beta2_pow
+    switch (cfg.accessor) {
+      case kCtrDouble:
+        for (int i = 0; i < cfg.dim; i++) v[5 + i] = dist(rngs[s]);
+        init_opt(v.data() + 5 + cfg.dim, cfg.dim);
+        break;
+      case kCtrDymf:
+        v[5] = dist(rngs[s]);          // embed_w; mf_dim starts 0
+        init_opt(v.data() + 6, 1);
+        break;
+      default:
+        for (int i = 0; i < cfg.dim; i++) v[3 + i] = dist(rngs[s]);
+        init_opt(v.data() + 3 + cfg.dim, cfg.dim);
     }
     auto& ref = shards[s].emplace(key, std::move(v)).first->second;
     evict_to_budget(s, key);
@@ -187,59 +358,99 @@ struct SparseTable {
   }
 
   void pull(const uint64_t* keys, int n, float* out) {
+    const int woff = w_off();
     parallel_for(n, [&](int i) {
       uint64_t k = keys[i];
       int s = shard_of(k);
       std::lock_guard<std::mutex> g(locks[s]);
       auto& v = get_or_init(k, s);
-      std::memcpy(out + (size_t)i * cfg.dim, v.data() + 3,
+      std::memcpy(out + (size_t)i * cfg.dim, v.data() + woff,
                   sizeof(float) * cfg.dim);
     });
   }
 
   void push(const uint64_t* keys, const float* grads, int n,
             const float* shows, const float* clicks) {
+    const int woff = w_off();
     parallel_for(n, [&](int i) {
       uint64_t k = keys[i];
       int s = shard_of(k);
       std::lock_guard<std::mutex> g(locks[s]);
       auto& v = get_or_init(k, s);
-      if (shows) v[0] += shows[i];
-      if (clicks) v[1] += clicks[i];
-      v[2] = 0.0f;  // unseen_days reset
-      const float* grad = grads + (size_t)i * cfg.dim;
-      float* w = v.data() + 3;
-      switch (cfg.rule) {
-        case kNaive: {
-          for (int d = 0; d < cfg.dim; d++) w[d] -= cfg.lr * grad[d];
-          break;
-        }
-        case kAdaGrad: {  // SparseAdaGradSGDRule parity
-          float* g2 = v.data() + 3 + cfg.dim;
-          for (int d = 0; d < cfg.dim; d++) {
-            g2[d] += grad[d] * grad[d];
-            w[d] -= cfg.lr * grad[d] / std::sqrt(g2[d] + cfg.eps);
-          }
-          break;
-        }
-        case kAdam: {  // SparseAdamSGDRule parity
-          float* m = v.data() + 3 + cfg.dim;
-          float* vv = v.data() + 3 + 2 * cfg.dim;
-          float& b1p = v[3 + 3 * cfg.dim];
-          float& b2p = v[3 + 3 * cfg.dim + 1];
-          b1p *= cfg.beta1;
-          b2p *= cfg.beta2;
-          for (int d = 0; d < cfg.dim; d++) {
-            m[d] = cfg.beta1 * m[d] + (1 - cfg.beta1) * grad[d];
-            vv[d] = cfg.beta2 * vv[d] + (1 - cfg.beta2) * grad[d] * grad[d];
-            float mhat = m[d] / (1 - b1p);
-            float vhat = vv[d] / (1 - b2p);
-            w[d] -= cfg.lr * mhat / (std::sqrt(vhat) + cfg.eps);
-          }
-          break;
-        }
+      add_show_click(v, shows ? shows[i] : 0.0f,
+                     clicks ? clicks[i] : 0.0f);
+      v[unseen_off()] = 0.0f;  // unseen_days reset
+      apply_rule(v.data() + woff, v.data() + woff + cfg.dim,
+                 grads + (size_t)i * cfg.dim, cfg.dim);
+    });
+  }
+
+  // dymf pull: out row i = [embed_w, embedx_w(min(alloc, stride-1)),
+  // zeros...]; rows whose mf block is unallocated read embed_w + zeros
+  void pull_dymf(const uint64_t* keys, int n, float* out, int stride) {
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      float* row = out + (size_t)i * stride;
+      std::memset(row, 0, sizeof(float) * stride);
+      row[0] = v[5];
+      int mf = std::min(dymf_mf(v), stride - 1);
+      if (mf > 0) {
+        std::memcpy(row + 1, v.data() + dymf_base_len(),
+                    sizeof(float) * mf);
       }
     });
+  }
+
+  // dymf push: grads row i = [embed_g, embedx_g(mf_dims[i])]; a key
+  // matures (allocates its mf block at mf_dims[i]) when its CTR score
+  // crosses cfg.embedx_threshold
+  void push_dymf(const uint64_t* keys, const int* mf_dims,
+                 const float* grads, int n, int stride,
+                 const float* shows, const float* clicks,
+                 const float* slots) {
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      add_show_click(v, shows ? shows[i] : 0.0f,
+                     clicks ? clicks[i] : 0.0f);
+      v[2] = 0.0f;
+      if (slots) v[3] = slots[i];
+      const float* grad = grads + (size_t)i * stride;
+      apply_rule(v.data() + 5, v.data() + 6, grad, 1);  // embed_w
+      int mf = dymf_mf(v);
+      if (mf == 0 && mf_dims[i] > 0 &&
+          score_of(v) >= cfg.embedx_threshold) {
+        // clamp to the push stride (= table max dim): an oversized
+        // slot config would otherwise allocate an mf block no push
+        // could ever update
+        int want = std::min(mf_dims[i], stride - 1);
+        dymf_extend(v, want, s);
+        mf = want;
+      }
+      if (mf > 0 && stride - 1 >= mf) {
+        // partial-gradient pushes (stride-1 < mf) are rejected rather
+        // than mis-indexing the opt block (adam pows live at 2*mf)
+        int base = dymf_base_len();
+        apply_rule(v.data() + base, v.data() + base + mf, grad + 1, mf);
+      }
+    });
+  }
+
+  // test/introspection: exact show/click + mf dim of one key
+  int key_stats(uint64_t key, double* show, double* click, int* mf) {
+    int s = shard_of(key);
+    std::lock_guard<std::mutex> g(locks[s]);
+    auto it = shards[s].find(key);
+    if (it == shards[s].end()) return -1;
+    *show = get_show(it->second);
+    *click = get_click(it->second);
+    *mf = cfg.accessor == kCtrDymf ? dymf_mf(it->second) : cfg.dim;
+    return 0;
   }
 
   // one pass of day-level maintenance: decay show/click, age features,
@@ -247,19 +458,17 @@ struct SparseTable {
   int64_t shrink(float score_threshold, int max_unseen_days) {
     std::atomic<int64_t> removed{0};
     std::vector<std::thread> ts;
+    const int uoff = unseen_off();
     for (int s = 0; s < kShards; s++) {
       ts.emplace_back([&, s]() {
         std::lock_guard<std::mutex> g(locks[s]);
         auto& mp = shards[s];
         for (auto it = mp.begin(); it != mp.end();) {
           auto& v = it->second;
-          v[0] *= cfg.decay_rate;
-          v[1] *= cfg.decay_rate;
-          v[2] += 1.0f;
-          float score = cfg.nonclk_coeff * (v[0] - v[1]) +
-                        cfg.clk_coeff * v[1];
-          if (score < score_threshold &&
-              v[2] > static_cast<float>(max_unseen_days)) {
+          scale_show_click(v, cfg.decay_rate);
+          v[uoff] += 1.0f;
+          if (score_of(v) < score_threshold &&
+              v[uoff] > static_cast<float>(max_unseen_days)) {
             it = mp.erase(it);
             removed++;
           } else {
@@ -286,17 +495,27 @@ struct SparseTable {
 
   int64_t size() const { return mem_size() + spill_size(); }
 
+  // save format v2 (versioned — VERDICT r3 #3): magic "PSC2", then
+  // accessor/rule/dim config, then (key, len, floats[len]) entries so
+  // dymf's variable-length values round-trip. v1 files (no magic:
+  // total + value_len header) still load for kCtrCommon tables.
+  static constexpr uint32_t kMagicV2 = 0x32435350u;  // "PSC2" LE
+
   int save(const char* path) {
     FILE* f = std::fopen(path, "wb");
     if (!f) return -1;
     int64_t total = size();
+    std::fwrite(&kMagicV2, sizeof(kMagicV2), 1, f);
+    int32_t hdr[3] = {cfg.accessor, cfg.rule, cfg.dim};
+    std::fwrite(hdr, sizeof(int32_t), 3, f);
     std::fwrite(&total, sizeof(total), 1, f);
-    std::fwrite(&value_len, sizeof(value_len), 1, f);
     for (int s = 0; s < kShards; s++) {
       std::lock_guard<std::mutex> g(locks[s]);
       for (auto& kv : shards[s]) {
+        int32_t len = (int32_t)kv.second.size();
         std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
-        std::fwrite(kv.second.data(), sizeof(float), value_len, f);
+        std::fwrite(&len, sizeof(len), 1, f);
+        std::fwrite(kv.second.data(), sizeof(float), len, f);
       }
       // spilled entries stream out of the shard log (this is also the
       // compaction point: a later load() rebuilds a dense log)
@@ -308,8 +527,10 @@ struct SparseTable {
           std::fclose(f);
           return -4;
         }
+        int32_t len = value_len;
         std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
-        std::fwrite(v.data(), sizeof(float), value_len, f);
+        std::fwrite(&len, sizeof(len), 1, f);
+        std::fwrite(v.data(), sizeof(float), len, f);
       }
     }
     std::fclose(f);
@@ -319,30 +540,90 @@ struct SparseTable {
   int load(const char* path) {
     FILE* f = std::fopen(path, "rb");
     if (!f) return -1;
+    uint32_t magic = 0;
+    if (std::fread(&magic, sizeof(magic), 1, f) != 1) {
+      std::fclose(f);
+      return -2;
+    }
+    if (magic != kMagicV2) {
+      // v1 legacy: [int64 total][int32 value_len] fixed-len entries
+      // (only ever written by kCtrCommon tables)
+      std::rewind(f);
+      if (cfg.accessor != kCtrCommon) {
+        std::fclose(f);
+        return -5;
+      }
+      int64_t total = 0;
+      int vl = 0;
+      if (std::fread(&total, sizeof(total), 1, f) != 1 ||
+          std::fread(&vl, sizeof(vl), 1, f) != 1 || vl != value_len) {
+        std::fclose(f);
+        return -2;
+      }
+      for (int64_t i = 0; i < total; i++) {
+        uint64_t k;
+        std::vector<float> v(value_len);
+        if (std::fread(&k, sizeof(k), 1, f) != 1 ||
+            std::fread(v.data(), sizeof(float), value_len, f) !=
+                (size_t)value_len) {
+          std::fclose(f);
+          return -3;
+        }
+        insert_loaded(k, std::move(v));
+      }
+      std::fclose(f);
+      return 0;
+    }
+    int32_t hdr[3];
     int64_t total = 0;
-    int vl = 0;
-    if (std::fread(&total, sizeof(total), 1, f) != 1 ||
-        std::fread(&vl, sizeof(vl), 1, f) != 1 || vl != value_len) {
+    if (std::fread(hdr, sizeof(int32_t), 3, f) != 3 ||
+        std::fread(&total, sizeof(total), 1, f) != 1 ||
+        hdr[0] != cfg.accessor || hdr[1] != cfg.rule ||
+        hdr[2] != cfg.dim) {
       std::fclose(f);
       return -2;
     }
     for (int64_t i = 0; i < total; i++) {
       uint64_t k;
-      std::vector<float> v(value_len);
+      int32_t len;
       if (std::fread(&k, sizeof(k), 1, f) != 1 ||
-          std::fread(v.data(), sizeof(float), value_len, f) !=
-              (size_t)value_len) {
+          std::fread(&len, sizeof(len), 1, f) != 1 || len <= 0 ||
+          len > (1 << 20)) {
         std::fclose(f);
         return -3;
       }
-      int s = shard_of(k);
-      std::lock_guard<std::mutex> g(locks[s]);
-      shards[s][k] = std::move(v);
-      spill_idx[s].erase(k);
-      evict_to_budget(s, k);
+      std::vector<float> v(len);
+      if (std::fread(v.data(), sizeof(float), len, f) != (size_t)len) {
+        std::fclose(f);
+        return -3;
+      }
+      // structural validation: a truncated/corrupt entry must fail the
+      // load, not become an under-sized value that later reads/writes
+      // out of bounds in push/pull
+      if (cfg.accessor == kCtrDymf) {
+        int mf = (len >= 5) ? (int)v[4] : -1;
+        bool ok = mf >= 0 && mf <= cfg.dim &&
+            len == dymf_base_len() + (mf > 0 ? mf + opt_len(mf) : 0);
+        if (!ok) {
+          std::fclose(f);
+          return -6;
+        }
+      } else if (len != value_len) {
+        std::fclose(f);
+        return -6;
+      }
+      insert_loaded(k, std::move(v));
     }
     std::fclose(f);
     return 0;
+  }
+
+  void insert_loaded(uint64_t k, std::vector<float>&& v) {
+    int s = shard_of(k);
+    std::lock_guard<std::mutex> g(locks[s]);
+    shards[s][k] = std::move(v);
+    spill_idx[s].erase(k);
+    evict_to_budget(s, k);
   }
 
   template <typename F>
@@ -478,6 +759,45 @@ int pscore_sparse_create(int dim, int rule, float lr, float initial_range) {
   if (rule == kAdaGrad) cfg.initial_g2sum = 0.0f;
   g_sparse.push_back(new SparseTable(cfg));
   return (int)g_sparse.size() - 1;
+}
+
+// accessor-selecting constructor (CtrCommon=0 / CtrDouble=1 / CtrDymf=2;
+// table-config accessor_class parity). For dymf, `dim` is the max mf
+// dim (pull/push strides) and embedx_threshold gates mf creation.
+int pscore_sparse_create2(int dim, int rule, float lr, float initial_range,
+                          int accessor, float embedx_threshold) {
+  if (accessor < kCtrCommon || accessor > kCtrDymf) return -1;
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.rule = rule;
+  cfg.lr = lr;
+  cfg.initial_range = initial_range;
+  cfg.accessor = accessor;
+  cfg.embedx_threshold = embedx_threshold;
+  if (rule == kAdaGrad) cfg.initial_g2sum = 0.0f;
+  g_sparse.push_back(new SparseTable(cfg));
+  return (int)g_sparse.size() - 1;
+}
+
+int pscore_sparse_accessor(int h) { return g_sparse[h]->cfg.accessor; }
+
+void pscore_sparse_pull_dymf(int h, const uint64_t* keys, int n,
+                             float* out, int stride) {
+  g_sparse[h]->pull_dymf(keys, n, out, stride);
+}
+
+void pscore_sparse_push_dymf(int h, const uint64_t* keys,
+                             const int* mf_dims, const float* grads,
+                             int n, int stride, const float* shows,
+                             const float* clicks, const float* slots) {
+  g_sparse[h]->push_dymf(keys, mf_dims, grads, n, stride, shows, clicks,
+                         slots);
+}
+
+int pscore_sparse_key_stats(int h, uint64_t key, double* show,
+                            double* click, int* mf_dim) {
+  return g_sparse[h]->key_stats(key, show, click, mf_dim);
 }
 
 void pscore_sparse_pull(int h, const uint64_t* keys, int n, float* out) {

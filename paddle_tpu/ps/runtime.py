@@ -42,24 +42,31 @@ class PSRuntime:
 
     # ---- table registry (the_one_ps table config parity) ----
     def create_sparse_table(self, table_id, dim=8, sgd_rule="adagrad",
-                            learning_rate=0.05, initial_range=0.02):
+                            learning_rate=0.05, initial_range=0.02,
+                            accessor="ctr", embedx_threshold=10.0):
+        """`accessor` selects the value layout family (the_one_ps
+        table-config accessor_class parity): "ctr" | "ctr_double" |
+        "ctr_dymf" (see table.MemorySparseTable)."""
         self._table_configs[table_id] = dict(
             kind="sparse", dim=dim, sgd_rule=sgd_rule,
-            learning_rate=learning_rate, initial_range=initial_range)
+            learning_rate=learning_rate, initial_range=initial_range,
+            accessor=accessor, embedx_threshold=embedx_threshold)
         if self.is_distributed:
             if self.role == "TRAINER":
                 from .service import RemoteSparseTable
                 self.init_worker()
                 self._tables.setdefault(
                     table_id,
-                    RemoteSparseTable(self._client, table_id, dim))
+                    RemoteSparseTable(self._client, table_id, dim,
+                                      accessor=accessor))
                 return self._tables[table_id]
             # PSERVER: the real table lives in the PSServer (registered at
             # init_server from the recorded config) — no local duplicate
             return None
         if table_id not in self._tables:
             self._tables[table_id] = MemorySparseTable(
-                dim, sgd_rule, learning_rate, initial_range)
+                dim, sgd_rule, learning_rate, initial_range,
+                accessor=accessor, embedx_threshold=embedx_threshold)
         return self._tables[table_id]
 
     def create_dense_table(self, table_id, size, sgd_rule="adam",
@@ -88,7 +95,8 @@ class PSRuntime:
             if cfg["kind"] == "sparse":
                 self._server.register_sparse_table(
                     tid, cfg["dim"], cfg["sgd_rule"], cfg["learning_rate"],
-                    cfg["initial_range"])
+                    cfg["initial_range"], cfg.get("accessor", "ctr"),
+                    cfg.get("embedx_threshold", 10.0))
             else:
                 self._server.register_dense_table(
                     tid, cfg["size"], cfg["sgd_rule"], cfg["learning_rate"])

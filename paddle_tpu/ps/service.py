@@ -91,8 +91,11 @@ class PSServer:
         self._thread = None
 
     def register_sparse_table(self, table_id, dim=8, sgd_rule="adagrad",
-                              learning_rate=0.05, initial_range=0.02):
-        t = MemorySparseTable(dim, sgd_rule, learning_rate, initial_range)
+                              learning_rate=0.05, initial_range=0.02,
+                              accessor="ctr", embedx_threshold=10.0):
+        t = MemorySparseTable(dim, sgd_rule, learning_rate, initial_range,
+                              accessor=accessor,
+                              embedx_threshold=embedx_threshold)
         self._tables[table_id] = t
         return t
 
@@ -135,8 +138,9 @@ class PSServer:
         elif op == PUSH_SPARSE:
             (n,) = struct.unpack("<I", body[:4])
             keys = np.frombuffer(body[4:4 + 8 * n], np.uint64)
+            width = getattr(table, "row_width", table.dim)
             grads = np.frombuffer(body[4 + 8 * n:], np.float32).reshape(
-                n, table.dim)
+                n, width)
             table.push(keys.copy(), grads.copy())
             _send_msg(sock, b"\x01")
         elif op == PULL_DENSE:
@@ -320,18 +324,26 @@ class RemoteSparseTable:
     SparseEmbedding works transparently against remote servers — the
     distributed_lookup_table capability)."""
 
-    def __init__(self, client: PSClient, table_id: int, dim: int):
+    def __init__(self, client: PSClient, table_id: int, dim: int,
+                 accessor="ctr"):
+        from .table import _ACCESSORS, ACCESSOR_CTR_DYMF
         self.client = client
         self.table_id = table_id
         self.dim = dim
+        acc = _ACCESSORS[accessor] if isinstance(accessor, str) \
+            else int(accessor)
+        self.accessor = acc
+        # dymf rows travel as [embed_w, embedx(dim)] = 1+dim floats
+        self.row_width = 1 + dim if acc == ACCESSOR_CTR_DYMF else dim
 
     def pull(self, keys):
         return self.client.pull_sparse(self.table_id, np.asarray(keys),
-                                       self.dim)
+                                       self.row_width)
 
-    def push(self, keys, grads, shows=None, clicks=None):
+    def push(self, keys, grads, shows=None, clicks=None, mf_dims=None,
+             slots=None):
         self.client.push_sparse(self.table_id, np.asarray(keys),
-                                np.asarray(grads), self.dim)
+                                np.asarray(grads), self.row_width)
 
     def __len__(self):
         raise NotImplementedError("size query not in the wire protocol yet")

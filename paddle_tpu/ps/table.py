@@ -18,36 +18,127 @@ SGD_ADAM = 2
 _RULES = {"naive": SGD_NAIVE, "sgd": SGD_NAIVE, "adagrad": SGD_ADAGRAD,
           "std_adagrad": SGD_ADAGRAD, "adam": SGD_ADAM}
 
+ACCESSOR_CTR = 0         # CtrCommonAccessor: float show/click
+ACCESSOR_CTR_DOUBLE = 1  # CtrDoubleAccessor: double show/click
+ACCESSOR_CTR_DYMF = 2    # CtrDymfAccessor: per-key dynamic mf dims
+
+_ACCESSORS = {"ctr": ACCESSOR_CTR, "CtrCommonAccessor": ACCESSOR_CTR,
+              "DownpourCtrAccessor": ACCESSOR_CTR,
+              "ctr_double": ACCESSOR_CTR_DOUBLE,
+              "CtrDoubleAccessor": ACCESSOR_CTR_DOUBLE,
+              "DownpourCtrDoubleAccessor": ACCESSOR_CTR_DOUBLE,
+              "ctr_dymf": ACCESSOR_CTR_DYMF,
+              "CtrDymfAccessor": ACCESSOR_CTR_DYMF}
+
 
 class MemorySparseTable:
+    """Sparse table with selectable accessor family.
+
+    accessor="ctr" (default, CtrCommonAccessor parity),
+    "ctr_double" (CtrDoubleAccessor: show/click accumulated in double —
+    exact CTR statistics at billions of impressions), or
+    "ctr_dymf" (CtrDymfAccessor: per-key dynamic mf dims — keys carry a
+    1-d embed_w from birth and only grow their mf block, at the slot's
+    dim, once their CTR score crosses `embedx_threshold`).
+    Ref: ctr_accessor.h, ctr_double_accessor.h:29, ctr_dymf_accessor.h:30.
+    """
+
     def __init__(self, dim=8, sgd_rule="adagrad", learning_rate=0.05,
-                 initial_range=0.02):
+                 initial_range=0.02, accessor="ctr",
+                 embedx_threshold=10.0):
         self.dim = dim
         self._lib = get_lib()
         rule = _RULES[sgd_rule] if isinstance(sgd_rule, str) else sgd_rule
-        self._h = self._lib.pscore_sparse_create(
-            dim, rule, float(learning_rate), float(initial_range))
+        acc = _ACCESSORS[accessor] if isinstance(accessor, str) \
+            else int(accessor)
+        self.accessor = acc
+        if acc == ACCESSOR_CTR:
+            self._h = self._lib.pscore_sparse_create(
+                dim, rule, float(learning_rate), float(initial_range))
+        else:
+            self._h = self._lib.pscore_sparse_create2(
+                dim, rule, float(learning_rate), float(initial_range),
+                acc, float(embedx_threshold))
+        if self._h < 0:
+            raise ValueError(f"bad accessor {accessor}")
 
     def pull(self, keys: np.ndarray) -> np.ndarray:
-        """keys: uint64 [n] (any shape; flattened) -> float32 [*, dim]."""
+        """keys: uint64 [n] (any shape; flattened) -> float32 [*, dim].
+
+        dymf tables return rows [1 + dim]: [embed_w, embedx_w...] with
+        zeros past each key's allocated mf dim."""
         shape = keys.shape
         flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        if self.accessor == ACCESSOR_CTR_DYMF:
+            stride = 1 + self.dim
+            out = np.empty((flat.size, stride), np.float32)
+            self._lib.pscore_sparse_pull_dymf(
+                self._h, u64_ptr(flat), flat.size, f32_ptr(out), stride)
+            return out.reshape(*shape, stride)
         out = np.empty((flat.size, self.dim), np.float32)
         self._lib.pscore_sparse_pull(self._h, u64_ptr(flat), flat.size,
                                      f32_ptr(out))
         return out.reshape(*shape, self.dim)
 
     def push(self, keys: np.ndarray, grads: np.ndarray, shows=None,
-             clicks=None):
+             clicks=None, mf_dims=None, slots=None):
+        """dymf tables: grads rows are [embed_g, embedx_g(dim)];
+        `mf_dims` [n] gives each key's slot-configured mf dim (used the
+        moment the key matures past embedx_threshold; defaults to the
+        table max dim)."""
         flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        sp = np.ascontiguousarray(np.asarray(shows).reshape(-1),
+                                  np.float32) if shows is not None \
+            else None
+        cp = np.ascontiguousarray(np.asarray(clicks).reshape(-1),
+                                  np.float32) if clicks is not None \
+            else None
+        if self.accessor == ACCESSOR_CTR_DYMF:
+            stride = 1 + self.dim
+            g = np.ascontiguousarray(grads.reshape(flat.size, stride),
+                                     dtype=np.float32)
+            md = np.ascontiguousarray(
+                np.asarray(mf_dims).reshape(-1) if mf_dims is not None
+                else np.full(flat.size, self.dim), np.int32)
+            sl = np.ascontiguousarray(np.asarray(slots).reshape(-1),
+                                      np.float32) if slots is not None \
+                else None
+            self._lib.pscore_sparse_push_dymf(
+                self._h, u64_ptr(flat), i32_ptr(md), f32_ptr(g),
+                flat.size, stride,
+                f32_ptr(sp) if sp is not None else None,
+                f32_ptr(cp) if cp is not None else None,
+                f32_ptr(sl) if sl is not None else None)
+            return
         g = np.ascontiguousarray(grads.reshape(flat.size, self.dim),
                                  dtype=np.float32)
-        sp = f32_ptr(np.ascontiguousarray(shows, np.float32)) \
-            if shows is not None else None
-        cp = f32_ptr(np.ascontiguousarray(clicks, np.float32)) \
-            if clicks is not None else None
         self._lib.pscore_sparse_push(self._h, u64_ptr(flat), f32_ptr(g),
-                                     flat.size, sp, cp)
+                                     flat.size,
+                                     f32_ptr(sp) if sp is not None
+                                     else None,
+                                     f32_ptr(cp) if cp is not None
+                                     else None)
+
+    def key_stats(self, key: int):
+        """(show, click, mf_dim) of one key — show/click exact doubles
+        for the ctr_double accessor. None if the key is absent."""
+        import ctypes
+        show = ctypes.c_double()
+        click = ctypes.c_double()
+        mf = (np.zeros(1, np.int32))
+        rc = self._lib.pscore_sparse_key_stats(
+            self._h, ctypes.c_uint64(int(key)), ctypes.byref(show),
+            ctypes.byref(click), i32_ptr(mf))
+        if rc != 0:
+            return None
+        return float(show.value), float(click.value), int(mf[0])
+
+    @property
+    def row_width(self):
+        """Floats per key in pull/push payloads: dim, or 1+dim for dymf
+        ([embed_w, embedx...]). The PS wire protocol sizes rows by this."""
+        return 1 + self.dim if self.accessor == ACCESSOR_CTR_DYMF \
+            else self.dim
 
     def __len__(self):
         return int(self._lib.pscore_sparse_size(self._h))

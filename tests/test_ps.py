@@ -257,3 +257,126 @@ def test_geo_communicator_merges_trainers():
         pb = geo_b.maybe_sync_dense(server, pb)
     merged = server.pull()
     assert merged[0] == 4.0 and merged[1] == 4.0, merged
+
+
+# --------------------------------------------------------- accessor families
+# Parity: ctr_double_accessor.h:29 (double show/click),
+# ctr_dymf_accessor.h:30 (per-key dynamic mf dims), ctr_accessor_test.cc.
+
+
+def test_ctr_double_accessor_exact_counts():
+    """Float show counts stop absorbing +1 at 2^24; the double accessor
+    must keep exact statistics."""
+    big = float(1 << 24)
+    tf = MemorySparseTable(dim=4, sgd_rule="naive", accessor="ctr")
+    td = MemorySparseTable(dim=4, sgd_rule="naive", accessor="ctr_double")
+    keys = np.array([42], np.uint64)
+    g = np.zeros((1, 4), np.float32)
+    for t in (tf, td):
+        t.push(keys, g, shows=np.array([big], np.float32),
+               clicks=np.array([0.0], np.float32))
+        for _ in range(10):
+            t.push(keys, g, shows=np.array([1.0], np.float32),
+                   clicks=np.array([1.0], np.float32))
+    show_f, click_f, _ = tf.key_stats(42)
+    show_d, click_d, _ = td.key_stats(42)
+    assert show_d == big + 10 and click_d == 10
+    assert show_f == big  # float path saturated (the failure mode)
+    assert click_f == 10
+
+
+def test_ctr_double_trains_and_roundtrips(tmp_path):
+    t = MemorySparseTable(dim=8, sgd_rule="adagrad",
+                          accessor="ctr_double", learning_rate=0.1)
+    keys = np.arange(1, 33, dtype=np.uint64)
+    w0 = t.pull(keys)
+    for _ in range(5):
+        t.push(keys, np.ones((32, 8), np.float32),
+               shows=np.ones(32, np.float32),
+               clicks=np.zeros(32, np.float32))
+    w1 = t.pull(keys)
+    assert (w1 < w0).all()  # positive grads moved weights down
+    p = str(tmp_path / "double.tbl")
+    t.save(p)
+    t2 = MemorySparseTable(dim=8, sgd_rule="adagrad",
+                           accessor="ctr_double")
+    t2.load(p)
+    np.testing.assert_array_equal(t2.pull(keys), w1)
+    assert t2.key_stats(1) == t.key_stats(1)
+
+
+def test_ctr_dymf_maturation_and_mixed_dims():
+    """Keys grow their mf block only past embedx_threshold, each at its
+    own slot-configured dim — one pull serves mixed-dim keys."""
+    t = MemorySparseTable(dim=8, sgd_rule="adagrad", accessor="ctr_dymf",
+                          learning_rate=0.1, embedx_threshold=5.0)
+    keys = np.array([100, 200, 300], np.uint64)
+    dims = np.array([8, 4, 8], np.int32)
+    # cold push: scores stay below threshold -> no mf anywhere
+    t.push(keys, np.zeros((3, 9), np.float32), mf_dims=dims,
+           shows=np.full(3, 0.5, np.float32),
+           clicks=np.zeros(3, np.float32))
+    out = t.pull(keys)
+    assert out.shape == (3, 9)
+    np.testing.assert_array_equal(out[:, 1:], 0.0)
+    assert t.key_stats(100)[2] == 0
+    # keys 100 (dim 8) and 200 (dim 4) mature; 300 stays cold
+    t.push(keys[:2], np.zeros((2, 9), np.float32), mf_dims=dims[:2],
+           shows=np.array([50.0, 50.0], np.float32),
+           clicks=np.array([10.0, 10.0], np.float32))
+    assert t.key_stats(100)[2] == 8
+    assert t.key_stats(200)[2] == 4
+    assert t.key_stats(300)[2] == 0
+    out = t.pull(keys)
+    assert np.abs(out[0, 1:]).max() > 0          # dim-8 mf live
+    assert np.abs(out[1, 1:5]).max() > 0         # dim-4 mf live
+    np.testing.assert_array_equal(out[1, 5:], 0)  # beyond key 200's dim
+    np.testing.assert_array_equal(out[2, 1:], 0)  # still cold
+    # gradients now move both embed_w and the allocated mf block
+    before = t.pull(keys[:1])
+    t.push(keys[:1], np.ones((1, 9), np.float32), mf_dims=dims[:1])
+    after = t.pull(keys[:1])
+    assert (after[0] < before[0]).all()
+
+
+def test_ctr_dymf_save_load_roundtrip(tmp_path):
+    t = MemorySparseTable(dim=6, sgd_rule="adam", accessor="ctr_dymf",
+                          embedx_threshold=1.0)
+    keys = np.array([7, 8], np.uint64)
+    t.push(keys, np.ones((2, 7), np.float32) * 0.1,
+           mf_dims=np.array([6, 3], np.int32),
+           shows=np.full(2, 10.0, np.float32),
+           clicks=np.full(2, 5.0, np.float32))
+    w = t.pull(keys)
+    p = str(tmp_path / "dymf.tbl")
+    t.save(p)
+    t2 = MemorySparseTable(dim=6, sgd_rule="adam", accessor="ctr_dymf")
+    t2.load(p)
+    np.testing.assert_array_equal(t2.pull(keys), w)
+    assert t2.key_stats(7)[2] == 6 and t2.key_stats(8)[2] == 3
+    # header mismatch (wrong accessor) is rejected, not misread
+    t3 = MemorySparseTable(dim=6, sgd_rule="adam", accessor="ctr_double")
+    with pytest.raises(IOError):
+        t3.load(p)
+
+
+def test_ctr_dymf_rejects_spill(tmp_path):
+    t = MemorySparseTable(dim=4, accessor="ctr_dymf")
+    with pytest.raises(IOError):
+        t.enable_spill(str(tmp_path / "sp"), 10)
+
+
+def test_accessor_shrink_decays_double():
+    t = MemorySparseTable(dim=4, sgd_rule="naive", accessor="ctr_double")
+    keys = np.array([5], np.uint64)
+    t.push(keys, np.zeros((1, 4), np.float32),
+           shows=np.array([100.0], np.float32),
+           clicks=np.array([40.0], np.float32))
+    t.shrink(threshold=0.0, max_unseen_days=30)
+    show, click, _ = t.key_stats(5)
+    # decay coefficient itself is f32 (0.98f), so compare at f32 eps
+    assert abs(show - 98.0) < 1e-4 and abs(click - 39.2) < 1e-4
+    # low-score aged features drop
+    for _ in range(40):
+        t.shrink(threshold=1e9, max_unseen_days=3)
+    assert len(t) == 0

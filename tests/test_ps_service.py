@@ -215,3 +215,27 @@ def test_ps_client_qps_microbench():
     finally:
         cli.close()
         srv.stop()
+
+
+def test_dymf_over_wire():
+    """dymf rows ([embed_w, embedx(dim)] = 1+dim floats) must size the
+    wire payloads via row_width on both ends."""
+    s = PSServer()
+    s.register_sparse_table(0, dim=4, sgd_rule="naive", learning_rate=0.5,
+                            accessor="ctr_dymf", embedx_threshold=1e9)
+    s.run()
+    client = PSClient([f"127.0.0.1:{s.port}"])
+    try:
+        remote = RemoteSparseTable(client, 0, dim=4, accessor="ctr_dymf")
+        keys = np.arange(1, 9, dtype=np.uint64)
+        v0 = remote.pull(keys)
+        assert v0.shape == (8, 5)          # [embed_w, 4 zeros]
+        np.testing.assert_array_equal(v0[:, 1:], 0.0)
+        remote.push(keys, np.ones((8, 5), np.float32))
+        v1 = remote.pull(keys)
+        # naive sgd on embed_w (threshold never crossed -> mf stays cold)
+        np.testing.assert_allclose(v1[:, 0], v0[:, 0] - 0.5, rtol=1e-5)
+        np.testing.assert_array_equal(v1[:, 1:], 0.0)
+    finally:
+        client.stop_server()
+        client.close()
