@@ -261,3 +261,271 @@ def test_model_fit_with_data_dependent_if_compiles():
     assert model._jit_ok, "data-dependent if forced eager fallback"
     for _ in range(3):
         model.train_batch([x], [y])
+
+
+# ---------------------------------------------- r4: break/continue/return
+
+
+def _assert_traces(fn, *args):
+    """The rewritten fn must trace under jax.jit (a leftover python
+    bool() on a tracer would raise TracerBoolConversionError)."""
+    import jax
+    from paddle_tpu.core.tensor import Tensor
+
+    def pure(*arrs):
+        out = fn(*[Tensor(a) for a in arrs])
+        return out._data if isinstance(out, Tensor) else out
+
+    return jax.jit(pure)(*[a._data for a in args])
+
+
+def test_midbody_break_compiles():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = x * 0
+        i = 0
+        while i < 10:
+            s = s + x
+            if (s.sum() > 6):
+                break
+            i += 1
+        return s
+
+    tf = dy2static.transform_function(f)
+    assert tf is not f
+    for v in (1.0, 0.1):
+        x = paddle.to_tensor(np.full((2,), v, np.float32))
+        eager = f(x).numpy()
+        np.testing.assert_allclose(tf(x).numpy(), eager, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(_assert_traces(tf, x)), eager, rtol=1e-6)
+
+
+def test_midbody_continue_compiles():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = x * 0
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            s = s + x * i
+        return s
+
+    tf = dy2static.transform_function(f)
+    assert tf is not f
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    eager = f(x).numpy()   # 1+3+5 = 9
+    np.testing.assert_allclose(eager, 9.0)
+    np.testing.assert_allclose(tf(x).numpy(), eager, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(_assert_traces(tf, x)), eager,
+                               rtol=1e-6)
+
+
+def test_break_and_continue_mixed():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = x * 0
+        n = 0
+        for i in range(20):
+            if (x.sum() * i > 8):
+                break
+            if i % 3 == 0:
+                continue
+            s = s + x
+            n = n + 1
+        return s + n
+
+    tf = dy2static.transform_function(f)
+    assert tf is not f
+    for v in (1.0, 0.25):
+        x = paddle.to_tensor(np.full((1,), v, np.float32))
+        eager = f(x).numpy()
+        np.testing.assert_allclose(tf(x).numpy(), eager, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(_assert_traces(tf, x)), eager, rtol=1e-6)
+
+
+def test_return_inside_branch_compiles():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        if (x.sum() > 0):
+            return x * 2.0
+        return x - 1.0
+
+    tf = dy2static.transform_function(f)
+    assert tf is not f
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.full((2, 2), sign, np.float32))
+        eager = f(x).numpy()
+        np.testing.assert_allclose(tf(x).numpy(), eager, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(_assert_traces(tf, x)), eager, rtol=1e-6)
+
+
+def test_return_in_elif_chain_with_tail_code():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        if (x.sum() > 10):
+            return x * 10.0
+        elif (x.sum() > 0):
+            y = x + 1.0
+            return y * 2.0
+        z = x - 5.0
+        return z
+
+    tf = dy2static.transform_function(f)
+    assert tf is not f
+    for v in (6.0, 1.0, -1.0):
+        x = paddle.to_tensor(np.full((2,), v, np.float32))
+        eager = f(x).numpy()
+        np.testing.assert_allclose(tf(x).numpy(), eager, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(_assert_traces(tf, x)), eager, rtol=1e-6)
+
+
+def test_return_one_branch_with_following_code():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        if (x.sum() > 0):
+            return x * 3.0
+        y = x * x
+        y = y + 1.0
+        return y
+
+    tf = dy2static.transform_function(f)
+    assert tf is not f
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.full((3,), sign, np.float32))
+        eager = f(x).numpy()
+        np.testing.assert_allclose(tf(x).numpy(), eager, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(_assert_traces(tf, x)), eager, rtol=1e-6)
+
+
+def test_return_inside_loop_falls_back():
+    """return-in-loop stays python (documented boundary) — the function
+    must still run correctly eagerly."""
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        for i in range(5):
+            if float(x.sum()) + i > 3:
+                return x * i
+        return x
+
+    tf = dy2static.transform_function(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(tf(x).numpy(), f(x).numpy())
+
+
+def test_layer_with_break_compiles_in_model_fit():
+    class LoopNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = self.fc(x)
+            acc = y * 0
+            for i in range(8):
+                acc = acc + y
+                if (acc.mean() > 2.0):
+                    break
+            return acc
+
+    model = paddle.Model(LoopNet())
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    x = np.random.rand(8, 4).astype(np.float32)
+    y = np.random.randint(0, 4, (8, 1))
+    model.train_batch([x], [y])
+    assert model._jit_ok, "mid-body break forced eager fallback"
+
+
+def test_untransformable_loop_keeps_break_semantics():
+    """A loop that bails to python (try/except in body) must keep its
+    original break — not a half-rewritten flag version (r4 review)."""
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = x * 0
+        for i in range(10):
+            try:
+                s = s + x
+            except ValueError:
+                pass
+            if (s.sum() > 6):
+                break
+        return s
+
+    tf = dy2static.transform_function(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(tf(x).numpy(), f(x).numpy())  # [4, 4]
+
+    def g(x):
+        i = 0
+        while i < 10:
+            try:
+                x = x + 1
+            except ValueError:
+                pass
+            if (x.sum() > 6):
+                break
+            i += 1
+        return x
+
+    tg = dy2static.transform_function(g)
+    x = paddle.to_tensor(np.zeros((2,), np.float32))
+    np.testing.assert_allclose(tg(x).numpy(), g(
+        paddle.to_tensor(np.zeros((2,), np.float32))).numpy())
+
+
+def test_if_containing_loop_return_stays_python():
+    """An if whose branch holds a loop with `return` must not lower to
+    cond (the early return would be swallowed into the branch tuple)."""
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        if float(x.sum()) > 0:
+            for i in range(3):
+                if i == 1:
+                    return x * 0.0
+                y = x + 1.0
+        return x
+
+    tf = dy2static.transform_function(f)
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(tf(x).numpy(), f(x).numpy())
+    assert tf(x).numpy().shape == (2, 2)
+
+
+def test_bounded_loop_int_accumulator_promotes_or_errors():
+    """`s = 0` then `s += x.sum()` must not silently truncate to int in
+    the masked-scan lowering."""
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = 0
+        t = x * 0
+        for i in range(5):
+            t = t + x
+            s = s + x.sum()
+        return t, s
+
+    tf = dy2static.transform_function(f)
+    import jax
+    from paddle_tpu.core.tensor import Tensor
+
+    def pure(a):
+        t, s = tf(Tensor(a))
+        return t._data, s._data if isinstance(s, Tensor) else s
+
+    x = np.full((2,), 0.3, np.float32)
+    t, s = jax.jit(pure)(x)
+    np.testing.assert_allclose(np.asarray(s), 3.0, rtol=1e-6)
