@@ -112,19 +112,35 @@ def run_bench(batch_size=512, dim=8, n=20000):
         net, nn.functional.binary_cross_entropy_with_logits, opt,
         n_labels=1, input_grads=True)
 
+    from paddle_tpu.ps.pipeline import PullPushPipeline
+    pipe = PullPushPipeline(prefetch_depth=2, push_depth=4)
+    last = {}
+
+    def pull_fn(batch):
+        keys, labels = batch
+        bsz = keys.shape[0]
+        return (jnp.asarray(
+                    table.pull(keys.astype(np.uint64)).reshape(bsz, feat)),
+                jnp.asarray(labels, jnp.float32))
+
+    def step_fn(batch, pulled):
+        keys, _ = batch
+        acts, lab = pulled
+        loss, _, (acts_grad,) = step.run(acts, lab)
+        last["loss"] = loss
+        return keys.shape[0], (keys, acts_grad)
+
+    def push_fn(item):
+        keys, acts_grad = item
+        bsz = keys.shape[0]
+        # the device->host gradient fetch blocks HERE, off the critical
+        # path (VERDICT r3 #2: the serial loop paid one sync per batch)
+        table.push(keys.astype(np.uint64),
+                   acts_grad.numpy().reshape(bsz, len(slots), 1, dim))
+
     def epoch():
-        seen = 0
-        last_loss = None
-        for keys, labels in ds:
-            bsz = keys.shape[0]
-            acts = jnp.asarray(
-                table.pull(keys.astype(np.uint64)).reshape(bsz, feat))
-            lab = jnp.asarray(labels, jnp.float32)
-            last_loss, _, (acts_grad,) = step.run(acts, lab)
-            table.push(keys.astype(np.uint64),
-                       acts_grad.numpy().reshape(bsz, len(slots), 1, dim))
-            seen += bsz
-        float(jax.device_get(last_loss._data))
+        seen = pipe.run(iter(ds), pull_fn, step_fn, push_fn)
+        float(jax.device_get(last["loss"]._data))
         return seen
 
     epoch()  # warmup/compile
