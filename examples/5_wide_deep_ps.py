@@ -28,15 +28,53 @@ def make_slot_files(path, n=20000, slots=(1, 2, 3, 4), vocab=10000):
     return path
 
 
-def main(epochs=3, batch_size=512, dim=8):
+def make_raw_logs(path, n=20000, n_slots=4, vocab=10000):
+    """Raw click logs: `<click> <f1> <f2> <f3> <f4>` — NOT the slot
+    format; the DataGenerator below parses them (fleet data_generator
+    deployment mode)."""
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for _ in range(n):
+            feats = [rng.randint(0, vocab) for _ in range(n_slots)]
+            label = int((feats[0] % 3 == 0) ^ (feats[1] % 2 == 0))
+            f.write(f"{label} " + " ".join(map(str, feats)) + "\n")
+    return path
+
+
+class WideDeepGenerator:
+    """User parser (fleet data_generator.py parity): raw log line ->
+    [(slot_name, [sign...]), ...]."""
+
+    def generate_sample(self, line):
+        def local_iter():
+            parts = line.split()
+            label = int(parts[0])
+            yield [("label", [label])] + [
+                (f"slot{i+1}", [(i + 1) * 100000 + int(v)])
+                for i, v in enumerate(parts[1:])]
+        return local_iter
+
+
+def main(epochs=3, batch_size=512, dim=8, use_data_generator=True):
+    from paddle_tpu.ps.data_generator import MultiSlotDataGenerator
     tmp = tempfile.mkdtemp()
-    data = make_slot_files(os.path.join(tmp, "part-0.txt"))
     slots = [1, 2, 3, 4]
 
     ds = InMemoryDataset()
     ds.init(batch_size=batch_size, slots=slots, max_per_slot=1)
-    ds.set_filelist([data])
-    ds.load_into_memory()
+    if use_data_generator:
+        raw = make_raw_logs(os.path.join(tmp, "raw-0.txt"))
+
+        class Gen(WideDeepGenerator, MultiSlotDataGenerator):
+            pass
+
+        gen = Gen()
+        gen.set_slots([f"slot{i}" for i in slots])
+        ds.load_from_generator(gen, [raw])
+    else:
+        data = make_slot_files(os.path.join(tmp, "part-0.txt"))
+        ds.set_filelist([data])
+        ds.load_into_memory()
     ds.global_shuffle(seed=42)
     print("records:", ds.get_memory_data_size())
 

@@ -11,3 +11,10 @@ from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
 from .trainer import HogwildTrainer  # noqa: F401
 from .pass_cache import PassCache, PassCacheEmbedding  # noqa: F401
 from .graph import GraphTable  # noqa: F401
+from .pipeline import PullPushPipeline  # noqa: F401
+from .data_generator import (DataGenerator,  # noqa: F401
+                             MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from .coordinator import (Coordinator, FLClient,  # noqa: F401
+                          ClientSelector, CapacityClientSelector,
+                          FLStrategy)

@@ -20,6 +20,13 @@ ops: 0 PULL_SPARSE (payload: u32 n, u64*n keys) -> f32 n*dim
      7 DENSE_ADD   (payload: u32 n, f32*n delta) -> u32 n, f32*n merged
        (geo-async dense mode: server merges the trainer's delta and
        returns the merged params in one round trip)
+     8 KV_SET      (payload: u16 klen, key, u32 vlen, val) -> u8 ok
+     9 KV_GET      (payload: u16 klen, key) -> u8 found, u32 vlen, val
+    10 KV_LIST     (payload: u16 plen, prefix) -> u32 cnt,
+       cnt x (u16 klen, key, u32 vlen, val)
+       (server-side KV namespace: the FL coordinator's client-info /
+       strategy exchange — CoordinatorClient/FLCommunicator parity —
+       and a TCPStore-style rendezvous primitive)
 
 Fault tolerance: the client transparently reconnects a broken server
 socket and retries the request ONCE (brpc_ps_client reconnect parity;
@@ -37,7 +44,7 @@ import numpy as np
 from .table import MemorySparseTable, MemoryDenseTable
 
 (PULL_SPARSE, PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, SAVE, BARRIER, STOP,
- DENSE_ADD) = range(8)
+ DENSE_ADD, KV_SET, KV_GET, KV_LIST) = range(11)
 
 
 def _recv_exact(sock, n):
@@ -69,6 +76,8 @@ class PSServer:
         self._barrier_cond = threading.Condition()
         self._barrier_count = 0
         self._barrier_generation = 0
+        self._kv = {}
+        self._kv_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -128,6 +137,39 @@ class PSServer:
                         lambda: self._barrier_generation != gen,
                         timeout=300)
             _send_msg(sock, b"\x01")
+            return True
+        if op == KV_SET:
+            (klen,) = struct.unpack("<H", body[:2])
+            key = body[2:2 + klen].decode()
+            (vlen,) = struct.unpack("<I", body[2 + klen:6 + klen])
+            val = body[6 + klen:6 + klen + vlen]
+            with self._kv_lock:
+                self._kv[key] = val
+            _send_msg(sock, b"\x01")
+            return True
+        if op == KV_GET:
+            (klen,) = struct.unpack("<H", body[:2])
+            key = body[2:2 + klen].decode()
+            with self._kv_lock:
+                val = self._kv.get(key)
+            if val is None:
+                _send_msg(sock, b"\x00" + struct.pack("<I", 0))
+            else:
+                _send_msg(sock, b"\x01" + struct.pack("<I", len(val))
+                          + val)
+            return True
+        if op == KV_LIST:
+            (plen,) = struct.unpack("<H", body[:2])
+            prefix = body[2:2 + plen].decode()
+            with self._kv_lock:
+                items = [(k, v) for k, v in self._kv.items()
+                         if k.startswith(prefix)]
+            out = struct.pack("<I", len(items))
+            for k, v in items:
+                kb = k.encode()
+                out += struct.pack("<H", len(kb)) + kb
+                out += struct.pack("<I", len(v)) + v
+            _send_msg(sock, out)
             return True
         table = self._tables[table_id]
         if op == PULL_SPARSE:
@@ -260,6 +302,40 @@ class PSClient:
                                       sub.size) + sub.tobytes() + \
                     g[idx].tobytes()
                 self._request(si, payload)
+
+    # -- KV namespace (FL coordinator exchange / rendezvous) ---------
+    def kv_set(self, key: str, value: bytes, server=0):
+        kb = key.encode()
+        payload = struct.pack("<BIH", KV_SET, 0, len(kb)) + kb + \
+            struct.pack("<I", len(value)) + value
+        with self._lock:
+            self._request(server, payload)
+
+    def kv_get(self, key: str, server=0):
+        kb = key.encode()
+        payload = struct.pack("<BIH", KV_GET, 0, len(kb)) + kb
+        with self._lock:
+            resp = self._request(server, payload)
+        if resp[0] == 0:
+            return None
+        (vlen,) = struct.unpack("<I", resp[1:5])
+        return resp[5:5 + vlen]
+
+    def kv_list(self, prefix: str, server=0):
+        pb = prefix.encode()
+        payload = struct.pack("<BIH", KV_LIST, 0, len(pb)) + pb
+        with self._lock:
+            resp = self._request(server, payload)
+        (cnt,) = struct.unpack("<I", resp[:4])
+        out, off = {}, 4
+        for _ in range(cnt):
+            (klen,) = struct.unpack("<H", resp[off:off + 2])
+            key = resp[off + 2:off + 2 + klen].decode()
+            off += 2 + klen
+            (vlen,) = struct.unpack("<I", resp[off:off + 4])
+            out[key] = resp[off + 4:off + 4 + vlen]
+            off += 4 + vlen
+        return out
 
     def pull_dense(self, table_id, server=0):
         with self._lock:

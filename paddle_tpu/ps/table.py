@@ -244,6 +244,33 @@ class InMemoryDataset:
             if rc != 0:
                 raise IOError(f"failed to load {f}")
 
+    def load_from_generator(self, generator, files=None):
+        """Parse raw input files through a fleet `DataGenerator`
+        subclass (ps/data_generator.py — the user-parser API) into the
+        native record pool. `files` defaults to the set_filelist()
+        list; the generator's slot registry must align with the slot
+        ids passed to init()."""
+        import tempfile
+        files = list(files) if files is not None else list(self._files)
+
+        def lines():
+            for path in files:
+                with open(path) as fh:
+                    yield from fh
+
+        with tempfile.NamedTemporaryFile("w", suffix=".slot",
+                                         delete=False) as tmp:
+            generator.run_from_iterable(lines(), write=tmp.write)
+            name = tmp.name
+        try:
+            rc = self._lib.pscore_dataset_load_file(self._h,
+                                                    name.encode())
+            if rc != 0:
+                raise IOError("failed to load generated slot file")
+        finally:
+            import os
+            os.unlink(name)
+
     def global_shuffle(self, fleet=None, seed=0):
         self._lib.pscore_dataset_shuffle(self._h, seed)
 
