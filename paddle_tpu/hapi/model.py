@@ -290,7 +290,8 @@ class Model:
                 can_group = (group_ok[0] and self._jit_ok
                              and not self._metrics and static_lr
                              and self._train_step is not None
-                             and not self._train_step.input_grads)
+                             and not self._train_step.input_grads
+                             and not self._train_step._offload)
                 if can_group:
                     arrs = _arrays(ins) + _arrays(lbs)
                     bshapes = tuple(getattr(a, "shape", ()) for a in arrs)

@@ -83,6 +83,85 @@ def _spmd(fn, x, n):
     return jax.shard_map(fn, mesh=mesh, in_specs=P("r"), out_specs=P("r"))(x)
 
 
+# --------------------------------------------------------------------------
+# multi-process backend: when this is one of several jax processes
+# (jax.distributed initialised — the TestDistBase two-rank reality), the
+# eager API runs REAL cross-process collectives: each process contributes
+# its local tensor as one shard of a global array over a process mesh and
+# a jitted XLA collective (gloo on CPU, ICI/DCN on TPU) produces the
+# replicated result.
+# --------------------------------------------------------------------------
+
+
+def _multiproc():
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+_mp_mesh = None
+_mp_jit_cache = {}
+
+
+def _check_mp_group(group):
+    """Multi-process collectives run over the FULL process world; a
+    sub-group would silently compute over the wrong ranks."""
+    if group is not None and group.nranks != dist_env.get_world_size():
+        raise NotImplementedError(
+            "multi-process eager collectives support only the default "
+            f"(world) group; got a {group.nranks}-rank sub-group of "
+            f"{dist_env.get_world_size()}")
+
+
+def _process_mesh():
+    global _mp_mesh
+    if _mp_mesh is None:
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        n = jax.process_count()
+        # one device per process keeps rank == process (eager contract)
+        per = [None] * n
+        for d in devs:
+            if per[d.process_index] is None:
+                per[d.process_index] = d
+        _mp_mesh = Mesh(np.array(per), ("r",))
+    return _mp_mesh
+
+
+def _to_global(local_arr, mesh):
+    from jax.sharding import NamedSharding
+    shard = NamedSharding(mesh, P("r", *([None] * local_arr.ndim)))
+    return jax.make_array_from_process_local_data(
+        shard, np.asarray(local_arr)[None])
+
+
+def _mp_collect(local_arr, kind, src=0):
+    """Global [world, ...] array -> jitted collective -> replicated host
+    value (every process receives the full result). Executables are
+    memoized per (kind, src, shape, dtype) — a fresh jit per eager call
+    would retrace every time."""
+    from jax.sharding import NamedSharding
+    mesh = _process_mesh()
+    garr = _to_global(local_arr, mesh)
+    key = (kind, src, local_arr.shape, str(local_arr.dtype))
+    fn = _mp_jit_cache.get(key)
+    if fn is None:
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+               "prod": jnp.prod, "avg": jnp.mean}
+        if kind in red:
+            body = (lambda a, _r=red[kind]: _r(a, axis=0))
+        elif kind == "gather":
+            body = (lambda a: a)
+        elif kind == "bcast":
+            body = (lambda a: a[src])
+        else:
+            raise ValueError(kind)
+        fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+        _mp_jit_cache[key] = fn
+    return np.asarray(jax.device_get(fn(garr)))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In the single-controller SPMD view, an eager all_reduce over the
     device world is an identity on a replicated tensor; for tensors carrying
@@ -90,11 +169,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     eager DP path uses it (gradient reduction)."""
     t = as_tensor(tensor)
     g = _get_group(group)
-    if g.nranks <= 1:
-        return t
     red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
            ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
            ReduceOp.AVG: jnp.mean}[op]
+    if _multiproc():
+        _check_mp_group(group)
+        out = _mp_collect(np.asarray(t.numpy()), op)
+        tensor_obj = tensor if isinstance(tensor, Tensor) else t
+        tensor_obj._data = jnp.asarray(out)
+        return tensor_obj
+    if g.nranks <= 1:
+        return t
     if t.shape and t.shape[0] == g.nranks:
         out = Tensor(red(t._data, axis=0))
         tensor_obj = tensor if isinstance(tensor, Tensor) else t
@@ -107,13 +192,26 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     t = as_tensor(tensor)
     g = _get_group(group)
+    if _multiproc():
+        _check_mp_group(group)
+        stacked = _mp_collect(np.asarray(t.numpy()), "gather")
+        for i in range(stacked.shape[0]):
+            tensor_list.append(Tensor(jnp.asarray(stacked[i])))
+        return tensor_list
     for _ in range(g.nranks):
         tensor_list.append(t)
     return tensor_list
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    return as_tensor(tensor)
+    t = as_tensor(tensor)
+    if _multiproc():
+        _check_mp_group(group)
+        out = _mp_collect(np.asarray(t.numpy()), "bcast", src=src)
+        tensor_obj = tensor if isinstance(tensor, Tensor) else t
+        tensor_obj._data = jnp.asarray(out)
+        return tensor_obj
+    return t
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):

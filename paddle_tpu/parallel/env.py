@@ -58,10 +58,29 @@ def init_parallel_env():
     coord = os.environ.get("MASTER_ADDR") or os.environ.get("PADDLE_MASTER")
     n_nodes = int(os.environ.get("PADDLE_NNODES",
                                  os.environ.get("WORLD_SIZE_NODES", "1")))
-    if coord and n_nodes > 1:
+    already = False
+    try:
+        from jax._src import distributed as _jd
+        already = _jd.global_state.client is not None
+    except Exception:
+        pass
+    if coord and n_nodes > 1 and not already:
+        # NOTE: importing paddle_tpu initialises the XLA backend, after
+        # which jax.distributed.initialize refuses to run — multi-process
+        # programs must call jax.distributed.initialize (with
+        # jax_cpu_collectives_implementation="gloo" on CPU) BEFORE the
+        # import; this path covers launcher-driven runs where the env is
+        # set and nothing touched jax yet.
         port = os.environ.get("MASTER_PORT", "8476")
         pid = int(os.environ.get("PADDLE_NODE_RANK",
                                  os.environ.get("NODE_RANK", "0")))
+        try:
+            # CPU multi-process collectives need the gloo implementation
+            # (the TestDistBase-style localhost two-rank tests)
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
         jax.distributed.initialize(
             coordinator_address=f"{coord}:{port}",
             num_processes=n_nodes, process_id=pid)

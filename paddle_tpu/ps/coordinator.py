@@ -100,11 +100,14 @@ class FLClient:
 
     def push_fl_client_info_sync(self, device_type="cpu",
                                  compute_capacity=1.0, bandwidth=1.0,
-                                 **extra):
+                                 round_id=0, **extra):
+        # infos are round-scoped like strategies: a new round must
+        # re-gather live capacities, not reuse stale (possibly departed)
+        # clients' reports
         info = {"client_id": self.client_id, "device_type": device_type,
                 "compute_capacity": compute_capacity,
                 "bandwidth": bandwidth, **extra}
-        self._client.kv_set(f"fl_info/{self.client_id}",
+        self._client.kv_set(f"fl_info/{round_id}/{self.client_id}",
                             json.dumps(info).encode())
 
     def pull_fl_strategy(self, round_id=0, timeout=60.0, poll=0.05):
@@ -130,24 +133,27 @@ class Coordinator:
         self._selector_cls = selector_cls
         self._selector_kw = selector_kw
 
-    def query_fl_clients_info(self, n_clients, timeout=60.0, poll=0.05):
-        """Block until n_clients infos are reported; returns
-        {client_id: info dict}."""
+    def query_fl_clients_info(self, n_clients, round_id=0, timeout=60.0,
+                              poll=0.05):
+        """Block until n_clients infos are reported FOR THIS ROUND;
+        returns {client_id: info dict}."""
+        prefix = f"fl_info/{round_id}/"
         deadline = time.time() + timeout
         while time.time() < deadline:
-            raw = self._client.kv_list("fl_info/")
+            raw = self._client.kv_list(prefix)
             if len(raw) >= n_clients:
-                return {k.split("/", 1)[1]: json.loads(v.decode())
+                return {k.rsplit("/", 1)[1]: json.loads(v.decode())
                         for k, v in raw.items()}
             time.sleep(poll)
         raise TimeoutError(
-            f"only {len(self._client.kv_list('fl_info/'))} of "
-            f"{n_clients} FL clients reported")
+            f"only {len(self._client.kv_list(prefix))} of "
+            f"{n_clients} FL clients reported for round {round_id}")
 
     def make_fl_strategy(self, n_clients, round_id=0, timeout=60.0):
         """One coordination round: gather -> select -> publish.
         Returns the strategy map."""
-        infos = self.query_fl_clients_info(n_clients, timeout=timeout)
+        infos = self.query_fl_clients_info(n_clients, round_id=round_id,
+                                           timeout=timeout)
         selector = self._selector_cls(infos, **self._selector_kw)
         strategy = selector.select()
         for cid, strat in strategy.items():

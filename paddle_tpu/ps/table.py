@@ -258,18 +258,18 @@ class InMemoryDataset:
                 with open(path) as fh:
                     yield from fh
 
-        with tempfile.NamedTemporaryFile("w", suffix=".slot",
-                                         delete=False) as tmp:
-            generator.run_from_iterable(lines(), write=tmp.write)
-            name = tmp.name
+        import os
+        tmp = tempfile.NamedTemporaryFile("w", suffix=".slot",
+                                          delete=False)
         try:
+            with tmp:
+                generator.run_from_iterable(lines(), write=tmp.write)
             rc = self._lib.pscore_dataset_load_file(self._h,
-                                                    name.encode())
+                                                    tmp.name.encode())
             if rc != 0:
                 raise IOError("failed to load generated slot file")
         finally:
-            import os
-            os.unlink(name)
+            os.unlink(tmp.name)
 
     def global_shuffle(self, fleet=None, seed=0):
         self._lib.pscore_dataset_shuffle(self._h, seed)

@@ -289,6 +289,11 @@ def test_fl_coordinator_round_trip():
         assert got["3"]["next_state"] == "WAIT"
         assert got["0"]["iteration_num"] == 7
 
+        # round 1: infos are round-scoped — clients must re-report
+        # (stale round-0 capacities never satisfy a new round)
+        for fl, (cc, bw) in zip(fls, caps):
+            fl.push_fl_client_info_sync(compute_capacity=cc,
+                                        bandwidth=bw, round_id=1)
         # late coordinator / early client: pull blocks until published
         res = {}
 
