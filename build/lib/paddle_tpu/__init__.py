@@ -1,0 +1,90 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+Reference: miaoli06/Paddle (see SURVEY.md). The user surface mirrors
+`python/paddle/__init__.py`; the execution engine is XLA.
+"""
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype,
+    finfo, iinfo,
+)
+from .core.tensor import Tensor, Parameter
+from .core.lod import (LoDTensor, create_lod_tensor,  # noqa: F401
+                       sequence_pool)
+from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled
+from .core.place import (
+    CPUPlace, TPUPlace, CUDAPlace, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core.random import seed, get_rng_state, set_rng_state
+
+from .ops import *  # noqa: F401,F403 — tensor op namespace (paddle.* ops)
+from . import ops
+
+# subpackages (populated progressively; import order matters)
+from . import nn  # noqa
+from . import optimizer  # noqa
+from . import amp  # noqa
+from . import io  # noqa
+from . import metric  # noqa
+from . import vision  # noqa
+from . import jit  # noqa
+from . import static  # noqa
+from . import parallel as distributed  # noqa — paddle.distributed parity
+from . import parallel  # noqa
+from . import hapi  # noqa
+from .hapi.model import Model  # noqa
+from .framework_io import save, load  # noqa
+from . import profiler  # noqa
+from . import incubate  # noqa
+from . import device  # noqa
+from . import distribution  # noqa
+from . import regularizer  # noqa
+from . import sparse  # noqa
+from . import fft  # noqa
+from .ops import linalg  # noqa — paddle.linalg namespace
+from . import models  # noqa
+from . import autograd_api as autograd  # noqa — paddle.autograd
+from . import onnx  # noqa
+from . import inference  # noqa
+from . import hub  # noqa
+from . import quantization  # noqa
+from . import text  # noqa
+from . import strings  # noqa
+from . import utils  # noqa
+from . import audio  # noqa
+from . import geometric  # noqa
+from .flags import set_flags, get_flags  # noqa
+from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa
+                      ClipGradByGlobalNorm)
+
+import sys as _sys
+_sys.modules[__name__ + ".distributed"] = distributed
+_sys.modules[__name__ + ".autograd"] = autograd
+
+DataParallel = distributed.DataParallel
+
+__version__ = "0.1.0"
+
+
+def disable_static():
+    """Dygraph is the default and only eager mode; kept for parity."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for "
+        "compiled execution (SURVEY.md §7.5: whole-step jax.jit subsumes "
+        "the static Program/Executor stack)"
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes)

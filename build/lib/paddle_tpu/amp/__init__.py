@@ -1,0 +1,13 @@
+"""paddle_tpu.amp — automatic mixed precision, bf16-first.
+
+Parity: `python/paddle/amp/auto_cast.py` (O1 list-driven cast at op
+dispatch, O2 pure low-precision `decorate`) and `grad_scaler.py`
+(`check_finite_and_unscale` + `update_loss_scaling` ops,
+`python/paddle/fluid/dygraph/amp/loss_scaler.py:293`).
+
+TPU-native: the default low dtype is bfloat16 — same exponent range as
+fp32, so dynamic loss scaling is unnecessary (GradScaler keeps the API and
+becomes a near-no-op unless fp16 is forced).
+"""
+from .auto_cast import auto_cast, decorate, amp_guard, amp_decorate  # noqa
+from .grad_scaler import GradScaler, AmpScaler  # noqa

@@ -1,0 +1,71 @@
+"""auto_cast / decorate.
+
+O1: white-listed ops (matmul/conv/linear — the MXU ops) run in bf16; the
+cast happens at op dispatch (`ops/linalg.py:_amp_cast2`), mirroring the
+generated-code cast insertion in the reference
+(`eager/auto_code_generator/generator/eager_gen.py:1395`,
+`imperative/amp_auto_cast.cc` lists).
+O2: `decorate` casts the model's float parameters to bf16 wholesale.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..core import dtype as dtype_mod
+
+_state = {"enabled": False, "level": "O1", "dtype": None}
+
+
+def _amp_enabled():
+    return _state["enabled"]
+
+
+def _amp_level():
+    return _state["level"]
+
+
+def _amp_dtype():
+    return _state["dtype"]
+
+
+# the reference's white/black lists (imperative/amp_auto_cast.cc); on TPU
+# only the matmul-class ops matter — everything else is bandwidth-bound and
+# fuses anyway.
+WHITE_LIST = {"matmul", "conv1d", "conv2d", "conv3d", "linear", "einsum",
+              "bmm", "mm"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "exp", "log",
+              "mean", "sum", "norm", "layer_norm", "batch_norm"}
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = dict(_state)
+    _state["enabled"] = enable
+    _state["level"] = level
+    _state["dtype"] = dtype_mod.convert_dtype(dtype)
+    try:
+        yield
+    finally:
+        _state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision (master weights live in the
+    optimizer's fp32 accumulators — `multi_precision` capability)."""
+    dt = dtype_mod.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._cast_all(dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+amp_decorate = decorate

@@ -1,0 +1,142 @@
+"""GradScaler — dynamic loss scaling.
+
+Parity: `python/paddle/amp/grad_scaler.py` →
+`python/paddle/fluid/dygraph/amp/loss_scaler.py:293` (`AmpScaler`), built on
+the `check_finite_and_unscale` / `update_loss_scaling` kernels
+(`paddle/fluid/operators/amp/`). With bf16 (TPU default) scaling is not
+needed; the class honours `enable=False` transparently and implements the
+full dynamic-scale state machine for fp16 parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import ops
+
+
+class OptimizerState:
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        # per-optimizer (state, found_inf) machine, mirroring reference
+        # python/paddle/amp/grad_scaler.py:199 — a user's explicit
+        # unscale_() (grad-clip pattern) must not be repeated inside
+        # step(), and step() twice per update() is an error. found_inf is
+        # kept per-optimizer too: a later unscale_() of a second optimizer
+        # (e.g. GAN D/G) must not mask the first one's inf.
+        self._opt_states = {}
+
+    def _state(self, optimizer):
+        return self._opt_states.get(
+            id(optimizer), (OptimizerState.INIT, False))[0]
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return ops.scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        state = self._state(optimizer)
+        if state == OptimizerState.UNSCALED:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update().")
+        if state == OptimizerState.STEPPED:
+            raise RuntimeError("unscale_() is being called after step().")
+        params = optimizer._params_with_grad()
+        found_inf = False
+        inv = 1.0 / self._scale
+        for p in params:
+            g = p.grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found_inf = True
+            p.grad._data = g.astype(p.grad._data.dtype)
+        self._found_inf = self._found_inf or found_inf
+        self._opt_states[id(optimizer)] = (OptimizerState.UNSCALED,
+                                           found_inf)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        state = self._state(optimizer)
+        if state == OptimizerState.STEPPED:
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if state == OptimizerState.INIT:
+            self.unscale_(optimizer)
+        found_inf = self._opt_states[id(optimizer)][1]
+        if not found_inf:
+            optimizer.step()
+        self._opt_states[id(optimizer)] = (OptimizerState.STEPPED,
+                                           found_inf)
+
+    def update(self):
+        self._opt_states.clear()
+        found_inf = self._found_inf
+        self._found_inf = False  # next backward cycle starts clean
+        if not self._enable or not self._dynamic:
+            return
+        if found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(np.float32(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler

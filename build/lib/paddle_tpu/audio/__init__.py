@@ -1,0 +1,7 @@
+"""paddle_tpu.audio — `python/paddle/audio/` parity essentials.
+
+Feature extractors (spectrogram / mel / MFCC) over jnp FFT (XLA),
+matching paddle.audio.features layer APIs.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

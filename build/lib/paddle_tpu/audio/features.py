@@ -1,0 +1,108 @@
+"""Audio feature layers (paddle.audio.features parity): Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..nn.layer_base import Layer
+from ..ops._helpers import as_tensor
+from . import functional as AF
+
+
+def _stft_mag(a, n_fft, hop, win, center, pad_mode):
+    # a: [B, T] -> power spectrogram [B, n_fft//2+1, frames]
+    if center:
+        pad = n_fft // 2
+        jmode = {"reflect": "reflect", "constant": "constant",
+                 "replicate": "edge"}.get(pad_mode, "reflect")
+        a = jnp.pad(a, ((0, 0), (pad, pad)), mode=jmode)
+    T = a.shape[1]
+    n_frames = 1 + (T - n_fft) // hop
+    idx = (jnp.arange(n_frames)[:, None] * hop
+           + jnp.arange(n_fft)[None, :])
+    frames = a[:, idx] * win[None, None, :]        # [B, F, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)           # [B, F, n_bins]
+    power = jnp.abs(spec) ** 2
+    return jnp.swapaxes(power, 1, 2)               # [B, n_bins, F]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.center = center
+        self.pad_mode = pad_mode
+        self.power = power
+        self.register_buffer("window",
+                             AF.get_window(window, self.win_length))
+
+    def forward(self, x):
+        x = as_tensor(x)
+        win = self.window
+        n_fft, hop = self.n_fft, self.hop
+        p = self.power
+        center, pad_mode = self.center, self.pad_mode
+
+        def _fn(a, w):
+            if w.shape[0] < n_fft:
+                # center the window inside the FFT frame (librosa/paddle)
+                lo = (n_fft - w.shape[0]) // 2
+                w = jnp.pad(w, (lo, n_fft - w.shape[0] - lo))
+            out = _stft_mag(a, n_fft, hop, w, center, pad_mode)
+            if p != 2.0:
+                out = out ** (p / 2.0)
+            return out
+        return dispatch.apply("spectrogram", _fn, (x, win))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        self.register_buffer(
+            "fbank", AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                             f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self.fbank
+
+        def _fn(s, f):
+            return jnp.einsum("mf,bft->bmt", f, s)
+        return dispatch.apply("mel", _fn, (spec, fb))
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *a, ref_value=1.0, amin=1e-10, top_db=None, **k):
+        super().__init__(*a, **k)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, n_mels=64, **k):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft,
+                                        n_mels=n_mels, **k)
+        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        d = self.dct
+
+        def _fn(m, dct):
+            return jnp.einsum("km,bmt->bkt", dct, m)
+        return dispatch.apply("mfcc", _fn, (lm, d))

@@ -1,0 +1,105 @@
+"""paddle.autograd namespace — PyLayer (user-defined autograd ops).
+
+Parity: `python/paddle/autograd/py_layer.py` (`PyLayer`, `PyLayerContext`)
+over the eager custom-grad-node machinery (`eager/pylayer/
+py_layer_node.h`). A PyLayer's backward plugs straight into the GradNode
+graph; its compute can be arbitrary python over Tensors (each op still
+XLA-dispatched).
+"""
+from __future__ import annotations
+
+from .core import autograd as _ag
+from .core.autograd import no_grad, enable_grad, grad  # noqa: F401
+from .core.autograd import run_backward
+from .core.dispatch import _edge_for
+from .core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        """Reference API: a METHOD returning the saved tuple
+        (python/paddle/autograd/py_layer.py)."""
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = _ag.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        with _ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        if not need_grad:
+            return outputs
+
+        cls_ref = cls
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else \
+                (cotangents,)
+            g_tensors = [Tensor(c) for c in cts]
+            with _ag.no_grad():
+                in_grads = cls_ref.backward(ctx, *g_tensors)
+            in_grads = in_grads if isinstance(in_grads, (tuple, list)) \
+                else (in_grads,)
+            out = []
+            gi = iter(in_grads)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    out.append(g._data if isinstance(g, Tensor)
+                               else (g if g is not None else None))
+            # autograd engine expects one cotangent per recorded input
+            return tuple(o if o is not None else
+                         _zero_like(t) for o, t in zip(out, tensor_inputs))
+
+        node = _ag.GradNode(
+            cls.__name__, vjp_fn,
+            [_edge_for(t) for t in tensor_inputs],
+            len(outs),
+            [o._data.shape for o in outs],
+            [o._data.dtype for o in outs])
+        for i, o in enumerate(outs):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_slot = i
+        return outputs
+
+
+def _zero_like(t):
+    import jax.numpy as jnp
+    return jnp.zeros(t._data.shape, t._data.dtype)
+
+
+class PyLayerBackwardFunction:  # parity alias
+    pass

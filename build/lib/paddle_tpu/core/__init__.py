@@ -1,0 +1,5 @@
+from . import dtype, place, random, autograd, dispatch
+from .tensor import Tensor, Parameter
+
+__all__ = ["dtype", "place", "random", "autograd", "dispatch", "Tensor",
+           "Parameter"]

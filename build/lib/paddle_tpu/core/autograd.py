@@ -1,0 +1,335 @@
+"""Define-by-run eager autograd engine.
+
+Capability parity with the reference's eager autograd
+(`paddle/fluid/eager/`): GradNode graph recorded at op execution
+(`grad_node_info.h:168`), queue-based topological backward walk
+(`backward.cc:394 egr::Backward`, `:105 RunBackward`), leaf accumulation
+(`accumulation/accumulation_node.h:23`), grad hooks (`hooks.h`), and
+`paddle.grad`-style partial backward (`general_grad.h`).
+
+TPU-native twist: instead of hand-written per-op grad kernels, each GradNode's
+vjp function comes from `jax.vjp` over the op's pure-jax forward — the
+residuals it closes over play the role of the reference's `TensorWrapper`
+saved tensors (`eager/tensor_wrapper.h`). Every vjp call is itself XLA-traced,
+so grad compute runs on the TPU like any forward op.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_grad_enabled = [True]
+
+
+def _zero_cotangent(shape, dtype):
+    """Zero cotangent matching jax.vjp's expectations: float0 for integral
+    outputs, ordinary zeros for inexact ones."""
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+        dtype, jnp.complexfloating
+    ):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _is_float0(g) -> bool:
+    return getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled[-1]
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad parity."""
+    _grad_enabled.append(False)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+@contextlib.contextmanager
+def enable_grad():
+    _grad_enabled.append(True)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+class Edge:
+    """Edge from a consumer GradNode input slot back to its producer.
+
+    kind: 'node' -> (producer GradNode, output slot); 'leaf' -> leaf Tensor
+    with stop_gradient=False; 'none' -> gradient is dropped.
+    Mirrors `egr::Edge` (`paddle/fluid/eager/grad_node_info.h:50`).
+    """
+
+    __slots__ = ("kind", "node", "slot", "tensor")
+
+    def __init__(self, kind, node=None, slot=0, tensor=None):
+        self.kind = kind
+        self.node = node
+        self.slot = slot
+        self.tensor = tensor
+
+
+class GradNode:
+    """One recorded op; calling it runs the op's vjp."""
+
+    __slots__ = (
+        "name", "vjp_fn", "edges", "n_outputs", "out_shapes", "out_dtypes",
+    )
+
+    def __init__(self, name, vjp_fn, edges, n_outputs, out_shapes, out_dtypes):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges = edges
+        self.n_outputs = n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+def _accumulate_leaf(tensor, grad_array, leaf_targets=None):
+    from .tensor import Tensor
+
+    if tensor.stop_gradient:
+        return
+    if leaf_targets is not None and id(tensor) not in leaf_targets:
+        # Partial backward (paddle.grad): only the requested inputs
+        # accumulate — other parameters' .grad must stay untouched
+        # (reference eager/general_grad.h restricts the same way).
+        return
+    g = grad_array
+    if tensor.grad is None:
+        tensor._grad = Tensor(g, stop_gradient=True)
+    else:
+        tensor._grad._data = tensor._grad._data + g
+    for hook in tensor._grad_hooks:
+        out = hook(tensor._grad)
+        if out is not None:
+            tensor._grad = out
+
+
+def _reachable_and_deps(root_nodes):
+    """DFS the consumer->producer DAG; count in-edges per producer."""
+    deps = defaultdict(int)
+    seen = set()
+    stack = list(root_nodes)
+    for n in root_nodes:
+        seen.add(id(n))
+    nodes = {id(n): n for n in root_nodes}
+    while stack:
+        node = stack.pop()
+        for e in node.edges:
+            if e.kind == "node":
+                deps[id(e.node)] += 1
+                if id(e.node) not in seen:
+                    seen.add(id(e.node))
+                    nodes[id(e.node)] = e.node
+                    stack.append(e.node)
+    return nodes, deps
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 leaf_targets=None, capture=None):
+    """Queue-based topological walk — `egr::RunBackward` parity.
+
+    leaf_targets: optional set of id(Tensor); when given, only those leaves
+    accumulate into .grad (paddle.grad partial backward).
+    capture: optional dict keyed (id(GradNode), slot); filled with the total
+    cotangent that arrived at that producer slot — used to read gradients of
+    non-leaf tensors without touching .grad.
+    """
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangent buffers.
+    buffers = defaultdict(dict)  # id(node) -> {slot: array}
+    root_nodes = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs"
+                )
+            g_arr = jnp.ones_like(t._data)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            _accumulate_leaf(t, g_arr, leaf_targets)
+            continue
+        slot = t._out_slot
+        buf = buffers[id(node)]
+        buf[slot] = buf[slot] + g_arr if slot in buf else g_arr
+        root_nodes.append(node)
+
+    if not root_nodes:
+        return
+
+    nodes, deps = _reachable_and_deps(root_nodes)
+    ready = [n for nid, n in nodes.items() if deps[nid] == 0 and nid in buffers]
+
+    processed = set()
+    while ready:
+        node = ready.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        buf = buffers.pop(id(node), {})
+        if capture is not None:
+            for slot, g in buf.items():
+                if (id(node), slot) in capture:
+                    capture[(id(node), slot)] = g
+        cotangents = []
+        for i in range(node.n_outputs):
+            if i in buf:
+                cotangents.append(buf[i])
+            else:
+                cotangents.append(
+                    _zero_cotangent(node.out_shapes[i], node.out_dtypes[i])
+                )
+        ct = tuple(cotangents) if node.n_outputs > 1 else cotangents[0]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad graph for {node.name} was freed; pass "
+                "retain_graph=True to backward() to reuse it"
+            )
+        in_grads = node.vjp_fn(ct)
+        if not retain_graph:
+            node.vjp_fn = None
+        for e, g in zip(node.edges, in_grads):
+            if e.kind == "none" or _is_float0(g):
+                if e.kind == "node":
+                    deps[id(e.node)] -= 1
+                    if deps[id(e.node)] == 0:
+                        ready.append(e.node)
+                continue
+            if e.kind == "leaf":
+                _accumulate_leaf(e.tensor, g, leaf_targets)
+                continue
+            pnode = e.node
+            buf2 = buffers[id(pnode)]
+            buf2[e.slot] = buf2[e.slot] + g if e.slot in buf2 else g
+            deps[id(pnode)] -= 1
+            if deps[id(pnode)] == 0:
+                ready.append(pnode)
+
+    # Diamond-free remainder: producers whose consumers were unreachable from
+    # the roots keep positive deps; flush any that already hold cotangents.
+    for nid, node in nodes.items():
+        if nid in buffers and nid not in processed and deps[nid] >= 0:
+            # Unreached due to consumers outside the backward subgraph.
+            ready.append(node)
+            deps[nid] = 0
+    while ready:
+        node = ready.pop()
+        if id(node) in processed or id(node) not in buffers:
+            continue
+        processed.add(id(node))
+        buf = buffers.pop(id(node))
+        if capture is not None:
+            for slot, g in buf.items():
+                if (id(node), slot) in capture:
+                    capture[(id(node), slot)] = g
+        cotangents = []
+        for i in range(node.n_outputs):
+            cotangents.append(
+                buf.get(i, _zero_cotangent(node.out_shapes[i],
+                                           node.out_dtypes[i]))
+            )
+        ct = tuple(cotangents) if node.n_outputs > 1 else cotangents[0]
+        if node.vjp_fn is None:
+            continue
+        in_grads = node.vjp_fn(ct)
+        if not retain_graph:
+            node.vjp_fn = None
+        for e, g in zip(node.edges, in_grads):
+            if _is_float0(g):
+                continue
+            if e.kind == "leaf":
+                _accumulate_leaf(e.tensor, g, leaf_targets)
+            elif e.kind == "node":
+                buf2 = buffers[id(e.node)]
+                buf2[e.slot] = buf2[e.slot] + g if e.slot in buf2 else g
+                deps[id(e.node)] -= 1
+                if deps[id(e.node)] <= 0:
+                    ready.append(e.node)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad parity (`eager/general_grad.h` capability).
+
+    Runs a backward pass and collects grads for `inputs` without writing
+    their `.grad` attributes.
+    """
+    from .tensor import Tensor as _T
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "paddle.grad(create_graph=True) (double backward) is not "
+            "supported yet; use paddle.incubate.autograd jvp/vjp "
+            "transforms for higher-order derivatives")
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    # Leaf inputs accumulate via .grad (stashed + restricted so no other
+    # parameter's .grad is touched); non-leaf inputs are read from the
+    # cotangent buffer of their producer slot.
+    leaf_inputs = [t for t in inputs if t._grad_node is None]
+    leaf_targets = {id(t) for t in leaf_inputs}
+    capture = {}
+    for t in inputs:
+        if t._grad_node is not None:
+            capture[(id(t._grad_node), t._out_slot)] = None
+
+    stash = [t._grad for t in leaf_inputs]
+    for t in leaf_inputs:
+        t._grad = None
+    prev_sg = [t.stop_gradient for t in leaf_inputs]
+    for t in leaf_inputs:
+        t.stop_gradient = False
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                     leaf_targets=leaf_targets, capture=capture)
+        results = []
+        for t in inputs:
+            if t._grad_node is not None:
+                g = capture.get((id(t._grad_node), t._out_slot))
+                got = None if g is None else _T(g, stop_gradient=True)
+            else:
+                got = t._grad
+            if got is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient; "
+                        "pass allow_unused=True to get None instead"
+                    )
+                results.append(None)
+            else:
+                results.append(got)
+        return results
+    finally:
+        for t, g, sg in zip(leaf_inputs, stash, prev_sg):
+            t._grad = g
+            t.stop_gradient = sg

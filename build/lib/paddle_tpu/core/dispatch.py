@@ -1,0 +1,108 @@
+"""Op dispatch: the eager hot path.
+
+Reference parity: this is the collapsed TPU-native form of the reference's
+dygraph call chain (SURVEY §3.1) — pybind `<op>_ad_func`
+(`eager/auto_code_generator/generator/eager_gen.py:1109`) → PHI API kernel
+selection (`paddle/phi/api/yaml/generator/api_base.py:373`) →
+`KernelFactory::SelectKernelOrThrowError` (`paddle/phi/core/kernel_factory.h:277`).
+
+Here every op is a pure-jax function over raw arrays; XLA is the kernel
+library and the per-(op, shape, dtype) compilation cache replaces the kernel
+registry. Autograd recording (the `eager_gen.py` grad-node wiring) happens in
+`apply()` via `jax.vjp`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .autograd import Edge, GradNode
+
+
+def _nan_inf_callback(x, op_name):
+    if not np.isfinite(np.asarray(x)).all():
+        raise FloatingPointError(
+            f"NaN/Inf detected in output of op '{op_name}' "
+            f"(shape {getattr(x, 'shape', ())}) inside a compiled step")
+
+
+def _edge_for(t):
+    if t._grad_node is not None:
+        return Edge("node", node=t._grad_node, slot=t._out_slot)
+    if not t.stop_gradient:
+        return Edge("leaf", tensor=t)
+    return Edge("none")
+
+
+def _is_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+        dtype, jnp.complexfloating
+    )
+
+
+def apply(name, fn, inputs, differentiable=True):
+    """Run op `fn` over the raw arrays of `inputs` (Tensors), recording a
+    GradNode when grad is enabled and any input requires grad."""
+    from .tensor import Tensor
+
+    arrays = tuple(t._data for t in inputs)
+    need_grad = (
+        differentiable
+        and autograd.is_grad_enabled()
+        and any(not t.stop_gradient for t in inputs)
+    )
+    if need_grad:
+        outs, vjp_fn = jax.vjp(fn, *arrays)
+    else:
+        outs = fn(*arrays)
+
+    multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if multi else (outs,)
+
+    node = None
+    if need_grad:
+        # Ops whose every output is integral can't carry grad.
+        if not any(_is_float(o.dtype) for o in outs_t):
+            need_grad = False
+        else:
+            node = GradNode(
+                name,
+                vjp_fn,
+                [_edge_for(t) for t in inputs],
+                len(outs_t),
+                [o.shape for o in outs_t],
+                [o.dtype for o in outs_t],
+            )
+
+    # FLAGS_check_nan_inf parity (`framework/details/nan_inf_utils_detail`):
+    # scan every float output when the debug flag is on. Eager values are
+    # checked synchronously; traced values (ops being compiled into a jit
+    # step, e.g. the whole-step trainer) get a `jax.debug.callback` baked
+    # into the executable so the scan runs at execution time with the op
+    # name attributed — the reference wraps every kernel launch the same
+    # way.
+    from ..flags import check_nan_inf_enabled
+    if check_nan_inf_enabled():
+        for o in outs_t:
+            if not _is_float(o.dtype):
+                continue
+            if isinstance(o, jax.core.Tracer):
+                jax.debug.callback(
+                    functools.partial(_nan_inf_callback, op_name=name), o)
+            elif not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}' "
+                    f"(shape {o.shape}, dtype {o.dtype})")
+
+    results = []
+    for i, o in enumerate(outs_t):
+        t = Tensor(o, stop_gradient=not (need_grad and _is_float(o.dtype)))
+        if need_grad and _is_float(o.dtype):
+            t._grad_node = node
+            t._out_slot = i
+        results.append(t)
+    return tuple(results) if multi else results[0]

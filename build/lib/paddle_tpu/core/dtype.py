@@ -1,0 +1,109 @@
+"""Dtype system for paddle_tpu.
+
+Capability parity with the reference's dtype handling
+(`paddle/phi/common/data_type.h`, `python/paddle/fluid/framework.py` dtype
+conversions), realised as thin aliases over numpy/jax dtypes. bfloat16 is
+first-class (TPU-native), float16 is supported but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtype instances (jnp uses the same).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+uint16 = np.dtype(np.uint16)
+uint32 = np.dtype(np.uint32)
+uint64 = np.dtype(np.uint64)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "fp16": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16, "float32": float32,
+    "fp32": float32, "float64": float64, "fp64": float64,
+    "complex64": complex64, "complex128": complex128,
+    "float": float32, "double": float64, "half": float16, "int": int32,
+    "long": int64,
+}
+
+_default_dtype = float32
+
+
+def _canonical(d: np.dtype) -> np.dtype:
+    """TPU-native canonicalisation: without jax x64, 64-bit int/float are
+    emulated or truncated — the framework stores them as 32-bit (the
+    reference's int64 indices become int32, which is what XLA:TPU natively
+    gathers/scatters with)."""
+    import jax
+    if jax.config.jax_enable_x64:
+        return d
+    return {np.dtype(np.int64): int32, np.dtype(np.uint64): uint32,
+            np.dtype(np.float64): float32,
+            np.dtype(np.complex128): complex64}.get(d, d)
+
+
+def convert_dtype(dtype):
+    """Normalise any dtype spec (str / np.dtype / jnp type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, np.dtype):
+        return _canonical(dtype)
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _ALIASES:
+            return _canonical(_ALIASES[key])
+        return _canonical(np.dtype(dtype))
+    return _canonical(np.dtype(dtype))
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (float16, bfloat16, float32, float64)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer) or d == bool_
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.complexfloating)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

@@ -1,0 +1,119 @@
+"""LoDTensor — variable-length sequence batches.
+
+Parity: `paddle/fluid/framework/lod_tensor.h` (level-of-detail tensor: a
+dense buffer + per-level offset table describing a ragged batch) and the
+python `fluid.create_lod_tensor` / `Tensor.lod()` surface, used by the
+PS/NLP legacy paths (sequence ops, DataFeed var-len slots).
+
+TPU-native stance: XLA wants static shapes, so the ragged structure lives
+as (data, offsets) pairs on host — exactly the SURVEY §7 plan — with
+conversions to padded+mask form (what compiled steps consume) and
+segment-id form (what segment reductions consume).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+class LoDTensor(Tensor):
+    """Dense data + offset levels. offsets are python lists of ints
+    (host metadata, never traced)."""
+
+    __slots__ = ("_lod",)
+
+    def __init__(self, data, lod=None, stop_gradient=True):
+        d = data._data if isinstance(data, Tensor) else data
+        super().__init__(d, stop_gradient=stop_gradient)
+        self._lod = [list(map(int, level)) for level in (lod or [])]
+
+    def lod(self):
+        return self._lod
+
+    def set_lod(self, lod):
+        self._lod = [list(map(int, level)) for level in lod]
+
+    def recursive_sequence_lengths(self):
+        return [[b - a for a, b in zip(level, level[1:])]
+                for level in self._lod]
+
+    # ----------------------------------------------------- conversions
+    def sequence_count(self):
+        return len(self._lod[-1]) - 1 if self._lod else self.shape[0]
+
+    def to_padded(self, pad_value=0.0):
+        """-> (padded [n_seq, max_len, *feat], length [n_seq]) Tensors —
+        the static-shape form compiled steps consume."""
+        assert self._lod, "LoDTensor without lod is already dense"
+        offs = self._lod[-1]
+        lens = [b - a for a, b in zip(offs, offs[1:])]
+        n, m = len(lens), max(lens) if lens else 0
+        feat = self.shape[1:]
+        arr = np.asarray(self.numpy())
+        out = np.full((n, m, *feat), pad_value, arr.dtype)
+        for i, (a, b) in enumerate(zip(offs, offs[1:])):
+            out[i, : b - a] = arr[a:b]
+        return Tensor(out), Tensor(np.asarray(lens, np.int64))
+
+    def segment_ids(self):
+        """-> int32 [total_len] mapping each row to its sequence — the
+        form segment reductions (sequence_pool parity) consume."""
+        assert self._lod
+        offs = self._lod[-1]
+        lens = [b - a for a, b in zip(offs, offs[1:])]
+        return Tensor(np.repeat(np.arange(len(lens), dtype=np.int32),
+                                lens))
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape}, lod={self._lod})")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """fluid.create_lod_tensor parity: lengths -> offsets."""
+    lod = []
+    for lens in recursive_seq_lens:
+        offs = [0]
+        for ln in lens:
+            offs.append(offs[-1] + int(ln))
+        lod.append(offs)
+    arr = data.numpy() if isinstance(data, Tensor) else np.asarray(data)
+    return LoDTensor(arr, lod)
+
+
+def from_padded(padded, lengths):
+    """(padded [n, m, *feat], lengths [n]) -> LoDTensor (ragged rows
+    concatenated)."""
+    p = padded.numpy() if isinstance(padded, Tensor) else \
+        np.asarray(padded)
+    lens = [int(x) for x in np.asarray(
+        lengths.numpy() if isinstance(lengths, Tensor) else lengths)]
+    rows = [p[i, :ln] for i, ln in enumerate(lens)]
+    offs = [0]
+    for ln in lens:
+        offs.append(offs[-1] + ln)
+    return LoDTensor(np.concatenate(rows, axis=0) if rows
+                     else p[:0, 0], [offs])
+
+
+def sequence_pool(x: LoDTensor, pool_type="sum"):
+    """sequence_pool op parity over segment reductions (runs on device)."""
+    import jax
+    seg = x.segment_ids()._data
+    n = x.sequence_count()
+    data = x._data
+    if pool_type in ("sum", "average", "mean"):
+        out = jax.ops.segment_sum(data, seg, num_segments=n)
+        if pool_type in ("average", "mean"):
+            lens = jax.ops.segment_sum(
+                np.ones((data.shape[0],), np.float32), seg,
+                num_segments=n)
+            out = out / np.maximum(
+                np.asarray(lens).reshape([-1] + [1] * (out.ndim - 1)), 1)
+    elif pool_type == "max":
+        out = jax.ops.segment_max(data, seg, num_segments=n)
+    elif pool_type == "min":
+        out = jax.ops.segment_min(data, seg, num_segments=n)
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return Tensor(out)

@@ -1,0 +1,109 @@
+"""Device placement.
+
+Parity with the reference's Place hierarchy (`paddle/phi/common/place.h`) and
+`paddle.device.set_device` (`python/paddle/device/__init__.py`), mapped onto
+jax devices. The TPU place is first-class; CPU is the host fallback.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Base place. Equality is by (kind, device_id)."""
+
+    kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_of(d) == self.kind]
+        if not devs:
+            # fall back to host cpu devices
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def _platform_of(dev) -> str:
+    p = dev.platform
+    return {"cpu": "cpu", "tpu": "tpu", "axon": "tpu"}.get(p, p)
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+
+# paddle calls its accelerator place CUDAPlace; we keep an alias so ported
+# user code keeps working, but it resolves to the TPU.
+CUDAPlace = TPUPlace
+
+_current_place: Place | None = None
+
+
+def _default_place() -> Place:
+    try:
+        plat = _platform_of(jax.devices()[0])
+    except Exception:
+        plat = "cpu"
+    return TPUPlace(0) if plat == "tpu" else CPUPlace(0)
+
+
+def get_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = _default_place()
+    return _current_place
+
+
+def set_device(device) -> Place:
+    """paddle.device.set_device('tpu:0' | 'cpu' | 'gpu:0') parity."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    s = str(device).lower()
+    dev_id = 0
+    if ":" in s:
+        s, idx = s.split(":", 1)
+        dev_id = int(idx)
+    if s in ("tpu", "gpu", "cuda", "xpu", "npu"):
+        _current_place = TPUPlace(dev_id)
+    elif s == "cpu":
+        _current_place = CPUPlace(dev_id)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _current_place
+
+
+def get_device() -> str:
+    p = get_place()
+    return f"{p.kind}:{p.device_id}"
+
+
+def is_compiled_with_cuda() -> bool:  # parity shim; we are TPU-native
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return _platform_of(jax.devices()[0]) == "tpu"
+    except Exception:
+        return False

@@ -1,0 +1,92 @@
+"""paddle_tpu.device — `python/paddle/device/` parity (set_device, streams,
+memory stats). Device memory is owned by XLA/PJRT; stats come from
+jax's device memory profile.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (set_device, get_device, CPUPlace, TPUPlace,  # noqa
+                          CUDAPlace)
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return jax.device_count()
+
+
+class _MemStats:
+    def _stats(self, device_id=0):
+        try:
+            d = jax.devices()[device_id]
+            return d.memory_stats() or {}
+        except Exception:
+            return {}
+
+
+_mem = _MemStats()
+
+
+def memory_allocated(device=None):
+    return _mem._stats().get("bytes_in_use", 0)
+
+
+def max_memory_allocated(device=None):
+    return _mem._stats().get("peak_bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return _mem._stats().get("bytes_reserved",
+                             _mem._stats().get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    pass
+
+
+def synchronize(device=None):
+    """device synchronize — block until all queued work completes."""
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.zeros(()))
+
+
+# paddle.device.cuda shim so ported code keeps working on TPU
+class cuda:
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    synchronize = staticmethod(synchronize)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    class Event:
+        def __init__(self, *a, **k):
+            self._t = None
+
+        def record(self, stream=None):
+            import time
+            synchronize()
+            self._t = time.perf_counter()
+
+    class Stream:
+        def __init__(self, *a, **k):
+            pass

@@ -1,0 +1,523 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Parity: `python/paddle/distribution/` (Distribution, Normal, Uniform,
+Categorical, Bernoulli, Beta, Dirichlet, Exponential family bits,
+kl_divergence) over jax.random + jax.scipy.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as rng
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+from ..core import dispatch
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_tensor(loc, dtype="float32")
+        self.scale = as_tensor(scale, dtype="float32")
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        key = rng.next_key()
+        out_shape = shape + tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape))
+        eps = jax.random.normal(key, out_shape)
+        return Tensor(self.loc._data + eps * self.scale._data)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return dispatch.apply("normal_log_prob", _fn,
+                              (value, self.loc, self.scale))
+
+    def entropy(self):
+        def _fn(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return dispatch.apply("normal_entropy", _fn, (self.scale,))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = as_tensor(low, dtype="float32")
+        self.high = as_tensor(high, dtype="float32")
+        super().__init__(tuple(self.low.shape))
+
+    def sample(self, shape=(), seed=0):
+        key = rng.next_key()
+        out_shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.low._data.shape, self.high._data.shape))
+        u = jax.random.uniform(key, out_shape)
+        return Tensor(self.low._data + u * (self.high._data
+                                            - self.low._data))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return dispatch.apply("uniform_log_prob", _fn,
+                              (value, self.low, self.high))
+
+    def entropy(self):
+        def _fn(lo, hi):
+            return jnp.log(hi - lo)
+        return dispatch.apply("uniform_entropy", _fn,
+                              (self.low, self.high))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = as_tensor(logits, dtype="float32")
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out = jax.random.categorical(
+            key, self.logits._data, shape=tuple(shape)
+            + tuple(self.logits.shape[:-1]))
+        # reference returns int64; canonical int on TPU is int32
+        return Tensor(out.astype(jnp.int32))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return dispatch.apply("categorical_log_prob", _fn,
+                              (value, self.logits))
+
+    def probs(self, value=None):
+        from ..nn import functional as F
+        p = F.softmax(self.logits)
+        if value is None:
+            return p
+        from .. import ops
+        return ops.take_along_axis(p, as_tensor(value).unsqueeze(-1),
+                                   axis=-1)
+
+    def entropy(self):
+        def _fn(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return dispatch.apply("categorical_entropy", _fn, (self.logits,))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = as_tensor(probs, dtype="float32")
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out_shape = tuple(shape) + tuple(self.probs_.shape)
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_._data, out_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return dispatch.apply("bernoulli_log_prob", _fn,
+                              (value, self.probs_))
+
+    def entropy(self):
+        def _fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return dispatch.apply("bernoulli_entropy", _fn, (self.probs_,))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = as_tensor(alpha, dtype="float32")
+        self.beta = as_tensor(beta, dtype="float32")
+        super().__init__(tuple(self.alpha.shape))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        out_shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.alpha._data.shape, self.beta._data.shape))
+        return Tensor(jax.random.beta(key, self.alpha._data,
+                                      self.beta._data, out_shape))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(a)
+                       + jax.scipy.special.gammaln(b)
+                       - jax.scipy.special.gammaln(a + b)))
+        return dispatch.apply("beta_log_prob", _fn,
+                              (value, self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = as_tensor(concentration, dtype="float32")
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = rng.next_key()
+        return Tensor(jax.random.dirichlet(
+            key, self.concentration._data, tuple(shape)
+            + tuple(self.concentration.shape[:-1])))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+
+        def _fn(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), axis=-1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), axis=-1))
+        return dispatch.apply("dirichlet_log_prob", _fn,
+                              (value, self.concentration))
+
+
+def kl_divergence(p, q):
+    """paddle.distribution.kl_divergence parity for the common pairs."""
+    from .. import ops
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        def _fn(l1, s1, l2, s2):
+            var1, var2 = s1 * s1, s2 * s2
+            return (jnp.log(s2 / s1) + (var1 + (l1 - l2) ** 2)
+                    / (2 * var2) - 0.5)
+        return dispatch.apply("kl_normal", _fn,
+                              (p.loc, p.scale, q.loc, q.scale))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def _fn(lg1, lg2):
+            lp1 = jax.nn.log_softmax(lg1, -1)
+            lp2 = jax.nn.log_softmax(lg2, -1)
+            return jnp.sum(jnp.exp(lp1) * (lp1 - lp2), axis=-1)
+        return dispatch.apply("kl_categorical", _fn,
+                              (p.logits, q.logits))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        def _fn(lo1, hi1, lo2, hi2):
+            return jnp.log((hi2 - lo2) / (hi1 - lo1))
+        return dispatch.apply("kl_uniform", _fn,
+                              (p.low, p.high, q.low, q.high))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        def _fn(p1, p2):
+            p1 = jnp.clip(p1, 1e-7, 1 - 1e-7)
+            p2 = jnp.clip(p2, 1e-7, 1 - 1e-7)
+            return (p1 * (jnp.log(p1) - jnp.log(p2))
+                    + (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+        return dispatch.apply("kl_bernoulli", _fn, (p.probs_, q.probs_))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions
+    (`python/paddle/distribution/exponential_family.py`): subclasses
+    defining `_natural_parameters`/`_log_normalizer` get entropy() for
+    free via the Bregman identity H = logZ - <eta, grad logZ> (+ mean
+    carrier measure, assumed 0 as in the reference)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [as_tensor(p, dtype="float32")._data
+               for p in self._natural_parameters]
+        # per-element grads via grad-of-sum; entropy stays batch-shaped
+        # (reference reduces nothing beyond the elementwise eta*grad)
+        grads = jax.grad(
+            lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = -self._mean_carrier_measure + self._log_normalizer(*nat)
+        for eta, g in zip(nat, grads):
+            ent = ent - eta * g
+        return Tensor(ent)
+
+
+class Multinomial(Distribution):
+    """`python/paddle/distribution/multinomial.py`: counts over k
+    categories from `total_count` draws."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = as_tensor(probs, dtype="float32")
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         (self.probs.shape[-1],))
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        key = rng.next_key()
+        draws = jax.random.categorical(
+            key, jnp.log(p), axis=-1,
+            shape=tuple(shape) + (self.total_count,)
+            + tuple(self.probs.shape[:-1]))
+        onehot = jax.nn.one_hot(draws, k)
+        # sum over the draw axis (first of the appended axes)
+        counts = onehot.sum(axis=len(tuple(shape)))
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = as_tensor(value, dtype="float32")
+        n = float(self.total_count)
+
+        def f(val, pr):
+            pn = pr / pr.sum(-1, keepdims=True)
+            logc = (jax.scipy.special.gammaln(n + 1.0)
+                    - jax.scipy.special.gammaln(val + 1.0).sum(-1))
+            # xlogy: count 0 with prob 0 contributes 0, not 0 * -inf
+            return logc + jax.scipy.special.xlogy(val, pn).sum(-1)
+
+        return dispatch.apply("multinomial_log_prob", f, (v, self.probs))
+
+    @property
+    def mean(self):
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        return Tensor(self.total_count * p)
+
+    @property
+    def variance(self):
+        p = self.probs._data / self.probs._data.sum(-1, keepdims=True)
+        return Tensor(self.total_count * p * (1 - p))
+
+
+class Independent(Distribution):
+    """Reinterprets `reinterpreted_batch_rank` trailing batch dims as
+    event dims (`python/paddle/distribution/independent.py`)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        if not 0 <= self.rank <= len(bshape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} out of range for "
+                f"base batch_shape {bshape}")
+        super().__init__(bshape[: len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        from .. import ops
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = ops.sum(lp, axis=-1)
+        return lp
+
+    def entropy(self):
+        from .. import ops
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = ops.sum(e, axis=-1)
+        return e
+
+
+# ------------------------------------------------------------ transforms
+
+
+class Transform:
+    """`python/paddle/distribution/transform.py` base: forward/inverse +
+    log|det J|."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """All transform math routes through dispatched ops so gradients flow
+    through the tape (MLE on transformed distributions needs d log_prob /
+    d params)."""
+
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, dtype="float32")
+        self.scale = as_tensor(scale, dtype="float32")
+
+    def forward(self, x):
+        return self.loc + self.scale * as_tensor(x)
+
+    def inverse(self, y):
+        return (as_tensor(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        shp = tuple(as_tensor(x).shape)
+        return dispatch.apply(
+            "affine_ldj",
+            lambda s: jnp.broadcast_to(jnp.log(jnp.abs(s)), shp),
+            (self.scale,))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        from .. import ops
+        return ops.exp(as_tensor(x))
+
+    def inverse(self, y):
+        from .. import ops
+        return ops.log(as_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return as_tensor(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        x = as_tensor(x)
+        return dispatch.apply("sigmoid_t", jax.nn.sigmoid, (x,))
+
+    def inverse(self, y):
+        y = as_tensor(y)
+        return dispatch.apply(
+            "logit_t", lambda v: jnp.log(v) - jnp.log1p(-v), (y,))
+
+    def forward_log_det_jacobian(self, x):
+        x = as_tensor(x)
+        return dispatch.apply(
+            "sigmoid_ldj",
+            lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), (x,))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        x = as_tensor(x)
+        return dispatch.apply("tanh_t", jnp.tanh, (x,))
+
+    def inverse(self, y):
+        y = as_tensor(y)
+        return dispatch.apply("arctanh_t", jnp.arctanh, (y,))
+
+    def forward_log_det_jacobian(self, x):
+        x = as_tensor(x)
+        return dispatch.apply(
+            "tanh_ldj",
+            lambda v: 2.0 * (jnp.log(2.0) - v - jax.nn.softplus(-2 * v)),
+            (x,))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """`python/paddle/distribution/transformed_distribution.py`: push a
+    base distribution through a Transform; log_prob via change of
+    variables."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms))
+        super().__init__(tuple(base.batch_shape),
+                         tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        from .. import ops
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        ildj = self.transform.forward_log_det_jacobian(x)
+        # elementwise transforms: reduce the per-element Jacobian over
+        # the base's event dims so it matches base_lp's shape
+        for _ in range(len(self.base.event_shape)):
+            ildj = ops.sum(ildj, axis=-1)
+        return base_lp - ildj

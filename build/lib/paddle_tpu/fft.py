@@ -1,0 +1,62 @@
+"""paddle_tpu.fft — `python/paddle/fft.py` parity over jnp.fft (XLA FFT)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._helpers import as_tensor, unary
+
+
+def _fft_op(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None, _f=jfn,
+           _n=name):
+        x = as_tensor(x)
+        return unary(_n, lambda a: _f(a, n=n, axis=axis, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+fft = _fft_op("fft", jnp.fft.fft)
+ifft = _fft_op("ifft", jnp.fft.ifft)
+rfft = _fft_op("rfft", jnp.fft.rfft)
+irfft = _fft_op("irfft", jnp.fft.irfft)
+hfft = _fft_op("hfft", jnp.fft.hfft)
+ihfft = _fft_op("ihfft", jnp.fft.ihfft)
+
+
+def _fftn_op(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name_=None, _f=jfn,
+           _n=name):
+        x = as_tensor(x)
+        return unary(_n, lambda a: _f(a, s=s, axes=axes, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+fft2 = _fftn_op("fft2", jnp.fft.fft2)
+ifft2 = _fftn_op("ifft2", jnp.fft.ifft2)
+fftn = _fftn_op("fftn", jnp.fft.fftn)
+ifftn = _fftn_op("ifftn", jnp.fft.ifftn)
+rfft2 = _fftn_op("rfft2", jnp.fft.rfft2)
+irfft2 = _fftn_op("irfft2", jnp.fft.irfft2)
+rfftn = _fftn_op("rfftn", jnp.fft.rfftn)
+irfftn = _fftn_op("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None):
+    return unary("fftshift", lambda a: jnp.fft.fftshift(a, axes),
+                 as_tensor(x))
+
+
+def ifftshift(x, axes=None):
+    return unary("ifftshift", lambda a: jnp.fft.ifftshift(a, axes),
+                 as_tensor(x))

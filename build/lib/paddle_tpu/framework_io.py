@@ -1,0 +1,44 @@
+"""paddle.save / paddle.load — checkpoint I/O.
+
+Parity: `python/paddle/framework/io.py:646,876` (pickle-based state_dict of
+params + optimizer accumulators, >4GB protocol). Tensors are stored as
+numpy arrays; `paddle_tpu.distributed.checkpoint` layers orbax-style async
+sharded checkpointing on top for the distributed case (SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj)
+    except Exception:
+        pass
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,105 @@
+"""paddle_tpu.geometric — graph learning ops.
+
+Parity: `python/paddle/geometric/` (segment_sum/mean/max/min,
+send_u_recv message passing) over XLA segment ops — the compute core the
+reference's GPU graph engine feeds (`paddle/phi/kernels/
+segment_pool_kernel.h`, `graph_send_recv_kernel.h`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+
+
+def _segment(name, jfn, data, segment_ids):
+    data, segment_ids = as_tensor(data), as_tensor(segment_ids)
+    n_seg = int(np.asarray(segment_ids.numpy()).max()) + 1 \
+        if segment_ids.size else 0
+
+    def _fn(d, s):
+        return jfn(d, s, num_segments=n_seg)
+    return dispatch.apply(name, _fn, (data, segment_ids))
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    data_t, seg_t = as_tensor(data), as_tensor(segment_ids)
+    n_seg = int(np.asarray(seg_t.numpy()).max()) + 1 if seg_t.size else 0
+
+    def _fn(d, s):
+        sums = jax.ops.segment_sum(d, s, num_segments=n_seg)
+        counts = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), s,
+                                     num_segments=n_seg)
+        return sums / jnp.maximum(counts, 1.0).reshape(
+            (-1,) + (1,) * (d.ndim - 1))
+    return dispatch.apply("segment_mean", _fn, (data_t, seg_t))
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Message passing: gather x[src] and segment-reduce onto dst
+    (graph_send_recv parity)."""
+    x, src_index, dst_index = (as_tensor(x), as_tensor(src_index),
+                               as_tensor(dst_index))
+    n_out = int(out_size) if out_size is not None else \
+        int(np.asarray(dst_index.numpy()).max()) + 1
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}.get(reduce_op)
+
+    def _fn(xa, src, dst):
+        msgs = jnp.take(xa, src, axis=0)
+        if reduce_op == "mean":
+            sums = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+            counts = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), xa.dtype), dst,
+                num_segments=n_out)
+            return sums / jnp.maximum(counts, 1.0).reshape(
+                (-1,) + (1,) * (xa.ndim - 1))
+        return red(msgs, dst, num_segments=n_out)
+    return dispatch.apply("send_u_recv", _fn, (x, src_index, dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node+edge message passing (graph_send_ue_recv parity)."""
+    x, y = as_tensor(x), as_tensor(y)
+    src_index, dst_index = as_tensor(src_index), as_tensor(dst_index)
+    n_out = int(out_size) if out_size is not None else \
+        int(np.asarray(dst_index.numpy()).max()) + 1
+
+    def _fn(xa, ya, src, dst):
+        msgs = jnp.take(xa, src, axis=0)
+        if message_op == "add":
+            msgs = msgs + ya
+        elif message_op == "mul":
+            msgs = msgs * ya
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, dst, num_segments=n_out)
+        if reduce_op == "min":
+            return jax.ops.segment_min(msgs, dst, num_segments=n_out)
+        sums = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+        counts = jax.ops.segment_sum(
+            jnp.ones((msgs.shape[0],), msgs.dtype), dst,
+            num_segments=n_out)
+        return sums / jnp.maximum(counts, 1.0).reshape(
+            (-1,) + (1,) * (msgs.ndim - 1))
+    return dispatch.apply("send_ue_recv", _fn,
+                          (x, y, src_index, dst_index))

@@ -1,0 +1,2 @@
+from .model import Model, InputSpec  # noqa: F401
+from . import callbacks  # noqa: F401

@@ -1,0 +1,65 @@
+"""paddle.summary — parity: `python/paddle/hapi/model_summary.py`."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import ops
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Prints a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(p.size for p in l._parameters.values()
+                           if p is not None)
+            rows.append((name or l.__class__.__name__,
+                         l.__class__.__name__, shape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    if input_size is not None:
+        if isinstance(input_size, tuple):
+            input_size = [input_size]
+        dtypes = dtypes or ["float32"] * len(input_size)
+        inputs = []
+        for shape, dt in zip(input_size, dtypes):
+            shape = [s if s and s > 0 else 1 for s in shape]
+            if str(dt).startswith("int"):
+                inputs.append(Tensor(np.zeros(shape, np.int32)))
+            else:
+                inputs.append(ops.zeros(shape, dt))
+        was_training = net.training
+        net.eval()
+        try:
+            net(*inputs)
+        finally:
+            if was_training:
+                net.train()
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters()
+                    if not p.stop_gradient)
+    header = f"{'Layer (type)':<40}{'Output Shape':<24}{'Param #':>12}"
+    lines = [header, "=" * len(header)]
+    for name, cls, shape, n in rows:
+        lines.append(f"{name + ' (' + cls + ')':<40}"
+                     f"{str(shape):<24}{n:>12,}")
+    lines += ["=" * len(header),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,44 @@
+"""paddle.hub — parity for the local-source paths (`python/paddle/hub.py`).
+Zero-egress image: github sources are rejected with a clear error; local
+directories with a hubconf.py work fully.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            "paddle_tpu.hub supports source='local' only in this "
+            "environment (no network egress); clone the repo and pass its "
+            "path")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod) if callable(getattr(mod, n))
+            and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

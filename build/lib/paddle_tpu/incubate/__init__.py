@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — incubating APIs (`python/paddle/incubate/`).
+MoE lives in paddle_tpu.incubate.distributed.models.moe (parity path).
+"""
+from . import nn  # noqa: F401
+from . import autotune  # noqa: F401
+from . import distributed  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401

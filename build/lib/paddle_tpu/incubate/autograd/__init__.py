@@ -1,0 +1,71 @@
+"""paddle.incubate.autograd — primitive-transform AD.
+
+Parity: `python/paddle/incubate/autograd/` (primops/primx forward+reverse
+prim transforms). TPU-native: jax's functional transforms ARE the
+primitive AD system; these wrappers expose jvp/vjp/jacobian/hessian over
+Tensor-valued functions.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.tensor import Tensor
+from ...core import autograd as _ag
+
+
+def _wrap_fn(func):
+    def pure(*arrays):
+        tensors = [Tensor(a) for a in arrays]
+        with _ag.no_grad():
+            out = func(*tensors)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+    return pure
+
+
+def _unwrap(xs):
+    return tuple(x._data if isinstance(x, Tensor) else x for x in xs)
+
+
+def jvp(func, primals, tangents):
+    primals = primals if isinstance(primals, (list, tuple)) else [primals]
+    tangents = tangents if isinstance(tangents, (list, tuple)) \
+        else [tangents]
+    out, tan = jax.jvp(_wrap_fn(func), _unwrap(primals), _unwrap(tangents))
+    wrap = lambda o: tuple(Tensor(v) for v in o) \
+        if isinstance(o, tuple) else Tensor(o)  # noqa: E731
+    return wrap(out), wrap(tan)
+
+
+def vjp(func, primals, cotangents=None):
+    primals = primals if isinstance(primals, (list, tuple)) else [primals]
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *_unwrap(primals))
+    if cotangents is None:
+        import jax.numpy as jnp
+        cotangents = jax.tree.map(jnp.ones_like, out)
+    else:
+        cts = cotangents if isinstance(cotangents, (list, tuple)) \
+            else [cotangents]
+        cotangents = tuple(c._data if isinstance(c, Tensor) else c
+                           for c in cts)
+        if not isinstance(out, tuple):
+            cotangents = cotangents[0]
+    grads = vjp_fn(cotangents)
+    wrap = lambda o: tuple(Tensor(v) for v in o) \
+        if isinstance(o, tuple) else Tensor(o)  # noqa: E731
+    return wrap(out), [Tensor(g) for g in grads]
+
+
+def Jacobian(func, xs, is_batched=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    jac = jax.jacobian(_wrap_fn(func), argnums=tuple(range(len(xs_l))))(
+        *_unwrap(xs_l))
+    return jax.tree.map(Tensor, jac)
+
+
+def Hessian(func, xs, is_batched=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    hes = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(xs_l))))(
+        *_unwrap(xs_l))
+    return jax.tree.map(Tensor, hes)

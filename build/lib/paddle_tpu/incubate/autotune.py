@@ -1,0 +1,65 @@
+"""Layout autotune — NCHW models rewritten to channels-last.
+
+Parity: the reference's layout autotune
+(`paddle/fluid/imperative/layout_autotune.cc`, enabled via
+`paddle.incubate.autotune.set_config(... "layout": ...)`) rewrites a
+dygraph NCHW program to NHWC for tensor-core GPUs, inserting boundary
+transposes.
+
+On TPU the stakes are higher: vector registers tile the two MINOR axes
+(8, 128), so NCHW feature maps put W on the 128-lane axis — deep-layer
+maps like [B, 512, 7, 7] pad 7 -> 128 (18x memory/bandwidth blowup) and
+every elementwise/BN op between convs pays it. Channels-last puts C
+(64/128/256/512 in ResNets — tile-aligned) on the lanes: pad-free.
+
+`to_channels_last(model)` flips every layout-aware layer (Conv2D,
+BatchNorm2D, SyncBatchNorm, pooling, AdaptiveAvgPool2D) to NHWC in
+place and returns the model. The caller feeds NHWC inputs (transpose
+once at the input edge: `x.transpose([0, 2, 3, 1])`).
+
+Safe for conv-BN-act-residual topologies (elementwise ops are
+layout-agnostic; flatten after a global pool sees [B, 1, 1, C] ==
+[B, C] either way). NOT safe for models that index/concat/reshape axis
+1 as channels mid-network — those need manual data_format plumbing.
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+
+
+_FLIP = {"NCHW": "NHWC", "NCL": "NLC", "NCDHW": "NDHWC"}
+
+
+def to_channels_last(model: Layer) -> Layer:
+    """Flip every layout-aware sublayer of `model` to channels-last (in
+    place). Feed the model channels-last inputs afterwards."""
+    for layer in model.sublayers(include_self=True):
+        fmt = getattr(layer, "_data_format", None)
+        if fmt in _FLIP:
+            layer._data_format = _FLIP[fmt]
+        elif fmt is None and layer.__class__.__name__.startswith(
+                ("MaxPool", "AvgPool")):
+            # pooling layers default to NCHW via `_data_format=None`
+            layer._data_format = "NHWC"
+        # LocalResponseNorm stores `data_format` without underscore
+        fmt2 = getattr(layer, "data_format", None)
+        if isinstance(fmt2, str) and fmt2 in _FLIP:
+            layer.data_format = _FLIP[fmt2]
+    return model
+
+
+def set_config(config=None):
+    """`paddle.incubate.autotune.set_config` shim: accepts the reference
+    config dict; layout autotune maps to `to_channels_last` (explicit —
+    the implicit per-op rewrite doesn't exist here because XLA already
+    owns kernel selection/fusion)."""
+    layout_cfg = config.get("layout") if isinstance(config, dict) else None
+    if isinstance(layout_cfg, dict) and layout_cfg.get("enable", False):
+        import warnings
+        warnings.warn(
+            "layout autotune via set_config is a no-op here: XLA owns "
+            "kernel selection, and the implicit per-op NCHW->NHWC rewrite "
+            "does not exist. Call "
+            "paddle.incubate.autotune.to_channels_last(model) explicitly "
+            "and feed channels-last inputs.", stacklevel=2)
+    return None

@@ -1,0 +1,14 @@
+"""MoE user API.
+
+Parity: `python/paddle/incubate/distributed/models/moe/` (`MoELayer`
+(moe_layer.py), gates: NaiveGate/GShardGate/SwitchGate, comm via
+global_scatter/global_gather ops `collective/global_scatter_op.cu.cc`).
+
+TPU-native: the dispatch/combine is the dense one-hot + `lax.all_to_all`
+implementation in parallel/hybrid_gpt._moe_ffn; this module provides the
+layer/gate class surface over it. Inside a compiled sharded step with an
+"ep" (=dp) mesh axis the all_to_all rides ICI; on one chip it degrades to
+a dense grouped-FFN.
+"""
+from .gate import NaiveGate, GShardGate, SwitchGate, BaseGate  # noqa
+from .moe_layer import MoELayer  # noqa

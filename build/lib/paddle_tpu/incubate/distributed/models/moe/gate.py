@@ -1,0 +1,127 @@
+"""MoE gates — parity: `python/paddle/incubate/distributed/models/moe/gate/`
+(naive_gate.py, gshard_gate.py, switch_gate.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer_base import Layer
+from .....nn.layers.common import Linear
+from .....core.tensor import Tensor
+from .....core import dispatch
+from .....ops._helpers import as_tensor
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.topk = topk
+        self.loss = None
+
+    def get_loss(self):
+        return self.loss
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no auxiliary loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.gate = Linear(d_model, self.tot_expert)
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        k = self.topk
+
+        def _fn(lg):
+            val, idx = jax.lax.top_k(lg, k)
+            return jax.nn.softmax(val, axis=-1), idx
+        val, idx = dispatch.apply("naive_gate", _fn, (as_tensor(logits),))
+        return val, idx
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch gate with load-balance aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_expert, world_size, 1)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        E = self.tot_expert
+        cap_factor = self.capacity[0] if self.training else self.capacity[1]
+
+        def _fn(lg):
+            T = lg.shape[0]
+            cap = max(1, int(cap_factor * T / E))
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            idx = jnp.argmax(probs, axis=-1)
+            val = jnp.max(probs, axis=-1)
+            oh = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(oh.astype(jnp.float32), axis=0)
+            aux = E * jnp.sum(me * ce)
+            # capacity: zero the gate of overflow tokens (reference
+            # prune_gate_by_capacity op)
+            pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)
+            val = jnp.where(pos < cap, val, 0.0)
+            return val[:, None], idx[:, None].astype(jnp.int32), aux
+        val, idx, aux = dispatch.apply("switch_gate", _fn,
+                                       (as_tensor(logits),))
+        self.loss = aux
+        return val, idx
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True):
+        super().__init__(d_model, num_expert, world_size, 2)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        E = self.tot_expert
+        cap_factor = self.capacity[0] if self.training else self.capacity[1]
+        do_random = self.random_routing and self.training
+        from .....core import random as rng
+        rkey = rng.next_key() if do_random else None
+
+        def _fn(lg):
+            T = lg.shape[0]
+            cap = max(1, int(cap_factor * T / E))
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            val, idx = jax.lax.top_k(probs, 2)
+            top1 = idx[:, 0]
+            oh1 = jax.nn.one_hot(top1, E, dtype=jnp.int32)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(oh1.astype(jnp.float32), axis=0)
+            aux = E * jnp.sum(me * ce)
+            # capacity-prune the primary expert (secondary experts keep
+            # their gate — GShard prunes them after dispatch)
+            pos = jnp.sum(jnp.cumsum(oh1, axis=0) * oh1 - oh1, axis=-1)
+            val = val.at[:, 0].set(jnp.where(pos < cap, val[:, 0], 0.0))
+            if do_random:
+                # GShard random routing: keep the 2nd expert with
+                # probability proportional to its gate (2*g2), else drop
+                u = jax.random.uniform(rkey, (T,))
+                keep2 = u < 2.0 * val[:, 1]
+                val = val.at[:, 1].set(jnp.where(keep2, val[:, 1], 0.0))
+            return val / jnp.maximum(
+                jnp.sum(val, -1, keepdims=True), 1e-12), \
+                idx.astype(jnp.int32), aux
+        val, idx, aux = dispatch.apply("gshard_gate", _fn,
+                                       (as_tensor(logits),))
+        self.loss = aux
+        return val, idx

@@ -1,0 +1,62 @@
+"""MoELayer — parity: moe_layer.py `MoELayer(gate, experts, ...)`.
+
+Top-k dispatch/combine implemented densely (one-hot einsum, TPU-friendly);
+the expert-parallel all_to_all happens when the surrounding step is
+compiled over a mesh with the experts sharded (hybrid_gpt's _moe_ffn path);
+eager single-controller execution evaluates experts locally.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer_base import Layer
+from .....nn.container import LayerList
+from .....core.tensor import Tensor
+from .....core import dispatch
+from .....ops._helpers import as_tensor
+from .gate import NaiveGate, SwitchGate, GShardGate
+
+
+class MoELayer(Layer):
+    """moe_layer.py:MoELayer parity: inp [B, S, d] -> [B, S, d]."""
+
+    def __init__(self, d_model, experts=None, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(gate, dict):
+            gtype = gate.get("type", "gshard")
+            topk = gate.get("top_k", 2)
+            n_exp = len(experts)
+            cls = {"naive": NaiveGate, "switch": SwitchGate,
+                   "gshard": GShardGate}[gtype]
+            gate = cls(d_model, n_exp, topk=topk)
+        self.gate = gate
+        self.experts = experts if isinstance(experts, LayerList) \
+            else LayerList(experts)
+        self.num_expert = len(self.experts)
+
+    def forward(self, inp):
+        inp = as_tensor(inp)
+        shape = inp.shape
+        d = shape[-1]
+        from ..... import ops
+        x = ops.reshape(inp, [-1, d])  # [T, d]
+        gate_val, gate_idx = self.gate(x)  # [T, k], [T, k]
+        E = self.num_expert
+
+        # run every expert on all tokens, combine by gates (dense combine;
+        # the sparse dispatch version lives in the compiled hybrid path)
+        expert_outs = [ops.unsqueeze(exp(x), 1) for exp in self.experts]
+        stacked = ops.concat(expert_outs, axis=1)  # [T, E, d]
+
+        gv, gi, st = as_tensor(gate_val), as_tensor(gate_idx), \
+            as_tensor(stacked)
+
+        def _fn(val, idx, outs):
+            mask = jax.nn.one_hot(idx, E, dtype=outs.dtype)  # [T,k,E]
+            w = jnp.einsum("tk,tke->te", val.astype(outs.dtype), mask)
+            return jnp.einsum("te,ted->td", w, outs)
+        out = dispatch.apply("moe_combine", _fn, (gv, gi, st))
+        return ops.reshape(out, shape)

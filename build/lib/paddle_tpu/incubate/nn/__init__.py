@@ -1,0 +1,23 @@
+"""Fused layers — the LLM-serving stack.
+
+Parity: `python/paddle/incubate/nn/layer/fused_transformer.py`. Real
+TPU-native implementations live in `fused_transformer.py` (stacked
+weights + `lax.scan`, fixed-shape KV cache, weight-only int8, MoE) and
+`generation.py` (compiled greedy/sampling decode).
+"""
+from __future__ import annotations
+
+from .fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedMultiHeadAttention,
+    FusedFeedForward,
+    FusedTransformerEncoderLayer,
+    FusedMultiTransformer,
+    FusedMultiTransformerWeightOnly,
+    FusedMultiTransformerINT8,
+    FusedMultiTransformerMoe,
+    FusedMultiTransformerMoeWeightOnly,
+    FusedMultiTransformerMoeINT8,
+    FusedMoELayer,
+)
+from .generation import GenerationMixin, SamplingConfig  # noqa: F401

@@ -1,0 +1,121 @@
+"""Incubate optimizers — parity: `python/paddle/incubate/optimizer/`
+(LookAhead, ModelAverage; DistributedFusedLamb's fused capability is the
+default fused step in paddle_tpu.optimizer)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """lookahead.py parity: wraps an inner optimizer; every k steps the
+    slow weights move alpha toward the fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._la_step = 0
+        # delegate bookkeeping to the inner optimizer
+        self._parameter_list = inner_optimizer._parameter_list
+        # slow weights anchor to the params at CREATION (reference
+        # lookahead.py), not lazily at the first sync
+        self._slow = {id(p): p.numpy().copy()
+                      for p in (self._parameter_list or [])}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._la_step += 1
+        if self._la_step % self.k:
+            return
+        for p in self._parameter_list or []:
+            slow = self._slow[id(p)]
+            slow += self.alpha * (p.numpy() - slow)
+            p.set_value(slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "la_step": self._la_step}
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd.get("inner", {}))
+        self._la_step = sd.get("la_step", 0)
+
+
+class ModelAverage(Optimizer):
+    """model_average.py parity: maintains a running average of params;
+    apply()/restore() swap the averaged weights in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(parameters=parameters)
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        # windowed accumulation (reference scheme): a current partial sum
+        # plus the previous completed window; when the partial exceeds
+        # max_average_window it rolls over, bounding the averaging window
+        # to [max_window, 2*max_window) recent steps.
+        self._sum_cur = {}
+        self._num_cur = {}
+        self._sum_prev = {}
+        self._num_prev = {}
+        self._backup = {}
+
+    def step(self):
+        for p in self._parameter_list or []:
+            key = id(p)
+            if key not in self._sum_cur:
+                self._sum_cur[key] = np.zeros(p.shape, np.float64)
+                self._num_cur[key] = 0
+                self._sum_prev[key] = np.zeros(p.shape, np.float64)
+                self._num_prev[key] = 0
+            self._sum_cur[key] += p.numpy().astype(np.float64)
+            self._num_cur[key] += 1
+            if self._num_cur[key] >= self.max_average_window:
+                self._sum_prev[key] = self._sum_cur[key]
+                self._num_prev[key] = self._num_cur[key]
+                self._sum_cur[key] = np.zeros(p.shape, np.float64)
+                self._num_cur[key] = 0
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            for p in self._parameter_list or []:
+                key = id(p)
+                total = (self._num_cur.get(key, 0)
+                         + self._num_prev.get(key, 0))
+                if total:
+                    self._backup[key] = p.numpy().copy()
+                    avg = (self._sum_cur[key] + self._sum_prev[key]) \
+                        / total
+                    p.set_value(avg.astype(np.float32))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameter_list or []:
+            key = id(p)
+            if key in self._backup:
+                p.set_value(self._backup.pop(key))

@@ -1,0 +1,103 @@
+"""paddle.inference — the serving API surface.
+
+Parity: `paddle/fluid/inference/api/` (`AnalysisConfig`,
+`AnalysisPredictor`, `create_predictor`, zero-copy tensors). TPU-native:
+the "optimized program" is the AOT StableHLO module exported by
+`paddle_tpu.jit.save(..., input_spec=...)`; XLA plays the role of the IR
+pass pipeline + TensorRT. The predictor wraps `TranslatedLayer` with the
+reference's handle-based API so serving code ports.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import jit as _jit
+from .core.tensor import Tensor
+
+
+class Config:
+    """AnalysisConfig parity (the knobs that are meaningful on TPU)."""
+
+    def __init__(self, model_prefix=None, params_file=None):
+        self.model_prefix = model_prefix
+        self._use_tpu = True
+        self._threads = 1
+        self._ir_optim = True
+
+    # gpu/trt/mkldnn switches accepted as no-ops: XLA owns optimization
+    def enable_use_gpu(self, memory_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._threads = n
+
+    def enable_memory_optim(self):
+        pass
+
+
+class _IOTensor:
+    """zero-copy paddle_infer.Tensor handle parity."""
+
+    def __init__(self, name, store, idx):
+        self.name = name
+        self._store = store
+        self._idx = idx
+
+    def copy_from_cpu(self, arr):
+        self._store[self._idx] = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return np.asarray(self._store[self._idx])
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        if config.model_prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        self._layer = _jit.load(config.model_prefix)
+        n_inputs = len(self._layer.meta.get("input_spec") or [1])
+        self._inputs = [None] * n_inputs
+        self._outputs = []
+
+    def get_input_names(self):
+        return [f"input_{i}" for i in range(len(self._inputs))]
+
+    def get_input_handle(self, name):
+        idx = int(name.rsplit("_", 1)[-1]) if name.startswith("input_") \
+            else 0
+        return _IOTensor(name, self._inputs, idx)
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            self._inputs = [np.asarray(a) for a in inputs]
+        outs = self._layer(*self._inputs)
+        self._outputs = [o.numpy() if isinstance(o, Tensor) else
+                         np.asarray(o) for o in outs]
+        return self._outputs
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs) or 1)]
+
+    def get_output_handle(self, name):
+        idx = int(name.rsplit("_", 1)[-1]) if name.startswith("output_") \
+            else 0
+        return _IOTensor(name, self._outputs, idx)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

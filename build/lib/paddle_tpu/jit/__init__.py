@@ -1,0 +1,247 @@
+"""paddle_tpu.jit — dygraph-to-compiled bridge.
+
+Parity: `python/paddle/fluid/dygraph/jit.py` (`to_static`, `jit.save/load`)
+and the dy2static stack (`dygraph_to_static/program_translator.py:1001`).
+TPU-native: `to_static` wraps forward in a functional `jax.jit` (XLA is the
+Program+Executor); `save` exports state_dict + StableHLO text when possible.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..core import random as rng_mod
+from .functional import bind_arrays, split_state
+from .trainer import CompiledTrainStep, CompiledEvalStep  # noqa: F401
+from . import dy2static  # noqa: F401
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool):
+    """ProgramTranslator().enable() parity: globally toggle the dy2static
+    AST rewrite inside to_static."""
+    _to_static_enabled[0] = bool(flag)
+
+
+class StaticFunction:
+    """Compiled callable wrapping a Layer's forward or a plain function."""
+
+    def __init__(self, function, input_spec=None):
+        from ..nn.layer_base import Layer
+        self._layer = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        else:
+            self._fn = function
+            self._layer = getattr(function, "__self__", None)
+        self.input_spec = input_spec
+        self._compiled = None
+
+    def _build(self):
+        layer = self._layer
+        fn = self._fn
+        if _to_static_enabled[0]:
+            # AST-rewrite data-dependent python control flow into
+            # lax.cond/while_loop calls (dy2static transformer parity);
+            # returns fn unchanged when there is nothing to rewrite or
+            # the source is unavailable
+            fn = dy2static.transform_function(fn)
+        if layer is not None:
+            p_names, p_tensors, b_names, b_tensors = split_state(layer)
+
+            def run(params, buffers, key, *arrays):
+                wrapped = [Tensor(a) for a in arrays]
+                with bind_arrays(p_tensors, params), \
+                        bind_arrays(b_tensors, buffers), \
+                        rng_mod.functional_rng(key), autograd.no_grad():
+                    out = fn(*wrapped)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in outs], not isinstance(out, (list, tuple))
+            jit_run = jax.jit(run, static_argnums=())
+            self._p_tensors, self._b_tensors = p_tensors, b_tensors
+
+            def call(*args):
+                arrays = [a._data if isinstance(a, Tensor)
+                          else np.asarray(a) for a in args]
+                outs, single = jit_run(
+                    [p._data for p in p_tensors],
+                    [b._data for b in b_tensors],
+                    rng_mod.next_key(), *arrays)
+                outs = [Tensor(o) for o in outs]
+                return outs[0] if single else outs
+            return call
+
+        def run(key, *arrays):
+            wrapped = [Tensor(a) for a in arrays]
+            with rng_mod.functional_rng(key), autograd.no_grad():
+                out = fn(*wrapped)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._data if isinstance(o, Tensor) else o
+                    for o in outs], not isinstance(out, (list, tuple))
+        jit_run = jax.jit(run)
+
+        def call(*args):
+            arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)
+                      for a in args]
+            outs, single = jit_run(rng_mod.next_key(), *arrays)
+            outs = [Tensor(o) for o in outs]
+            return outs[0] if single else outs
+        return call
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._compiled = self._build()
+        return self._compiled(*args)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None):
+    """@paddle.jit.to_static parity."""
+    def decorate(fn):
+        from ..nn.layer_base import Layer
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn, input_spec)
+            orig_forward = fn.forward
+            fn.forward = sf  # layer(x) now runs compiled
+            fn._orig_forward = orig_forward
+            return fn
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: state_dict + (best-effort) StableHLO export.
+
+    Format parity target: the reference saves program+params
+    (`fluid/dygraph/jit.py`, `paddle/fluid/jit/serializer.cc`); we save
+    pickled state_dict + an exported StableHLO module when input_spec is
+    given (the AOT serving artifact — AnalysisPredictor capability)."""
+    from ..nn.layer_base import Layer
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    net = layer
+    state = {k: np.asarray(v.numpy())
+             for k, v in net.state_dict().items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    meta = {"class": type(net).__name__, "input_spec": None}
+    if input_spec:
+        try:
+            import jax.export as jexport
+            from ..core import dtype as dtype_mod
+            p_names, p_tensors, b_names, b_tensors = split_state(net)
+
+            n_p = len(p_tensors)
+
+            def fwd(state_list, *xs):
+                wrapped = [Tensor(a) for a in xs]
+                with bind_arrays(p_tensors, state_list[:n_p]), \
+                        bind_arrays(b_tensors, state_list[n_p:]), \
+                        autograd.no_grad():
+                    out = net(*wrapped)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return [o._data for o in outs]
+            import jax.numpy as jnp
+            sample = [
+                jnp.zeros([d if d and d > 0 else 1 for d in spec.shape],
+                          dtype_mod.convert_dtype(spec.dtype))
+                for spec in input_spec]
+            exported = jexport.export(jax.jit(fwd))(
+                [p._data for p in p_tensors]
+                + [b._data for b in b_tensors], *sample)
+            meta["state_order"] = p_names + b_names
+            with open(path + ".stablehlo", "wb") as f:
+                f.write(exported.serialize())
+            meta["input_spec"] = [(list(s.shape), str(s.dtype))
+                                  for s in input_spec]
+        except Exception as e:
+            meta["export_error"] = str(e)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """jit.load result: runs the exported StableHLO module."""
+
+    def __init__(self, path):
+        with open(path + ".pdparams", "rb") as f:
+            self.state = pickle.load(f)
+        with open(path + ".pdmodel", "rb") as f:
+            self.meta = pickle.load(f)
+        self._exported = None
+        hlo = path + ".stablehlo"
+        if os.path.exists(hlo):
+            import jax.export as jexport
+            with open(hlo, "rb") as f:
+                self._exported = jexport.deserialize(f.read())
+
+    def __call__(self, *args):
+        if self._exported is None:
+            raise RuntimeError("no compiled module was exported at save "
+                               "time; re-save with input_spec")
+        arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        state_list = [self.state[k] for k in self.meta["state_order"]]
+        out = self._exported.call(state_list, *arrays)
+        return [Tensor(o) for o in out]
+
+    def state_dict(self):
+        return self.state
+
+
+def load(path, **configs):
+    return TranslatedLayer(path)
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+# ------------------------------------------------------- control flow
+# Parity: the dy2static control-flow transformers
+# (`fluid/dygraph/dygraph_to_static/ast_transformer.py` ifelse/loop) and
+# static `paddle.static.nn.cond/while_loop` ops. Under tracing these map
+# straight to lax.cond / lax.while_loop; eagerly they just execute.
+
+
+def cond(pred, true_fn, false_fn, *operands):
+    import jax
+    from ..core.tensor import Tensor
+    p = pred._data if isinstance(pred, Tensor) else pred
+
+    def _wrap(fn):
+        def inner(ops_):
+            out = fn(*[Tensor(o) for o in ops_]) if ops_ else fn()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._data if isinstance(o, Tensor) else o for o in outs]
+        return inner
+    ops_ = [o._data if isinstance(o, Tensor) else o for o in operands]
+    res = jax.lax.cond(p, _wrap(true_fn), _wrap(false_fn), ops_)
+    res = [Tensor(r) for r in res]
+    return res[0] if len(res) == 1 else res
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    import jax
+    from ..core.tensor import Tensor
+    init = [v._data if isinstance(v, Tensor) else v for v in loop_vars]
+
+    def c(vs):
+        out = cond_fn(*[Tensor(v) for v in vs])
+        return out._data if isinstance(out, Tensor) else out
+
+    def b(vs):
+        out = body_fn(*[Tensor(v) for v in vs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o._data if isinstance(o, Tensor) else o for o in outs]
+    res = jax.lax.while_loop(c, b, init)
+    return [Tensor(r) for r in res]

@@ -1,0 +1,835 @@
+"""dy2static: AST transforms for data-dependent Python control flow.
+
+Parity: the reference's dygraph_to_static transformer stack
+(`fluid/dygraph/dygraph_to_static/ast_transformer.py` — IfElse / Loop /
+break-continue transformers feeding `program_translator.py:1001`).
+TPU-native re-design: instead of lowering to static-graph
+`cond`/`while_loop` *ops*, the rewritten source calls the runtime helpers
+below, which dispatch per call —
+
+  - concrete predicate (eager, or a trace-time constant): plain Python
+    branch/loop, zero overhead, side effects allowed;
+  - traced predicate (inside jax.jit): `lax.cond` / `lax.while_loop`, so
+    a model whose `if`/`while` depends on tensor VALUES still compiles
+    into one XLA program instead of falling back to eager.
+
+Supported subset (transformed): `if`/`elif`/`else` whose branches only
+assign; `while`; `for i in range(...)`; `break`/`continue` anywhere in a
+loop body, possibly nested in `if`s (flag rewriting: the loop condition
+folds in `not break_flag`, statements after a potential break/continue
+are guarded — break_continue_transformer.py parity); `return` inside
+branches (single-exit rewriting by else-hoisting into a result var —
+return_transformer.py parity). Still python (eager fallback): `return`
+inside loops, partially-returning nested branches, try/with, non-range
+`for`.
+
+Like `lax.cond` (and the reference's trace-both-branches behavior),
+Python side effects in both branches of a TRACED `if` execute at trace
+time.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class _Undef:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dy2static UNDEF>"
+
+
+UNDEF = _Undef()
+
+
+def _val(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _rewrap(arr):
+    return Tensor(arr)
+
+
+class _Poison:
+    """Stand-in for a variable assigned in only ONE branch of a traced
+    `if` (python would UnboundLocalError on the other path; a traced
+    cond can't be path-dependent). Any actual USE raises with the
+    variable's name; carrying it dead is free — so branch-local
+    temporaries no longer block tracing."""
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise ValueError(
+            f"dy2static: variable '{self.name}' was assigned in only one "
+            "branch of a traced `if` and then read afterwards; "
+            "initialise it before the `if` so both paths define it")
+
+    def __repr__(self):
+        return f"<dy2static poisoned '{self.name}'>"
+
+    __getattr__ = __call__ = __getitem__ = __bool__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __neg__ = __iter__ = __array__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = __eq__ = __ne__ = _raise
+    __hash__ = object.__hash__
+
+
+def cond(pred, true_fn, false_fn, names=None, cur_vals=None, both=None):
+    """Runtime for a transformed `if`: fns take no args (outer values are
+    captured as default args) and return the tuple of assigned names.
+
+    Traced predicate: a slot is undefined on some path iff its CURRENT
+    value is UNDEF/poisoned and the transformer says it is not assigned
+    in both branches (`both`, static). lax.cond carries only the slots
+    every path defines; the rest come back poisoned (error on use, not
+    on existence) — so dead branch-local temporaries never block
+    tracing."""
+    p = _val(pred)
+    if not _is_tracer(p):
+        return true_fn() if bool(p) else false_fn()
+
+    if cur_vals is not None and both is not None:
+        n = len(cur_vals)
+        undef = {i for i in range(n)
+                 if isinstance(cur_vals[i], (_Undef, _Poison))
+                 and not both[i]}
+    else:  # legacy probe path (direct cond() callers)
+        t_probe = true_fn()
+        f_probe = false_fn()
+        n = len(t_probe)
+        undef = {i for i in range(n)
+                 if isinstance(_val(t_probe[i]), _Undef)
+                 or isinstance(_val(f_probe[i]), _Undef)}
+    live = [i for i in range(n) if i not in undef]
+
+    def wrap(fn):
+        def inner(_):
+            out = fn()
+            return tuple(_val(out[i]) for i in live)
+        return inner
+
+    res = jax.lax.cond(p, wrap(true_fn), wrap(false_fn), None)
+    merged, j = [], 0
+    for i in range(n):
+        if i in undef:
+            merged.append(_Poison(names[i] if names else f"<slot {i}>"))
+        else:
+            merged.append(_rewrap(res[j]))
+            j += 1
+    return tuple(merged)
+
+
+def while_loop(cond_fn, body_fn, init_vals):
+    """Runtime for a transformed `while`/`for`: cond_fn/body_fn take the
+    loop vars positionally; body_fn returns the updated tuple."""
+    for v in init_vals:
+        if isinstance(v, _Undef):
+            raise ValueError(
+                "dy2static: loop variables must be initialised before a "
+                "transformed loop")
+        if isinstance(v, _Poison):
+            v._raise()
+    c0 = _val(cond_fn(*init_vals))
+    traced = _is_tracer(c0) or any(_is_tracer(_val(v)) for v in init_vals)
+    if not traced:
+        vals = tuple(init_vals)
+        while bool(_val(cond_fn(*vals))):
+            vals = tuple(body_fn(*vals))
+        return vals
+
+    init = tuple(jnp.asarray(_val(v)) for v in init_vals)
+
+    def c(arrs):
+        return _val(cond_fn(*[_rewrap(a) for a in arrs]))
+
+    def b(arrs):
+        out = body_fn(*[_rewrap(a) for a in arrs])
+        return tuple(jnp.asarray(_val(o)) for o in out)
+
+    res = jax.lax.while_loop(c, b, init)
+    return tuple(_rewrap(r) for r in res)
+
+
+def trip_count(start, stop, step):
+    """Static trip count of range(start, stop, step), or None when any
+    bound is traced (dynamic)."""
+    s, e, st = _val(start), _val(stop), _val(step)
+    if any(_is_tracer(v) for v in (s, e, st)):
+        return None
+    s, e, st = int(s), int(e), int(st)
+    if st == 0:
+        return 0
+    if st > 0:
+        return max(0, (e - s + st - 1) // st)
+    return max(0, (s - e + (-st) - 1) // (-st))
+
+
+def bounded_while(cond_fn, body_fn, init_vals, max_trips):
+    """while_loop with a STATIC trip bound: lowers to a masked lax.scan
+    (each step keeps the old carry once the condition goes false), which
+    — unlike lax.while_loop — is reverse-mode differentiable, so
+    data-dependent `for`/`break` loops work in training steps."""
+    if max_trips is None:
+        return while_loop(cond_fn, body_fn, init_vals)
+    for v in init_vals:
+        if isinstance(v, _Undef):
+            raise ValueError(
+                "dy2static: loop variables must be initialised before a "
+                "transformed loop")
+        if isinstance(v, _Poison):
+            v._raise()
+    c0 = _val(cond_fn(*init_vals))
+    traced = _is_tracer(c0) or any(_is_tracer(_val(v)) for v in init_vals)
+    if not traced:
+        vals = tuple(init_vals)
+        while bool(_val(cond_fn(*vals))):
+            vals = tuple(body_fn(*vals))
+        return vals
+    init = tuple(jnp.asarray(_val(v)) for v in init_vals)
+    # probe one body application to learn the steady-state carry dtypes
+    # (e.g. `s = 0` then `s = s + x.sum()` promotes int->float); the
+    # probe ops are pure and DCE'd by XLA
+    probe = body_fn(*[_rewrap(a) for a in init])
+    init = tuple(
+        a.astype(jnp.result_type(a, jnp.asarray(_val(p)).dtype))
+        for a, p in zip(init, probe))
+
+    def step(carry, _):
+        active = _val(cond_fn(*[_rewrap(a) for a in carry]))
+        out = body_fn(*[_rewrap(a) for a in carry])
+        new = []
+        for o, a, in zip(out, carry):
+            oa = jnp.asarray(_val(o))
+            if oa.dtype != a.dtype or oa.shape != a.shape:
+                # loud, like lax.while_loop's carry check — a silent
+                # astype would truncate (float sum into int carry)
+                raise TypeError(
+                    "dy2static: loop variable changed "
+                    f"dtype/shape across iterations ({a.dtype}"
+                    f"{a.shape} -> {oa.dtype}{oa.shape}); keep loop "
+                    "variables stable (e.g. initialise accumulators "
+                    "with the right dtype)")
+            new.append(jnp.where(active, oa, a))
+        return tuple(new), None
+
+    res, _ = jax.lax.scan(step, init, None, length=int(max_trips))
+    return tuple(_rewrap(r) for r in res)
+
+
+def range_cond(i, stop, step):
+    """`for i in range(...)` continuation test, sign-aware on step."""
+    iv, sv, st = _val(i), _val(stop), _val(step)
+    out = jnp.where(st > 0, iv < sv, iv > sv)
+    return _rewrap(out) if (_is_tracer(out) or isinstance(out, Tensor)) \
+        else bool(out)
+
+
+def logical_and(a, b):
+    av, bv = _val(a), _val(b)
+    if not (_is_tracer(av) or _is_tracer(bv)):
+        return bool(av) and bool(bv)
+    return _rewrap(jnp.logical_and(av, bv))
+
+
+def logical_not(a):
+    av = _val(a)
+    if not _is_tracer(av):
+        return not bool(av)
+    return _rewrap(jnp.logical_not(av))
+
+
+def logical_or(a, b):
+    av, bv = _val(a), _val(b)
+    if not (_is_tracer(av) or _is_tracer(bv)):
+        return bool(av) or bool(bv)
+    return _rewrap(jnp.logical_or(av, bv))
+
+
+def range3(*args):
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+# ------------------------------------------------------------ transforms
+
+_SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
+                 ast.If, ast.For, ast.While, ast.Pass)
+
+
+def _mark_generated(stmts):
+    for s in stmts:
+        s._dy2s_generated = True
+    return stmts
+
+
+class _RenameVar(ast.NodeTransformer):
+    def __init__(self, old, new):
+        self.old, self.new = old, new
+
+    def visit_Name(self, node):
+        if node.id == self.old and isinstance(node.ctx, ast.Load):
+            return ast.copy_location(_name(self.new), node)
+        return node
+
+
+def _assigned_names(stmts):
+    """Names (re)bound anywhere in these statements, not descending into
+    nested function/class definitions."""
+    names = []
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id not in names:
+                names.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for s in stmts:
+        visit(s)
+    return names
+
+
+def _transformable(stmts):
+    # statements this transformer itself generated (UNDEF preambles,
+    # branch helper defs, _jst calls) are always acceptable — without
+    # this, an already-rewritten inner `elif` blocks the outer `if`
+    return all(isinstance(s, _SIMPLE_STMTS)
+               or getattr(s, "_dy2s_generated", False) for s in stmts)
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name("_jst"), attr=fn_name,
+                         ctx=ast.Load())
+
+
+def _undef_preamble(var):
+    """try: v \n except NameError/UnboundLocalError: v = _jst.UNDEF"""
+    return ast.Try(
+        body=[ast.Expr(value=_name(var))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError"),
+                                 _name("UnboundLocalError")],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(targets=[_name(var, ast.Store())],
+                             value=_jst_attr("UNDEF"))])],
+        orelse=[], finalbody=[])
+
+
+def _ret_tuple(names):
+    return ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load()))
+
+
+def _assign_tuple(names, value):
+    return ast.Assign(
+        targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                           ctx=ast.Store())],
+        value=value)
+
+
+def _contains_return_deep(stmts):
+    """True if a `return` appears ANYWHERE under these statements,
+    descending through loops (unlike _contains_ctrl) but not into nested
+    function/class definitions."""
+    stop = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            ast.ClassDef)
+
+    def visit(node):
+        if isinstance(node, stop):
+            return False
+        if isinstance(node, ast.Return):
+            return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, stop):
+                continue
+            if visit(child):
+                return True
+        return False
+
+    return any(visit(s) for s in stmts)
+
+
+def _contains_ctrl(stmts, kinds):
+    """True if any node of `kinds` appears at THIS loop/function level
+    (not inside nested loops or function defs, whose break/continue
+    belong to them)."""
+    stop = (ast.For, ast.While, ast.AsyncFor, ast.FunctionDef,
+            ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+    def visit(node, top=False):
+        if not top and isinstance(node, stop):
+            return False
+        if isinstance(node, kinds):
+            return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, stop):
+                continue
+            if visit(child):
+                return True
+        return False
+
+    # the top-level statements themselves are searched even when they
+    # are loops (callers pass e.g. [the_loop_node] deliberately)
+    return any(visit(s, top=True) for s in stmts)
+
+
+def _bool_const(v):
+    return ast.Constant(value=v)
+
+
+def _rewrite_break_continue(body, uid):
+    """Flag rewriting for mid-body break/continue (parity:
+    dygraph_to_static/break_continue_transformer.py — re-designed for the
+    lax lowering). Returns (pre_stmts, new_body, brk_name or None).
+
+    `break` -> `__dy2s_brk = True`; `continue` -> `__dy2s_cnt = True`;
+    every statement after a possible flag set is guarded with
+    `if not (brk or cnt):` (a plain if, which the control-flow
+    transformer then lowers to lax.cond when traced). The continue flag
+    resets each iteration; the break flag persists in the loop carry and
+    the caller folds `and not brk` into the loop condition."""
+    if not _contains_ctrl(body, (ast.Break, ast.Continue)):
+        return [], body, None
+    brk = f"__dy2s_brk_{uid}"
+    cnt = f"__dy2s_cnt_{uid}"
+
+    def guard_test():
+        return ast.Call(
+            func=_jst_attr("logical_not"),
+            args=[ast.Call(func=_jst_attr("logical_or"),
+                           args=[_name(brk), _name(cnt)], keywords=[])],
+            keywords=[])
+
+    def set_flag(name):
+        return ast.Assign(targets=[_name(name, ast.Store())],
+                          value=_bool_const(True))
+
+    def rewrite_stmt(st):
+        """-> (new_stmt, may_set_flag)"""
+        if isinstance(st, ast.Break):
+            return set_flag(brk), True
+        if isinstance(st, ast.Continue):
+            return set_flag(cnt), True
+        if isinstance(st, ast.If) and _contains_ctrl(
+                [st], (ast.Break, ast.Continue)):
+            b2, s1 = rewrite_seq(st.body)
+            o2, s2 = rewrite_seq(st.orelse)
+            return ast.If(test=st.test, body=b2,
+                          orelse=o2), (s1 or s2)
+        return st, False
+
+    def rewrite_seq(stmts):
+        out, sets_any, guarded = [], False, False
+        for st in stmts:
+            st2, sets = rewrite_stmt(st)
+            if guarded:
+                out.append(ast.If(test=guard_test(), body=[st2],
+                                  orelse=[]))
+            else:
+                out.append(st2)
+            if sets:
+                sets_any = True
+                guarded = True
+        return out, sets_any
+
+    new_body, _ = rewrite_seq(body)
+    # continue resets every iteration; break persists across iterations
+    new_body = [ast.Assign(targets=[_name(cnt, ast.Store())],
+                           value=_bool_const(False))] + new_body
+    # both flags pre-initialised: they ride the loop carry
+    pre = [ast.Assign(targets=[_name(brk, ast.Store())],
+                      value=_bool_const(False)),
+           ast.Assign(targets=[_name(cnt, ast.Store())],
+                      value=_bool_const(False))]
+    return pre, new_body, brk
+
+
+class _UnsupportedReturn(Exception):
+    pass
+
+
+def _rewrite_returns(body, retv):
+    """Single-exit rewriting for return-inside-branch (parity:
+    dygraph_to_static/return_transformer.py — re-designed as else-hoisting
+    instead of guard flags, which lowers cleanly to lax.cond).
+
+    Returns (new_stmts, always_returns). `return X` becomes
+    `retv = X`; when an if-branch always returns, the statements after
+    the `if` are hoisted into its else side, so control flow stays
+    structured and every path ends assigning `retv`. Returns inside
+    loops (or partially-returning branches) raise _UnsupportedReturn —
+    the caller leaves the function untransformed (eager fallback)."""
+
+    import copy
+
+    # continuation duplication doubles the spliced tail per returning
+    # `if`; cap total emitted statements so a long guard-clause chain
+    # falls back to eager instead of exploding (O(2^k))
+    budget = [2000]
+
+    def spend(stmts):
+        budget[0] -= len(stmts)
+        if budget[0] < 0:
+            raise _UnsupportedReturn("return-rewrite size budget")
+
+    def block(stmts):
+        if not stmts:
+            return [], False
+        st, rest = stmts[0], list(stmts[1:])
+        if isinstance(st, ast.Return):
+            return [ast.Assign(
+                targets=[_name(retv, ast.Store())],
+                value=st.value if st.value is not None
+                else ast.Constant(value=None))], True  # rest unreachable
+        if isinstance(st, (ast.For, ast.While)) and _contains_ctrl(
+                [st], (ast.Return,)):
+            raise _UnsupportedReturn("return inside loop")
+        if isinstance(st, ast.If) and _contains_ctrl(
+                [st], (ast.Return,)):
+            # continuation duplication: whatever follows the `if` runs
+            # on any branch path that falls through, so splice `rest`
+            # into BOTH branch continuations (deep-copied on one side —
+            # shared AST subtrees confuse location fixing)
+            spend(rest)  # each duplicating `if` spends its tail once
+            tb, ta = block(list(st.body) + copy.deepcopy(rest))
+            fb, fa = block(list(st.orelse) + rest)
+            return [ast.If(test=st.test, body=tb or [ast.Pass()],
+                           orelse=fb or [ast.Pass()])], ta and fa
+        out, always = block(rest)
+        return [st] + out, always
+
+    return block(body)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _uid(self):
+        self._counter += 1
+        return self._counter
+
+    # -- don't descend into nested defs/lambdas: they run as plain python
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if not (_transformable(node.body)
+                and _transformable(node.orelse or [ast.Pass()])):
+            return node
+        if _contains_return_deep(node.body + node.orelse):
+            # a `return` anywhere under this if (e.g. inside a nested
+            # python-fallback loop) must keep python early-exit
+            # semantics — lowering to cond would swallow it into the
+            # branch tuple
+            return node
+        body_names = _assigned_names(node.body)
+        else_names = _assigned_names(node.orelse)
+        outs = _assigned_names(node.body + node.orelse)
+        if not outs:
+            return node
+        both_flags = tuple(n in body_names and n in else_names
+                           for n in outs)
+        uid = self._uid()
+        tname, fname = f"__dy2s_true_{uid}", f"__dy2s_false_{uid}"
+        # outer values captured via default args so aug-assigns/reads of
+        # the output vars resolve inside the generated functions
+        arg_defaults = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in outs],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[_name(n) for n in outs])
+        tdef = ast.FunctionDef(
+            name=tname, args=arg_defaults,
+            body=list(node.body) + [_ret_tuple(outs)],
+            decorator_list=[], returns=None)
+        fdef = ast.FunctionDef(
+            name=fname, args=arg_defaults,
+            body=list(node.orelse or [ast.Pass()]) + [_ret_tuple(outs)],
+            decorator_list=[], returns=None)
+        call = ast.Call(
+            func=_jst_attr("cond"),
+            args=[node.test, _name(tname), _name(fname),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in outs],
+                            ctx=ast.Load()),
+                  # current values + static both-branch-assigned flags:
+                  # lets cond() find undefined slots without probing
+                  ast.Tuple(elts=[_name(n) for n in outs],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=b)
+                                  for b in both_flags],
+                            ctx=ast.Load())],
+            keywords=[])
+        stmts = [_undef_preamble(n) for n in outs]
+        stmts += [tdef, fdef, _assign_tuple(outs, call)]
+        return _mark_generated(stmts)
+
+    def _loop_helpers(self, loop_vars, body_stmts, test_expr, uid,
+                      trips_expr=None):
+        cname, bname = f"__dy2s_cond_{uid}", f"__dy2s_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cdef = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=test_expr)],
+            decorator_list=[], returns=None)
+        bdef = ast.FunctionDef(
+            name=bname, args=args,
+            body=body_stmts + [_ret_tuple(loop_vars)],
+            decorator_list=[], returns=None)
+        vars_tuple = ast.Tuple(elts=[_name(n) for n in loop_vars],
+                               ctx=ast.Load())
+        if trips_expr is not None:
+            call = ast.Call(
+                func=_jst_attr("bounded_while"),
+                args=[_name(cname), _name(bname), vars_tuple,
+                      trips_expr],
+                keywords=[])
+        else:
+            call = ast.Call(
+                func=_jst_attr("while_loop"),
+                args=[_name(cname), _name(bname), vars_tuple],
+                keywords=[])
+        return [cdef, bdef, _assign_tuple(loop_vars, call)]
+
+    @staticmethod
+    def _fold_leading_break(body, test):
+        """`while c: if b: break; rest` == `while c and not b: rest`."""
+        if body and isinstance(body[0], ast.If) and not body[0].orelse \
+                and len(body[0].body) == 1 \
+                and isinstance(body[0].body[0], ast.Break):
+            # python `and`/`not` would force bool() on tracers — use the
+            # tracer-aware logical helpers
+            folded = ast.Call(
+                func=_jst_attr("logical_and"),
+                args=[test,
+                      ast.Call(func=_jst_attr("logical_not"),
+                               args=[body[0].test], keywords=[])],
+                keywords=[])
+            return body[1:], folded
+        return body, test
+
+    def _augment_break(self, test, brk):
+        return ast.Call(
+            func=_jst_attr("logical_and"),
+            args=[test, ast.Call(func=_jst_attr("logical_not"),
+                                 args=[_name(brk)], keywords=[])],
+            keywords=[])
+
+    def _bail_loop(self, orig):
+        """Fallback for a loop we decided not to transform: the ORIGINAL
+        node (no flag rewriting / test augmentation baked in), with its
+        nested constructs still visited."""
+        self.generic_visit(orig)
+        return orig
+
+    def visit_While(self, node):
+        if node.orelse:
+            self.generic_visit(node)
+            return node
+        if _contains_ctrl(node.body, (ast.Return,)):
+            # a return that escapes the loop can't ride the lax carry —
+            # leave the whole loop to python (eager fallback)
+            self.generic_visit(node)
+            return node
+        import copy
+        orig = copy.deepcopy(node)
+        uid = self._uid()
+        body0, test = self._fold_leading_break(node.body, node.test)
+        pre, body0, brk = _rewrite_break_continue(body0, uid)
+        if brk is not None:
+            test = self._augment_break(test, brk)
+        node.body = body0
+        node.test = test
+        self.generic_visit(node)
+        body = node.body
+        if not _transformable(body):
+            return self._bail_loop(orig)
+        loop_vars = _assigned_names(body)
+        if not loop_vars:
+            return self._bail_loop(orig)
+        stmts = list(pre)
+        stmts += [_undef_preamble(n) for n in loop_vars
+                  if not any(isinstance(p, ast.Assign)
+                             and p.targets[0].id == n for p in pre)]
+        stmts += self._loop_helpers(loop_vars, body, node.test, uid)
+        return _mark_generated(stmts)
+
+    def visit_For(self, node):
+        if node.orelse or not isinstance(node.target, ast.Name):
+            self.generic_visit(node)
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            self.generic_visit(node)
+            return node
+        if _contains_ctrl(node.body, (ast.Return,)):
+            self.generic_visit(node)
+            return node
+        import copy
+        orig = copy.deepcopy(node)
+        uid = self._uid()
+        i = node.target.id
+        # internal counter `ctr` drives the loop; the USER's variable is
+        # assigned from it at body start, so after the loop it holds the
+        # last ITERATED value (python for semantics), not one past it
+        ctr = f"__dy2s_i_{uid}"
+        stop_v, step_v = f"__dy2s_stop_{uid}", f"__dy2s_step_{uid}"
+        start_assign = _assign_tuple(
+            [ctr, stop_v, step_v],
+            ast.Call(func=_jst_attr("range3"), args=list(it.args),
+                     keywords=[]))
+        test = ast.Call(func=_jst_attr("range_cond"),
+                        args=[_name(ctr), _name(stop_v), _name(step_v)],
+                        keywords=[])
+        body, test = self._fold_leading_break(node.body, test)
+        # the folded break test runs in the loop CONDITION, where the
+        # user's variable still holds the previous iteration's value —
+        # the internal counter is the current one, so reads of the loop
+        # var inside the folded test must use the counter
+        test = _RenameVar(i, ctr).visit(test)
+        pre, body, brk = _rewrite_break_continue(body, uid)
+        if brk is not None:
+            test = self._augment_break(test, brk)
+        node.body = body
+        self.generic_visit(node)
+        body = node.body
+        if not _transformable(body):
+            return self._bail_loop(orig)
+        set_user = ast.Assign(targets=[_name(i, ast.Store())],
+                              value=_name(ctr))
+        # the counter increment sits after the (possibly guarded) body:
+        # `continue` still advances it, and the user's `i` (assigned at
+        # body start) keeps the breaking iteration's value on `break`
+        incr = ast.AugAssign(target=_name(ctr, ast.Store()),
+                             op=ast.Add(), value=_name(step_v))
+        body = [set_user] + body + [incr]
+        loop_vars = [ctr, i] + [n for n in _assigned_names(body)
+                                if n not in (ctr, i)]
+        pre_names = {p.targets[0].id for p in pre
+                     if isinstance(p, ast.Assign)}
+        stmts = [start_assign,
+                 # seed the user's var so the traced carry is defined even
+                 # for range(0) (python would NameError on a later read;
+                 # we leave it at start — documented approximation)
+                 ast.Assign(targets=[_name(i, ast.Store())],
+                            value=_name(ctr))] + list(pre)
+        stmts += [_undef_preamble(n) for n in loop_vars
+                  if n not in (ctr, i) and n not in pre_names]
+        # static-bound range loops lower to a masked lax.scan
+        # (differentiable); dynamic bounds fall back to lax.while_loop
+        trips = ast.Call(func=_jst_attr("trip_count"),
+                         args=[_name(ctr), _name(stop_v), _name(step_v)],
+                         keywords=[])
+        stmts += self._loop_helpers(loop_vars, body, test, uid,
+                                    trips_expr=trips)
+        return _mark_generated(stmts)
+
+
+_cache = {}
+
+
+def transform_function(fn):
+    """Rewrite data-dependent control flow in `fn` (a function or bound
+    method) into _jst.cond/while_loop calls. Returns the original on any
+    failure (source unavailable, unsupported constructs, …)."""
+    if isinstance(fn, types.MethodType):
+        new = transform_function(fn.__func__)
+        return types.MethodType(new, fn.__self__)
+    if fn in _cache:
+        return _cache[fn]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            raise ValueError("not a function definition")
+        fdef.decorator_list = []
+        # pass 1: single-exit return rewriting (return-inside-branch)
+        did_return_rewrite = False
+        body0 = fdef.body
+        top_last_ret = body0 and isinstance(body0[-1], ast.Return)
+        early = body0[:-1] if top_last_ret else body0
+        if _contains_ctrl(early, (ast.Return,)) or any(
+                isinstance(s, (ast.For, ast.While))
+                and _contains_ctrl([s], (ast.Return,)) for s in early):
+            retv = "__dy2s_ret"
+            try:
+                new0, always = _rewrite_returns(body0, retv)
+                pre0 = [] if always else [ast.Assign(
+                    targets=[_name(retv, ast.Store())],
+                    value=ast.Constant(value=None))]
+                fdef.body = pre0 + new0 + [
+                    ast.Return(value=_name(retv))]
+                did_return_rewrite = True
+            except _UnsupportedReturn:
+                pass  # leave returns as-is (eager fallback semantics)
+        # pass 2: control flow -> _jst.cond / while_loop
+        new_body = []
+        tr = _ControlFlowTransformer()
+        for stmt in fdef.body:
+            out = tr.visit(stmt)
+            new_body.extend(out if isinstance(out, list) else [out])
+        if tr._counter == 0 and not did_return_rewrite:
+            _cache[fn] = fn  # nothing to rewrite
+            return fn
+        fdef.body = new_body
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        glb = dict(fn.__globals__)
+        # re-expose the original closure as globals (exec'd functions
+        # have no closure cells)
+        if fn.__closure__:
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    glb[name] = cell.cell_contents
+                except ValueError:
+                    pass
+        import paddle_tpu.jit.dy2static as _jst_mod
+        glb["_jst"] = _jst_mod
+        loc = {}
+        exec(code, glb, loc)
+        new_fn = loc[fdef.name]
+        new_fn = functools.wraps(fn)(new_fn)
+        _cache[fn] = new_fn
+        return new_fn
+    except Exception:
+        _cache[fn] = fn
+        return fn

@@ -1,0 +1,69 @@
+"""Functionalisation: run a stateful Layer as a pure jax function.
+
+This is the TPU-native replacement for the reference's dygraph-to-static
+bridge (`python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py`
++ `partial_program.py`): instead of AST-transforming python into a static
+Program run by InterpreterCore, we temporarily bind traced arrays into the
+layer's Parameters/buffers and trace the ordinary eager forward under
+`jax.jit` — XLA is the static executor (SURVEY.md §7.5).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core import random as rng_mod
+from ..core.tensor import Tensor
+
+
+@contextlib.contextmanager
+def bind_arrays(tensors, arrays):
+    old = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, o in zip(tensors, old):
+            t._data = o
+
+
+def split_state(layer):
+    """(param_names, param_tensors, buffer_names, buffer_tensors)."""
+    p_names, p_tensors = [], []
+    for n, p in layer.named_parameters():
+        p_names.append(n)
+        p_tensors.append(p)
+    b_names, b_tensors = [], []
+    for n, b in layer.named_buffers():
+        b_names.append(n)
+        b_tensors.append(b)
+    return p_names, p_tensors, b_names, b_tensors
+
+
+def call_functional(layer, param_tensors, buffer_tensors, param_arrays,
+                    buffer_arrays, args, rng_key, grad_params=True):
+    """Run layer(*args) with the given arrays bound in; returns
+    (outputs_arrays, new_buffer_arrays). Tape is disabled — gradients come
+    from jax AD over this function."""
+    wrapped = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+    with bind_arrays(param_tensors, param_arrays), \
+            bind_arrays(buffer_tensors, buffer_arrays), \
+            rng_mod.functional_rng(rng_key), autograd.no_grad():
+        out = layer(*wrapped)
+        new_buffers = [b._data for b in buffer_tensors]
+    return out, new_buffers
+
+
+def tree_arrays(x):
+    """Extract raw arrays from Tensor/list/tuple/dict structures."""
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_arrays(v) for v in x)
+    if isinstance(x, dict):
+        return {k: tree_arrays(v) for k, v in x.items()}
+    return x

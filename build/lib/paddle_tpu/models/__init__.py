@@ -1,0 +1,8 @@
+from .gpt import (  # noqa: F401
+    GPTModel, GPTForPretraining, GPTPretrainingCriterion, GPTDecoderLayer,
+    gpt_tiny, gpt2_small, gpt2_medium, gpt3_1p3b,
+)
+from .bert import (  # noqa: F401
+    BertModel, BertForPretraining, BertPretrainingCriterion,
+    BertForSequenceClassification, bert_tiny, bert_base, bert_large,
+)

@@ -1,0 +1,183 @@
+"""BERT model family (BASELINE config 3: BERT-base pretrain with fused
+attention + LAMB).
+
+Parity target: PaddleNLP's BertModel / BertForPretraining as exercised by
+the reference's `fused_attention_op.cu` path — here the encoder rides
+`nn.TransformerEncoder` whose attention goes through
+`F.scaled_dot_product_attention` (XLA-fused / Pallas).
+"""
+from __future__ import annotations
+
+from .. import nn
+from .. import ops
+from ..core.tensor import Tensor
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings,
+                 type_vocab_size, hidden_dropout_prob=0.1):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size,
+                                                  hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(seq, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids) \
+            + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden_states):
+        return self.activation(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.embeddings = BertEmbeddings(
+            vocab_size, hidden_size, max_position_embeddings,
+            type_vocab_size, hidden_dropout_prob)
+        encoder_layer = nn.TransformerEncoderLayer(
+            hidden_size, num_attention_heads, intermediate_size,
+            dropout=hidden_dropout_prob, activation=hidden_act,
+            attn_dropout=attention_probs_dropout_prob,
+            act_dropout=0.0)
+        self.encoder = nn.TransformerEncoder(encoder_layer,
+                                             num_hidden_layers)
+        self.pooler = BertPooler(hidden_size)
+        self.hidden_size = hidden_size
+        self.vocab_size = vocab_size
+        self.num_layers = num_hidden_layers
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            attention_mask = ops.cast(
+                ops.not_equal(input_ids,
+                              ops.full_like(input_ids, self.pad_token_id)),
+                "float32")
+        # [B, S] -> additive mask [B, 1, 1, S]
+        mask = ops.unsqueeze(attention_mask, [1, 2])
+        mask = (mask - 1.0) * 1e9
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq_out = self.encoder(emb, src_mask=mask)
+        pooled = self.pooler(seq_out)
+        return seq_out, pooled
+
+
+class BertPretrainingHeads(nn.Layer):
+    def __init__(self, hidden_size, vocab_size, activation="gelu",
+                 embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(hidden_size, hidden_size)
+        self.activation = nn.GELU()
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        # weight tying (reference: decoder_weight = embedding table)
+        self._tied_weight = embedding_weights
+        if embedding_weights is None:
+            self.decoder = nn.Linear(hidden_size, vocab_size)
+        else:
+            self.decoder = None
+            self.decoder_bias = self.create_parameter(
+                [vocab_size], is_bias=True)
+        self.seq_relationship = nn.Linear(hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        h = self.layer_norm(self.activation(self.transform(
+            sequence_output)))
+        if self.decoder is not None:
+            prediction_scores = self.decoder(h)
+        else:
+            from .. import ops
+            prediction_scores = ops.matmul(
+                h, self._tied_weight, transpose_y=True) + self.decoder_bias
+        seq_relationship_score = self.seq_relationship(pooled_output)
+        return prediction_scores, seq_relationship_score
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, bert: BertModel):
+        super().__init__()
+        self.bert = bert
+        # reference ties the MLM decoder to the word embedding table
+        self.cls = BertPretrainingHeads(
+            bert.hidden_size, bert.vocab_size,
+            embedding_weights=bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    position_ids, attention_mask)
+        return self.cls(seq_out, pooled)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None,
+                masked_lm_scale=1.0):
+        mlm = nn.functional.cross_entropy(
+            prediction_scores.reshape([-1, self.vocab_size]),
+            masked_lm_labels.reshape([-1]), ignore_index=-1,
+            reduction="mean")
+        if next_sentence_labels is None:
+            return mlm
+        nsp = nn.functional.cross_entropy(
+            seq_relationship_score, next_sentence_labels.reshape([-1]),
+            reduction="mean")
+        return mlm + nsp
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, bert: BertModel, num_classes=2, dropout=None):
+        super().__init__()
+        self.bert = bert
+        self.dropout = nn.Dropout(dropout if dropout is not None else 0.1)
+        self.classifier = nn.Linear(bert.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_tiny(**kw):
+    return BertModel(vocab_size=1024, hidden_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=256, max_position_embeddings=128,
+                     **kw)
+
+
+def bert_base(**kw):
+    return BertModel(**kw)
+
+
+def bert_large(**kw):
+    return BertModel(hidden_size=1024, num_hidden_layers=24,
+                     num_attention_heads=16, intermediate_size=4096, **kw)

@@ -1,0 +1,287 @@
+"""GPT model family (paddle-API, nn.Layer-based).
+
+Parity target: the PaddleNLP/fleetx GPT used in the reference's hybrid
+parallel examples (BASELINE config 4). For the performance/parallel path
+use `paddle_tpu.parallel.hybrid_gpt.HybridGPT` — this class is the
+user-facing eager/single-chip model.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from .. import ops
+from ..core.tensor import Tensor
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, d_model, n_heads, d_ff, dropout=0.0):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(d_model)
+        self.attn = nn.MultiHeadAttention(d_model, n_heads, dropout=dropout)
+        self.ln2 = nn.LayerNorm(d_model)
+        self.fc1 = nn.Linear(d_model, d_ff)
+        self.fc2 = nn.Linear(d_ff, d_model)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        h = self.ln1(x)
+        x = x + self.dropout(self.attn(h, h, h, attn_mask=mask))
+        h = self.ln2(x)
+        x = x + self.dropout(self.fc2(nn.functional.gelu(self.fc1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_attention_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, hidden_dropout_prob=0.0):
+        super().__init__()
+        d_ff = intermediate_size or 4 * hidden_size
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        self.layers = nn.LayerList([
+            GPTDecoderLayer(hidden_size, num_attention_heads, d_ff,
+                            hidden_dropout_prob)
+            for _ in range(num_layers)])
+        self.ln_f = nn.LayerNorm(hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(seq, dtype="int64")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        # causal mask: bool [S, S], True = attend
+        mask = ops.cast(ops.tril(ops.ones([seq, seq], "float32")), "bool")
+        for layer in self.layers:
+            x = layer(x, mask=mask)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    def __init__(self, gpt: GPTModel):
+        super().__init__()
+        self.gpt = gpt
+        self.lm_head = nn.Linear(gpt.hidden_size, gpt.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        return self.lm_head(hidden)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    def forward(self, prediction_scores, masked_lm_labels,
+                loss_mask=None):
+        per_tok = nn.functional.cross_entropy(
+            prediction_scores.reshape([-1, prediction_scores.shape[-1]]),
+            masked_lm_labels.reshape([-1]), reduction="none")
+        if loss_mask is not None:
+            mask = loss_mask.reshape([-1]).astype("float32")
+            from .. import ops
+            return ops.sum(per_tok * mask) / ops.maximum(
+                ops.sum(mask), ops.to_tensor(1e-8))
+        from .. import ops
+        return ops.mean(per_tok)
+
+
+def gpt_tiny(**kw):
+    return GPTModel(vocab_size=1024, hidden_size=128, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=256,
+                    **kw)
+
+
+def gpt2_small(**kw):
+    return GPTModel(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_attention_heads=12, **kw)
+
+
+def gpt2_medium(**kw):
+    return GPTModel(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_attention_heads=16, **kw)
+
+
+def gpt3_1p3b(**kw):
+    return GPTModel(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_attention_heads=16, max_position_embeddings=2048,
+                    **kw)
+
+
+from ..incubate.nn.generation import GenerationMixin  # noqa: E402
+
+
+class GPTForGeneration(nn.Layer, GenerationMixin):
+    """Serving-side GPT: `FusedMultiTransformer` decode stack +
+    `generate()` — the capability behind the reference's
+    `fused_multi_transformer_op.cu` serving path (see
+    `incubate/nn/fused_transformer.py`).
+
+    `weight_only=True` swaps the matmul weights to int8 + scales
+    (`weight_only_linear_kernel.h` parity); `moe=dict(num_expert=..,
+    top_k=..)` builds the `FusedMultiTransformerMoe` stack (weight-only
+    MoE when both are given).
+    """
+
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_attention_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, weight_only=False,
+                 moe=None, compute_dtype="float32"):
+        super().__init__()
+        from ..incubate.nn.fused_transformer import (
+            FusedMultiTransformer, FusedMultiTransformerMoe,
+            FusedMultiTransformerMoeWeightOnly,
+            FusedMultiTransformerWeightOnly)
+        d_ff = intermediate_size or 4 * hidden_size
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self._compute_dtype = compute_dtype
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        if moe and weight_only:
+            self.decoder = FusedMultiTransformerMoeWeightOnly(
+                hidden_size, num_attention_heads, d_ff,
+                normalize_before=True, activation="gelu",
+                num_layers=num_layers, **moe)
+        elif moe:
+            self.decoder = FusedMultiTransformerMoe(
+                hidden_size, num_attention_heads, d_ff,
+                normalize_before=True, activation="gelu",
+                num_layers=num_layers, **moe)
+        else:
+            self.decoder = FusedMultiTransformer(
+                hidden_size, num_attention_heads, d_ff,
+                normalize_before=True, activation="gelu",
+                num_layers=num_layers)
+            if weight_only:
+                self.decoder = FusedMultiTransformerWeightOnly.from_float(
+                    self.decoder)
+        self.ln_f = nn.LayerNorm(hidden_size)
+        self.lm_head = nn.Linear(hidden_size, vocab_size,
+                                 bias_attr=False)
+
+    # ---- eager scoring path (parity oracle) -----------------------------
+    def forward(self, input_ids):
+        seq = input_ids.shape[1]
+        position_ids = ops.arange(seq, dtype="int64")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        hidden = self.decoder(x)
+        return self.lm_head(self.ln_f(hidden))
+
+    # ---- GenerationMixin cores ------------------------------------------
+    def _gen_tensors(self):
+        names, dec_tensors = self.decoder._param_tensors()
+        self._dec_names = names
+        return ([self.word_embeddings.weight,
+                 self.position_embeddings.weight] + dec_tensors +
+                [self.ln_f.weight, self.ln_f.bias, self.lm_head.weight])
+
+    def _gen_cache(self, batch, s_max, dtype):
+        import jax.numpy as jnp
+        d = self.decoder
+        L, H, Dh = d.num_layers, d.num_heads, d.head_dim
+        return (jnp.zeros((L, batch, H, Dh, s_max), jnp.dtype(dtype)),
+                jnp.zeros((L, batch, H, s_max, Dh), jnp.dtype(dtype)))
+
+    def _split_arrays(self, arrays):
+        n_dec = len(self._dec_names)
+        return (arrays[0], arrays[1], arrays[2:2 + n_dec],
+                arrays[-3], arrays[-2], arrays[-1])
+
+    def _embed(self, we, pe, ids, positions):
+        import jax.numpy as jnp
+        positions = jnp.clip(positions, 0,
+                             self.max_position_embeddings - 1)
+        x = we[ids] + pe[positions]
+        return x.astype(jnp.dtype(self._compute_dtype))
+
+    def _prefill_core(self, arrays, ids, seq_lens, cache):
+        import jax.numpy as jnp
+        from ..incubate.nn.fused_transformer import _run_stack, _ln
+        we, pe, dec, lnw, lnb, head = self._split_arrays(arrays)
+        S = ids.shape[1]
+        x = self._embed(we, pe, ids, jnp.arange(S)[None, :])
+        params = dict(zip(self._dec_names, dec))
+        cfg = self.decoder._cfg()
+        out, cache, _ = _run_stack(cfg, params, x, cache, "prefill",
+                                   None, seq_lens, None, None, False)
+        out = _ln(out, lnw, lnb, 1e-5)
+        idx = (seq_lens - 1)[:, None, None]
+        h_last = jnp.take_along_axis(
+            out, jnp.broadcast_to(idx, (out.shape[0], 1, out.shape[2])),
+            axis=1)[:, 0]
+        logits = jnp.matmul(h_last, head.astype(h_last.dtype))
+        return logits, cache
+
+    def _decode_core(self, arrays, token, positions, cache):
+        import jax.numpy as jnp
+        from ..incubate.nn.fused_transformer import _run_stack, _ln
+        we, pe, dec, lnw, lnb, head = self._split_arrays(arrays)
+        pos_col = positions[None, None] if positions.ndim == 0 \
+            else positions[:, None]
+        x = self._embed(we, pe, token[:, None], pos_col)
+        params = dict(zip(self._dec_names, dec))
+        cfg = self.decoder._cfg()
+        out, cache, _ = _run_stack(cfg, params, x, cache, "decode",
+                                   positions, None, None, None, False)
+        out = _ln(out[:, 0], lnw, lnb, 1e-5)
+        logits = jnp.matmul(out, head.astype(out.dtype))
+        return logits, cache
+
+    @classmethod
+    def from_pretraining(cls, model: "GPTForPretraining",
+                         compute_dtype="float32", weight_only=False):
+        """Repack an eager `GPTForPretraining` into the fused serving
+        layout (per-layer q/k/v/out params -> stacked [L, ...])."""
+        import numpy as np
+        gpt = model.gpt
+        L = len(gpt.layers)
+        H = gpt.layers[0].attn.num_heads
+        d = gpt.hidden_size
+        d_ff = gpt.layers[0].fc1._out_features
+        new = cls(vocab_size=gpt.vocab_size, hidden_size=d, num_layers=L,
+                  num_attention_heads=H, intermediate_size=d_ff,
+                  max_position_embeddings=gpt.position_embeddings
+                  ._num_embeddings, compute_dtype=compute_dtype)
+        new.word_embeddings.weight.set_value(gpt.word_embeddings.weight)
+        new.position_embeddings.weight.set_value(
+            gpt.position_embeddings.weight)
+        dec = new.decoder
+
+        def stack(get):
+            return np.stack([np.asarray(get(l).numpy())
+                             for l in gpt.layers])
+        dec.ln_scales.set_value(stack(lambda l: l.ln1.weight))
+        dec.ln_biases.set_value(stack(lambda l: l.ln1.bias))
+        dec.qkv_weights.set_value(np.concatenate(
+            [stack(lambda l: l.attn.q_proj.weight),
+             stack(lambda l: l.attn.k_proj.weight),
+             stack(lambda l: l.attn.v_proj.weight)], axis=2))
+        dec.qkv_biases.set_value(np.concatenate(
+            [stack(lambda l: l.attn.q_proj.bias),
+             stack(lambda l: l.attn.k_proj.bias),
+             stack(lambda l: l.attn.v_proj.bias)], axis=1))
+        dec.linear_weights.set_value(
+            stack(lambda l: l.attn.out_proj.weight))
+        dec.linear_biases.set_value(stack(lambda l: l.attn.out_proj.bias))
+        dec.ffn_ln_scales.set_value(stack(lambda l: l.ln2.weight))
+        dec.ffn_ln_biases.set_value(stack(lambda l: l.ln2.bias))
+        dec.ffn1_weights.set_value(stack(lambda l: l.fc1.weight))
+        dec.ffn1_biases.set_value(stack(lambda l: l.fc1.bias))
+        dec.ffn2_weights.set_value(stack(lambda l: l.fc2.weight))
+        dec.ffn2_biases.set_value(stack(lambda l: l.fc2.bias))
+        new.ln_f.weight.set_value(gpt.ln_f.weight)
+        new.ln_f.bias.set_value(gpt.ln_f.bias)
+        new.lm_head.weight.set_value(model.lm_head.weight)
+        if weight_only:
+            from ..incubate.nn.fused_transformer import (
+                FusedMultiTransformerWeightOnly)
+            new.decoder = FusedMultiTransformerWeightOnly.from_float(
+                new.decoder)
+        return new

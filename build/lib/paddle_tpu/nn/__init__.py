@@ -1,0 +1,58 @@
+"""paddle_tpu.nn — parity with `python/paddle/nn/`."""
+from .layer_base import Layer  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+from .container import Sequential, LayerList, ParameterList  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
+
+from .layers.common import (  # noqa: F401
+    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D,
+    AlphaDropout, Flatten, Upsample, PixelShuffle, Pad1D, Pad2D, Pad3D,
+    CosineSimilarity, Unfold,
+)
+from .layers.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose,
+)
+from .layers.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    RMSNorm, LocalResponseNorm,
+)
+from .layers.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layers.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, GELU, SELU, CELU,
+    Hardswish, Hardsigmoid, Hardshrink, Softshrink, Tanhshrink, Softplus,
+    Softsign, LogSigmoid, LeakyReLU, ELU, Hardtanh, PReLU, Softmax,
+    LogSoftmax, Maxout,
+)
+from .layers.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss,
+)
+from .layers.rnn import (  # noqa: F401
+    RNNCellBase, LSTMCell, GRUCell, SimpleRNNCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
+from .layers.extras import (  # noqa: F401
+    Bilinear, CTCLoss, ChannelShuffle, Fold, Unfold, HSigmoidLoss,
+    LayerDict, MaxUnPool1D, MaxUnPool2D, MultiLabelSoftMarginLoss,
+    PairwiseDistance, PixelUnshuffle, RReLU, SoftMarginLoss, Softmax2D,
+    ThresholdedReLU, TripletMarginWithDistanceLoss,
+    UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
+)
+from .layers.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+
+import sys as _sys
+# paddle code imports `paddle.nn.functional as F`
+functional = functional  # noqa

@@ -1,0 +1,17 @@
+"""paddle_tpu.nn.functional — parity with `python/paddle/nn/functional/`."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+)
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import (  # noqa: F401
+    flash_attention, scaled_dot_product_attention, sparse_attention,
+)
+
+# re-export a few tensor ops that paddle exposes under nn.functional
+from ...ops.manipulation import one_hot, pad  # noqa: F401
+from ...ops.math import sigmoid  # noqa: F401

@@ -1,0 +1,186 @@
+"""Activation functionals.
+
+Parity: `python/paddle/nn/functional/activation.py` over PHI activation
+kernels (`paddle/phi/kernels/activation_kernel.h`). All are single XLA
+elementwise HLOs — fused into surrounding ops by the compiler.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops._helpers import as_tensor, unary
+
+
+def relu(x, name=None):
+    return unary("relu", jax.nn.relu, as_tensor(x))
+
+
+def relu6(x, name=None):
+    return unary("relu6", jax.nn.relu6, as_tensor(x))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._grad_node, x._out_slot = out._data, out._grad_node, \
+        out._out_slot
+    return x
+
+
+def sigmoid(x, name=None):
+    return unary("sigmoid", jax.nn.sigmoid, as_tensor(x))
+
+
+def tanh(x, name=None):
+    return unary("tanh", jnp.tanh, as_tensor(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return unary("gelu",
+                 lambda a: jax.nn.gelu(a, approximate=approximate),
+                 as_tensor(x))
+
+
+def silu(x, name=None):
+    return unary("silu", jax.nn.silu, as_tensor(x))
+
+
+def swish(x, name=None):
+    return unary("swish", jax.nn.silu, as_tensor(x))
+
+
+def mish(x, name=None):
+    return unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)),
+                 as_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return unary("leaky_relu",
+                 lambda a: jax.nn.leaky_relu(a, negative_slope),
+                 as_tensor(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return unary("elu", lambda a: jax.nn.elu(a, alpha), as_tensor(x))
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    return unary("selu",
+                 lambda a: scale * jnp.where(
+                     a > 0, a, alpha * jnp.expm1(a)), as_tensor(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return unary("celu", lambda a: jax.nn.celu(a, alpha), as_tensor(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return unary("hardshrink",
+                 lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+                 as_tensor(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return unary(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        as_tensor(x))
+
+
+def tanhshrink(x, name=None):
+    return unary("tanhshrink", lambda a: a - jnp.tanh(a), as_tensor(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return unary("hardsigmoid",
+                 lambda a: jnp.clip(a * slope + offset, 0.0, 1.0),
+                 as_tensor(x))
+
+
+def hardswish(x, name=None):
+    return unary("hardswish", jax.nn.hard_swish, as_tensor(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return unary("hardtanh", lambda a: jnp.clip(a, min, max), as_tensor(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return unary(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jax.nn.softplus(a * beta) / beta), as_tensor(x))
+
+
+def softsign(x, name=None):
+    return unary("softsign", jax.nn.soft_sign, as_tensor(x))
+
+
+def log_sigmoid(x, name=None):
+    return unary("log_sigmoid", jax.nn.log_sigmoid, as_tensor(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return unary("softmax", lambda a: jax.nn.softmax(a, axis=axis), x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return unary("log_softmax",
+                 lambda a: jax.nn.log_softmax(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as rng
+    x = as_tensor(x)
+    key = rng.next_key()
+
+    def _fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = y_hard + jax.lax.stop_gradient(-y) + y
+        return y
+    return unary("gumbel_softmax", _fn, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def _fn(a, w):
+        if w.size > 1:
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+    return dispatch.apply("prelu", _fn, (x, weight))
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = (list(a.shape[:ax]) + [c // groups, groups]
+                     + list(a.shape[ax + 1:]))
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return unary("maxout", _fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    return unary("glu", lambda a: jax.nn.glu(a, axis=axis), as_tensor(x))

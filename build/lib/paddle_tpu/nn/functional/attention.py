@@ -1,0 +1,187 @@
+"""Attention functionals: the long-context hot path.
+
+Parity: `python/paddle/nn/functional/flash_attention.py:142` over the
+reference's FlashAttention integration (`paddle/phi/kernels/flash_attn_kernel.h`,
+`cmake/external/flashattn.cmake`) and `sparse_attention`
+(`python/paddle/nn/functional/sparse_attention.py`).
+
+TPU-native: `scaled_dot_product_attention` dispatches to a Pallas
+flash-attention kernel on TPU (paddle_tpu/ops/pallas/flash_attention.py)
+with an XLA fallback that the compiler fuses well on the MXU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops._helpers import as_tensor
+
+
+def _xla_attention(q, k, v, bias=None, causal=False, scale=None,
+                   dropout_p=0.0, dropout_key=None):
+    """Reference XLA attention: [B, S, H, D] layout (paddle flash_attention
+    layout). Fast path: jax's fused flash-style attention (no [S,S] probs
+    materialized — ~180x faster fwd+bwd on v5e at S=1024). General path
+    (arbitrary bias rank / dropout) computes probs explicitly in fp32."""
+    # Fast path constraints: jax's is_causal mask is top-left aligned, so
+    # it only matches our bottom-right-aligned general path when q and k
+    # have equal sequence length (KV-cache decode must use the general
+    # path).
+    if dropout_p == 0.0 and q.shape[-1] == k.shape[-1] and \
+            (not causal or q.shape[1] == k.shape[1]):
+        try:
+            return jax.nn.dot_product_attention(
+                q, k, v, bias=bias, is_causal=causal, scale=scale)
+        except (ValueError, TypeError):
+            pass  # e.g. unbroadcastable bias rank -> general path
+    orig_dtype = q.dtype
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bshd,bthd->bhst", qf, k.astype(jnp.float32))
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(orig_dtype)
+
+
+def _attention_impl(q, k, v, bias, causal, scale, dropout_p, dropout_key,
+                    use_pallas):
+    if use_pallas and bias is None and dropout_p == 0.0 \
+            and q.shape[1] == k.shape[1] and q.shape[2] == k.shape[2]:
+        # equal head counts only: GQA/MQA q/kv head mismatch takes the
+        # XLA path (jax.nn.dot_product_attention broadcasts kv heads)
+        from ...ops.pallas.flash_attention import (splash_mha,
+                                                  splash_supported)
+        if splash_supported(q.shape[1], q.shape[-1]):
+            # [B, S, H, D] -> [B, H, S, D] kernel layout
+            out = splash_mha(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+            return jnp.swapaxes(out, 1, 2)
+    return _xla_attention(q, k, v, bias, causal, scale, dropout_p,
+                          dropout_key)
+
+
+def _on_tpu(arr) -> bool:
+    # splash (Pallas flash, fused backward) is the default on TPU —
+    # trace-measured 2.1x faster fwd+bwd than XLA's fused attention at
+    # [32,16,1024,64] (docs/gpt_perf_analysis.md). Opt out with
+    # paddle.set_flags({"FLAGS_use_pallas_flash_attention": False}) or
+    # PADDLE_TPU_PALLAS_FLASH=0.
+    import os
+    if os.environ.get("PADDLE_TPU_PALLAS_FLASH", "1") != "1":
+        return False
+    from ... import flags as _flags
+    if not _flags.get_flags("FLAGS_use_pallas_flash_attention")[
+            "FLAGS_use_pallas_flash_attention"]:
+        return False
+    from ...ops.pallas.flash_attention import _on_tpu_backend
+    return _on_tpu_backend()
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention parity: inputs [B, S, H, D]."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    from ...core import random as rng
+    dkey = rng.next_key() if (dropout > 0.0 and training) else None
+    use_pallas = _on_tpu(q._data) and dkey is None
+
+    def _fn(qa, ka, va):
+        return _attention_impl(qa, ka, va, None, causal, None,
+                               dropout if training else 0.0, dkey,
+                               use_pallas)
+    out = dispatch.apply("flash_attention", _fn, (q, k, v))
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """[B, S, H, D] in/out — paddle 2.5+ SDPA API."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    inputs = [q, k, v]
+    if attn_mask is not None:
+        inputs.append(as_tensor(attn_mask))
+    from ...core import random as rng
+    dkey = rng.next_key() if (dropout_p > 0.0 and training) else None
+    use_pallas = _on_tpu(q._data) and attn_mask is None and dropout_p == 0.0
+
+    def _fn(qa, ka, va, *rest):
+        bias = rest[0] if rest else None
+        return _attention_impl(qa, ka, va, bias, is_causal, None,
+                               dropout_p if training else 0.0, dkey,
+                               use_pallas)
+    return dispatch.apply("sdpa", _fn, tuple(inputs))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Parity: `python/paddle/nn/functional/sparse_attention.py` —
+    layout [B, H, S, D] with a per-(batch, head) CSR sparsity pattern.
+
+    TPU-native realisation: the CSR pattern densifies into an additive
+    mask consumed by the fused attention (XLA's flash-style kernel skips
+    fully-masked blocks); a Pallas block-sparse kernel is the perf
+    upgrade path.
+    """
+    q = as_tensor(query)
+    k = as_tensor(key)
+    v = as_tensor(value)
+    offs = as_tensor(sparse_csr_offset)
+    cols = as_tensor(sparse_csr_columns)
+    extra = []
+    kpm_idx = am_idx = None
+    if key_padding_mask is not None:
+        kpm_idx = len(extra)
+        extra.append(as_tensor(key_padding_mask))
+    if attn_mask is not None:
+        am_idx = len(extra)
+        extra.append(as_tensor(attn_mask))
+
+    def _fn(qa, ka, va, off, col, *rest):
+        B, H, S, D = qa.shape
+        # dense bool mask [B, H, S, S] from CSR rows (padded column
+        # entries map past the last offset and are dropped by jax's
+        # out-of-bounds scatter semantics)
+
+        def one_bh(off_bh, col_bh):
+            # positions of each nnz entry -> (row, col) scatter
+            rows = jnp.searchsorted(off_bh, jnp.arange(col_bh.shape[0]),
+                                    side="right") - 1
+            m = jnp.zeros((S, S), bool)
+            return m.at[rows, col_bh].set(True)
+        mask = jax.vmap(jax.vmap(one_bh))(off, col)
+        bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+        if kpm_idx is not None:
+            kpm = rest[kpm_idx]  # [B, S]: 0 masks the key position
+            bias = bias + jnp.where(kpm[:, None, None, :] > 0.5, 0.0,
+                                    -1e30)
+        if am_idx is not None:
+            bias = bias + rest[am_idx].astype(jnp.float32)
+        # to [B, S, H, D] for the fused kernel
+        qt = jnp.swapaxes(qa, 1, 2)
+        kt = jnp.swapaxes(ka, 1, 2)
+        vt = jnp.swapaxes(va, 1, 2)
+        out = _xla_attention(qt, kt, vt, bias=bias, causal=False)
+        return jnp.swapaxes(out, 1, 2)
+    return dispatch.apply("sparse_attention", _fn,
+                          (q, k, v, offs, cols, *extra))

@@ -1,0 +1,252 @@
+"""Common functionals: linear, dropout, embedding, normalize, interpolate,
+one_hot, cosine_similarity, unfold.
+
+Parity: `python/paddle/nn/functional/common.py` + `input.py` over PHI
+kernels (matmul/dropout/embedding/interpolate). linear() is the MXU hot
+path: x @ W + b in one fused XLA dot.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dispatch
+from ...core import random as rng
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor, unary
+from ...ops.manipulation import pad as _pad  # re-exported
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Weight layout [in, out] (paddle nn.Linear)."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    from ...ops.linalg import _amp_cast2
+    x, weight = _amp_cast2(x, weight)  # O1 cast + O2 dtype harmonization
+    if bias is not None:
+        bias = as_tensor(bias)
+        if bias.dtype != x.dtype and jnp.issubdtype(x.dtype, jnp.floating):
+            bias = bias.astype(x.dtype)
+
+        def _fn(a, w, b):
+            return jnp.matmul(a, w) + b
+        return dispatch.apply("linear", _fn, (x, weight, bias))
+
+    def _fn(a, w):
+        return jnp.matmul(a, w)
+    return dispatch.apply("linear", _fn, (x, weight))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return unary("dropout_scale", lambda a: a * (1 - p), x)
+        return x
+    key = rng.next_key()
+
+    def _fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return unary("dropout", _fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return unary("alpha_dropout", _fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Parity: `paddle/phi/kernels/embedding_kernel.h`; on TPU this is an
+    XLA gather feeding the MXU."""
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def _fn(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return dispatch.apply("embedding", _fn, (x, weight))
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return unary("normalize", _fn, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = as_tensor(x1), as_tensor(x2)
+
+    def _fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return dispatch.apply("cosine_similarity", _fn, (x1, x2))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    r = upscale_factor
+
+    def _fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return unary("pixel_shuffle", _fn, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (`paddle/phi/kernels/funcs/im2col.h`)."""
+    x = as_tensor(x)
+
+    def _to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k, s, p, d = _to2(kernel_sizes), _to2(strides), _to2(paddings), \
+        _to2(dilations)
+
+    def _fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        out_h = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        out_w = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                di, dj = i * d[0], j * d[1]
+                patches.append(
+                    a[:, :, di:di + out_h * s[0]:s[0],
+                      dj:dj + out_w * s[1]:s[1]])
+        col = jnp.stack(patches, axis=2)  # [N, C, k*k, oh, ow]
+        return col.reshape(n, c * k[0] * k[1], out_h * out_w)
+    return unary("unfold", _fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """col2im — inverse of unfold: overlapping patches scatter-ADD back
+    (`paddle/phi/kernels/funcs/im2col.h` col2im path)."""
+    x = as_tensor(x)
+
+    def _to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    o = _to2(output_sizes)
+    k, s, p, d = _to2(kernel_sizes), _to2(strides), _to2(paddings), \
+        _to2(dilations)
+
+    def _fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        out_h = (o[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        out_w = (o[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        col = a.reshape(n, c, k[0] * k[1], out_h, out_w)
+        out = jnp.zeros((n, c, o[0] + 2 * p[0], o[1] + 2 * p[1]),
+                        a.dtype)
+        pos = 0
+        for i in range(k[0]):
+            for j in range(k[1]):
+                di, dj = i * d[0], j * d[1]
+                out = out.at[:, :, di:di + out_h * s[0]:s[0],
+                             dj:dj + out_w * s[1]:s[1]].add(
+                    col[:, :, pos])
+                pos += 1
+        return out[:, :, p[0]:p[0] + o[0], p[1]:p[1] + o[1]]
+
+    return unary("fold", _fn, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = as_tensor(x)
+    nchw = data_format in ("NCHW", "NCDHW", "NCL")
+
+    def _fn(a):
+        spatial = a.shape[2:] if nchw else a.shape[1:-1]
+        if size is not None:
+            tgt = [int(v) for v in (size.tolist() if isinstance(size, Tensor)
+                                    else size)]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            tgt = [int(s * f) for s, f in zip(spatial, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "linear",
+                 "linear": "linear", "trilinear": "linear",
+                 "bicubic": "cubic", "area": "linear"}[mode]
+        if nchw:
+            full = list(a.shape[:2]) + tgt
+        else:
+            full = [a.shape[0]] + tgt + [a.shape[-1]]
+        return jax.image.resize(a, full, method=jmode)
+    return unary("interpolate", _fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       data_format=data_format)
+
+
+pad = _pad
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+
+    def _fn(a):
+        k = a.shape[-1]
+        if prior_dist is None:
+            return (1 - epsilon) * a + epsilon / k
+        return (1 - epsilon) * a + epsilon * prior_dist._data
+    return unary("label_smooth", _fn, label)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: planned (PS round)")

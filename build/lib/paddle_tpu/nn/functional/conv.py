@@ -1,0 +1,193 @@
+"""Convolution functionals over `jax.lax.conv_general_dilated`.
+
+Parity: `python/paddle/nn/functional/conv.py` over PHI conv kernels
+(`paddle/phi/kernels/gpudnn/conv_kernel.cu` → cuDNN). On TPU the conv
+lowers straight onto the MXU; XLA picks the layout/tiling, replacing the
+reference's cuDNN algo search + `phi/kernels/autotune/`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops._helpers import as_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _conv_padding(padding, n, strides=None):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    from ...ops.linalg import _amp_cast2
+    x, weight = _amp_cast2(x, weight)  # O1 cast + O2 dtype harmonization
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    pad = _conv_padding(padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    # layout autotune (imperative/layout_autotune.cc capability): TPU convs
+    # run ~20x faster channels-last, so compute internally in N...C and
+    # transpose at the facade edges (XLA cancels transposes between
+    # stacked channel-first layers)
+    spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
+            3: ("NDHWC", "OIDHW", "NDHWC")}[n]
+
+    def _fn(a, w, *b):
+        if not channel_last:
+            a = jnp.moveaxis(a, 1, -1)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, spec)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            out = out + b[0].reshape((1,) * (out.ndim - 1)
+                                     + (-1,)).astype(out.dtype)
+        if not channel_last:
+            out = jnp.moveaxis(out, -1, 1)
+        return out
+    if bias is not None:
+        bias = as_tensor(bias)
+        return dispatch.apply(f"conv{n}d", _fn, (x, weight, bias))
+    return dispatch.apply(f"conv{n}d", _fn, (x, weight))
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 fmt, name)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, name)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, name)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size, name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    opad = _tuple(output_padding, n) if output_padding is not None \
+        else (0,) * n
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        pads = _conv_padding(padding, n)
+
+    if channel_last:
+        spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
+                3: ("NDHWC", "OIDHW", "NDHWC")}[n]
+        ch_in_axis = x.ndim - 1
+    else:
+        spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+                3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+        ch_in_axis = 1
+
+    def _one_group(a, w):
+        # paddle conv_transpose weight layout: [in_c, out_c, *k];
+        # transpose conv = conv with lhs_dilation (fractional stride),
+        # flipped kernel, swapped in/out channels.
+        k = w.shape[2:]
+        if isinstance(pads, str):
+            if pads == "SAME":
+                pad_t = [(min(dilations[i] * (k[i] - 1), strides[i] - 1
+                              + dilations[i] * (k[i] - 1)) // 1,) * 2
+                         for i in range(n)]
+                pad_t = [(dilations[i] * (k[i] - 1) // 2,
+                          dilations[i] * (k[i] - 1)
+                          - dilations[i] * (k[i] - 1) // 2)
+                         for i in range(n)]
+            else:  # VALID
+                pad_t = [(dilations[i] * (k[i] - 1),
+                          dilations[i] * (k[i] - 1) + opad[i])
+                         for i in range(n)]
+        else:
+            pad_t = []
+            for i in range(n):
+                lo, hi = pads[i]
+                eff_k = dilations[i] * (k[i] - 1)
+                pad_t.append((eff_k - lo, eff_k - hi + opad[i]))
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wf = jnp.swapaxes(wf, 0, 1)  # [out_c, in_c, *k]
+        dn = jax.lax.conv_dimension_numbers(a.shape, wf.shape, spec)
+        return jax.lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * n, padding=pad_t,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn)
+
+    def _fn(a, w, *b):
+        if groups == 1:
+            out = _one_group(a, w)
+        else:
+            a_groups = jnp.split(a, groups, axis=ch_in_axis)
+            w_groups = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate(
+                [_one_group(ag, wg) for ag, wg in zip(a_groups, w_groups)],
+                axis=ch_in_axis)
+        if b:
+            bias_shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channel_last else 1
+            bias_shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(bias_shape).astype(out.dtype)
+        return out
+    if bias is not None:
+        bias = as_tensor(bias)
+        return dispatch.apply(f"conv{n}d_transpose", _fn, (x, weight, bias))
+    return dispatch.apply(f"conv{n}d_transpose", _fn, (x, weight))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format, output_size,
+                           name)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size,
+                           name)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size,
+                           name)

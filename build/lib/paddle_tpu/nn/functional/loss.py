@@ -1,0 +1,295 @@
+"""Loss functionals.
+
+Parity: `python/paddle/nn/functional/loss.py` over PHI loss kernels
+(`paddle/phi/kernels/cross_entropy_kernel.h`,
+`c_softmax_with_cross_entropy` for the vocab-parallel variant — that one
+lives in parallel/mp_ops.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    inputs = [input, label]
+    if weight is not None:
+        inputs.append(as_tensor(weight))
+
+    def _fn(logits, lab, *w):
+        lg = logits.astype(jnp.float32)
+        if use_softmax:
+            logp = jax.nn.log_softmax(lg, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(lg, 1e-30))
+        n_classes = logp.shape[axis]
+        if soft_label:
+            tgt = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / n_classes
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            valid = None
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:  # [N, ..., 1]
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe_lab = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_lab, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked \
+                    + label_smoothing * smooth
+            loss = -jnp.where(valid, picked, 0.0)
+            if w:
+                wgt = jnp.take(w[0].astype(jnp.float32), safe_lab)
+                loss = loss * jnp.where(valid, wgt, 0.0)
+        if reduction == "mean":
+            if valid is not None:
+                if w:
+                    wgt = jnp.take(w[0].astype(jnp.float32),
+                                   jnp.where(valid, lab_i, 0))
+                    denom = jnp.maximum(
+                        jnp.sum(jnp.where(valid, wgt, 0.0)), 1e-12)
+                else:
+                    denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                        1.0)
+                return jnp.sum(loss) / denom
+            return jnp.mean(loss)
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("cross_entropy", _fn, tuple(inputs))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    loss = loss.astype(as_tensor(logits).dtype)
+    if loss.ndim < as_tensor(logits).ndim:
+        from ...ops.manipulation import unsqueeze
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    inputs = [input, label]
+    if weight is not None:
+        inputs.append(as_tensor(weight))
+
+    def _fn(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -jnp.where(valid, picked, 0.0)
+        if w:
+            wgt = jnp.take(w[0], safe)
+            loss = loss * jnp.where(valid, wgt, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wgt, 0.0)), 1e-12)
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("nll_loss", _fn, tuple(inputs))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def _fn(a, b):
+        return _reduce_loss((a - b) ** 2, reduction)
+    return dispatch.apply("mse_loss", _fn, (input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def _fn(a, b):
+        return _reduce_loss(jnp.abs(a - b), reduction)
+    return dispatch.apply("l1_loss", _fn, (input, label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def _fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("smooth_l1_loss", _fn, (input, label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    inputs = [input, label]
+    if weight is not None:
+        inputs.append(as_tensor(weight))
+
+    def _fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("bce", _fn, tuple(inputs))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = as_tensor(logit), as_tensor(label)
+    inputs = [logit, label]
+    w_idx = pw_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if pos_weight is not None:
+        pw_idx = len(inputs)
+        inputs.append(as_tensor(pos_weight))
+
+    def _fn(z, y, *rest):
+        max_val = jnp.maximum(-z, 0.0)
+        if pw_idx is not None:
+            pw = rest[pw_idx - 2]
+            log_w = (pw - 1.0) * y + 1.0
+            loss = (1 - y) * z + log_w * (
+                jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            loss = (1 - y) * z + jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val
+        if w_idx is not None:
+            loss = loss * rest[w_idx - 2]
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("bce_with_logits", _fn, tuple(inputs))
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def _fn(logp, tgt):
+        loss = tgt * (jnp.log(jnp.maximum(tgt, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("kl_div", _fn, (input, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = as_tensor(input), as_tensor(other), \
+        as_tensor(label)
+
+    def _fn(a, b, y):
+        return _reduce_loss(jnp.maximum(0.0, -y * (a - b) + margin),
+                            reduction)
+    return dispatch.apply("margin_ranking", _fn, (input, other, label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def _fn(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("hinge_embedding", _fn, (input, label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = as_tensor(input1), as_tensor(input2), \
+        as_tensor(label)
+
+    def _fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+    return dispatch.apply("cosine_embedding", _fn, (input1, input2, label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    input, positive, negative = (as_tensor(input), as_tensor(positive),
+                                 as_tensor(negative))
+
+    def _fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v) ** p + epsilon,
+                           axis=-1) ** (1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce_loss(jnp.maximum(0.0, d_pos - d_neg + margin),
+                            reduction)
+    return dispatch.apply("triplet_margin", _fn, (input, positive, negative))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (warpctc kernel parity). log_probs [T,B,C] time-major
+    unnormalized logits (softmax applied internally, like warpctc);
+    labels [B,L]; lengths [B]. Alpha-recursion runs on device via
+    optax.ctc_loss."""
+    import optax
+    from ...core import dispatch
+
+    log_probs = as_tensor(log_probs)
+    labels = as_tensor(labels)
+    ilen = as_tensor(input_lengths)
+    llen = as_tensor(label_lengths)
+
+    def _fn(lp, lab, il, ll):
+        logits = jnp.swapaxes(lp, 0, 1)              # [B,T,C]
+        B, T, _ = logits.shape
+        L = lab.shape[1]
+        t_idx = jnp.arange(T)[None, :]
+        logit_pad = (t_idx >= il[:, None]).astype(jnp.float32)
+        l_idx = jnp.arange(L)[None, :]
+        label_pad = (l_idx >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                                 blank_id=blank)
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(il.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference semantics: each sequence's loss is normalized by
+            # its label length before averaging (warpctc convention)
+            per_seq = per_seq / jnp.maximum(ll.astype(jnp.float32), 1.0)
+        return _reduce_loss(per_seq, reduction)
+
+    return dispatch.apply("ctc_loss", _fn,
+                          (log_probs, labels, ilen, llen))
+
+
+def square_error_cost(input, label):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def _fn(a, b):
+        return (a - b) ** 2
+    return dispatch.apply("square_error_cost", _fn, (input, label))

@@ -1,0 +1,294 @@
+"""Normalization functionals.
+
+Parity: `python/paddle/nn/functional/norm.py` over PHI batch_norm /
+layer_norm / group_norm kernels (`paddle/phi/kernels/batch_norm_kernel.h`,
+`layer_norm_kernel.h`). On TPU these are XLA-fused reductions +
+elementwise — no cuDNN equivalent needed.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_train_core(axes, eps, x, w, b):
+    """Affine train-mode batch norm with a hand-written backward.
+
+    jax AD of the naive form runs three separate reduction fusions over
+    the feature map (profiled at ~20% of a ResNet-50 train step); the
+    analytic backward needs exactly two passes — one fused quad-reduce
+    (sum dy, sum dy*xhat — both read (dy, x) once) and one elementwise
+    dx pass."""
+    return _bn_fwd_math(axes, eps, x, w, b)[0]
+
+
+def _bn_fwd_math(axes, eps, x, w, b):
+    af = x.astype(jnp.float32)
+    m1 = jnp.mean(af, axis=axes, keepdims=True)
+    # Centered two-pass variance: E[(x-m)^2].  The single-pass
+    # E[x^2]-E[x]^2 form cancels catastrophically in f32 when
+    # |mean| >> std, silently collapsing var toward 0.
+    var = jnp.mean(jnp.square(af - m1), axis=axes, keepdims=True)
+    ivar = jax.lax.rsqrt(var + eps)
+    xhat = (af - m1) * ivar
+    bshape = m1.shape
+    out = xhat * w.astype(jnp.float32).reshape(bshape) \
+        + b.astype(jnp.float32).reshape(bshape)
+    return ((out.astype(x.dtype), m1.reshape(-1), var.reshape(-1)),
+            (x, m1, ivar, w))
+
+
+def _bn_train_fwd(axes, eps, x, w, b):
+    return _bn_fwd_math(axes, eps, x, w, b)
+
+
+def _bn_train_bwd(axes, eps, res, cots):
+    x, m1, ivar, w = res
+    dy, dm1_c, dvar_c = cots
+    n = 1
+    for ax in axes:
+        n *= x.shape[ax]
+    nf = jnp.float32(n)
+    af = x.astype(jnp.float32)
+    xhat = (af - m1) * ivar
+    dyf = dy.astype(jnp.float32)
+    bshape = m1.shape
+    # pass 1: both reductions read (dy, x) once (multi-output fusion)
+    s1 = jnp.sum(dyf, axis=axes, keepdims=True)          # = dbeta
+    s2 = jnp.sum(dyf * xhat, axis=axes, keepdims=True)   # = dgamma
+    wf = w.astype(jnp.float32).reshape(bshape)
+    # pass 2: elementwise dx (+ cotangents of the mean/var outputs,
+    # which feed running-stat updates: usually zero, kept for
+    # correctness — they are per-channel broadcasts, no extra pass)
+    dx = (wf * ivar / nf) * (nf * dyf - s1 - xhat * s2)
+    if dm1_c is not None:
+        dx = dx + dm1_c.reshape(bshape) / nf
+    if dvar_c is not None:
+        dx = dx + dvar_c.reshape(bshape) * 2.0 * (af - m1) / nf
+    dgamma = s2.reshape(-1).astype(w.dtype)
+    dbeta = s1.reshape(-1)
+    return (dx.astype(x.dtype), dgamma, dbeta.astype(w.dtype))
+
+
+_bn_train_core.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = as_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_stats = (not training) if use_global_stats is None else \
+        use_global_stats
+
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if use_stats:
+        rm, rv = as_tensor(running_mean), as_tensor(running_var)
+        inputs.extend([rm, rv])
+
+        def _fn(*arrs):
+            a = arrs[0]
+            mean = arrs[-2].reshape(bshape)
+            var = arrs[-1].reshape(bshape)
+            out = (a - mean) / jnp.sqrt(var + epsilon)
+            if w_idx is not None:
+                out = out * arrs[w_idx].reshape(bshape)
+            if b_idx is not None:
+                out = out + arrs[b_idx].reshape(bshape)
+            return out.astype(a.dtype)
+        return dispatch.apply("batch_norm_infer", _fn, tuple(inputs))
+
+    # training: compute batch stats; update running stats (stateful, on the
+    # Tensor wrappers — traced arrays flow through during functional mode).
+    # PERF: on the TPU backend, mixed-dtype (bf16 data + f32 stats)
+    # backward is pathologically slow (~35x, measured); for bf16 inputs we
+    # therefore keep the whole computation in bf16 (standard TPU practice
+    # — the var uses E[x^2]-E[x]^2 whose grads lower cleanly, unlike
+    # jnp.var's). fp32 inputs keep fp32 stats.
+    def _fn(*arrs):
+        a = arrs[0]
+        if w_idx is not None and b_idx is not None:
+            # affine hot path: single-pass f32 moments forward +
+            # analytic two-pass backward (see _bn_train_core)
+            return _bn_train_core(reduce_axes, epsilon, a,
+                                  arrs[w_idx], arrs[b_idx])
+        # generic path (no affine params): same math, jax AD backward.
+        # f32 accumulation keeps E[x^2]-E[x]^2 from cancelling (it was
+        # bf16 accumulation that produced negative variances).
+        af = a.astype(jnp.float32)
+        m1 = jnp.mean(af, axis=reduce_axes, keepdims=True)
+        m2 = jnp.mean(jnp.square(af), axis=reduce_axes, keepdims=True)
+        var = jnp.maximum(m2 - jnp.square(m1), 0.0)
+        out = (af - m1) * jax.lax.rsqrt(var + epsilon)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
+        return (out.astype(a.dtype),
+                m1.reshape(-1),
+                var.reshape(-1))
+
+    out, batch_mean, batch_var = dispatch.apply(
+        "batch_norm_train", _fn, tuple(inputs))
+    if running_mean is not None:
+        rm, rv = as_tensor(running_mean), as_tensor(running_var)
+        # The reference kernel updates running_var with the *biased*
+        # batch variance (paddle/phi/kernels/cpu/batch_norm_kernel.cc:125,
+        # 152) — no n/(n-1) correction — so checkpoints eval identically.
+        rm._data = (momentum * rm._data
+                    + (1 - momentum) * batch_mean._data.astype(rm.dtype))
+        rv._data = (momentum * rv._data
+                    + (1 - momentum) * batch_var._data.astype(rv.dtype))
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = as_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + epsilon)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32)
+        return out.astype(a.dtype)
+    return dispatch.apply("layer_norm", _fn, tuple(inputs))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = as_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        if channel_last:
+            af = jnp.moveaxis(af, -1, 1)
+        shp = af.shape
+        g = af.reshape(shp[0], num_groups, shp[1] // num_groups, *shp[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(shp)
+        bshape = [1, shp[1]] + [1] * (len(shp) - 2)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+    return dispatch.apply("group_norm", _fn, tuple(inputs))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    inputs = [x]
+    w_idx = b_idx = None
+    if weight is not None:
+        w_idx = len(inputs)
+        inputs.append(as_tensor(weight))
+    if bias is not None:
+        b_idx = len(inputs)
+        inputs.append(as_tensor(bias))
+
+    def _fn(*arrs):
+        a = arrs[0]
+        af = a.astype(jnp.float32)
+        axes = tuple(range(2, af.ndim))
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + eps)
+        bshape = [1, af.shape[1]] + [1] * (af.ndim - 2)
+        if w_idx is not None:
+            out = out * arrs[w_idx].astype(jnp.float32).reshape(bshape)
+        if b_idx is not None:
+            out = out + arrs[b_idx].astype(jnp.float32).reshape(bshape)
+        return out.astype(a.dtype)
+    return dispatch.apply("instance_norm", _fn, tuple(inputs))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        sq = a * a
+        half = size // 2
+        ch = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jnp.take(sq, jnp.arange(i, i + ch), axis=1)
+        return a / (k + alpha * acc) ** beta
+    from ...ops._helpers import unary
+    return unary("lrn", _fn, x)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLM-era extension; reference has fused rms_norm in
+    fluid/operators/fused)."""
+    x = as_tensor(x)
+    inputs = [x]
+    if weight is not None:
+        inputs.append(as_tensor(weight))
+
+    def _fn(a, *w):
+        af = a.astype(jnp.float32)
+        scale = jnp.sqrt(jnp.mean(af * af, axis=-1, keepdims=True) + epsilon)
+        out = af / scale
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    return dispatch.apply("rms_norm", _fn, tuple(inputs))

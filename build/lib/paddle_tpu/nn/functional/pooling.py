@@ -1,0 +1,259 @@
+"""Pooling functionals over `jax.lax.reduce_window`.
+
+Parity: `python/paddle/nn/functional/pooling.py` over PHI pool kernels
+(`paddle/phi/kernels/pool_kernel.h`, `gpudnn/pool_kernel.cu`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import as_tensor, unary
+from .conv import _tuple
+
+
+def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
+          ceil_mode=False, count_include_pad=True, average=False,
+          exclusive=True):
+    x = as_tensor(x)
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _tuple(padding, n) if not isinstance(padding, (list, tuple)) or \
+            all(isinstance(v, int) for v in padding) else padding
+        if isinstance(p, tuple) and len(p) == n:
+            pads = [(v, v) for v in p]
+        else:
+            pads = [tuple(v) for v in p]
+
+    def _fn(a):
+        # channels-last internally (layout autotune; see conv.py)
+        to_cl = not channel_last
+        if to_cl:
+            a = jnp.moveaxis(a, 1, -1)
+        window = (1,) + k + (1,)
+        strides_full = (1,) + s + (1,)
+        pad_full = [(0, 0)] + (pads or [(0, 0)] * n) + [(0, 0)]
+        pad_cfg = pad_mode if pad_mode is not None else pad_full
+        out = jax.lax.reduce_window(
+            a, init(a.dtype), reducer, window, strides_full,
+            pad_cfg if isinstance(pad_cfg, str) else pad_cfg)
+        if average:
+            if exclusive and pads is not None and any(
+                    p_ != (0, 0) for p_ in (pads or [])):
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0 if not jnp.issubdtype(a.dtype, jnp.integer)
+                    else 0, jax.lax.add, window, strides_full, pad_cfg)
+                out = out / counts
+            else:
+                out = out / float(np.prod(k))
+        if to_cl:
+            out = jnp.moveaxis(out, -1, 1)
+        return out
+    return unary("pool", _fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    def init(dt):
+        return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
+            jnp.iinfo(dt).min
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, init,
+                 channel_last=False, ceil_mode=ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    def init(dt):
+        return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
+            jnp.iinfo(dt).min
+    if not return_mask:
+        return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+                     init, channel_last=(data_format == "NHWC"),
+                     ceil_mode=ceil_mode)
+    assert data_format == "NCHW" and not ceil_mode, \
+        "return_mask supports NCHW, ceil_mode=False"
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    p = _tuple(padding, 2)
+
+    def _pool_with_mask(a):
+        """One pass producing (pooled max, flat H*W argmax index) — the
+        MaxPoolWithIndex kernel role, feeding max_unpool2d."""
+        n, c, h, w = a.shape
+        av = jnp.pad(a.astype(jnp.float32),
+                     ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                     constant_values=-jnp.inf)
+        iv = jnp.pad(jnp.arange(h * w, dtype=jnp.int32
+                                ).reshape(1, 1, h, w),
+                     ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                     constant_values=-1)
+        oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+        ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+        pv, pi = [], []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                pv.append(av[:, :, i:i + oh * s[0]:s[0],
+                             j:j + ow * s[1]:s[1]])
+                pi.append(iv[:, :, i:i + oh * s[0]:s[0],
+                             j:j + ow * s[1]:s[1]])
+        stacked_v = jnp.stack(pv, axis=2)          # [N,C,K,oh,ow]
+        stacked_i = jnp.stack(pi, axis=2)          # [1,1,K,oh,ow]
+        out = jnp.max(stacked_v, axis=2).astype(a.dtype)
+        am = jnp.argmax(stacked_v, axis=2)[:, :, None]
+        bi = jnp.broadcast_to(stacked_i,
+                              (n, c) + stacked_i.shape[2:])
+        mask = jnp.take_along_axis(bi, am, axis=2)[:, :, 0]
+        return out, mask
+
+    from ...core import dispatch
+    return dispatch.apply("max_pool2d_with_mask", _pool_with_mask,
+                          (as_tensor(x),))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter values back to
+    their argmax positions (`paddle/phi/kernels/unpool_kernel.h`)."""
+    from ...core import dispatch
+    x = as_tensor(x)
+    indices = as_tensor(indices)
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    p = _tuple(padding, 2)
+    n, c, ih, iw = x.shape
+    if output_size is None:
+        if p[0] or p[1]:
+            # the mask addresses the ORIGINAL input plane; the padded
+            # default formula yields a smaller buffer and jax scatter
+            # would silently drop out-of-range maxima
+            raise ValueError(
+                "max_unpool2d with padding>0 needs explicit output_size "
+                "(the pooled-from input's spatial shape)")
+        oh = (ih - 1) * s[0] - 2 * p[0] + k[0]
+        ow = (iw - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        oh, ow = [int(v) for v in output_size[-2:]]
+
+    def _fn(a, idx):
+        flat_v = a.reshape(n * c, ih * iw)
+        flat_i = idx.reshape(n * c, ih * iw).astype(jnp.int32)
+        out = jnp.zeros((n * c, oh * ow), a.dtype)
+        rows = jnp.arange(n * c)[:, None]
+        out = out.at[rows, flat_i].set(flat_v)
+        return out.reshape(n, c, oh, ow)
+
+    return dispatch.apply("max_unpool2d", _fn, (x, indices))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    def init(dt):
+        return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else \
+            jnp.iinfo(dt).min
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, init,
+                 channel_last=(data_format == "NDHWC"), ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add,
+                 lambda dt: jnp.zeros((), dt).item() if False else 0.0,
+                 channel_last=False, average=True, exclusive=exclusive,
+                 ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add,
+                 lambda dt: 0.0, channel_last=(data_format == "NHWC"),
+                 average=True, exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add,
+                 lambda dt: 0.0, channel_last=(data_format == "NDHWC"),
+                 average=True, exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", False)
+
+
+def _adaptive(x, output_size, n, mode, channel_last):
+    x = as_tensor(x)
+    out_sz = _tuple(output_size, n)
+
+    def _fn(a):
+        spatial = a.shape[2:2 + n] if not channel_last else a.shape[1:1 + n]
+        # exact adaptive pooling when divisible; else mean over variable bins
+        if all(s % o == 0 for s, o in zip(spatial, out_sz)):
+            k = tuple(s // o for s, o in zip(spatial, out_sz))
+            if channel_last:
+                window = (1,) + k + (1,)
+            else:
+                window = (1, 1) + k
+            red = jax.lax.max if mode == "max" else jax.lax.add
+            init = (-jnp.inf if mode == "max" else 0.0)
+            out = jax.lax.reduce_window(a, init, red, window, window,
+                                        "VALID")
+            if mode == "avg":
+                out = out / float(np.prod(k))
+            return out
+        # general path: resize-style bins
+        slices = []
+        for dim_i, (s, o) in enumerate(zip(spatial, out_sz)):
+            starts = [int(np.floor(i * s / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * s / o)) for i in range(o)]
+            slices.append((starts, ends))
+
+        def pool_one(index):
+            idx = [slice(None)] * a.ndim
+            base = 1 if channel_last else 2
+            for d, ii in enumerate(index):
+                st, en = slices[d][0][ii], slices[d][1][ii]
+                idx[base + d] = slice(st, en)
+            patch = a[tuple(idx)]
+            axes = tuple(range(base, base + n))
+            return (jnp.max(patch, axis=axes) if mode == "max"
+                    else jnp.mean(patch, axis=axes))
+        import itertools
+        outs = [pool_one(ix) for ix in itertools.product(
+            *[range(o) for o in out_sz])]
+        stacked = jnp.stack(outs, axis=-1)
+        if channel_last:
+            nb, c = a.shape[0], a.shape[-1]
+            return stacked.reshape((nb, c) + tuple(out_sz)).transpose(
+                (0,) + tuple(range(2, 2 + n)) + (1,))
+        nb, c = a.shape[0], a.shape[1]
+        return stacked.reshape((nb, c) + tuple(out_sz))
+    return unary("adaptive_pool", _fn, x)

@@ -1,0 +1,177 @@
+"""Weight initializers.
+
+Parity: `python/paddle/fluid/initializer.py` + `python/paddle/nn/initializer/`
+(Constant, Normal, TruncatedNormal, Uniform, Xavier*, Kaiming*, Assign).
+Each initializer is a callable (shape, dtype) -> jax array; randomness comes
+from the global RNG facade so `paddle.seed` reproduces runs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as rng
+from ..core.tensor import Tensor
+
+
+def _fan_in_out(shape):
+    shape = list(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight [out_c, in_c, *k] (paddle layout)
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype_mod.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.normal(rng.next_key(), tuple(shape), dt) \
+            * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.truncated_normal(
+            rng.next_key(), -2.0, 2.0, tuple(shape), dt) * self.std + self.mean
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.uniform(rng.next_key(), tuple(shape), dt,
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.uniform(rng.next_key(), tuple(shape), dt,
+                                  minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.normal(rng.next_key(), tuple(shape), dt) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.uniform(rng.next_key(), tuple(shape), dt,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.normal(rng.next_key(), tuple(shape), dt) * std
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = self.value.numpy() if isinstance(self.value, Tensor) \
+            else np.asarray(self.value)
+        arr = arr.reshape(shape).astype(dtype_mod.convert_dtype(dtype))
+        return jnp.asarray(arr)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        dt = dtype_mod.convert_dtype(dtype)
+        w = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic)):
+            w[(i, i, *centers)] = 1.0
+        return jnp.asarray(w, dt)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        dt = dtype_mod.convert_dtype(dtype)
+        return jax.random.orthogonal(
+            rng.next_key(), shape[0],
+            shape=(), ).astype(dt) * self.gain if len(shape) == 1 else \
+            jax.nn.initializers.orthogonal(self.gain)(
+                rng.next_key(), tuple(shape), dt)
+
+
+# paddle.nn.initializer namespace aliases
+constant = Constant
+normal = Normal
+uniform = Uniform

@@ -1,0 +1,335 @@
+"""nn.Layer — the module base class.
+
+Parity: `python/paddle/fluid/dygraph/layers.py:98` (`Layer`): parameter /
+buffer / sublayer registration via `__setattr__`, `create_parameter`,
+forward pre/post hooks, `state_dict` / `set_state_dict`, train/eval modes,
+`apply`, `to`. Parameters are `core.Parameter` tensors (stop_gradient=False)
+living on the TPU as jax Arrays.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Parameter, Tensor
+from .param_attr import ParamAttr
+from . import initializer as I
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype_mod.convert_dtype(dtype)
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._name = name_scope or self.__class__.__name__.lower()
+
+    # ---------------------------------------------------------- registry
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning "
+                                   "parameters")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning "
+                                   "sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None:
+                params.pop(name, None)
+            if layers is not None:
+                layers.pop(name, None)
+            if buffers is not None and isinstance(value, Tensor):
+                # plain tensors assigned to a layer become buffers only via
+                # register_buffer; a raw assignment stays a python attr
+                pass
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(d)
+            if store is not None and name in store:
+                return store[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(d)
+            if store is not None and name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # -------------------------------------------------------- parameters
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """`Layer.create_parameter` parity (layers.py:421) — ParamAttr +
+        initializer-driven creation."""
+        dtype = dtype_mod.convert_dtype(dtype) or self._dtype
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = None
+        if default_initializer is not None:
+            init = default_initializer
+        elif attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        else:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        data = init(shape, dtype)
+        trainable = attr.trainable if attr is not None else True
+        p = Parameter(data, dtype=dtype,
+                      name=attr.name if attr is not None else None,
+                      trainable=trainable)
+        if attr is not None:
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+            p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        """layers.py register_buffer parity (e.g. BN running stats)."""
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+        self.__dict__.pop(name, None)
+        return tensor
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (name + ("." if name else "") + pname, p)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + ("." if name else "") + bname, b)
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = prefix + ("." if prefix else "") + lname
+                yield from sub._traverse(sub_prefix, True)
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def sublayers(self, include_self=False):
+        out = []
+        for name, layer in self._traverse("", True):
+            if layer is self and not include_self:
+                continue
+            out.append(layer)
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, layer in self._traverse(prefix, True):
+            if layer is self and not include_self:
+                continue
+            yield name, layer
+
+    # ------------------------------------------------------------- modes
+    def train(self):
+        self.training = True
+        for sub in self._sub_layers.values():
+            if sub is not None:
+                sub.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for sub in self._sub_layers.values():
+            if sub is not None:
+                sub.eval()
+        return self
+
+    def apply(self, fn):
+        for sub in self._sub_layers.values():
+            if sub is not None:
+                sub.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------ state
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix,
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, layer in self._traverse(structured_name_prefix,
+                                          include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[name + ("." if name else "") + bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            tgt = own[k]
+            arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+            tgt.set_value(arr.astype(tgt.dtype))
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -------------------------------------------------------- conversion
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def _cast_all(self, dt, floating_only=True):
+        for _, p in self.named_parameters():
+            if not floating_only or dtype_mod.is_floating(p.dtype):
+                p._data = p._data.astype(dt)
+        for _, b in self.named_buffers():
+            if isinstance(b, Tensor) and (
+                    not floating_only or dtype_mod.is_floating(b.dtype)):
+                b._data = b._data.astype(dt)
+        self._dtype = dt
+
+    def float(self):
+        self._cast_all(dtype_mod.float32)
+        return self
+
+    def bfloat16(self):
+        self._cast_all(dtype_mod.bfloat16)
+        return self
+
+    def half(self):
+        self._cast_all(dtype_mod.float16)
+        return self
+
+    # ------------------------------------------------------------- call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    def full_name(self):
+        return self._name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"

@@ -1,0 +1,4 @@
+def _ntuple(v, n):
+    if isinstance(v, int):
+        return tuple([v] * n)
+    return tuple(int(x) for x in v)

@@ -1,0 +1,412 @@
+"""Round-2 layer additions.
+
+Parity: the remaining `python/paddle/nn/layer/*` classes — Bilinear,
+CTCLoss, ChannelShuffle, Fold, HSigmoidLoss, LayerDict, MaxUnPool1/2/3D,
+MultiLabelSoftMarginLoss, PairwiseDistance, PixelUnshuffle, RReLU,
+SoftMarginLoss, Softmax2D, ThresholdedReLU, TripletMarginWithDistanceLoss,
+UpsamplingBilinear2D/Nearest2D, ZeroPad2D.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ..initializer import XavierUniform
+from ... import ops
+from ...core.tensor import Tensor
+from .. import functional as F
+
+
+def _reduce_tensor(loss, reduction):
+    """Tensor-level reduction (the array-level _reduce_loss runs inside
+    dispatched fns; this one composes eager Tensor ops)."""
+    if reduction == "mean":
+        return ops.mean(loss)
+    if reduction == "sum":
+        return ops.sum(loss)
+    return loss
+
+
+class Bilinear(Layer):
+    """out = x1 . W . x2 + b (per output feature)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features],
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x1, x2):
+        from ...core import dispatch
+
+        def f(a, b, w, bias):
+            return jnp.einsum("bi,oij,bj->bo", a, w, b) + bias
+
+        from ...ops._helpers import as_tensor
+        return dispatch.apply(
+            "bilinear", f, (as_tensor(x1), as_tensor(x2), self.weight,
+                            self.bias))
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, blank=self.blank,
+                          reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        assert data_format == "NCHW"
+        self.groups = groups
+
+    def forward(self, x):
+        n, c, h, w = x.shape
+        g = self.groups
+        x = ops.reshape(x, [n, g, c // g, h, w])
+        x = ops.transpose(x, [0, 2, 1, 3, 4])
+        return ops.reshape(x, [n, c, h, w])
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a complete binary tree of classes
+    (`paddle/phi/kernels/hsigmoid_loss_kernel.h` default-tree mode):
+    path/code tables precomputed per class at init."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        assert not is_custom, "custom trees: pass path tables directly"
+        self.num_classes = num_classes
+        n_nodes = num_classes - 1  # internal nodes of a complete tree
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([n_nodes], attr=bias_attr,
+                                          is_bias=True)
+        # heap numbering: classes are leaves [num_classes-1, 2*nc-2];
+        # internal nodes [0, nc-2]; parent(i) = (i-1)//2
+        depth = int(np.ceil(np.log2(max(num_classes, 2)))) + 1
+        paths = np.zeros((num_classes, depth), np.int32)
+        codes = np.zeros((num_classes, depth), np.float32)
+        lengths = np.zeros(num_classes, np.int32)
+        for cls in range(num_classes):
+            node = cls + num_classes - 1
+            seq = []
+            while node > 0:
+                parent = (node - 1) // 2
+                seq.append((parent, 1.0 if node == 2 * parent + 2
+                            else 0.0))
+                node = parent
+            seq.reverse()
+            lengths[cls] = len(seq)
+            for i, (p, c) in enumerate(seq):
+                paths[cls, i] = p
+                codes[cls, i] = c
+        self._paths = jnp.asarray(paths)
+        self._codes = jnp.asarray(codes)
+        self._lens = jnp.asarray(lengths)
+
+    def forward(self, input, label):
+        from ...core import dispatch
+        from ...ops._helpers import as_tensor
+        paths, codes, lens = self._paths, self._codes, self._lens
+
+        def f(x, lab, w, b):
+            lab = lab.reshape(-1)
+            pth = paths[lab]                   # [B, D]
+            cde = codes[lab]                   # [B, D]
+            msk = (jnp.arange(paths.shape[1])[None, :]
+                   < lens[lab][:, None]).astype(x.dtype)
+            logits = jnp.einsum("bf,bdf->bd", x, w[pth]) + b[pth]
+            # code 1 -> right child: sigmoid(logit); 0 -> 1-sigmoid
+            logp = -jnp.logaddexp(0.0, -logits) * cde \
+                   + -jnp.logaddexp(0.0, logits) * (1.0 - cde)
+            return -(logp * msk).sum(-1, keepdims=True)
+
+        return dispatch.apply(
+            "hsigmoid_loss", f,
+            (as_tensor(input), as_tensor(label), self.weight, self.bias))
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        self._keys = []
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def __setitem__(self, key, layer):
+        if key not in self._keys:
+            self._keys.append(key)
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        self._keys.remove(key)
+        delattr(self, key)
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __iter__(self):
+        return iter(self._keys)
+
+    def keys(self):
+        return list(self._keys)
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+    def items(self):
+        return [(k, self[k]) for k in self._keys]
+
+    def update(self, sublayers):
+        pairs = sublayers.items() if isinstance(sublayers, dict) \
+            else sublayers
+        for k, v in pairs:
+            self[k] = v
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, osize = self.args
+        return F.max_unpool2d(x, indices, k, stride=s, padding=p,
+                              output_size=osize)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, osize = self.args
+        x4 = ops.unsqueeze(x, 2)          # [N,C,1,L]
+        i4 = ops.unsqueeze(indices, 2)
+        o4 = None if osize is None else [1, osize[-1]]
+        out = F.max_unpool2d(
+            x4, i4, (1, k), stride=(1, s if s is not None else k),
+            padding=(0, p), output_size=o4)
+        return ops.squeeze(out, 2)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from ...ops._helpers import as_tensor
+        from ...core import dispatch
+
+        w = None if self.weight is None else as_tensor(self.weight)
+
+        def f(x, y, *rest):
+            logsig = -jnp.logaddexp(0.0, -x)
+            logsig_neg = -jnp.logaddexp(0.0, x)
+            per = -(y * logsig + (1 - y) * logsig_neg)
+            if rest:
+                per = per * rest[0]
+            return per.mean(-1)
+
+        args = (as_tensor(input), as_tensor(label)) + \
+            ((w,) if w is not None else ())
+        return _reduce_tensor(
+            dispatch.apply("multilabel_soft_margin", f, args),
+            self.reduction)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ...core import dispatch
+        from ...ops._helpers import as_tensor
+        p, eps, keep = self.p, self.eps, self.keepdim
+
+        def f(a, b):
+            d = a - b + eps
+            return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                           keepdims=keep) ** (1.0 / p)
+
+        return dispatch.apply("pairwise_distance", f,
+                              (as_tensor(x), as_tensor(y)))
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        assert data_format == "NCHW"
+        self.r = downscale_factor
+
+    def forward(self, x):
+        n, c, h, w = x.shape
+        r = self.r
+        x = ops.reshape(x, [n, c, h // r, r, w // r, r])
+        x = ops.transpose(x, [0, 1, 3, 5, 2, 4])
+        return ops.reshape(x, [n, c * r * r, h // r, w // r])
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] in train, mean
+    slope in eval."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        from ...core import dispatch, random as rng_mod
+        from ...ops._helpers import as_tensor
+        lower, upper = self.lower, self.upper
+        if self.training:
+            import jax
+            key = rng_mod.next_key()
+
+            def f(a):
+                slope = jax.random.uniform(key, a.shape, jnp.float32,
+                                           lower, upper).astype(a.dtype)
+                return jnp.where(a >= 0, a, a * slope)
+        else:
+            mean = (lower + upper) / 2.0
+
+            def f(a):
+                return jnp.where(a >= 0, a, a * mean)
+
+        return dispatch.apply("rrelu", f, (as_tensor(x),))
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from ...core import dispatch
+        from ...ops._helpers import as_tensor
+
+        def f(x, y):
+            return jnp.logaddexp(0.0, -y * x)
+
+        return _reduce_tensor(
+            dispatch.apply("soft_margin", f,
+                           (as_tensor(input), as_tensor(label))),
+            self.reduction)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        from ...core import dispatch
+        from ...ops._helpers import as_tensor
+        th = self.threshold
+
+        def f(a):
+            return jnp.where(a > th, a, 0.0).astype(a.dtype)
+
+        return dispatch.apply("thresholded_relu", f, (as_tensor(x),))
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.dist = distance_function or PairwiseDistance(2.0)
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        d_pos = self.dist(input, positive)
+        d_neg = self.dist(input, negative)
+        if self.swap:
+            d_pn = self.dist(positive, negative)
+            d_neg = ops.minimum(d_neg, d_pn)
+        loss = ops.maximum(d_pos - d_neg + self.margin,
+                           ops.zeros_like(d_pos))
+        return _reduce_tensor(loss, self.reduction)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.sf, self.df = size, scale_factor, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.sf,
+                             mode="bilinear", align_corners=True,
+                             data_format=self.df)
+
+
+class UpsamplingNearest2D(UpsamplingBilinear2D):
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.sf,
+                             mode="nearest", data_format=self.df)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.df = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.df)

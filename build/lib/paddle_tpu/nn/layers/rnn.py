@@ -1,0 +1,333 @@
+"""Recurrent layers.
+
+Parity: `python/paddle/nn/layer/rnn.py` (SimpleRNN/LSTM/GRU + cells) over
+the reference's cuDNN rnn kernel (`paddle/phi/kernels/gpu/rnn_kernel.cu`).
+TPU-native: the whole time loop is ONE dispatched op built on `jax.lax.scan`
+— XLA compiles the recurrence; no per-step python dispatch, no cuDNN.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..layer_base import Layer
+from .. import initializer as I
+from ...core import dispatch
+from ...ops._helpers import as_tensor
+from ...ops import manipulation as manip
+from ...core.tensor import Tensor
+
+
+def _cell_step(mode, w_ih, w_hh, b_ih, b_hh, x_t, h, c=None):
+    if mode == "GRU":
+        # paddle gate order: update(z), reset(r), candidate(c)
+        xg = x_t @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+        hg = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        xz, xr, xc = jnp.split(xg, 3, axis=-1)
+        hz, hr, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        cand = jnp.tanh(xc + r * hc)
+        h_new = (1.0 - z) * cand + z * h
+        return h_new, None
+    gates = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    # SimpleRNN (tanh or relu)
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    return act(gates), None
+
+
+class RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, n_gates, mode,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._mode = mode
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [n_gates * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [n_gates * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [n_gates * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [n_gates * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = as_tensor(batch_ref).shape[batch_dim_idx]
+        from ...ops.creation import full
+        if self._mode == "LSTM":
+            return (full([batch, self.hidden_size], init_value, "float32"),
+                    full([batch, self.hidden_size], init_value, "float32"))
+        return full([batch, self.hidden_size], init_value, "float32")
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, "LSTM", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        args = [as_tensor(inputs), as_tensor(h), as_tensor(c),
+                self.weight_ih, self.weight_hh]
+        has_bias = self.bias_ih is not None
+        if has_bias:
+            args += [self.bias_ih, self.bias_hh]
+
+        def _fn(x, h0, c0, wih, whh, *bs):
+            bih, bhh = (bs[0], bs[1]) if bs else (None, None)
+            h1, c1 = _cell_step("LSTM", wih, whh, bih, bhh, x, h0, c0)
+            return h1, c1
+        h1, c1 = dispatch.apply("lstm_cell", _fn, tuple(args))
+        return h1, (h1, c1)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, "GRU", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = [as_tensor(inputs), as_tensor(states), self.weight_ih,
+                self.weight_hh]
+        has_bias = self.bias_ih is not None
+        if has_bias:
+            args += [self.bias_ih, self.bias_hh]
+
+        def _fn(x, h0, wih, whh, *bs):
+            bih, bhh = (bs[0], bs[1]) if bs else (None, None)
+            h1, _ = _cell_step("GRU", wih, whh, bih, bhh, x, h0)
+            return h1
+        h1 = dispatch.apply("gru_cell", _fn, tuple(args))
+        return h1, h1
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, 1, mode, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = [as_tensor(inputs), as_tensor(states), self.weight_ih,
+                self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        mode = self._mode
+
+        def _fn(x, h0, wih, whh, *bs):
+            bih, bhh = (bs[0], bs[1]) if bs else (None, None)
+            h1, _ = _cell_step(mode, wih, whh, bih, bhh, x, h0)
+            return h1
+        h1 = dispatch.apply("rnn_cell", _fn, tuple(args))
+        return h1, h1
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrence as one scan op."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        n_dir = 2 if self.bidirect else 1
+        n_gates = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._weights = []  # (wih, whh, bih, bhh) per (layer, dir)
+        for layer in range(num_layers):
+            for d in range(n_dir):
+                in_sz = input_size if layer == 0 else hidden_size * n_dir
+                wih = self.create_parameter([n_gates * hidden_size, in_sz],
+                                            weight_ih_attr,
+                                            default_initializer=u)
+                whh = self.create_parameter(
+                    [n_gates * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u)
+                bih = self.create_parameter([n_gates * hidden_size],
+                                            bias_ih_attr, is_bias=True,
+                                            default_initializer=u)
+                bhh = self.create_parameter([n_gates * hidden_size],
+                                            bias_hh_attr, is_bias=True,
+                                            default_initializer=u)
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter(f"weight_ih{sfx}", wih)
+                self.add_parameter(f"weight_hh{sfx}", whh)
+                self.add_parameter(f"bias_ih{sfx}", bih)
+                self.add_parameter(f"bias_hh{sfx}", bhh)
+                self._weights.append((wih, whh, bih, bhh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = as_tensor(inputs)
+        n_dir = 2 if self.bidirect else 1
+        time_major = self.time_major
+        mode = self.mode
+        num_layers = self.num_layers
+        hidden = self.hidden_size
+        batch = x.shape[0] if not time_major else x.shape[1]
+        is_lstm = mode == "LSTM"
+
+        from ...ops.creation import zeros
+        if initial_states is None:
+            h0 = zeros([num_layers * n_dir, batch, hidden], "float32")
+            c0 = zeros([num_layers * n_dir, batch, hidden], "float32")
+            initial_states = (h0, c0) if is_lstm else h0
+        if is_lstm:
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, None
+
+        flat_weights = [w for group in self._weights for w in group]
+        args = [x, as_tensor(h0)] + ([as_tensor(c0)] if is_lstm else []) \
+            + flat_weights
+        n_state = 2 if is_lstm else 1
+
+        def _fn(xa, h0a, *rest):
+            if is_lstm:
+                c0a, weights = rest[0], rest[1:]
+            else:
+                c0a, weights = None, rest
+            seq = xa if time_major else jnp.swapaxes(xa, 0, 1)  # [T,B,I]
+            out = seq
+            h_finals, c_finals = [], []
+            for layer in range(num_layers):
+                dir_outs = []
+                for d in range(n_dir):
+                    w_off = (layer * n_dir + d) * 4
+                    wih, whh, bih, bhh = weights[w_off:w_off + 4]
+                    idx = layer * n_dir + d
+                    h_init = h0a[idx]
+                    c_init = c0a[idx] if is_lstm else jnp.zeros_like(h_init)
+
+                    def step(carry, x_t, wih=wih, whh=whh, bih=bih, bhh=bhh):
+                        h, c = carry
+                        h1, c1 = _cell_step(mode, wih, whh, bih, bhh,
+                                            x_t, h, c)
+                        if c1 is None:
+                            c1 = c
+                        return (h1, c1), h1
+                    seq_d = jnp.flip(out, 0) if d == 1 else out
+                    (h_f, c_f), ys = jax.lax.scan(step, (h_init, c_init),
+                                                  seq_d)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    h_finals.append(h_f)
+                    c_finals.append(c_f)
+                out = jnp.concatenate(dir_outs, axis=-1) if n_dir == 2 \
+                    else dir_outs[0]
+            y = out if time_major else jnp.swapaxes(out, 0, 1)
+            h_all = jnp.stack(h_finals)
+            if is_lstm:
+                return y, h_all, jnp.stack(c_finals)
+            return y, h_all
+
+        outs = dispatch.apply(f"rnn_{mode.lower()}", _fn, tuple(args))
+        if is_lstm:
+            y, h_n, c_n = outs
+            return y, (h_n, c_n)
+        y, h_n = outs
+        return y, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class RNN(Layer):
+    """Wrapper running a cell over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = as_tensor(inputs)
+        steps = x.shape[0] if self.time_major else x.shape[1]
+        outputs = []
+        states = initial_states
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            x_t = x[t] if self.time_major else x[:, t]
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        y = manip.stack(outputs, axis=0 if self.time_major else 1)
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        y = manip.concat([y_fw, y_bw], axis=-1)
+        return y, (s_fw, s_bw)

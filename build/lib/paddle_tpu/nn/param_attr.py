@@ -1,0 +1,34 @@
+"""ParamAttr — parameter attribute bundle.
+
+Parity: `python/paddle/fluid/param_attr.py` (`ParamAttr`): name, initializer,
+learning_rate multiplier, regularizer, trainable, need_clip.
+"""
+from __future__ import annotations
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        """Normalise user input: None -> default, False -> no parameter,
+        str -> named, initializer -> wrapped."""
+        if attr is None:
+            return ParamAttr()
+        if attr is False:
+            return False
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # assume it's an initializer object
+        return ParamAttr(initializer=attr)

@@ -1,0 +1,103 @@
+"""paddle.nn.utils parity: weight_norm, vector<->parameters, clip helper."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor, Parameter
+from ... import ops
+
+
+def parameters_to_vector(parameters, name=None):
+    ts = [ops.reshape(p, [-1]) for p in parameters]
+    return ops.concat(ts, axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    vec = vec if isinstance(vec, Tensor) else Tensor(vec)
+    offset = 0
+    arr = vec.numpy()
+    for p in parameters:
+        n = p.size
+        p.set_value(arr[offset:offset + n].reshape(p.shape))
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(np.float32(0.0))
+    import jax.numpy as jnp
+    if norm_type == float("inf"):
+        total = max(float(jnp.max(jnp.abs(p.grad._data))) for p in params)
+    else:
+        total = float(sum(
+            jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32))
+                    ** norm_type) for p in params) ** (1.0 / norm_type))
+    if error_if_nonfinite and not np.isfinite(total):
+        raise RuntimeError("non-finite gradient norm")
+    scale = max_norm / (total + 1e-6)
+    if scale < 1.0:
+        for p in params:
+            p.grad._data = p.grad._data * scale
+    return Tensor(np.float32(total))
+
+
+class _WeightNormWrapper:
+    """weight_norm(layer): reparameterise weight = g * v / ||v|| via a
+    forward pre-hook (paddle.nn.utils.weight_norm parity)."""
+
+    def __init__(self, layer, name, dim):
+        self.name = name
+        self.dim = dim
+        w = getattr(layer, name)
+        axes = [i for i in range(w.ndim) if i != dim] if dim is not None \
+            else None
+        norm = np.sqrt((w.numpy() ** 2).sum(
+            axis=tuple(axes) if axes else None, keepdims=True))
+        g = Parameter(norm.astype(np.float32).reshape(-1)
+                      if dim is not None else norm.astype(np.float32))
+        v = Parameter(w.numpy())
+        layer.add_parameter(name + "_g", g)
+        layer.add_parameter(name + "_v", v)
+        # the original weight leaves the parameter registry (it is now a
+        # derived value recomputed each forward)
+        layer._parameters.pop(name, None)
+        self.axes = axes
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        vn = ops.sqrt(ops.sum(v * v,
+                              axis=self.axes if self.axes else None,
+                              keepdim=True)) if self.axes else \
+            ops.sqrt(ops.sum(v * v))
+        if self.dim is not None:
+            shape = [1] * v.ndim
+            shape[self.dim] = -1
+            gshaped = ops.reshape(g, shape)
+        else:
+            gshaped = g
+        w = v * (gshaped / (vn + 1e-12))
+        layer.__dict__[self.name] = w  # visible to forward
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    hook = _WeightNormWrapper(layer, name, dim)
+    layer.register_forward_pre_hook(hook)
+    # materialise once so the attribute exists before the first call
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    w = layer.__dict__.pop(name, None)
+    if w is not None:
+        layer.add_parameter(name, Parameter(w.numpy()))
+    for hid, hook in list(layer._forward_pre_hooks.items()):
+        if isinstance(hook, _WeightNormWrapper) and hook.name == name:
+            layer._forward_pre_hooks.pop(hid)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    return layer

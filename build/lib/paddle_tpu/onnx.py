@@ -1,0 +1,23 @@
+"""paddle.onnx shim — export goes through StableHLO instead.
+
+The reference exports via paddle2onnx (`python/paddle/onnx/export.py`).
+The TPU-native serving artifact is the StableHLO module written by
+`paddle_tpu.jit.save(layer, path, input_spec=...)`; ONNX conversion from
+StableHLO is an ecosystem tool concern, not a framework one.
+"""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    import os
+    import pickle
+
+    from . import jit
+    jit.save(layer, path, input_spec=input_spec)
+    artifact = path + ".stablehlo"
+    if not os.path.exists(artifact):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        raise RuntimeError(
+            "StableHLO export failed: "
+            f"{meta.get('export_error', 'no input_spec given')}")
+    return artifact

@@ -1,0 +1,49 @@
+"""paddle_tpu.ops — the functional op library (PHI-kernel-layer parity).
+
+Every op is a thin pure-jax function dispatched through
+`paddle_tpu.core.dispatch.apply`, which records eager autograd nodes. This
+package plays the role of the reference's PHI kernel library
+(`paddle/phi/kernels/`) + generated C++ API (`paddle/phi/api/`): XLA is the
+actual kernel backend.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .extras2 import *  # noqa: F401,F403
+
+from . import creation, math, logic, manipulation, linalg, random_ops  # noqa
+
+
+def _bind_tensor_methods():
+    """Attach op functions as Tensor methods (parity:
+    `python/paddle/tensor/__init__.py` method-patching of the pybind Tensor
+    via `math_op_patch.py` / monkey_patch_math_varbase)."""
+    import inspect
+    from ..core.tensor import Tensor
+
+    skip = {"to_tensor", "zeros", "ones", "full", "arange", "linspace",
+            "eye", "meshgrid", "rand", "randn", "randint", "randperm",
+            "uniform", "normal", "standard_normal", "empty", "einsum",
+            "assign"}
+    first_arg_names = {"x", "input", "arr", "tensor", "x1", "condition"}
+    for mod in (math, logic, manipulation, linalg, creation, random_ops):
+        for name, fn in vars(mod).items():
+            if name.startswith("_") or not callable(fn) or name in skip:
+                continue
+            if not inspect.isfunction(fn):
+                continue
+            try:
+                params = list(inspect.signature(fn).parameters)
+            except (TypeError, ValueError):
+                continue
+            if not params or params[0] not in first_arg_names:
+                continue
+            if hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, fn)
+
+
+_bind_tensor_methods()

@@ -1,0 +1,49 @@
+"""Shared helpers for the op library."""
+from __future__ import annotations
+
+import numbers
+
+import jax.numpy as jnp
+import numpy as np
+import jax
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+
+def as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x if dtype is None else x.astype(dtype)
+    return Tensor(x, dtype=dtype)
+
+
+def is_scalar(x) -> bool:
+    return isinstance(x, numbers.Number) or (
+        isinstance(x, np.ndarray) and x.ndim == 0
+    )
+
+
+def unary(name, fn, x, differentiable=True):
+    x = as_tensor(x)
+    return dispatch.apply(name, fn, (x,), differentiable=differentiable)
+
+
+def binary(name, jfn, x, y, differentiable=True):
+    """Elementwise binary with paddle-style scalar handling: python scalars
+    are closed over (no tape node, no device transfer)."""
+    if isinstance(x, Tensor) and is_scalar(y):
+        return dispatch.apply(name, lambda a: jfn(a, y), (x,),
+                              differentiable=differentiable)
+    if is_scalar(x) and isinstance(y, Tensor):
+        return dispatch.apply(name, lambda b: jfn(x, b), (y,),
+                              differentiable=differentiable)
+    x, y = as_tensor(x), as_tensor(y)
+    return dispatch.apply(name, jfn, (x, y), differentiable=differentiable)
+
+
+def norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)

@@ -1,0 +1,160 @@
+"""Tensor creation ops.
+
+Parity: `python/paddle/tensor/creation.py` (to_tensor, zeros, ones, full,
+arange, linspace, eye, tril/triu, meshgrid, assign, …) backed by PHI
+full/arange kernels (`paddle/phi/kernels/full_kernel.h`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, unary
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [shape]
+    return [int(s) for s in shape]
+
+
+def zeros(shape, dtype=None):
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    return Tensor(jnp.zeros(_shape_list(shape), dt))
+
+
+def ones(shape, dtype=None):
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    return Tensor(jnp.ones(_shape_list(shape), dt))
+
+
+def full(shape, fill_value, dtype=None):
+    dt = dtype_mod.convert_dtype(dtype)
+    if dt is None:
+        if isinstance(fill_value, bool):
+            dt = dtype_mod.bool_
+        elif isinstance(fill_value, int):
+            dt = dtype_mod.convert_dtype("int64")
+        else:
+            dt = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, dt))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.zeros(x._data.shape, dt))
+
+
+def ones_like(x, dtype=None):
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.ones(x._data.shape, dt))
+
+
+def full_like(x, fill_value, dtype=None):
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.full(x._data.shape, fill_value, dt))
+
+
+empty_like = zeros_like
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            v = v.item()
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    dt = dtype_mod.convert_dtype(dtype)
+    if dt is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dt = dtype_mod.convert_dtype("int64")
+        else:
+            dt = dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None):
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns),
+                          dtype=dt))
+
+
+def diag(x, offset=0, padding_value=0):
+    x = as_tensor(x)
+
+    def _fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return unary("diag", _fn, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return unary("diagonal",
+                 lambda a: jnp.diagonal(a, offset, axis1, axis2),
+                 as_tensor(x))
+
+
+def tril(x, diagonal=0):
+    return unary("tril", lambda a: jnp.tril(a, diagonal), as_tensor(x))
+
+
+def triu(x, diagonal=0):
+    return unary("triu", lambda a: jnp.triu(a, diagonal), as_tensor(x))
+
+
+def meshgrid(*args):
+    args = [as_tensor(a) for a in (args[0] if len(args) == 1 and
+            isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[a._data for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    x = as_tensor(x)
+    out = unary("assign", lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.number) else a, x)
+    if output is not None:
+        output._data = out._data
+        output._grad_node = out._grad_node
+        output._out_slot = out._out_slot
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def numel(x):
+    return Tensor(np.int64(as_tensor(x).size))

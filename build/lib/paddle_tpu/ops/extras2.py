@@ -1,0 +1,460 @@
+"""Second batch of tensor-namespace ops (round 2 coverage push).
+
+Parity: `python/paddle/tensor/{math,linalg,manipulation,search,attribute,
+creation}.py` — the listed functions match the reference signatures;
+kernels are jnp/lax compiled by XLA (SURVEY §3.1 TPU mapping).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, unary, binary, norm_axis
+
+
+# ----------------------------------------------------------- elementwise
+
+
+def lerp(x, y, weight, name=None):
+    from ..core import dispatch
+    x, y = as_tensor(x), as_tensor(y)
+    if isinstance(weight, Tensor):
+        # weight stays a dispatch input so it can carry gradient
+        return dispatch.apply("lerp", lambda a, b, w: a + w * (b - a),
+                              (x, y, weight))
+    return dispatch.apply("lerp", lambda a, b: a + weight * (b - a),
+                          (x, y))
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return unary("logit", f, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / mag)
+        return jnp.sign(a)
+    return unary("sgn", f, x)
+
+
+def gcd(x, y, name=None):
+    return binary("gcd", jnp.gcd, x, y, differentiable=False)
+
+
+def lcm(x, y, name=None):
+    return binary("lcm", jnp.lcm, x, y, differentiable=False)
+
+
+# ------------------------------------------------------------- nan-aware
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return unary("nansum",
+                 lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim,
+                                      dtype=dtype), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return unary("nanmean",
+                 lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return unary("nanmedian",
+                 lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return unary("nanquantile",
+                 lambda a: jnp.nanquantile(a, q, axis=ax,
+                                           keepdims=keepdim), x)
+
+
+# --------------------------------------------------------------- complex
+
+
+def complex(real_part, imag_part, name=None):  # noqa: A001
+    return binary("complex", jax.lax.complex, real_part, imag_part)
+
+
+def real(x, name=None):
+    return unary("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return unary("imag", jnp.imag, x)
+
+
+def conj(x, name=None):
+    return unary("conj", jnp.conj, x)
+
+
+def angle(x, name=None):
+    return unary("angle", jnp.angle, x)
+
+
+def as_complex(x, name=None):
+    """[..., 2] float -> complex."""
+    return unary("as_complex",
+                 lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    """complex -> [..., 2] float."""
+    return unary("as_real",
+                 lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x)
+
+
+def is_complex(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(as_tensor(x)._data.dtype, jnp.integer)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def rank(x):
+    return Tensor(np.asarray(as_tensor(x).ndim, np.int32))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---------------------------------------------------------------- linalg
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from ..core import dispatch
+    return dispatch.apply(
+        "addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+        (as_tensor(input), as_tensor(x), as_tensor(y)))
+
+
+def mv(x, vec, name=None):
+    return binary("mv", jnp.matmul, x, vec)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    from ..core import dispatch
+    return dispatch.apply(
+        "tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
+        (as_tensor(x), as_tensor(y)))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
+        name=None):
+    fw = None if fweights is None else as_tensor(fweights)._data
+    aw = None if aweights is None else as_tensor(aweights)._data
+    return unary("cov",
+                 lambda a: jnp.cov(a, rowvar=rowvar,
+                                   ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return unary("corrcoef",
+                 lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def eig(x, name=None):
+    """General eigendecomposition (CPU-backed in jax; the reference's eig
+    is CPU-only too)."""
+    a = as_tensor(x)._data
+    w, v = np.linalg.eig(np.asarray(a))
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    a = as_tensor(x)._data
+    return Tensor(np.linalg.eigvals(np.asarray(a)))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    from ..core import dispatch
+
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return dispatch.apply("cholesky_solve", f,
+                          (as_tensor(x), as_tensor(y)))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a = np.asarray(as_tensor(x)._data)
+    b = np.asarray(as_tensor(y)._data)
+    sol, res, rk, sv = np.linalg.lstsq(a, b, rcond=rcond)
+    return (Tensor(sol), Tensor(res if res.size else np.zeros(0)),
+            Tensor(np.asarray(rk)), Tensor(sv))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    lu = as_tensor(lu_data)._data
+    piv = np.asarray(as_tensor(lu_pivots)._data)
+    m, n = lu.shape[-2], lu.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+    U = jnp.triu(lu[..., :k, :])
+    # pivots (1-based sequential row swaps) -> permutation matrix,
+    # per batch element
+    batch_shape = lu.shape[:-2]
+    piv2 = piv.reshape(-1, piv.shape[-1]) if batch_shape \
+        else piv.reshape(1, -1)
+    Ps = []
+    for row in piv2:
+        perm = np.arange(m)
+        for i, p in enumerate(row[:k]):
+            j = int(p) - 1
+            perm[i], perm[j] = perm[j], perm[i]
+        Ps.append(np.eye(m, dtype=np.float32)[perm].T)
+    P = np.stack(Ps).reshape(tuple(batch_shape) + (m, m)) \
+        if batch_shape else Ps[0]
+    return Tensor(P), Tensor(L), Tensor(U)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        dims = [d for d in range(a.ndim) if d != axis]
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) \
+            ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                           1.0)
+        return a * factor
+    return unary("renorm", f, x)
+
+
+def cond_number(x, p=None, name=None):
+    """paddle.linalg.cond."""
+    return unary("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+# ------------------------------------------------------------ selection
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    from ..core import dispatch
+
+    def f(a):
+        # one sort yields both: values gathered through argsort
+        si = jnp.argsort(a, axis=axis)
+        i = jnp.take(si, k - 1, axis=axis)
+        v = jnp.take_along_axis(
+            a, jnp.expand_dims(i, axis % a.ndim), axis=axis)
+        v = v if keepdim else jnp.squeeze(v, axis)
+        return v, (jnp.expand_dims(i, axis) if keepdim else i)
+
+    return dispatch.apply("kthvalue", f, (as_tensor(x),))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value (+ its last index) along axis — host compute,
+    like the reference's CPU mode kernel."""
+    a = np.asarray(as_tensor(x)._data)
+    a2 = np.moveaxis(a, axis, -1)
+    flat = a2.reshape(-1, a2.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uv, counts = np.unique(row, return_counts=True)
+        m = uv[np.argmax(counts)]
+        vals[i] = m
+        idxs[i] = np.where(row == m)[0][-1]
+    out_shape = a2.shape[:-1]
+    v = vals.reshape(out_shape)
+    ix = idxs.reshape(out_shape)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        ix = np.expand_dims(ix, axis)
+    return Tensor(v), Tensor(ix)
+
+
+def take(x, index, mode="raise", name=None):
+    from ..core import dispatch
+    if mode not in ("raise", "clip", "wrap"):
+        raise ValueError(f"take: unknown mode {mode!r}")
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        idx = i.reshape(-1)
+        if mode == "raise":
+            # python-style negative indexing (XLA can't raise on
+            # out-of-range; clip after normalising, like the kernel)
+            idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+            return jnp.take(flat, idx, mode="clip").reshape(i.shape)
+        return jnp.take(flat, idx, mode=mode).reshape(i.shape)
+
+    return dispatch.apply("take", f, (as_tensor(x), as_tensor(index)))
+
+
+def index_add(x, index, axis, value, name=None):
+    from ..core import dispatch
+
+    def impl(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return dispatch.apply("index_add", impl,
+                          (as_tensor(x), as_tensor(index),
+                           as_tensor(value)))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors: out[i] =
+    inputs[index[i]][i]."""
+    from ..core import dispatch
+    ts = [as_tensor(t) for t in inputs]
+
+    def f(idx, *arrs):
+        stacked = jnp.stack(arrs, axis=0)  # [n_cands, batch, ...]
+        sel = idx.reshape(-1).astype(jnp.int32)
+        batch = jnp.arange(stacked.shape[1])
+        return stacked[sel, batch]
+
+    return dispatch.apply("multiplex", f, (as_tensor(index), *ts))
+
+
+# ---------------------------------------------------------- manipulation
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    xt = as_tensor(x)
+    offs = [0] * xt.ndim if offsets is None else \
+        [int(o) for o in (offsets.tolist()
+                          if isinstance(offsets, Tensor) else offsets)]
+    if shape is None:
+        shp = [-1] * xt.ndim
+    else:
+        shp = [int(s) for s in (shape.tolist()
+                                if isinstance(shape, Tensor) else shape)]
+    # -1 means "to the end": dims[i] - offsets[i] (reference semantics)
+    shp = [xt.shape[i] - offs[i] if s == -1 else s
+           for i, s in enumerate(shp)]
+
+    def f(a):
+        return jax.lax.dynamic_slice(a, offs, shp)
+
+    return unary("crop", f, xt)
+
+
+def diagflat(x, offset=0, name=None):
+    return unary("diagflat",
+                 lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    from ..core import dispatch
+
+    def f(a, b):
+        assert a.ndim == 2 and dim1 == 0 and dim2 == 1 and offset == 0, \
+            "fill_diagonal_tensor: 2-D main diagonal supported"
+        n = min(a.shape[0], a.shape[1])
+        idx = jnp.arange(n)
+        return a.at[idx, idx].set(b[:n])
+
+    return dispatch.apply("fill_diagonal_tensor", f,
+                          (as_tensor(x), as_tensor(y)))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    xt = as_tensor(x)
+    n = num if num is not None else xt.shape[axis]
+    outs = []
+    for i in range(n):
+        outs.append(unary(
+            "unstack", lambda a, i=i: jnp.take(a, i, axis=axis), xt))
+    return outs
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(np.int64))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(np.int64))
+
+
+# ------------------------------------------------------------- creation
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    from ..core import dtype as dtype_mod
+    dt = dtype_mod.convert_dtype(dtype) if dtype else jnp.float32
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=float(base), dtype=dt))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    from ..core import random as rng_mod
+    from ..core import dtype as dtype_mod
+    dt = dtype_mod.convert_dtype(dtype) if dtype else jnp.float32
+    # seed=0 draws from the global stream (paddle convention); a nonzero
+    # seed must be reproducible across calls
+    key = jax.random.PRNGKey(seed) if seed else rng_mod.next_key()
+    return Tensor(mean + std * jax.random.normal(key, tuple(shape), dt))
+
+
+# ------------------------------------------------------- tensor array
+
+
+class LoDTensorArray(list):
+    """create_array/array_read/array_write capability: a python list of
+    Tensors (the reference's TensorArray is exactly a vector of
+    LoDTensors; under jit, writes at traced indices belong in lax.scan —
+    this is the eager/legacy surface)."""
+
+
+def create_array(dtype="float32", initialized_list=None):
+    return LoDTensorArray(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    i = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    if array is None:
+        array = LoDTensorArray()
+    while len(array) <= i:
+        array.append(None)
+    array[i] = as_tensor(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    return array[i]
+
+
+def array_length(array):
+    return Tensor(np.asarray(len(array), np.int64))

@@ -1,0 +1,244 @@
+"""Linear algebra ops.
+
+Parity: `python/paddle/tensor/linalg.py` over PHI matmul
+(`paddle/phi/kernels/impl/matmul_kernel_impl.h:489` → cuBLAS) and
+`paddle/phi/kernels/funcs/blas/`. On TPU, matmul lowers to MXU dot_general;
+AMP (`paddle/fluid/imperative/amp_auto_cast.cc` white list) is applied here
+at the op boundary with bfloat16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, unary, binary
+
+
+def _amp_cast2(x, y):
+    """AMP casts for matmul-class ops (white list in
+    `imperative/amp_auto_cast.cc`):
+    - O1 auto_cast: fp32 inputs -> the amp dtype (bf16)
+    - O2 decorate: weights already low-precision; harmonize a fp32 input
+      to the weight dtype so decorated layers accept fp32 pipelines."""
+    from ..amp.auto_cast import _amp_enabled, _amp_level, _amp_dtype
+    if _amp_enabled() and _amp_level() == "O1":
+        dt = _amp_dtype()
+        if x.dtype == jnp.float32:
+            x = x.astype(dt)
+        if y.dtype == jnp.float32:
+            y = y.astype(dt)
+    if x.dtype != y.dtype and jnp.issubdtype(x.dtype, jnp.floating) \
+            and jnp.issubdtype(y.dtype, jnp.floating):
+        # cast toward the lower-precision side (the decorated weight)
+        if jnp.finfo(x.dtype).bits > jnp.finfo(y.dtype).bits:
+            x = x.astype(y.dtype)
+        else:
+            y = y.astype(x.dtype)
+    return x, y
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    x, y = _amp_cast2(x, y)
+
+    def _fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return dispatch.apply("matmul", _fn, (x, y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def _fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return dispatch.apply("dot", _fn, (x, y))
+
+
+def t(x, name=None):
+    x = as_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2")
+    return unary("t", lambda a: a.T, x)
+
+
+def matmul_fp32(x, y, transpose_x=False, transpose_y=False):
+    """Non-AMP matmul used internally (e.g. loss heads)."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def _fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return dispatch.apply("matmul", _fn, (x, y))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if axis is None and p in ("fro", 2, 2.0):
+        return unary("norm", lambda a: jnp.sqrt(jnp.sum(a * a)), x)
+    if p == "fro":
+        p = 2
+
+    def _fn(a):
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis,
+                       keepdims=keepdim) ** (1.0 / p)
+    return unary("p_norm", _fn, x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(binary("sub", jnp.subtract, x, y), p=float(p))
+
+
+def einsum(equation, *operands):
+    ts = [as_tensor(o) for o in operands]
+    return dispatch.apply(
+        "einsum", lambda *arrs: jnp.einsum(equation, *arrs), tuple(ts))
+
+
+def transpose_last2(a):
+    return jnp.swapaxes(a, -1, -2)
+
+
+def cholesky(x, upper=False, name=None):
+    def _fn(a):
+        L = jnp.linalg.cholesky(a)
+        return transpose_last2(L) if upper else L
+    return unary("cholesky", _fn, as_tensor(x))
+
+
+def inverse(x, name=None):
+    return unary("inverse", jnp.linalg.inv, as_tensor(x))
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary("pinv",
+                 lambda a: jnp.linalg.pinv(a, rcond=rcond,
+                                           hermitian=hermitian),
+                 as_tensor(x))
+
+
+def det(x, name=None):
+    return unary("det", jnp.linalg.det, as_tensor(x))
+
+
+def slogdet(x, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return unary("slogdet", _fn, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
+    return dispatch.apply("svd", _fn, (x,))
+
+
+def qr(x, mode="reduced", name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        return jnp.linalg.qr(a, mode=mode)
+    return dispatch.apply("qr", _fn, (x,))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+        return w, v
+    return dispatch.apply("eigh", _fn, (x,))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return unary("eigvalsh",
+                 lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), as_tensor(x))
+
+
+def solve(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return dispatch.apply("solve", jnp.linalg.solve, (x, y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def _fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return dispatch.apply("triangular_solve", _fn, (x, y))
+
+
+def matrix_power(x, n, name=None):
+    return unary("matrix_power",
+                 lambda a: jnp.linalg.matrix_power(a, n), as_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._data, tol=tol))
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    ax = axis if axis != 9 else -1
+
+    def _fn(a, b):
+        return jnp.cross(a, b, axis=ax)
+    return dispatch.apply("cross", _fn, (x, y))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = as_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    if get_infos:
+        return (Tensor(lu_), Tensor(piv.astype(jnp.int32)),
+                Tensor(jnp.zeros((), jnp.int32)))
+    return Tensor(lu_), Tensor(piv.astype(jnp.int32))
+
+
+def multi_dot(tensors, name=None):
+    ts = [as_tensor(t) for t in tensors]
+    return dispatch.apply(
+        "multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(ts))
+
+
+# round-2 additions living in extras2 but belonging to paddle.linalg
+from .extras2 import (  # noqa: F401,E402
+    cholesky_solve, corrcoef, cov, eig, eigvals, lstsq, lu_unpack,
+    cond_number as cond,
+)

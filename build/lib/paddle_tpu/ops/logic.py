@@ -1,0 +1,67 @@
+"""Comparison / logical / bitwise ops.
+
+Parity: `python/paddle/tensor/logic.py` over PHI compare kernels
+(`paddle/phi/kernels/compare_kernel.h`, `logical_kernel.h`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import as_tensor, binary
+from ..core.tensor import Tensor
+
+
+def _cmp(name, jfn):
+    def op(x, y, name=None, _n=name, _f=jfn):
+        return binary(_n, _f, x, y, differentiable=False)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", lambda a, b: a & b)
+bitwise_or = _cmp("bitwise_or", lambda a, b: a | b)
+bitwise_xor = _cmp("bitwise_xor", lambda a, b: a ^ b)
+
+
+def logical_not(x, name=None):
+    from ._helpers import unary
+    return unary("logical_not", jnp.logical_not, as_tensor(x),
+                 differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    from ._helpers import unary
+    return unary("bitwise_not", jnp.invert, as_tensor(x),
+                 differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary("isclose",
+                  lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                  x, y, differentiable=False)
+
+
+def is_empty(x, name=None):
+    return Tensor(as_tensor(x).size == 0)

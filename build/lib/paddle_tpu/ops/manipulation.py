@@ -1,0 +1,591 @@
+"""Shape / layout / gather-scatter manipulation ops.
+
+Parity: `python/paddle/tensor/manipulation.py` over PHI kernels
+(`paddle/phi/kernels/reshape_kernel.h`, `transpose_kernel.h`,
+`concat_kernel.h`, `gather_kernel.h`, `scatter_kernel.h`, …). All lower to
+XLA reshape/transpose/gather/scatter HLOs.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_py_slice = builtins.slice
+
+from ..core import dispatch
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, unary, norm_axis
+
+
+def cast(x, dtype):
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype)
+    if x.dtype == dt:
+        return x
+    return unary("cast", lambda a: a.astype(dt), x)
+
+
+def reshape(x, shape, name=None):
+    x = as_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s) for s in shape]
+    return unary("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+
+    def _fn(a):
+        new_shape = (list(a.shape[:sa]) + [-1] + list(a.shape[ea + 1:]))
+        return jnp.reshape(a, new_shape)
+    return unary("flatten", _fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+
+    def _fn(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        axes = tuple(a_ % a.ndim for a_ in axes)
+        axes = tuple(i for i in axes if a.shape[i] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return unary("squeeze", _fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    axes = ax if isinstance(ax, tuple) else (ax,)
+    return unary("unsqueeze", lambda a: jnp.expand_dims(a, axes), x)
+
+
+def transpose(x, perm, name=None):
+    x = as_tensor(x)
+    perm = [int(p) for p in perm]
+    return unary("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return unary("moveaxis",
+                 lambda a: jnp.moveaxis(a, source, destination), as_tensor(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return unary("swapaxes",
+                 lambda a: jnp.swapaxes(a, axis0, axis1), as_tensor(x))
+
+
+def concat(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return dispatch.apply(
+        "concat", lambda *arrs: jnp.concatenate(arrs, axis=axis), tuple(ts))
+
+
+def stack(x, axis=0, name=None):
+    ts = [as_tensor(t) for t in x]
+    return dispatch.apply(
+        "stack", lambda *arrs: jnp.stack(arrs, axis=axis), tuple(ts))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = dim - builtins.sum(s for s in sizes if s >= 0)
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def _fn(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, off, off + sz, axis=axis)
+            for off, sz in zip(offsets, sizes)
+        )
+    out = dispatch.apply("split", _fn, (x,))
+    return list(out)
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = as_tensor(x)
+    n = x.shape[axis]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+def tile(x, repeat_times, name=None):
+    x = as_tensor(x)
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = [int(r) for r in repeat_times]
+    return unary("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    tgt = [int(s) for s in shape]
+
+    def _fn(a):
+        shp = list(a.shape)
+        full = list(tgt)
+        # paddle: -1 means keep original dim
+        pad = len(full) - len(shp)
+        for i, s in enumerate(full):
+            if s == -1:
+                full[i] = shp[i - pad] if i >= pad else 1
+        return jnp.broadcast_to(a, full)
+    return unary("expand", _fn, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    out = dispatch.apply(
+        "broadcast_tensors",
+        lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), tuple(ts))
+    return list(out)
+
+
+def flip(x, axis, name=None):
+    ax = norm_axis(axis)
+    return unary("flip", lambda a: jnp.flip(a, axis=ax), as_tensor(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    ax = norm_axis(axis)
+    return unary("roll", lambda a: jnp.roll(a, shifts, axis=ax), as_tensor(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return unary("rot90", lambda a: jnp.rot90(a, k, axes), as_tensor(x))
+
+
+# ----------------------------------------------------------- gather family
+
+
+def gather(x, index, axis=0, name=None):
+    """paddle.gather: select rows of `axis` by 1-D index."""
+    x, index = as_tensor(x), as_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+
+    def _fn(a, idx):
+        return jnp.take(a, idx, axis=ax)
+    return dispatch.apply("gather", _fn, (x, index))
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def gather_nd(x, index, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def _fn(a, idx):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return dispatch.apply("gather_nd", _fn, (x, index))
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+
+    def _fn(a, idx):
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return dispatch.apply("take_along_axis", _fn, (arr, indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+    values = as_tensor(values, dtype=arr.dtype)
+
+    def _fn(a, idx, v):
+        v = jnp.broadcast_to(v, idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, v, axis=axis, inplace=False)
+        # add/multiply via scatter
+        dims = list(range(a.ndim))
+        idx_full = [jnp.broadcast_to(
+            jnp.arange(a.shape[d]).reshape(
+                [-1 if i == d else 1 for i in dims]), idx.shape)
+            for d in dims]
+        idx_full[axis] = idx
+        flat_idx = tuple(idx_full)
+        if reduce == "add":
+            return a.at[flat_idx].add(v)
+        if reduce in ("multiply", "mul"):
+            return a.at[flat_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return dispatch.apply("put_along_axis", _fn, (arr, indices, values))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """paddle.scatter: write rows of `updates` at `index` (1-D)."""
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def _fn(a, idx, upd):
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+    return dispatch.apply("scatter", _fn, (x, index, updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def _fn(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return dispatch.apply("scatter_nd_add", _fn, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = as_tensor(index), as_tensor(updates)
+    shape = [int(s) for s in (shape.tolist() if isinstance(shape, Tensor)
+                              else shape)]
+
+    def _fn(idx, upd):
+        zeros = jnp.zeros(shape, upd.dtype)
+        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return dispatch.apply("scatter_nd", _fn, (index, updates))
+
+
+def slice(x, axes, starts, ends, name=None):
+    x = as_tensor(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s)
+              for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def _fn(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = _py_slice(st, en)
+        return a[tuple(idx)]
+    return unary("slice", _fn, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = _py_slice(int(st), int(en), int(sd))
+        return a[tuple(idx)]
+    return unary("strided_slice", _fn, x)
+
+
+# -------------------------------------------------------------- searching
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    x, y = as_tensor(x), as_tensor(y)
+
+    def _fn(c, a, b):
+        return jnp.where(c, a, b)
+    return dispatch.apply("where", _fn, (condition, x, y))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = as_tensor(x)
+    idx = jnp.nonzero(x._data)  # dynamic shape: eager-only
+    if as_tuple:
+        return tuple(Tensor(i.reshape(-1, 1)) for i in idx)
+    return Tensor(jnp.stack(idx, axis=1).astype(dtype_mod.convert_dtype("int64")))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    return Tensor(x._data[mask._data])
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    v = float(value.item()) if isinstance(value, Tensor) else value
+
+    def _fn(a, m):
+        return jnp.where(m, jnp.asarray(v, a.dtype), a)
+    return dispatch.apply("masked_fill", _fn, (x, mask))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        out = jnp.sort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return unary("sort", _fn, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        out = jnp.argsort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out.astype(dtype_mod.convert_dtype("int64"))
+    return unary("argsort", _fn, x, differentiable=False)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _fn(a):
+        ax = axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(am, k)
+        else:
+            vals, idx = jax.lax.top_k(-am, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(dtype_mod.convert_dtype("int64")))
+    return dispatch.apply("topk", _fn, (x,))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    res = jnp.unique(x._data, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(repeats, Tensor):
+        repeats = repeats._data
+
+    def _fn(a):
+        return jnp.repeat(a, repeats, axis=axis)
+    return unary("repeat_interleave", _fn, x)
+
+
+def index_sample(x, index):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def _fn(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+    return dispatch.apply("index_sample", _fn, (x, index))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = as_tensor(input)
+    size = index_num // nshards
+
+    def _fn(a):
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+    return unary("shard_index", _fn, input, differentiable=False)
+
+
+# ------------------------------------------------------------- indexing
+
+
+def _conv_index(i):
+    if isinstance(i, Tensor):
+        return i._data
+    if isinstance(i, (list, np.ndarray)):
+        return jnp.asarray(i)
+    return i
+
+
+def getitem(x, idx):
+    x = as_tensor(x)
+    if isinstance(idx, tuple):
+        jidx = tuple(_conv_index(i) for i in idx)
+    else:
+        jidx = _conv_index(idx)
+    has_dyn = isinstance(jidx, jax.Array) and jidx.dtype == jnp.bool_ or (
+        isinstance(jidx, tuple)
+        and any(isinstance(i, jax.Array) and i.dtype == jnp.bool_
+                for i in jidx))
+    if has_dyn:
+        # boolean masks produce dynamic shapes: eager-only, no grad
+        return Tensor(x._data[jidx])
+    return unary("getitem", lambda a: a[jidx], x)
+
+
+def setitem(x, idx, value):
+    x = as_tensor(x)
+    value = as_tensor(value, dtype=x.dtype) if not np.isscalar(value) \
+        else value
+    if isinstance(idx, tuple):
+        jidx = tuple(_conv_index(i) for i in idx)
+    else:
+        jidx = _conv_index(idx)
+    if np.isscalar(value):
+        return unary("setitem", lambda a: a.at[jidx].set(value), x)
+
+    def _fn(a, v):
+        return a.at[jidx].set(v.astype(a.dtype))
+    return dispatch.apply("setitem", _fn, (x, value))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics (PHI pad kernels)."""
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    def _fn(a):
+        if len(pad) == 2 * nd:
+            # paddle "pad" op layout: per-dim (before, after), dim order 0..n
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to last dims (torch-style), respecting
+            # data_format for 3/4/5-D inputs
+            n_spec = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.startswith("N") and data_format.endswith("C"):
+                dims = list(range(1, 1 + n_spec))
+            else:
+                dims = list(range(nd - n_spec, nd))
+            for j, d in enumerate(reversed(dims)):
+                widths[d] = (pad[2 * j], pad[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return unary("pad", _fn, x)
+
+
+def shape(x):
+    return Tensor(np.array(as_tensor(x).shape, dtype=np.int32))
+
+
+def one_hot(x, num_classes, name=None):
+    x = as_tensor(x)
+    return unary("one_hot",
+                 lambda a: jax.nn.one_hot(a, num_classes,
+                                          dtype=jnp.float32), x,
+                 differentiable=False)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    sorted_sequence, values = as_tensor(sorted_sequence), as_tensor(values)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else dtype_mod.convert_dtype("int64")
+
+    def _fn(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(dt)
+        # batched: apply along last dim
+        return jax.vmap(
+            lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]),
+                v.reshape(-1, v.shape[-1])).reshape(v.shape).astype(dt)
+    return dispatch.apply("searchsorted", _fn,
+                          (sorted_sequence, values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = as_tensor(input)
+    arr = input._data
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo = float(jnp.min(arr))
+        hi = float(jnp.max(arr))
+    hist, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(dtype_mod.convert_dtype("int64")))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    arr = x._data
+    if arr.size and int(jnp.min(arr)) < 0:
+        raise ValueError("bincount requires non-negative inputs "
+                         "(reference semantics)")
+    n = builtins.max(int(jnp.max(arr)) + 1 if arr.size else 0,
+                     int(minlength))
+    w = as_tensor(weights)._data if weights is not None else None
+    return Tensor(jnp.bincount(arr, weights=w, length=n))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    idt = dtype_mod.convert_dtype(dtype)
+    arr = np.asarray(x.numpy())
+    if axis is None:
+        arr = arr.reshape(-1)
+    else:
+        arr = np.moveaxis(arr, int(axis), 0)
+    keep = np.ones(len(arr), bool)
+    keep[1:] = arr[1:] != arr[:-1] if arr.ndim == 1 else \
+        (arr[1:] != arr[:-1]).any(axis=tuple(range(1, arr.ndim)))
+    uniq = arr[keep]
+    if axis is not None:
+        uniq = np.moveaxis(uniq, 0, int(axis))
+    out = [Tensor(uniq)]
+    if return_inverse:
+        out.append(Tensor((np.cumsum(keep) - 1).astype(idt)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(arr)))
+        out.append(Tensor(counts.astype(idt)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError(
+        "as_strided has no XLA equivalent; use reshape/slice/gather")

@@ -1,0 +1,464 @@
+"""Elementwise + reduction math ops.
+
+Parity: `python/paddle/tensor/math.py` / `tensor/stat.py` over PHI kernels
+(`paddle/phi/kernels/elementwise_*`, `funcs/broadcast_function.h`,
+`funcs/reduce_function.h`). On TPU each op lowers to an XLA HLO that the
+compiler fuses; there is no hand-written kernel per op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, binary, unary, norm_axis
+
+# ---------------------------------------------------------------- binary
+
+
+def add(x, y, name=None):
+    return binary("add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return binary("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return binary("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return binary("divide", jnp.divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return binary("floor_divide", jnp.floor_divide, x, y,
+                  differentiable=False)
+
+
+def remainder(x, y, name=None):
+    return binary("remainder", jnp.remainder, x, y, differentiable=False)
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    return binary("pow", jnp.power, x, y)
+
+
+def atan2(x, y, name=None):
+    return binary("atan2", jnp.arctan2, x, y)
+
+
+def maximum(x, y, name=None):
+    return binary("maximum", jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return binary("minimum", jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return binary("fmax", jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return binary("fmin", jnp.fmin, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """PHI scale kernel parity (`paddle/phi/kernels/scale_kernel.h`)."""
+    s, b = scale, bias
+
+    def _fn(a):
+        if bias_after_scale:
+            return a * s + b
+        return (a + b) * s
+    out = unary("scale", _fn, as_tensor(x))
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def add_n(inputs, name=None):
+    """sum of a list of tensors (PHI add_n kernel)."""
+    ts = [as_tensor(t) for t in inputs]
+    from ..core import dispatch
+
+    def _fn(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return dispatch.apply("add_n", _fn, tuple(ts))
+
+
+# ----------------------------------------------------------------- unary
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "exp": jnp.exp, "expm1": jnp.expm1,
+    "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "square": jnp.square, "sin": jnp.sin, "cos": jnp.cos,
+    "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "lgamma": jax.scipy.special.gammaln, "digamma": jax.scipy.special.digamma,
+    "reciprocal": lambda a: 1.0 / a,
+    "rsqrt": jax.lax.rsqrt,
+    "neg": jnp.negative,
+}
+
+_UNARY_NODIFF = {
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "trunc": jnp.trunc, "isnan": jnp.isnan, "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite, "logical_not": jnp.logical_not,
+    "bitwise_not": jnp.invert,
+}
+
+
+def _make_unary(name, fn, diff):
+    def op(x, name=None, _f=fn, _n=name, _d=diff):
+        return unary(_n, _f, as_tensor(x), differentiable=_d)
+    op.__name__ = name
+    return op
+
+
+for _n, _f in _UNARY.items():
+    globals()[_n] = _make_unary(_n, _f, True)
+for _n, _f in _UNARY_NODIFF.items():
+    globals()[_n] = _make_unary(_n, _f, False)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = float(min.item()) if isinstance(min, Tensor) else min
+    hi = float(max.item()) if isinstance(max, Tensor) else max
+    return unary("clip", lambda a: jnp.clip(a, lo, hi), as_tensor(x))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), as_tensor(x))
+
+
+def sigmoid(x, name=None):
+    return unary("sigmoid", jax.nn.sigmoid, as_tensor(x))
+
+
+def increment(x, value=1.0, name=None):
+    out = unary("increment", lambda a: a + value, as_tensor(x))
+    return _rebind(x, out)
+
+
+# ------------------------------------------------------------- reductions
+
+
+def _reduce(name, jfn, x, axis=None, keepdim=False, dtype=None,
+            differentiable=True):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def _fn(a):
+        out = jfn(a, axis=ax, keepdims=keepdim)
+        if dt is not None:
+            out = out.astype(dt)
+        return out
+    return unary(name, _fn, x, differentiable=differentiable)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce("sum", jnp.sum, x, axis, keepdim, dtype)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("mean", jnp.mean, x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce("prod", jnp.prod, x, axis, keepdim, dtype)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("max", jnp.max, x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("min", jnp.min, x, axis, keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _reduce("all", jnp.all, x, axis, keepdim, differentiable=False)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _reduce("any", jnp.any, x, axis, keepdim, differentiable=False)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    dd = 1 if unbiased else 0
+    return unary("std", lambda a: jnp.std(a, axis=ax, ddof=dd,
+                                          keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    dd = 1 if unbiased else 0
+    return unary("var", lambda a: jnp.var(a, axis=ax, ddof=dd,
+                                          keepdims=keepdim), x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def _fn(a):
+        out = jnp.argmax(a, axis=ax, keepdims=keepdim) if ax is not None \
+            else jnp.argmax(a)
+        return out.astype(dt)
+    return unary("argmax", _fn, x, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def _fn(a):
+        out = jnp.argmin(a, axis=ax, keepdims=keepdim) if ax is not None \
+            else jnp.argmin(a)
+        return out.astype(dt)
+    return unary("argmin", _fn, x, differentiable=False)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def _fn(a):
+        if axis is None:
+            out = jnp.cumsum(a.reshape(-1))
+        else:
+            out = jnp.cumsum(a, axis=int(axis))
+        return out.astype(dt) if dt is not None else out
+    return unary("cumsum", _fn, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def _fn(a):
+        out = jnp.cumprod(a, axis=int(dim) if dim is not None else None)
+        return out.astype(dt) if dt is not None else out
+    return unary("cumprod", _fn, x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    return unary("logsumexp",
+                 lambda a: jax.scipy.special.logsumexp(a, axis=ax,
+                                                       keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    return unary("count_nonzero",
+                 lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                 x, differentiable=False)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary("trace",
+                 lambda a: jnp.trace(a, offset, axis1, axis2), as_tensor(x))
+
+
+def outer(x, y, name=None):
+    return binary("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def inner(x, y, name=None):
+    return binary("inner", jnp.inner, x, y)
+
+
+def kron(x, y, name=None):
+    return binary("kron", jnp.kron, x, y)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    return unary("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
+                 x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = norm_axis(axis)
+    return unary("quantile",
+                 lambda a: jnp.quantile(a, q, axis=ax, keepdims=keepdim), x)
+
+
+def logaddexp(x, y, name=None):
+    return binary("logaddexp", jnp.logaddexp, x, y)
+
+
+def heaviside(x, y, name=None):
+    # differentiable: dx = 0 a.e., dy = 1 where x == 0 (reference grads)
+    return binary("heaviside", jnp.heaviside, x, y)
+
+
+def frac(x, name=None):
+    return unary("frac", lambda a: a - jnp.trunc(a), as_tensor(x))
+
+
+def deg2rad(x, name=None):
+    return unary("deg2rad", jnp.deg2rad, as_tensor(x))
+
+
+def rad2deg(x, name=None):
+    return unary("rad2deg", jnp.rad2deg, as_tensor(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    pre = as_tensor(prepend)._data if prepend is not None else None
+    app = as_tensor(append)._data if append is not None else None
+    return unary("diff",
+                 lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                    append=app), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        xs = as_tensor(x)
+        from ..core import dispatch as _dispatch
+        return _dispatch.apply(
+            "trapezoid",
+            lambda ya, xa: jnp.trapezoid(ya, xa, axis=axis), (y, xs))
+    return unary("trapezoid",
+                 lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def _fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.cumlogsumexp(a, axis=ax)
+    return unary("logcumsumexp", _fn, x)
+
+
+def _cum_extreme(name, scan_fn, x, axis, dtype):
+    """Shared cummax/cummin: ONE dispatch returning (values, indices)."""
+    x = as_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype)
+    from ..core import dispatch as _dispatch
+
+    def _fn(a):
+        ax = 0 if axis is None else axis
+        arr = a.reshape(-1) if axis is None else a
+        vals = scan_fn(arr, axis=ax)
+        changed = arr == vals
+        idx = jnp.arange(arr.shape[ax])
+        shape = [1] * arr.ndim
+        shape[ax] = -1
+        idx = jnp.broadcast_to(idx.reshape(shape), arr.shape)
+        indices = jax.lax.cummax(jnp.where(changed, idx, 0),
+                                 axis=ax).astype(dt)
+        return vals, indices
+    return _dispatch.apply(name, _fn, (x,))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme("cummax", jax.lax.cummax, x, axis, dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme("cummin", jax.lax.cummin, x, axis, dtype)
+
+
+# ---------------------------------------------------- inplace variants
+# Parity: paddle's `op_` inplace APIs. TPU-native: functional compute +
+# wrapper rebind (version-counter semantics: the wrapper adopts the new
+# value/grad node; aliasing views are not mutated).
+
+
+def _rebind(x, out):
+    x._data = out._data
+    if out._grad_node is not None:
+        x._grad_node, x._out_slot = out._grad_node, out._out_slot
+    else:
+        x._grad_node, x._out_slot = None, 0
+    # NOTE: x.stop_gradient is preserved (paddle semantics — an in-place
+    # op under no_grad, or zero_/fill_, must not freeze a trainable
+    # tensor)
+    return x
+
+
+def add_(x, y, name=None):
+    return _rebind(x, add(x, y))
+
+
+def subtract_(x, y, name=None):
+    return _rebind(x, subtract(x, y))
+
+
+def multiply_(x, y, name=None):
+    return _rebind(x, multiply(x, y))
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    _scale_fn = globals()["scale"]
+    return _rebind(x, _scale_fn(x, scale, bias, bias_after_scale))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return _rebind(x, clip(x, min, max))
+
+
+def exp_(x, name=None):
+    return _rebind(x, exp(x))  # noqa: F821
+
+
+def sqrt_(x, name=None):
+    return _rebind(x, sqrt(x))  # noqa: F821
+
+
+def tanh_(x, name=None):
+    return _rebind(x, tanh(x))  # noqa: F821
+
+
+def zero_(x, name=None):
+    from .creation import zeros_like
+    return _rebind(x, zeros_like(x))
+
+
+def fill_(x, value, name=None):
+    from .creation import full_like
+    return _rebind(x, full_like(x, value))

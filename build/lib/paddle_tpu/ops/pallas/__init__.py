@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the hand-written device kernels for ops where XLA
+fusion isn't enough (the reference's CUDA `paddle/phi/kernels/fusion/` +
+external flashattn equivalents)."""

@@ -1,0 +1,234 @@
+"""Flash attention as Pallas TPU kernels.
+
+Parity: the reference's FlashAttention integration
+(`paddle/phi/kernels/flash_attn_kernel.h`, `cmake/external/flashattn.cmake`,
+`python/paddle/nn/functional/flash_attention.py:142`) — re-implemented as
+TPU-native online-softmax kernels instead of the CUDA library.
+
+Two tiers:
+
+* `splash_mha` — the production path: jax's Pallas *splash attention*
+  kernel (fwd + fused dkv/dq backward, causal block-skipping), tuned
+  block sizes for v5e. Trace-measured 2.1x faster fwd+bwd than XLA's
+  fused attention at [32,16,1024,64] and the engine behind the GPT
+  training headline (see docs/gpt_perf_analysis.md). Falls back to
+  XLA's `jax.nn.dot_product_attention` off-TPU (the CPU test mesh) or
+  for shapes the kernel doesn't tile.
+* `flash_attention` — the hand-written educational fwd kernel kept for
+  the paddle [B, S, H, D] API surface; backward recomputes in XLA.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+# ---------------------------------------------------------------------------
+# splash attention (library Pallas kernel, fused backward) — production path
+# ---------------------------------------------------------------------------
+
+_SPLASH_CACHE = {}
+
+
+def _on_tpu_backend() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def splash_supported(seq_len: int, head_dim: int) -> bool:
+    """Static gate for the splash kernel: lane-aligned sequence and a
+    head_dim the kernel tiles without padding waste."""
+    return (_on_tpu_backend() and seq_len % 128 == 0
+            and head_dim % 64 == 0 and seq_len >= 128)
+
+
+def _splash_kernel(n_heads: int, seq_len: int, causal: bool):
+    """Build (and cache) a vmapped splash kernel for [B, H, S, D] inputs.
+
+    Block sizes: the largest power-of-two tile <= 1024 dividing S, with
+    the fused dkv backward — measured fastest on v5e at S=1024 (5.0
+    ms/layer fwd+bwd vs 10.6 for XLA's attention at [32,16,1024,64])."""
+    block = next(b for b in (1024, 512, 256, 128) if seq_len % b == 0)
+    key = (n_heads, seq_len, causal, block)
+    if key not in _SPLASH_CACHE:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk, splash_attention_mask as smask)
+        bs = sk.BlockSizes(
+            block_q=block, block_kv=block, block_kv_compute=block,
+            block_q_dkv=block, block_kv_dkv=block,
+            block_kv_dkv_compute=block,
+            use_fused_bwd_kernel=True)
+        m = (smask.CausalMask((seq_len, seq_len)) if causal
+             else smask.FullMask((seq_len, seq_len)))
+        mask = smask.MultiHeadMask([m] * n_heads)
+        _SPLASH_CACHE[key] = jax.vmap(
+            sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                               block_sizes=bs))
+    return _SPLASH_CACHE[key]
+
+
+def splash_mha(q, k, v, *, causal=True, scale=None):
+    """Multi-head self-attention on [B, H, S, D] tensors (q and k/v
+    must share S — causal alignment for a shorter decode-style q is a
+    different op; use the general masked path in
+    `nn.functional.scaled_dot_product_attention` for KV-cache decode).
+
+    TPU: splash Pallas kernel (fwd + fused backward). Off-TPU or for
+    non-tileable shapes: XLA's fused attention. Differentiable either
+    way."""
+    b, h, s, d = q.shape
+    if k.shape[2] != s or v.shape[2] != s:
+        raise ValueError(
+            f"splash_mha requires equal q/kv sequence lengths, got "
+            f"q S={s}, k S={k.shape[2]}, v S={v.shape[2]}")
+    if k.shape[1] != h or v.shape[1] != h:
+        raise ValueError(
+            f"splash_mha requires equal q/kv head counts (no GQA/MQA), "
+            f"got q H={h}, k H={k.shape[1]}, v H={v.shape[1]}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if splash_supported(s, d):
+        kern = _splash_kernel(h, s, causal)
+        return kern((q * scale).astype(q.dtype), k, v)
+    return jax.nn.dot_product_attention(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2), scale=scale,
+        is_causal=causal).transpose(0, 2, 1, 3)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                seq_len):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]; o_ref like q_ref
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q_idx = pl.program_id(1)
+    q = q_ref[0] * scale  # [bq, d]
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_start = q_idx * block_q
+    if causal:
+        num_k = jax.lax.div(q_start + block_q + block_k - 1, block_k)
+    else:
+        num_k = seq_len // block_k
+
+    def body(ki, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_start = ki * block_k
+        k = k_ref[0, pl.ds(k_start, block_k), :]   # [bk, d]
+        v = v_ref[0, pl.ds(k_start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc_prev * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    """q/k/v: [BH, S, D] -> [BH, S, D]."""
+    bh, s, d = q.shape
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_len=s)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+    )(q, k, v)
+
+
+def _xla_reference(q, k, v, scale, causal):
+    logits = jnp.einsum("bsd,btd->bst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bst,btd->bsd", p, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k):
+    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, res, g):
+    # recompute-based backward in XLA (fused well by the compiler)
+    q, k, v = res
+
+    def f(q, k, v):
+        return _xla_reference(q, k, v, scale, causal)
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q/k/v: [B, S, H, D] (paddle layout). bias unsupported -> caller
+    falls back to the XLA path."""
+    if bias is not None:
+        raise NotImplementedError("flash_attention kernel: bias "
+                                  "unsupported; use the XLA path")
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q != 0 or s % block_k != 0 or d % 128 != 0:
+        # grid/num_k floor-divide by the block size: a non-divisible seq
+        # would silently drop trailing queries/keys — refuse so the caller
+        # falls back to the XLA path
+        raise NotImplementedError(
+            f"flash_attention kernel needs seq divisible by block "
+            f"({block_q}/{block_k}) and head_dim%128==0 (got S={s}, D={d})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def to_bh(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
+                      bool(causal), block_q, block_k)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

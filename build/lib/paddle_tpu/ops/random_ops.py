@@ -1,0 +1,112 @@
+"""Random sampling ops over the stateful RNG facade.
+
+Parity: `python/paddle/tensor/random.py` over PHI distribution kernels
+(`paddle/phi/kernels/funcs/distribution_helper.h`, `gaussian_kernel.h`,
+`uniform_kernel.h`), with the reference's global `Generator`
+(`paddle/phi/core/generator.h`) replaced by split jax PRNG keys
+(core/random.py) so the same code works eagerly and under jit tracing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as rng
+from ..core.tensor import Tensor
+from ._helpers import as_tensor
+from .creation import _shape_list
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None):
+    return Tensor(jax.random.normal(rng.next_key(), _shape_list(shape),
+                                    _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean)._data if isinstance(mean, Tensor) else mean
+        s = as_tensor(std)._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape)
+        return Tensor(jax.random.normal(rng.next_key(), shp) * s + m)
+    shp = _shape_list(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(rng.next_key(), shp, _dt(dtype))
+                  * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(
+        rng.next_key(), _shape_list(shape), low, high,
+        dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    x = as_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64"):
+    return Tensor(jax.random.permutation(rng.next_key(), int(n)).astype(
+        dtype_mod.convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    x = as_tensor(x)
+    return Tensor(jax.random.permutation(rng.next_key(), x._data, axis=axis,
+                                         independent=False))
+
+
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    return Tensor(
+        jax.random.bernoulli(rng.next_key(), x._data).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.random.poisson(rng.next_key(), x._data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    probs = x._data / jnp.sum(x._data, axis=-1, keepdims=True)
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            rng.next_key(), logits, shape=(*logits.shape[:-1], num_samples)
+            if logits.ndim > 1 else (num_samples,), axis=-1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(rng.next_key(), logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(dtype_mod.convert_dtype("int64")))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = as_tensor(x)
+    x._data = jax.random.exponential(rng.next_key(), x._data.shape,
+                                     x._data.dtype) / lam
+    return x

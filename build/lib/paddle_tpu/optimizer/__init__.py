@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer — `python/paddle/optimizer/` parity."""
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta, Adamax, Lamb,
+)
+from . import lr  # noqa: F401

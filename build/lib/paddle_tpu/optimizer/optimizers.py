@@ -1,0 +1,254 @@
+"""Concrete optimizers.
+
+Parity: `python/paddle/optimizer/{sgd,momentum,adam,adamw,adagrad,rmsprop,
+adadelta,lamb,adamax}.py` over PHI optimizer kernels
+(`paddle/phi/kernels/gpu/adam_kernel.cu`, `momentum_kernel.h`,
+`lamb_kernel.h`, …). Each `_single_update` is the pure-functional form the
+fused jitted step maps over all parameters.
+
+Convention: non-AdamW optimizers apply weight decay as L2 regularisation
+added to the gradient (reference `paddle/fluid/regularizer.py` appended to
+grad); AdamW applies decoupled decay.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+def _l2(g, p, wd):
+    if wd:
+        return g + wd * p.astype(g.dtype)
+    return g
+
+
+class SGD(Optimizer):
+    def _single_update(self, p, g, accums, lr, t, wd):
+        g = _l2(g.astype(jnp.float32), p, wd)
+        return (p - lr * g).astype(p.dtype), accums
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _accumulator_specs(self, param):
+        return {"velocity": jnp.zeros(param._data.shape, jnp.float32)}
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        g = _l2(g.astype(jnp.float32), p, wd)
+        v = self._momentum * accums["velocity"] + g
+        if self._use_nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        return (p - lr * update).astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = float(beta1) if not hasattr(beta1, "item") else \
+            float(beta1.item())
+        self._beta2 = float(beta2) if not hasattr(beta2, "item") else \
+            float(beta2.item())
+        self._epsilon = epsilon
+
+    def _accumulator_specs(self, param):
+        return {"moment1": jnp.zeros(param._data.shape, jnp.float32),
+                "moment2": jnp.zeros(param._data.shape, jnp.float32)}
+
+    def _decoupled_wd(self):
+        return 0.0
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        dwd = self._decoupled_wd()
+        if not dwd:
+            gf = _l2(gf, p, wd)
+        m = b1 * accums["moment1"] + (1 - b1) * gf
+        v = b2 * accums["moment2"] + (1 - b2) * gf * gf
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        pf = p.astype(jnp.float32)
+        if dwd and wd:
+            pf = pf * (1.0 - lr * wd)
+        new_p = pf - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (`python/paddle/optimizer/adamw.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_wd(self):
+        return 1.0
+
+    def step(self):
+        if self._apply_decay_param_fun is not None and \
+                self._parameter_list is not None:
+            for p in self._parameter_list:
+                if not self._apply_decay_param_fun(p.name or ""):
+                    p.optimize_attr["weight_decay"] = 0.0
+        super().step()
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _accumulator_specs(self, param):
+        return {"moment": jnp.full(param._data.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        gf = _l2(g.astype(jnp.float32), p, wd)
+        moment = accums["moment"] + gf * gf
+        new_p = p.astype(jnp.float32) - lr * gf / (
+            jnp.sqrt(moment) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": moment}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _accumulator_specs(self, param):
+        shape = param._data.shape
+        specs = {"mean_square": jnp.zeros(shape, jnp.float32),
+                 "momentum_acc": jnp.zeros(shape, jnp.float32)}
+        if self._centered:
+            specs["mean_grad"] = jnp.zeros(shape, jnp.float32)
+        return specs
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        gf = _l2(g.astype(jnp.float32), p, wd)
+        ms = self._rho * accums["mean_square"] + (1 - self._rho) * gf * gf
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * accums["mean_grad"] + (1 - self._rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * accums["momentum_acc"] + lr * gf / denom
+        out["momentum_acc"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _accumulator_specs(self, param):
+        shape = param._data.shape
+        return {"avg_squared_grad": jnp.zeros(shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(shape, jnp.float32)}
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        gf = _l2(g.astype(jnp.float32), p, wd)
+        rho, eps = self._rho, self._epsilon
+        asg = rho * accums["avg_squared_grad"] + (1 - rho) * gf * gf
+        update = gf * jnp.sqrt(accums["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * accums["avg_squared_update"] + (1 - rho) * update ** 2
+        new_p = p.astype(jnp.float32) - lr * update
+        return new_p.astype(p.dtype), {"avg_squared_grad": asg,
+                                       "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _accumulator_specs(self, param):
+        shape = param._data.shape
+        return {"moment": jnp.zeros(shape, jnp.float32),
+                "inf_norm": jnp.zeros(shape, jnp.float32)}
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        gf = _l2(g.astype(jnp.float32), p, wd)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accums["moment"] + (1 - b1) * gf
+        u = jnp.maximum(b2 * accums["inf_norm"], jnp.abs(gf))
+        new_p = p.astype(jnp.float32) - (lr / (1 - b1 ** t)) * m / \
+            (u + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """LAMB (`python/paddle/optimizer/lamb.py`,
+    `paddle/phi/kernels/lamb_kernel.h`) — BERT-large batch scaling."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _accumulator_specs(self, param):
+        shape = param._data.shape
+        return {"moment1": jnp.zeros(shape, jnp.float32),
+                "moment2": jnp.zeros(shape, jnp.float32)}
+
+    def _single_update(self, p, g, accums, lr, t, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m = b1 * accums["moment1"] + (1 - b1) * gf
+        v = b2 * accums["moment2"] + (1 - b2) * gf * gf
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    def step(self):
+        if self._exclude_fn is not None and self._parameter_list is not None:
+            for p in self._parameter_list:
+                if self._exclude_fn(p):
+                    p.optimize_attr["weight_decay"] = 0.0
+        super().step()

@@ -1,0 +1,225 @@
+"""Auto-parallel cost model + parallel-strategy tuner.
+
+Parity: `python/paddle/distributed/auto_parallel/cost_model.py` (comp/comm
+cost graph simulation) and `auto_parallel/tuner/` (parallel-strategy
+search). TPU-native re-design: instead of simulating a serialized Program
+op-graph, the model prices a transformer-family training step analytically
+from the hardware roofline —
+
+  comp  = step FLOPs / (MXU peak x efficiency)
+  comm  = bytes moved per collective / ICI bandwidth  (ring allreduce =
+          2 (n-1)/n x bytes, all_gather/reduce_scatter = (n-1)/n x bytes)
+  pp    = bubble factor (pp-1)/(M + pp - 1) on the compute term
+  mem   = params + grads + optimizer state (/ zero shard factor)
+          + activations (/ pp mp, x remat factor); configs over the HBM
+          budget are infeasible
+
+and the tuner brute-force scores every (dp, mp, pp, zero, micro) mesh
+factorization — the search space is tiny (divisors of n_devices), so
+beam search is unnecessary on TPU pods.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """One TPU slice. Defaults are v5e-ish."""
+    n_devices: int = 8
+    peak_flops: float = 197e12       # bf16 per chip
+    hbm_bytes: float = 16e9
+    ici_bw: float = 9e10             # bytes/s per direction per link
+    dcn_bw: float = 2.5e10
+    mxu_efficiency: float = 0.4      # achievable fraction of peak
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Transformer-family training job description."""
+    n_layers: int
+    d_model: int
+    seq_len: int
+    vocab_size: int
+    d_ff: int = 0
+    global_batch: int = 32
+    param_bytes: int = 2             # bf16 params
+    grad_bytes: int = 4
+    opt_state_bytes: int = 8         # Adam m+v fp32... per param elem
+    master_bytes: int = 4            # fp32 master copy
+    act_bytes: int = 2
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.d_ff == 0:
+            self.d_ff = 4 * self.d_model
+
+    @property
+    def n_params(self) -> int:
+        d, L = self.d_model, self.n_layers
+        return (4 * d * d + 2 * d * self.d_ff) * L \
+            + self.vocab_size * d + self.seq_len * d
+
+    def step_flops(self) -> float:
+        """fwd+bwd (+recompute) matmul FLOPs for one global batch."""
+        toks = self.global_batch * self.seq_len
+        base = 6.0 * self.n_params * toks \
+            + 6.0 * self.n_layers * self.seq_len * self.d_model * toks
+        if self.remat:
+            base *= 4.0 / 3.0  # one extra forward
+        return base
+
+
+@dataclasses.dataclass
+class Strategy:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    micro_batches: int = 1
+    zero_stage: int = 0
+
+    def degree(self):
+        return self.dp * self.mp * self.pp
+
+    def as_hybrid_configs(self):
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_degree": 1,
+                "micro_batches": self.micro_batches,
+                "zero_stage": self.zero_stage}
+
+
+def _ring_allreduce_time(bytes_, n, bw):
+    if n <= 1 or bytes_ <= 0:
+        return 0.0
+    return 2.0 * (n - 1) / n * bytes_ / bw
+
+
+def _shard_xfer_time(bytes_, n, bw):
+    """all_gather or reduce_scatter of a full buffer over n ranks."""
+    if n <= 1 or bytes_ <= 0:
+        return 0.0
+    return (n - 1) / n * bytes_ / bw
+
+
+class CostModel:
+    """Analytic step-time + memory estimate for a (model, strategy) pair."""
+
+    def __init__(self, cluster: Optional[ClusterSpec] = None):
+        self.cluster = cluster or ClusterSpec()
+
+    # -------------------------------------------------------------- mem
+    def memory_per_device(self, m: ModelSpec, s: Strategy) -> float:
+        P = float(m.n_params)
+        # params + grads live sharded over mp and pp always
+        shard = s.mp * s.pp
+        p_bytes = P * m.param_bytes / shard
+        g_bytes = P * m.grad_bytes / shard
+        # optimizer state (+master weights): zero>=1 additionally shards
+        # over dp; zero>=2 shards grads; zero>=3 shards params too
+        opt_shard = shard * (s.dp if s.zero_stage >= 1 else 1)
+        o_bytes = P * (m.opt_state_bytes + m.master_bytes) / opt_shard
+        if s.zero_stage >= 2:
+            g_bytes /= s.dp
+        if s.zero_stage >= 3:
+            p_bytes /= s.dp  # params stored sharded between steps
+        # activations: batch split over dp, per-microbatch live set over
+        # pp stages; remat keeps ~1 residual per layer boundary
+        b_local = max(m.global_batch // (s.dp * s.micro_batches), 1)
+        act_per_layer = b_local * m.seq_len * m.d_model * m.act_bytes
+        layers_local = max(m.n_layers // s.pp, 1)
+        live_factor = 2.0 if m.remat else 14.0   # resid vs full act set
+        # gpipe keeps micro_batches in flight; 1f1b keeps <= pp
+        in_flight = min(s.micro_batches, s.pp)
+        a_bytes = act_per_layer * layers_local * live_factor * in_flight \
+            / max(s.mp, 1)
+        return p_bytes + g_bytes + o_bytes + a_bytes
+
+    # ------------------------------------------------------------- time
+    def step_time(self, m: ModelSpec, s: Strategy) -> float:
+        c = self.cluster
+        flops = m.step_flops() / s.degree()
+        comp = flops / (c.peak_flops * c.mxu_efficiency)
+        # pipeline bubble stretches compute
+        if s.pp > 1:
+            bubble = (s.pp - 1) / max(s.micro_batches + s.pp - 1, 1)
+            comp = comp / (1.0 - bubble)
+
+        P = float(m.n_params)
+        comm = 0.0
+        # dp grad sync: allreduce (zero=0) or RS+AG (zero>=1) of the
+        # mp/pp-local shard
+        g_local = P * m.grad_bytes / (s.mp * s.pp)
+        if s.zero_stage >= 1:
+            comm += 2.0 * _shard_xfer_time(g_local, s.dp, c.ici_bw)
+        else:
+            comm += _ring_allreduce_time(g_local, s.dp, c.ici_bw)
+        if s.zero_stage >= 3:
+            # params stored sharded: all-gather them for fwd AND for the
+            # recomputing bwd
+            p_local = P * m.param_bytes / (s.mp * s.pp)
+            comm += 2.0 * _shard_xfer_time(p_local, s.dp, c.ici_bw)
+        # mp: 2 allreduce fwd + 2 bwd per layer of [B_local, S, d] acts
+        if s.mp > 1:
+            b_local = max(m.global_batch // s.dp, 1)
+            act = b_local * m.seq_len * m.d_model * m.act_bytes
+            layers_local = max(m.n_layers // s.pp, 1)
+            comm += 4.0 * layers_local * _ring_allreduce_time(
+                act, s.mp, c.ici_bw)
+        # pp: p2p activation sends per microbatch tick (fwd+bwd)
+        if s.pp > 1:
+            b_micro = max(m.global_batch // (s.dp * s.micro_batches), 1)
+            act = b_micro * m.seq_len * m.d_model * m.act_bytes
+            comm += 2.0 * s.micro_batches * act / c.ici_bw
+        return comp + comm
+
+
+class StrategyTuner:
+    """Brute-force search over mesh factorizations (the reference tuner's
+    role, minus the Program rewriting — shardings here are GSPMD specs)."""
+
+    def __init__(self, cluster: Optional[ClusterSpec] = None):
+        self.cluster = cluster or ClusterSpec()
+        self.cost_model = CostModel(self.cluster)
+
+    def _factorizations(self, n):
+        for dp in range(1, n + 1):
+            if n % dp:
+                continue
+            rest = n // dp
+            for mp in range(1, rest + 1):
+                if rest % mp:
+                    continue
+                yield dp, mp, rest // mp
+
+    def search(self, model: ModelSpec, n_devices: Optional[int] = None,
+               top_k: int = 1):
+        n = n_devices or self.cluster.n_devices
+        scored = []
+        for dp, mp, pp in self._factorizations(n):
+            if model.n_layers % pp or model.global_batch % dp:
+                continue
+            micro_opts = {1} if pp == 1 else {
+                mb for mb in (pp, 2 * pp, 4 * pp)
+                if model.global_batch % (dp * mb) == 0}
+            for micro in sorted(micro_opts):
+                for zero in (0, 1, 2, 3):
+                    s = Strategy(dp=dp, mp=mp, pp=pp,
+                                 micro_batches=micro, zero_stage=zero)
+                    mem = self.cost_model.memory_per_device(model, s)
+                    if mem > self.cluster.hbm_bytes:
+                        continue
+                    t = self.cost_model.step_time(model, s)
+                    # prefer simpler configs on near-ties (zero adds
+                    # collectives; mp/pp add failure surface)
+                    tie_break = (zero, mp, pp)
+                    scored.append((t, tie_break, s, mem))
+        if not scored:
+            raise ValueError(
+                "no feasible parallel strategy: model does not fit "
+                f"{n} x {self.cluster.hbm_bytes / 1e9:.0f}GB devices")
+        scored.sort(key=lambda r: (r[0], r[1]))
+        if top_k == 1:
+            return scored[0][2]
+        return [r[2] for r in scored[:top_k]]

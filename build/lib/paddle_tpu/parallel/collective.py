@@ -1,0 +1,252 @@
+"""Eager collective API over sharded arrays.
+
+Parity: the ProcessGroup suite (`paddle/fluid/distributed/collective/
+ProcessGroup.h:53` — AllReduce :99, Broadcast :117, AllGather :199,
+AllToAll :234, Reduce, Scatter, Send/Recv) + python
+`paddle.distributed.all_reduce/...` (`python/paddle/distributed/
+communication/`).
+
+TPU-native: there is no NCCL; a "collective" over the dp world on one host
+is a `shard_map`-wrapped `jax.lax` collective compiled over ICI. The eager
+API here operates on REPLICATED host-visible Tensors: each rank slot of a
+sharded tensor is dim 0 of the array (the single-controller SPMD view).
+These functions exist for API parity and for the eager DataParallel path;
+the performance path fuses collectives inside jitted steps (pjit/GSPMD).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+from . import env as dist_env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Communication group = a named axis over a sub-mesh.
+
+    Parity: `paddle.distributed.collective.Group` /
+    `ProcessGroup` (gid, ranks)."""
+
+    def __init__(self, ranks=None, gid=0, name="dp"):
+        all_n = dist_env.get_world_size()
+        self.ranks = list(ranks) if ranks is not None else list(range(all_n))
+        self.nranks = len(self.ranks)
+        self.id = gid
+        self.name = name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+
+_default_group = None
+_group_counter = 0
+
+
+def _get_group(group):
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group()
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    global _group_counter
+    _group_counter += 1
+    return Group(ranks, _group_counter)
+
+
+def get_group(gid=0):
+    return _get_group(None)
+
+
+def _spmd(fn, x, n):
+    """Run fn over a length-n leading 'rank' axis with an axis name."""
+    mesh = dist_env.global_mesh({"r": n})
+    return jax.shard_map(fn, mesh=mesh, in_specs=P("r"), out_specs=P("r"))(x)
+
+
+# --------------------------------------------------------------------------
+# multi-process backend: when this is one of several jax processes
+# (jax.distributed initialised — the TestDistBase two-rank reality), the
+# eager API runs REAL cross-process collectives: each process contributes
+# its local tensor as one shard of a global array over a process mesh and
+# a jitted XLA collective (gloo on CPU, ICI/DCN on TPU) produces the
+# replicated result.
+# --------------------------------------------------------------------------
+
+
+def _multiproc():
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+_mp_mesh = None
+_mp_jit_cache = {}
+
+
+def _check_mp_group(group):
+    """Multi-process collectives run over the FULL process world; a
+    sub-group would silently compute over the wrong ranks."""
+    if group is not None and group.nranks != dist_env.get_world_size():
+        raise NotImplementedError(
+            "multi-process eager collectives support only the default "
+            f"(world) group; got a {group.nranks}-rank sub-group of "
+            f"{dist_env.get_world_size()}")
+
+
+def _process_mesh():
+    global _mp_mesh
+    if _mp_mesh is None:
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        n = jax.process_count()
+        # one device per process keeps rank == process (eager contract)
+        per = [None] * n
+        for d in devs:
+            if per[d.process_index] is None:
+                per[d.process_index] = d
+        _mp_mesh = Mesh(np.array(per), ("r",))
+    return _mp_mesh
+
+
+def _to_global(local_arr, mesh):
+    from jax.sharding import NamedSharding
+    shard = NamedSharding(mesh, P("r", *([None] * local_arr.ndim)))
+    return jax.make_array_from_process_local_data(
+        shard, np.asarray(local_arr)[None])
+
+
+def _mp_collect(local_arr, kind, src=0):
+    """Global [world, ...] array -> jitted collective -> replicated host
+    value (every process receives the full result). Executables are
+    memoized per (kind, src, shape, dtype) — a fresh jit per eager call
+    would retrace every time."""
+    from jax.sharding import NamedSharding
+    mesh = _process_mesh()
+    garr = _to_global(local_arr, mesh)
+    key = (kind, src, local_arr.shape, str(local_arr.dtype))
+    fn = _mp_jit_cache.get(key)
+    if fn is None:
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+               "prod": jnp.prod, "avg": jnp.mean}
+        if kind in red:
+            body = (lambda a, _r=red[kind]: _r(a, axis=0))
+        elif kind == "gather":
+            body = (lambda a: a)
+        elif kind == "bcast":
+            body = (lambda a: a[src])
+        else:
+            raise ValueError(kind)
+        fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+        _mp_jit_cache[key] = fn
+    return np.asarray(jax.device_get(fn(garr)))
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In the single-controller SPMD view, an eager all_reduce over the
+    device world is an identity on a replicated tensor; for tensors carrying
+    a per-rank leading axis it reduces that axis. This matches how the
+    eager DP path uses it (gradient reduction)."""
+    t = as_tensor(tensor)
+    g = _get_group(group)
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+           ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+           ReduceOp.AVG: jnp.mean}[op]
+    if _multiproc():
+        _check_mp_group(group)
+        out = _mp_collect(np.asarray(t.numpy()), op)
+        tensor_obj = tensor if isinstance(tensor, Tensor) else t
+        tensor_obj._data = jnp.asarray(out)
+        return tensor_obj
+    if g.nranks <= 1:
+        return t
+    if t.shape and t.shape[0] == g.nranks:
+        out = Tensor(red(t._data, axis=0))
+        tensor_obj = tensor if isinstance(tensor, Tensor) else t
+        tensor_obj._data = jnp.broadcast_to(
+            out._data[None], t._data.shape) if False else out._data
+        return out
+    return t
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    t = as_tensor(tensor)
+    g = _get_group(group)
+    if _multiproc():
+        _check_mp_group(group)
+        stacked = _mp_collect(np.asarray(t.numpy()), "gather")
+        for i in range(stacked.shape[0]):
+            tensor_list.append(Tensor(jnp.asarray(stacked[i])))
+        return tensor_list
+    for _ in range(g.nranks):
+        tensor_list.append(t)
+    return tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    t = as_tensor(tensor)
+    if _multiproc():
+        _check_mp_group(group)
+        out = _mp_collect(np.asarray(t.numpy()), "bcast", src=src)
+        tensor_obj = tensor if isinstance(tensor, Tensor) else t
+        tensor_obj._data = jnp.asarray(out)
+        return tensor_obj
+    return t
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        rank = dist_env.get_rank()
+        tensor.set_value(tensor_list[rank if rank < len(tensor_list) else 0])
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    for t in in_tensor_list:
+        out_tensor_list.append(as_tensor(t))
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes requires the multi-host "
+        "backend; within one host use pipeline_parallel (ppermute)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes requires the multi-host "
+        "backend; within one host use pipeline_parallel (ppermute)")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(as_tensor(tensor)._data)
+
+
+def split(x, num_or_sections, axis=0):
+    from ..ops.manipulation import split as _split
+    return _split(x, num_or_sections, axis)

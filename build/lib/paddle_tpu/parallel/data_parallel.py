@@ -1,0 +1,77 @@
+"""paddle.DataParallel — eager data parallelism.
+
+Parity: `python/paddle/fluid/dygraph/parallel.py:437` (`DataParallel`) +
+`EagerReducer` (`paddle/fluid/distributed/collective/reducer.h:88` —
+bucketed fused allreduce overlapping backward).
+
+TPU-native: under jax's single-controller SPMD there is one python process
+driving all chips, so "DataParallel" = shard the batch over the dp mesh
+axis and let grads reduce inside the compiled step (GSPMD inserts the
+psum; the EagerReducer's bucketing/overlap job is done by XLA's scheduler).
+This wrapper therefore: (1) marks the model as dp-replicated, (2) exposes
+the paddle API (scale_loss / apply_collective_grads no-ops that keep user
+code working), and (3) when used with the compiled trainers, triggers
+batch sharding via `shard_batch`.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer_base import Layer
+from ..core.tensor import Tensor
+from . import env as dist_env
+
+
+def shard_batch(arrays, mesh=None, axis="dp"):
+    """Place host batch arrays sharded over the dp mesh axis (dim 0)."""
+    mesh = mesh or dist_env.global_mesh()
+    out = []
+    for a in arrays:
+        arr = a._data if isinstance(a, Tensor) else np.asarray(a)
+        spec = P(axis, *([None] * (arr.ndim - 1)))
+        out.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+    return out
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.comm_buffer_size = comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
+        self._nranks = dist_env.get_world_size()
+        for p in layers.parameters():
+            p.is_distributed = False  # replicated over dp
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # grads average inside the compiled step (psum/mean over dp)
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+        return ctx()

@@ -1,0 +1,196 @@
+"""Elastic training manager.
+
+Parity: `python/paddle/distributed/fleet/elastic/manager.py:127`
+(`ElasticManager`: etcd registration :229, watch/scale callbacks :244,
+fault-tolerant restart via the launcher).
+
+TPU-native scope: within a slice, chip failure kills the whole SPMD
+program — elasticity happens at the JOB level: a watchdog restarts the
+training process and the program resumes from the latest (orbax) sharded
+checkpoint. This manager implements that restart loop with a file-based
+heartbeat/KV (no etcd in-image); the etcd transport can be slotted in via
+the same Store interface.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+
+class FileStore:
+    """KV + heartbeat store on a shared filesystem (etcd stand-in)."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, key, value):
+        # atomic write: a concurrent alive_nodes() reader must never see a
+        # truncated file; the dot prefix keeps in-flight temps out of the
+        # heartbeat_* directory listing
+        path = os.path.join(self.root, key)
+        tmp = os.path.join(self.root, f".{key}.tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, path)
+
+    def get(self, key, default=None):
+        p = os.path.join(self.root, key)
+        if not os.path.exists(p):
+            return default
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return default
+
+    def heartbeat(self, node_id):
+        self.put(f"heartbeat_{node_id}", {"ts": time.time()})
+
+    def alive_nodes(self, timeout=30.0):
+        now = time.time()
+        out = []
+        for f in os.listdir(self.root):
+            if f.startswith("heartbeat_") and ".tmp" not in f:
+                hb = self.get(f)
+                if hb and now - hb["ts"] < timeout:
+                    out.append(f[len("heartbeat_"):])
+        return sorted(out)
+
+
+class KVMasterServer:
+    """TCP KV master (the launcher master.py HTTP/etcd-server role): a
+    json-line protocol over one listening socket. Second Store transport
+    proving the FileStore seam is real."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        import socketserver
+        import threading
+
+        kv = {}
+        lock = threading.Lock()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                    except json.JSONDecodeError:
+                        break
+                    with lock:
+                        if req["op"] == "put":
+                            kv[req["key"]] = req["value"]
+                            resp = {"ok": True}
+                        elif req["op"] == "get":
+                            resp = {"ok": True,
+                                    "value": kv.get(req["key"])}
+                        elif req["op"] == "list":
+                            pfx = req.get("prefix", "")
+                            resp = {"ok": True,
+                                    "items": {k: v for k, v in kv.items()
+                                              if k.startswith(pfx)}}
+                        else:
+                            resp = {"ok": False}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+
+
+class TcpStore:
+    """Store client with the same interface as FileStore, over a
+    KVMasterServer (PADDLE_ELASTIC_STORE=tcp://host:port)."""
+
+    def __init__(self, host, port):
+        import socket
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=30)
+        self._rfile = self._sock.makefile("r")
+
+    def _call(self, req):
+        self._sock.sendall((json.dumps(req) + "\n").encode())
+        return json.loads(self._rfile.readline())
+
+    def put(self, key, value):
+        self._call({"op": "put", "key": key, "value": value})
+
+    def get(self, key, default=None):
+        resp = self._call({"op": "get", "key": key})
+        v = resp.get("value")
+        return default if v is None else v
+
+    def heartbeat(self, node_id):
+        self.put(f"heartbeat_{node_id}", {"ts": time.time()})
+
+    def alive_nodes(self, timeout=30.0):
+        now = time.time()
+        items = self._call({"op": "list",
+                            "prefix": "heartbeat_"}).get("items", {})
+        return sorted(k[len("heartbeat_"):] for k, v in items.items()
+                      if v and now - v["ts"] < timeout)
+
+
+def make_store(spec):
+    """'tcp://host:port' -> TcpStore; anything else -> FileStore root."""
+    if spec.startswith("tcp://"):
+        host, port = spec[len("tcp://"):].rsplit(":", 1)
+        return TcpStore(host, port)
+    return FileStore(spec)
+
+
+class ElasticManager:
+    def __init__(self, args=None, store_root=None, max_restarts=3,
+                 heartbeat_interval=5.0):
+        self.store = make_store(store_root or
+                                os.environ.get("PADDLE_ELASTIC_STORE",
+                                               "/tmp/paddle_tpu_elastic"))
+        self.max_restarts = max_restarts
+        self.heartbeat_interval = heartbeat_interval
+        self.node_id = os.environ.get("PADDLE_NODE_RANK", "0")
+        self.restarts = 0
+
+    def register(self):
+        """manager.py:229 parity: announce this node."""
+        self.store.heartbeat(self.node_id)
+        self.store.put(f"node_{self.node_id}",
+                       {"pid": os.getpid(), "restarts": self.restarts})
+
+    def watch(self):
+        return self.store.alive_nodes(timeout=self.heartbeat_interval * 4)
+
+    def run(self, cmd):
+        """Supervise `cmd` (the training script); restart on failure up to
+        max_restarts (the launcher watchdog capability)."""
+        while True:
+            self.register()
+            proc = subprocess.Popen(cmd)
+            while proc.poll() is None:
+                self.store.heartbeat(self.node_id)
+                time.sleep(self.heartbeat_interval)
+            if proc.returncode == 0:
+                return 0
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                return proc.returncode
+            sys.stderr.write(
+                f"[elastic] training exited {proc.returncode}; "
+                f"restart {self.restarts}/{self.max_restarts}\n")

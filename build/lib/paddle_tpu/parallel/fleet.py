@@ -1,0 +1,130 @@
+"""fleet — the distributed facade.
+
+Parity: `python/paddle/distributed/fleet/fleet.py:107` (`Fleet`: init,
+distributed_model, distributed_optimizer, worker/server lifecycle) +
+role_maker env parsing (`fleet/base/role_maker.py`).
+
+TPU-native: `fleet.init` builds the hybrid topology/mesh; `distributed_model`
+wraps per the parallel mode (DataParallel now; PipelineParallel in
+parallel/pipeline.py); `distributed_optimizer` returns a
+HybridParallelOptimizer that folds dp-grad reduction/sharding into the
+compiled step. PS mode (init_server/init_worker) binds to the native PS
+engine (paddle_tpu/ps).
+"""
+from __future__ import annotations
+
+from . import env as dist_env
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       set_hybrid_communicate_group)
+from .data_parallel import DataParallel
+
+
+class _RoleMakerStub:
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_collective = True
+        self._role_maker = None
+        self._user_defined_optimizer = None
+
+    # ------------------------------------------------------------- init
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        self._is_collective = is_collective or role_maker is None
+        self._role_maker = role_maker or _RoleMakerStub(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        world = dist_env.get_world_size()
+        dp = hc.get("dp_degree", 1)
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sh = hc.get("sharding_degree", 1)
+        if dp * mp * pp * sh < world and dp == 1 and mp == 1 and pp == 1:
+            dp = world // (mp * pp * sh)
+            hc["dp_degree"] = dp
+        topo = CommunicateTopology(dims=(dp, pp, sh, mp))
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        dist_env.init_parallel_env()
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_num(self):
+        return dist_env.get_world_size()
+
+    def worker_index(self):
+        return dist_env.get_rank()
+
+    def is_first_worker(self):
+        return dist_env.get_rank() == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        dist_env.barrier()
+
+    # ------------------------------------------------------ distributed
+    def distributed_model(self, model):
+        if self._hcg is None:
+            self.init(is_collective=True)
+        mode = self._hcg.get_parallel_mode()
+        if mode == "data_parallel":
+            return DataParallel(model)
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            from .pipeline import PipelineParallel
+            return PipelineParallel(model, self._hcg, self._strategy)
+        from .mp_layers import TensorParallel
+        return TensorParallel(model, self._hcg, self._strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        from .hybrid_optimizer import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    # --------------------------------------------------------------- PS
+    def init_worker(self, scopes=None):
+        from ..ps.runtime import get_ps_runtime
+        get_ps_runtime().init_worker()
+
+    def init_server(self, *args, **kwargs):
+        from ..ps.runtime import get_ps_runtime
+        get_ps_runtime().init_server()
+
+    def run_server(self):
+        from ..ps.runtime import get_ps_runtime
+        get_ps_runtime().run_server()
+
+    def stop_worker(self):
+        from ..ps.runtime import get_ps_runtime
+        get_ps_runtime().stop_worker()
+
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        from ..ps.runtime import get_ps_runtime
+        get_ps_runtime().save_persistables(dirname)
+
+    # ------------------------------------------------------------- misc
+    def all_reduce(self, input, mode="sum"):
+        from .collective import all_reduce as ar
+        return ar(input)
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer

@@ -1,0 +1,40 @@
+"""HybridParallelOptimizer.
+
+Parity: `python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:172` — wraps the user optimizer; in the
+reference it fuses DP grad allreduce, sharding and a cross-axis global-norm
+clip. TPU-native: grad reduction happens inside the compiled step (GSPMD /
+shard_map transpose), so this wrapper mostly delegates; it keeps the fleet
+API and carries the sharding (ZeRO) configuration into the compiled
+trainers.
+"""
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if strategy is not None and getattr(strategy, "sharding", False):
+            optimizer._zero_stage = strategy.sharding_configs.get("stage", 1)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, *a, **k):
+        return self._inner_opt.minimize(loss, *a, **k)
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

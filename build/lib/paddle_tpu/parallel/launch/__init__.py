@@ -1,0 +1,14 @@
+"""Multi-host launcher.
+
+Parity: `python -m paddle.distributed.launch`
+(`python/paddle/distributed/launch/main.py:18`, controllers
+`collective.py`, `master.py`).
+
+TPU-native: within one host, jax's single controller drives all local
+chips — no per-chip process spawning (the reference forks one proc per
+GPU). Across hosts, one process per host; this launcher fills the env that
+`paddle_tpu.distributed.init_parallel_env` consumes
+(MASTER_ADDR/MASTER_PORT/PADDLE_NNODES/PADDLE_NODE_RANK → fed to
+jax.distributed.initialize) and execs the training script.
+"""
+from .main import main  # noqa: F401

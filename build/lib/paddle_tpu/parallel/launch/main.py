@@ -1,0 +1,47 @@
+"""`python -m paddle_tpu.distributed.launch [--nnodes N] [--master ip:port]
+[--rank R] script.py args...`"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a (multi-host) TPU training job. One process "
+                    "per host drives all local chips (single-controller "
+                    "SPMD); multi-host coordination runs over "
+                    "jax.distributed.")
+    parser.add_argument("--nnodes", type=int,
+                        default=int(os.environ.get("PADDLE_NNODES", "1")))
+    parser.add_argument("--master", type=str,
+                        default=os.environ.get("PADDLE_MASTER"))
+    parser.add_argument("--rank", type=int,
+                        default=int(os.environ.get("PADDLE_NODE_RANK",
+                                                   "0")))
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="accepted for reference-CLI compatibility; "
+                             "ignored (chips are driven by one process)")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.nnodes > 1:
+        if not args.master:
+            parser.error("--master ip:port is required when --nnodes > 1")
+        host, _, port = args.master.partition(":")
+        os.environ["MASTER_ADDR"] = host
+        os.environ["MASTER_PORT"] = port or "8476"
+        os.environ["PADDLE_NNODES"] = str(args.nnodes)
+        os.environ["PADDLE_NODE_RANK"] = str(args.rank)
+
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

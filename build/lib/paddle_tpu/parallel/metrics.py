@@ -1,0 +1,68 @@
+"""Distributed training metrics.
+
+Parity: `paddle/fluid/framework/fleet/metrics.cc` (global AUC: per-worker
+bucket stats merged across all trainers) exposed as `fleet.metrics`.
+
+TPU-native transport: trainers accumulate their local `metric.Auc`
+buckets into a shared PS dense table (a naive-rule table with lr=-1 makes
+`push(g)` an atomic ADD), then any trainer pulls the global buckets and
+computes AUC. Single-process mode degrades to the local metric.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..metric import Auc
+
+
+class GlobalAuc:
+    def __init__(self, num_thresholds=4095, table=None):
+        """`table`: a MemoryDenseTable-like (local or remote via
+        PSClient) of size 2*(num_thresholds+1) with sgd_rule='naive',
+        learning_rate=-1.0 so pushes accumulate."""
+        self.num_thresholds = num_thresholds
+        self.local = Auc(num_thresholds=num_thresholds)
+        self.table = table
+
+    @staticmethod
+    def make_table(num_thresholds=4095):
+        from ..ps import MemoryDenseTable
+        return MemoryDenseTable(2 * (num_thresholds + 1),
+                                sgd_rule="naive", learning_rate=-1.0)
+
+    def update(self, preds, labels):
+        self.local.update(preds, labels)
+
+    def commit(self):
+        """Push this worker's buckets to the shared table and reset the
+        local stats (the per-pass flush in the reference).
+
+        LIMITATION: the dense-table transport is float32, exact for
+        per-bucket counts below 2^24 (~16.7M); beyond that, increments
+        can be absorbed — a warning fires before precision loss (the
+        reference all-reduces int64 buckets; an int64 dense table is the
+        round-2 fix)."""
+        if self.table is None:
+            return
+        import warnings
+        merged = self.table.pull()
+        if merged.size and merged.max() > 2 ** 23:
+            warnings.warn(
+                "GlobalAuc buckets approaching float32 precision limit "
+                "(2^24 per bucket); counts may be lost")
+        buckets = np.concatenate([self.local._stat_pos,
+                                  self.local._stat_neg]).astype(np.float32)
+        self.table.push(buckets)
+        self.local.reset()
+
+    def accumulate(self):
+        """Global AUC over all committed buckets (+ any uncommitted local
+        stats on this worker)."""
+        if self.table is None:
+            return self.local.accumulate()
+        n = self.num_thresholds + 1
+        merged = self.table.pull()
+        agg = Auc(num_thresholds=self.num_thresholds)
+        agg._stat_pos = merged[:n].astype(np.int64) + self.local._stat_pos
+        agg._stat_neg = merged[n:].astype(np.int64) + self.local._stat_neg
+        return agg.accumulate()

@@ -1,0 +1,157 @@
+"""Tensor-parallel layers (GSPMD tier).
+
+Parity: `python/paddle/distributed/fleet/layers/mpu/mp_layers.py`
+(`VocabParallelEmbedding:39`, `ColumnParallelLinear:155`,
+`RowParallelLinear:293`, `ParallelCrossEntropy:438`) and `mp_ops.py`
+(`_c_identity`, `_mp_allreduce`).
+
+TPU-native: instead of allocating per-rank weight shards and calling NCCL
+collectives by hand, these layers hold the FULL logical weight with a
+`dist_spec` PartitionSpec (weight sharded over the "mp" mesh axis) and add
+`with_sharding_constraint` hints in forward. When the training step is
+compiled over a mesh (Model.fit / CompiledTrainStep with a placed model,
+or pjit), XLA GSPMD partitions the matmuls and inserts the identity /
+all-reduce collectives the reference codes by hand. On a single chip they
+degrade to plain dense layers. For the fully manual (shard_map) path used
+by the flagship hybrid trainer, see parallel/hybrid_gpt.py.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer_base import Layer
+from ..nn.layers.common import Linear, Embedding
+from ..nn import functional as F
+from .. import ops
+from ..core.tensor import Tensor
+from ..core import dispatch
+from . import env as dist_env
+from .topology import get_hybrid_communicate_group
+
+
+def _constraint(x, spec):
+    """Apply a sharding constraint when tracing inside a mesh context."""
+    try:
+        mesh = get_hybrid_communicate_group().mesh()
+        arr = x._data if isinstance(x, Tensor) else x
+        if isinstance(arr, jax.core.Tracer):
+            out = jax.lax.with_sharding_constraint(
+                arr, NamedSharding(mesh, spec))
+            if isinstance(x, Tensor):
+                t = Tensor(out, stop_gradient=x.stop_gradient)
+                t._grad_node, t._out_slot = x._grad_node, x._out_slot
+                return t
+            return out
+    except Exception:
+        pass
+    return x
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.embedding = Embedding(num_embeddings, embedding_dim,
+                                   weight_attr=weight_attr)
+        self.weight = self.embedding.weight
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return self.embedding(x)
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        # reference semantics (mp_layers.py:209 `if has_bias:`): the
+        # default has_bias=None means NO bias
+        bias_attr = None if has_bias else False
+        self.linear = Linear(in_features, out_features, weight_attr,
+                             bias_attr)
+        self.weight = self.linear.weight
+        self.bias = self.linear.bias
+        self.weight.dist_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        if self.bias is not None:
+            self.bias.dist_spec = P("mp")
+            self.bias.is_distributed = True
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        out = self.linear(x)
+        if not self.gather_output:
+            out = _constraint(
+                out, P(*([None] * (out.ndim - 1) + ["mp"])))
+        return out
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.linear = Linear(in_features, out_features, weight_attr,
+                             None if has_bias else False)
+        self.weight = self.linear.weight
+        self.bias = self.linear.bias
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+        self.input_is_parallel = input_is_parallel
+
+    def forward(self, x):
+        return self.linear(x)
+
+
+class ParallelCrossEntropy(Layer):
+    """c_softmax_with_cross_entropy parity: with GSPMD the vocab-sharded
+    logits reduce inside the compiled softmax; eager falls back to the
+    dense kernel."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class TensorParallel(Layer):
+    """fleet.distributed_model wrapper for pure-mp topologies (parity:
+    meta_parallel/tensor_parallel.py). Placement of mp-sharded params on
+    the mesh happens here."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._hcg = hcg or get_hybrid_communicate_group()
+        place_model_on_mesh(layers, self._hcg.mesh())
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+def place_model_on_mesh(model, mesh):
+    """device_put every parameter/buffer to its dist_spec sharding
+    (replicated by default) so compiled steps run SPMD over the mesh."""
+    for _, p in model.named_parameters():
+        spec = p.dist_spec if p.dist_spec is not None else \
+            P(*([None] * p.ndim))
+        p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+    for _, b in model.named_buffers():
+        if isinstance(b, Tensor):
+            spec = b.dist_spec if b.dist_spec is not None else \
+                P(*([None] * b.ndim))
+            b._data = jax.device_put(b._data, NamedSharding(mesh, spec))
+    return model

@@ -1,0 +1,205 @@
+"""Pipeline-parallel user API.
+
+Parity: `python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py:211` (`PipelineLayer`, `LayerDesc`, `SharedLayerDesc` — layer
+partition across stages) and `meta_parallel/pipeline_parallel.py:34`
+(`PipelineParallel` 1F1B scheduler over `pp_utils/p2p_communication.py`
+NCCL send/recv).
+
+TPU-native execution model: under single-controller SPMD the pipeline
+schedule must live INSIDE a compiled step (lax.scan + ppermute over the pp
+mesh axis — parallel/hybrid_gpt.py is the flagship implementation). This
+module provides (a) the PipelineLayer partitioning API so reference model
+code ports, and (b) a PipelineParallel wrapper whose `train_batch` runs
+the REAL compiled pipeline (pipeline_schedule.CompiledPipeline: GPipe or
+true-1F1B tick schedule over ppermute) when the model compiles, falling
+back to eager microbatch gradient accumulation (identical gradients —
+1F1B only reorders microbatch execution) otherwise.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..nn.container import LayerList, Sequential
+from ..core.tensor import Tensor
+from . import env as dist_env
+from .topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Builds all stages' layers (single-controller owns every stage) and
+    records the stage partition for the compiled pipeline."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        descs = list(layers)
+        built = []
+        self._shared = {}
+        for i, d in enumerate(descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(self._shared[d.layer_name])
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+            elif isinstance(d, Layer):
+                layer = d
+            else:  # callable (e.g. lambda x: ...)
+                layer = d
+            built.append(layer)
+        self.run_function = built
+        for i, l in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+        if num_stages is None:
+            hcg = get_hybrid_communicate_group()
+            num_stages = hcg.get_pipe_parallel_world_size()
+        self._num_stages = max(num_stages, 1)
+        n = len(built)
+        per = int(np.ceil(n / self._num_stages))
+        self.segment_parts = [min(i * per, n)
+                              for i in range(self._num_stages + 1)]
+        self.segment_parts[-1] = n
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id
+                                                                  + 1]
+        return self.run_function[lo:hi]
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """fleet.distributed_model wrapper for pp topologies.
+
+    train_batch(data, optimizer, lr_scheduler): microbatch gradient
+    accumulation (1F1B-equivalent gradients), then one optimizer step.
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None, schedule="1f1b"):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._hcg = hcg or get_hybrid_communicate_group()
+        pcfg = (strategy.pipeline_configs if strategy is not None
+                else {"accumulate_steps": 1, "micro_batch_size": 1})
+        self.accumulate_steps = pcfg.get("accumulate_steps", 1)
+        self.micro_batch_size = pcfg.get("micro_batch_size", 1)
+        self._schedule = schedule
+        self._runner = None
+        self._runner_failed = False
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _compiled_runner(self):
+        """Build the compiled pipeline (ppermute tick schedule) lazily;
+        None if the model can't run it (no loss_fn / not a PipelineLayer /
+        too few devices / untraceable)."""
+        if self._runner is not None:
+            return self._runner
+        if self._runner_failed:
+            return None
+        try:
+            from .pipeline_schedule import CompiledPipeline
+            self._runner = CompiledPipeline(
+                self._layers, micro_batches=self.accumulate_steps,
+                schedule=self._schedule)
+            return self._runner
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                "compiled pipeline unavailable, falling back to eager "
+                f"microbatch accumulation: {e!r}")
+            self._runner_failed = True
+            return None
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        inputs, labels = data
+        inputs = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        labels = labels if isinstance(labels, Tensor) else Tensor(labels)
+        if isinstance(self._layers, PipelineLayer) \
+                and self._layers._num_stages > 1 \
+                and getattr(self._layers, "_loss_fn", None) is not None:
+            runner = self._compiled_runner()
+            if runner is not None:
+                # Guard ONLY the compiled forward/backward: a failure
+                # there (trace/compile/shape) falls back to eager with
+                # .grad still untouched. Optimizer/scaler errors below
+                # are real user-facing errors and must propagate.
+                try:
+                    loss_arr, grads = runner.loss_and_grads(inputs,
+                                                            labels)
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        "compiled pipeline step failed, falling back to "
+                        f"eager microbatch accumulation: {e!r}")
+                    self._runner = None
+                    self._runner_failed = True  # eager fallback below
+                else:
+                    loss = runner.finish_batch(loss_arr, grads, optimizer,
+                                               scaler)
+                    if lr_scheduler is not None:
+                        lr_scheduler.step()
+                    return loss
+        m = self.accumulate_steps
+        bsz = inputs.shape[0]
+        assert bsz % m == 0, "batch must divide accumulate_steps"
+        mb = bsz // m
+        total = None
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        for i in range(m):
+            x = inputs[i * mb:(i + 1) * mb]
+            y = labels[i * mb:(i + 1) * mb]
+            out = self._layers(x)
+            loss = loss_fn(out, y) if loss_fn is not None else out
+            scaled = loss * (1.0 / m)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = float(loss) if total is None else total + float(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(np.float32(total / m))
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

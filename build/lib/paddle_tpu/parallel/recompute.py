@@ -1,0 +1,38 @@
+"""Recompute (activation checkpointing) user API.
+
+Parity: `python/paddle/distributed/fleet/recompute/recompute.py:229`
+(`recompute(function, *args)`) + `recompute_hybrid.py`. TPU-native: the
+eager tape records ONE GradNode whose vjp re-runs the function under
+`jax.vjp` of a `jax.checkpoint`-wrapped pure function — forward saves
+only the inputs; backward recomputes activations (XLA remat).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import autograd
+from ..core import dispatch
+from ..core import random as rng_mod
+from ..core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """All positional Tensor args participate in autograd; the function
+    runs under no-tape with traced values, wrapped in jax.checkpoint."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    key = rng_mod.next_key() if preserve else rng_mod.get_rng_state()
+
+    def pure(*arrays):
+        it = iter(arrays)
+        wrapped = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                   for a in args]
+        with rng_mod.functional_rng(key), autograd.no_grad():
+            out = function(*wrapped, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(pure)
+    return dispatch.apply("recompute", ckpt, tuple(tensor_args))

@@ -1,0 +1,72 @@
+"""DistributedStrategy.
+
+Parity: `python/paddle/distributed/fleet/base/distributed_strategy.py:109`
+backed by `framework/distributed_strategy.proto:305` (233 fields). Here a
+plain dataclass-style object covering the fields the TPU engine consumes:
+hybrid degrees, amp, recompute, sharding, gradient merge, moe/ep, sp.
+"""
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # collective hybrid parallel (proto: hybrid_configs)
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,   # sequence/context parallel (TPU extension)
+            "ep_degree": 1,    # expert parallel
+        }
+        # amp (proto: amp / amp_configs)
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_bf16": True,
+            "use_dynamic_loss_scaling": False,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        # recompute (proto: recompute / recompute_configs)
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        # sharding (proto: sharding_configs)
+        self.sharding = False
+        self.sharding_configs = {
+            "stage": 1,
+            "degree": 1,
+            "offload": False,
+        }
+        # gradient merge / accumulation
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        # parameter server (a_sync etc.)
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": 0}
+        # misc parity fields
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.without_graph_optimization = True
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in self.__dict__.items():
+            lines.append(f"  {k}={v},")
+        return "\n".join(lines) + ")"

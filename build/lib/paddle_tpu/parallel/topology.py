@@ -1,0 +1,185 @@
+"""Hybrid-parallel topology.
+
+Parity: `python/paddle/distributed/fleet/base/topology.py:54
+CommunicateTopology` / `:140 HybridCommunicateGroup` — builds the
+dp/pp/mp/sharding(/sp/ep) axes and per-axis communication groups.
+
+TPU-native: the topology IS a `jax.sharding.Mesh` with named axes; a
+"communication group" for axis X is the mesh axis name, used by shard_map
+collectives inside compiled steps. Rank bookkeeping is kept for API parity
+and for laying out per-rank data feeds.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+
+import numpy as np
+import jax
+
+from . import env as dist_env
+from .collective import Group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple(
+            "Coordinate", self._parallel_names)
+        self.world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c)
+                      for c in itertools.product(*ranges)]
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def get_rank(self, **kwargs):
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = [self._coord2rank[c] for c in self._coord2rank
+                 if c[axis] == index]
+        return sorted(ranks)
+
+    def get_comm_list(self, axis_name):
+        """All groups along `axis_name`: list of rank-lists."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for other in itertools.product(
+                *[range(self._dims[i]) for i in other_axes]):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(other_axes, other):
+                    coord[i] = o
+                coord[axis] = v
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Axes order matches the reference: data, pipe, sharding, model."""
+
+    def __init__(self, topology: CommunicateTopology, rank=None):
+        self._topo = topology
+        self.global_rank = rank if rank is not None else dist_env.get_rank()
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._mp_degree = topology.get_dim("model")
+        coord = topology.get_coord(
+            self.global_rank % topology.world_size)
+        self._dp_rank = coord.data
+        self._pp_rank = coord.pipe
+        self._sharding_rank = coord.sharding
+        self._mp_rank = coord.model
+        # per-axis groups (rank lists) containing this rank
+        self._dp_group = Group(topology.get_axis_list("data", 0), name="dp")
+        self._pp_group = Group(topology.get_axis_list("pipe", 0), name="pp")
+        self._mp_group = Group(topology.get_axis_list("model", 0),
+                               name="mp")
+        self._sharding_group = Group(
+            topology.get_axis_list("sharding", 0), name="sharding")
+
+    # --- mesh view (the TPU-native core) ---
+    def mesh(self):
+        """jax Mesh with axes (dp, pp, sharding, mp) collapsed of size-1
+        axes."""
+        axes = {}
+        for name, size in (("dp", self._dp_degree),
+                           ("pp", self._pp_degree),
+                           ("sharding", self._sharding_degree),
+                           ("mp", self._mp_degree)):
+            axes[name] = size
+        return dist_env.global_mesh(axes)
+
+    # --- parity accessors (topology.py:140) ---
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1:
+            return "data_parallel"
+        return "hybrid_parallel"
+
+    def get_data_parallel_rank(self):
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    def get_model_parallel_rank(self):
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    def get_stage_id(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_rank(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_rank(self):
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def topology(self):
+        return self._topo
+
+
+_hcg = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group():
+    global _hcg
+    if _hcg is None:
+        topo = CommunicateTopology(dims=(dist_env.get_world_size(), 1, 1, 1))
+        _hcg = HybridCommunicateGroup(topo)
+    return _hcg

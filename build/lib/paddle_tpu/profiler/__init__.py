@@ -1,0 +1,171 @@
+"""paddle_tpu.profiler.
+
+Parity: `python/paddle/profiler/` over the reference profiler
+(`paddle/fluid/platform/profiler/` — HostTracer RecordEvent spans +
+CudaTracer/CUPTI → chrome trace). TPU-native: host spans recorded here +
+`jax.profiler` for the device timeline (XLA/TPU trace), exported as a
+chrome-trace/perfetto file.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class _HostEventRecorder:
+    """Ring-buffer span recorder (host_event_recorder.h parity)."""
+
+    def __init__(self):
+        self.events = []
+        self.lock = threading.Lock()
+
+    def add(self, name, start, end, tid):
+        with self.lock:
+            self.events.append(
+                {"name": name, "ph": "X", "ts": start * 1e6,
+                 "dur": (end - start) * 1e6, "pid": os.getpid(),
+                 "tid": tid})
+
+    def clear(self):
+        with self.lock:
+            self.events = []
+
+
+_recorder = _HostEventRecorder()
+_recording = [False]
+
+
+class RecordEvent:
+    """platform/profiler/event_tracing.h:49 parity — user span."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def end(self):
+        if self._start is not None and _recording[0]:
+            _recorder.add(self.name, self._start, time.perf_counter(),
+                          threading.get_ident())
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+
+
+def make_scheduler(closed=0, ready=1, record=4, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        total = closed + ready + record
+        pos = s % total if repeat == 0 or s < repeat * total else None
+        if pos is None:
+            return ProfilerState.CLOSED
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(
+            dir_name, f"{worker_name or 'worker'}_{int(time.time())}.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": _recorder.events}, f)
+    return handler
+
+
+class Profiler:
+    """python/paddle/profiler/profiler.py parity + jax device trace."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self.timer_only = timer_only
+        self._jax_tracing = False
+        self._trace_dir = None
+
+    def start(self):
+        _recording[0] = True
+        if not self.timer_only:
+            try:
+                import jax
+                self._trace_dir = os.environ.get(
+                    "PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_trace")
+                jax.profiler.start_trace(self._trace_dir)
+                self._jax_tracing = True
+            except Exception:
+                self._jax_tracing = False
+
+    def stop(self):
+        _recording[0] = False
+        if self._jax_tracing:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self.step_num += 1
+
+    def step_info(self, unit=None):
+        return f"step {self.step_num}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        events = _recorder.events
+        by_name = {}
+        for e in events:
+            agg = by_name.setdefault(e["name"], {"calls": 0, "total": 0.0})
+            agg["calls"] += 1
+            agg["total"] += e["dur"] / 1e3
+        lines = [f"{'Name':40s} {'Calls':>8s} {'Total(ms)':>12s}"]
+        for name, agg in sorted(by_name.items(),
+                                key=lambda kv: -kv[1]["total"]):
+            lines.append(f"{name:40s} {agg['calls']:>8d} "
+                         f"{agg['total']:>12.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -1,0 +1,20 @@
+"""paddle_tpu.ps — the native parameter-server / embedding engine
+(SURVEY.md §2.3 PS core + §7.7): C++ sharded hash tables with in-table SGD
+rules, dense tables, the out-of-core slot Dataset/DataFeed, and the
+PS-backed SparseEmbedding layer feeding TPU steps.
+"""
+from .table import (MemorySparseTable, MemoryDenseTable,  # noqa: F401
+                    InMemoryDataset)
+from .embedding import SparseEmbedding  # noqa: F401
+from .runtime import get_ps_runtime, PSRuntime  # noqa: F401
+from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
+from .trainer import HogwildTrainer  # noqa: F401
+from .pass_cache import PassCache, PassCacheEmbedding  # noqa: F401
+from .graph import GraphTable  # noqa: F401
+from .pipeline import PullPushPipeline  # noqa: F401
+from .data_generator import (DataGenerator,  # noqa: F401
+                             MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from .coordinator import (Coordinator, FLClient,  # noqa: F401
+                          ClientSelector, CapacityClientSelector,
+                          FLStrategy)

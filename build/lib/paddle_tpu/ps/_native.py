@@ -1,0 +1,122 @@
+"""ctypes loader for the native ps_core library; builds on first import.
+
+The reference's pybind bridge role (`paddle/fluid/pybind/`) is played by a
+plain C ABI + ctypes (pybind11 is not in this image); numpy arrays pass
+zero-copy via ctypes pointers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc", "ps_core.cpp")
+_LIB = os.path.join(_HERE, "csrc", "libps_core.so")
+
+_lib = None
+
+
+def _build():
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
+           "-o", _LIB, "-lpthread"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native ps_core build failed ({' '.join(cmd)}):\n"
+            f"{proc.stderr[-4000:]}")
+
+
+def get_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if (not os.path.exists(_LIB)) or \
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        _build()
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        # stale/foreign binary (e.g. different arch): rebuild from source
+        _build()
+        lib = ctypes.CDLL(_LIB)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32p = ctypes.POINTER(ctypes.c_int)
+
+    lib.pscore_sparse_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_float, ctypes.c_float]
+    lib.pscore_sparse_create.restype = ctypes.c_int
+    lib.pscore_sparse_pull.argtypes = [ctypes.c_int, u64p, ctypes.c_int,
+                                       f32p]
+    lib.pscore_sparse_push.argtypes = [ctypes.c_int, u64p, f32p,
+                                       ctypes.c_int, f32p, f32p]
+    lib.pscore_sparse_size.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_size.restype = ctypes.c_int64
+    lib.pscore_sparse_enable_spill.argtypes = [ctypes.c_int,
+                                               ctypes.c_char_p,
+                                               ctypes.c_int64]
+    lib.pscore_sparse_enable_spill.restype = ctypes.c_int
+    lib.pscore_sparse_mem_size.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_mem_size.restype = ctypes.c_int64
+    lib.pscore_sparse_spill_size.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_spill_size.restype = ctypes.c_int64
+    lib.pscore_sparse_shrink.argtypes = [ctypes.c_int, ctypes.c_float,
+                                         ctypes.c_int]
+    lib.pscore_sparse_shrink.restype = ctypes.c_int64
+    lib.pscore_sparse_save.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.pscore_sparse_save.restype = ctypes.c_int
+    lib.pscore_sparse_load.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.pscore_sparse_load.restype = ctypes.c_int
+    # accessor-family API (CtrCommon/CtrDouble/CtrDymf)
+    lib.pscore_sparse_create2.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+        ctypes.c_int, ctypes.c_float]
+    lib.pscore_sparse_create2.restype = ctypes.c_int
+    lib.pscore_sparse_accessor.argtypes = [ctypes.c_int]
+    lib.pscore_sparse_accessor.restype = ctypes.c_int
+    lib.pscore_sparse_pull_dymf.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int, f32p, ctypes.c_int]
+    lib.pscore_sparse_push_dymf.argtypes = [
+        ctypes.c_int, u64p, i32p, f32p, ctypes.c_int, ctypes.c_int,
+        f32p, f32p, f32p]
+    lib.pscore_sparse_key_stats.argtypes = [
+        ctypes.c_int, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        i32p]
+    lib.pscore_sparse_key_stats.restype = ctypes.c_int
+
+    lib.pscore_dense_create.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                        ctypes.c_float]
+    lib.pscore_dense_create.restype = ctypes.c_int
+    lib.pscore_dense_set.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
+    lib.pscore_dense_pull.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
+    lib.pscore_dense_push.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
+    lib.pscore_dense_add.argtypes = [ctypes.c_int, f32p, ctypes.c_int64]
+
+    lib.pscore_dataset_create.restype = ctypes.c_int
+    lib.pscore_dataset_load_file.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.pscore_dataset_load_file.restype = ctypes.c_int
+    lib.pscore_dataset_shuffle.argtypes = [ctypes.c_int, ctypes.c_uint64]
+    lib.pscore_dataset_size.argtypes = [ctypes.c_int]
+    lib.pscore_dataset_size.restype = ctypes.c_int64
+    lib.pscore_dataset_rewind.argtypes = [ctypes.c_int]
+    lib.pscore_dataset_next_batch.argtypes = [
+        ctypes.c_int, ctypes.c_int, i32p, ctypes.c_int, ctypes.c_int,
+        u64p, f32p]
+    lib.pscore_dataset_next_batch.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def u64_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def f32_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def i32_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int))

@@ -1,0 +1,182 @@
+"""Async gradient communicator.
+
+Parity: `Communicator` (`paddle/fluid/distributed/ps/service/communicator/
+communicator.h:235`) — the a_sync PS mode: trainer threads enqueue sparse
+grads; background send threads MERGE grads by key (the reference's
+merge_add) and push batched updates to the tables/servers, decoupling the
+training loop from PS latency. flush() drains (the barrier before
+save/eval).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class AsyncCommunicator:
+    def __init__(self, send_queue_size=64, merge_size=4, num_threads=1):
+        self._q = queue.Queue(maxsize=send_queue_size)
+        self.merge_size = merge_size
+        self.num_threads = num_threads
+        self._threads = []
+        self._running = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._errors = []
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        for _ in range(self.num_threads):
+            t = threading.Thread(target=self._send_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self.flush()
+        self._running = False
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+
+    def push_sparse(self, table, keys: np.ndarray, grads: np.ndarray):
+        """Non-blocking enqueue (blocks only when the send queue is full —
+        backpressure, like the reference's bounded send queue)."""
+        if not self._running:
+            raise RuntimeError(
+                "AsyncCommunicator is stopped; call start() before pushing")
+        with self._inflight_cv:
+            self._inflight += 1
+        self._q.put((table, keys.copy(), grads.copy()))
+
+    def flush(self):
+        """Barrier: wait until every enqueued push has been applied.
+        Raises the first send-thread error, if any (silently dropped
+        grads would otherwise masquerade as a completed flush)."""
+        with self._inflight_cv:
+            done = self._inflight_cv.wait_for(
+                lambda: self._inflight == 0 or self._errors, timeout=60)
+        if self._errors:
+            raise self._errors[0]
+        if not done:
+            raise TimeoutError("AsyncCommunicator.flush timed out")
+
+    def _send_loop(self):
+        holdover = None  # different-table item deferred to next round
+        while True:
+            item = holdover if holdover is not None else self._q.get()
+            holdover = None
+            if item is None:
+                return
+            batch = [item]
+            # opportunistically merge up to merge_size pending requests
+            # for the same table (async merge_add). NOTE: never put items
+            # back into the bounded queue — this thread is its consumer
+            # and a blocking put would deadlock against producers.
+            stop_after = False
+            while len(batch) < self.merge_size:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop_after = True
+                    break
+                if nxt[0] is not batch[0][0]:
+                    holdover = nxt
+                    break
+                batch.append(nxt)
+            try:
+                table = batch[0][0]
+                dim = batch[0][2].reshape(
+                    -1, batch[0][2].shape[-1]).shape[-1]
+                all_keys = np.concatenate(
+                    [b[1].reshape(-1) for b in batch]).astype(np.uint64)
+                all_grads = np.concatenate(
+                    [b[2].reshape(-1, dim) for b in batch])
+                # merge duplicate keys: sum grads per unique key
+                uniq, inv = np.unique(all_keys, return_inverse=True)
+                merged = np.zeros((uniq.size, dim), np.float32)
+                np.add.at(merged, inv, all_grads)
+                table.push(uniq, merged)
+            except Exception as e:  # noqa: BLE001
+                self._errors.append(e)
+            finally:
+                with self._inflight_cv:
+                    self._inflight -= len(batch)
+                    if self._inflight == 0 or self._errors:
+                        self._inflight_cv.notify_all()
+            if stop_after:
+                return
+
+
+class GeoCommunicator(AsyncCommunicator):
+    """Geo-async dense mode (`communicator.h:235` GeoCommunicator): each
+    trainer optimizes a LOCAL copy of the dense params; every k_steps it
+    sends only the delta vs its last synced snapshot, the server MERGES
+    deltas additively (so concurrent trainers' progress composes instead
+    of overwriting), and the trainer rebases onto the merged params.
+
+    `table` is anything exposing add(delta) -> None + pull() -> params —
+    a local MemoryDenseTable — or a (PSClient, table_id) pair for the
+    remote path, which merges and pulls in one DENSE_ADD round trip.
+    """
+
+    def __init__(self, k_steps=100, **kw):
+        super().__init__(**kw)
+        self.k_steps = k_steps
+        self._base = {}   # tid -> snapshot at last sync
+        self._steps = {}  # per-table step counters
+
+    @staticmethod
+    def _tid(table):
+        return (id(table[0]), table[1]) if isinstance(table, tuple) \
+            else id(table)
+
+    @staticmethod
+    def _pull(table):
+        if isinstance(table, tuple):
+            client, table_id = table
+            return client.pull_dense(table_id)
+        return table.pull()
+
+    @staticmethod
+    def _add(table, delta):
+        if isinstance(table, tuple):
+            client, table_id = table
+            return client.push_dense_delta(table_id, delta)
+        table.add(delta)
+        return table.pull()
+
+    def register_dense(self, table, params: np.ndarray, is_chief=True):
+        """Start geo tracking. The chief seeds the server with its params
+        (as a delta vs whatever is there); non-chief trainers adopt the
+        server's. Returns the params the trainer should train from."""
+        if is_chief:
+            merged = self._add(table, params - self._pull(table))
+        else:
+            merged = self._pull(table)
+        self._base[self._tid(table)] = merged.copy()
+        return merged.copy()
+
+    def maybe_sync_dense(self, table, params: np.ndarray):
+        """Called each local step with the trainer's CURRENT local params.
+        Every k_steps: push delta, rebase onto the merged result.
+        Returns the params the trainer should continue from."""
+        tid = self._tid(table)
+        if tid not in self._base:
+            # implicit registration ADOPTS the server's params: only an
+            # explicit register_dense(..., is_chief=True) may seed, else a
+            # late-joining trainer would wipe the merged progress
+            return self.register_dense(table, params, is_chief=False)
+        self._steps[tid] = self._steps.get(tid, 0) + 1
+        if self._steps[tid] % self.k_steps != 0:
+            return params
+        merged = self._add(table, params - self._base[tid])
+        self._base[tid] = merged.copy()
+        return merged.copy()

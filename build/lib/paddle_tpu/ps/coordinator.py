@@ -1,0 +1,162 @@
+"""FL (federated-learning) coordinator over the PS service.
+
+Parity: `python/paddle/distributed/ps/coordinator.py` (Coordinator /
+ClientSelector / FLClient over `FLCommunicator` + the brpc
+`CoordinatorClient`, `paddle/fluid/distributed/ps/service/
+coordinator_client.h`). TPU-native re-design: the exchange rides the PS
+server's KV namespace (service.py KV_SET/KV_GET/KV_LIST) instead of a
+dedicated brpc channel; infos and strategies are JSON blobs.
+
+Flow (reference §3.5-style round):
+  1. every FL client pushes its ClientInfo (device type, compute
+     capacity, bandwidth) -> `fl_info/<client_id>`;
+  2. the coordinator blocks until `n_clients` infos arrived, runs its
+     ClientSelector to produce a per-client FLStrategy
+     (JOIN / WAIT / FINISH + iteration budget), publishes
+     `fl_strategy/<round>/<client_id>`;
+  3. clients poll their strategy for the round and act on it.
+
+The reference's in-tree selector is a placeholder that JOINs everyone;
+`CapacityClientSelector` here implements the real capability: rank
+clients by compute_capacity * bandwidth and JOIN the top fraction.
+"""
+from __future__ import annotations
+
+import abc
+import json
+import time
+
+
+class ClientInfoAttr:
+    CLIENT_ID = 0
+    DEVICE_TYPE = 1
+    COMPUTE_CAPACITY = 2
+    BANDWIDTH = 3
+
+
+class FLStrategy:
+    JOIN = 0
+    WAIT = 1
+    FINISH = 2
+    _NAMES = {0: "JOIN", 1: "WAIT", 2: "FINISH"}
+
+
+class ClientSelectorBase(abc.ABC):
+    def __init__(self, clients_info):
+        self.clients_info = clients_info  # {client_id: info dict}
+        self.fl_strategy = {}
+
+    @abc.abstractmethod
+    def select(self):
+        """-> {client_id: {"next_state": str, "iteration_num": int}}"""
+
+
+class ClientSelector(ClientSelectorBase):
+    """Reference-default behavior: every reporting client JOINs."""
+
+    def __init__(self, clients_info, iteration_num=99):
+        super().__init__(clients_info)
+        self.iteration_num = iteration_num
+
+    def select(self):
+        for cid in self.clients_info:
+            self.fl_strategy[cid] = {
+                "next_state": "JOIN",
+                "iteration_num": self.iteration_num,
+            }
+        return self.fl_strategy
+
+
+class CapacityClientSelector(ClientSelectorBase):
+    """JOIN the top `join_fraction` of clients ranked by
+    compute_capacity * bandwidth; the rest WAIT this round."""
+
+    def __init__(self, clients_info, join_fraction=0.5, iteration_num=20):
+        super().__init__(clients_info)
+        self.join_fraction = join_fraction
+        self.iteration_num = iteration_num
+
+    def select(self):
+        ranked = sorted(
+            self.clients_info.items(),
+            key=lambda kv: (float(kv[1].get("compute_capacity", 0.0))
+                            * float(kv[1].get("bandwidth", 0.0))),
+            reverse=True)
+        n_join = max(1, int(len(ranked) * self.join_fraction))
+        for rank, (cid, _info) in enumerate(ranked):
+            self.fl_strategy[cid] = {
+                "next_state": "JOIN" if rank < n_join else "WAIT",
+                "iteration_num": self.iteration_num,
+            }
+        return self.fl_strategy
+
+
+class FLClient:
+    """Trainer-side handle: report info, receive the round strategy."""
+
+    def __init__(self, client, client_id):
+        self._client = client          # ps.service.PSClient
+        self.client_id = str(client_id)
+
+    def push_fl_client_info_sync(self, device_type="cpu",
+                                 compute_capacity=1.0, bandwidth=1.0,
+                                 round_id=0, **extra):
+        # infos are round-scoped like strategies: a new round must
+        # re-gather live capacities, not reuse stale (possibly departed)
+        # clients' reports
+        info = {"client_id": self.client_id, "device_type": device_type,
+                "compute_capacity": compute_capacity,
+                "bandwidth": bandwidth, **extra}
+        self._client.kv_set(f"fl_info/{round_id}/{self.client_id}",
+                            json.dumps(info).encode())
+
+    def pull_fl_strategy(self, round_id=0, timeout=60.0, poll=0.05):
+        """Block until the coordinator publishes this client's strategy
+        for `round_id`; returns {"next_state": ..., "iteration_num"...}."""
+        key = f"fl_strategy/{round_id}/{self.client_id}"
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            raw = self._client.kv_get(key)
+            if raw is not None:
+                return json.loads(raw.decode())
+            time.sleep(poll)
+        raise TimeoutError(f"no FL strategy for client "
+                           f"{self.client_id} round {round_id}")
+
+
+class Coordinator:
+    """Coordinator role: gather infos, select, publish strategies."""
+
+    def __init__(self, client, selector_cls=ClientSelector,
+                 **selector_kw):
+        self._client = client
+        self._selector_cls = selector_cls
+        self._selector_kw = selector_kw
+
+    def query_fl_clients_info(self, n_clients, round_id=0, timeout=60.0,
+                              poll=0.05):
+        """Block until n_clients infos are reported FOR THIS ROUND;
+        returns {client_id: info dict}."""
+        prefix = f"fl_info/{round_id}/"
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            raw = self._client.kv_list(prefix)
+            if len(raw) >= n_clients:
+                return {k.rsplit("/", 1)[1]: json.loads(v.decode())
+                        for k, v in raw.items()}
+            time.sleep(poll)
+        raise TimeoutError(
+            f"only {len(self._client.kv_list(prefix))} of "
+            f"{n_clients} FL clients reported for round {round_id}")
+
+    def make_fl_strategy(self, n_clients, round_id=0, timeout=60.0):
+        """One coordination round: gather -> select -> publish.
+        Returns the strategy map."""
+        infos = self.query_fl_clients_info(n_clients, round_id=round_id,
+                                           timeout=timeout)
+        selector = self._selector_cls(infos, **self._selector_kw)
+        strategy = selector.select()
+        for cid, strat in strategy.items():
+            self._client.kv_set(f"fl_strategy/{round_id}/{cid}",
+                                json.dumps(strat).encode())
+        return strategy

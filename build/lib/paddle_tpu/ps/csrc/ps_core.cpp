@@ -1,0 +1,1149 @@
+// ps_core — native parameter-server engine for paddle_tpu.
+//
+// Reference parity (re-designed, not ported):
+//   - MemorySparseTable (paddle/fluid/distributed/ps/table/
+//     memory_sparse_table.h): shard-parallel hash tables keyed by uint64
+//     feature ids, values = accessor-defined float blocks.
+//   - Accessor + SGD rules (ps/table/ctr_accessor.h, sparse_sgd_rule.h):
+//     CTR-style value layout [show, click, slot, emb(dim), g2sum(dim)]
+//     with naive / adagrad / adam update applied IN the table on push
+//     (the HeterPS optimizer.cuh.h "SGD inside the table" capability,
+//     executed on host CPU feeding the TPU step).
+//   - MemoryDenseTable (ps/table/memory_dense_table.h): flat dense params.
+//   - DataFeed/Dataset channels (framework/data_feed.h, data_set.h:230
+//     LoadIntoMemory + shuffle): slot-file parser + in-memory record pool.
+//
+// Plain C ABI (loaded via ctypes; no pybind dependency). Thread-safe per
+// shard; bulk ops fan out over an internal thread pool.
+//
+// Build: g++ -O3 -march=native -std=c++17 -shared -fPIC ps_core.cpp -o libps_core.so -lpthread
+
+#include <atomic>
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShardBits = 6;
+constexpr int kShards = 1 << kShardBits;  // 64 shards
+
+enum SgdRule : int { kNaive = 0, kAdaGrad = 1, kAdam = 2 };
+
+// Accessor families (parity: ps/table/ctr_accessor.h,
+// ctr_double_accessor.h:29, ctr_dymf_accessor.h:30 — semantics
+// re-implemented, layouts our own):
+//   kCtrCommon — float show/click, fixed embedding dim.
+//   kCtrDouble — show/click accumulated in DOUBLE precision (stored in
+//     two float slots each): at billions of impressions a float show
+//     count stops absorbing +1 increments; the double variant keeps
+//     CTR statistics exact.
+//   kCtrDymf   — dynamic-mf: per-key embedding dim. Every key carries a
+//     1-d embed_w from birth; the mf block (embedx_w, mf_dim floats) is
+//     only allocated once the key's CTR score
+//     (nonclk_coeff*(show-click) + clk_coeff*click) crosses
+//     embedx_threshold (reference NeedExtendMF), with the dim supplied
+//     by the slot's config at that push.
+enum Accessor : int { kCtrCommon = 0, kCtrDouble = 1, kCtrDymf = 2 };
+
+struct TableConfig {
+  int dim = 8;             // embedding dim (common/double; max dim for dymf)
+  int rule = kAdaGrad;
+  float lr = 0.05f;
+  float initial_range = 0.02f;
+  float initial_g2sum = 3.0f;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  float nonclk_coeff = 0.1f, clk_coeff = 1.0f;  // show/click score
+  float decay_rate = 0.98f;  // show/click decay on shrink
+  int accessor = kCtrCommon;
+  float embedx_threshold = 10.0f;  // dymf mf-creation score threshold
+};
+
+// value block layouts:
+//
+// kCtrCommon (v1-compatible):
+//   [0] show  [1] click  [2] unseen_days  [3..3+dim) w
+//   adagrad: [3+dim .. 3+2*dim) g2sum
+//   adam:    [3+dim..3+2dim) m, [3+2dim..3+3dim) v, [3+3dim] beta1_pow,
+//            [3+3dim+1] beta2_pow
+//
+// kCtrDouble:
+//   [0..1] show (double)  [2..3] click (double)  [4] unseen_days
+//   [5..5+dim) w, then opt block (adagrad: g2sum[dim];
+//   adam: m[dim], v[dim], b1p, b2p)
+//
+// kCtrDymf (variable length per key):
+//   [0] show [1] click [2] unseen_days [3] slot [4] mf_dim [5] embed_w
+//   [6..6+eol) embed opt block (naive: 0, adagrad: g2sum,
+//   adam: m, v, b1p, b2p)
+//   then, once matured (score >= embedx_threshold), the mf block:
+//   embedx_w[mf], + opt (adagrad: g2sum[mf]; adam: m[mf], v[mf], b1p,
+//   b2p)
+struct SparseTable {
+  TableConfig cfg;
+  int value_len;
+  std::unordered_map<uint64_t, std::vector<float>> shards[kShards];
+  std::mutex locks[kShards];
+  std::mt19937 rngs[kShards];
+
+  // Spill mode (SSDSparseTable capability, ssd_sparse_table.h parity
+  // re-designed: log-structured per-shard files instead of rocksdb).
+  // Values past the per-shard memory budget are appended to a shard file
+  // and indexed by offset; touching a spilled key promotes it back to
+  // memory (evicting another). The log holds stale copies of re-promoted
+  // keys; save()+load() compacts.
+  bool spill_enabled = false;
+  int64_t mem_budget_shard = 0;
+  std::string spill_dir;
+  std::unordered_map<uint64_t, int64_t> spill_idx[kShards];
+  FILE* spill_f[kShards] = {nullptr};
+
+  explicit SparseTable(const TableConfig& c) : cfg(c) {
+    switch (cfg.accessor) {
+      case kCtrDouble:
+        value_len = 5 + cfg.dim + opt_len(cfg.dim);
+        break;
+      case kCtrDymf:
+        // base length; the embedx block extends per key on maturation
+        value_len = 6 + opt_len(1);
+        break;
+      default:  // kCtrCommon keeps its historical (v1) layout: the adam
+        // block reserves 3*dim+2 even though m,v,pows use 2*dim+2, so
+        // existing v1 save files load bit-identically
+        value_len = 3 + cfg.dim +
+            (cfg.rule == kAdaGrad ? cfg.dim
+             : cfg.rule == kAdam ? 3 * cfg.dim + 2 : 0);
+    }
+    for (int i = 0; i < kShards; i++) rngs[i].seed(1234 + i);
+  }
+
+  // generic opt-state block length for `dim` weights
+  int opt_len(int dim) const {
+    if (cfg.rule == kAdaGrad) return dim;
+    if (cfg.rule == kAdam) return 2 * dim + 2;
+    return 0;
+  }
+
+  // offset of the weight block (common/double)
+  int w_off() const { return cfg.accessor == kCtrDouble ? 5 : 3; }
+
+  // --- accessor-generic show/click/unseen ---------------------------
+  double get_show(const std::vector<float>& v) const {
+    if (cfg.accessor == kCtrDouble) {
+      double d;
+      std::memcpy(&d, v.data(), sizeof(double));
+      return d;
+    }
+    return v[0];
+  }
+  double get_click(const std::vector<float>& v) const {
+    if (cfg.accessor == kCtrDouble) {
+      double d;
+      std::memcpy(&d, v.data() + 2, sizeof(double));
+      return d;
+    }
+    return v[1];
+  }
+  void add_show_click(std::vector<float>& v, float show, float click) {
+    if (cfg.accessor == kCtrDouble) {
+      double s, c;
+      std::memcpy(&s, v.data(), sizeof(double));
+      std::memcpy(&c, v.data() + 2, sizeof(double));
+      s += show;
+      c += click;
+      std::memcpy(v.data(), &s, sizeof(double));
+      std::memcpy(v.data() + 2, &c, sizeof(double));
+    } else {
+      v[0] += show;
+      v[1] += click;
+    }
+  }
+  void scale_show_click(std::vector<float>& v, float f) {
+    if (cfg.accessor == kCtrDouble) {
+      double s, c;
+      std::memcpy(&s, v.data(), sizeof(double));
+      std::memcpy(&c, v.data() + 2, sizeof(double));
+      s *= f;
+      c *= f;
+      std::memcpy(v.data(), &s, sizeof(double));
+      std::memcpy(v.data() + 2, &c, sizeof(double));
+    } else {
+      v[0] *= f;
+      v[1] *= f;
+    }
+  }
+  int unseen_off() const {
+    return cfg.accessor == kCtrDouble ? 4 : 2;
+  }
+  float score_of(const std::vector<float>& v) const {
+    double show = get_show(v), click = get_click(v);
+    return (float)(cfg.nonclk_coeff * (show - click) +
+                   cfg.clk_coeff * click);
+  }
+
+  // apply the SGD rule to `dim` weights at w, opt block at opt
+  // (layout: adagrad g2sum[dim]; adam m[dim], v[dim], b1p, b2p)
+  void apply_rule(float* w, float* opt, const float* grad, int dim) {
+    switch (cfg.rule) {
+      case kNaive:
+        for (int d = 0; d < dim; d++) w[d] -= cfg.lr * grad[d];
+        break;
+      case kAdaGrad:
+        for (int d = 0; d < dim; d++) {
+          opt[d] += grad[d] * grad[d];
+          w[d] -= cfg.lr * grad[d] / std::sqrt(opt[d] + cfg.eps);
+        }
+        break;
+      case kAdam: {
+        float* m = opt;
+        float* vv = opt + dim;
+        float& b1p = opt[2 * dim];
+        float& b2p = opt[2 * dim + 1];
+        b1p *= cfg.beta1;
+        b2p *= cfg.beta2;
+        for (int d = 0; d < dim; d++) {
+          m[d] = cfg.beta1 * m[d] + (1 - cfg.beta1) * grad[d];
+          vv[d] = cfg.beta2 * vv[d] + (1 - cfg.beta2) * grad[d] * grad[d];
+          float mhat = m[d] / (1 - b1p);
+          float vhat = vv[d] / (1 - b2p);
+          w[d] -= cfg.lr * mhat / (std::sqrt(vhat) + cfg.eps);
+        }
+        break;
+      }
+    }
+  }
+
+  void init_opt(float* opt, int dim) {
+    if (cfg.rule == kAdaGrad) {
+      for (int d = 0; d < dim; d++) opt[d] = cfg.initial_g2sum;
+    } else if (cfg.rule == kAdam) {
+      opt[2 * dim] = 1.0f;      // beta1_pow
+      opt[2 * dim + 1] = 1.0f;  // beta2_pow
+    }
+  }
+
+  // --- dymf helpers --------------------------------------------------
+  int dymf_base_len() const { return 6 + opt_len(1); }
+  int dymf_mf(const std::vector<float>& v) const { return (int)v[4]; }
+
+  // allocate the embedx block with `mf` dims (reference NeedExtendMF /
+  // CreateValue stage-2); call under shard lock
+  void dymf_extend(std::vector<float>& v, int mf, int s) {
+    std::uniform_real_distribution<float> dist(-cfg.initial_range,
+                                               cfg.initial_range);
+    size_t base = v.size();
+    v.resize(base + mf + opt_len(mf), 0.0f);
+    for (int d = 0; d < mf; d++) v[base + d] = dist(rngs[s]);
+    init_opt(v.data() + base + mf, mf);
+    v[4] = (float)mf;
+  }
+
+  ~SparseTable() {
+    for (int s = 0; s < kShards; s++) {
+      if (spill_f[s]) std::fclose(spill_f[s]);
+    }
+  }
+
+  int enable_spill(const char* dir, int64_t max_mem_keys) {
+    if (cfg.accessor == kCtrDymf) return -2;  // variable-length values
+    if (spill_enabled) {
+      // already spilling: only adjust the budget — re-opening "wb+"
+      // would truncate logs that live spill_idx offsets point into
+      mem_budget_shard = std::max<int64_t>(1, max_mem_keys / kShards);
+      for (int s = 0; s < kShards; s++) {
+        std::lock_guard<std::mutex> g(locks[s]);
+        evict_to_budget(s, 0);
+      }
+      return 0;
+    }
+    // open all shard logs before flipping any state so a mid-loop
+    // failure leaves the table fully un-spilled
+    FILE* files[kShards] = {nullptr};
+    for (int s = 0; s < kShards; s++) {
+      std::string p = std::string(dir) + "/spill_" + std::to_string(s) +
+          ".bin";
+      files[s] = std::fopen(p.c_str(), "wb+");
+      if (!files[s]) {
+        for (int j = 0; j < s; j++) std::fclose(files[j]);
+        return -1;
+      }
+    }
+    spill_dir = dir;
+    mem_budget_shard = std::max<int64_t>(1, max_mem_keys / kShards);
+    for (int s = 0; s < kShards; s++) spill_f[s] = files[s];
+    spill_enabled = true;
+    for (int s = 0; s < kShards; s++) {
+      std::lock_guard<std::mutex> g(locks[s]);
+      evict_to_budget(s, 0);
+    }
+    return 0;
+  }
+
+  static int shard_of(uint64_t key) {
+    // mix then take low bits
+    uint64_t h = key * 0x9E3779B97F4A7C15ull;
+    return static_cast<int>((h >> 32) & (kShards - 1));
+  }
+
+  // under shard lock. Evicts arbitrary (hash-order) residents until the
+  // shard fits its budget; `protect` is never evicted.
+  void evict_to_budget(int s, uint64_t protect) {
+    if (!spill_enabled) return;
+    auto& mp = shards[s];
+    while ((int64_t)mp.size() > mem_budget_shard) {
+      auto it = mp.begin();
+      if (it->first == protect) {
+        ++it;
+        if (it == mp.end()) break;
+      }
+      std::fseek(spill_f[s], 0, SEEK_END);
+      int64_t off = std::ftell(spill_f[s]);
+      if (std::fwrite(it->second.data(), sizeof(float), value_len,
+                      spill_f[s]) != (size_t)value_len) {
+        // short write (disk full): keep the entry in memory rather than
+        // indexing truncated data that would later read back "corrupt"
+        // and silently re-initialize trained weights
+        break;
+      }
+      spill_idx[s][it->first] = off;
+      mp.erase(it);
+    }
+  }
+
+  std::vector<float>& get_or_init(uint64_t key, int s) {
+    auto it = shards[s].find(key);
+    if (it != shards[s].end()) return it->second;
+    if (spill_enabled) {
+      auto sit = spill_idx[s].find(key);
+      if (sit != spill_idx[s].end()) {
+        std::vector<float> v(value_len);
+        std::fseek(spill_f[s], sit->second, SEEK_SET);
+        if (std::fread(v.data(), sizeof(float), value_len, spill_f[s]) ==
+            (size_t)value_len) {
+          spill_idx[s].erase(sit);
+          auto& ref = shards[s].emplace(key, std::move(v)).first->second;
+          evict_to_budget(s, key);  // node-based map: ref stays valid
+          return ref;
+        }
+        spill_idx[s].erase(sit);  // corrupt entry: fall through to init
+      }
+    }
+    std::vector<float> v(value_len, 0.0f);
+    std::uniform_real_distribution<float> dist(-cfg.initial_range,
+                                               cfg.initial_range);
+    switch (cfg.accessor) {
+      case kCtrDouble:
+        for (int i = 0; i < cfg.dim; i++) v[5 + i] = dist(rngs[s]);
+        init_opt(v.data() + 5 + cfg.dim, cfg.dim);
+        break;
+      case kCtrDymf:
+        v[5] = dist(rngs[s]);          // embed_w; mf_dim starts 0
+        init_opt(v.data() + 6, 1);
+        break;
+      default:
+        for (int i = 0; i < cfg.dim; i++) v[3 + i] = dist(rngs[s]);
+        init_opt(v.data() + 3 + cfg.dim, cfg.dim);
+    }
+    auto& ref = shards[s].emplace(key, std::move(v)).first->second;
+    evict_to_budget(s, key);
+    return ref;
+  }
+
+  void pull(const uint64_t* keys, int n, float* out) {
+    const int woff = w_off();
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      std::memcpy(out + (size_t)i * cfg.dim, v.data() + woff,
+                  sizeof(float) * cfg.dim);
+    });
+  }
+
+  void push(const uint64_t* keys, const float* grads, int n,
+            const float* shows, const float* clicks) {
+    const int woff = w_off();
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      add_show_click(v, shows ? shows[i] : 0.0f,
+                     clicks ? clicks[i] : 0.0f);
+      v[unseen_off()] = 0.0f;  // unseen_days reset
+      apply_rule(v.data() + woff, v.data() + woff + cfg.dim,
+                 grads + (size_t)i * cfg.dim, cfg.dim);
+    });
+  }
+
+  // dymf pull: out row i = [embed_w, embedx_w(min(alloc, stride-1)),
+  // zeros...]; rows whose mf block is unallocated read embed_w + zeros
+  void pull_dymf(const uint64_t* keys, int n, float* out, int stride) {
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      float* row = out + (size_t)i * stride;
+      std::memset(row, 0, sizeof(float) * stride);
+      row[0] = v[5];
+      int mf = std::min(dymf_mf(v), stride - 1);
+      if (mf > 0) {
+        std::memcpy(row + 1, v.data() + dymf_base_len(),
+                    sizeof(float) * mf);
+      }
+    });
+  }
+
+  // dymf push: grads row i = [embed_g, embedx_g(mf_dims[i])]; a key
+  // matures (allocates its mf block at mf_dims[i]) when its CTR score
+  // crosses cfg.embedx_threshold
+  void push_dymf(const uint64_t* keys, const int* mf_dims,
+                 const float* grads, int n, int stride,
+                 const float* shows, const float* clicks,
+                 const float* slots) {
+    parallel_for(n, [&](int i) {
+      uint64_t k = keys[i];
+      int s = shard_of(k);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto& v = get_or_init(k, s);
+      add_show_click(v, shows ? shows[i] : 0.0f,
+                     clicks ? clicks[i] : 0.0f);
+      v[2] = 0.0f;
+      if (slots) v[3] = slots[i];
+      const float* grad = grads + (size_t)i * stride;
+      apply_rule(v.data() + 5, v.data() + 6, grad, 1);  // embed_w
+      int mf = dymf_mf(v);
+      if (mf == 0 && mf_dims[i] > 0 &&
+          score_of(v) >= cfg.embedx_threshold) {
+        // clamp to the push stride (= table max dim): an oversized
+        // slot config would otherwise allocate an mf block no push
+        // could ever update
+        int want = std::min(mf_dims[i], stride - 1);
+        dymf_extend(v, want, s);
+        mf = want;
+      }
+      if (mf > 0 && stride - 1 >= mf) {
+        // partial-gradient pushes (stride-1 < mf) are rejected rather
+        // than mis-indexing the opt block (adam pows live at 2*mf)
+        int base = dymf_base_len();
+        apply_rule(v.data() + base, v.data() + base + mf, grad + 1, mf);
+      }
+    });
+  }
+
+  // test/introspection: exact show/click + mf dim of one key
+  int key_stats(uint64_t key, double* show, double* click, int* mf) {
+    int s = shard_of(key);
+    std::lock_guard<std::mutex> g(locks[s]);
+    auto it = shards[s].find(key);
+    if (it == shards[s].end()) return -1;
+    *show = get_show(it->second);
+    *click = get_click(it->second);
+    *mf = cfg.accessor == kCtrDymf ? dymf_mf(it->second) : cfg.dim;
+    return 0;
+  }
+
+  // one pass of day-level maintenance: decay show/click, age features,
+  // drop features whose score is below threshold (Table::Shrink parity)
+  int64_t shrink(float score_threshold, int max_unseen_days) {
+    std::atomic<int64_t> removed{0};
+    std::vector<std::thread> ts;
+    const int uoff = unseen_off();
+    for (int s = 0; s < kShards; s++) {
+      ts.emplace_back([&, s]() {
+        std::lock_guard<std::mutex> g(locks[s]);
+        auto& mp = shards[s];
+        for (auto it = mp.begin(); it != mp.end();) {
+          auto& v = it->second;
+          scale_show_click(v, cfg.decay_rate);
+          v[uoff] += 1.0f;
+          if (score_of(v) < score_threshold &&
+              v[uoff] > static_cast<float>(max_unseen_days)) {
+            it = mp.erase(it);
+            removed++;
+          } else {
+            ++it;
+          }
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+    return removed.load();
+  }
+
+  int64_t mem_size() const {
+    int64_t n = 0;
+    for (int s = 0; s < kShards; s++) n += (int64_t)shards[s].size();
+    return n;
+  }
+
+  int64_t spill_size() const {
+    int64_t n = 0;
+    for (int s = 0; s < kShards; s++) n += (int64_t)spill_idx[s].size();
+    return n;
+  }
+
+  int64_t size() const { return mem_size() + spill_size(); }
+
+  // save format v2 (versioned — VERDICT r3 #3): magic "PSC2", then
+  // accessor/rule/dim config, then (key, len, floats[len]) entries so
+  // dymf's variable-length values round-trip. v1 files (no magic:
+  // total + value_len header) still load for kCtrCommon tables.
+  static constexpr uint32_t kMagicV2 = 0x32435350u;  // "PSC2" LE
+
+  int save(const char* path) {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return -1;
+    int64_t total = size();
+    std::fwrite(&kMagicV2, sizeof(kMagicV2), 1, f);
+    int32_t hdr[3] = {cfg.accessor, cfg.rule, cfg.dim};
+    std::fwrite(hdr, sizeof(int32_t), 3, f);
+    std::fwrite(&total, sizeof(total), 1, f);
+    for (int s = 0; s < kShards; s++) {
+      std::lock_guard<std::mutex> g(locks[s]);
+      for (auto& kv : shards[s]) {
+        int32_t len = (int32_t)kv.second.size();
+        std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
+        std::fwrite(&len, sizeof(len), 1, f);
+        std::fwrite(kv.second.data(), sizeof(float), len, f);
+      }
+      // spilled entries stream out of the shard log (this is also the
+      // compaction point: a later load() rebuilds a dense log)
+      std::vector<float> v(value_len);
+      for (auto& kv : spill_idx[s]) {
+        std::fseek(spill_f[s], kv.second, SEEK_SET);
+        if (std::fread(v.data(), sizeof(float), value_len, spill_f[s]) !=
+            (size_t)value_len) {
+          std::fclose(f);
+          return -4;
+        }
+        int32_t len = value_len;
+        std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
+        std::fwrite(&len, sizeof(len), 1, f);
+        std::fwrite(v.data(), sizeof(float), len, f);
+      }
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+  int load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    uint32_t magic = 0;
+    if (std::fread(&magic, sizeof(magic), 1, f) != 1) {
+      std::fclose(f);
+      return -2;
+    }
+    if (magic != kMagicV2) {
+      // v1 legacy: [int64 total][int32 value_len] fixed-len entries
+      // (only ever written by kCtrCommon tables)
+      std::rewind(f);
+      if (cfg.accessor != kCtrCommon) {
+        std::fclose(f);
+        return -5;
+      }
+      int64_t total = 0;
+      int vl = 0;
+      if (std::fread(&total, sizeof(total), 1, f) != 1 ||
+          std::fread(&vl, sizeof(vl), 1, f) != 1 || vl != value_len) {
+        std::fclose(f);
+        return -2;
+      }
+      for (int64_t i = 0; i < total; i++) {
+        uint64_t k;
+        std::vector<float> v(value_len);
+        if (std::fread(&k, sizeof(k), 1, f) != 1 ||
+            std::fread(v.data(), sizeof(float), value_len, f) !=
+                (size_t)value_len) {
+          std::fclose(f);
+          return -3;
+        }
+        insert_loaded(k, std::move(v));
+      }
+      std::fclose(f);
+      return 0;
+    }
+    int32_t hdr[3];
+    int64_t total = 0;
+    if (std::fread(hdr, sizeof(int32_t), 3, f) != 3 ||
+        std::fread(&total, sizeof(total), 1, f) != 1 ||
+        hdr[0] != cfg.accessor || hdr[1] != cfg.rule ||
+        hdr[2] != cfg.dim) {
+      std::fclose(f);
+      return -2;
+    }
+    for (int64_t i = 0; i < total; i++) {
+      uint64_t k;
+      int32_t len;
+      if (std::fread(&k, sizeof(k), 1, f) != 1 ||
+          std::fread(&len, sizeof(len), 1, f) != 1 || len <= 0 ||
+          len > (1 << 20)) {
+        std::fclose(f);
+        return -3;
+      }
+      std::vector<float> v(len);
+      if (std::fread(v.data(), sizeof(float), len, f) != (size_t)len) {
+        std::fclose(f);
+        return -3;
+      }
+      // structural validation: a truncated/corrupt entry must fail the
+      // load, not become an under-sized value that later reads/writes
+      // out of bounds in push/pull
+      if (cfg.accessor == kCtrDymf) {
+        int mf = (len >= 5) ? (int)v[4] : -1;
+        bool ok = mf >= 0 && mf <= cfg.dim &&
+            len == dymf_base_len() + (mf > 0 ? mf + opt_len(mf) : 0);
+        if (!ok) {
+          std::fclose(f);
+          return -6;
+        }
+      } else if (len != value_len) {
+        std::fclose(f);
+        return -6;
+      }
+      insert_loaded(k, std::move(v));
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+  void insert_loaded(uint64_t k, std::vector<float>&& v) {
+    int s = shard_of(k);
+    std::lock_guard<std::mutex> g(locks[s]);
+    shards[s][k] = std::move(v);
+    spill_idx[s].erase(k);
+    evict_to_budget(s, k);
+  }
+
+  template <typename F>
+  static void parallel_for(int n, F&& fn) {
+    int nthreads = std::min<int>(std::thread::hardware_concurrency(),
+                                 std::max(1, n / 4096));
+    if (nthreads <= 1) {
+      for (int i = 0; i < n; i++) fn(i);
+      return;
+    }
+    std::vector<std::thread> ts;
+    std::atomic<int> next{0};
+    for (int t = 0; t < nthreads; t++) {
+      ts.emplace_back([&]() {
+        constexpr int kChunk = 1024;
+        while (true) {
+          int start = next.fetch_add(kChunk);
+          if (start >= n) break;
+          int end = std::min(n, start + kChunk);
+          for (int i = start; i < end; i++) fn(i);
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+  }
+};
+
+struct DenseTable {
+  std::vector<float> data;
+  std::vector<float> m, v;  // adam state
+  float lr = 0.01f;
+  int rule = kNaive;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  int64_t step = 0;
+  std::mutex lock;
+};
+
+// ------------------------------------------------------------ DataFeed
+// Slot-record text parser (MultiSlotDataFeed capability):
+// each line: "<label> <slot_id>:<feature_sign> <slot_id>:<feature_sign> ..."
+struct Record {
+  float label;
+  std::vector<std::pair<int, uint64_t>> feats;  // (slot, sign)
+};
+
+struct Dataset {
+  std::vector<Record> records;
+  std::mutex lock;
+  std::atomic<int64_t> cursor{0};
+
+  int load_file(const char* path) {
+    FILE* f = std::fopen(path, "r");
+    if (!f) return -1;
+    char line[1 << 16];
+    std::vector<Record> local;
+    while (std::fgets(line, sizeof(line), f)) {
+      Record r;
+      char* save = nullptr;
+      char* tok = strtok_r(line, " \t\n", &save);
+      if (!tok) continue;
+      r.label = std::strtof(tok, nullptr);
+      while ((tok = strtok_r(nullptr, " \t\n", &save))) {
+        char* colon = std::strchr(tok, ':');
+        if (!colon) continue;
+        *colon = 0;
+        int slot = std::atoi(tok);
+        uint64_t sign = std::strtoull(colon + 1, nullptr, 10);
+        r.feats.emplace_back(slot, sign);
+      }
+      // skip malformed lines that parsed no features (a bare token would
+      // otherwise become a label-0 empty record and pollute training)
+      if (r.feats.empty()) continue;
+      local.push_back(std::move(r));
+    }
+    std::fclose(f);
+    std::lock_guard<std::mutex> g(lock);
+    for (auto& r : local) records.push_back(std::move(r));
+    return 0;
+  }
+
+  void shuffle(uint64_t seed) {
+    std::lock_guard<std::mutex> g(lock);
+    std::mt19937_64 rng(seed);
+    std::shuffle(records.begin(), records.end(), rng);
+    cursor = 0;
+  }
+
+  // fixed-slot batch: out_keys [batch, n_slots, max_feats_per_slot]
+  // (0-padded), out_labels [batch]; returns #rows filled
+  int next_batch(int batch, const int* slot_ids, int n_slots,
+                 int max_per_slot, uint64_t* out_keys, float* out_labels) {
+    int64_t start = cursor.fetch_add(batch);
+    if (start >= (int64_t)records.size()) return 0;
+    int nrows = std::min<int64_t>(batch, records.size() - start);
+    std::memset(out_keys, 0,
+                sizeof(uint64_t) * (size_t)batch * n_slots * max_per_slot);
+    for (int i = 0; i < nrows; i++) {
+      const Record& r = records[start + i];
+      out_labels[i] = r.label;
+      std::vector<int> counts(n_slots, 0);
+      for (auto& f : r.feats) {
+        for (int sidx = 0; sidx < n_slots; sidx++) {
+          if (slot_ids[sidx] == f.first && counts[sidx] < max_per_slot) {
+            out_keys[((size_t)i * n_slots + sidx) * max_per_slot +
+                     counts[sidx]] = f.second;
+            counts[sidx]++;
+            break;
+          }
+        }
+      }
+    }
+    return nrows;
+  }
+};
+
+std::vector<SparseTable*> g_sparse;
+std::vector<DenseTable*> g_dense;
+std::vector<Dataset*> g_datasets;
+std::mutex g_reg_lock;
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------- sparse table
+int pscore_sparse_create(int dim, int rule, float lr, float initial_range) {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.rule = rule;
+  cfg.lr = lr;
+  cfg.initial_range = initial_range;
+  if (rule == kAdaGrad) cfg.initial_g2sum = 0.0f;
+  g_sparse.push_back(new SparseTable(cfg));
+  return (int)g_sparse.size() - 1;
+}
+
+// accessor-selecting constructor (CtrCommon=0 / CtrDouble=1 / CtrDymf=2;
+// table-config accessor_class parity). For dymf, `dim` is the max mf
+// dim (pull/push strides) and embedx_threshold gates mf creation.
+int pscore_sparse_create2(int dim, int rule, float lr, float initial_range,
+                          int accessor, float embedx_threshold) {
+  if (accessor < kCtrCommon || accessor > kCtrDymf) return -1;
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.rule = rule;
+  cfg.lr = lr;
+  cfg.initial_range = initial_range;
+  cfg.accessor = accessor;
+  cfg.embedx_threshold = embedx_threshold;
+  if (rule == kAdaGrad) cfg.initial_g2sum = 0.0f;
+  g_sparse.push_back(new SparseTable(cfg));
+  return (int)g_sparse.size() - 1;
+}
+
+int pscore_sparse_accessor(int h) { return g_sparse[h]->cfg.accessor; }
+
+void pscore_sparse_pull_dymf(int h, const uint64_t* keys, int n,
+                             float* out, int stride) {
+  g_sparse[h]->pull_dymf(keys, n, out, stride);
+}
+
+void pscore_sparse_push_dymf(int h, const uint64_t* keys,
+                             const int* mf_dims, const float* grads,
+                             int n, int stride, const float* shows,
+                             const float* clicks, const float* slots) {
+  g_sparse[h]->push_dymf(keys, mf_dims, grads, n, stride, shows, clicks,
+                         slots);
+}
+
+int pscore_sparse_key_stats(int h, uint64_t key, double* show,
+                            double* click, int* mf_dim) {
+  return g_sparse[h]->key_stats(key, show, click, mf_dim);
+}
+
+void pscore_sparse_pull(int h, const uint64_t* keys, int n, float* out) {
+  g_sparse[h]->pull(keys, n, out);
+}
+
+void pscore_sparse_push(int h, const uint64_t* keys, const float* grads,
+                        int n, const float* shows, const float* clicks) {
+  g_sparse[h]->push(keys, grads, n, shows, clicks);
+}
+
+int64_t pscore_sparse_size(int h) { return g_sparse[h]->size(); }
+
+int pscore_sparse_enable_spill(int h, const char* dir,
+                               int64_t max_mem_keys) {
+  return g_sparse[h]->enable_spill(dir, max_mem_keys);
+}
+
+int64_t pscore_sparse_mem_size(int h) { return g_sparse[h]->mem_size(); }
+
+int64_t pscore_sparse_spill_size(int h) {
+  return g_sparse[h]->spill_size();
+}
+
+int64_t pscore_sparse_shrink(int h, float threshold, int max_unseen) {
+  return g_sparse[h]->shrink(threshold, max_unseen);
+}
+
+int pscore_sparse_save(int h, const char* path) {
+  return g_sparse[h]->save(path);
+}
+
+int pscore_sparse_load(int h, const char* path) {
+  return g_sparse[h]->load(path);
+}
+
+// ----------------------------------------------------------- dense table
+int pscore_dense_create(int64_t size, int rule, float lr) {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  auto* t = new DenseTable();
+  t->data.assign(size, 0.0f);
+  t->rule = rule;
+  t->lr = lr;
+  if (rule == kAdam) {
+    t->m.assign(size, 0.0f);
+    t->v.assign(size, 0.0f);
+  }
+  g_dense.push_back(t);
+  return (int)g_dense.size() - 1;
+}
+
+void pscore_dense_set(int h, const float* vals, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  std::memcpy(t->data.data(), vals, sizeof(float) * n);
+}
+
+void pscore_dense_pull(int h, float* out, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  std::memcpy(out, t->data.data(), sizeof(float) * n);
+}
+
+// geo-async merge (MemorySparseGeoTable/geo dense mode capability): the
+// server adds trainer deltas instead of running an SGD rule
+void pscore_dense_add(int h, const float* delta, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  for (int64_t i = 0; i < n; i++) t->data[i] += delta[i];
+}
+
+void pscore_dense_push(int h, const float* grads, int64_t n) {
+  auto* t = g_dense[h];
+  std::lock_guard<std::mutex> g(t->lock);
+  t->step++;
+  if (t->rule == kAdam) {
+    float b1p = 1 - std::pow(t->beta1, (float)t->step);
+    float b2p = 1 - std::pow(t->beta2, (float)t->step);
+    for (int64_t i = 0; i < n; i++) {
+      t->m[i] = t->beta1 * t->m[i] + (1 - t->beta1) * grads[i];
+      t->v[i] = t->beta2 * t->v[i] + (1 - t->beta2) * grads[i] * grads[i];
+      t->data[i] -= t->lr * (t->m[i] / b1p) /
+                    (std::sqrt(t->v[i] / b2p) + t->eps);
+    }
+  } else {
+    for (int64_t i = 0; i < n; i++) t->data[i] -= t->lr * grads[i];
+  }
+}
+
+// -------------------------------------------------------------- dataset
+int pscore_dataset_create() {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  g_datasets.push_back(new Dataset());
+  return (int)g_datasets.size() - 1;
+}
+
+int pscore_dataset_load_file(int h, const char* path) {
+  return g_datasets[h]->load_file(path);
+}
+
+void pscore_dataset_shuffle(int h, uint64_t seed) {
+  g_datasets[h]->shuffle(seed);
+}
+
+int64_t pscore_dataset_size(int h) {
+  return (int64_t)g_datasets[h]->records.size();
+}
+
+void pscore_dataset_rewind(int h) { g_datasets[h]->cursor = 0; }
+
+int pscore_dataset_next_batch(int h, int batch, const int* slot_ids,
+                              int n_slots, int max_per_slot,
+                              uint64_t* out_keys, float* out_labels) {
+  return g_datasets[h]->next_batch(batch, slot_ids, n_slots, max_per_slot,
+                                   out_keys, out_labels);
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------ graph store
+// Parity: the fork's graph engine (`paddle/fluid/framework/fleet/heter_ps/
+// graph_gpu_ps_table.h`, `gpu_graph_node.h`, `graph_sampler_inl.h`;
+// distributed `ps/table/common_graph_table.h`): adjacency storage keyed by
+// uint64 node ids + random-walk / neighbor sampling for GNN training
+// (PGLBox-style). Host C++ here feeds slot/segment tensors to TPU steps.
+namespace {
+
+struct GraphTable {
+  std::unordered_map<uint64_t, std::vector<uint64_t>> adj[kShards];
+  // per-edge weights, parallel to adj lists; only materialised for nodes
+  // that ever saw a weighted edge (graph_gpu_ps_table weighted-sampling
+  // capability)
+  std::unordered_map<uint64_t, std::vector<float>> wts[kShards];
+  // node feature vectors (common_graph_table.h Node::get_feature parity);
+  // the feature dim is caller-supplied per get call (Python tracks it)
+  std::unordered_map<uint64_t, std::vector<float>> feats[kShards];
+  std::mutex locks[kShards];
+  std::vector<uint64_t> nodes;  // insertion order, for sampling starts
+  std::mutex nodes_lock;
+  // one RNG per shard, each only touched under its shard lock (same
+  // pattern as SparseTable) + one for node sampling under nodes_lock
+  std::mt19937_64 rngs[kShards];
+  std::mt19937_64 nodes_rng{20240731ull};
+
+  GraphTable() {
+    for (int i = 0; i < kShards; i++) rngs[i].seed(977 + i);
+  }
+
+  static int shard_of(uint64_t key) {
+    return SparseTable::shard_of(key);
+  }
+
+  void add_one(uint64_t src, uint64_t dst, float w, bool has_w) {
+    int s = shard_of(src);
+    std::lock_guard<std::mutex> g(locks[s]);
+    auto it = adj[s].find(src);
+    if (it == adj[s].end()) {
+      adj[s][src] = {dst};
+      if (has_w) wts[s][src] = {w};
+      std::lock_guard<std::mutex> g2(nodes_lock);
+      nodes.push_back(src);
+      return;
+    }
+    it->second.push_back(dst);
+    auto wit = wts[s].find(src);
+    if (has_w || wit != wts[s].end()) {
+      auto& wv = (wit != wts[s].end()) ? wit->second : wts[s][src];
+      // earlier unweighted edges on this node default to weight 1
+      while (wv.size() + 1 < it->second.size()) wv.push_back(1.0f);
+      wv.push_back(has_w ? w : 1.0f);
+    }
+  }
+
+  void add_edges(const uint64_t* src, const uint64_t* dst, int64_t n) {
+    for (int64_t i = 0; i < n; i++) add_one(src[i], dst[i], 1.0f, false);
+  }
+
+  void add_edges_weighted(const uint64_t* src, const uint64_t* dst,
+                          const float* w, int64_t n) {
+    for (int64_t i = 0; i < n; i++) add_one(src[i], dst[i], w[i], true);
+  }
+
+  void set_node_feat(const uint64_t* keys, int64_t n, int dim,
+                     const float* vals) {
+    for (int64_t i = 0; i < n; i++) {
+      int s = shard_of(keys[i]);
+      std::lock_guard<std::mutex> g(locks[s]);
+      feats[s][keys[i]].assign(vals + (size_t)i * dim,
+                               vals + (size_t)(i + 1) * dim);
+    }
+  }
+
+  void get_node_feat(const uint64_t* keys, int64_t n, int dim,
+                     float* out) {
+    for (int64_t i = 0; i < n; i++) {
+      int s = shard_of(keys[i]);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto it = feats[s].find(keys[i]);
+      float* dst = out + (size_t)i * dim;
+      if (it == feats[s].end() || (int)it->second.size() != dim) {
+        std::memset(dst, 0, sizeof(float) * dim);
+      } else {
+        std::memcpy(dst, it->second.data(), sizeof(float) * dim);
+      }
+    }
+  }
+
+  // pick an edge index from `nb`, weighted when this node has weights;
+  // call under shard lock
+  size_t choose_edge(int s, uint64_t node,
+                     const std::vector<uint64_t>& nb) {
+    auto wit = wts[s].find(node);
+    if (wit == wts[s].end() || wit->second.size() != nb.size()) {
+      std::uniform_int_distribution<uint64_t> u;
+      return (size_t)(u(rngs[s]) % nb.size());
+    }
+    const auto& wv = wit->second;
+    float total = 0.0f;
+    for (float w : wv) total += (w > 0 ? w : 0);
+    if (total <= 0.0f) {
+      std::uniform_int_distribution<uint64_t> u;
+      return (size_t)(u(rngs[s]) % nb.size());
+    }
+    std::uniform_real_distribution<float> ur(0.0f, total);
+    float r = ur(rngs[s]);
+    for (size_t j = 0; j < wv.size(); j++) {
+      r -= (wv[j] > 0 ? wv[j] : 0);
+      if (r <= 0) return j;
+    }
+    return wv.size() - 1;
+  }
+
+  // sample up to k neighbors per query node (out: [n, k]); slots past
+  // the true degree pad with the node itself, so callers may mask either
+  // via out_deg or by out[i][j] == q[i]
+  void sample_neighbors(const uint64_t* q, int64_t n, int k,
+                        uint64_t* out, int* out_deg) {
+    std::uniform_int_distribution<uint64_t> u;
+    for (int64_t i = 0; i < n; i++) {
+      int s = shard_of(q[i]);
+      std::lock_guard<std::mutex> g(locks[s]);
+      auto it = adj[s].find(q[i]);
+      if (it == adj[s].end() || it->second.empty()) {
+        out_deg[i] = 0;
+        for (int j = 0; j < k; j++) out[i * k + j] = q[i];
+        continue;
+      }
+      auto& nb = it->second;
+      int deg = (int)std::min<size_t>(nb.size(), (size_t)k);
+      out_deg[i] = deg;
+      for (int j = 0; j < k; j++) {
+        if (j < deg) {
+          out[i * k + j] = nb.size() <= (size_t)k
+              ? nb[j]                              // take all
+              : nb[choose_edge(s, q[i], nb)];      // (weighted) subsample
+        } else {
+          out[i * k + j] = q[i];                   // self-pad
+        }
+      }
+    }
+  }
+
+  // random walks: for each start node, walk `walk_len` steps
+  // (out: [n, walk_len+1]); dead ends repeat the last node
+  void random_walk(const uint64_t* starts, int64_t n, int walk_len,
+                   uint64_t* out) {
+    std::uniform_int_distribution<uint64_t> u;
+    for (int64_t i = 0; i < n; i++) {
+      uint64_t cur = starts[i];
+      out[i * (walk_len + 1)] = cur;
+      for (int t = 1; t <= walk_len; t++) {
+        int s = shard_of(cur);
+        std::lock_guard<std::mutex> g(locks[s]);
+        auto it = adj[s].find(cur);
+        if (it == adj[s].end() || it->second.empty()) {
+          out[i * (walk_len + 1) + t] = cur;
+          continue;
+        }
+        cur = it->second[choose_edge(s, cur, it->second)];
+        out[i * (walk_len + 1) + t] = cur;
+      }
+    }
+  }
+
+  int64_t num_nodes() {
+    std::lock_guard<std::mutex> g(nodes_lock);
+    return (int64_t)nodes.size();
+  }
+
+  void sample_nodes(int64_t n, uint64_t* out) {
+    std::lock_guard<std::mutex> g(nodes_lock);
+    std::uniform_int_distribution<uint64_t> u;
+    for (int64_t i = 0; i < n; i++) {
+      out[i] = nodes.empty() ? 0
+          : nodes[(size_t)(u(nodes_rng) % nodes.size())];
+    }
+  }
+};
+
+std::vector<GraphTable*> g_graphs;
+
+}  // namespace
+
+extern "C" {
+
+int pscore_graph_create() {
+  std::lock_guard<std::mutex> g(g_reg_lock);
+  g_graphs.push_back(new GraphTable());
+  return (int)g_graphs.size() - 1;
+}
+
+void pscore_graph_add_edges(int h, const uint64_t* src,
+                            const uint64_t* dst, int64_t n) {
+  g_graphs[h]->add_edges(src, dst, n);
+}
+
+void pscore_graph_add_edges_weighted(int h, const uint64_t* src,
+                                     const uint64_t* dst, const float* w,
+                                     int64_t n) {
+  g_graphs[h]->add_edges_weighted(src, dst, w, n);
+}
+
+void pscore_graph_set_node_feat(int h, const uint64_t* keys, int64_t n,
+                                int dim, const float* vals) {
+  g_graphs[h]->set_node_feat(keys, n, dim, vals);
+}
+
+void pscore_graph_get_node_feat(int h, const uint64_t* keys, int64_t n,
+                                int dim, float* out) {
+  g_graphs[h]->get_node_feat(keys, n, dim, out);
+}
+
+void pscore_graph_sample_neighbors(int h, const uint64_t* q, int64_t n,
+                                   int k, uint64_t* out, int* out_deg) {
+  g_graphs[h]->sample_neighbors(q, n, k, out, out_deg);
+}
+
+void pscore_graph_random_walk(int h, const uint64_t* starts, int64_t n,
+                              int walk_len, uint64_t* out) {
+  g_graphs[h]->random_walk(starts, n, walk_len, out);
+}
+
+int64_t pscore_graph_num_nodes(int h) { return g_graphs[h]->num_nodes(); }
+
+void pscore_graph_sample_nodes(int h, int64_t n, uint64_t* out) {
+  g_graphs[h]->sample_nodes(n, out);
+}
+
+}  // extern "C"

@@ -1,0 +1,154 @@
+"""fleet data_generator — the user-parser API feeding the native Dataset.
+
+Parity: `python/paddle/distributed/fleet/data_generator/
+data_generator.py` (DataGenerator / MultiSlotDataGenerator /
+MultiSlotStringDataGenerator). Users subclass and implement
+`generate_sample(line)` (returning a no-arg iterator of parsed samples,
+each `[(slot_name, [values...]), ...]`), optionally `generate_batch`;
+`run_from_stdin` keeps the reference's pipe-into-Dataset deployment
+mode, and `InMemoryDataset.load_from_generator(gen, files)` (table.py)
+is the in-process bridge that parses files straight into the native C++
+record pool.
+
+TPU-native line format: the native DataFeed (ps/csrc/ps_core.cpp)
+parses `<label> <slot_id>:<feature_sign> ...`. A sample's `label` slot
+(configurable name) becomes the label column; every other slot's values
+become `<slot_id>:<sign>` pairs, with slot ids taken from the
+generator's slot registry (declaration order, or an explicit mapping).
+The reference's `<count> <vals...>` MultiSlotDataFeed encoding is kept
+available through `_gen_str_multislot` for byte-compat pipelines.
+"""
+from __future__ import annotations
+
+import sys
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+        self._label_slot = "label"
+        self._slot_ids = {}          # name -> int id (declaration order)
+        self._proto_info = None
+
+    # -- user configuration -------------------------------------------
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def set_label_slot(self, name):
+        self._label_slot = name
+
+    def set_slots(self, slots):
+        """Explicit slot-name -> integer-id mapping (list of names ->
+        ids 1..N, or a dict). Once set, unknown slot names in parsed
+        samples RAISE instead of being silently auto-registered (a typo
+        would otherwise train on all-zero keys)."""
+        if isinstance(slots, dict):
+            self._slot_ids = {str(k): int(v) for k, v in slots.items()}
+        else:
+            self._slot_ids = {str(n): i + 1 for i, n in enumerate(slots)}
+        self._slots_frozen = True
+
+    # -- user hooks ----------------------------------------------------
+    def generate_sample(self, line):
+        """Must return a NO-ARG iterator over parsed samples for this
+        input line (reference contract)."""
+        raise NotImplementedError(
+            "implement generate_sample(line) in your DataGenerator")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- encoding ------------------------------------------------------
+    def _slot_id(self, name):
+        if name not in self._slot_ids:
+            if getattr(self, "_slots_frozen", False):
+                raise KeyError(
+                    f"slot '{name}' is not in the registry set by "
+                    f"set_slots() ({sorted(self._slot_ids)}); "
+                    "a mistyped slot name would otherwise emit keys "
+                    "the Dataset's slot filter drops")
+            self._slot_ids[name] = len(self._slot_ids) + 1
+        return self._slot_ids[name]
+
+    def _gen_str(self, parsed):
+        """One parsed sample -> one native DataFeed line."""
+        if not isinstance(parsed, (list, tuple)):
+            raise ValueError(
+                "generate_sample must yield [(name, [values...]), ...], "
+                f"got {type(parsed).__name__}")
+        label = 0.0
+        pairs = []
+        for name, values in parsed:
+            if not isinstance(values, (list, tuple)) or not values:
+                raise ValueError(
+                    f"slot '{name}' values must be a non-empty list")
+            if name == self._label_slot:
+                label = float(values[0])
+                continue
+            sid = self._slot_id(name)
+            pairs.extend(f"{sid}:{int(v)}" for v in values)
+        lab = int(label) if float(label).is_integer() else label
+        return f"{lab} " + " ".join(pairs) + "\n"
+
+    def _gen_str_multislot(self, parsed):
+        """Reference MultiSlotDataFeed encoding: `cnt v1 v2 ...` per
+        slot (kept for byte-compat pipe deployments)."""
+        out = []
+        for name, values in parsed:
+            out.append(str(len(values)))
+            out.extend(str(v) for v in values)
+        return " ".join(out) + "\n"
+
+    # -- drivers -------------------------------------------------------
+    def _emit(self, samples, write):
+        batch_iter = self.generate_batch(samples)
+        for sample in batch_iter():
+            write(self._gen_str(sample))
+
+    def run_from_iterable(self, lines, write=None):
+        write = write or sys.stdout.write
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            for parsed in it():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    self._emit(batch, write)
+                    batch = []
+        if batch:
+            self._emit(batch, write)
+
+    def run_from_stdin(self):
+        self.run_from_iterable(sys.stdin)
+
+    def run_from_memory(self):
+        self.run_from_iterable([None])
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Integer feature signs (the native table keyspace)."""
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String slots: signs hashed to uint64, namespaced per slot (the
+    reference emits raw strings for the C++ feed to hash; our native
+    feed takes ints, so the stable 64-bit hash happens here)."""
+
+    def _gen_str(self, parsed):
+        import hashlib
+        conv = []
+        for name, values in parsed:
+            if name == self._label_slot:
+                conv.append((name, values))
+                continue
+            conv.append((name, [
+                int.from_bytes(
+                    hashlib.blake2b(f"{name}\x00{v}".encode(),
+                                    digest_size=8).digest(), "little")
+                for v in values]))
+        return super()._gen_str(conv)

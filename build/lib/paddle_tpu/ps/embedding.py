@@ -1,0 +1,69 @@
+"""SparseEmbedding: the PS-backed embedding layer feeding the TPU step.
+
+Parity: the `distributed_lookup_table` / `distributed_push_sparse` op pair
+the PS trainer pass rewrites embeddings into
+(`python/paddle/distributed/passes/ps_trainer_pass.py`), plus the
+HeterPS/PSGPU pull-train-push cycle (`fleet/ps_gpu_wrapper.h:157
+PullSparse / :170 PushSparseGrad`).
+
+Design (SURVEY.md §7.7): the hash-table lookup and the in-table SGD run in
+native host code (ps/csrc); the TPU step consumes a dense [batch, slots,
+dim] activation and produces its gradient. The pull happens in forward
+(host), the push happens when the gradient for the pulled block arrives
+(leaf grad hook) — so the surrounding model stays an ordinary autograd
+graph and can be jitted between the pull/push boundaries.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..core.tensor import Tensor
+from .table import MemorySparseTable
+
+
+class SparseEmbedding(Layer):
+    def __init__(self, dim=8, sgd_rule="adagrad", learning_rate=0.05,
+                 initial_range=0.02, table=None, communicator=None,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.table = table if table is not None else MemorySparseTable(
+            dim, sgd_rule, learning_rate, initial_range)
+        # a_sync mode: pushes go through the background communicator
+        self.communicator = communicator
+        if communicator is not None:
+            communicator.start()
+
+    def forward(self, keys):
+        """keys: uint64/int ndarray or Tensor [batch, n_slots, per_slot]
+        -> Tensor [batch, n_slots, per_slot, dim] (requires_grad; grads
+        are pushed to the table on backward)."""
+        keys_np = keys.numpy() if isinstance(keys, Tensor) \
+            else np.asarray(keys)
+        keys_np = keys_np.astype(np.uint64)
+        values = self.table.pull(keys_np)
+        t = Tensor(values, stop_gradient=not self.training)
+        if self.training:
+            table = self.table
+            # leaf hooks fire once per accumulated edge with the CUMULATIVE
+            # grad; push only the delta so multi-consumer graphs don't
+            # double-apply earlier contributions
+            state = {"pushed": None}
+
+            comm = self.communicator
+
+            def push_hook(grad, _keys=keys_np, _table=table, _s=state,
+                          _comm=comm):
+                g = grad.numpy()
+                delta = g if _s["pushed"] is None else g - _s["pushed"]
+                _s["pushed"] = g.copy()
+                if _comm is not None:
+                    _comm.push_sparse(_table, _keys, delta)
+                else:
+                    _table.push(_keys, delta)
+            t.register_hook(push_hook)
+        return t
+
+    def state(self):
+        return {"size": len(self.table)}

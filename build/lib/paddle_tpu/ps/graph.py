@@ -1,0 +1,110 @@
+"""Graph table + samplers over the native engine.
+
+Parity: the fork-focus graph engine (`graph_gpu_ps_table.h`,
+`gpu_graph_node.h`, `graph_sampler_inl.h`; `ps/table/common_graph_table.h`)
+— adjacency storage keyed by uint64 node ids with random-walk and
+neighbor sampling (uniform or edge-weight-proportional) plus per-node
+float feature vectors (`Node::get_feature` capability; the per-edge
+feature supported is its sampling weight), feeding GNN training
+(paddle_tpu.geometric ops consume the sampled edges on the TPU).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ._native import get_lib, u64_ptr, f32_ptr, i32_ptr
+
+
+def _bind_graph(lib):
+    if getattr(lib, "_graph_bound", False):
+        return lib
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.pscore_graph_create.restype = ctypes.c_int
+    lib.pscore_graph_add_edges.argtypes = [ctypes.c_int, u64p, u64p,
+                                           ctypes.c_int64]
+    lib.pscore_graph_add_edges_weighted.argtypes = [
+        ctypes.c_int, u64p, u64p, f32p, ctypes.c_int64]
+    lib.pscore_graph_set_node_feat.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int64, ctypes.c_int, f32p]
+    lib.pscore_graph_get_node_feat.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int64, ctypes.c_int, f32p]
+    lib.pscore_graph_sample_neighbors.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int64, ctypes.c_int, u64p, i32p]
+    lib.pscore_graph_random_walk.argtypes = [
+        ctypes.c_int, u64p, ctypes.c_int64, ctypes.c_int, u64p]
+    lib.pscore_graph_num_nodes.argtypes = [ctypes.c_int]
+    lib.pscore_graph_num_nodes.restype = ctypes.c_int64
+    lib.pscore_graph_sample_nodes.argtypes = [ctypes.c_int,
+                                              ctypes.c_int64, u64p]
+    lib._graph_bound = True
+    return lib
+
+
+class GraphTable:
+    def __init__(self):
+        self._lib = _bind_graph(get_lib())
+        self._h = self._lib.pscore_graph_create()
+
+    def add_edges(self, src, dst):
+        src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.uint64)
+        dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.uint64)
+        assert src.size == dst.size
+        self._lib.pscore_graph_add_edges(self._h, u64_ptr(src),
+                                         u64_ptr(dst), src.size)
+
+    def add_edges_weighted(self, src, dst, weights):
+        """Edges with sampling weights: sample_neighbors/random_walk pick
+        neighbors with probability proportional to weight."""
+        src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.uint64)
+        dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.uint64)
+        w = np.ascontiguousarray(np.asarray(weights).reshape(-1),
+                                 np.float32)
+        assert src.size == dst.size == w.size
+        self._lib.pscore_graph_add_edges_weighted(
+            self._h, u64_ptr(src), u64_ptr(dst), f32_ptr(w), src.size)
+
+    def set_node_feat(self, nodes, feats):
+        """Per-node float feature vectors [n, dim]."""
+        q = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.uint64)
+        f = np.ascontiguousarray(np.asarray(feats, np.float32).reshape(
+            q.size, -1))
+        self.feat_dim = f.shape[1]
+        self._lib.pscore_graph_set_node_feat(
+            self._h, u64_ptr(q), q.size, f.shape[1], f32_ptr(f))
+
+    def get_node_feat(self, nodes, dim=None):
+        """[n, dim] features; zeros for nodes without features."""
+        q = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.uint64)
+        dim = dim if dim is not None else getattr(self, "feat_dim", 0)
+        out = np.empty((q.size, dim), np.float32)
+        self._lib.pscore_graph_get_node_feat(
+            self._h, u64_ptr(q), q.size, dim, f32_ptr(out))
+        return out.reshape(*np.asarray(nodes).shape, dim)
+
+    def sample_neighbors(self, nodes, k):
+        q = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.uint64)
+        out = np.empty((q.size, k), np.uint64)
+        deg = np.empty(q.size, np.int32)
+        self._lib.pscore_graph_sample_neighbors(
+            self._h, u64_ptr(q), q.size, k, u64_ptr(out), i32_ptr(deg))
+        return out, deg
+
+    def random_walk(self, starts, walk_len):
+        s = np.ascontiguousarray(np.asarray(starts).reshape(-1),
+                                 np.uint64)
+        out = np.empty((s.size, walk_len + 1), np.uint64)
+        self._lib.pscore_graph_random_walk(self._h, u64_ptr(s), s.size,
+                                           walk_len, u64_ptr(out))
+        return out
+
+    def num_nodes(self):
+        return int(self._lib.pscore_graph_num_nodes(self._h))
+
+    def sample_nodes(self, n):
+        out = np.empty(n, np.uint64)
+        self._lib.pscore_graph_sample_nodes(self._h, n, u64_ptr(out))
+        return out

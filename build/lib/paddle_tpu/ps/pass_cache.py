@@ -1,0 +1,88 @@
+"""Pass-based device embedding cache.
+
+Parity: `PSGPUWrapper` / HeterPS (`paddle/fluid/framework/fleet/
+ps_gpu_wrapper.h:191 BuildGPUTask`, `:157 PullSparse`, `:195
+BeginPass/EndPass`; `heter_ps/heter_comm.h`): instead of per-batch host
+pull/push, a PASS (a slice of the dataset) is scanned for its unique keys,
+their embeddings are bulk-pulled ONCE into a dense on-device matrix, every
+batch in the pass looks embeddings up on-device (XLA gather inside the
+compiled step — grads flow into the dense matrix like any parameter), and
+EndPass pushes the accumulated deltas back to the host/remote table.
+
+The reference's multi-GPU hashtable + NVLink routing collapses to one
+dense [n_unique, dim] device array (sharded over the mesh when large);
+the in-table SGD rule applies at EndPass via table.push of the delta.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from ..nn.layer_base import Layer
+
+
+class PassCache:
+    """BeginPass/EndPass lifecycle around a dense device cache."""
+
+    def __init__(self, table, dim):
+        self.table = table
+        self.dim = dim
+        self._key_to_slot = None
+        self._keys = None
+        self._embedding = None  # Parameter [n_unique, dim]
+        self._initial = None
+
+    # ---- lifecycle (BuildGPUTask / BeginPass parity) ----
+    def begin_pass(self, keys_iterable):
+        """Collect the pass's unique keys and bulk-pull once."""
+        all_keys = np.concatenate(
+            [np.asarray(k).reshape(-1) for k in keys_iterable]
+        ).astype(np.uint64)
+        uniq = np.unique(all_keys)
+        values = self.table.pull(uniq)          # one bulk host/RPC pull
+        self._keys = uniq
+        self._key_to_slot = {int(k): i for i, k in enumerate(uniq)}
+        self._embedding = Parameter(values.astype(np.float32))
+        self._initial = values.copy()
+        return self
+
+    def lookup_slots(self, keys: np.ndarray) -> np.ndarray:
+        """Map raw keys -> dense slot ids (host-side, cheap dict lookups;
+        feed the slots to the compiled step)."""
+        flat = np.asarray(keys).reshape(-1)
+        slots = np.fromiter((self._key_to_slot[int(k)] for k in flat),
+                            np.int32, count=flat.size)
+        return slots.reshape(np.asarray(keys).shape)
+
+    @property
+    def embedding(self) -> Parameter:
+        return self._embedding
+
+    def end_pass(self, push=True):
+        """Push the accumulated embedding delta back through the table's
+        SGD rule (EndPass parity). The device cache trained with plain
+        SGD-like updates via the optimizer; the table receives the total
+        delta as a gradient with lr-neutralising naive semantics when its
+        rule is 'naive' lr=1, or as a single accumulated grad otherwise."""
+        if push and self._embedding is not None:
+            delta = self._initial - self._embedding.numpy()
+            self.table.push(self._keys, delta.astype(np.float32))
+        self._embedding = None
+        self._key_to_slot = None
+        self._keys = None
+        self._initial = None
+
+
+class PassCacheEmbedding(Layer):
+    """Layer facade: forward(slots) gathers from the pass's dense cache —
+    fully on-device, jit/Model.fit compatible (the cache is a Parameter,
+    so compiled steps donate/update it like any weight)."""
+
+    def __init__(self, cache: PassCache):
+        super().__init__()
+        self.cache = cache
+        self.add_parameter("weight", cache.embedding)
+
+    def forward(self, slots):
+        from ..nn import functional as F
+        return F.embedding(slots, self.weight)

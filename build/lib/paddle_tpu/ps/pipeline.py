@@ -1,0 +1,111 @@
+"""Double-buffered pull/train/push pipeline.
+
+Parity: the reference overlaps PS I/O with compute through the
+Communicator's async send threads + `PullDenseWorker`
+(`paddle/fluid/distributed/ps/service/communicator/communicator.h:235`,
+`paddle/fluid/framework/pull_dense_worker.cc`). TPU-native re-design:
+three pipelined stages —
+
+  pull(t+1)  on a prefetch thread (host C++ tables / RPC),
+  step(t)    on the device (dispatch is async; the XLA step releases
+             the GIL),
+  push(t-1)  on a drain thread (the device->host gradient fetch blocks
+             THERE, off the critical path).
+
+Steady-state throughput = max(stage) instead of sum(stages). Gradient
+pushes land at most `push_depth` batches late — the same staleness
+window the reference's AsyncCommunicator exposes (async SGD semantics).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+_STOP = object()
+
+
+class PullPushPipeline:
+    """run(batch_iter, pull_fn, step_fn, push_fn) -> n_examples.
+
+    pull_fn(batch)            -> acts        (host: table/RPC pull)
+    step_fn(batch, acts)      -> (count, push_item or None)
+                                             (dispatch device work; do
+                                             NOT block on results)
+    push_fn(push_item)        -> None        (fetch grads + push; may
+                                             block on the device)
+    """
+
+    def __init__(self, prefetch_depth=2, push_depth=4):
+        self.prefetch_depth = prefetch_depth
+        self.push_depth = push_depth
+
+    def run(self, batch_iter, pull_fn, step_fn, push_fn):
+        pulled = queue.Queue(maxsize=self.prefetch_depth)
+        to_push = queue.Queue(maxsize=self.push_depth)
+        errors = []
+
+        stop = threading.Event()
+
+        def put_or_stop(item):
+            while not stop.is_set():
+                try:
+                    pulled.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def pull_worker():
+            try:
+                for batch in batch_iter:
+                    if not put_or_stop((batch, pull_fn(batch))):
+                        return
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                put_or_stop(_STOP)
+
+        def push_worker():
+            while True:
+                item = to_push.get()
+                if item is _STOP:
+                    return
+                if errors:
+                    continue  # keep draining so producers never block
+                try:
+                    push_fn(item)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        pt = threading.Thread(target=pull_worker, daemon=True)
+        st = threading.Thread(target=push_worker, daemon=True)
+        pt.start()
+        st.start()
+        seen = 0
+        try:
+            while True:
+                item = pulled.get()
+                if item is _STOP:
+                    break
+                if errors:
+                    break
+                batch, acts = item
+                count, push_item = step_fn(batch, acts)
+                seen += count
+                if push_item is not None:
+                    to_push.put(push_item)
+        finally:
+            stop.set()
+            # unblock a pull thread waiting on a full queue
+            while True:
+                try:
+                    pulled.get_nowait()
+                except queue.Empty:
+                    break
+            to_push.put(_STOP)
+            st.join()
+            pt.join()
+        if errors:
+            raise errors[0]
+        return seen

@@ -1,0 +1,168 @@
+"""PS runtime facade.
+
+Parity: `TheOnePSRuntime` (`python/paddle/distributed/ps/the_one_ps.py:921`
+— `_init_worker:1044`, `_init_server:1202`) and the brpc client/server
+pair (`BrpcPsClient`/`BrpcPsServer`).
+
+Round-1 scope: the in-process local PS (the reference's `ps_local_client.h`
+capability, used by its own single-process tests and HeterPS): tables live
+in this process's native engine; init_server/init_worker manage the table
+registry and persistence. The multi-host RPC transport (gRPC/TCP) is the
+next native milestone — the table/accessor engine below it is already the
+real one.
+"""
+from __future__ import annotations
+
+import os
+
+from .table import MemorySparseTable, MemoryDenseTable
+
+
+class PSRuntime:
+    """Local mode by default; distributed mode when the reference's PS env
+    is present (role_maker env parsing parity, `fleet/base/role_maker.py`):
+      TRAINING_ROLE=PSERVER|TRAINER
+      PADDLE_PSERVERS_IP_PORT_LIST=h1:p1,h2:p2
+      PADDLE_PORT / POD_IP (which endpoint this server binds)
+    """
+
+    def __init__(self):
+        self._tables = {}
+        self._table_configs = {}
+        self._running = False
+        self._server = None
+        self._client = None
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.server_endpoints = [e for e in eps.split(",") if e]
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+
+    @property
+    def is_distributed(self):
+        return bool(self.server_endpoints)
+
+    # ---- table registry (the_one_ps table config parity) ----
+    def create_sparse_table(self, table_id, dim=8, sgd_rule="adagrad",
+                            learning_rate=0.05, initial_range=0.02,
+                            accessor="ctr", embedx_threshold=10.0):
+        """`accessor` selects the value layout family (the_one_ps
+        table-config accessor_class parity): "ctr" | "ctr_double" |
+        "ctr_dymf" (see table.MemorySparseTable)."""
+        self._table_configs[table_id] = dict(
+            kind="sparse", dim=dim, sgd_rule=sgd_rule,
+            learning_rate=learning_rate, initial_range=initial_range,
+            accessor=accessor, embedx_threshold=embedx_threshold)
+        if self.is_distributed:
+            if self.role == "TRAINER":
+                from .service import RemoteSparseTable
+                self.init_worker()
+                self._tables.setdefault(
+                    table_id,
+                    RemoteSparseTable(self._client, table_id, dim,
+                                      accessor=accessor))
+                return self._tables[table_id]
+            # PSERVER: the real table lives in the PSServer (registered at
+            # init_server from the recorded config) — no local duplicate
+            return None
+        if table_id not in self._tables:
+            self._tables[table_id] = MemorySparseTable(
+                dim, sgd_rule, learning_rate, initial_range,
+                accessor=accessor, embedx_threshold=embedx_threshold)
+        return self._tables[table_id]
+
+    def create_dense_table(self, table_id, size, sgd_rule="adam",
+                           learning_rate=0.01):
+        self._table_configs[table_id] = dict(
+            kind="dense", size=size, sgd_rule=sgd_rule,
+            learning_rate=learning_rate)
+        if table_id not in self._tables:
+            self._tables[table_id] = MemoryDenseTable(size, sgd_rule,
+                                                      learning_rate)
+        return self._tables[table_id]
+
+    def get_table(self, table_id):
+        return self._tables[table_id]
+
+    # ---- lifecycle ----
+    def init_server(self, *a, **k):
+        self._running = True
+        if not self.is_distributed:
+            return
+        from .service import PSServer
+        port = int(os.environ.get("PADDLE_PORT", "0") or 0)
+        host = os.environ.get("POD_IP", "127.0.0.1")
+        self._server = PSServer(host=host, port=port)
+        for tid, cfg in self._table_configs.items():
+            if cfg["kind"] == "sparse":
+                self._server.register_sparse_table(
+                    tid, cfg["dim"], cfg["sgd_rule"], cfg["learning_rate"],
+                    cfg["initial_range"], cfg.get("accessor", "ctr"),
+                    cfg.get("embedx_threshold", 10.0))
+            else:
+                self._server.register_dense_table(
+                    tid, cfg["size"], cfg["sgd_rule"], cfg["learning_rate"])
+
+    def run_server(self):
+        self._running = True
+        if self._server is not None:
+            self._server.run(background=False)
+
+    def init_worker(self, *a, **k):
+        if self.is_distributed and self._client is None:
+            from .service import PSClient
+            self._client = PSClient(self.server_endpoints)
+
+    def stop_worker(self):
+        """Finalize THIS worker only (reference fleet.stop_worker
+        semantics) — other trainers keep their servers."""
+        self._running = False
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def shutdown_servers(self):
+        """Explicit server shutdown (separate from worker teardown)."""
+        if self._client is None and self.is_distributed:
+            self.init_worker()
+        if self._client is not None:
+            self._client.stop_server()
+            self._client.close()
+            self._client = None
+        if self._server is not None:
+            self._server.stop()
+
+    def save_persistables(self, dirname):
+        import numpy as np
+        os.makedirs(dirname, exist_ok=True)
+        # on a PS server, the live tables are inside the PSServer
+        tables = self._server._tables if self._server is not None \
+            else self._tables
+        for tid, table in tables.items():
+            if isinstance(table, MemorySparseTable):
+                table.save(os.path.join(dirname, f"sparse_{tid}.bin"))
+            elif isinstance(table, MemoryDenseTable):
+                np.save(os.path.join(dirname, f"dense_{tid}.npy"),
+                        table.pull())
+
+    def load_persistables(self, dirname):
+        import numpy as np
+        tables = self._server._tables if self._server is not None \
+            else self._tables
+        for tid, table in tables.items():
+            if isinstance(table, MemorySparseTable):
+                path = os.path.join(dirname, f"sparse_{tid}.bin")
+                if os.path.exists(path):
+                    table.load(path)
+            elif isinstance(table, MemoryDenseTable):
+                path = os.path.join(dirname, f"dense_{tid}.npy")
+                if os.path.exists(path):
+                    table.set(np.load(path))
+
+
+_runtime = None
+
+
+def get_ps_runtime() -> PSRuntime:
+    global _runtime
+    if _runtime is None:
+        _runtime = PSRuntime()
+    return _runtime

@@ -1,0 +1,425 @@
+"""PS RPC transport: TCP server/client over the native tables.
+
+Parity: the brpc PS service pair (`paddle/fluid/distributed/ps/service/
+brpc_ps_server.h` / `brpc_ps_client.h`, wire proto `sendrecv.proto`) and
+`PSClient`/`PSServer` (`ps_client.h:63`, `server.h:62`). The storage and
+the SGD rules are the native C++ engine (ps/csrc); this module is the
+wire: a length-prefixed binary protocol over TCP, one thread per
+connection (the brpc threading model scaled down). Shards-by-key routing
+across multiple servers matches the reference's table sharding
+(`MemorySparseTable` shard_num semantics).
+
+Message format: [u32 len][u8 op][u32 table_id][payload]
+ops: 0 PULL_SPARSE (payload: u32 n, u64*n keys) -> f32 n*dim
+     1 PUSH_SPARSE (payload: u32 n, u64*n keys, f32 n*dim grads) -> u8 ok
+     2 PULL_DENSE  (payload: -) -> u32 n, f32*n
+     3 PUSH_DENSE  (payload: u32 n, f32*n grads) -> u8 ok
+     4 SAVE        (payload: u16 len, path) -> u8 ok
+     5 BARRIER     -> u8 ok
+     6 STOP        -> u8 ok
+     7 DENSE_ADD   (payload: u32 n, f32*n delta) -> u32 n, f32*n merged
+       (geo-async dense mode: server merges the trainer's delta and
+       returns the merged params in one round trip)
+     8 KV_SET      (payload: u16 klen, key, u32 vlen, val) -> u8 ok
+     9 KV_GET      (payload: u16 klen, key) -> u8 found, u32 vlen, val
+    10 KV_LIST     (payload: u16 plen, prefix) -> u32 cnt,
+       cnt x (u16 klen, key, u32 vlen, val)
+       (server-side KV namespace: the FL coordinator's client-info /
+       strategy exchange — CoordinatorClient/FLCommunicator parity —
+       and a TCPStore-style rendezvous primitive)
+
+Fault tolerance: the client transparently reconnects a broken server
+socket and retries the request ONCE (brpc_ps_client reconnect parity;
+pushes are at-least-once on retry, like the reference's async push).
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from .table import MemorySparseTable, MemoryDenseTable
+
+(PULL_SPARSE, PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, SAVE, BARRIER, STOP,
+ DENSE_ADD, KV_SET, KV_GET, KV_LIST) = range(11)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+class PSServer:
+    """One PS shard server process. Tables registered by id."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._tables = {}
+        # count-based trainer rendezvous (BarrierTable parity): BARRIER
+        # carries the participant count; connections block until all arrive
+        self._barrier_cond = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_generation = 0
+        self._kv = {}
+        self._kv_lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    while True:
+                        msg = _recv_msg(sock)
+                        if not outer._handle(sock, msg):
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = None
+
+    def register_sparse_table(self, table_id, dim=8, sgd_rule="adagrad",
+                              learning_rate=0.05, initial_range=0.02,
+                              accessor="ctr", embedx_threshold=10.0):
+        t = MemorySparseTable(dim, sgd_rule, learning_rate, initial_range,
+                              accessor=accessor,
+                              embedx_threshold=embedx_threshold)
+        self._tables[table_id] = t
+        return t
+
+    def register_dense_table(self, table_id, size, sgd_rule="adam",
+                             learning_rate=0.01):
+        t = MemoryDenseTable(size, sgd_rule, learning_rate)
+        self._tables[table_id] = t
+        return t
+
+    def _handle(self, sock, msg) -> bool:
+        op, table_id = struct.unpack("<BI", msg[:5])
+        body = msg[5:]
+        if op == STOP:
+            _send_msg(sock, b"\x01")
+            threading.Thread(target=self._server.shutdown,
+                             daemon=True).start()
+            return False
+        if op == BARRIER:
+            (n_participants,) = struct.unpack("<I", body[:4]) if body \
+                else (1,)
+            with self._barrier_cond:
+                gen = self._barrier_generation
+                self._barrier_count += 1
+                if self._barrier_count >= n_participants:
+                    self._barrier_count = 0
+                    self._barrier_generation += 1
+                    self._barrier_cond.notify_all()
+                else:
+                    self._barrier_cond.wait_for(
+                        lambda: self._barrier_generation != gen,
+                        timeout=300)
+            _send_msg(sock, b"\x01")
+            return True
+        if op == KV_SET:
+            (klen,) = struct.unpack("<H", body[:2])
+            key = body[2:2 + klen].decode()
+            (vlen,) = struct.unpack("<I", body[2 + klen:6 + klen])
+            val = body[6 + klen:6 + klen + vlen]
+            with self._kv_lock:
+                self._kv[key] = val
+            _send_msg(sock, b"\x01")
+            return True
+        if op == KV_GET:
+            (klen,) = struct.unpack("<H", body[:2])
+            key = body[2:2 + klen].decode()
+            with self._kv_lock:
+                val = self._kv.get(key)
+            if val is None:
+                _send_msg(sock, b"\x00" + struct.pack("<I", 0))
+            else:
+                _send_msg(sock, b"\x01" + struct.pack("<I", len(val))
+                          + val)
+            return True
+        if op == KV_LIST:
+            (plen,) = struct.unpack("<H", body[:2])
+            prefix = body[2:2 + plen].decode()
+            with self._kv_lock:
+                items = [(k, v) for k, v in self._kv.items()
+                         if k.startswith(prefix)]
+            out = struct.pack("<I", len(items))
+            for k, v in items:
+                kb = k.encode()
+                out += struct.pack("<H", len(kb)) + kb
+                out += struct.pack("<I", len(v)) + v
+            _send_msg(sock, out)
+            return True
+        table = self._tables[table_id]
+        if op == PULL_SPARSE:
+            (n,) = struct.unpack("<I", body[:4])
+            keys = np.frombuffer(body[4:4 + 8 * n], np.uint64)
+            vals = table.pull(keys.copy())
+            _send_msg(sock, vals.astype(np.float32).tobytes())
+        elif op == PUSH_SPARSE:
+            (n,) = struct.unpack("<I", body[:4])
+            keys = np.frombuffer(body[4:4 + 8 * n], np.uint64)
+            width = getattr(table, "row_width", table.dim)
+            grads = np.frombuffer(body[4 + 8 * n:], np.float32).reshape(
+                n, width)
+            table.push(keys.copy(), grads.copy())
+            _send_msg(sock, b"\x01")
+        elif op == PULL_DENSE:
+            vals = table.pull()
+            _send_msg(sock, struct.pack("<I", vals.size)
+                      + vals.astype(np.float32).tobytes())
+        elif op == PUSH_DENSE:
+            (n,) = struct.unpack("<I", body[:4])
+            grads = np.frombuffer(body[4:4 + 4 * n], np.float32)
+            table.push(grads.copy())
+            _send_msg(sock, b"\x01")
+        elif op == DENSE_ADD:
+            (n,) = struct.unpack("<I", body[:4])
+            delta = np.frombuffer(body[4:4 + 4 * n], np.float32)
+            table.add(delta.copy())
+            merged = table.pull()
+            _send_msg(sock, struct.pack("<I", merged.size)
+                      + merged.astype(np.float32).tobytes())
+        elif op == SAVE:
+            (ln,) = struct.unpack("<H", body[:2])
+            path = body[2:2 + ln].decode()
+            table.save(path)
+            _send_msg(sock, b"\x01")
+        return True
+
+    def run(self, background=True):
+        if background:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+
+
+class PSClient:
+    """Client with key-sharded routing across servers (BrpcPsClient
+    capability: shard_of(key) -> server)."""
+
+    def __init__(self, endpoints):
+        self.endpoints = [(h, int(p)) for h, p in
+                          (e.split(":") for e in endpoints)]
+        self._socks = [self._connect(i)
+                       for i in range(len(self.endpoints))]
+        self._lock = threading.Lock()
+
+    def _connect(self, si):
+        host, port = self.endpoints[si]
+        s = socket.create_connection((host, port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Connect quickly, but allow long replies: BARRIER legitimately
+        # parks the socket until the last participant arrives (server
+        # waits up to 300s), far beyond the 30s connect timeout this
+        # socket would otherwise inherit. Keep a bound (> the server's
+        # 300s barrier wait) so a dead server still errors out.
+        s.settimeout(330.0)
+        return s
+
+    def _request(self, si, payload: bytes, retry=True) -> bytes:
+        """Send + receive on server si, reconnecting and retrying ONCE on
+        a broken socket (brpc_ps_client reconnect capability). Retried
+        pushes are at-least-once, matching the reference's async push
+        semantics; non-idempotent ops (BARRIER: a double arrival would
+        release the rendezvous early) pass retry=False and surface the
+        error instead. Call with self._lock held."""
+        for attempt in (0, 1):
+            try:
+                _send_msg(self._socks[si], payload)
+                return _recv_msg(self._socks[si])
+            except (ConnectionError, OSError):
+                if attempt or not retry:
+                    raise
+                try:
+                    self._socks[si].close()
+                except OSError:
+                    pass
+                self._socks[si] = self._connect(si)
+        raise ConnectionError("unreachable")
+
+    def _shard_of(self, keys):
+        n = len(self._socks)
+        return ((keys * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(48)) \
+            % np.uint64(n)
+
+    def pull_sparse(self, table_id, keys: np.ndarray, dim: int):
+        shape = keys.shape
+        flat = keys.reshape(-1).astype(np.uint64)
+        out = np.empty((flat.size, dim), np.float32)
+        assign = self._shard_of(flat)
+        with self._lock:
+            for si in range(len(self._socks)):
+                idx = np.where(assign == si)[0]
+                if idx.size == 0:
+                    continue
+                sub = flat[idx]
+                payload = struct.pack("<BII", PULL_SPARSE, table_id,
+                                      sub.size) + sub.tobytes()
+                resp = self._request(si, payload)
+                out[idx] = np.frombuffer(resp, np.float32).reshape(
+                    sub.size, dim)
+        return out.reshape(*shape, dim)
+
+    def push_sparse(self, table_id, keys: np.ndarray, grads: np.ndarray,
+                    dim: int):
+        flat = keys.reshape(-1).astype(np.uint64)
+        g = grads.reshape(flat.size, dim).astype(np.float32)
+        assign = self._shard_of(flat)
+        with self._lock:
+            for si in range(len(self._socks)):
+                idx = np.where(assign == si)[0]
+                if idx.size == 0:
+                    continue
+                sub = flat[idx]
+                payload = struct.pack("<BII", PUSH_SPARSE, table_id,
+                                      sub.size) + sub.tobytes() + \
+                    g[idx].tobytes()
+                self._request(si, payload)
+
+    # -- KV namespace (FL coordinator exchange / rendezvous) ---------
+    def kv_set(self, key: str, value: bytes, server=0):
+        kb = key.encode()
+        payload = struct.pack("<BIH", KV_SET, 0, len(kb)) + kb + \
+            struct.pack("<I", len(value)) + value
+        with self._lock:
+            self._request(server, payload)
+
+    def kv_get(self, key: str, server=0):
+        kb = key.encode()
+        payload = struct.pack("<BIH", KV_GET, 0, len(kb)) + kb
+        with self._lock:
+            resp = self._request(server, payload)
+        if resp[0] == 0:
+            return None
+        (vlen,) = struct.unpack("<I", resp[1:5])
+        return resp[5:5 + vlen]
+
+    def kv_list(self, prefix: str, server=0):
+        pb = prefix.encode()
+        payload = struct.pack("<BIH", KV_LIST, 0, len(pb)) + pb
+        with self._lock:
+            resp = self._request(server, payload)
+        (cnt,) = struct.unpack("<I", resp[:4])
+        out, off = {}, 4
+        for _ in range(cnt):
+            (klen,) = struct.unpack("<H", resp[off:off + 2])
+            key = resp[off + 2:off + 2 + klen].decode()
+            off += 2 + klen
+            (vlen,) = struct.unpack("<I", resp[off:off + 4])
+            out[key] = resp[off + 4:off + 4 + vlen]
+            off += 4 + vlen
+        return out
+
+    def pull_dense(self, table_id, server=0):
+        with self._lock:
+            resp = self._request(server, struct.pack("<BI", PULL_DENSE,
+                                                     table_id))
+        (n,) = struct.unpack("<I", resp[:4])
+        return np.frombuffer(resp[4:], np.float32)[:n]
+
+    def push_dense(self, table_id, grads: np.ndarray, server=0):
+        g = grads.reshape(-1).astype(np.float32)
+        with self._lock:
+            self._request(server, struct.pack(
+                "<BII", PUSH_DENSE, table_id, g.size) + g.tobytes())
+
+    def push_dense_delta(self, table_id, delta: np.ndarray, server=0):
+        """Geo-async dense: merge a local delta into the server's params;
+        returns the merged params (one round trip). Never retried: the
+        additive merge is not idempotent — a reconnect retry could apply
+        the delta twice and silently offset the shared params."""
+        d = delta.reshape(-1).astype(np.float32)
+        with self._lock:
+            resp = self._request(server, struct.pack(
+                "<BII", DENSE_ADD, table_id, d.size) + d.tobytes(),
+                retry=False)
+        (n,) = struct.unpack("<I", resp[:4])
+        return np.frombuffer(resp[4:], np.float32)[:n]
+
+    def barrier(self, num_trainers=1):
+        """Block until `num_trainers` clients reach the barrier on each
+        server (count-based rendezvous)."""
+        with self._lock:
+            for si in range(len(self._socks)):
+                self._request(si, struct.pack("<BII", BARRIER, 0,
+                                              num_trainers), retry=False)
+
+    def save(self, table_id, path):
+        with self._lock:
+            for si in range(len(self._socks)):
+                p = f"{path}.shard{si}".encode()
+                self._request(si, struct.pack("<BIH", SAVE, table_id,
+                                              len(p)) + p)
+
+    def stop_server(self):
+        with self._lock:
+            for sock in self._socks:
+                try:
+                    _send_msg(sock, struct.pack("<BI", STOP, 0))
+                    _recv_msg(sock)
+                except (ConnectionError, OSError):
+                    pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class RemoteSparseTable:
+    """MemorySparseTable-compatible facade over PSClient (so
+    SparseEmbedding works transparently against remote servers — the
+    distributed_lookup_table capability)."""
+
+    def __init__(self, client: PSClient, table_id: int, dim: int,
+                 accessor="ctr"):
+        from .table import _ACCESSORS, ACCESSOR_CTR_DYMF
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        acc = _ACCESSORS[accessor] if isinstance(accessor, str) \
+            else int(accessor)
+        self.accessor = acc
+        # dymf rows travel as [embed_w, embedx(dim)] = 1+dim floats
+        self.row_width = 1 + dim if acc == ACCESSOR_CTR_DYMF else dim
+
+    def pull(self, keys):
+        return self.client.pull_sparse(self.table_id, np.asarray(keys),
+                                       self.row_width)
+
+    def push(self, keys, grads, shows=None, clicks=None, mf_dims=None,
+             slots=None):
+        self.client.push_sparse(self.table_id, np.asarray(keys),
+                                np.asarray(grads), self.row_width)
+
+    def __len__(self):
+        raise NotImplementedError("size query not in the wire protocol yet")

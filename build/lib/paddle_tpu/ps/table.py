@@ -1,0 +1,298 @@
+"""Python wrappers over the native PS tables.
+
+Parity surface: `Table`/`MemorySparseTable` (`paddle/fluid/distributed/ps/
+table/table.h:67`, `memory_sparse_table.h`) + `MemoryDenseTable`, with the
+accessor/SGD-rule semantics (`ctr_accessor.h`, `sparse_sgd_rule.h`)
+executing natively inside the table on push.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._native import get_lib, u64_ptr, f32_ptr, i32_ptr
+
+SGD_NAIVE = 0
+SGD_ADAGRAD = 1
+SGD_ADAM = 2
+
+_RULES = {"naive": SGD_NAIVE, "sgd": SGD_NAIVE, "adagrad": SGD_ADAGRAD,
+          "std_adagrad": SGD_ADAGRAD, "adam": SGD_ADAM}
+
+ACCESSOR_CTR = 0         # CtrCommonAccessor: float show/click
+ACCESSOR_CTR_DOUBLE = 1  # CtrDoubleAccessor: double show/click
+ACCESSOR_CTR_DYMF = 2    # CtrDymfAccessor: per-key dynamic mf dims
+
+_ACCESSORS = {"ctr": ACCESSOR_CTR, "CtrCommonAccessor": ACCESSOR_CTR,
+              "DownpourCtrAccessor": ACCESSOR_CTR,
+              "ctr_double": ACCESSOR_CTR_DOUBLE,
+              "CtrDoubleAccessor": ACCESSOR_CTR_DOUBLE,
+              "DownpourCtrDoubleAccessor": ACCESSOR_CTR_DOUBLE,
+              "ctr_dymf": ACCESSOR_CTR_DYMF,
+              "CtrDymfAccessor": ACCESSOR_CTR_DYMF}
+
+
+class MemorySparseTable:
+    """Sparse table with selectable accessor family.
+
+    accessor="ctr" (default, CtrCommonAccessor parity),
+    "ctr_double" (CtrDoubleAccessor: show/click accumulated in double —
+    exact CTR statistics at billions of impressions), or
+    "ctr_dymf" (CtrDymfAccessor: per-key dynamic mf dims — keys carry a
+    1-d embed_w from birth and only grow their mf block, at the slot's
+    dim, once their CTR score crosses `embedx_threshold`).
+    Ref: ctr_accessor.h, ctr_double_accessor.h:29, ctr_dymf_accessor.h:30.
+    """
+
+    def __init__(self, dim=8, sgd_rule="adagrad", learning_rate=0.05,
+                 initial_range=0.02, accessor="ctr",
+                 embedx_threshold=10.0):
+        self.dim = dim
+        self._lib = get_lib()
+        rule = _RULES[sgd_rule] if isinstance(sgd_rule, str) else sgd_rule
+        acc = _ACCESSORS[accessor] if isinstance(accessor, str) \
+            else int(accessor)
+        self.accessor = acc
+        if acc == ACCESSOR_CTR:
+            self._h = self._lib.pscore_sparse_create(
+                dim, rule, float(learning_rate), float(initial_range))
+        else:
+            self._h = self._lib.pscore_sparse_create2(
+                dim, rule, float(learning_rate), float(initial_range),
+                acc, float(embedx_threshold))
+        if self._h < 0:
+            raise ValueError(f"bad accessor {accessor}")
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """keys: uint64 [n] (any shape; flattened) -> float32 [*, dim].
+
+        dymf tables return rows [1 + dim]: [embed_w, embedx_w...] with
+        zeros past each key's allocated mf dim."""
+        shape = keys.shape
+        flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        if self.accessor == ACCESSOR_CTR_DYMF:
+            stride = 1 + self.dim
+            out = np.empty((flat.size, stride), np.float32)
+            self._lib.pscore_sparse_pull_dymf(
+                self._h, u64_ptr(flat), flat.size, f32_ptr(out), stride)
+            return out.reshape(*shape, stride)
+        out = np.empty((flat.size, self.dim), np.float32)
+        self._lib.pscore_sparse_pull(self._h, u64_ptr(flat), flat.size,
+                                     f32_ptr(out))
+        return out.reshape(*shape, self.dim)
+
+    def push(self, keys: np.ndarray, grads: np.ndarray, shows=None,
+             clicks=None, mf_dims=None, slots=None):
+        """dymf tables: grads rows are [embed_g, embedx_g(dim)];
+        `mf_dims` [n] gives each key's slot-configured mf dim (used the
+        moment the key matures past embedx_threshold; defaults to the
+        table max dim)."""
+        flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        sp = np.ascontiguousarray(np.asarray(shows).reshape(-1),
+                                  np.float32) if shows is not None \
+            else None
+        cp = np.ascontiguousarray(np.asarray(clicks).reshape(-1),
+                                  np.float32) if clicks is not None \
+            else None
+        if self.accessor == ACCESSOR_CTR_DYMF:
+            stride = 1 + self.dim
+            g = np.ascontiguousarray(grads.reshape(flat.size, stride),
+                                     dtype=np.float32)
+            md = np.ascontiguousarray(
+                np.asarray(mf_dims).reshape(-1) if mf_dims is not None
+                else np.full(flat.size, self.dim), np.int32)
+            sl = np.ascontiguousarray(np.asarray(slots).reshape(-1),
+                                      np.float32) if slots is not None \
+                else None
+            self._lib.pscore_sparse_push_dymf(
+                self._h, u64_ptr(flat), i32_ptr(md), f32_ptr(g),
+                flat.size, stride,
+                f32_ptr(sp) if sp is not None else None,
+                f32_ptr(cp) if cp is not None else None,
+                f32_ptr(sl) if sl is not None else None)
+            return
+        g = np.ascontiguousarray(grads.reshape(flat.size, self.dim),
+                                 dtype=np.float32)
+        self._lib.pscore_sparse_push(self._h, u64_ptr(flat), f32_ptr(g),
+                                     flat.size,
+                                     f32_ptr(sp) if sp is not None
+                                     else None,
+                                     f32_ptr(cp) if cp is not None
+                                     else None)
+
+    def key_stats(self, key: int):
+        """(show, click, mf_dim) of one key — show/click exact doubles
+        for the ctr_double accessor. None if the key is absent."""
+        import ctypes
+        show = ctypes.c_double()
+        click = ctypes.c_double()
+        mf = (np.zeros(1, np.int32))
+        rc = self._lib.pscore_sparse_key_stats(
+            self._h, ctypes.c_uint64(int(key)), ctypes.byref(show),
+            ctypes.byref(click), i32_ptr(mf))
+        if rc != 0:
+            return None
+        return float(show.value), float(click.value), int(mf[0])
+
+    @property
+    def row_width(self):
+        """Floats per key in pull/push payloads: dim, or 1+dim for dymf
+        ([embed_w, embedx...]). The PS wire protocol sizes rows by this."""
+        return 1 + self.dim if self.accessor == ACCESSOR_CTR_DYMF \
+            else self.dim
+
+    def __len__(self):
+        return int(self._lib.pscore_sparse_size(self._h))
+
+    def enable_spill(self, directory: str, max_mem_keys: int):
+        """SSDSparseTable capability (`ps/table/ssd_sparse_table.h`,
+        re-designed as log-structured per-shard files instead of rocksdb):
+        keys beyond `max_mem_keys` spill to disk and are promoted back on
+        touch. save()+load() compacts the logs."""
+        import os
+        os.makedirs(directory, exist_ok=True)
+        rc = self._lib.pscore_sparse_enable_spill(
+            self._h, directory.encode(), int(max_mem_keys))
+        if rc != 0:
+            raise IOError(f"enable_spill failed ({rc}): {directory}")
+
+    def mem_size(self):
+        return int(self._lib.pscore_sparse_mem_size(self._h))
+
+    def spill_size(self):
+        return int(self._lib.pscore_sparse_spill_size(self._h))
+
+    def shrink(self, threshold=0.0, max_unseen_days=30):
+        """Decay show/click + age + drop low-score features (Table::Shrink
+        parity). Spilled entries are not decayed in place; they age when
+        promoted back to memory."""
+        return int(self._lib.pscore_sparse_shrink(
+            self._h, float(threshold), int(max_unseen_days)))
+
+    def save(self, path: str):
+        rc = self._lib.pscore_sparse_save(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"sparse table save failed ({rc}): {path}")
+
+    def load(self, path: str):
+        rc = self._lib.pscore_sparse_load(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"sparse table load failed ({rc}): {path}")
+
+
+class MemoryDenseTable:
+    def __init__(self, size, sgd_rule="adam", learning_rate=0.01):
+        self.size = int(size)
+        self._lib = get_lib()
+        rule = _RULES[sgd_rule] if isinstance(sgd_rule, str) else sgd_rule
+        self._h = self._lib.pscore_dense_create(self.size, rule,
+                                                float(learning_rate))
+
+    def set(self, values: np.ndarray):
+        v = np.ascontiguousarray(values.reshape(-1), np.float32)
+        self._lib.pscore_dense_set(self._h, f32_ptr(v), v.size)
+
+    def pull(self) -> np.ndarray:
+        out = np.empty(self.size, np.float32)
+        self._lib.pscore_dense_pull(self._h, f32_ptr(out), self.size)
+        return out
+
+    def push(self, grads: np.ndarray):
+        g = np.ascontiguousarray(grads.reshape(-1), np.float32)
+        self._lib.pscore_dense_push(self._h, f32_ptr(g), g.size)
+
+    def add(self, delta: np.ndarray):
+        """Geo-async merge: server adds a trainer's local delta instead of
+        applying an SGD rule (communicator.h geo dense mode)."""
+        d = np.ascontiguousarray(delta.reshape(-1), np.float32)
+        self._lib.pscore_dense_add(self._h, f32_ptr(d), d.size)
+
+    def save(self, path: str):
+        np.save(path if path.endswith(".npy") else path + ".npy",
+                self.pull())
+
+    def load(self, path: str):
+        self.set(np.load(path if path.endswith(".npy") else path + ".npy"))
+
+
+class InMemoryDataset:
+    """Parity: `paddle.distributed.InMemoryDataset`
+    (`python/paddle/distributed/fleet/dataset/dataset.py`, C++
+    `data_set.h:230 LoadIntoMemory`): slot-file loading, in-memory global
+    shuffle, fixed-slot batch iteration — all native."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        self._h = self._lib.pscore_dataset_create()
+        self._files = []
+        self.slots = []
+        self.batch_size = 32
+        self.max_per_slot = 1
+
+    def init(self, batch_size=32, use_var=None, slots=None,
+             max_per_slot=1, **kw):
+        self.batch_size = batch_size
+        if slots is not None:
+            self.slots = [int(s) for s in slots]
+        self.max_per_slot = max_per_slot
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        for f in self._files:
+            rc = self._lib.pscore_dataset_load_file(self._h, f.encode())
+            if rc != 0:
+                raise IOError(f"failed to load {f}")
+
+    def load_from_generator(self, generator, files=None):
+        """Parse raw input files through a fleet `DataGenerator`
+        subclass (ps/data_generator.py — the user-parser API) into the
+        native record pool. `files` defaults to the set_filelist()
+        list; the generator's slot registry must align with the slot
+        ids passed to init()."""
+        import tempfile
+        files = list(files) if files is not None else list(self._files)
+
+        def lines():
+            for path in files:
+                with open(path) as fh:
+                    yield from fh
+
+        import os
+        tmp = tempfile.NamedTemporaryFile("w", suffix=".slot",
+                                          delete=False)
+        try:
+            with tmp:
+                generator.run_from_iterable(lines(), write=tmp.write)
+            rc = self._lib.pscore_dataset_load_file(self._h,
+                                                    tmp.name.encode())
+            if rc != 0:
+                raise IOError("failed to load generated slot file")
+        finally:
+            os.unlink(tmp.name)
+
+    def global_shuffle(self, fleet=None, seed=0):
+        self._lib.pscore_dataset_shuffle(self._h, seed)
+
+    local_shuffle = global_shuffle
+
+    def get_memory_data_size(self, fleet=None):
+        return int(self._lib.pscore_dataset_size(self._h))
+
+    def rewind(self):
+        self._lib.pscore_dataset_rewind(self._h)
+
+    def __iter__(self):
+        self.rewind()
+        n_slots = len(self.slots)
+        slot_arr = np.asarray(self.slots, np.int32)
+        while True:
+            keys = np.zeros((self.batch_size, n_slots, self.max_per_slot),
+                            np.uint64)
+            labels = np.zeros(self.batch_size, np.float32)
+            n = self._lib.pscore_dataset_next_batch(
+                self._h, self.batch_size, i32_ptr(slot_arr), n_slots,
+                self.max_per_slot, u64_ptr(keys), f32_ptr(labels))
+            if n <= 0:
+                return
+            yield keys[:n], labels[:n]

@@ -1,0 +1,64 @@
+"""Multi-threaded PS training loop.
+
+Parity: `exe.train_from_dataset` (`python/paddle/fluid/executor.py:2582` →
+`DistMultiTrainer` + `HogwildWorker::TrainFiles`
+(`framework/hogwild_worker.cc:223`)): N worker threads consume batches
+from the native Dataset channels, pull sparse embeddings, run the model,
+push gradients — Hogwild-style (lock-free on the shard-parallel native
+tables). Compiled steps release the GIL during XLA execution, so threads
+overlap host pull/push with device compute.
+"""
+from __future__ import annotations
+
+import threading
+
+from .table import InMemoryDataset
+
+
+class HogwildTrainer:
+    """train_from_dataset(dataset, step_fn, num_threads)."""
+
+    def __init__(self, num_threads=4):
+        self.num_threads = num_threads
+        self.metrics_lock = threading.Lock()
+        self.losses = []
+
+    def train_from_dataset(self, dataset: InMemoryDataset, step_fn,
+                           epochs=1, shuffle_seed=None):
+        """step_fn(keys, labels) -> float loss. Called concurrently from
+        worker threads; the PS tables underneath are shard-locked."""
+        for epoch in range(epochs):
+            if shuffle_seed is not None:
+                dataset.global_shuffle(seed=shuffle_seed + epoch)
+            else:
+                dataset.rewind()
+            it = iter(dataset)
+            it_lock = threading.Lock()
+            errors = []
+
+            def fetch():
+                with it_lock:
+                    return next(it, None)
+
+            def worker():
+                while True:
+                    batch = fetch()
+                    if batch is None:
+                        return
+                    try:
+                        loss = step_fn(*batch)
+                        with self.metrics_lock:
+                            self.losses.append(float(loss))
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(self.num_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        return self.losses

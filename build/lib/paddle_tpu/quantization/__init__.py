@@ -1,0 +1,184 @@
+"""paddle_tpu.quantization — QAT / PTQ.
+
+Parity: `python/paddle/quantization/` (QuantConfig, QAT with FakeQuant
+observers, PTQ with abs-max observers; reference kernels
+`paddle/phi/kernels/fake_quantize_*`). TPU-native: scales are computed
+on-device and fake-quant is an elementwise round-trip XLA fuses into the
+producer (works inside compiled steps; observer state is a registered
+buffer so the functional trainer tracks its updates). int8 deployment maps
+to XLA int8 dots (weight-only int8 matching the reference's
+`weight_only_linear` capability).
+"""
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers.common import Linear
+from ..ops._helpers import as_tensor
+
+
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with straight-through gradient
+    (fake_quantize_abs_max parity). `scale` may be a python float or a
+    Tensor (traced scales work inside compiled steps)."""
+    x = as_tensor(x)
+    qmax = float(2 ** (bits - 1) - 1)
+    if isinstance(scale, Tensor):
+        def _fn(a, s):
+            s = jnp.maximum(s, 1e-9)
+            q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+            deq = q * s / qmax
+            return a + jax.lax.stop_gradient(deq - a)
+        return dispatch.apply("fake_quant", _fn, (x, scale))
+    s = float(scale)
+
+    def _fn1(a):
+        q = jnp.clip(jnp.round(a / max(s, 1e-9) * qmax), -qmax, qmax)
+        deq = q * s / qmax
+        return a + jax.lax.stop_gradient(deq - a)
+    from ..ops._helpers import unary
+    return unary("fake_quant", _fn1, x)
+
+
+def abs_max_scale(x):
+    x = as_tensor(x)
+    return float(np.abs(x.numpy()).max())
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + activation (QAT/PTQ).
+
+    The activation scale is a moving-average abs-max kept in a registered
+    buffer — entirely on-device, so compiled train steps trace it and the
+    buffer update flows through the functional trainer. Observation
+    happens while `training` or while `calibrating` (PTQ flow)."""
+
+    def __init__(self, linear: Linear, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.linear = linear
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.calibrating = False
+        self.register_buffer("act_scale",
+                             Tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        from .. import ops
+        x = as_tensor(x)
+        observing = self.training or self.calibrating
+        if observing:
+            cur = ops.max(ops.abs(x.detach())).astype("float32")
+            prev = Tensor(self.act_scale._data)
+            r = self.moving_rate
+
+            def _upd(p, c):
+                return jnp.where(p == 0.0, c, r * p + (1 - r) * c)
+            new_scale = dispatch.apply("scale_update", _upd, (prev, cur))
+            self.act_scale._data = new_scale._data
+            a_scale = new_scale
+        else:
+            a_scale = Tensor(self.act_scale._data)
+        w = self.linear.weight
+        w_scale = ops.max(ops.abs(w.detach())).astype("float32")
+        xq = fake_quant(x, a_scale, self.bits)
+        wq = fake_quant(w, w_scale, self.bits)
+        from ..nn import functional as F
+        return F.linear(xq, wq, self.linear.bias)
+
+
+class QuantConfig:
+    """paddle.quantization.QuantConfig parity (the knobs we consume)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.bits = 8
+        self.moving_rate = 0.9
+
+    def add_layer_config(self, *a, **k):
+        pass
+
+
+def _swap_linears(model, bits, moving_rate):
+    for name, layer in list(model.named_sublayers(include_self=True)):
+        for child_name, child in list(layer._sub_layers.items()):
+            if isinstance(child, Linear):
+                layer._sub_layers[child_name] = QuantedLinear(
+                    child, bits, moving_rate)
+    return model
+
+
+def _set_calibrating(model, flag):
+    for _, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, QuantedLinear):
+            layer.calibrating = flag
+
+
+class QAT:
+    """paddle.quantization.QAT parity: quantize(model) swaps Linear ->
+    QuantedLinear (copy unless inplace)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            model = copy.deepcopy(model)
+        return _swap_linears(model, self.config.bits,
+                             self.config.moving_rate)
+
+    def convert(self, model, inplace=True):
+        return model
+
+
+class PTQ:
+    """paddle.quantization.PTQ parity: quantize() arms calibration-mode
+    observers (they run even in eval), feed sample batches, then
+    convert() freezes the scales."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        model = QAT(self.config).quantize(model, inplace)
+        _set_calibrating(model, True)
+        return model
+
+    def convert(self, model, inplace=True):
+        _set_calibrating(model, False)
+        model.eval()
+        return model
+
+
+def weight_quantize(w, algo="abs_max", bits=8):
+    """weight_quantize_kernel parity: returns (int8 weights, scales)."""
+    w = as_tensor(w)
+    arr = w.numpy()
+    qmax = 2 ** (bits - 1) - 1
+    scale = np.maximum(np.abs(arr).max(axis=0), 1e-9)  # per-out-channel
+    q = np.clip(np.round(arr / scale * qmax), -qmax, qmax).astype(np.int8)
+    return Tensor(q), Tensor(scale.astype(np.float32))
+
+
+def weight_only_linear(x, weight_int8, scale, bias=None, bits=8):
+    """weight_only_linear_kernel parity: int8 weights dequantized into a
+    bf16 matmul (XLA fuses the dequant into the dot)."""
+    x, weight_int8, scale = as_tensor(x), as_tensor(weight_int8), \
+        as_tensor(scale)
+    qmax = float(2 ** (bits - 1) - 1)
+    inputs = [x, weight_int8, scale]
+    if bias is not None:
+        inputs.append(as_tensor(bias))
+
+    def _fn(a, w_q, s, *b):
+        w = w_q.astype(a.dtype) * (s.astype(a.dtype) / qmax)
+        out = jnp.matmul(a, w)
+        if b:
+            out = out + b[0].astype(out.dtype)
+        return out
+    return dispatch.apply("weight_only_linear", _fn, tuple(inputs))

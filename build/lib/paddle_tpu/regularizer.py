@@ -1,0 +1,18 @@
+"""paddle.regularizer parity (`python/paddle/regularizer.py`)."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay:
+    """L1 decay; applied via grad += coeff * sign(param) in the fused step
+    (not yet wired into the optimizer fast path — treated as L2 for now is
+    WRONG, so it raises if used until implemented)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

@@ -1,0 +1,236 @@
+"""paddle_tpu.sparse — sparse tensors.
+
+Parity: `paddle.sparse` (`python/paddle/incubate/sparse/` in the snapshot:
+SparseCooTensor/SparseCsrTensor, `paddle/phi/core/sparse_coo_tensor.h`)
+over `jax.experimental.sparse` (BCOO — XLA-lowerable sparse ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+
+
+class SparseTensor(Tensor):
+    """Tensor holding a BCOO; densifies lazily when a dense op touches it
+    (so inherited Tensor methods keep working — a dense fallback, like the
+    reference's coo→dense kernel fallbacks)."""
+
+    __slots__ = ("_bcoo", "_dense_cache")
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        self._dense_cache = None
+        super().__init__(jnp.zeros((), jnp.float32),
+                         stop_gradient=stop_gradient)
+        self._dense_cache = None  # discard the placeholder written above
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._bcoo.todense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: [ndim, nnz] (paddle layout)."""
+    idx = as_tensor(indices)._data
+    vals = as_tensor(values, dtype=dtype)._data
+    idx_t = jnp.swapaxes(idx, 0, 1).astype(jnp.int32)  # [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(i) for i in (idx.max(axis=1) + 1).tolist())
+    bcoo = jsparse.BCOO((vals, idx_t), shape=tuple(int(s) for s in shape))
+    return SparseTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    crows = np.asarray(as_tensor(crows).numpy())
+    cols = np.asarray(as_tensor(cols).numpy())
+    vals = as_tensor(values, dtype=dtype)._data
+    # expand crows to row indices -> BCOO
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     jnp.asarray(cols, jnp.int32)], axis=1)
+    bcoo = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def matmul(x, y):
+    """sparse @ dense — BCOO dot_general, no densification."""
+    if isinstance(x, SparseTensor):
+        yd = as_tensor(y)._data
+        return Tensor(x._bcoo @ yd)
+    raise TypeError("sparse.matmul expects a SparseTensor lhs")
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector."""
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated ONLY at `mask`'s nonzero positions
+    (reference sparse.masked_matmul / SDDMM): out is sparse with mask's
+    pattern. Computes a gathered row·col dot per nonzero — O(nnz·k), not
+    O(n·m·k)."""
+    xd = as_tensor(x)._data
+    yd = as_tensor(y)._data
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows = xd[idx[:, 0], :]          # [nnz, k]
+    cols = yd[:, idx[:, 1]].T        # [nnz, k]
+    vals = jnp.sum(rows * cols, axis=-1).astype(xd.dtype)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def add(x, y):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return SparseTensor(x._bcoo + y._bcoo)
+    raise TypeError("sparse.add expects SparseTensors")
+
+
+def _unary_on_values(fn, x: "SparseTensor") -> "SparseTensor":
+    """Value-space op: touches only the nnz values (real sparse compute,
+    like the reference's sparse unary kernels
+    `paddle/phi/kernels/sparse/unary_kernel.h`)."""
+    b = x._bcoo
+    return SparseTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                     shape=b.shape))
+
+
+def relu(x):
+    return _unary_on_values(lambda v: jnp.maximum(v, 0), x)
+
+
+def sin(x):
+    return _unary_on_values(jnp.sin, x)
+
+
+def tanh(x):
+    return _unary_on_values(jnp.tanh, x)
+
+
+def sqrt(x):
+    return _unary_on_values(jnp.sqrt, x)
+
+
+def abs(x):  # noqa: A001 - paddle API name
+    return _unary_on_values(jnp.abs, x)
+
+
+def neg(x):
+    return _unary_on_values(jnp.negative, x)
+
+
+def pow(x, factor):  # noqa: A001 - paddle API name
+    return _unary_on_values(lambda v: jnp.power(v, factor), x)
+
+
+def scale(x, scale_, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return _unary_on_values(lambda v: v * scale_ + bias, x)
+    return _unary_on_values(lambda v: (v + bias) * scale_, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtype as dtype_mod
+    b = x._bcoo
+    vals = b.data if value_dtype is None else \
+        b.data.astype(dtype_mod.convert_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(dtype_mod.convert_dtype(index_dtype))
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+def multiply(x, y):
+    """elementwise sparse*sparse (same pattern) or sparse*scalar."""
+    if isinstance(y, (int, float)):
+        return _unary_on_values(lambda v: v * y, x)
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return SparseTensor(jsparse.bcoo_multiply_sparse(x._bcoo,
+                                                         y._bcoo))
+    raise TypeError("sparse.multiply expects sparse operands or a scalar")
+
+
+def transpose(x, perm):
+    return SparseTensor(jsparse.bcoo_transpose(x._bcoo,
+                                               permutation=tuple(perm)))
+
+
+def coalesce(x):
+    """Sum duplicate coordinates (reference CoalesceKernel)."""
+    return SparseTensor(jsparse.bcoo_sum_duplicates(x._bcoo))
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over the SPARSE pattern only (2-D COO; the
+    reference's sparse softmax semantics: missing entries are -inf, i.e.
+    excluded), via segment max/sum over the row index — O(nnz)."""
+    b = x._bcoo
+    if len(b.shape) != 2 or axis not in (-1, 1):
+        raise NotImplementedError("sparse.softmax: 2-D, last axis only")
+    rows = b.indices[:, 0]
+    n_rows = b.shape[0]
+    rmax = jax.ops.segment_max(b.data, rows, num_segments=n_rows)
+    e = jnp.exp(b.data - rmax[rows])
+    rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    return SparseTensor(jsparse.BCOO((e / rsum[rows], b.indices),
+                                     shape=b.shape))
+
+
+def is_sparse(x):
+    return isinstance(x, SparseTensor)
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _SparseSoftmax:
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        return softmax(x, self.axis)
+
+
+class nn:  # namespace shim: paddle.sparse.nn.ReLU()/Softmax()
+    ReLU = _SparseReLU
+    Softmax = _SparseSoftmax

@@ -1,0 +1,28 @@
+"""paddle.static shim.
+
+The reference's static Program/Executor stack (SURVEY.md §2.2) is subsumed
+by whole-step jax.jit (see jit/). This module keeps the few static symbols
+user code touches: InputSpec, and save/load_inference_model mapped onto
+jit.save/load (StableHLO export = the inference Program).
+"""
+from ..hapi.model import InputSpec  # noqa: F401
+from .. import jit as _jit
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "static graphs are not part of the TPU-native design; use "
+        "paddle_tpu.jit.save(layer, path, input_spec=[...]) which exports "
+        "an AOT StableHLO module (AnalysisPredictor capability)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return _jit.load(path_prefix)
+
+
+class Executor:
+    def __init__(self, place=None):
+        raise NotImplementedError(
+            "the static Executor is replaced by compiled eager execution "
+            "(SURVEY.md §7.5); use paddle_tpu.jit.to_static or Model.fit")

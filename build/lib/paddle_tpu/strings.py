@@ -1,0 +1,135 @@
+"""String tensors + string kernels.
+
+Parity: `paddle/phi/core/string_tensor.h` (pstring arrays) and the
+strings kernel family (`paddle/phi/kernels/strings/` —
+strings_lower/strings_upper with UTF-8 handling) plus the
+faster_tokenizer custom op the reference ships for NLP serving
+(`paddle/fluid/operators/fused/` fork focus). TPU-native stance: strings
+are HOST data in the reference too (strings kernels are CPU-only);
+here they live as numpy object arrays feeding int token tensors into
+the compiled path — the tokenizer emits `Tensor[int32]`, which is where
+the TPU program starts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+class StringTensor:
+    """A shaped array of (unicode) strings — phi::StringTensor parity."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data.tolist()!r})"
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            return bool((self._data == other._data).all())
+        return NotImplemented
+
+
+def to_string_tensor(data, name=None):
+    return StringTensor(data, name)
+
+
+def _map(fn, x: StringTensor) -> StringTensor:
+    out = np.empty(x._data.shape, dtype=object)
+    flat_in = x._data.reshape(-1)
+    flat_out = out.reshape(-1)
+    for i, s in enumerate(flat_in):
+        flat_out[i] = fn(s)
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding=True):
+    """strings_lower kernel parity (python str.lower is full-unicode)."""
+    return _map(str.lower, x)
+
+
+def upper(x, use_utf8_encoding=True):
+    return _map(str.upper, x)
+
+
+class FasterTokenizer:
+    """Vocabulary-driven whitespace + greedy-wordpiece tokenizer
+    (faster_tokenizer op capability): StringTensor batch ->
+    (input_ids, seq_len) int32 Tensors, padded, ready for a compiled
+    encoder."""
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 cls_token="[CLS]", sep_token="[SEP]", pad_token="[PAD]",
+                 max_seq_len=128):
+        self.vocab = dict(vocab)
+        self.do_lower_case = do_lower_case
+        self.unk = unk_token
+        self.cls = cls_token
+        self.sep = sep_token
+        self.pad = pad_token
+        self.max_seq_len = max_seq_len
+
+    def _wordpiece(self, word):
+        if word in self.vocab:
+            return [word]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+    def __call__(self, text):
+        if isinstance(text, StringTensor):
+            texts = [str(s) for s in text._data.reshape(-1)]
+        elif isinstance(text, str):
+            texts = [text]
+        else:
+            texts = [str(s) for s in text]
+        ids_rows, lens = [], []
+        for t in texts:
+            if self.do_lower_case:
+                t = t.lower()
+            toks = [self.cls]
+            for w in t.split():
+                toks.extend(self._wordpiece(w))
+            toks.append(self.sep)
+            if len(toks) > self.max_seq_len:
+                # truncation preserves the special-token frame
+                toks = toks[: self.max_seq_len - 1] + [self.sep]
+            ids = [self.vocab.get(tok, self.vocab.get(self.unk, 0))
+                   for tok in toks]
+            lens.append(len(ids))
+            ids_rows.append(ids)
+        width = max(lens)
+        pad_id = self.vocab.get(self.pad, 0)
+        out = np.full((len(ids_rows), width), pad_id, np.int32)
+        for i, row in enumerate(ids_rows):
+            out[i, : len(row)] = row
+        return (Tensor(out),
+                Tensor(np.asarray(lens, np.int32)))

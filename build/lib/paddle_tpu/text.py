@@ -1,0 +1,111 @@
+"""paddle_tpu.text — `python/paddle/text/` parity essentials.
+
+Datasets are zero-egress synthetic stand-ins (same API shapes); the real
+op here is viterbi_decode (`paddle.text.viterbi_decode`,
+`paddle/phi/kernels/viterbi_decode_kernel.h`) as a lax.scan.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dispatch
+from .core.tensor import Tensor
+from .ops._helpers import as_tensor
+from .io import Dataset
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """potentials [B, T, N], transition [N, N] (+2 rows/cols when
+    include_bos_eos_tag, matching the reference layout where the last two
+    tags are BOS/EOS). `lengths` [B] masks padded timesteps (required
+    input in the reference; defaults to full length here).
+    Returns (scores [B], paths [B, T])."""
+    potentials = as_tensor(potentials)
+    transition_params = as_tensor(transition_params)
+    B, T, N = potentials.shape
+    if lengths is None:
+        lengths = np.full((B,), T, np.int32)
+    lengths = as_tensor(lengths)
+
+    def _fn(pot, trans, lens):
+        if include_bos_eos_tag:
+            start = trans[-2][:N]
+            stop = trans[:N, -1]
+            trans_core = trans[:N, :N]
+        else:
+            start = jnp.zeros((N,))
+            stop = jnp.zeros((N,))
+            trans_core = trans
+
+        alpha0 = pot[:, 0] + start[None, :]
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+
+        def step(alpha, xs):
+            emit, t = xs
+            valid = (t < lens)[:, None]            # [B,1]
+            scores = alpha[:, :, None] + trans_core[None]
+            best = jnp.max(scores, axis=1) + emit
+            back = jnp.argmax(scores, axis=1)
+            # frozen past each sequence's end: alpha carries, backpointer
+            # is identity so backtracking repeats the final tag
+            alpha_new = jnp.where(valid, best, alpha)
+            back = jnp.where(valid, back, ident)
+            return alpha_new, back
+
+        ts = jnp.arange(1, T)
+        alpha_f, backs = jax.lax.scan(
+            step, alpha0, (jnp.swapaxes(pot[:, 1:], 0, 1), ts))
+        alpha_f = alpha_f + stop[None, :]
+        scores = jnp.max(alpha_f, axis=-1)
+        last = jnp.argmax(alpha_f, axis=-1)
+
+        def backtrack(carry, back):
+            tag = carry
+            prev = jnp.take_along_axis(back, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+        paths = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1),
+                                 last[:, None]], axis=1)
+        return scores, paths.astype(jnp.int32)
+    return dispatch.apply("viterbi_decode", _fn,
+                          (potentials, transition_params, lengths))
+
+
+class _SyntheticTextDataset(Dataset):
+    def __init__(self, size, seq_len, vocab, n_classes, seed):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randint(1, vocab, (size, seq_len)).astype(np.int64)
+        self.y = rng.randint(0, n_classes, (size,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.x[idx], np.array([self.y[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(_SyntheticTextDataset):
+    """API-shaped stand-in (zero-egress image)."""
+
+    def __init__(self, mode="train", cutoff=150):
+        super().__init__(2000 if mode == "train" else 400, 64, 5000, 2,
+                         0 if mode == "train" else 1)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, mode="train"):
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.x[idx], np.array([self.y[idx]], np.float32)
+
+    def __len__(self):
+        return len(self.x)

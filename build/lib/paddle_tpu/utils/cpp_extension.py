@@ -1,0 +1,132 @@
+"""Custom C++ ops — paddle.utils.cpp_extension parity.
+
+Parity: `python/paddle/utils/cpp_extension/` (`load(sources)` JIT-compiles
+user C++ against `paddle/extension.h` and registers ops). TPU-native: the
+user writes a plain C ABI elementwise/host function; `load()` builds it
+with g++ and wraps it as a paddle_tpu op via `jax.pure_callback` (host
+execution, like the reference's CPU custom kernels) with an optional
+custom backward. Device-side custom kernels are written in Pallas instead
+(ops/pallas/).
+
+User C signature convention:
+    extern "C" void <name>(const float* x, float* out, long long n);
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+
+_cache = {}
+
+
+def _build(source_paths, extra_cxx_flags=None) -> str:
+    blob = b""
+    for p in source_paths:
+        with open(p, "rb") as f:
+            blob += f.read()
+    blob += " ".join(extra_cxx_flags or []).encode()
+    tag = hashlib.sha1(blob).hexdigest()[:16]
+    out = os.path.join(tempfile.gettempdir(), f"pt_customop_{tag}.so")
+    if not os.path.exists(out):
+        cmd = (["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
+               + list(extra_cxx_flags or []) + list(source_paths)
+               + ["-o", out])
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"custom op build failed ({' '.join(cmd)}):\n"
+                f"{proc.stderr[-4000:]}")
+    return out
+
+
+class CustomOpModule:
+    def __init__(self, lib_path, op_names, backward_map=None):
+        self._lib = ctypes.CDLL(lib_path)
+        self._backward_map = backward_map or {}
+        for name in op_names:
+            fn = getattr(self._lib, name)
+            fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                           ctypes.POINTER(ctypes.c_float),
+                           ctypes.c_longlong]
+            setattr(self, name, self._make_op(name))
+
+    def _host_call(self, name, arr):
+        cfn = getattr(self._lib, name)
+
+        def call(a):
+            a = np.ascontiguousarray(a, np.float32)
+            out = np.empty_like(a)
+            cfn(a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                a.size)
+            return out
+        return call(arr)
+
+    def _make_op(self, name):
+        bwd_name = self._backward_map.get(name)
+
+        def jax_fn(a):
+            return jax.pure_callback(
+                lambda x: self._host_call(name, x),
+                jax.ShapeDtypeStruct(a.shape, jnp.float32),
+                a.astype(jnp.float32))
+
+        if bwd_name is not None:
+            @jax.custom_vjp
+            def op_core(a):
+                return jax_fn(a)
+
+            def fwd(a):
+                return jax_fn(a), a
+
+            def bwd(res, g):
+                # backward C fn computes d(op)/dx elementwise from x
+                dydx = jax.pure_callback(
+                    lambda x: self._host_call(bwd_name, x),
+                    jax.ShapeDtypeStruct(res.shape, jnp.float32),
+                    res.astype(jnp.float32))
+                return (g * dydx,)
+            op_core.defvjp(fwd, bwd)
+            core = op_core
+            differentiable = True
+        else:
+            core = jax_fn
+            differentiable = False
+
+        def op(x):
+            x = as_tensor(x)
+            return dispatch.apply(f"custom_{name}", core, (x,),
+                                  differentiable=differentiable)
+        op.__name__ = name
+        return op
+
+
+def load(name=None, sources=None, extra_cxx_flags=None, op_names=None,
+         backward_map=None, verbose=False, **kwargs):
+    """paddle.utils.cpp_extension.load parity (C-ABI convention above).
+
+    op_names: exported C symbols to wrap (default: [name]).
+    backward_map: {op: bwd_symbol} where bwd computes elementwise dy/dx.
+    """
+    assert sources, "sources required"
+    srcs = list(sources) if isinstance(sources, (list, tuple)) \
+        else [sources]
+    lib = _build(srcs, extra_cxx_flags)
+    names = op_names or ([name] if name else [])
+    assert names, "op_names (or name) required"
+    key = (lib, tuple(names),
+           tuple(sorted((backward_map or {}).items())))
+    if key not in _cache:
+        _cache[key] = CustomOpModule(lib, names, backward_map)
+    return _cache[key]

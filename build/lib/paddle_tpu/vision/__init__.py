@@ -1,0 +1,6 @@
+"""paddle_tpu.vision — `python/paddle/vision/` parity."""
+from . import models  # noqa: F401
+from . import datasets  # noqa: F401
+from . import transforms  # noqa: F401
+from . import ops  # noqa: F401
+from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,118 @@
+"""Vision datasets — parity: `python/paddle/vision/datasets/`.
+
+Zero-egress environment: when the on-disk dataset files exist (same paths
+paddle uses: ~/.cache/paddle/dataset/...), they are parsed; otherwise a
+deterministic synthetic dataset with the right shapes/classes is generated
+so training pipelines (Model.fit, benchmarks) run unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class MNIST(Dataset):
+    """`python/paddle/vision/datasets/mnist.py` parity."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.expanduser("~/.cache/paddle/dataset/mnist")
+        tag = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{tag}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            with gzip.open(image_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(
+                    f.read(), dtype=np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self.labels = np.frombuffer(f.read(), dtype=np.uint8)
+        else:
+            n = synthetic_size or (6000 if mode == "train" else 1000)
+            rng = np.random.RandomState(42 if mode == "train" else 43)
+            self.labels = rng.randint(0, 10, n).astype(np.uint8)
+            # class-dependent blobs so a real model can actually learn
+            self.images = np.zeros((n, 28, 28), np.uint8)
+            for i, lab in enumerate(self.labels):
+                img = rng.rand(28, 28) * 64
+                r, c = divmod(int(lab), 4)
+                img[r * 7:(r + 1) * 7 + 7, c * 7:(c + 1) * 7] += 160
+                self.images[i] = np.clip(img, 0, 255).astype(np.uint8)
+        self.images = self.images.astype(np.float32) / 255.0
+        self.labels = self.labels.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (5000 if mode == "train" else 1000)
+        rng = np.random.RandomState(7 if mode == "train" else 8)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+        for i, lab in enumerate(self.labels):
+            self.images[i, lab % 3, :, :] = np.clip(
+                self.images[i, lab % 3].astype(np.int32) + 60, 0, 255)
+        self.images = self.images.astype(np.float32) / 255.0
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        super().__init__(data_file, mode, transform, download, backend,
+                         synthetic_size)
+        rng = np.random.RandomState(9)
+        self.labels = rng.randint(0, 100, len(self.images)).astype(np.int64)
+
+
+class FakeImageNet(Dataset):
+    """Synthetic ImageNet-shaped dataset for the ResNet-50 benchmark."""
+
+    def __init__(self, size=1024, image_shape=(3, 224, 224),
+                 num_classes=1000, mode="train"):
+        rng = np.random.RandomState(0)
+        self.size = size
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self._base = rng.rand(64, *image_shape).astype(np.float32)
+        self._labels = rng.randint(0, num_classes, size).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return (self._base[idx % 64],
+                np.array([self._labels[idx]], dtype=np.int64))
+
+    def __len__(self):
+        return self.size

@@ -1,0 +1,121 @@
+"""DenseNet — parity: `python/paddle/vision/models/densenet.py`
+(densenet121/161/169/201/264). Dense connectivity: each layer's input is
+the channel-concat of all previous layers' outputs in the block; BN-ReLU-
+Conv pre-activation ordering."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth_rate, bn_size, dropout):
+        super().__init__()
+        inter = bn_size * growth_rate
+        self.norm1 = nn.BatchNorm2D(in_ch)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_ch, inter, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(inter)
+        self.conv2 = nn.Conv2D(inter, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.norm1(x)))
+        y = self.conv2(self.relu(self.norm2(y)))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return concat([x, y], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, n_layers, in_ch, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(in_ch + i * growth_rate, growth_rate, bn_size,
+                        dropout) for i in range(n_layers)])
+        self.out_channels = in_ch + n_layers * growth_rate
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_ch)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        init_ch, growth, block_cfg = _CFG[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        ch = init_ch
+        for i, n in enumerate(block_cfg):
+            blk = _DenseBlock(n, ch, growth, bn_size, dropout)
+            blocks.append(blk)
+            ch = blk.out_channels
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch = ch // 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm5 = nn.BatchNorm2D(ch)
+        self.relu5 = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu5(self.norm5(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)

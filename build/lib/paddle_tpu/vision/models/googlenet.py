@@ -1,0 +1,98 @@
+"""GoogLeNet (Inception v1) — parity:
+`python/paddle/vision/models/googlenet.py` (main head + two auxiliary
+classifier heads in train mode)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+def _conv_relu(inp, oup, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(inp, oup, k, stride=stride, padding=padding),
+        nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_relu(inp, c1, 1)
+        self.b2 = nn.Sequential(_conv_relu(inp, c3r, 1),
+                                _conv_relu(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv_relu(inp, c5r, 1),
+                                _conv_relu(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_relu(inp, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, inp, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _conv_relu(inp, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = flatten(self.conv(self.pool(x)), 1)
+        return self.fc2(self.drop(self.relu(self.fc1(x))))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_relu(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_relu(64, 64, 1),
+            _conv_relu(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.ince3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.ince4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.ince5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.ince3b(self.ince3a(x)))
+        x = self.ince4a(x)
+        aux1 = self.aux1(x) if (self.num_classes > 0 and self.training) \
+            else None
+        x = self.ince4d(self.ince4c(self.ince4b(x)))
+        aux2 = self.aux2(x) if (self.num_classes > 0 and self.training) \
+            else None
+        x = self.pool4(self.ince4e(x))
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(flatten(x, 1)))
+        if self.training and self.num_classes > 0:
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

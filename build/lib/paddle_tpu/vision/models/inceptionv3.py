@@ -1,0 +1,146 @@
+"""InceptionV3 — parity: `python/paddle/vision/models/inceptionv3.py`
+(299x299 stem, factorized 7x7 branches, grid-reduction blocks)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+def _cbr(inp, oup, k, stride=1, padding=0):
+    if isinstance(k, int):
+        k = (k, k)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    return nn.Sequential(
+        nn.Conv2D(inp, oup, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(oup), nn.ReLU())
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_feat):
+        super().__init__()
+        self.b1 = _cbr(inp, 64, 1)
+        self.b5 = nn.Sequential(_cbr(inp, 48, 1),
+                                _cbr(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_cbr(inp, 64, 1),
+                                _cbr(64, 96, 3, padding=1),
+                                _cbr(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbr(inp, pool_feat, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _cbr(inp, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_cbr(inp, 64, 1),
+                                 _cbr(64, 96, 3, padding=1),
+                                 _cbr(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Factorized 7x7 branches."""
+
+    def __init__(self, inp, ch7):
+        super().__init__()
+        self.b1 = _cbr(inp, 192, 1)
+        self.b7 = nn.Sequential(
+            _cbr(inp, ch7, 1),
+            _cbr(ch7, ch7, (1, 7), padding=(0, 3)),
+            _cbr(ch7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _cbr(inp, ch7, 1),
+            _cbr(ch7, ch7, (7, 1), padding=(3, 0)),
+            _cbr(ch7, ch7, (1, 7), padding=(0, 3)),
+            _cbr(ch7, ch7, (7, 1), padding=(3, 0)),
+            _cbr(ch7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbr(inp, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class _ReductionB(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = nn.Sequential(_cbr(inp, 192, 1),
+                                _cbr(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _cbr(inp, 192, 1),
+            _cbr(192, 192, (1, 7), padding=(0, 3)),
+            _cbr(192, 192, (7, 1), padding=(3, 0)),
+            _cbr(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _cbr(inp, 320, 1)
+        self.b3_stem = _cbr(inp, 384, 1)
+        self.b3_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_cbr(inp, 448, 1),
+                                      _cbr(448, 384, 3, padding=1))
+        self.b3d_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbr(inp, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       self.b3_a(s), self.b3_b(s),
+                       self.b3d_a(d), self.b3d_b(d),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3),
+            _cbr(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _cbr(64, 80, 1), _cbr(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64), _ReductionA(288),
+            _InceptionB(768, 128), _InceptionB(768, 160),
+            _InceptionB(768, 160), _InceptionB(768, 192),
+            _ReductionB(768),
+            _InceptionC(1280), _InceptionC(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,109 @@
+"""MobileNetV1/V2 — parity: `python/paddle/vision/models/mobilenetv1.py`,
+`mobilenetv2.py` (depthwise-separable convs)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _conv_bn(inp, oup, stride, kernel=3, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(inp, oup, kernel, stride,
+                  padding=(kernel - 1) // 2, groups=groups,
+                  bias_attr=False),
+        nn.BatchNorm2D(oup),
+        nn.ReLU())
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+            [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 2)]
+        for inp, oup, s in cfg:
+            layers.append(_conv_bn(c(inp), c(inp), s, groups=c(inp)))
+            layers.append(_conv_bn(c(inp), c(oup), 1, kernel=1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(inp, hidden, 1, kernel=1))
+        layers += [
+            _conv_bn(hidden, hidden, stride, groups=hidden),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+
+        def c(ch):
+            return max(8, int(ch * scale))
+        layers = [_conv_bn(3, c(32), 2)]
+        inp = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(InvertedResidual(
+                    inp, c(ch), s if i == 0 else 1, t))
+                inp = c(ch)
+        layers.append(_conv_bn(inp, c(1280), 1, kernel=1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(c(1280), num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,145 @@
+"""MobileNetV3 (small/large) — parity:
+`python/paddle/vision/models/mobilenetv3.py`: inverted residuals with
+squeeze-excitation and hardswish."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _cbn(inp, oup, k, stride=1, groups=1, act=None):
+    layers = [nn.Conv2D(inp, oup, k, stride=stride, padding=k // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(oup)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        mid = _make_divisible(ch // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _Block(nn.Layer):
+    def __init__(self, inp, exp, oup, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if exp != inp:
+            layers.append(_cbn(inp, exp, 1, act=act))
+        layers.append(_cbn(exp, exp, k, stride=stride, groups=exp,
+                           act=act))
+        if se:
+            layers.append(_SE(exp))
+        layers.append(_cbn(exp, oup, 1, act=None))
+        self.body = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.body(x)
+        return x + y if self.use_res else y
+
+
+# (kernel, exp, out, SE, act, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        inp = _make_divisible(16 * scale)
+        self.stem = _cbn(3, inp, 3, stride=2, act="hardswish")
+        blocks = []
+        for k, exp, out, se, act, stride in config:
+            e = _make_divisible(exp * scale)
+            o = _make_divisible(out * scale)
+            blocks.append(_Block(inp, e, o, k, stride, se, act))
+            inp = o
+        self.blocks = nn.Sequential(*blocks)
+        # tail width = last block's expansion width (no identity check:
+        # callers may pass modified configs)
+        last_exp = _make_divisible(config[-1][1] * scale)
+        self.tail = _cbn(inp, last_exp, 1, act="hardswish")
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.tail(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(_LARGE, 1280, scale=scale, **kw)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(_SMALL, 1024, scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -1,0 +1,125 @@
+"""ShuffleNetV2 — parity: `python/paddle/vision/models/shufflenetv2.py`.
+Channel-split + depthwise units with channel shuffle between groups."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten, reshape, transpose
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _conv_bn_relu(inp, oup, k, stride=1, groups=1, relu=True,
+                  act="relu"):
+    pad = k // 2
+    layers = [nn.Conv2D(inp, oup, k, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(oup)]
+    if relu:
+        layers.append(nn.Swish() if act == "swish" else nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn_relu(branch, branch, 1, act=act),
+                _conv_bn_relu(branch, branch, 3, stride, groups=branch,
+                              relu=False),
+                _conv_bn_relu(branch, branch, 1, act=act))
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn_relu(inp, inp, 3, stride, groups=inp,
+                              relu=False),
+                _conv_bn_relu(inp, branch, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn_relu(inp, branch, 1, act=act),
+                _conv_bn_relu(branch, branch, 3, stride, groups=branch,
+                              relu=False),
+                _conv_bn_relu(branch, branch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        chs = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn_relu(3, chs[0], 3, stride=2, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = chs[0]
+        for stage_i, rep in enumerate(_REPEATS):
+            oup = chs[stage_i + 1]
+            units = [_InvertedResidual(inp, oup, 2, act=act)]
+            units += [_InvertedResidual(oup, oup, 1, act=act)
+                      for _ in range(rep - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = _conv_bn_relu(inp, chs[4], 1, act=act)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chs[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv5(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)

@@ -1,0 +1,134 @@
+"""Vision ops — parity: `python/paddle/vision/ops.py` (nms, roi_align,
+box ops; deform_conv planned)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor
+from ..core import dispatch
+
+
+def _nms_single(b, s, iou_threshold):
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-9)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    return keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (host loop — eager-only like the reference's CPU path).
+    boxes [N,4] (x1,y1,x2,y2); per-category when category_idxs given.
+    Returns kept indices sorted by score."""
+    b = as_tensor(boxes).numpy()
+    s = as_tensor(scores).numpy() if scores is not None else \
+        np.arange(len(b), 0, -1, dtype=np.float32)
+    if category_idxs is not None:
+        cats = as_tensor(category_idxs).numpy()
+        cat_list = (as_tensor(categories).numpy().tolist()
+                    if categories is not None else np.unique(cats).tolist())
+        keep = []
+        for c in cat_list:
+            idx = np.where(cats == c)[0]
+            if idx.size == 0:
+                continue
+            kept = _nms_single(b[idx], s[idx], iou_threshold)
+            keep.extend(idx[kept].tolist())
+    else:
+        keep = _nms_single(b, s, iou_threshold)
+    keep = np.asarray(sorted(keep, key=lambda i: -s[i]), np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def box_area(boxes):
+    boxes = as_tensor(boxes)
+
+    def _fn(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return dispatch.apply("box_area", _fn, (boxes,))
+
+
+def box_iou(boxes1, boxes2):
+    boxes1, boxes2 = as_tensor(boxes1), as_tensor(boxes2)
+
+    def _fn(b1, b2):
+        a1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        a2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter,
+                                   1e-9)
+    return dispatch.apply("box_iou", _fn, (boxes1, boxes2))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign via bilinear grid sampling (XLA gather).
+    x [N,C,H,W]; boxes [R,4]; boxes_num [N]."""
+    x, boxes = as_tensor(x), as_tensor(boxes)
+    boxes_num = as_tensor(boxes_num)
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def _fn(img, bxs, bn):
+        R = bxs.shape[0]
+        C, H, W = img.shape[1], img.shape[2], img.shape[3]
+        # map each roi to its batch image
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=R)
+        off = 0.5 if aligned else 0.0
+        x1 = bxs[:, 0] * spatial_scale - off
+        y1 = bxs[:, 1] * spatial_scale - off
+        x2 = bxs[:, 2] * spatial_scale - off
+        y2 = bxs[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * \
+            (rh[:, None] / oh)                       # [R, oh]
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * \
+            (rw[:, None] / ow)                       # [R, ow]
+
+        def bilinear(r):
+            im = img[batch_idx[r]]                   # [C,H,W]
+            yy = jnp.clip(ys[r], 0, H - 1)
+            xx = jnp.clip(xs[r], 0, W - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, H - 1)
+            x1_ = jnp.minimum(x0 + 1, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            # gather 4 corners: [C, oh, ow]
+            def g(yi, xi):
+                return im[:, yi][:, :, xi]
+            out = (g(y0, x0) * (1 - wy)[None, :, None]
+                   * (1 - wx)[None, None, :]
+                   + g(y1_, x0) * wy[None, :, None]
+                   * (1 - wx)[None, None, :]
+                   + g(y0, x1_) * (1 - wy)[None, :, None]
+                   * wx[None, None, :]
+                   + g(y1_, x1_) * wy[None, :, None]
+                   * wx[None, None, :])
+            return out
+        return jax.vmap(bilinear)(jnp.arange(R))
+    return dispatch.apply("roi_align", _fn, (x, boxes, boxes_num))

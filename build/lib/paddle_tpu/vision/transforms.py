@@ -1,0 +1,414 @@
+"""Minimal numpy transforms — parity: `python/paddle/vision/transforms/`.
+
+Operate on numpy CHW float arrays (the DataLoader host path).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
+                self.data_format == "CHW" and arr.shape[0] not in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = arr[None]
+        return (arr - self.mean) / self.std
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        c, h, w = arr.shape
+        th, tw = self.size
+        ys = (np.arange(th) * (h / th)).astype(np.int64).clip(0, h - 1)
+        xs = (np.arange(tw) * (w / tw)).astype(np.int64).clip(0, w - 1)
+        return arr[:, ys][:, :, xs]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(img[..., ::-1])
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            arr = np.pad(arr, ((0, 0), (self.padding, self.padding),
+                               (self.padding, self.padding)))
+        c, h, w = arr.shape
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[:, i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        c, h, w = arr.shape
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return arr[:, i:i + th, j:j + tw]
+
+
+class BaseTransform:
+    """`transforms.BaseTransform` parity: subclass and implement
+    `_apply_image` (and `_apply_<key>` for other keys); inputs are
+    dispatched per key — keys without a matching `_apply_<key>` pass
+    through untouched."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            out = []
+            for key, item in zip(self.keys, inputs):
+                fn = getattr(self, f"_apply_{key}", None)
+                out.append(fn(item) if fn is not None else item)
+            out.extend(inputs[len(self.keys):])
+            return type(inputs)(out)
+        return self._apply_image(inputs)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding  # (left, top, right, bottom)
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        if self.mode == "constant":
+            return np.pad(arr, ((0, 0), (t, b), (l, r)),
+                          constant_values=self.fill)
+        return np.pad(arr, ((0, 0), (t, b), (l, r)), mode=self.mode)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(np.asarray(img)[..., ::-1, :])
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+        return np.repeat(gray, self.n, axis=0)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1.0 - self.value),
+                      1.0 + self.value)
+        return np.clip(np.asarray(img, np.float32) * f, 0,
+                       255.0 if np.asarray(img).max() > 1.5 else 1.0)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        f = np.random.uniform(max(0.0, 1.0 - self.value),
+                      1.0 + self.value)
+        mean = arr.mean()
+        hi = 255.0 if arr.max() > 1.5 else 1.0
+        return np.clip(mean + (arr - mean) * f, 0, hi)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        gray = (0.299 * arr[0] + 0.587 * arr[1]
+                + 0.114 * arr[2])[None]
+        f = np.random.uniform(max(0.0, 1.0 - self.value),
+                      1.0 + self.value)
+        hi = 255.0 if arr.max() > 1.5 else 1.0
+        return np.clip(gray + (arr - gray) * f, 0, hi)
+
+
+class HueTransform:
+    """Approximate hue shift by rotating chroma channels in YIQ space."""
+
+    def __init__(self, value):
+        self.value = float(value)  # fraction of the hue circle (<=0.5)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        hi = 255.0 if arr.max() > 1.5 else 1.0
+        theta = np.random.uniform(-self.value, self.value) * 2 * np.pi
+        r, g, b = arr[0] / hi, arr[1] / hi, arr[2] / hi
+        y = 0.299 * r + 0.587 * g + 0.114 * b
+        i = 0.596 * r - 0.274 * g - 0.322 * b
+        q = 0.211 * r - 0.523 * g + 0.312 * b
+        i2 = i * np.cos(theta) - q * np.sin(theta)
+        q2 = i * np.sin(theta) + q * np.cos(theta)
+        r2 = y + 0.956 * i2 + 0.621 * q2
+        g2 = y - 0.272 * i2 - 0.647 * q2
+        b2 = y - 1.106 * i2 + 1.703 * q2
+        return np.clip(np.stack([r2, g2, b2]) * hi, 0, hi)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x = np.random.randint(0, w - cw + 1)
+                return self._resize(arr[:, y:y + ch, x:x + cw])
+        return self._resize(arr)  # fallback: whole image
+
+
+def _affine_grid_sample(arr, mat, fill=0.0):
+    """Nearest-neighbour inverse-warp by a 2x3 affine matrix (host)."""
+    c, h, w = arr.shape
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # centre-origin coordinates
+    xc, yc = xs - (w - 1) / 2.0, ys - (h - 1) / 2.0
+    sx = mat[0, 0] * xc + mat[0, 1] * yc + mat[0, 2] + (w - 1) / 2.0
+    sy = mat[1, 0] * xc + mat[1, 1] * yc + mat[1, 2] + (h - 1) / 2.0
+    sxr = np.round(sx).astype(np.int64)
+    syr = np.round(sy).astype(np.int64)
+    valid = (sxr >= 0) & (sxr < w) & (syr >= 0) & (syr < h)
+    out = np.full_like(arr, fill, dtype=np.float32)
+    out[:, valid] = arr[:, syr[valid], sxr[valid]]
+    return out
+
+
+class RandomRotation:
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        a = np.deg2rad(np.random.uniform(*self.degrees))
+        # inverse rotation matrix
+        mat = np.array([[np.cos(a), np.sin(a), 0],
+                        [-np.sin(a), np.cos(a), 0]], np.float32)
+        return _affine_grid_sample(arr, mat, self.fill)
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        c, h, w = arr.shape
+        a = np.deg2rad(np.random.uniform(*self.degrees))
+        s = np.random.uniform(*self.scale) if self.scale else 1.0
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        if isinstance(self.shear, (list, tuple)):
+            sh = np.deg2rad(np.random.uniform(self.shear[0],
+                                              self.shear[1]))
+        elif isinstance(self.shear, (int, float)) and self.shear:
+            sh = np.deg2rad(np.random.uniform(-self.shear, self.shear))
+        else:
+            sh = 0.0
+        # inverse of rotate+scale+shear+translate
+        cs, sn = np.cos(a), np.sin(a)
+        fwd = np.array([[s * cs, s * (-sn + np.tan(sh) * cs)],
+                        [s * sn, s * (cs + np.tan(sh) * sn)]], np.float32)
+        inv = np.linalg.inv(fwd)
+        mat = np.zeros((2, 3), np.float32)
+        mat[:, :2] = inv
+        mat[:, 2] = -inv @ np.array([tx, ty], np.float32)
+        return _affine_grid_sample(arr, mat, self.fill)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5, fill=0):
+        self.prob = prob
+        self.scale = distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img, np.float32)
+        c, h, w = arr.shape
+        d = self.scale
+        # random shifts of the four corners -> projective transform
+        src = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                       np.float32)
+        jitter = np.random.uniform(0, d, (4, 2)).astype(np.float32) \
+            * np.array([w / 2, h / 2], np.float32)
+        signs = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], np.float32)
+        dst = src + jitter * signs
+        # solve the 8-dof homography dst -> src (inverse warp)
+        A, bvec = [], []
+        for (xs_, ys_), (xd, yd) in zip(src, dst):
+            A.append([xd, yd, 1, 0, 0, 0, -xs_ * xd, -xs_ * yd])
+            bvec.append(xs_)
+            A.append([0, 0, 0, xd, yd, 1, -ys_ * xd, -ys_ * yd])
+            bvec.append(ys_)
+        hvec = np.linalg.solve(np.asarray(A, np.float32),
+                               np.asarray(bvec, np.float32))
+        H = np.append(hvec, 1.0).reshape(3, 3)
+        ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        denom = H[2, 0] * xs + H[2, 1] * ys + H[2, 2]
+        sx = (H[0, 0] * xs + H[0, 1] * ys + H[0, 2]) / denom
+        sy = (H[1, 0] * xs + H[1, 1] * ys + H[1, 2]) / denom
+        sxr, syr = np.round(sx).astype(np.int64), \
+            np.round(sy).astype(np.int64)
+        valid = (sxr >= 0) & (sxr < w) & (syr >= 0) & (syr < h)
+        out = np.full_like(arr, self.fill, dtype=np.float32)
+        out[:, valid] = arr[:, syr[valid], sxr[valid]]
+        return out
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.array(img, np.float32)
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                y = np.random.randint(0, h - eh)
+                x = np.random.randint(0, w - ew)
+                arr[:, y:y + eh, x:x + ew] = self.value
+                return arr
+        return arr

@@ -1,0 +1,30 @@
+"""Build hook: compile the native PS engine (libps_core.so) at install.
+
+The C-ABI library (no pybind dependency — loaded via ctypes) is the one
+native component; everything device-side is jax/XLA. `_native.py` also
+rebuilds it on import when the source is newer, so editable installs
+never ship a stale binary.
+"""
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        super().run()
+        import os
+        src = os.path.join("paddle_tpu", "ps", "csrc", "ps_core.cpp")
+        for root in (self.build_lib, "."):
+            out_dir = os.path.join(root, "paddle_tpu", "ps", "csrc")
+            if not os.path.isdir(out_dir):
+                continue
+            out = os.path.join(out_dir, "libps_core.so")
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
+                   "-o", out, "-lpthread"]
+            print("building native ps_core:", " ".join(cmd))
+            subprocess.run(cmd, check=True)
+
+
+setup(cmdclass={"build_py": BuildWithNative})
