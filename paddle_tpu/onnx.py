@@ -1,23 +1,56 @@
-"""paddle.onnx shim — export goes through StableHLO instead.
+"""paddle.onnx — real ONNX artifact export.
 
-The reference exports via paddle2onnx (`python/paddle/onnx/export.py`).
-The TPU-native serving artifact is the StableHLO module written by
-`paddle_tpu.jit.save(layer, path, input_spec=...)`; ONNX conversion from
-StableHLO is an ecosystem tool concern, not a framework one.
+Parity: `python/paddle/onnx/export.py` (paddle2onnx). The model's
+forward is traced to a jaxpr (parameters captured as initializers) and
+converted primitive-by-primitive into an ONNX GraphProto
+(onnx_export.py over the hand-rolled protobuf writer in
+onnx_format.py — the `onnx` package is not a dependency). Models whose
+graphs use primitives outside the supported set raise
+UnsupportedOnnxExport; the StableHLO path (`paddle_tpu.jit.save`)
+remains the full-fidelity serving artifact.
 """
+from __future__ import annotations
+
+from .onnx_export import UnsupportedOnnxExport  # noqa: F401
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    import os
-    import pickle
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Write `<path>.onnx` (reference semantics: `path` is the stem).
+    Returns the artifact path."""
+    import jax
+    import numpy as np
 
-    from . import jit
-    jit.save(layer, path, input_spec=input_spec)
-    artifact = path + ".stablehlo"
-    if not os.path.exists(artifact):
-        with open(path + ".pdmodel", "rb") as f:
-            meta = pickle.load(f)
-        raise RuntimeError(
-            "StableHLO export failed: "
-            f"{meta.get('export_error', 'no input_spec given')}")
+    from .core.tensor import Tensor
+    from .core import autograd
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+
+    examples = []
+    for spec in input_spec:
+        shape = [1 if (d is None or d == -1) else int(d)
+                 for d in spec.shape]
+        examples.append(np.zeros(shape, np.dtype(spec.dtype or
+                                                 "float32")))
+
+    was_training = getattr(layer, "training", False)
+    layer.eval()
+    try:
+        def pure(*xs):
+            with autograd.no_grad():
+                out = layer(*[Tensor(x) for x in xs])
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in outs)
+
+        closed = jax.make_jaxpr(pure)(*examples)
+    finally:
+        if was_training:
+            layer.train()
+    from .onnx_export import export_jaxpr
+    artifact = path if path.endswith(".onnx") else path + ".onnx"
+    export_jaxpr(closed, examples, artifact,
+                 graph_name=type(layer).__name__,
+                 input_dims=[list(s.shape) for s in input_spec],
+                 opset=int(opset_version))
     return artifact

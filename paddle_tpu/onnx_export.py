@@ -1,0 +1,574 @@
+"""jaxpr -> ONNX graph converter + numpy ONNX interpreter.
+
+Parity: `python/paddle/onnx/export.py` (paddle2onnx) — the deliverable
+is an actual .onnx protobuf artifact. TPU-native re-design: instead of
+walking a static Program, the model's forward is traced to a jaxpr
+(params captured as constants -> initializers) and each primitive maps
+to an ONNX op. The interpreter (`run_model`) executes a decoded model
+in numpy so tests verify exported artifacts end-to-end without the
+`onnx`/`onnxruntime` packages (absent in this environment).
+
+Supported primitive set: the nn layer library's inference graphs —
+matmul/dot_general, conv (NCHW, groups), elementwise arithmetic,
+(log)softmax-style reductions, max/avg pooling via reduce_window,
+transpose/reshape/broadcast/concat/slice/squeeze, tanh/erf/exp/log/
+rsqrt/logistic, select_n, convert_element_type. Anything else raises
+UnsupportedOnnxExport with the primitive name.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import onnx_format as F
+
+
+class UnsupportedOnnxExport(NotImplementedError):
+    pass
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.names = {}          # jax var -> onnx name
+        self.counter = 0
+
+    def name_of(self, var):
+        if var not in self.names:
+            self.counter += 1
+            self.names[var] = f"t{self.counter}"
+        return self.names[var]
+
+    def fresh(self, prefix="tmp"):
+        self.counter += 1
+        return f"{prefix}{self.counter}"
+
+    def add_const(self, arr, name=None):
+        name = name or self.fresh("const")
+        self.initializers.append(F.tensor(name, _np(arr)))
+        return name
+
+    def add_node(self, op, inputs, outputs=None, attrs=None):
+        out = outputs or [self.fresh(op.lower())]
+        self.nodes.append(F.node(op, inputs, out, attrs=attrs or {}))
+        return out[0]
+
+    # ---- primitive handlers ------------------------------------------
+    def convert_eqn(self, eqn, inp):
+        """inp: list of onnx names (or np constants) for eqn.invars."""
+        p = eqn.primitive.name
+        out_var = eqn.outvars[0]
+        out = self.name_of(out_var)
+        a = inp
+
+        def n(op, ins, attrs=None):
+            self.add_node(op, ins, [out], attrs)
+
+        binops = {"add": "Add", "sub": "Sub", "mul": "Mul",
+                  "div": "Div", "max": "Max", "min": "Min",
+                  "pow": "Pow"}
+        unops = {"tanh": "Tanh", "exp": "Exp", "log": "Log",
+                 "logistic": "Sigmoid", "erf": "Erf", "neg": "Neg",
+                 "abs": "Abs", "sqrt": "Sqrt", "floor": "Floor",
+                 "ceil": "Ceil", "sign": "Sign", "sin": "Sin",
+                 "cos": "Cos", "stop_gradient": "Identity",
+                 "copy": "Identity"}
+        if p in binops:
+            n(binops[p], a)
+        elif p == "rem":
+            # jax rem = C fmod (sign of dividend); ONNX Mod defaults to
+            # divisor-sign semantics and is spec-invalid on floats
+            n("Mod", a, {"fmod": 1})
+        elif p in unops:
+            n(unops[p], [a[0]])
+        elif p == "erfc":
+            e = self.add_node("Erf", [a[0]])
+            one = self.add_const(np.ones((), _np_dtype(eqn.invars[0])))
+            n("Sub", [one, e])
+        elif p == "rsqrt":
+            s = self.add_node("Sqrt", [a[0]])
+            one = self.add_const(np.ones((), _np_dtype(eqn.invars[0])))
+            n("Div", [one, s])
+        elif p == "integer_pow":
+            y = eqn.params["y"]
+            e = self.add_const(
+                np.asarray(y, _np_dtype(eqn.invars[0])))
+            n("Pow", [a[0], e])
+        elif p == "dot_general":
+            self._dot_general(eqn, a, out)
+        elif p == "conv_general_dilated":
+            self._conv(eqn, a, out)
+        elif p == "reduce_window_max":
+            self._pool(eqn, a, out, "MaxPool")
+        elif p == "reduce_window_sum":
+            self._pool(eqn, a, out, "_SumPool")
+        elif p in ("reduce_sum", "reduce_max", "reduce_min",
+                   "reduce_prod"):
+            op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+                  "reduce_min": "ReduceMin",
+                  "reduce_prod": "ReduceProd"}[p]
+            axes = [int(x) for x in eqn.params["axes"]]
+            if op == "ReduceSum":
+                # opset 13 moved ReduceSum's axes to a second INPUT
+                ax = self.add_const(np.asarray(axes, np.int64))
+                n(op, [a[0], ax], {"keepdims": 0})
+            else:
+                n(op, [a[0]], {"axes": axes, "keepdims": 0})
+        elif p == "reduce_and":
+            axes = [int(x) for x in eqn.params["axes"]]
+            f32 = self.add_node("Cast", [a[0]], attrs={"to": F.FLOAT})
+            red = self.add_node("ReduceMin", [f32],
+                                attrs={"axes": axes, "keepdims": 0})
+            n("Cast", [red], {"to": F.BOOL})
+        elif p == "transpose":
+            n("Transpose", [a[0]],
+              {"perm": [int(x) for x in eqn.params["permutation"]]})
+        elif p == "reshape":
+            sizes = [int(s) for s in eqn.params["new_sizes"]]
+            in_shape = eqn.invars[0].aval.shape
+            if sizes and in_shape and sizes[0] == in_shape[0]:
+                # leading (batch) dim preserved -> export as dynamic so
+                # flatten-style reshapes work at any batch size
+                sizes = [-1] + sizes[1:]
+            shp = self.add_const(np.asarray(sizes, np.int64))
+            n("Reshape", [a[0], shp])
+        elif p == "squeeze":
+            axes = [int(x) for x in eqn.params["dimensions"]]
+            shp = self.add_const(
+                np.asarray(eqn.outvars[0].aval.shape, np.int64))
+            n("Reshape", [a[0], shp])
+        elif p == "broadcast_in_dim":
+            self._broadcast(eqn, a, out)
+        elif p == "concatenate":
+            n("Concat", a, {"axis": int(eqn.params["dimension"])})
+        elif p == "slice":
+            starts = [int(x) for x in eqn.params["start_indices"]]
+            ends = [int(x) for x in eqn.params["limit_indices"]]
+            axes = list(range(len(starts)))
+            strides = eqn.params.get("strides")
+            attrs = [self.add_const(np.asarray(v, np.int64))
+                     for v in (starts, ends, axes,
+                               strides or [1] * len(starts))]
+            n("Slice", [a[0]] + attrs)
+        elif p == "rev":
+            dims = [int(x) for x in eqn.params["dimensions"]]
+            shape = eqn.invars[0].aval.shape
+            starts = self.add_const(np.asarray(
+                [shape[d] - 1 for d in dims], np.int64))
+            ends = self.add_const(np.asarray(
+                [-(shape[d] + 1) for d in dims], np.int64))
+            axes_c = self.add_const(np.asarray(dims, np.int64))
+            steps = self.add_const(np.asarray([-1] * len(dims), np.int64))
+            n("Slice", [a[0], starts, ends, axes_c, steps])
+        elif p == "select_n":
+            # select_n(pred, on_false, on_true) with bool pred
+            n("Where", [a[0], a[2], a[1]])
+        elif p == "convert_element_type":
+            to = F._NP2ONNX[np.dtype(eqn.params["new_dtype"])]
+            n("Cast", [a[0]], {"to": int(to)})
+        elif p in ("eq", "ne", "lt", "le", "gt", "ge"):
+            op = {"eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+                  "gt": "Greater", "ge": "GreaterOrEqual"}.get(p)
+            if p == "ne":
+                e = self.add_node("Equal", a)
+                n("Not", [e])
+            else:
+                n(op, a)
+        elif p == "and":
+            n("And", a)
+        elif p == "or":
+            n("Or", a)
+        elif p == "not":
+            n("Not", [a[0]])
+        elif p == "iota":
+            dt = _np_dtype(eqn.outvars[0])
+            arr = np.arange(eqn.outvars[0].aval.shape[
+                eqn.params["dimension"]], dtype=dt)
+            arr = np.broadcast_to(
+                arr.reshape([-1 if i == eqn.params["dimension"] else 1
+                             for i in range(
+                                 len(eqn.outvars[0].aval.shape))]),
+                eqn.outvars[0].aval.shape)
+            cname = self.add_const(np.ascontiguousarray(arr))
+            n("Identity", [cname])
+        elif p in ("custom_jvp_call", "custom_vjp_call", "pjit", "jit",
+                   "closed_call", "core_call", "remat"):
+            self._subjaxpr(eqn, a)
+        else:
+            raise UnsupportedOnnxExport(
+                f"primitive '{p}' has no ONNX mapping")
+
+    def _subjaxpr(self, eqn, inp):
+        sub = eqn.params.get("call_jaxpr") or eqn.params.get("jaxpr")
+        if sub is None:
+            raise UnsupportedOnnxExport(eqn.primitive.name)
+        closed = sub if hasattr(sub, "jaxpr") else None
+        jaxpr = closed.jaxpr if closed is not None else sub
+        consts = closed.consts if closed is not None else []
+        if eqn.primitive.name == "custom_jvp_call":
+            # invars beyond the jaxpr's inputs are tangent plumbing
+            inp = inp[:len(jaxpr.invars) - len(jaxpr.constvars)]
+        self._walk(jaxpr, consts, inp, eqn.outvars)
+
+    def _walk(self, jaxpr, consts, in_names, final_outvars=None):
+        for cv, cval in zip(jaxpr.constvars, consts):
+            self.names[cv] = self.add_const(_np(cval))
+        for v, name in zip(jaxpr.invars, in_names):
+            self.names[v] = name
+        for eqn in jaxpr.eqns:
+            inp = []
+            for iv in eqn.invars:
+                if hasattr(iv, "val"):  # Literal
+                    inp.append(self.add_const(_np(iv.val)))
+                else:
+                    inp.append(self.name_of(iv))
+            self.convert_eqn(eqn, inp)
+        outs = []
+        for ov in jaxpr.outvars:
+            if hasattr(ov, "val"):
+                outs.append(self.add_const(_np(ov.val)))
+            else:
+                outs.append(self.name_of(ov))
+        if final_outvars is not None:
+            for fv, name in zip(final_outvars, outs):
+                self.names[fv] = name
+        return outs
+
+    def _dot_general(self, eqn, a, out):
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        lshape = eqn.invars[0].aval.shape
+        rshape = eqn.invars[1].aval.shape
+        # plain matmul: contract last of lhs with second-to-last (2D) or
+        # first (2D rhs) of rhs, no batch dims
+        if not lb and not rb and len(lc) == 1 and len(rc) == 1 and \
+                lc[0] == len(lshape) - 1 and \
+                rc[0] == max(len(rshape) - 2, 0):
+            self.add_node("MatMul", a, [out])
+            return
+        raise UnsupportedOnnxExport(
+            f"dot_general dims {eqn.params['dimension_numbers']}")
+
+    def _conv(self, eqn, a, out):
+        dn = eqn.params["dimension_numbers"]
+        # normalize arbitrary operand layouts (our conv uses channels-
+        # last internally) to ONNX's NCHW/OIHW via Transpose nodes
+        lhs_perm = (dn.lhs_spec[0], dn.lhs_spec[1]) + \
+            tuple(dn.lhs_spec[2:])
+        rhs_perm = (dn.rhs_spec[0], dn.rhs_spec[1]) + \
+            tuple(dn.rhs_spec[2:])
+        x_in, w_in = a[0], a[1]
+        if lhs_perm != tuple(range(len(lhs_perm))):
+            x_in = self.add_node("Transpose", [x_in],
+                                 attrs={"perm": list(lhs_perm)})
+        if rhs_perm != tuple(range(len(rhs_perm))):
+            w_in = self.add_node("Transpose", [w_in],
+                                 attrs={"perm": list(rhs_perm)})
+        strides = [int(s) for s in eqn.params["window_strides"]]
+        pads = eqn.params["padding"]
+        dil = [int(d) for d in eqn.params["rhs_dilation"]]
+        groups = int(eqn.params["feature_group_count"])
+        onnx_pads = [int(p[0]) for p in pads] + [int(p[1]) for p in pads]
+        attrs = {"strides": strides, "pads": onnx_pads,
+                 "dilations": dil, "group": groups}
+        out_spec = dn.out_spec
+        canon_out = (out_spec[0], out_spec[1]) + tuple(out_spec[2:])
+        if canon_out == tuple(range(len(canon_out))):
+            self.add_node("Conv", [x_in, w_in], [out], attrs)
+            return
+        y = self.add_node("Conv", [x_in, w_in], attrs=attrs)
+        # NCHW -> the jaxpr's expected output layout: expected dim
+        # out_spec[k] holds NCHW dim k, so transpose axes[out_spec[k]]=k
+        perm = [0] * len(canon_out)
+        perm[out_spec[0]] = 0
+        perm[out_spec[1]] = 1
+        for i, s in enumerate(out_spec[2:]):
+            perm[s] = 2 + i
+        self.add_node("Transpose", [y], [out], {"perm": perm})
+
+    def _pool(self, eqn, a, out, kind):
+        dims = [int(d) for d in eqn.params["window_dimensions"]]
+        strides = [int(s) for s in eqn.params["window_strides"]]
+        pads = [tuple(map(int, p)) for p in eqn.params["padding"]]
+        rank = len(dims)
+        if rank != 4:
+            raise UnsupportedOnnxExport(f"pooling rank {rank}")
+        if dims[0] != 1:
+            raise UnsupportedOnnxExport("pooling over batch")
+        nhwc = dims[1] != 1 and dims[3] == 1  # window on dims 1,2
+        if nhwc:
+            perm, inv = [0, 3, 1, 2], [0, 2, 3, 1]
+            sp = (1, 2)
+        else:
+            if dims[1] != 1:
+                raise UnsupportedOnnxExport("pooling over channel")
+            perm = inv = None
+            sp = (2, 3)
+        x_in = a[0]
+        if perm:
+            x_in = self.add_node("Transpose", [x_in],
+                                 attrs={"perm": perm})
+        kshape = [dims[i] for i in sp]
+        attrs = {"kernel_shape": kshape,
+                 "strides": [strides[i] for i in sp],
+                 "pads": [pads[i][0] for i in sp] +
+                         [pads[i][1] for i in sp]}
+        target = [out] if not perm else None
+        if kind == "MaxPool":
+            y = self.add_node("MaxPool", [x_in], target, attrs)
+        else:
+            # reduce_window_sum = AveragePool * window_size;
+            # count_include_pad matches jax's zero-including sum
+            ap = self.add_node("AveragePool", [x_in],
+                               attrs={**attrs, "count_include_pad": 1})
+            scale = self.add_const(
+                np.asarray(float(np.prod(kshape)),
+                           _np_dtype(eqn.invars[0])))
+            y = self.add_node("Mul", [ap, scale], target)
+        if perm:
+            self.add_node("Transpose", [y], [out], {"perm": inv})
+
+    def _broadcast(self, eqn, a, out):
+        bdims = eqn.params["broadcast_dimensions"]
+        tgt = eqn.outvars[0].aval.shape
+        in_shape = eqn.invars[0].aval.shape
+        # reshape to rank(target) with 1s, then Expand (ONNX Expand
+        # broadcasts bidirectionally, so a traced batch-1 target still
+        # follows a larger runtime batch)
+        mid = [1] * len(tgt)
+        for i, d in enumerate(bdims):
+            mid[d] = in_shape[i]
+        if bdims and bdims[0] == 0 and in_shape:
+            mid[0] = -1   # preserved leading dim stays batch-dynamic
+        shp = self.add_const(np.asarray(mid, np.int64))
+        r = self.add_node("Reshape", [a[0], shp])
+        tgt_c = self.add_const(np.asarray(tgt, np.int64))
+        self.add_node("Expand", [r, tgt_c], [out])
+
+
+def _np_dtype(var):
+    return np.dtype(var.aval.dtype)
+
+
+def export_jaxpr(closed_jaxpr, example_inputs, path, graph_name="model",
+                 input_dims=None, opset=13):
+    """closed_jaxpr: jax.make_jaxpr(fn)(x...) with params as consts.
+
+    input_dims: optional per-input shape lists where None marks a
+    dynamic dim (exported as a dim_param, typically the batch)."""
+    if opset < 13:
+        raise ValueError(
+            "ONNX export emits opset-13 semantics (ReduceSum axes as "
+            f"input); opset_version={opset} is not supported")
+    conv = _Converter()
+    jaxpr = closed_jaxpr.jaxpr
+    in_names = []
+    in_infos = []
+    dynamic_batch = False
+    for i, v in enumerate(jaxpr.invars):
+        name = f"input_{i}"
+        conv.names[v] = name
+        in_names.append(name)
+        shape = list(v.aval.shape)
+        if input_dims is not None and i < len(input_dims):
+            spec_shape = input_dims[i]
+            shape = [("N" if s is None or s == -1 else int(s))
+                     for s in spec_shape]
+            dynamic_batch = dynamic_batch or "N" in shape
+        in_infos.append(F.value_info(
+            name, F._NP2ONNX[np.dtype(v.aval.dtype)], shape))
+    outs = conv._walk(jaxpr, closed_jaxpr.consts, in_names)
+    out_infos = []
+    for name, v in zip(outs, jaxpr.outvars):
+        shape = list(v.aval.shape)
+        if dynamic_batch and shape:
+            # outputs follow the batch when inputs are batch-dynamic
+            shape = ["N"] + shape[1:]
+        out_infos.append(F.value_info(
+            name, F._NP2ONNX[np.dtype(v.aval.dtype)], shape))
+    g = F.graph(conv.nodes, graph_name, conv.initializers, in_infos,
+                out_infos)
+    blob = F.model(g, opset=opset)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path
+
+
+# ---------------------------------------------------------- interpreter
+
+def _run_node(n, env):
+    op = n["op_type"]
+    x = [env[i] for i in n["input"]]
+    at = n["attrs"]
+
+    def put(v):
+        env[n["output"][0]] = v
+
+    if op == "MatMul":
+        put(x[0] @ x[1])
+    elif op == "Add":
+        put(x[0] + x[1])
+    elif op == "Sub":
+        put(x[0] - x[1])
+    elif op == "Mul":
+        put(x[0] * x[1])
+    elif op == "Div":
+        put(x[0] / x[1])
+    elif op == "Max":
+        put(np.maximum(x[0], x[1]))
+    elif op == "Min":
+        put(np.minimum(x[0], x[1]))
+    elif op == "Pow":
+        put(np.power(x[0], x[1]))
+    elif op == "Mod":
+        put(np.fmod(x[0], x[1]) if at.get("fmod") else
+            np.mod(x[0], x[1]))
+    elif op == "Neg":
+        put(-x[0])
+    elif op == "Abs":
+        put(np.abs(x[0]))
+    elif op == "Sqrt":
+        put(np.sqrt(x[0]))
+    elif op == "Exp":
+        put(np.exp(x[0]))
+    elif op == "Log":
+        put(np.log(x[0]))
+    elif op == "Tanh":
+        put(np.tanh(x[0]))
+    elif op == "Erf":
+        from math import erf
+        put(np.vectorize(erf)(x[0]).astype(x[0].dtype))
+    elif op == "Sigmoid":
+        put(1.0 / (1.0 + np.exp(-x[0])))
+    elif op == "Sign":
+        put(np.sign(x[0]))
+    elif op == "Floor":
+        put(np.floor(x[0]))
+    elif op == "Ceil":
+        put(np.ceil(x[0]))
+    elif op == "Sin":
+        put(np.sin(x[0]))
+    elif op == "Cos":
+        put(np.cos(x[0]))
+    elif op == "Identity":
+        put(x[0])
+    elif op == "Not":
+        put(~x[0])
+    elif op == "And":
+        put(x[0] & x[1])
+    elif op == "Or":
+        put(x[0] | x[1])
+    elif op in ("Equal", "Less", "LessOrEqual", "Greater",
+                "GreaterOrEqual"):
+        f = {"Equal": np.equal, "Less": np.less,
+             "LessOrEqual": np.less_equal, "Greater": np.greater,
+             "GreaterOrEqual": np.greater_equal}[op]
+        put(f(x[0], x[1]))
+    elif op == "Where":
+        put(np.where(x[0], x[1], x[2]))
+    elif op == "Cast":
+        put(x[0].astype(F._ONNX2NP[at["to"]]))
+    elif op == "Transpose":
+        put(np.transpose(x[0], at["perm"]))
+    elif op == "Reshape":
+        put(x[0].reshape([int(d) for d in x[1]]))
+    elif op == "Expand":
+        # ONNX Expand broadcasts BIDIRECTIONALLY (unlike broadcast_to)
+        tgt = np.broadcast_shapes(x[0].shape,
+                                  tuple(int(d) for d in x[1]))
+        put(np.broadcast_to(x[0], tgt).copy())
+    elif op == "Concat":
+        put(np.concatenate(x, axis=at["axis"]))
+    elif op == "Slice":
+        starts, ends, axes, steps = (x[1], x[2], x[3],
+                                     x[4] if len(x) > 4 else
+                                     np.ones_like(x[1]))
+        sl = [slice(None)] * x[0].ndim
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            sl[int(ax)] = slice(int(s), int(e), int(st))
+        put(x[0][tuple(sl)])
+    elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+        f = {"ReduceSum": np.sum, "ReduceMax": np.max,
+             "ReduceMin": np.min, "ReduceProd": np.prod}[op]
+        # opset 13: ReduceSum takes axes as a second input
+        axes = (tuple(int(v) for v in x[1]) if len(x) > 1
+                else tuple(at["axes"]))
+        put(f(x[0], axis=axes, keepdims=bool(at.get("keepdims", 1))))
+    elif op == "Conv":
+        put(_conv_np(x[0], x[1], x[2] if len(x) > 2 else None, at))
+    elif op in ("MaxPool", "AveragePool"):
+        if op == "AveragePool" and not at.get("count_include_pad") and \
+                any(at.get("pads", [0] * 4)):
+            raise NotImplementedError(
+                "interpreter: AveragePool count_include_pad=0 with pads")
+        put(_pool_np(x[0], at, op))
+    else:
+        raise NotImplementedError(f"interpreter: {op}")
+
+
+def _conv_np(x, w, b, at):
+    strides = at.get("strides", [1, 1])
+    pads = at.get("pads", [0] * 4)
+    dil = at.get("dilations", [1, 1])
+    groups = at.get("group", 1)
+    N, C, H, W = x.shape
+    O, Cg, kh, kw = w.shape
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    kh_e = (kh - 1) * dil[0] + 1
+    kw_e = (kw - 1) * dil[1] + 1
+    Ho = (xp.shape[2] - kh_e) // strides[0] + 1
+    Wo = (xp.shape[3] - kw_e) // strides[1] + 1
+    out = np.zeros((N, O, Ho, Wo), x.dtype)
+    og = O // groups
+    for g in range(groups):
+        xs = xp[:, g * Cg:(g + 1) * Cg]
+        for o in range(og):
+            oc = g * og + o
+            acc = np.zeros((N, Ho, Wo), x.dtype)
+            for i in range(kh):
+                for j in range(kw):
+                    patch = xs[:, :,
+                               i * dil[0]:i * dil[0] + Ho * strides[0]:
+                               strides[0],
+                               j * dil[1]:j * dil[1] + Wo * strides[1]:
+                               strides[1]]
+                    acc += np.einsum("nchw,c->nhw", patch, w[oc, :, i, j])
+            out[:, oc] = acc
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool_np(x, at, op):
+    kh, kw = at["kernel_shape"]
+    sh, sw = at.get("strides", [kh, kw])
+    pads = at.get("pads", [0] * 4)
+    ph0, pw0, ph1, pw1 = pads
+    fill = -np.inf if op == "MaxPool" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=fill)
+    N, C, H, W = xp.shape
+    Ho = (H - kh) // sh + 1
+    Wo = (W - kw) // sw + 1
+    out = np.full((N, C, Ho, Wo, kh * kw), fill, x.dtype)
+    idx = 0
+    for i in range(kh):
+        for j in range(kw):
+            out[..., idx] = xp[:, :, i:i + Ho * sh:sh, j:j + Wo * sw:sw]
+            idx += 1
+    return out.max(-1) if op == "MaxPool" else out.mean(-1)
+
+
+def run_model(decoded, inputs):
+    """Execute a decode_model() result on numpy inputs."""
+    g = decoded["graph"]
+    env = dict(g["initializers"])
+    for name, arr in zip(g["inputs"], inputs):
+        env[name] = np.asarray(arr)
+    for n in g["nodes"]:
+        _run_node(n, env)
+    return [env[o] for o in g["outputs"]]

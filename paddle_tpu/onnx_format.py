@@ -1,0 +1,292 @@
+"""Minimal ONNX protobuf writer/reader (no `onnx` dependency).
+
+The ONNX serialization is standard protobuf; this module hand-encodes
+the subset of `onnx.proto` the exporter emits (ModelProto / GraphProto /
+NodeProto / TensorProto / ValueInfoProto, with their published field
+numbers) and decodes it back for verification. Field numbers follow the
+public onnx.proto schema (ONNX IR v8 / opset 13 era).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE = 8, 9, 10, 11
+
+_NP2ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.float64): DOUBLE,
+            np.dtype(np.int32): INT32, np.dtype(np.int64): INT64,
+            np.dtype(np.bool_): BOOL, np.dtype(np.float16): FLOAT16,
+            np.dtype(np.int8): INT8, np.dtype(np.uint8): UINT8}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+# ------------------------------------------------------------- encoding
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_int(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+def f_str(field: int, value: str) -> bytes:
+    return f_bytes(field, value.encode())
+
+
+def f_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS = 1, 2, 3, 4, 6, 7
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20."""
+    body = f_str(1, name)
+    if isinstance(value, bool):
+        body += f_int(3, int(value)) + f_int(20, A_INT)
+    elif isinstance(value, int):
+        body += f_int(3, value) + f_int(20, A_INT)
+    elif isinstance(value, float):
+        body += f_float(2, value) + f_int(20, A_FLOAT)
+    elif isinstance(value, str):
+        body += f_bytes(4, value.encode()) + f_int(20, A_STRING)
+    elif isinstance(value, np.ndarray):
+        body += f_bytes(5, tensor("", value)) + f_int(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            body += b"".join(f_float(7, v) for v in value)
+            body += f_int(20, A_FLOATS)
+        else:
+            body += b"".join(f_int(8, int(v)) for v in value)
+            body += f_int(20, A_INTS)
+    else:
+        raise TypeError(f"attribute {name}: {type(value)}")
+    return body
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    dt = _NP2ONNX[arr.dtype]
+    body = b"".join(f_int(1, d) for d in arr.shape)
+    body += f_int(2, dt)
+    if name:
+        body += f_str(8, name)
+    body += f_bytes(9, arr.tobytes())
+    return body
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    """ValueInfoProto{name=1, type=2{tensor_type=1{elem_type=1,
+    shape=2{dim=1{dim_value=1}}}}}"""
+    dims = b"".join(
+        f_bytes(1, f_int(1, d) if isinstance(d, int) else f_str(2, str(d)))
+        for d in shape)
+    tshape = f_bytes(2, dims)
+    ttype = f_bytes(1, f_int(1, elem_type) + tshape)
+    return f_str(1, name) + f_bytes(2, ttype)
+
+
+def node(op_type: str, inputs, outputs, name="", attrs=None) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    body = b"".join(f_str(1, i) for i in inputs)
+    body += b"".join(f_str(2, o) for o in outputs)
+    if name:
+        body += f_str(3, name)
+    body += f_str(4, op_type)
+    for k, v in (attrs or {}).items():
+        body += f_bytes(5, attribute(k, v))
+    return body
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    body = b"".join(f_bytes(1, n) for n in nodes)
+    body += f_str(2, name)
+    body += b"".join(f_bytes(5, t) for t in initializers)
+    body += b"".join(f_bytes(11, i) for i in inputs)
+    body += b"".join(f_bytes(12, o) for o in outputs)
+    return body
+
+
+def model(graph_bytes: bytes, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7,
+    opset_import=8{domain=1, version=2}."""
+    body = f_int(1, 8)                       # IR version 8
+    body += f_str(2, producer)
+    body += f_bytes(7, graph_bytes)
+    body += f_bytes(8, f_str(1, "") + f_int(2, opset))
+    return body
+
+
+# ------------------------------------------------------------- decoding
+
+def _read_varint(buf, off):
+    n = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field, wire, value) over a protobuf message body."""
+    off = 0
+    while off < len(buf):
+        key, off = _read_varint(buf, off)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, off = _read_varint(buf, off)
+        elif wire == 2:
+            ln, off = _read_varint(buf, off)
+            val = buf[off:off + ln]
+            off += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[off:off + 4])[0]
+            off += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[off:off + 8])[0]
+            off += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, val
+
+
+def decode_tensor(buf):
+    dims, dt, name, raw, floats, int64s = [], FLOAT, "", None, [], []
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            dims.append(val)
+        elif field == 2:
+            dt = val
+        elif field == 4:
+            floats.append(val)
+        elif field == 7:
+            int64s.append(val)
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    np_dt = _ONNX2NP[dt]
+    if raw is not None:
+        arr = np.frombuffer(raw, np_dt).reshape(dims)
+    elif floats:
+        arr = np.asarray(floats, np_dt).reshape(dims)
+    else:
+        arr = np.asarray(int64s, np_dt).reshape(dims)
+    return name, arr
+
+
+def decode_attribute(buf):
+    name, val, typ = "", None, None
+    floats, ints = [], []
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            name = v.decode()
+        elif field == 2:
+            val = v
+        elif field == 3:
+            val = v
+        elif field == 4:
+            val = v.decode()
+        elif field == 5:
+            val = decode_tensor(v)[1]
+        elif field == 7:
+            floats.append(v)
+        elif field == 8:
+            ints.append(v)
+        elif field == 20:
+            typ = v
+    if typ == A_FLOATS:
+        val = floats
+    elif typ == A_INTS:
+        val = ints
+    return name, val
+
+
+def decode_node(buf):
+    n = {"input": [], "output": [], "op_type": "", "name": "",
+         "attrs": {}}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            n["input"].append(val.decode())
+        elif field == 2:
+            n["output"].append(val.decode())
+        elif field == 3:
+            n["name"] = val.decode()
+        elif field == 4:
+            n["op_type"] = val.decode()
+        elif field == 5:
+            k, v = decode_attribute(val)
+            n["attrs"][k] = v
+    return n
+
+
+def _decode_value_info(buf):
+    name = ""
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+    return name
+
+
+def decode_graph(buf):
+    g = {"nodes": [], "name": "", "initializers": {}, "inputs": [],
+         "outputs": []}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            g["nodes"].append(decode_node(val))
+        elif field == 2:
+            g["name"] = val.decode()
+        elif field == 5:
+            n, arr = decode_tensor(val)
+            g["initializers"][n] = arr
+        elif field == 11:
+            g["inputs"].append(_decode_value_info(val))
+        elif field == 12:
+            g["outputs"].append(_decode_value_info(val))
+    return g
+
+
+def decode_model(buf):
+    m = {"ir_version": None, "producer": "", "graph": None, "opset": None}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            m["ir_version"] = val
+        elif field == 2:
+            m["producer"] = val.decode()
+        elif field == 7:
+            m["graph"] = decode_graph(val)
+        elif field == 8:
+            for f2, w2, v2 in _fields(val):
+                if f2 == 2:
+                    m["opset"] = v2
+    return m

@@ -1,0 +1,92 @@
+"""ONNX export tests: export -> decode -> numpy-execute -> match eager
+(self-contained verification; the onnx/onnxruntime packages are absent,
+so the decoded protobuf is executed by our interpreter)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi.model import InputSpec
+from paddle_tpu.onnx_format import decode_model
+from paddle_tpu.onnx_export import run_model
+
+
+def _roundtrip(layer, spec, x):
+    import paddle_tpu.onnx as ponnx
+    import tempfile, os
+    stem = os.path.join(tempfile.mkdtemp(), "m")
+    path = ponnx.export(layer, stem, input_spec=[spec])
+    assert path.endswith(".onnx") and os.path.exists(path)
+    blob = open(path, "rb").read()
+    dec = decode_model(blob)
+    assert dec["ir_version"] == 8 and dec["opset"] == 13
+    assert dec["producer"] == "paddle_tpu"
+    (out,) = run_model(dec, [x])
+    layer.eval()
+    ref = layer(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    return dec
+
+
+def test_mlp_export_matches_eager():
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 16),
+                        nn.GELU(), nn.Linear(16, 4), nn.Sigmoid())
+    x = np.random.RandomState(0).rand(3, 8).astype(np.float32)
+    dec = _roundtrip(net, InputSpec([None, 8], "float32"), x)
+    ops = {n["op_type"] for n in dec["graph"]["nodes"]}
+    assert "MatMul" in ops
+
+
+def test_lenet_export_matches_eager():
+    from paddle_tpu.vision.models import LeNet
+    net = LeNet()
+    x = np.random.RandomState(1).rand(2, 1, 28, 28).astype(np.float32)
+    dec = _roundtrip(net, InputSpec([None, 1, 28, 28], "float32"), x)
+    ops = {n["op_type"] for n in dec["graph"]["nodes"]}
+    assert "Conv" in ops and "MaxPool" in ops
+
+
+def test_unsupported_primitive_raises():
+    import paddle_tpu.onnx as ponnx
+
+    class Weird(nn.Layer):
+        def forward(self, x):
+            import jax
+            return paddle.to_tensor(
+                jax.lax.cumsum(x._data, axis=0))  # no mapping
+
+    with pytest.raises(NotImplementedError):
+        ponnx.export(Weird(), "/tmp/weird",
+                     input_spec=[InputSpec([2, 3], "float32")])
+
+
+def test_dynamic_batch_and_opset_metadata(tmp_path):
+    import paddle_tpu.onnx as ponnx
+    net = nn.Sequential(nn.Linear(4, 2))
+    path = ponnx.export(net, str(tmp_path / "dyn"),
+                        input_spec=[InputSpec([None, 4], "float32")])
+    dec = decode_model(open(path, "rb").read())
+    assert dec["opset"] == 13
+    with pytest.raises(ValueError):
+        ponnx.export(net, str(tmp_path / "old"), opset_version=9,
+                     input_spec=[InputSpec([None, 4], "float32")])
+
+
+def test_export_restores_train_mode(tmp_path):
+    import paddle_tpu.onnx as ponnx
+    net = nn.Sequential(nn.Linear(4, 2), nn.Dropout(0.5))
+    net.train()
+    ponnx.export(net, str(tmp_path / "t"),
+                 input_spec=[InputSpec([2, 4], "float32")])
+    assert net.training
+
+
+def test_softmax_model_reduce_sum_as_input(tmp_path):
+    """opset-13 ReduceSum (axes as 2nd input) round-trips."""
+    class SM(nn.Layer):
+        def forward(self, x):
+            import paddle_tpu.nn.functional as Fn
+            return Fn.softmax(x, axis=-1)
+
+    x = np.random.RandomState(2).rand(2, 5).astype(np.float32)
+    dec = _roundtrip(SM(), InputSpec([None, 5], "float32"), x)
