@@ -158,14 +158,27 @@ def make_store(spec):
 
 
 class ElasticManager:
+    """manager.py:127 parity: node registration (:229), membership
+    watch + scale in/out with RANK REGENERATION (:244), fault-tolerant
+    restart. On a membership change the leader (lowest alive node id)
+    publishes a new `generation` {gen, nodes}; every node kills its
+    training process and relaunches it with regenerated ranks
+    (NODE_RANK = index in the sorted alive set, PADDLE_NNODES = world);
+    nodes scaled out of the membership exit cleanly."""
+
     def __init__(self, args=None, store_root=None, max_restarts=3,
-                 heartbeat_interval=5.0):
+                 heartbeat_interval=5.0, min_nodes=1, max_nodes=None,
+                 settle_checks=2):
         self.store = make_store(store_root or
                                 os.environ.get("PADDLE_ELASTIC_STORE",
                                                "/tmp/paddle_tpu_elastic"))
         self.max_restarts = max_restarts
         self.heartbeat_interval = heartbeat_interval
         self.node_id = os.environ.get("PADDLE_NODE_RANK", "0")
+        self.min_nodes = int(os.environ.get("PADDLE_ELASTIC_MIN_NODES",
+                                            min_nodes))
+        self.max_nodes = max_nodes
+        self.settle_checks = settle_checks
         self.restarts = 0
 
     def register(self):
@@ -177,20 +190,132 @@ class ElasticManager:
     def watch(self):
         return self.store.alive_nodes(timeout=self.heartbeat_interval * 4)
 
-    def run(self, cmd):
-        """Supervise `cmd` (the training script); restart on failure up to
-        max_restarts (the launcher watchdog capability)."""
-        while True:
-            self.register()
-            proc = subprocess.Popen(cmd)
-            while proc.poll() is None:
-                self.store.heartbeat(self.node_id)
-                time.sleep(self.heartbeat_interval)
-            if proc.returncode == 0:
-                return 0
-            self.restarts += 1
-            if self.restarts > self.max_restarts:
-                return proc.returncode
+    # ---- scale in/out ----------------------------------------------
+    def _generation(self):
+        return self.store.get("generation") or {"gen": 0, "nodes": []}
+
+    def _maybe_bump_generation(self, pending):
+        """Leader duty (lowest alive id): after the membership has
+        differed from the current generation for `settle_checks`
+        consecutive watches (debounce), publish gen+1 with the new
+        node list. Returns the updated pending counter."""
+        alive = self.watch()
+        if not alive or alive[0] != self.node_id:
+            return 0
+        gen = self._generation()
+        if self.max_nodes:
+            alive = alive[:self.max_nodes]
+        if alive == gen["nodes"] or len(alive) < self.min_nodes:
+            return 0
+        pending += 1
+        if pending >= self.settle_checks:
+            self.store.put("generation",
+                           {"gen": gen["gen"] + 1, "nodes": alive})
             sys.stderr.write(
-                f"[elastic] training exited {proc.returncode}; "
-                f"restart {self.restarts}/{self.max_restarts}\n")
+                f"[elastic] scale event: gen {gen['gen'] + 1} "
+                f"nodes {alive}\n")
+            return 0
+        return pending
+
+    def _spawn(self, cmd, gen):
+        """Relaunch training with REGENERATED ranks for this
+        generation (manager.py scale in/out -> launcher restart)."""
+        env = dict(os.environ)
+        nodes = gen["nodes"]
+        env["PADDLE_NNODES"] = str(len(nodes))
+        env["PADDLE_TRAINERS_NUM"] = str(len(nodes))
+        env["NODE_RANK"] = str(nodes.index(self.node_id))
+        env["PADDLE_NODE_RANK"] = env["NODE_RANK"]
+        env["PADDLE_ELASTIC_GEN"] = str(gen["gen"])
+        return subprocess.Popen(cmd, env=env)
+
+    def _stop_proc(self, proc, grace=30.0):
+        """Terminate the training process, heartbeating WHILE waiting
+        (a graceful shutdown longer than the aliveness window must not
+        make this node look dead); SIGKILL past the grace period."""
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        deadline = time.time() + grace
+        while proc.poll() is None and time.time() < deadline:
+            self.store.heartbeat(self.node_id)
+            time.sleep(min(self.heartbeat_interval, 0.5))
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    def _handle_exit(self, returncode):
+        """-> "done" | "give-up" | "restart" (shared restart
+        bookkeeping for the watchdog and elastic loops)."""
+        if returncode == 0:
+            return "done"
+        self.restarts += 1
+        if self.restarts > self.max_restarts:
+            return "give-up"
+        sys.stderr.write(
+            f"[elastic] training exited {returncode}; restart "
+            f"{self.restarts}/{self.max_restarts}\n")
+        return "restart"
+
+    def run(self, cmd, elastic=False, poll_timeout=None):
+        """Supervise `cmd` (the training script).
+
+        elastic=False: plain fault-tolerant restart (watchdog).
+        elastic=True: additionally watch membership; on a scale event
+        every surviving node restarts `cmd` with regenerated ranks, and
+        a node dropped from the membership returns "scaled-in".
+        `poll_timeout` bounds either loop (tests)."""
+        deadline = time.time() + poll_timeout if poll_timeout else None
+        if not elastic:
+            while True:
+                self.register()
+                proc = subprocess.Popen(cmd)
+                while proc.poll() is None:
+                    if deadline and time.time() > deadline:
+                        self._stop_proc(proc)
+                        return "timeout"
+                    self.store.heartbeat(self.node_id)
+                    time.sleep(self.heartbeat_interval)
+                verdict = self._handle_exit(proc.returncode)
+                if verdict == "done":
+                    return 0
+                if verdict == "give-up":
+                    return proc.returncode
+
+        self.register()
+        my_gen = -1
+        proc = None
+        pending = 0
+        try:
+            while True:
+                if deadline and time.time() > deadline:
+                    return "timeout"
+                self.store.heartbeat(self.node_id)
+                pending = self._maybe_bump_generation(pending)
+                gen = self._generation()
+                if gen["gen"] != my_gen and gen["nodes"]:
+                    if self.node_id not in gen["nodes"]:
+                        if my_gen == -1:
+                            # joining node: keep heartbeating until the
+                            # leader includes us in a future generation
+                            time.sleep(self.heartbeat_interval)
+                            continue
+                        self._stop_proc(proc)
+                        return "scaled-in"
+                    self._stop_proc(proc)
+                    my_gen = gen["gen"]
+                    self.restarts = 0
+                    proc = self._spawn(cmd, gen)
+                elif proc is not None and proc.poll() is not None:
+                    verdict = self._handle_exit(proc.returncode)
+                    if verdict == "done":
+                        return 0
+                    if verdict == "give-up":
+                        return proc.returncode
+                    # respawn from the ALREADY-VALIDATED generation (a
+                    # fresh read could exclude this node mid-loop)
+                    proc = self._spawn(cmd, gen)
+                time.sleep(self.heartbeat_interval)
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()

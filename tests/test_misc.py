@@ -276,3 +276,78 @@ def test_multiprocess_dataloader_tuple_collate():
                               collate_fn=tuple_collate)))
     assert type(b0) is tuple and type(b2) is tuple
     np.testing.assert_array_equal(b0[0].numpy(), b2[0].numpy())
+
+
+def test_elastic_scale_out_reranks(tmp_path):
+    """manager.py:244 parity (scale-out): membership change -> leader publishes a
+    new generation -> every node relaunches training with REGENERATED
+    ranks; a removed node scales in cleanly."""
+    import os
+    import sys
+    import threading
+    import time
+    from paddle_tpu.parallel.elastic import ElasticManager, FileStore
+
+    store_root = str(tmp_path / "store")
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, time, sys\n"
+        f"out = os.path.join({str(outdir)!r}, "
+        "f\"g{os.environ['PADDLE_ELASTIC_GEN']}_\"\n"
+        "    f\"n{os.environ['PADDLE_NODE_RANK']}\")\n"
+        # write-then-rename so the reader never sees a partial file
+        "open(out + '.tmp', 'w').write(os.environ['PADDLE_NNODES'])\n"
+        "os.replace(out + '.tmp', out)\n"
+        "time.sleep(60)\n")
+
+    def make_mgr(node_id):
+        mgr = ElasticManager(store_root=store_root,
+                             heartbeat_interval=0.15, settle_checks=2)
+        mgr.node_id = node_id
+        return mgr
+
+    results = {}
+
+    def run_node(node_id, timeout):
+        mgr = make_mgr(node_id)
+        results[node_id] = mgr.run([sys.executable, str(script)],
+                                   elastic=True, poll_timeout=timeout)
+
+    def wait_for(cond, timeout=25):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(0.1)
+        return False
+
+    t0 = threading.Thread(target=run_node, args=("0", 40))
+    t1 = threading.Thread(target=run_node, args=("1", 40))
+    t0.start(); t1.start()
+    store = FileStore(store_root)
+    assert wait_for(lambda: (store.get("generation") or {}).get(
+        "nodes") == ["0", "1"])
+    gen1 = store.get("generation")["gen"]
+    # spawned children pay the interpreter/sitecustomize startup — poll
+    assert wait_for(lambda: (outdir / f"g{gen1}_n0").exists()
+                    and (outdir / f"g{gen1}_n1").exists()), \
+        "gen-1 training procs never launched"
+    assert (outdir / f"g{gen1}_n0").read_text() == "2"
+
+    # scale OUT: node 2 joins -> new generation with 3 nodes, re-ranked
+    t2 = threading.Thread(target=run_node, args=("2", 25))
+    t2.start()
+    assert wait_for(lambda: len((store.get("generation") or {}).get(
+        "nodes", [])) == 3)
+    g = store.get("generation")
+    assert g["nodes"] == ["0", "1", "2"]
+    assert wait_for(lambda: all(
+        (outdir / f"g{g['gen']}_n{r}").exists() for r in range(3))), \
+        "scale-out relaunch with regenerated ranks did not happen"
+    for rank in range(3):
+        assert (outdir / f"g{g['gen']}_n{rank}").read_text() == "3"
+
+    t0.join(timeout=60); t1.join(timeout=60); t2.join(timeout=60)
+    assert results["0"] == "timeout"  # supervisors ran to their bound
