@@ -289,6 +289,7 @@ class ElasticManager:
         try:
             while True:
                 if deadline and time.time() > deadline:
+                    self._stop_proc(proc)
                     return "timeout"
                 self.store.heartbeat(self.node_id)
                 pending = self._maybe_bump_generation(pending)
@@ -296,6 +297,11 @@ class ElasticManager:
                 if gen["gen"] != my_gen and gen["nodes"]:
                     if self.node_id not in gen["nodes"]:
                         if my_gen == -1:
+                            if self.max_nodes and \
+                                    len(gen["nodes"]) >= self.max_nodes:
+                                # cluster already full: don't spin (and
+                                # heartbeat) forever hoping for a slot
+                                return "not-admitted"
                             # joining node: keep heartbeating until the
                             # leader includes us in a future generation
                             time.sleep(self.heartbeat_interval)

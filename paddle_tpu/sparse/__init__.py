@@ -20,7 +20,7 @@ class SparseTensor(Tensor):
     (so inherited Tensor methods keep working — a dense fallback, like the
     reference's coo→dense kernel fallbacks)."""
 
-    __slots__ = ("_bcoo", "_dense_cache")
+    __slots__ = ("_bcoo", "_dense_cache", "_values_ref")
 
     def __init__(self, bcoo, stop_gradient=True):
         self._bcoo = bcoo
@@ -48,10 +48,23 @@ class SparseTensor(Tensor):
         return self._bcoo.dtype
 
     def to_dense(self):
+        vref = getattr(self, "_values_ref", None)
+        if vref is not None and not vref.stop_gradient:
+            # differentiable densify: grads flow back into the values
+            # produced by sparse conv/bn layers (conv.py _wrap_out)
+            from ..core import dispatch
+            idx = self._bcoo.indices
+            shape = tuple(self._bcoo.shape)
+
+            def fn(v):
+                return jnp.zeros(shape, v.dtype).at[
+                    tuple(idx[:, i] for i in range(idx.shape[1]))].add(v)
+            return dispatch.apply("sparse_to_dense", fn, (vref,))
         return Tensor(self._bcoo.todense())
 
     def values(self):
-        return Tensor(self._bcoo.data)
+        vref = getattr(self, "_values_ref", None)
+        return vref if vref is not None else Tensor(self._bcoo.data)
 
     def indices(self):
         return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
@@ -128,8 +141,17 @@ def add(x, y):
 def _unary_on_values(fn, x: "SparseTensor") -> "SparseTensor":
     """Value-space op: touches only the nnz values (real sparse compute,
     like the reference's sparse unary kernels
-    `paddle/phi/kernels/sparse/unary_kernel.h`)."""
+    `paddle/phi/kernels/sparse/unary_kernel.h`). Autograd-linked values
+    (sparse conv/bn outputs) stay linked so grads flow through chains
+    of sparse ops."""
     b = x._bcoo
+    vref = getattr(x, "_values_ref", None)
+    if vref is not None and not vref.stop_gradient:
+        from ..core import dispatch
+        from .conv import _wrap_out
+        out_vals = dispatch.apply("sparse_unary", fn, (vref,))
+        return _wrap_out(out_vals, np.asarray(b.indices),
+                         tuple(b.shape))
     return SparseTensor(jsparse.BCOO((fn(b.data), b.indices),
                                      shape=b.shape))
 
@@ -199,18 +221,56 @@ def coalesce(x):
 
 
 def softmax(x, axis=-1):
-    """Row-wise softmax over the SPARSE pattern only (2-D COO; the
-    reference's sparse softmax semantics: missing entries are -inf, i.e.
-    excluded), via segment max/sum over the row index — O(nnz)."""
+    """Softmax over the SPARSE pattern only (the reference's sparse
+    softmax semantics: missing entries are -inf, i.e. excluded), for
+    N-D COO along any axis — including hybrid tensors whose trailing
+    dims are dense (values [nnz, ...]). Sparse-axis softmax groups
+    entries by every OTHER sparse index (segment max/sum, O(nnz));
+    dense-axis softmax is a plain softmax over that value axis. Keeps
+    the autograd link of values-linked tensors."""
+    from ..core import dispatch
     b = x._bcoo
-    if len(b.shape) != 2 or axis not in (-1, 1):
-        raise NotImplementedError("sparse.softmax: 2-D, last axis only")
-    rows = b.indices[:, 0]
-    n_rows = b.shape[0]
-    rmax = jax.ops.segment_max(b.data, rows, num_segments=n_rows)
-    e = jnp.exp(b.data - rmax[rows])
-    rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-    return SparseTensor(jsparse.BCOO((e / rsum[rows], b.indices),
+    nd = len(b.shape)
+    ax = axis % nd
+    n_sparse = b.indices.shape[1]
+    vref = getattr(x, "_values_ref", None)
+    linked = vref is not None and not vref.stop_gradient
+    vals_in = vref if linked else Tensor(b.data)
+
+    if ax >= n_sparse:
+        # dense trailing dim: softmax along the matching value axis
+        vax = ax - n_sparse + 1
+
+        def fn(v):
+            m = jnp.max(v, axis=vax, keepdims=True)
+            e = jnp.exp(v - m)
+            return e / jnp.sum(e, axis=vax, keepdims=True)
+    else:
+        # segment ids over the OTHER sparse index columns, built on
+        # host in int64 (jnp would silently be int32 with x64 off and
+        # overflow the row-major flatten for large shapes)
+        idx_np = np.asarray(b.indices, np.int64)
+        seg_np = np.zeros(idx_np.shape[0], np.int64)
+        for i in range(n_sparse):
+            if i == ax:
+                continue
+            seg_np = seg_np * int(b.shape[i]) + idx_np[:, i]
+        _, seg_c_np = np.unique(seg_np, return_inverse=True)
+        seg_c = jnp.asarray(seg_c_np)
+        n_seg = int(seg_c_np.max()) + 1 if len(seg_c_np) else 0
+
+        def fn(v):
+            rmax = jax.ops.segment_max(v, seg_c, num_segments=n_seg)
+            e = jnp.exp(v - rmax[seg_c])
+            rsum = jax.ops.segment_sum(e, seg_c, num_segments=n_seg)
+            return e / rsum[seg_c]
+
+    out_vals = dispatch.apply("sparse_softmax", fn, (vals_in,))
+    if linked:
+        from .conv import _wrap_out
+        return _wrap_out(out_vals, np.asarray(b.indices),
+                         tuple(b.shape))
+    return SparseTensor(jsparse.BCOO((out_vals._data, b.indices),
                                      shape=b.shape))
 
 
@@ -231,6 +291,31 @@ class _SparseSoftmax:
         return softmax(x, self.axis)
 
 
-class nn:  # namespace shim: paddle.sparse.nn.ReLU()/Softmax()
+from . import conv as _conv_mod  # noqa: E402
+from .conv import (conv3d, subm_conv3d, max_pool3d,  # noqa: F401,E402
+                   Conv3D, SubmConv3D, MaxPool3D, BatchNorm)
+
+
+class _SparseFunctional:
+    """paddle.sparse.nn.functional namespace."""
+    conv3d = staticmethod(conv3d)
+    subm_conv3d = staticmethod(subm_conv3d)
+    max_pool3d = staticmethod(max_pool3d)
+
+    @staticmethod
+    def relu(x):
+        return relu(x)
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        return softmax(x, axis)
+
+
+class nn:  # namespace shim: paddle.sparse.nn.*
     ReLU = _SparseReLU
     Softmax = _SparseSoftmax
+    Conv3D = Conv3D
+    SubmConv3D = SubmConv3D
+    MaxPool3D = MaxPool3D
+    BatchNorm = BatchNorm
+    functional = _SparseFunctional
