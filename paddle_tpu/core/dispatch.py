@@ -44,6 +44,30 @@ def _is_float(dtype) -> bool:
     )
 
 
+class _LazyVjp:
+    """Deferred vjp: the eager forward runs fn directly (one jax eager
+    dispatch, ~50us) and the `jax.vjp` LINEARIZATION — measured ~1.4 ms
+    of tracing per op on CPU, the dominant eager-dispatch cost
+    (docs/eager_dispatch_analysis.md) — happens only if backward
+    actually reaches this node. Ops are pure (randomness enters as
+    explicit key inputs/closures), so the deferred re-trace reproduces
+    the forward exactly; this is the remat trade the reference makes in
+    `fleet/recompute` applied to the eager tape."""
+
+    __slots__ = ("fn", "arrays", "_vjp")
+
+    def __init__(self, fn, arrays):
+        self.fn = fn
+        self.arrays = arrays
+        self._vjp = None
+
+    def __call__(self, ct):
+        if self._vjp is None:
+            _, self._vjp = jax.vjp(self.fn, *self.arrays)
+            self.fn = self.arrays = None  # free after tracing
+        return self._vjp(ct)
+
+
 def apply(name, fn, inputs, differentiable=True):
     """Run op `fn` over the raw arrays of `inputs` (Tensors), recording a
     GradNode when grad is enabled and any input requires grad."""
@@ -55,10 +79,8 @@ def apply(name, fn, inputs, differentiable=True):
         and autograd.is_grad_enabled()
         and any(not t.stop_gradient for t in inputs)
     )
-    if need_grad:
-        outs, vjp_fn = jax.vjp(fn, *arrays)
-    else:
-        outs = fn(*arrays)
+    outs = fn(*arrays)
+    vjp_fn = _LazyVjp(fn, arrays) if need_grad else None
 
     multi = isinstance(outs, (tuple, list))
     outs_t = tuple(outs) if multi else (outs,)

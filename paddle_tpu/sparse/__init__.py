@@ -32,7 +32,19 @@ class SparseTensor(Tensor):
     @property
     def _data(self):
         if self._dense_cache is None:
-            self._dense_cache = self._bcoo.todense()
+            vref = getattr(self, "_values_ref", None)
+            if vref is not None and not vref.stop_gradient:
+                # densify THROUGH the autograd graph and adopt the
+                # resulting grad node, so inherited dense Tensor ops
+                # consuming this sparse tensor keep gradients flowing
+                # into the sparse conv/bn parameters (instead of
+                # recording this tensor as a grad-less leaf)
+                dense = self.to_dense()
+                self._dense_cache = dense._data
+                self._grad_node = dense._grad_node
+                self._out_slot = dense._out_slot
+            else:
+                self._dense_cache = self._bcoo.todense()
         return self._dense_cache
 
     @_data.setter

@@ -41,11 +41,34 @@ def _coord_key(coords, spatial):
             coords[:, 2]) * W + coords[:, 3]
 
 
+_RULEBOOK_CACHE = {}
+_RULEBOOK_CACHE_MAX = 32
+
+
 def build_rulebook(coords_np, spatial_in, kernel, stride, padding, subm):
-    """Host-side rulebook (the reference's sparse-conv hashmap step).
+    """Host-side rulebook (the reference's sparse-conv hashmap step),
+    memoized per (sparsity pattern, geometry) — a training loop over a
+    static point cloud pays the O(nnz * k^3) numpy work once.
 
     Returns (out_coords [n_out, 4], out_spatial, rules) where rules is a
     list over kernel offsets of (in_idx, out_idx) index arrays."""
+    import hashlib
+    ck = (hashlib.blake2b(np.ascontiguousarray(coords_np).tobytes(),
+                          digest_size=16).digest(),
+          coords_np.shape, spatial_in, kernel, stride, padding, subm)
+    hit = _RULEBOOK_CACHE.get(ck)
+    if hit is not None:
+        return hit
+    out = _build_rulebook_impl(coords_np, spatial_in, kernel, stride,
+                               padding, subm)
+    if len(_RULEBOOK_CACHE) >= _RULEBOOK_CACHE_MAX:
+        _RULEBOOK_CACHE.pop(next(iter(_RULEBOOK_CACHE)))
+    _RULEBOOK_CACHE[ck] = out
+    return out
+
+
+def _build_rulebook_impl(coords_np, spatial_in, kernel, stride, padding,
+                         subm):
     kd, kh, kw = kernel
     sd, sh, sw = stride
     pd, ph, pw = padding

@@ -272,3 +272,18 @@ def test_sparse_softmax_nd():
     np.testing.assert_allclose(dv[2], 1.0, rtol=1e-6)
     e2 = np.exp(vals[3:] - vals[3:].max())
     np.testing.assert_allclose(dv[3:], e2 / e2.sum(), rtol=1e-5)
+
+
+def test_sparse_conv_dense_fallback_keeps_grads():
+    """A plain DENSE op (paddle.mean) on a sparse-conv output must keep
+    gradients flowing to the conv weights (the densify fallback adopts
+    the values' grad node)."""
+    import paddle_tpu.sparse as sparse
+    rng = np.random.RandomState(5)
+    shape = (1, 4, 4, 4, 2)
+    x, _ = _random_sparse_input(rng, shape, 12)
+    conv = sparse.nn.SubmConv3D(2, 4, kernel_size=3, padding=1)
+    loss = paddle.mean(conv(x))
+    loss.backward()
+    g = conv.weight.grad
+    assert g is not None and float(np.abs(g.numpy()).max()) > 0
