@@ -44,10 +44,13 @@ def _to_list(x):
 
 
 def _arrays(batch):
+    import jax
     out = []
     for b in _to_list(batch):
         if isinstance(b, Tensor):
             out.append(b._data)
+        elif isinstance(b, jax.Array):
+            out.append(b)   # device-resident (DeviceCacheLoader): keep
         else:
             out.append(np.asarray(b))
     return out
@@ -204,7 +207,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
-        if isinstance(train_data, DataLoader):
+        from ..io import DeviceCacheLoader
+        if isinstance(train_data, (DataLoader, DeviceCacheLoader)):
             loader = train_data
         else:
             loader = DataLoader(train_data, batch_size=batch_size,
@@ -281,7 +285,11 @@ class Model:
                     else:
                         cbks.on_train_batch_end(s, {})
 
-            group_max = 8
+            # group size cap: larger groups amortise per-dispatch relay
+            # latency further but compile one executable per distinct
+            # size — raise via model._fit_group_max for small models on
+            # high-latency links
+            group_max = getattr(self, "_fit_group_max", 8)
             shapes = None
             static_lr = not hasattr(
                 getattr(self._optimizer, "_learning_rate", 0.0), "step")
@@ -340,7 +348,8 @@ class Model:
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None,
                  _inner=False):
-        if isinstance(eval_data, DataLoader):
+        from ..io import DeviceCacheLoader
+        if isinstance(eval_data, (DataLoader, DeviceCacheLoader)):
             loader = eval_data
         else:
             loader = DataLoader(eval_data, batch_size=batch_size,
@@ -363,7 +372,8 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, callbacks=None, verbose=1):
-        if isinstance(test_data, DataLoader):
+        from ..io import DeviceCacheLoader
+        if isinstance(test_data, (DataLoader, DeviceCacheLoader)):
             loader = test_data
         else:
             loader = DataLoader(test_data, batch_size=batch_size,

@@ -517,3 +517,79 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+class DeviceCacheLoader:
+    """Pin a (small) dataset's batches in device HBM after the first
+    epoch — repeated epochs then feed with ZERO host->device transfers.
+
+    The TPU-first input-pipeline pattern (tf.data `.cache()` on-device
+    analogue): host->device bandwidth through a relay/DCN link is often
+    the fit-loop bottleneck for small models; datasets that fit in HBM
+    (MNIST: ~13 MB) should live there. Wraps any iterable loader:
+
+        loader = DeviceCacheLoader(DataLoader(ds, batch_size=64))
+        model.fit(loader, ...)
+
+    Caching is ALL-OR-NOTHING: if the first epoch exceeds `max_bytes`
+    the cache is discarded (with a warning) and every epoch streams
+    from the base loader — a partial cache over a shuffling base would
+    silently bias sampling (cached prefix replayed + a differently-
+    shuffled remainder). Cached epochs replay the first epoch's batches
+    (re-shuffled at batch granularity when `reshuffle=True`); a
+    per-sample re-shuffle would need fresh host batches and defeat the
+    cache.
+    """
+
+    def __init__(self, loader, max_bytes=512 * 1024 * 1024,
+                 reshuffle=True, seed=0):
+        self._loader = loader
+        self._max_bytes = max_bytes
+        self._cache = None
+        self._overflowed = False
+        self._reshuffle = reshuffle
+        self._epoch = 0
+        self._seed = seed
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __iter__(self):
+        import jax.numpy as jnp
+        if self._cache is not None:
+            order = list(range(len(self._cache)))
+            if self._reshuffle:
+                import random as _random
+                self._epoch += 1
+                _random.Random(self._seed + self._epoch).shuffle(order)
+            for i in order:
+                yield self._cache[i]
+            return
+        if self._overflowed:
+            yield from self._loader
+            return
+        cache = []
+        used = 0
+        for batch in self._loader:
+            if cache is not None:
+                items = tuple(
+                    t._data if hasattr(t, "_data") else jnp.asarray(t)
+                    for t in (batch if isinstance(batch, (list, tuple))
+                              else [batch]))
+                nbytes = sum(getattr(a, "nbytes", 0) for a in items)
+                if used + nbytes <= self._max_bytes:
+                    cache.append(items)
+                    used += nbytes
+                    yield items
+                    continue
+                import warnings
+                warnings.warn(
+                    f"DeviceCacheLoader: dataset exceeds max_bytes="
+                    f"{self._max_bytes}; caching disabled (all epochs "
+                    "stream from host — a partial cache would bias "
+                    "sampling)")
+                cache = None
+                self._overflowed = True
+            yield batch
+        if cache is not None:
+            self._cache = cache

@@ -297,16 +297,17 @@ class ElasticManager:
                 if gen["gen"] != my_gen and gen["nodes"]:
                     if self.node_id not in gen["nodes"]:
                         if my_gen == -1:
-                            alive = set(self.watch())
                             if self.max_nodes and \
-                                    len(gen["nodes"]) >= self.max_nodes \
-                                    and all(n in alive
-                                            for n in gen["nodes"]):
-                                # cluster full of LIVE nodes: no slot is
-                                # coming — don't spin forever. (A dead
-                                # member means a reshuffle is imminent;
-                                # keep waiting to replace it.)
-                                return "not-admitted"
+                                    len(gen["nodes"]) >= self.max_nodes:
+                                alive = set(self.watch())
+                                if all(n in alive
+                                       for n in gen["nodes"]):
+                                    # cluster full of LIVE nodes: no
+                                    # slot is coming — don't spin
+                                    # forever. (A dead member means a
+                                    # reshuffle is imminent; keep
+                                    # waiting to replace it.)
+                                    return "not-admitted"
                             # joining node: keep heartbeating until the
                             # leader includes us in a future generation
                             time.sleep(self.heartbeat_interval)

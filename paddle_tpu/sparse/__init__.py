@@ -34,6 +34,13 @@ class SparseTensor(Tensor):
         if self._dense_cache is None:
             vref = getattr(self, "_values_ref", None)
             if vref is not None and not vref.stop_gradient:
+                from ..core import autograd as _ag
+                if not _ag.is_grad_enabled():
+                    # no_grad access: densify WITHOUT caching, so a
+                    # later grad-enabled access can still adopt the
+                    # grad node (caching here would permanently sever
+                    # the conv/bn weight gradients)
+                    return self._bcoo.todense()
                 # densify THROUGH the autograd graph and adopt the
                 # resulting grad node, so inherited dense Tensor ops
                 # consuming this sparse tensor keep gradients flowing
