@@ -23,7 +23,7 @@ import time
 import numpy as np
 
 PEAK_FLOPS = 197e12  # v5e bf16 peak per chip
-BUDGET_S = 520.0     # soft wall-clock budget for the whole suite
+BUDGET_S = 555.0     # soft wall-clock budget for the whole suite
 
 _t_start = time.time()
 
@@ -238,21 +238,49 @@ def bench_decode():
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.models.gpt import GPTForGeneration
 
-    m = GPTForGeneration(vocab_size=50304, hidden_size=1024,
-                         num_layers=24, num_attention_heads=16,
-                         max_position_embeddings=2048,
-                         compute_dtype="bfloat16", weight_only=True)
-    m.eval()
-    B, P, T = 64, 128, 128
-    rng = np.random.RandomState(0)
-    ids = Tensor(rng.randint(0, 50304, (B, P)).astype(np.int32))
-    out, _ = m.generate(ids, max_new_tokens=T)  # compile + warm
-    np.asarray(out.numpy())
-    t0 = time.perf_counter()
-    out, _ = m.generate(ids, max_new_tokens=T)
-    np.asarray(out.numpy())
-    dt = time.perf_counter() - t0
-    return B * T / dt, None  # bandwidth-bound; MFU not meaningful
+    def _decode_tps(m, B, T=128):
+        P = 128
+        rng = np.random.RandomState(0)
+        ids = Tensor(rng.randint(0, 50304, (B, P)).astype(np.int32))
+        out, _ = m.generate(ids, max_new_tokens=T)  # compile + warm
+        np.asarray(out.numpy())
+        t0 = time.perf_counter()
+        out, _ = m.generate(ids, max_new_tokens=T)
+        np.asarray(out.numpy())
+        return B * T / (time.perf_counter() - t0)
+
+    def run(weight_only, B, T=128):
+        m = GPTForGeneration(vocab_size=50304, hidden_size=1024,
+                             num_layers=24, num_attention_heads=16,
+                             max_position_embeddings=2048,
+                             compute_dtype="bfloat16",
+                             weight_only=weight_only)
+        m.eval()
+        return m, _decode_tps(m, B, T)
+
+    m64, tps = run(True, 64)
+    extra = {"metric": "gpt2_350m_decode_int8_speedup_b1",
+             "skipped": "time budget",
+             "measured_offline": "1.26-1.34x at B=1 "
+                                 "(docs/decode_int8_analysis.md)"}
+    if _budget_left() > 100:
+        # the weight-only-int8 REGIME win: B=1 serving is
+        # weight-bandwidth-bound (int8 halves HBM reads); at B>=8 the
+        # KV cache + per-step kernel latency dominate and int8 ~ bf16
+        # (docs/decode_int8_analysis.md). Failure here must not lose
+        # the already-measured headline.
+        try:
+            i8 = _decode_tps(m64, 1)  # same weights, new batch shape
+            del m64
+            import gc
+            gc.collect()
+            _, b16 = run(False, 1)
+            extra = {"metric": "gpt2_350m_decode_int8_speedup_b1",
+                     "value": round(i8 / b16, 3), "unit": "x vs bf16"}
+        except Exception as e:  # noqa: BLE001
+            extra = {"metric": "gpt2_350m_decode_int8_speedup_b1",
+                     "error": f"{type(e).__name__}: {e}"}
+    return tps, None, extra  # bandwidth-bound; MFU not meaningful
 
 
 def main():
@@ -292,11 +320,13 @@ def main():
                     {"metric": name, "skipped": "time budget"})
                 continue
             try:
-                val, mfu = fn()
+                res = fn()
             except Exception as e:
                 result["extras"].append(
                     {"metric": name, "error": f"{type(e).__name__}: {e}"})
                 continue
+            # (value, mfu) or (value, mfu, secondary-metric dict)
+            val, mfu, extra_metric = (tuple(res) + (None,))[:3]
             if val is None:
                 result["extras"].append(
                     {"metric": name, "skipped": "not available"})
@@ -304,6 +334,8 @@ def main():
             result["extras"].append({
                 "metric": name, "value": round(val, 1), "unit": unit,
                 "mfu": round(mfu, 4) if mfu else None})
+            if extra_metric is not None:
+                result["extras"].append(extra_metric)
 
     print(json.dumps(result))
 
