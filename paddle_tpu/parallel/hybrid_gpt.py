@@ -54,6 +54,10 @@ class GPTConfig:
     moe_experts: int = 0     # 0 = dense
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # fused residual-add+LN Pallas kernel between attention and FFN
+    # (docs/gpt_perf_analysis.md: the XLA add/LN fusions pay carry-layout
+    # conversions); jnp fallback off-TPU
+    fused_add_ln: bool = True
     # memory / precision
     remat: bool = True
     # None = full per-block recompute; else a jax.checkpoint_policies
@@ -306,9 +310,13 @@ def _block(x, lp, cfg: GPTConfig):
     attn, b_o = _attention(h, lp["w_qkv"], lp["b_qkv"], lp["w_o"],
                            lp["b_o"], cfg)
     attn = reduce_mp(attn) + b_o.astype(attn.dtype)
-    x = x + attn.astype(x.dtype)
-
-    h2 = _layer_norm(x, lp["ln2_w"], lp["ln2_b"])
+    if cfg.fused_add_ln:
+        from ..ops.pallas.layer_norm import add_ln
+        h2, x = add_ln(x, attn.astype(x.dtype), lp["ln2_w"],
+                       lp["ln2_b"])
+    else:
+        x = x + attn.astype(x.dtype)
+        h2 = _layer_norm(x, lp["ln2_w"], lp["ln2_b"])
     aux = jnp.zeros((), jnp.float32)
     if cfg.moe_experts:
         h2 = gather_sp(h2)
