@@ -43,6 +43,17 @@ def _platform_of(dev) -> str:
     return {"cpu": "cpu", "tpu": "tpu", "axon": "tpu"}.get(p, p)
 
 
+def on_tpu_backend() -> bool:
+    """True when the default jax backend is a TPU (incl. the axon
+    relay).  The single shared predicate for TPU-only fast paths
+    (Pallas kernels, rbg RNG); extend the platform set here, not at
+    call sites."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 class CPUPlace(Place):
     kind = "cpu"
 

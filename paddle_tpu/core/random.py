@@ -14,9 +14,31 @@ replicated or varied across model-parallel ranks as required).
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 import numpy as np
+
+
+def _use_rbg() -> bool:
+    """TPU default: the hardware RngBitGenerator PRNG ('rbg') instead of
+    threefry. Threefry is a software counter-based PRNG that costs real
+    compute on TPU (measured 11.3 ms/step of a 65 ms BERT-base AMP
+    train step just for dropout masks); rbg lowers to the on-chip RNG
+    and is effectively free. Same design choice as T5X/MaxText.
+    Opt out: PADDLE_TPU_RBG_RANDOM=0. Off-TPU keeps threefry (bitwise
+    reproducibility of existing CPU tests)."""
+    if os.environ.get("PADDLE_TPU_RBG_RANDOM", "1") != "1":
+        return False
+    from .place import on_tpu_backend
+    return on_tpu_backend()
+
+
+def make_key(s: int):
+    """Seed -> PRNG key with the platform-appropriate implementation."""
+    if _use_rbg():
+        return jax.random.key(int(s), impl="rbg")
+    return jax.random.PRNGKey(int(s))
 
 
 class RNGState:
@@ -28,12 +50,12 @@ class RNGState:
         return sub
 
 
-_stack = [RNGState(jax.random.PRNGKey(0))]
+_stack = [RNGState(make_key(0))]
 
 
 def seed(s: int):
     """paddle.seed parity."""
-    _stack[0] = RNGState(jax.random.PRNGKey(int(s)))
+    _stack[0] = RNGState(make_key(int(s)))
     return _stack[0]
 
 
@@ -74,7 +96,7 @@ class RNGStatesTracker:
     def add(self, name, s):
         if name in self.states_:
             raise ValueError(f"state {name} already exists")
-        self.states_[name] = RNGState(jax.random.PRNGKey(int(s)))
+        self.states_[name] = RNGState(make_key(int(s)))
 
     def reset(self):
         self.states_ = {}

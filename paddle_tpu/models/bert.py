@@ -74,13 +74,15 @@ class BertModel(nn.Layer):
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
         if attention_mask is None:
-            attention_mask = ops.cast(
-                ops.not_equal(input_ids,
-                              ops.full_like(input_ids, self.pad_token_id)),
-                "float32")
-        # [B, S] -> additive mask [B, 1, 1, S]
-        mask = ops.unsqueeze(attention_mask, [1, 2])
-        mask = (mask - 1.0) * 1e9
+            attention_mask = ops.not_equal(
+                input_ids, ops.full_like(input_ids, self.pad_token_id))
+        # [B, S] -> bool key-padding mask [B, 1, 1, S]: stays bool so
+        # scaled_dot_product_attention can fold it into the splash flash
+        # kernel as segment ids when attention dropout is 0 (eval,
+        # long-sequence pretrain configs). With probs dropout active the
+        # additive XLA path runs either way — the r5 BERT bench win came
+        # from AMP O2 + the rbg dropout RNG (core/random.py), not this.
+        mask = ops.unsqueeze(ops.cast(attention_mask, "bool"), [1, 2])
         emb = self.embeddings(input_ids, token_type_ids, position_ids)
         seq_out = self.encoder(emb, src_mask=mask)
         pooled = self.pooler(seq_out)

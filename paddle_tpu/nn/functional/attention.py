@@ -58,20 +58,54 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None,
     return out.astype(orig_dtype)
 
 
+def _is_key_padding_mask(mask) -> bool:
+    """Static (shape+dtype) test: is this mask a per-key boolean padding
+    mask the splash kernel can take as segment ids?  [*, 1, 1, S] bool
+    only — a 2-D mask means [S, S] in paddle's broadcast convention, and
+    float masks are additive biases whose values must be honored exactly
+    (ALiBi-style soft biases would be silently destroyed by any
+    keep/drop binarization), so they always take the additive XLA
+    path."""
+    return (mask.dtype == jnp.bool_ and mask.ndim == 4
+            and mask.shape[1] == 1 and mask.shape[2] == 1)
+
+
+def _mask_to_keep(mask, batch):
+    """[*, 1, 1, S] bool mask -> [B, S] int32 keep vector (True =
+    attend), broadcast over a size-1 mask batch dim."""
+    flat = mask.reshape(mask.shape[0], mask.shape[-1])
+    return jnp.broadcast_to(flat, (batch, mask.shape[-1])).astype(
+        jnp.int32)
+
+
+def _bias_from_mask(mask):
+    """Additive f32 bias from a bool or float mask (for the XLA path)."""
+    if mask is None:
+        return None
+    if mask.dtype == jnp.bool_:
+        return jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    return mask.astype(jnp.float32)
+
+
 def _attention_impl(q, k, v, bias, causal, scale, dropout_p, dropout_key,
                     use_pallas):
-    if use_pallas and bias is None and dropout_p == 0.0 \
-            and q.shape[1] == k.shape[1] and q.shape[2] == k.shape[2]:
+    if use_pallas and dropout_p == 0.0 \
+            and q.shape[1] == k.shape[1] and q.shape[2] == k.shape[2] \
+            and (bias is None or _is_key_padding_mask(bias)):
         # equal head counts only: GQA/MQA q/kv head mismatch takes the
         # XLA path (jax.nn.dot_product_attention broadcasts kv heads)
         from ...ops.pallas.flash_attention import (splash_mha,
                                                   splash_supported)
         if splash_supported(q.shape[1], q.shape[-1]):
+            kv_keep = None if bias is None else _mask_to_keep(
+                bias, q.shape[0])
             # [B, S, H, D] -> [B, H, S, D] kernel layout
             out = splash_mha(
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+                jnp.swapaxes(v, 1, 2), causal=causal, scale=scale,
+                kv_keep=kv_keep)
             return jnp.swapaxes(out, 1, 2)
+    bias = _bias_from_mask(bias)
     return _xla_attention(q, k, v, bias, causal, scale, dropout_p,
                           dropout_key)
 
@@ -122,7 +156,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         inputs.append(as_tensor(attn_mask))
     from ...core import random as rng
     dkey = rng.next_key() if (dropout_p > 0.0 and training) else None
-    use_pallas = _on_tpu(q._data) and attn_mask is None and dropout_p == 0.0
+    # [*,1,1,S] bool key-padding masks ride the splash kernel as
+    # segment ids; float biases and dense per-query masks take the
+    # exact additive XLA path, as does any attention dropout (probs
+    # dropout cannot ride a fused flash kernel)
+    m = inputs[3]._data if len(inputs) > 3 else None
+    use_pallas = _on_tpu(q._data) and dropout_p == 0.0 and (
+        m is None or _is_key_padding_mask(m))
 
     def _fn(qa, ka, va, *rest):
         bias = rest[0] if rest else None

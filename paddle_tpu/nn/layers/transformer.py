@@ -26,6 +26,13 @@ def _convert_attention_mask(attn_mask, dtype):
     from ... import ops
     attn_mask = as_tensor(attn_mask)
     if attn_mask.dtype == jnp.bool_:
+        # key-padding-shaped bool masks ([*, 1, 1, S]) stay bool:
+        # F.scaled_dot_product_attention folds them into the splash
+        # flash kernel as segment ids (when attention dropout is 0 and
+        # the shape tiles) instead of an additive bias
+        from ..functional.attention import _is_key_padding_mask
+        if _is_key_padding_mask(attn_mask._data):
+            return attn_mask
         zero = ops.zeros_like(ops.cast(attn_mask, "float32"))
         return ops.where(attn_mask, zero, ops.full_like(zero, -1e9))
     return attn_mask.astype("float32")

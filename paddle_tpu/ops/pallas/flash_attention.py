@@ -37,12 +37,16 @@ DEFAULT_BLOCK_K = 256
 
 _SPLASH_CACHE = {}
 
+# Set by tests to run the splash kernel in Pallas interpret mode on the
+# CPU mesh (exercises the real mask/segment plumbing without a TPU).
+_INTERPRET = False
+
 
 def _on_tpu_backend() -> bool:
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
+    if _INTERPRET:
+        return True
+    from ...core.place import on_tpu_backend
+    return on_tpu_backend()
 
 
 def splash_supported(seq_len: int, head_dim: int) -> bool:
@@ -52,14 +56,23 @@ def splash_supported(seq_len: int, head_dim: int) -> bool:
             and head_dim % 64 == 0 and seq_len >= 128)
 
 
-def _splash_kernel(n_heads: int, seq_len: int, causal: bool):
+def _splash_kernel(n_heads: int, seq_len: int, causal: bool,
+                   segmented: bool = False,
+                   residual_ckpt: str | None = None):
     """Build (and cache) a vmapped splash kernel for [B, H, S, D] inputs.
 
     Block sizes: the largest power-of-two tile <= 1024 dividing S, with
     the fused dkv backward — measured fastest on v5e at S=1024 (5.0
-    ms/layer fwd+bwd vs 10.6 for XLA's attention at [32,16,1024,64])."""
+    ms/layer fwd+bwd vs 10.6 for XLA's attention at [32,16,1024,64]).
+
+    `segmented=True` builds the variant taking per-position segment ids
+    (key-padding / ragged batches): position i attends j iff their
+    segment ids match, fused into the same kernel (the TPU answer to the
+    reference's varlen `flash_attn_unpadded` cu_seqlens path,
+    `python/paddle/nn/functional/flash_attention.py:327`)."""
     block = next(b for b in (1024, 512, 256, 128) if seq_len % b == 0)
-    key = (n_heads, seq_len, causal, block)
+    key = (n_heads, seq_len, causal, block, segmented, residual_ckpt,
+           _INTERPRET)
     if key not in _SPLASH_CACHE:
         from jax.experimental.pallas.ops.tpu.splash_attention import (
             splash_attention_kernel as sk, splash_attention_mask as smask)
@@ -71,17 +84,40 @@ def _splash_kernel(n_heads: int, seq_len: int, causal: bool):
         m = (smask.CausalMask((seq_len, seq_len)) if causal
              else smask.FullMask((seq_len, seq_len)))
         mask = smask.MultiHeadMask([m] * n_heads)
-        _SPLASH_CACHE[key] = jax.vmap(
-            sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
-                               block_sizes=bs))
+        kern = sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                                  block_sizes=bs, interpret=_INTERPRET,
+                                  residual_checkpoint_name=residual_ckpt)
+        if segmented:
+            _SPLASH_CACHE[key] = jax.vmap(
+                lambda q, k, v, seg: kern(q, k, v, segment_ids=seg))
+        else:
+            _SPLASH_CACHE[key] = jax.vmap(kern)
     return _SPLASH_CACHE[key]
 
 
-def splash_mha(q, k, v, *, causal=True, scale=None):
+SPLASH_RESIDUAL_NAME = "splash_residuals"
+
+
+def splash_mha(q, k, v, *, causal=True, scale=None, kv_keep=None,
+               save_residuals_for_remat=False):
     """Multi-head self-attention on [B, H, S, D] tensors (q and k/v
     must share S — causal alignment for a shorter decode-style q is a
     different op; use the general masked path in
     `nn.functional.scaled_dot_product_attention` for KV-cache decode).
+
+    `kv_keep`: optional [B, S] key-padding mask (nonzero = real token).
+    Folded into the kernel as segment ids — real tokens are segment 1,
+    padding segment 0, so real queries attend exactly the real keys.
+    Padded query rows attend (only) other padded rows; their outputs are
+    garbage by contract, exactly like the reference's varlen flash path
+    where padded rows are never read back.
+
+    `save_residuals_for_remat`: tag the kernel's saved residuals (out +
+    logsumexp) with `checkpoint_name(SPLASH_RESIDUAL_NAME)` so a
+    surrounding `jax.checkpoint(policy=save_only_these_names(
+    SPLASH_RESIDUAL_NAME))` keeps them across the backward instead of
+    re-running the attention forward during remat (the reference keeps
+    softmax_lse for the same reason, `flash_attn_kernel.h:21`).
 
     TPU: splash Pallas kernel (fwd + fused backward). Off-TPU or for
     non-tileable shapes: XLA's fused attention. Differentiable either
@@ -98,11 +134,23 @@ def splash_mha(q, k, v, *, causal=True, scale=None):
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if splash_supported(s, d):
-        kern = _splash_kernel(h, s, causal)
-        return kern((q * scale).astype(q.dtype), k, v)
+        qs = (q * scale).astype(q.dtype)
+        rc = SPLASH_RESIDUAL_NAME if save_residuals_for_remat else None
+        if kv_keep is not None:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (
+                splash_attention_kernel as sk)
+            seg = kv_keep.astype(jnp.int32)
+            kern = _splash_kernel(h, s, causal, segmented=True,
+                                  residual_ckpt=rc)
+            return kern(qs, k, v, sk.SegmentIds(q=seg, kv=seg))
+        kern = _splash_kernel(h, s, causal, residual_ckpt=rc)
+        return kern(qs, k, v)
+    mask = None
+    if kv_keep is not None:
+        mask = (kv_keep != 0)[:, None, None, :]  # [B, 1, 1(q), S]
     return jax.nn.dot_product_attention(
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-        jnp.swapaxes(v, 1, 2), scale=scale,
+        jnp.swapaxes(v, 1, 2), scale=scale, mask=mask,
         is_causal=causal).transpose(0, 2, 1, 3)
 
 

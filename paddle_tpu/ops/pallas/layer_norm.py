@@ -25,10 +25,8 @@ from jax.experimental import pallas as pl
 
 
 def _on_tpu():
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
+    from ...core.place import on_tpu_backend
+    return on_tpu_backend()
 
 
 # --------------------------------------------------------------- kernels

@@ -68,6 +68,17 @@ class GPTConfig:
     # single largest buffer (6.6GB at B=32,S=1024,V=50k) — chunking the
     # head+CE over S with per-chunk remat caps it at 1/N of that
     ce_seq_chunks: int = 1
+    # mp=1 fused softmax-CE custom vjp (bf16 logits, recomputed in bwd)
+    fused_ce: bool = True
+    # python-unrolled layer loop (static slice indices) instead of
+    # lax.scan: trades compile time for removing the scan-backward's
+    # stacked-gradient dynamic-update-slice traffic
+    unroll_layers: bool = False
+    # AMP-O2-style step: cast params to compute_dtype once up front and
+    # differentiate wrt the bf16 copies — gradients (and the scan-bwd
+    # stacked-grad DUS traffic) stay bf16; Adam still updates the f32
+    # master params
+    bf16_grads: bool = False
     compute_dtype: Any = jnp.bfloat16
     # optimizer
     learning_rate: float = 1e-4
@@ -203,7 +214,9 @@ def _attention(x, w_qkv, b_qkv, w_o, b_o, cfg: GPTConfig):
     q = q.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
     k_ = k_.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
-    ctx = splash_mha(q, k_, v, causal=True, scale=1.0 / math.sqrt(hd))
+    ctx = splash_mha(q, k_, v, causal=True, scale=1.0 / math.sqrt(hd),
+                     save_residuals_for_remat=(
+                         cfg.remat_policy == "save_splash_residuals"))
     out = jnp.einsum("bhse,hed->bsd", ctx.astype(cd),
                      w_o.astype(cd).reshape(h_loc, hd, d))
     # row-parallel: partial sums over mp; reduction by caller
@@ -344,12 +357,30 @@ def _stage_forward(x, blocks_local, cfg: GPTConfig):
         # so named no-batch-dims policies are safe to try via
         # cfg.remat_policy.)
         policy = None
-        if cfg.remat_policy is not None:
+        if cfg.remat_policy == "save_splash_residuals":
+            # keep the splash kernel's (out, logsumexp) residuals across
+            # the backward: the block still fully remats (LN/FFN/matmuls
+            # recompute) but the attention forward does NOT re-run — its
+            # fused bwd kernel reads the saved residuals directly.
+            # +~66MB/layer at [32,16,1024,64] bf16 for -1 splash fwd pass
+            from ..ops.pallas.flash_attention import SPLASH_RESIDUAL_NAME
+            policy = jax.checkpoint_policies.save_only_these_names(
+                SPLASH_RESIDUAL_NAME)
+        elif cfg.remat_policy is not None:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
         block_fn = jax.checkpoint(lambda c, p: _block(c, p, cfg),
                                   policy=policy)
     else:
         block_fn = lambda c, p: _block(c, p, cfg)  # noqa: E731
+
+    if cfg.unroll_layers:
+        n = jax.tree_util.tree_leaves(blocks_local)[0].shape[0]
+        aux_tot = jnp.zeros((), jnp.float32)
+        for i in range(n):
+            lp = jax.tree_util.tree_map(lambda a: a[i], blocks_local)
+            x, aux = block_fn(x, lp)
+            aux_tot = aux_tot + aux
+        return x, aux_tot
 
     def body(carry, lp):
         y, aux = block_fn(carry, lp)
@@ -373,9 +404,63 @@ def _vocab_parallel_embed(tokens, tok_emb_local, cfg: GPTConfig):
     return jax.lax.psum(emb, "mp")
 
 
+def _ce_sum_fused(y, head_local, labels, cfg: GPTConfig):
+    """mp=1 fused softmax-CE (sum) with a custom vjp.
+
+    The reference's `c_softmax_with_cross_entropy` / Megatron fused CE
+    capability, TPU-style: logits stay in compute dtype (bf16) and are
+    NEVER saved — the fp32 upcast feeds only the logsumexp/gather
+    *reductions* (XLA fuses the convert into them, so no fp32 [B,S,V]
+    buffer materialises), and the backward recomputes the bf16 logits
+    from (y, head) with one extra head matmul. Residuals are just
+    (yc, hc, lse, labels): the head's ~6.6GB fp32 logits highwater at
+    [32,1024,50304] drops to a transient bf16 3.3GB, which is what buys
+    the memory for the save_splash_residuals remat policy."""
+    cd = cfg.compute_dtype
+    y_dt, h_dt = y.dtype, head_local.dtype
+
+    def _logits(yc, hc):
+        return jnp.einsum("bsd,dv->bsv", yc, hc,
+                          preferred_element_type=cd)
+
+    @jax.custom_vjp
+    def ce(y, head, labels):
+        return _fwd(y, head, labels)[0]
+
+    def _fwd(y, head, labels):
+        logits = _logits(y.astype(cd), head.astype(cd))
+        lf = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lf, axis=-1)
+        tgt = jnp.take_along_axis(lf, labels[..., None], axis=-1)[..., 0]
+        # residuals are (y, head, lse, labels): y and head are alive in
+        # the caller anyway (no extra buffer), the bf16 casts + logits
+        # recompute in _bwd
+        return jnp.sum(lse - tgt), (y, head, lse, labels)
+
+    def _bwd(res, g):
+        y, head, lse, labels = res
+        yc, hc = y.astype(cd), head.astype(cd)
+        logits = _logits(yc, hc)
+        # d/dlogits = softmax - onehot
+        probs = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+        oh = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+        dlogits = (g * (probs - oh)).astype(cd)
+        dy = jnp.einsum("bsv,dv->bsd", dlogits, hc,
+                        preferred_element_type=jnp.float32)
+        dw = jnp.einsum("bsd,bsv->dv", yc, dlogits,
+                        preferred_element_type=jnp.float32)
+        return (dy.astype(y_dt), dw.astype(h_dt),
+                np.zeros(labels.shape, jax.dtypes.float0))
+
+    ce.defvjp(_fwd, _bwd)
+    return ce(y, head_local, labels)
+
+
 def _ce_sum(y, head_local, labels, cfg: GPTConfig):
     """Sum (not mean) of token CE over y [B,S',d]."""
     V_loc = head_local.shape[1]
+    if cfg.mp == 1 and cfg.fused_ce:
+        return _ce_sum_fused(y, head_local, labels, cfg)
     logits = jnp.einsum("bsd,dv->bsv", y.astype(cfg.compute_dtype),
                         head_local.astype(cfg.compute_dtype),
                         preferred_element_type=jnp.float32)
@@ -671,8 +756,16 @@ class HybridGPT:
             out_specs=P(), check_vma=False)
 
         def step(params, opt_state, tokens, labels, lr, t):
-            loss, grads = jax.value_and_grad(loss_sm)(params, tokens,
-                                                      labels)
+            if cfg_ref.bf16_grads:
+                cd = cfg_ref.compute_dtype
+                pc = jax.tree.map(
+                    lambda a: a.astype(cd)
+                    if a.dtype == jnp.float32 else a, params)
+                loss, grads = jax.value_and_grad(loss_sm)(pc, tokens,
+                                                          labels)
+            else:
+                loss, grads = jax.value_and_grad(loss_sm)(params, tokens,
+                                                          labels)
             if cfg_ref.grad_clip > 0:
                 sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                          for g in jax.tree.leaves(grads))

@@ -1,0 +1,97 @@
+"""Parse a jax.profiler xplane trace: aggregate TPU device-plane op time.
+
+Usage: python tools/parse_xplane.py <trace_dir> [n_steps] [top_k]
+
+Finds the newest .xplane.pb under <trace_dir>, sums duration by HLO op
+name on the TPU device plane's "XLA Ops" line, and prints a per-step
+table (total / n_steps).  This is the ground-truth timing method on the
+axon relay, where host-side single-kernel timing is meaningless
+(docs/gpt_perf_analysis.md "Setup").
+
+Requires PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python (the in-image
+C++ protobuf lacks the xplane descriptor); set automatically below.
+"""
+import collections
+import glob
+import os
+import re
+import sys
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def load_xplane(trace_dir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise SystemExit(f"no .xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def device_op_times(xs):
+    """{op_name: total_ns} over all TPU device planes' XLA Ops lines."""
+    out = collections.Counter()
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "/device:" not in plane.name:
+            continue
+        ev_meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name not in ("XLA Ops", "XLA Modules", "Steps"):
+                continue
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev_meta[ev.metadata_id].name
+                out[name] += ev.duration_ps // 1000
+    return out
+
+
+def bucket(name):
+    """Group HLO op names into readable classes."""
+    n = name.lower()
+    for pat, label in (
+            (r"splash|flash", "splash attention"),
+            (r"fusion.*softmax|softmax", "softmax fusion"),
+            (r"convolution|conv", "conv/matmul (convolution hlo)"),
+            (r"dot", "matmul (dot)"),
+            (r"all-reduce|all-gather|reduce-scatter|collective",
+             "collectives"),
+            (r"dynamic-update-slice", "dynamic-update-slice"),
+            (r"copy|transpose|bitcast", "copy/transpose"),
+            (r"scatter", "scatter"),
+            (r"gather", "gather"),
+            (r"reduce", "reduce fusion"),
+            (r"fusion", "other fusion"),
+    ):
+        if re.search(pat, n):
+            return label
+    return "other"
+
+
+def main():
+    trace_dir = sys.argv[1]
+    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    top_k = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+    xs = load_xplane(trace_dir)
+    times = device_op_times(xs)
+    total = sum(times.values())
+    print(f"device total: {total / 1e6 / n_steps:.2f} ms/step "
+          f"({len(times)} distinct ops)")
+    print("\n-- by bucket --")
+    buckets = collections.Counter()
+    for name, ns in times.items():
+        buckets[bucket(name)] += ns
+    for b, ns in buckets.most_common():
+        print(f"{ns / 1e6 / n_steps:9.2f} ms  {100 * ns / total:5.1f}%  {b}")
+    print(f"\n-- top {top_k} ops --")
+    for name, ns in times.most_common(top_k):
+        print(f"{ns / 1e6 / n_steps:9.2f} ms  {100 * ns / total:5.1f}%  "
+              f"{name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
