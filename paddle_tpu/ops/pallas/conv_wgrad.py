@@ -1,0 +1,70 @@
+"""Pallas split-K conv weight-gradient kernel — a measured NEGATIVE result.
+
+Context (VERDICT r4 #3, docs/resnet50_perf_analysis.md): ResNet-50's
+weight-grad convs run at 37% MXU under XLA's conv emitter. The 1x1-conv
+weight grads are the largest class (5.7 of 11.6 ms/step at B=128): they
+are tall-skinny split-K matmuls — dW[Ci,Co] = x[N,Ci]^T @ dy[N,Co] with
+N = B*H*W up to 401k and outputs as small as 256x64, a shape where a
+single output tile serializes the whole contraction.
+
+This module implements the obvious TPU answer — a Pallas split-K kernel
+(grid over N-chunks, f32 accumulator revisited across sequential grid
+steps) — and it LOSES to XLA's own dot_general at equal layouts:
+
+    [N=401408, Ci=256, Co=64] bf16 (v5e, r5):
+      XLA dot_general (standalone)   278 us   (~bandwidth floor: 312 us)
+      pallas split-K, Nc=2048        373 us
+      pallas split-K, Nc=4096        362 us
+      pallas split-K, Nc=8192        363 us
+      in-model wgrad fusion          615 us
+
+XLA's standalone matmul is already AT the HBM roofline for this shape;
+the in-model 2.2x gap comes from the channel-minor NCHW feature layouts
+({1,0,3,2}) the rest of the net prefers — the wgrad fusion pays an
+internal relayout, which a custom kernel cannot avoid either (it would
+just move the copy in front of the kernel; forcing NHWC model-wide was
+measured flat in r3, docs/resnet50_perf_analysis.md "channels-last").
+
+The kernel is kept (a) as the committed artifact of the experiment and
+(b) because the split-K pattern is the right building block if a future
+XLA version regresses; `wgrad_1x1` is correct and tested (interpret
+mode) but NOT wired into the conv backward path — XLA wins.
+
+Reference for what the CUDA side does about the same problem:
+`paddle/phi/kernels/gpudnn/conv_kernel.cu:1` (exhaustive cudnn algo
+search over precomputed workspaces).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def wgrad_1x1(x, dy, *, chunk=4096, interpret=False):
+    """dW[Ci,Co] (f32) = x[N,Ci]^T @ dy[N,Co] via split-K Pallas.
+
+    N must be divisible by `chunk`. Sequential grid steps revisit the
+    single output block, accumulating partial [Ci,Co] products in f32.
+    """
+    N, Ci = x.shape
+    _, Co = dy.shape
+    if N % chunk != 0:
+        raise ValueError(f"N={N} not divisible by chunk={chunk}")
+
+    def kern(x_ref, dy_ref, o_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            o_ref[...] = jnp.zeros_like(o_ref)
+        o_ref[...] += jax.lax.dot_general(
+            x_ref[...], dy_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((Ci, Co), jnp.float32),
+        grid=(N // chunk,),
+        in_specs=[pl.BlockSpec((chunk, Ci), lambda i: (i, 0)),
+                  pl.BlockSpec((chunk, Co), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((Ci, Co), lambda i: (0, 0)),
+        interpret=interpret)(x, dy)
