@@ -103,3 +103,77 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
             (-1,) + (1,) * (msgs.ndim - 1))
     return dispatch.apply("send_ue_recv", _fn,
                           (x, y, src_index, dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, compute_type="add", name=None):
+    """`graph_send_uv_kernel.h` — per-edge message from both endpoints:
+    out[e] = x[src[e]] OP y[dst[e]]."""
+    x, y = as_tensor(x), as_tensor(y)
+    src_index, dst_index = as_tensor(src_index), as_tensor(dst_index)
+    ops_ = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}
+    op = ops_[compute_type]
+
+    def _fn(xa, ya, src, dst):
+        return op(jnp.take(xa, src, axis=0), jnp.take(ya, dst, axis=0))
+    return dispatch.apply("graph_send_uv", _fn,
+                          (x, y, src_index, dst_index))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """`graph_reindex_kernel.h` — compact node ids: unique over
+    (x ++ neighbors), remap neighbors to local ids (host-side like the
+    reference CPU kernel; ragged output sizes)."""
+    xs = np.asarray(as_tensor(x).numpy()).reshape(-1)
+    nb = np.asarray(as_tensor(neighbors).numpy()).reshape(-1)
+    ct = np.asarray(as_tensor(count).numpy()).reshape(-1)
+    keep = {}
+    for v in xs.tolist():
+        if v not in keep:
+            keep[v] = len(keep)
+    for v in nb.tolist():
+        if v not in keep:
+            keep[v] = len(keep)
+    reindex_src = np.asarray([keep[v] for v in nb], np.int64)
+    # dst of edge j is the center node whose count covers j
+    reindex_dst = np.repeat(np.arange(len(ct)), ct).astype(np.int64)
+    out_nodes = np.asarray(list(keep.keys()),
+                           xs.dtype if xs.size else np.int64)
+    from ..core.tensor import Tensor as _T
+    return (_T(jnp.asarray(reindex_src)), _T(jnp.asarray(reindex_dst)),
+            _T(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """`graph_sample_neighbors_kernel.h` — uniform neighbor sampling
+    from CSC (row, colptr) for the given nodes (host-side, like the
+    reference's CPU path; the PS GraphTable covers the distributed
+    case)."""
+    rows = np.asarray(as_tensor(row).numpy()).reshape(-1)
+    cp = np.asarray(as_tensor(colptr).numpy()).reshape(-1)
+    nodes = np.asarray(as_tensor(input_nodes).numpy()).reshape(-1)
+    rng = np.random.default_rng()
+    out, cnt, oeids = [], [], []
+    ei = np.asarray(as_tensor(eids).numpy()).reshape(-1) \
+        if eids is not None else None
+    for n in nodes.tolist():
+        beg, end = int(cp[n]), int(cp[n + 1])
+        neigh = rows[beg:end]
+        idx = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out.append(neigh)
+        cnt.append(len(neigh))
+        if ei is not None:
+            oeids.append(ei[idx])
+    from ..core.tensor import Tensor as _T
+    res = (_T(jnp.asarray(np.concatenate(out) if out else
+                          np.zeros(0, rows.dtype))),
+           _T(jnp.asarray(np.asarray(cnt, np.int32))))
+    if return_eids and ei is not None:
+        return res + (_T(jnp.asarray(np.concatenate(oeids))),)
+    return res

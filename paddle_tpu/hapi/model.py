@@ -224,6 +224,12 @@ class Model:
                                 save_dir=save_dir,
                                 metrics=self._metrics_name())
         cbks.on_train_begin()
+        # throughput timer (python/paddle/profiler/timer.py parity):
+        # paddle.profiler.benchmark().step_info() reports reader/batch
+        # cost + ips for this fit loop
+        from ..profiler.timer import benchmark as _benchmark
+        _bm = _benchmark()
+        _bm.begin()
         self.stop_training = False
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
@@ -294,7 +300,10 @@ class Model:
             static_lr = not hasattr(
                 getattr(self._optimizer, "_learning_rate", 0.0), "step")
             for step, batch in enumerate(loader):
+                _bm.after_reader()
                 ins, lbs = self._split_batch(batch)
+                _bs = next((int(x.shape[0]) for x in _to_list(ins)
+                            if hasattr(x, "shape") and len(x.shape)), 1)
                 can_group = (group_ok[0] and self._jit_ok
                              and not self._metrics and static_lr
                              and self._train_step is not None
@@ -312,13 +321,16 @@ class Model:
                     next_is_log = (step + 1) % max(log_freq, 1) == 0
                     if len(pending) >= group_max or next_is_log or \
                             is_last:
+                        _n = len(pending)
                         flush()
+                        _bm.after_step(num_samples=_n * _bs)
                     if is_last:
                         break
                     continue
                 flush()
                 cbks.on_train_batch_begin(step)
                 res = self._train_batch_inner(ins, lbs)
+                _bm.after_step(num_samples=_bs)
                 last_loss[0] = ("plain", res[0][0])
                 # lazy logging: only materialise the loss (device->host
                 # sync) at log points so steps pipeline on the device;

@@ -7,3 +7,33 @@ from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from ..ops.extras3 import identity_loss  # noqa: F401
+from .optimizer import ModelAverage  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """`fusion/fused_softmax_mask_kernel.h` — softmax(x + mask) fused
+    (XLA fuses the add into the softmax reductions on TPU)."""
+    import jax
+    from ..core import dispatch
+    from ..ops._helpers import as_tensor
+
+    def f(a, m):
+        return jax.nn.softmax(a + m.astype(a.dtype), axis=-1)
+    return dispatch.apply("softmax_mask_fuse", f,
+                          (as_tensor(x), as_tensor(mask)))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """`fused_softmax_mask_upper_triangle` — causal-masked softmax."""
+    import jax
+    import jax.numpy as jnp
+    from ..core import dispatch
+    from ..ops._helpers import as_tensor
+
+    def f(a):
+        S, T = a.shape[-2], a.shape[-1]
+        m = jnp.tril(jnp.ones((S, T), bool))
+        return jax.nn.softmax(jnp.where(m, a, -1e30), axis=-1)
+    return dispatch.apply("softmax_mask_fuse_upper_triangle", f,
+                          (as_tensor(x),))

@@ -135,23 +135,67 @@ def cond(pred, true_fn, false_fn, names=None, cur_vals=None, both=None):
     return tuple(merged)
 
 
-def while_loop(cond_fn, body_fn, init_vals):
-    """Runtime for a transformed `while`/`for`: cond_fn/body_fn take the
-    loop vars positionally; body_fn returns the updated tuple."""
+def _check_loop_init(init_vals):
+    """Traced loops need every carry defined up front; eager python
+    loops may assign vars inside the body (read-before-assign raises at
+    the read through _Poison, faithful python semantics)."""
     for v in init_vals:
         if isinstance(v, _Undef):
             raise ValueError(
                 "dy2static: loop variables must be initialised before a "
-                "transformed loop")
+                "transformed (traced) loop")
         if isinstance(v, _Poison):
             v._raise()
+
+
+def _lax_carry_ok(v):
+    """Can this value ride a lax loop carry?  Layer objects / UNDEF
+    can't — loops over them must unroll pythonically (possible whenever
+    the loop condition is concrete, e.g. `for blk in self.blocks`)."""
+    if isinstance(v, (_Undef, _Poison)):
+        return False
+    x = _val(v)
+    if _is_tracer(x) or isinstance(x, (bool, int, float, jax.Array)):
+        return True
+    try:
+        jnp.asarray(x)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _loop_dispatch(cond_fn, init_vals):
+    """(traced, c0): traced -> lower to lax; else python loop (which,
+    under an outer jit trace with a CONCRETE condition, simply unrolls
+    — required when the carry holds non-array objects)."""
     c0 = _val(cond_fn(*init_vals))
-    traced = _is_tracer(c0) or any(_is_tracer(_val(v)) for v in init_vals)
-    if not traced:
-        vals = tuple(init_vals)
-        while bool(_val(cond_fn(*vals))):
-            vals = tuple(body_fn(*vals))
-        return vals
+    if _is_tracer(c0):
+        return True, c0
+    if all(_lax_carry_ok(v) for v in init_vals) and \
+            any(_is_tracer(_val(v)) for v in init_vals):
+        return True, c0
+    return False, c0
+
+
+def while_loop(cond_fn, body_fn, init_vals):
+    """Runtime for a transformed `while`/`for`: cond_fn/body_fn take the
+    loop vars positionally; body_fn returns the updated tuple.
+
+    Starts as a python loop whenever the condition is concrete (which,
+    under an outer trace, unrolls — required for non-arrayable carries
+    like Layer objects); escalates to lax.while_loop from the CURRENT
+    state the moment the condition or an arrayable carry turns traced
+    (e.g. a break flag assigned from a traced cond)."""
+    vals = tuple(init_vals)
+    while True:
+        traced, c = _loop_dispatch(cond_fn, vals)
+        if traced:
+            break
+        if not bool(c):
+            return vals
+        vals = tuple(body_fn(*vals))
+    init_vals = vals
+    _check_loop_init(init_vals)
 
     init = tuple(jnp.asarray(_val(v)) for v in init_vals)
 
@@ -187,20 +231,21 @@ def bounded_while(cond_fn, body_fn, init_vals, max_trips):
     data-dependent `for`/`break` loops work in training steps."""
     if max_trips is None:
         return while_loop(cond_fn, body_fn, init_vals)
-    for v in init_vals:
-        if isinstance(v, _Undef):
-            raise ValueError(
-                "dy2static: loop variables must be initialised before a "
-                "transformed loop")
-        if isinstance(v, _Poison):
-            v._raise()
-    c0 = _val(cond_fn(*init_vals))
-    traced = _is_tracer(c0) or any(_is_tracer(_val(v)) for v in init_vals)
-    if not traced:
-        vals = tuple(init_vals)
-        while bool(_val(cond_fn(*vals))):
-            vals = tuple(body_fn(*vals))
-        return vals
+    # python start + mid-loop lax escalation (see while_loop); each
+    # concrete iteration consumed shrinks the remaining scan bound
+    vals = tuple(init_vals)
+    done = 0
+    while True:
+        traced, c = _loop_dispatch(cond_fn, vals)
+        if traced:
+            break
+        if not bool(c):
+            return vals
+        vals = tuple(body_fn(*vals))
+        done += 1
+    init_vals = vals
+    max_trips = max(0, int(max_trips) - done)
+    _check_loop_init(init_vals)
     init = tuple(jnp.asarray(_val(v)) for v in init_vals)
     # probe one body application to learn the steady-state carry dtypes
     # (e.g. `s = 0` then `s = s + x.sum()` promotes int->float); the
@@ -232,9 +277,47 @@ def bounded_while(cond_fn, body_fn, init_vals, max_trips):
     return tuple(_rewrap(r) for r in res)
 
 
+def as_seq(seq):
+    """Materialise a `for x in seq` iterable once so it can be indexed
+    (dict views, generators); tensors and real sequences pass through."""
+    if isinstance(seq, (Tensor, jax.Array, list, tuple, str)):
+        return seq
+    if hasattr(seq, "__getitem__") and hasattr(seq, "__len__"):
+        return seq
+    return list(seq)
+
+
+def seq_len(seq):
+    """Static length of a `for x in seq` iterable: dim-0 for tensors
+    (paddle iterates over dim-0 slices), len() otherwise."""
+    if isinstance(seq, Tensor) or isinstance(seq, jax.Array):
+        return int(seq.shape[0])
+    return len(seq)
+
+
+def seq_get(seq, i):
+    """Index the iterable for the transformed non-range `for`. Python
+    sequences need a concrete index (they are only reached on the eager
+    path); tensors accept traced indices (lax gather)."""
+    iv = _val(i)
+    if isinstance(seq, Tensor):
+        return seq[iv if not isinstance(iv, int) else int(iv)]
+    if isinstance(seq, jax.Array):
+        return _rewrap(seq[iv])
+    return seq[int(iv)]
+
+
 def range_cond(i, stop, step):
-    """`for i in range(...)` continuation test, sign-aware on step."""
+    """`for i in range(...)` continuation test, sign-aware on step.
+
+    Concrete operands MUST produce a python bool even under an active
+    jit trace (jnp ops on constants return tracers there): a concrete
+    condition is what lets loops with non-arrayable carries (e.g.
+    `for layer in self.layers`) unroll pythonically instead of failing
+    the lax-carry check."""
     iv, sv, st = _val(i), _val(stop), _val(step)
+    if not any(_is_tracer(v) for v in (iv, sv, st)):
+        return bool(iv < sv) if st > 0 else bool(iv > sv)
     out = jnp.where(st > 0, iv < sv, iv > sv)
     return _rewrap(out) if (_is_tracer(out) or isinstance(out, Tensor)) \
         else bool(out)
@@ -528,6 +611,64 @@ def _rewrite_returns(body, retv):
     return block(body)
 
 
+def _hoist_loop_returns(body):
+    """Return-inside-loop rewriting (parity:
+    dygraph_to_static/return_transformer.py's loop handling). A shared
+    (flag, value) pair turns `return e` inside any loop into
+    `flag = True; val = e; break`; every loop that transitively
+    contained a return is followed by `if flag: break` (when itself
+    nested in a loop) or `if flag: return val` (at function level),
+    which the subsequent single-exit pass else-hoists. Returns
+    (new_body, used).
+
+    Traced-loop contract: `val` is pre-initialised to 0.0 so it can ride
+    a lax carry; loops returning non-f32-scalar values under tracing
+    fail the carry check loudly and fall back to eager (documented)."""
+    FLAG, VAL = "__dy2s_rflag", "__dy2s_rval"
+    used = [False]
+
+    def assign(name, value):
+        return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+    def rewrite(stmts, in_loop):
+        out = []
+        for st in stmts:
+            if isinstance(st, ast.Return) and in_loop:
+                used[0] = True
+                out.append(assign(FLAG, ast.Constant(value=True)))
+                out.append(assign(VAL, st.value if st.value is not None
+                                  else ast.Constant(value=None)))
+                out.append(ast.Break())
+                continue
+            if isinstance(st, (ast.For, ast.While)) and \
+                    _contains_return_deep([st]):
+                st.body = rewrite(st.body, True)
+                if st.orelse:
+                    st.orelse = rewrite(st.orelse, in_loop)
+                out.append(st)
+                if in_loop:
+                    out.append(ast.If(test=_name(FLAG),
+                                      body=[ast.Break()], orelse=[]))
+                else:
+                    out.append(ast.If(
+                        test=_name(FLAG),
+                        body=[ast.Return(value=_name(VAL))], orelse=[]))
+                continue
+            if isinstance(st, ast.If):
+                st.body = rewrite(st.body, in_loop)
+                st.orelse = rewrite(st.orelse, in_loop)
+                out.append(st)
+                continue
+            out.append(st)
+        return out
+
+    new = rewrite(list(body), False)
+    if used[0]:
+        new = [assign(FLAG, ast.Constant(value=False)),
+               assign(VAL, ast.Constant(value=0.0))] + new
+    return new, used[0]
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
@@ -697,8 +838,33 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range" and not it.keywords):
-            self.generic_visit(node)
-            return node
+            # non-range iterable (for x in seq): rewrite to an indexed
+            # range loop over _jst.seq_len(seq) — tensors iterate dim-0
+            # slices (traced indices ok); python sequences only reach
+            # the eager path (concrete indices). enumerate/zip/dict
+            # targets are tuple-unpacking and bail above.
+            uid = self._uid()
+            seq = f"__dy2s_seq_{uid}"
+            idx = f"__dy2s_it_{uid}"
+            seq_assign = ast.Assign(
+                targets=[_name(seq, ast.Store())],
+                value=ast.Call(func=_jst_attr("as_seq"), args=[it],
+                               keywords=[]))
+            get = ast.Assign(
+                targets=[node.target],
+                value=ast.Call(func=_jst_attr("seq_get"),
+                               args=[_name(seq), _name(idx)],
+                               keywords=[]))
+            rng = ast.Call(
+                func=_name("range"),
+                args=[ast.Call(func=_jst_attr("seq_len"),
+                               args=[_name(seq)], keywords=[])],
+                keywords=[])
+            new_for = ast.For(target=_name(idx, ast.Store()), iter=rng,
+                              body=[get] + node.body, orelse=[])
+            out = self.visit(new_for)
+            return _mark_generated(
+                [seq_assign] + (out if isinstance(out, list) else [out]))
         if _contains_ctrl(node.body, (ast.Return,)):
             self.generic_visit(node)
             return node
@@ -781,8 +947,14 @@ def transform_function(fn):
         if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
             raise ValueError("not a function definition")
         fdef.decorator_list = []
+        # pass 0: return-inside-loop -> shared flag + break + guarded
+        # return (then pass 1 else-hoists the guard)
+        did_loop_ret = False
+        if any(isinstance(s, (ast.For, ast.While))
+               and _contains_return_deep([s]) for s in ast.walk(fdef)):
+            fdef.body, did_loop_ret = _hoist_loop_returns(fdef.body)
         # pass 1: single-exit return rewriting (return-inside-branch)
-        did_return_rewrite = False
+        did_return_rewrite = did_loop_ret
         body0 = fdef.body
         top_last_ret = body0 and isinstance(body0[-1], ast.Return)
         early = body0[:-1] if top_last_ret else body0

@@ -15,3 +15,7 @@ from .attention import (  # noqa: F401
 # re-export a few tensor ops that paddle exposes under nn.functional
 from ...ops.manipulation import one_hot, pad  # noqa: F401
 from ...ops.math import sigmoid  # noqa: F401
+from .vision_ext import (  # noqa: F401
+    affine_grid, grid_sample, channel_shuffle, pixel_unshuffle,
+    temporal_shift, log_loss, rrelu, gather_tree, margin_cross_entropy,
+    spectral_norm, bilinear)

@@ -1,0 +1,275 @@
+"""Vision/sequence functional ops (round-5 kernel-family coverage).
+
+Parity: `paddle/phi/kernels/{affine_grid,grid_sample,channel_shuffle,
+pixel_unshuffle,temporal_shift,log_loss,rrelu,gather_tree,
+margin_cross_entropy,spectral_norm}_kernel.h` and the matching
+`python/paddle/nn/functional` entry points — implemented as pure-jax
+gather/arithmetic programs that XLA fuses (no CUDA kernels to port).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops._helpers import as_tensor
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N,2,3] -> sampling grid [N,H,W,2]
+    (`affine_grid_kernel.h`)."""
+    theta = as_tensor(theta)
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy().tolist()]
+    N, _, H, W = [int(s) for s in out_shape]
+
+    def f(th):
+        def axis(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+        ys, xs = axis(H), axis(W)
+        gx, gy = jnp.meshgrid(xs, ys)               # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)   # [H, W, 3]
+        # broadcast multiply-add, not einsum: coordinate math must stay
+        # full f32 (matmul default precision may downcast)
+        return jnp.sum(base[None, :, :, None, :].astype(th.dtype)
+                       * th[:, None, None, :, :], axis=-1)
+    return dispatch.apply("affine_grid", f, (theta,))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N,C,H,W], grid [N,Ho,Wo,2] in [-1,1] -> [N,C,Ho,Wo]
+    (`grid_sample_kernel.h`). Bilinear/nearest; zeros/border/reflection
+    padding."""
+    x, grid = as_tensor(x), as_tensor(grid)
+
+    def f(xa, ga):
+        N, C, H, W = xa.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) * 0.5 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) * 0.5
+
+        gx = unnorm(ga[..., 0].astype(jnp.float32), W)  # [N,Ho,Wo]
+        gy = unnorm(ga[..., 1].astype(jnp.float32), H)
+
+        def reflect(v, lo, hi):
+            rng = hi - lo
+            v = jnp.abs(v - lo) % (2 * rng + 1e-12)
+            return lo + jnp.where(v > rng, 2 * rng - v, v)
+
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            gx = reflect(gx, 0.0, W - 1.0) if align_corners else \
+                jnp.clip(reflect(gx, -0.5, W - 0.5), 0, W - 1)
+            gy = reflect(gy, 0.0, H - 1.0) if align_corners else \
+                jnp.clip(reflect(gy, -0.5, H - 0.5), 0, H - 1)
+
+        def gather2d(iy, ix):
+            iyc = jnp.clip(iy, 0, H - 1)
+            ixc = jnp.clip(ix, 0, W - 1)
+            # [N,C,Ho,Wo] gather via advanced indexing per batch
+            bidx = jnp.arange(N)[:, None, None]
+            out = xa[bidx, :, iyc, ix * 0 + ixc]      # [N,Ho,Wo,C]
+            out = jnp.moveaxis(out, -1, 1)
+            if padding_mode == "zeros":
+                ok = ((iy >= 0) & (iy <= H - 1) & (ix >= 0)
+                      & (ix <= W - 1))
+                out = out * ok[:, None, :, :].astype(out.dtype)
+            return out
+
+        if mode == "nearest":
+            return gather2d(jnp.round(gy).astype(jnp.int32),
+                            jnp.round(gx).astype(jnp.int32)).astype(
+                                xa.dtype)
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+        out = (gather2d(y0, x0) * (1 - wy) * (1 - wx)
+               + gather2d(y0, x1) * (1 - wy) * wx
+               + gather2d(y1, x0) * wy * (1 - wx)
+               + gather2d(y1, x1) * wy * wx)
+        return out.astype(xa.dtype)
+    return dispatch.apply("grid_sample", f, (x, grid))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """`channel_shuffle_kernel.h` — interleave channel groups."""
+    x = as_tensor(x)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w) \
+                    .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+                .swapaxes(3, 4).reshape(n, h, w, c)
+    return dispatch.apply("channel_shuffle", f, (x,))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """`pixel_unshuffle_kernel.h` — inverse of pixel_shuffle."""
+    x = as_tensor(x)
+    r = int(downscale_factor)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+                n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        return a.transpose(0, 1, 3, 2, 4, 5).reshape(
+            n, h // r, w // r, c * r * r)
+    return dispatch.apply("pixel_unshuffle", f, (x,))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """`temporal_shift_kernel.h` — TSM channel time-shift."""
+    x = as_tensor(x)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.pad(a[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                       (0, 0)))
+        fwd = jnp.pad(a[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                         (0, 0)))
+        out = jnp.concatenate([back, fwd, a[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return dispatch.apply("temporal_shift", f, (x,))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """`log_loss_kernel.h` — elementwise negative log likelihood."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def f(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+    return dispatch.apply("log_loss", f, (input, label))
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=False, name=None):
+    """`rrelu_kernel.h` — randomized leaky relu (train: slope ~
+    U[lower, upper]; eval: fixed mean slope)."""
+    x = as_tensor(x)
+    if training:
+        from ...core import random as rng
+        key = rng.next_key()
+
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32,
+                                       lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, a * slope)
+        return dispatch.apply("rrelu", f, (x,))
+    mid = (lower + upper) / 2.0
+
+    def f(a):
+        return jnp.where(a >= 0, a, a * jnp.asarray(mid, a.dtype))
+    return dispatch.apply("rrelu", f, (x,))
+
+
+def gather_tree(ids, parents, name=None):
+    """`gather_tree_kernel.h` — beam-search backtrace.
+    ids/parents [T, B, beam] -> full sequences [T, B, beam]."""
+    ids, parents = as_tensor(ids), as_tensor(parents)
+
+    def f(idsa, par):
+        T, B, K = idsa.shape
+        bidx = jnp.arange(B)[:, None]
+
+        def step(beam, t):
+            tok = idsa[t, bidx, beam]
+            beam = par[t, bidx, beam]
+            return beam, tok
+        beam0 = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, toks = jax.lax.scan(step, beam0, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+    return dispatch.apply("gather_tree", f, (ids, parents))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """`margin_cross_entropy` (ArcFace/CosFace margins, the reference's
+    class-parallel `margin_cross_entropy_op.cu`) — single-shard form;
+    model-parallel sharding rides GSPMD like the rest of the stack."""
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    def f(lg, lab):
+        lf = lg.astype(jnp.float32)
+        theta = jnp.arccos(jnp.clip(lf, -1.0, 1.0))
+        m_cos = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(lab, lf.shape[-1], dtype=jnp.float32)
+        adj = jnp.where(oh > 0, m_cos, lf) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(oh * logp, axis=-1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jax.nn.softmax(adj, axis=-1)
+        return loss
+    return dispatch.apply("margin_cross_entropy", f, (logits, label))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """`spectral_norm_kernel.h` — normalize weight by its largest
+    singular value (power iteration)."""
+    weight = as_tensor(weight)
+
+    def f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1) \
+            .astype(jnp.float32)
+        u = jnp.ones((wm.shape[0],), jnp.float32)
+        v = jnp.ones((wm.shape[1],), jnp.float32)
+
+        def it(_, uv):
+            u, v = uv
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+            return (u, v)
+        u, v = jax.lax.fori_loop(0, max(1, power_iters), it, (u, v))
+        sigma = u @ wm @ v
+        return (w.astype(jnp.float32) / sigma).astype(w.dtype)
+    return dispatch.apply("spectral_norm", f, (weight,))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """`bilinear_tensor_product_kernel.h` — out[b,k] = x1[b,:] W[k] x2[b,:]."""
+    x1, x2, weight = as_tensor(x1), as_tensor(x2), as_tensor(weight)
+    inputs = [x1, x2, weight]
+    if bias is not None:
+        inputs.append(as_tensor(bias))
+
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,kij,bj->bk", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    return dispatch.apply("bilinear", f, tuple(inputs))

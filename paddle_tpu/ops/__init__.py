@@ -47,3 +47,4 @@ def _bind_tensor_methods():
 
 
 _bind_tensor_methods()
+from .extras3 import *  # noqa: F401,F403

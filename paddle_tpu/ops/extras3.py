@@ -1,0 +1,181 @@
+"""Third batch of tensor-namespace ops (round-5 kernel-family coverage).
+
+Parity: `paddle/phi/kernels/{diag_embed,frame,overlap_add,edit_distance,
+accuracy,fill_diagonal,uniform_random_inplace}_kernel.h` — pure-jax
+programs; signal ops (frame/overlap_add) are strided gathers/scatter-adds
+XLA vectorizes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import dispatch
+from ._helpers import as_tensor
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """`diag_embed_kernel.h` — last-dim vectors -> diagonal planes."""
+    x = as_tensor(input)
+
+    def f(a):
+        n = a.shape[-1]
+        size = n + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(0, -offset)
+        c = idx + max(0, offset)
+        out = out.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = []
+        src = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(src))
+        return out.transpose(order)
+    return dispatch.apply("diag_embed", f, (x,))
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """`frame_kernel.h` — sliding windows over the signal axis.
+    axis=-1: [..., T] -> [..., frame_length, n_frames]."""
+    x = as_tensor(x)
+
+    def f(a):
+        T = a.shape[axis]
+        n = 1 + (T - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        offs = jnp.arange(frame_length)
+        gather = starts[None, :] + offs[:, None]       # [fl, n]
+        if axis in (-1, a.ndim - 1):
+            return a[..., gather]
+        # axis 0: [T, ...] -> [fl, n, ...] per reference layout
+        return jnp.moveaxis(a[gather.T], (0, 1), (1, 0))
+    return dispatch.apply("frame", f, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """`overlap_add_kernel.h` — inverse of frame (scatter-add)."""
+    x = as_tensor(x)
+
+    def f(a):
+        if axis in (-1, a.ndim - 1):
+            fl, n = a.shape[-2], a.shape[-1]
+            T = (n - 1) * hop_length + fl
+            out = jnp.zeros(a.shape[:-2] + (T,), a.dtype)
+            pos = (jnp.arange(n) * hop_length)[None, :] \
+                + jnp.arange(fl)[:, None]
+            return out.at[..., pos].add(a)
+        fl, n = a.shape[0], a.shape[1]
+        T = (n - 1) * hop_length + fl
+        out = jnp.zeros((T,) + a.shape[2:], a.dtype)
+        pos = (jnp.arange(n) * hop_length)[None, :] \
+            + jnp.arange(fl)[:, None]
+        return out.at[pos].add(a)
+    return dispatch.apply("overlap_add", f, (x,))
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """`edit_distance_kernel.h` — batched Levenshtein distance via a
+    wavefront lax.scan DP (static shapes; lengths mask the tails).
+    Returns (distance [B,1] f32, sequence_num [1])."""
+    inp, lab = as_tensor(input), as_tensor(label)
+    args = [inp, lab]
+    if input_length is not None:
+        args.append(as_tensor(input_length))
+    if label_length is not None:
+        args.append(as_tensor(label_length))
+
+    def f(a, b, *lens):
+        B, N = a.shape
+        M = b.shape[1]
+        alen = lens[0].reshape(-1) if lens else jnp.full((B,), N)
+        blen = (lens[1].reshape(-1) if len(lens) > 1
+                else jnp.full((B,), M))
+
+        def one_full(av, bv, an, bn):
+            # full DP table (N+1 rows) so we can read D[an, bn]
+            row0 = jnp.arange(M + 1, dtype=jnp.float32)
+
+            def step(prev, i):
+                ai = av[i]
+
+                def inner(carry, j):
+                    left = carry
+                    sub = prev[j] + jnp.where(ai == bv[j], 0.0, 1.0)
+                    cur = jnp.minimum(jnp.minimum(prev[j + 1] + 1.0,
+                                                  left + 1.0), sub)
+                    return cur, cur
+                _, rest = jax.lax.scan(inner, i + 1.0, jnp.arange(M))
+                row = jnp.concatenate([jnp.array([i + 1.0]), rest])
+                return row, row
+            _, rows = jax.lax.scan(step, row0, jnp.arange(N))
+            table = jnp.concatenate([row0[None], rows])  # [N+1, M+1]
+            return table[an, bn]
+
+        d = jax.vmap(one_full)(a, b, alen, blen)
+        if normalized:
+            d = d / jnp.maximum(blen.astype(jnp.float32), 1.0)
+        return d.reshape(B, 1), jnp.array([B], jnp.int32)
+    return dispatch.apply("edit_distance", f, tuple(args),
+                          differentiable=False)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """`accuracy_kernel.h` — top-k accuracy over a batch."""
+    inp, lab = as_tensor(input), as_tensor(label)
+
+    def f(p, y):
+        topk = jnp.argsort(-p, axis=-1)[:, :k]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return dispatch.apply("accuracy", f, (inp, lab),
+                          differentiable=False)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """`uniform_random_inplace_kernel.h` — Tensor.uniform_()."""
+    from ..core import random as rng
+    key = jax.random.key(seed) if seed else rng.next_key()
+    x._data = jax.random.uniform(key, x._data.shape,
+                                 jnp.float32 if x._data.dtype
+                                 not in (jnp.float64,) else x._data.dtype,
+                                 min, max).astype(x._data.dtype)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """`fill_diagonal_kernel.h` — in-place diagonal fill."""
+    a = x._data
+    n = min(a.shape[-2], a.shape[-1])
+    idx = jnp.arange(n - abs(offset))
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    x._data = a.at[..., r, c].set(value)
+    return x
+
+
+def identity_loss(x, reduction="none", name=None):
+    """`identity_loss_kernel.h` (IPU-origin marker op): reduce or pass
+    through the input as the loss value."""
+    x = as_tensor(x)
+    red = {0, "sum"}, {1, "mean"}
+    if reduction in red[1]:
+        return dispatch.apply("identity_loss", jnp.mean, (x,))
+    if reduction in red[0]:
+        return dispatch.apply("identity_loss", jnp.sum, (x,))
+    return dispatch.apply("identity_loss", lambda a: a, (x,))
+
+
+Tensor.uniform_ = uniform_
+Tensor.fill_diagonal_ = fill_diagonal_

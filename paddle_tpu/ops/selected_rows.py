@@ -1,0 +1,135 @@
+"""SelectedRows: rows-sparse tensors (embedding-style sparse gradients).
+
+Parity: `paddle/phi/core/selected_rows.h` + the
+`paddle/phi/kernels/selected_rows/` kernel family. A SelectedRows holds
+(rows, values, height): logically a [height, *value_dims] tensor that is
+zero outside `rows`. The reference uses it for embedding gradients and
+rows-sparse optimizer updates; here the same capability rides jax
+segment/scatter ops (TPU-friendly: fixed shapes, no host compaction).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import as_tensor
+
+
+class SelectedRows:
+    """Rows-sparse value container (`selected_rows.h:28`)."""
+
+    def __init__(self, rows, values, height):
+        self.rows = as_tensor(rows)            # [n] int
+        self.values = as_tensor(values)        # [n, *dims]
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    def to_dense(self):
+        """Densify (merging duplicate rows by summation, the reference's
+        MergeAdd semantics)."""
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values._data.dtype)
+        return Tensor(out.at[self.rows._data].add(self.values._data))
+
+    def merge_rows(self):
+        """Merge duplicate rows (scatter-add into unique rows) —
+        `merge_selected_rows` / MergeAdd kernel."""
+        rows = self.rows._data
+        uniq, inv = jnp.unique(rows, return_inverse=True,
+                               size=rows.shape[0], fill_value=-1)
+        summed = jax.ops.segment_sum(self.values._data, inv,
+                                     num_segments=rows.shape[0])
+        return SelectedRows(Tensor(uniq), Tensor(summed), self.height)
+
+    def map_fn(self, fn, name):
+        return SelectedRows(self.rows, Tensor(fn(self.values._data)),
+                            self.height)
+
+
+def add_n(inputs):
+    """`selected_rows/add_n_kernel.h` — sum SelectedRows (concat rows;
+    duplicates merge on densify/merge_rows)."""
+    rows = jnp.concatenate([s.rows._data for s in inputs])
+    vals = jnp.concatenate([s.values._data for s in inputs])
+    return SelectedRows(Tensor(rows), Tensor(vals), inputs[0].height)
+
+
+def scale(x: SelectedRows, scale_v, bias=0.0, bias_after_scale=True):
+    """`selected_rows/scale_kernel.h`."""
+    def f(v):
+        if bias_after_scale:
+            return v * scale_v + bias
+        return (v + bias) * scale_v
+    return x.map_fn(f, "scale")
+
+
+def clip(x: SelectedRows, min, max):
+    return x.map_fn(lambda v: jnp.clip(v, min, max), "clip")
+
+
+def clip_by_norm(x: SelectedRows, max_norm):
+    """`selected_rows/clip_by_norm_kernel.h` — norm over the (merged)
+    values."""
+    m = x.merge_rows()
+
+    def f(v):
+        n = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+        s = jnp.where(n > max_norm, max_norm / (n + 1e-12), 1.0)
+        return (v.astype(jnp.float32) * s).astype(v.dtype)
+    return m.map_fn(f, "clip_by_norm")
+
+
+def multiply(x: SelectedRows, y):
+    """`selected_rows/elementwise_multiply_kernel.h` — rows-sparse *
+    dense (gathers the dense rows)."""
+    y = as_tensor(y)
+    gathered = y._data[x.rows._data]
+    return SelectedRows(x.rows, Tensor(x.values._data * gathered),
+                        x.height)
+
+
+def isfinite(x: SelectedRows):
+    return x.map_fn(lambda v: jnp.isfinite(v), "isfinite")
+
+
+def activation(x: SelectedRows, act="square"):
+    """`selected_rows/activation_kernel.h` (square etc. on values)."""
+    fns = {"square": jnp.square, "sqrt": jnp.sqrt, "abs": jnp.abs}
+    return x.map_fn(fns[act], "activation")
+
+
+def adam_sparse(param, grad: SelectedRows, moment1, moment2, lr,
+                beta1=0.9, beta2=0.999, epsilon=1e-8, t=1):
+    """`selected_rows/adam_kernel.h` — rows-sparse Adam: only touched
+    rows update their moments and values (lazy_mode semantics).
+    param/moment1/moment2: dense Tensors [height, D]. Returns updated
+    (param, m1, m2)."""
+    p = as_tensor(param)._data
+    m1 = as_tensor(moment1)._data
+    m2 = as_tensor(moment2)._data
+    g = grad.merge_rows()
+    rows = g.rows._data
+    gv = g.values._data.astype(jnp.float32)
+    ok = (rows >= 0)
+    rws = jnp.clip(rows, 0, p.shape[0] - 1)
+    m1r = m1[rws]
+    m2r = m2[rws]
+    nm1 = beta1 * m1r + (1 - beta1) * gv
+    nm2 = beta2 * m2r + (1 - beta2) * gv * gv
+    mhat = nm1 / (1 - beta1 ** t)
+    vhat = nm2 / (1 - beta2 ** t)
+    upd = lr * mhat / (jnp.sqrt(vhat) + epsilon)
+    okf = ok.reshape(-1, *([1] * (gv.ndim - 1))).astype(jnp.float32)
+    new_p = p.at[rws].add((-upd * okf).astype(p.dtype))
+    new_m1 = m1.at[rws].set(jnp.where(okf > 0, nm1, m1r))
+    new_m2 = m2.at[rws].set(jnp.where(okf > 0, nm2, m2r))
+    return Tensor(new_p), Tensor(new_m1), Tensor(new_m2)
+
+
+def merge_selected_rows(x: SelectedRows):
+    return x.merge_rows()

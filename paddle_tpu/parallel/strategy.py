@@ -56,6 +56,7 @@ class DistributedStrategy:
         self.lars = False
         self.dgc = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32

@@ -169,3 +169,22 @@ class Profiler:
         out = "\n".join(lines)
         print(out)
         return out
+
+
+from .statistic import (SortedKeys, host_statistic_table,  # noqa: E402
+                        device_statistic_table, statistic_report)
+from .timer import benchmark, Benchmark  # noqa: E402,F401
+
+
+def _full_summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                  time_unit="ms"):
+    """profiler_statistic.py-parity tables: host spans + device ops."""
+    out = statistic_report(
+        _recorder.events,
+        trace_dir=self._trace_dir,
+        sorted_by=sorted_by or SortedKeys.CPUTotal)
+    print(out)
+    return out
+
+
+Profiler.summary = _full_summary

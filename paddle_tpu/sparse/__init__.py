@@ -338,3 +338,22 @@ class nn:  # namespace shim: paddle.sparse.nn.*
     MaxPool3D = MaxPool3D
     BatchNorm = BatchNorm
     functional = _SparseFunctional
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """`sparse/addmm_kernel.h` — beta*input + alpha*(x @ y), x sparse."""
+    from ..ops._helpers import as_tensor as _as_dense
+    inp = _as_dense(input)
+    prod = matmul(x, y)
+    from ..core.tensor import Tensor as _T
+    return _T(beta * inp._data + alpha * _as_dense(prod)._data)
+
+
+def mask_as(x, mask, name=None):
+    """`sparse/mask_kernel.h` — take dense x's values at the sparse
+    pattern of `mask`, producing a SparseTensor."""
+    from ..ops._helpers import as_tensor as _as_dense
+    xd = _as_dense(x)._data
+    idx = mask.indices()._data if hasattr(mask, "indices") else None
+    vals = xd[tuple(idx[i] for i in range(idx.shape[0]))]
+    return sparse_coo_tensor(idx, vals, shape=list(xd.shape))

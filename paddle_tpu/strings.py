@@ -133,3 +133,11 @@ class FasterTokenizer:
             out[i, : len(row)] = row
         return (Tensor(out),
                 Tensor(np.asarray(lens, np.int32)))
+
+
+def empty(shape, name=None):
+    """`strings/strings_empty_kernel.h` — uninitialised StringTensor."""
+    if np.isscalar(shape):
+        shape = [int(shape)]
+    arr = np.full(tuple(int(s) for s in shape), "", dtype=object)
+    return StringTensor(arr)

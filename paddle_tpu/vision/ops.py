@@ -132,3 +132,591 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             return out
         return jax.vmap(bilinear)(jnp.arange(R))
     return dispatch.apply("roi_align", _fn, (x, boxes, boxes_num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """`roi_pool_kernel.h` — max pooling inside each RoI bin."""
+    x, boxes = as_tensor(x), as_tensor(boxes)
+    boxes_num = as_tensor(boxes_num)
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def _fn(img, bxs, bn):
+        R = bxs.shape[0]
+        H, W = img.shape[2], img.shape[3]
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=R)
+        b = jnp.round(bxs * spatial_scale).astype(jnp.int32)
+
+        def one(r):
+            im = img[batch_idx[r]]               # [C, H, W]
+            x1, y1, x2, y2 = b[r, 0], b[r, 1], b[r, 2], b[r, 3]
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            # bin edges (ceil/floor like the reference kernel)
+            ys = y1 + (jnp.arange(oh + 1) * rh) // oh
+            xs = x1 + (jnp.arange(ow + 1) * rw) // ow
+            yy = jnp.clip(jnp.arange(H), 0, H - 1)
+            # mask-based max per bin (static shapes: mask full image)
+            gy = jnp.arange(H)[None, :]
+            gx = jnp.arange(W)[None, :]
+            ymask = (gy >= ys[:-1, None]) & (gy < jnp.maximum(
+                ys[1:, None], ys[:-1, None] + 1))     # [oh, H]
+            xmask = (gx >= xs[:-1, None]) & (gx < jnp.maximum(
+                xs[1:, None], xs[:-1, None] + 1))     # [ow, W]
+            m = ymask[:, None, :, None] & xmask[None, :, None, :]
+            big = jnp.where(m[None], im[:, None, None, :, :],
+                            -jnp.inf)
+            return jnp.max(big, axis=(-2, -1))        # [C, oh, ow]
+        return jax.vmap(one)(jnp.arange(R))
+    return dispatch.apply("roi_pool", _fn, (x, boxes, boxes_num))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """`psroi_pool_kernel.h` — position-sensitive RoI average pooling:
+    channel group (i,j) pools bin (i,j)."""
+    x, boxes = as_tensor(x), as_tensor(boxes)
+    boxes_num = as_tensor(boxes_num)
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def _fn(img, bxs, bn):
+        R = bxs.shape[0]
+        C, H, W = img.shape[1], img.shape[2], img.shape[3]
+        Co = C // (oh * ow)
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=R)
+        bs = bxs * spatial_scale
+
+        def one(r):
+            im = img[batch_idx[r]].reshape(Co, oh, ow, H, W)
+            x1, y1, x2, y2 = bs[r, 0], bs[r, 1], bs[r, 2], bs[r, 3]
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            ys = y1 + jnp.arange(oh + 1) * (rh / oh)
+            xs = x1 + jnp.arange(ow + 1) * (rw / ow)
+            gy = jnp.arange(H)[None, :]
+            gx = jnp.arange(W)[None, :]
+            ymask = ((gy + 0.5 >= ys[:-1, None])
+                     & (gy + 0.5 < ys[1:, None])).astype(img.dtype)
+            xmask = ((gx + 0.5 >= xs[:-1, None])
+                     & (gx + 0.5 < xs[1:, None])).astype(img.dtype)
+            m = ymask[:, None, :, None] * xmask[None, :, None, :]
+            s = jnp.einsum("cijhw,ijhw->cij", im, m)
+            cnt = jnp.maximum(jnp.sum(m, axis=(-2, -1)), 1.0)
+            return s / cnt
+        return jax.vmap(one)(jnp.arange(R))
+    return dispatch.apply("psroi_pool", _fn, (x, boxes, boxes_num))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """`box_coder_kernel.h` — encode/decode boxes against priors."""
+    pb = as_tensor(prior_box)
+    tb = as_tensor(target_box)
+    pbv = as_tensor(prior_box_var) if prior_box_var is not None else None
+    inputs = (pb, tb) if pbv is None else (pb, tb, pbv)
+
+    def _fn(p, t, *rest):
+        v = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw * 0.5
+            tcy = t[:, 1] + th * 0.5
+            out = jnp.stack([(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                             (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                             jnp.log(tw[:, None] / pw[None, :]),
+                             jnp.log(th[:, None] / ph[None, :])], -1)
+            if v is not None:
+                out = out / v[None, :, :]
+            return out
+        # decode_center_size: t [N, M, 4] deltas against M priors
+        if axis == 1:
+            pw, ph, pcx, pcy = (pw[None, :], ph[None, :],
+                                pcx[None, :], pcy[None, :])
+        else:
+            pw, ph, pcx, pcy = (pw[:, None], ph[:, None],
+                                pcx[:, None], pcy[:, None])
+        d = t if v is None else t * (v[None] if v.ndim == 2 else v)
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+    return dispatch.apply("box_coder", _fn, inputs)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """`prior_box_kernel.h` — SSD prior (anchor) boxes + variances."""
+    input, image = as_tensor(input), as_tensor(image)
+
+    def _fn(feat, img):
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sw = steps[0] or iw / fw
+        sh = steps[1] or ih / fh
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if all(abs(ar - a) > 1e-6 for a in ars):
+                ars.append(float(ar))
+                if flip:
+                    ars.append(1.0 / float(ar))
+        whs = []
+        for ms in min_sizes:
+            if min_max_aspect_ratios_order:
+                whs.append((ms, ms))
+                if max_sizes:
+                    mx = max_sizes[len(whs) // (len(ars) + 1)] \
+                        if False else max_sizes[0]
+                    whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            else:
+                for ar in ars:
+                    whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        whs = jnp.asarray(whs, jnp.float32)           # [K, 2]
+        cx = (jnp.arange(fw) + offset) * sw
+        cy = (jnp.arange(fh) + offset) * sh
+        gx, gy = jnp.meshgrid(cx, cy)                 # [fh, fw]
+        c = jnp.stack([gx, gy], -1)[:, :, None, :]    # [fh,fw,1,2]
+        half = whs[None, None, :, :] * 0.5
+        mins = (c - half) / jnp.asarray([iw, ih], jnp.float32)
+        maxs = (c + half) / jnp.asarray([iw, ih], jnp.float32)
+        boxes = jnp.concatenate([mins, maxs], -1)     # [fh,fw,K,4]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+    return dispatch.apply("prior_box", _fn, (input, image))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """`yolo_box_kernel.h` — decode YOLOv3 head to boxes + scores."""
+    x, img_size = as_tensor(x), as_tensor(img_size)
+    na = len(anchors) // 2
+
+    def _fn(p, imsz):
+        N, C, H, W = p.shape
+        an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        p = p.reshape(N, na, -1, H, W)                # [N,a,5+cls,H,W]
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        sx = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        sy = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        bx = (gx[None, None, None, :] + sx) / W
+        by = (gy[None, None, :, None] + sy) / H
+        bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] \
+            / (W * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] \
+            / (H * downsample_ratio)
+        obj = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:])
+        score = obj[:, :, None] * cls                 # [N,a,cls,H,W]
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+        keep = (obj > conf_thresh).astype(score.dtype)
+        scores = (score * keep[:, :, None]).transpose(0, 1, 3, 4, 2) \
+            .reshape(N, -1, cls.shape[2])
+        return boxes, scores
+    return dispatch.apply("yolo_box", _fn, (x, img_size),
+                          differentiable=False)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """`decode_jpeg_kernel.h` — host-side JPEG decode (the reference
+    runs nvjpeg; TPU has no device decoder, so decode on host like its
+    CPU path)."""
+    import io
+    from PIL import Image
+    data = bytes(np.asarray(as_tensor(x).numpy(), np.uint8).tobytes())
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """`deformable_conv_kernel.h` — DCNv1/v2: per-position learned
+    sampling offsets (+ optional modulation mask), realised as a
+    bilinear gather (im2col on deformed locations) + matmul on the MXU.
+    x [N,Ci,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo]; weight [Co,Ci/g,kh,kw];
+    mask [N, dg*kh*kw, Ho, Wo]."""
+    x, offset, weight = as_tensor(x), as_tensor(offset), as_tensor(weight)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    inputs = [x, offset, weight]
+    if mask is not None:
+        inputs.append(as_tensor(mask))
+    if bias is not None:
+        inputs.append(as_tensor(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def _fn(xa, off, w, *rest):
+        m = rest[0] if has_mask else None
+        b = rest[-1] if has_bias else None
+        N, Ci, H, W = xa.shape
+        Co, Cig, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        dg = off.shape[1] // (2 * kh * kw)
+        off = off.reshape(N, dg, kh * kw, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * s[0] - p[0])[:, None]   # [Ho, 1]
+        base_x = (jnp.arange(Wo) * s[1] - p[1])[None, :]   # [1, Wo]
+        tap_y = jnp.repeat(jnp.arange(kh) * d[0], kw)      # [khkw]
+        tap_x = jnp.tile(jnp.arange(kw) * d[1], kh)        # [khkw]
+        # sampling locations [N, dg, kh*kw, Ho, Wo]
+        sy = (tap_y[:, None, None] + base_y[None])[None, None] \
+            + off[:, :, :, 0]
+        sx = (tap_x[:, None, None] + base_x[None])[None, None] \
+            + off[:, :, :, 1]
+
+        def bilin(img, yy, xx):
+            # img [Cd,H,W]; yy/xx [khkw,Ho,Wo] -> [Cd,khkw,Ho,Wo]
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            wy = yy - y0
+            wx = xx - x0
+
+            def g(yi, xi):
+                ok = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                return v * ok[None].astype(img.dtype)
+            return (g(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                    + g(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                    + g(y0 + 1, x0) * (wy * (1 - wx))[None]
+                    + g(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+        Cd = Ci // dg
+
+        def per_img(img, syi, sxi, mi):
+            cols = jax.vmap(
+                lambda gidx: bilin(
+                    jax.lax.dynamic_slice_in_dim(img, gidx * Cd, Cd, 0),
+                    syi[gidx], sxi[gidx]))(jnp.arange(dg))
+            # cols [dg, Cd, khkw, Ho, Wo]; DCNv2 modulation per
+            # (deform-group, tap) broadcast over the group's channels
+            if mi is not None:
+                cols = cols * mi[:, None]
+            return cols.reshape(Ci, kh * kw, Ho, Wo)
+        mm = (m.reshape(N, dg, kh * kw, Ho, Wo) if m is not None
+              else None)
+        if mm is None:
+            cols = jax.vmap(lambda img, syi, sxi: per_img(
+                img, syi, sxi, None))(xa, sy, sx)
+        else:
+            cols = jax.vmap(per_img)(xa, sy, sx, mm)
+        # grouped conv as matmul: [N,Ci,khkw,Ho,Wo] x [Co,Cig,khkw]
+        wf = w.reshape(groups, Co // groups, Cig * kh * kw)
+        cols = cols.reshape(N, groups, Cig, kh * kw, Ho, Wo) \
+            .reshape(N, groups, Cig * kh * kw, Ho * Wo)
+        out = jnp.einsum("ngkp,gok->ngop", cols, wf)
+        out = out.reshape(N, Co, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+    return dispatch.apply("deform_conv2d", _fn, tuple(inputs))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None,
+                             name=None):
+    """`distribute_fpn_proposals_kernel.h` — route RoIs to FPN levels
+    by scale (host-side like the reference CPU kernel: ragged outputs)."""
+    rois = as_tensor(fpn_rois).numpy()
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + off)
+        * (rois[:, 3] - rois[:, 1] + off), 0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for lv in range(min_level, max_level + 1):
+        sel = np.where(lvl == lv)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros((0,), np.int64)
+    restore = np.argsort(order).astype(np.int32)
+    nums = [Tensor(jnp.asarray(np.asarray([len(i)], np.int32)))
+            for i in idxs]
+    return outs, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False, name=None):
+    """`matrix_nms_kernel.h` — parallel (matrix) soft-NMS: decay each
+    box by the max IoU with any higher-scored same-class box. Fully
+    vectorized (the TPU-friendly NMS variant the reference runs on GPU).
+    bboxes [N,M,4], scores [N,C,M]."""
+    bboxes, scores = as_tensor(bboxes), as_tensor(scores)
+
+    def _fn(bx, sc):
+        N, C, M = sc.shape
+
+        def one(b, s):
+            # flatten class/box pairs, drop background
+            cls_ids = jnp.arange(C)
+            valid_cls = (cls_ids != background_label)[:, None]
+            s = jnp.where(valid_cls & (s > score_threshold), s, 0.0)
+            flat_s = s.reshape(-1)                     # [C*M]
+            k = min(nms_top_k if nms_top_k > 0 else C * M, C * M)
+            top_s, top_i = jax.lax.top_k(flat_s, k)
+            top_c = top_i // M
+            top_b = b[top_i % M]                       # [k,4]
+            area = jnp.maximum(top_b[:, 2] - top_b[:, 0], 0) \
+                * jnp.maximum(top_b[:, 3] - top_b[:, 1], 0)
+            lt = jnp.maximum(top_b[:, None, :2], top_b[None, :, :2])
+            rb = jnp.minimum(top_b[:, None, 2:], top_b[None, :, 2:])
+            wh = jnp.clip(rb - lt, 0)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / jnp.maximum(area[:, None] + area[None, :]
+                                      - inter, 1e-9)
+            same = (top_c[:, None] == top_c[None, :])
+            higher = jnp.arange(k)[None, :] < jnp.arange(k)[:, None]
+            ious = jnp.where(same & higher, iou, 0.0)  # [k, k]
+            max_iou = jnp.max(ious, axis=1)
+            comp = jnp.max(jnp.where(same & higher,
+                                     jnp.max(ious, axis=1)[None, :]
+                                     * 0 + ious, 0.0), axis=1)
+            if use_gaussian:
+                decay = jnp.exp(-(max_iou ** 2 - 0.0)
+                                / gaussian_sigma)
+            else:
+                decay = (1.0 - max_iou) / 1.0
+            dec_s = top_s * decay
+            keep = dec_s > post_threshold
+            dec_s = jnp.where(keep, dec_s, 0.0)
+            kk = min(keep_top_k if keep_top_k > 0 else k, k)
+            fin_s, fin_i = jax.lax.top_k(dec_s, kk)
+            out = jnp.concatenate(
+                [top_c[fin_i].astype(b.dtype)[:, None],
+                 fin_s[:, None], top_b[fin_i]], axis=1)  # [kk, 6]
+            return out, top_i[fin_i], jnp.sum(fin_s > 0)
+        outs, idxs, nums = jax.vmap(one)(bx, sc)
+        return outs, idxs, nums
+    out, idx, nums = _fn(bboxes._data, scores._data)
+    if return_index:
+        return Tensor(out), Tensor(idx), Tensor(nums)
+    return Tensor(out), Tensor(nums)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors,
+                       variances, pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """`generate_proposals_v2_kernel.h` — RPN proposal generation:
+    decode anchors, clip, filter small, NMS (host NMS like the
+    reference CPU path)."""
+    sc = as_tensor(scores).numpy()          # [N, A, H, W]
+    bd = as_tensor(bbox_deltas).numpy()     # [N, 4A, H, W]
+    ims = as_tensor(img_size).numpy()       # [N, 2]
+    an = as_tensor(anchors).numpy().reshape(-1, 4)
+    va = as_tensor(variances).numpy().reshape(-1, 4)
+    N = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    rois, roi_probs, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order % len(an)], \
+            va[order % len(va)]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        box = np.stack([cx - w * 0.5, cy - h * 0.5,
+                        cx + w * 0.5 - off, cy + h * 0.5 - off], -1)
+        ih, iw = ims[n, 0], ims[n, 1]
+        box[:, 0::2] = np.clip(box[:, 0::2], 0, iw - off)
+        box[:, 1::2] = np.clip(box[:, 1::2], 0, ih - off)
+        ok = ((box[:, 2] - box[:, 0] + off >= min_size)
+              & (box[:, 3] - box[:, 1] + off >= min_size))
+        box, s = box[ok], s[ok]
+        keep = _nms_single(box, s, nms_thresh)[:post_nms_top_n]
+        rois.append(box[keep])
+        roi_probs.append(s[keep])
+        nums.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(rois, 0)))
+    probs = Tensor(jnp.asarray(np.concatenate(roi_probs, 0)[:, None]))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums,
+                                                          np.int32)))
+    return rois, probs
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """`yolov3_loss` capability — YOLOv3 training loss (grid-cell
+    responsibility assignment + box/obj/cls terms). Faithful structure,
+    vectorized assignment; the reference's exact ignore-mask via best
+    IoU over predictions is included."""
+    x, gt_box, gt_label = as_tensor(x), as_tensor(gt_box), \
+        as_tensor(gt_label)
+    inputs = [x, gt_box, gt_label]
+    if gt_score is not None:
+        inputs.append(as_tensor(gt_score))
+    na = len(anchor_mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+
+    def _fn(p, gb, gl, *rest):
+        N, C, H, W = p.shape
+        p = p.reshape(N, na, 5 + class_num, H, W)
+        an = jnp.asarray(an_all[np.asarray(anchor_mask)], jnp.float32)
+        stride = downsample_ratio
+        # decode predictions (grid units)
+        sx = jax.nn.sigmoid(p[:, :, 0])
+        sy = jax.nn.sigmoid(p[:, :, 1])
+        pw = jnp.exp(jnp.clip(p[:, :, 2], -10, 10)) \
+            * an[None, :, 0, None, None] / (W * stride)
+        ph = jnp.exp(jnp.clip(p[:, :, 3], -10, 10)) \
+            * an[None, :, 1, None, None] / (H * stride)
+        px = (jnp.arange(W)[None, None, None, :] + sx) / W
+        py = (jnp.arange(H)[None, None, :, None] + sy) / H
+        # gt: [N, B, 4] normalized cx cy w h; label [N, B]
+        B = gb.shape[1]
+        gw = gb[:, :, 2]
+        gh = gb[:, :, 3]
+        gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+        # best anchor (over the FULL anchor set, like the reference)
+        aw = jnp.asarray(an_all[:, 0]) / (W * stride)
+        ah = jnp.asarray(an_all[:, 1]) / (H * stride)
+        inter = jnp.minimum(gw[..., None], aw) \
+            * jnp.minimum(gh[..., None], ah)
+        iou_a = inter / (gw[..., None] * gh[..., None]
+                         + aw * ah - inter + 1e-9)
+        best = jnp.argmax(iou_a, axis=-1)              # [N, B]
+        mask_ids = jnp.asarray(np.asarray(anchor_mask))
+        resp = (best[..., None] == mask_ids)           # [N, B, na]
+        valid = (gw > 1e-6)                            # real gt
+        obj_t = jnp.zeros((N, na, H, W))
+        tx = jnp.zeros((N, na, H, W))
+        ty = jnp.zeros_like(tx)
+        tw = jnp.zeros_like(tx)
+        th = jnp.zeros_like(tx)
+        tcls = jnp.zeros((N, na, class_num, H, W))
+        bscale = jnp.zeros_like(tx)
+        bidx = jnp.arange(N)[:, None, None]
+        a_idx = jnp.broadcast_to(jnp.arange(na)[None, None, :],
+                                 (N, B, na))
+        gi_b = jnp.broadcast_to(gi[..., None], (N, B, na))
+        gj_b = jnp.broadcast_to(gj[..., None], (N, B, na))
+        sel = (resp & valid[..., None]).astype(jnp.float32)
+        score = rest[0] if rest else jnp.ones((N, B))
+        obj_t = obj_t.at[bidx, a_idx, gj_b, gi_b].max(
+            sel * score[..., None])
+        txv = gb[:, :, 0] * W - gi
+        tyv = gb[:, :, 1] * H - gj
+        twv = jnp.log(jnp.clip(
+            gw[..., None] / (aw[mask_ids] + 1e-9), 1e-9, 1e9))
+        thv = jnp.log(jnp.clip(
+            gh[..., None] / (ah[mask_ids] + 1e-9), 1e-9, 1e9))
+        scl = (2.0 - gw * gh)
+        tx = tx.at[bidx, a_idx, gj_b, gi_b].max(sel * txv[..., None])
+        ty = ty.at[bidx, a_idx, gj_b, gi_b].max(sel * tyv[..., None])
+        tw = tw.at[bidx, a_idx, gj_b, gi_b].max(sel * twv)
+        th = th.at[bidx, a_idx, gj_b, gi_b].max(sel * thv)
+        bscale = bscale.at[bidx, a_idx, gj_b, gi_b].max(
+            sel * scl[..., None])
+        cls_oh = jax.nn.one_hot(gl, class_num)          # [N,B,cls]
+        if use_label_smooth:
+            delta = 1.0 / max(class_num, 1)
+            cls_oh = cls_oh * (1.0 - delta) + delta / class_num
+        tcls = tcls.at[bidx, a_idx[..., None].repeat(1, -1),
+                       jnp.arange(class_num)[None, None, None, :],
+                       gj_b[..., None], gi_b[..., None]].max(
+            sel[..., None] * cls_oh[:, :, None, :])
+        # ignore mask: predictions overlapping any gt above thresh
+        px1 = px - pw / 2
+        py1 = py - ph / 2
+        px2 = px + pw / 2
+        py2 = py + ph / 2
+        gx1 = gb[:, :, 0] - gw / 2
+        gy1 = gb[:, :, 1] - gh / 2
+        gx2 = gb[:, :, 0] + gw / 2
+        gy2 = gb[:, :, 1] + gh / 2
+        ix1 = jnp.maximum(px1[:, :, :, :, None],
+                          gx1[:, None, None, None, :])
+        iy1 = jnp.maximum(py1[:, :, :, :, None],
+                          gy1[:, None, None, None, :])
+        ix2 = jnp.minimum(px2[:, :, :, :, None],
+                          gx2[:, None, None, None, :])
+        iy2 = jnp.minimum(py2[:, :, :, :, None],
+                          gy2[:, None, None, None, :])
+        iw_ = jnp.clip(ix2 - ix1, 0)
+        ih_ = jnp.clip(iy2 - iy1, 0)
+        inter = iw_ * ih_
+        pa = pw * ph
+        ga = (gw * gh)[:, None, None, None, :]
+        iou = inter / jnp.maximum(pa[..., None] + ga - inter, 1e-9)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = jnp.max(iou, axis=-1)
+        noobj = (best_iou < ignore_thresh).astype(jnp.float32) \
+            * (1.0 - obj_t)
+
+        def bce(logit, t):
+            return jnp.maximum(logit, 0) - logit * t \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        lx = bce(p[:, :, 0], tx) * bscale * obj_t
+        ly = bce(p[:, :, 1], ty) * bscale * obj_t
+        lw = jnp.abs(p[:, :, 2] - tw) * bscale * obj_t
+        lh = jnp.abs(p[:, :, 3] - th) * bscale * obj_t
+        lobj = bce(p[:, :, 4], obj_t) * (obj_t + noobj)
+        lcls = jnp.sum(bce(p[:, :, 5:], tcls), axis=2) * obj_t
+        per_img = jnp.sum(lx + ly + lw + lh + lobj + lcls,
+                          axis=(1, 2, 3))
+        return per_img
+    return dispatch.apply("yolo_loss", _fn, tuple(inputs))

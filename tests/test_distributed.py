@@ -157,3 +157,99 @@ def test_shard_batch():
     mesh = dist_env.global_mesh({"dp": 8})
     arrs = shard_batch([np.ones((16, 4), np.float32)], mesh=mesh)
     assert arrs[0].shape == (16, 4)
+
+
+def test_gradient_merge_strategy_knob(reset_topology):
+    """gradient_merge k_steps: inner optimizer runs every k-th step on
+    1/k-scaled accumulated grads (VERDICT r4 #6)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.parallel as dist
+    fleet = dist.fleet
+    strat = dist.fleet.DistributedStrategy() if hasattr(
+        dist.fleet, "DistributedStrategy") else None
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    strat = DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strat)
+
+    lin = paddle.nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(learning_rate=1.0,
+                                 parameters=lin.parameters())
+    opt = fleet.distributed_optimizer(inner, strategy=strat)
+    w0 = lin.weight.numpy().copy()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    loss = lin(x).sum()
+    loss.backward()
+    opt.step()            # accumulation phase: no update
+    np.testing.assert_allclose(lin.weight.numpy(), w0)
+    opt.clear_grad()      # must NOT clear inside the window
+    assert lin.weight.grad is not None
+
+    loss = lin(x).sum()
+    loss.backward()       # grads now hold 2x one-step grad
+    opt.step()            # k-th call: update with avg (1/2) scaling
+    g = np.ones((4, 4), np.float32) * 2  # d(sum(x@W))/dW for ones x, B=2
+    np.testing.assert_allclose(lin.weight.numpy(), w0 - 1.0 * g,
+                               rtol=1e-5)
+    # grads consumed after the merged update
+    assert lin.weight.grad is None or \
+        float(np.abs(lin.weight.grad.numpy()).max()) == 0.0
+
+
+def test_localsgd_strategy_knob(reset_topology, monkeypatch):
+    """localsgd: param averaging fires every k_steps optimizer steps."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.parallel as dist
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 2}
+    dist.fleet.init(is_collective=True, strategy=strat)
+    lin = paddle.nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = dist.fleet.distributed_optimizer(inner, strategy=strat)
+    calls = []
+    monkeypatch.setattr(type(opt), "_sync_params",
+                        lambda self: calls.append(1))
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    for i in range(4):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert len(calls) == 2  # steps 2 and 4
+
+
+def test_dgc_lars_raise(reset_topology):
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    import paddle_tpu.parallel as dist
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    from paddle_tpu.parallel.hybrid_optimizer import \
+        HybridParallelOptimizer
+    lin = paddle.nn.Linear(2, 2)
+    inner = paddle.optimizer.SGD(parameters=lin.parameters())
+    for field in ("dgc", "lars"):
+        strat = DistributedStrategy()
+        setattr(strat, field, True)
+        with _pytest.raises(NotImplementedError):
+            HybridParallelOptimizer(inner, strategy=strat)
+
+
+def test_lamb_strategy_swaps_optimizer(reset_topology):
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    from paddle_tpu.parallel.hybrid_optimizer import \
+        HybridParallelOptimizer
+    from paddle_tpu.optimizer import Lamb, Momentum
+    lin = paddle.nn.Linear(2, 2)
+    strat = DistributedStrategy()
+    strat.lamb = True
+    opt = HybridParallelOptimizer(
+        Momentum(0.01, parameters=lin.parameters()), strategy=strat)
+    assert isinstance(opt._inner_opt, Lamb)

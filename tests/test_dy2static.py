@@ -529,3 +529,109 @@ def test_bounded_loop_int_accumulator_promotes_or_errors():
     x = np.full((2,), 0.3, np.float32)
     t, s = jax.jit(pure)(x)
     np.testing.assert_allclose(np.asarray(s), 3.0, rtol=1e-6)
+
+
+def test_return_in_loop_transforms_and_traces():
+    """VERDICT r4 #10: return-inside-loop now compiles (shared
+    flag+break rewrite) — parity eagerly AND under jit with a traced
+    predicate."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = 0.0
+        for i in range(5):
+            s = s + i * 1.0
+            if x.sum() + i > 3.0:
+                return s
+        return -1.0
+
+    tf = dy2static.transform_function(f)
+    assert getattr(tf, "__wrapped__", None) is not None or tf is not f, \
+        "function was not transformed"
+    for v in (0.0, 1.0, 10.0):
+        x = paddle.to_tensor(np.full((2,), v, np.float32))
+        assert float(np.asarray(tf(x))) == float(np.asarray(f(x)))
+
+    # traced: predicate depends on tensor values inside jit
+    def jf(xa):
+        out = tf(paddle.Tensor(xa))
+        return out._data if hasattr(out, "_data") else jnp.asarray(out)
+    r0 = float(jax.jit(jf)(jnp.zeros((2,), jnp.float32)))
+    r1 = float(jax.jit(jf)(jnp.full((2,), 10.0, jnp.float32)))
+    assert r0 == float(np.asarray(f(paddle.to_tensor(
+        np.zeros((2,), np.float32)))))
+    assert r1 == float(np.asarray(f(paddle.to_tensor(
+        np.full((2,), 10.0, np.float32)))))
+
+
+def test_while_return_transforms():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        i = 0.0
+        while i < 10.0:
+            i = i + 1.0
+            if x.sum() + i > 5.0:
+                return i
+        return 99.0
+
+    tf = dy2static.transform_function(f)
+    for v in (0.0, 2.0, 100.0):
+        x = paddle.to_tensor(np.full((3,), v, np.float32))
+        assert float(np.asarray(tf(x))) == float(np.asarray(f(x)))
+
+
+def test_non_range_for_over_tensor_traces():
+    """VERDICT r4 #10: `for row in tensor` compiles to an indexed scan
+    (dim-0 iteration, paddle semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit import dy2static
+
+    def f(xs):
+        s = xs[0] * 0.0
+        for row in xs:
+            s = s + row * 2.0
+        return s
+
+    tf = dy2static.transform_function(f)
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    np.testing.assert_allclose(np.asarray(tf(x).numpy()),
+                               np.asarray(f(x).numpy()))
+
+    def jf(xa):
+        return tf(paddle.Tensor(xa))._data
+    out = jax.jit(jf)(x._data)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(f(x).numpy()))
+
+
+def test_non_range_for_over_list_stays_correct():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        s = x * 0.0
+        for w in [1.0, 2.0, 3.0]:
+            s = s + x * w
+        return s
+
+    tf = dy2static.transform_function(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(tf(x).numpy(), f(x).numpy())
+
+
+def test_non_range_for_with_break():
+    from paddle_tpu.jit import dy2static
+
+    def f(xs):
+        s = 0.0
+        for row in xs:
+            if row.sum() > 10.0:
+                break
+            s = s + float(row.sum())
+        return s
+
+    tf = dy2static.transform_function(f)
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    assert float(np.asarray(tf(x))) == float(np.asarray(f(x)))

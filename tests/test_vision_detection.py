@@ -1,0 +1,232 @@
+"""Round-5 kernel-family coverage: detection/vision op tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.vision.ops as vo
+
+
+def test_grid_sample_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    theta = rng.randn(2, 2, 3).astype(np.float32) * 0.3 \
+        + np.array([[1, 0, 0], [0, 1, 0]], np.float32)
+    for ac in (True, False):
+        g1 = F.affine_grid(paddle.to_tensor(theta), [2, 3, 8, 8],
+                           align_corners=ac).numpy()
+        g2 = TF.affine_grid(torch.tensor(theta), [2, 3, 8, 8],
+                            align_corners=ac).numpy()
+        np.testing.assert_allclose(g1, g2, atol=1e-5)
+        o1 = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g2),
+                           align_corners=ac).numpy()
+        o2 = TF.grid_sample(torch.tensor(x), torch.tensor(g2),
+                            align_corners=ac).numpy()
+        np.testing.assert_allclose(o1, o2, atol=1e-5)
+    for pm in ("border", "reflection"):
+        g2 = TF.affine_grid(torch.tensor(theta), [2, 3, 8, 8],
+                            align_corners=True)
+        o1 = F.grid_sample(paddle.to_tensor(x),
+                           paddle.to_tensor(g2.numpy()),
+                           padding_mode=pm).numpy()
+        o2 = TF.grid_sample(torch.tensor(x), g2, padding_mode=pm,
+                            align_corners=True).numpy()
+        np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    N, Ci, H, W, Co, k = 2, 4, 8, 8, 6, 3
+    x = rng.randn(N, Ci, H, W).astype(np.float32)
+    w = rng.randn(Co, Ci, k, k).astype(np.float32)
+    off = np.zeros((N, 2 * k * k, H, W), np.float32)
+    out = vo.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                           paddle.to_tensor(w), padding=1).numpy()
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   padding=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    # modulation mask scales the output linearly
+    mask = np.full((N, k * k, H, W), 0.5, np.float32)
+    out2 = vo.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w), padding=1,
+                            mask=paddle.to_tensor(mask)).numpy()
+    np.testing.assert_allclose(out2, 0.5 * ref, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_pool_basic():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = np.array([[0, 0, 3, 3]], np.float32)
+    out = vo.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([1], np.int32)),
+                      output_size=2).numpy()
+    # 2x2 max pooling over the full 4x4 map
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_psroi_pool_shapes_and_mean():
+    x = np.ones((1, 8, 4, 4), np.float32)  # C=8 = Co2 * 2*2
+    boxes = np.array([[0, 0, 4, 4]], np.float32)
+    out = vo.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1], np.int32)),
+                        output_size=2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = np.array([[0, 0, 10, 10], [5, 5, 20, 30]], np.float32)
+    targets = np.array([[1, 2, 11, 13], [4, 6, 22, 28]], np.float32)
+    enc = vo.box_coder(paddle.to_tensor(priors), None,
+                       paddle.to_tensor(targets),
+                       code_type="encode_center_size").numpy()
+    # decode the diagonal (each target against its own prior)
+    diag = np.stack([enc[i, i] for i in range(2)])[None]  # [1, M, 4]
+    dec = vo.box_coder(paddle.to_tensor(priors), None,
+                       paddle.to_tensor(np.repeat(diag, 1, 0)),
+                       code_type="decode_center_size", axis=1).numpy()
+    np.testing.assert_allclose(dec[0], targets, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_shapes():
+    feat = paddle.zeros([1, 8, 4, 4])
+    img = paddle.zeros([1, 3, 32, 32])
+    boxes, var = vo.prior_box(feat, img, min_sizes=[8.0],
+                              aspect_ratios=[1.0, 2.0], flip=True,
+                              clip=True)
+    assert list(boxes.shape) == [4, 4, 3, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_yolo_box_decode():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2 * 7, 4, 4).astype(np.float32)  # na=2, cls=2
+    boxes, scores = vo.yolo_box(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([[128, 128]], np.int32)),
+        anchors=[10, 13, 16, 30], class_num=2, conf_thresh=0.0,
+        downsample_ratio=32)
+    assert list(boxes.shape) == [1, 32, 4]
+    assert list(scores.shape) == [1, 32, 2]
+    assert np.isfinite(boxes.numpy()).all()
+
+
+def test_matrix_nms_suppresses_overlap():
+    bboxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.001],
+                        [20, 20, 30, 30]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]   # class 1 (0 is background)
+    out, nums = vo.matrix_nms(paddle.to_tensor(bboxes),
+                              paddle.to_tensor(scores),
+                              score_threshold=0.1, post_threshold=0.3,
+                              nms_top_k=10, keep_top_k=10)
+    o = out.numpy()[0]
+    # duplicate box decayed below post_threshold; 2 survivors
+    assert int(nums.numpy()[0]) == 2
+    assert o[0, 1] == pytest.approx(0.9, abs=1e-5)
+
+
+def test_generate_proposals_and_fpn_distribute():
+    rng = np.random.RandomState(0)
+    H = W = 4
+    A = 2
+    scores = rng.rand(1, A, H, W).astype(np.float32)
+    deltas = (rng.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+    anchors = np.tile(np.array([[0, 0, 16, 16], [0, 0, 32, 32]],
+                               np.float32), (H * W, 1))
+    var = np.ones_like(anchors)
+    rois, probs, nums = vo.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[64, 64]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        pre_nms_top_n=16, post_nms_top_n=8, nms_thresh=0.7,
+        return_rois_num=True)
+    n = int(nums.numpy()[0])
+    assert 1 <= n <= 8 and rois.shape[0] == n
+    outs, restore, lvl_nums = vo.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    assert sum(int(x.numpy()[0]) for x in lvl_nums) == n
+    assert sorted(restore.numpy().ravel().tolist()) == list(range(n))
+
+
+def test_yolo_loss_finite_and_grads():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 2 * 7, 4, 4).astype(np.float32))
+    x.stop_gradient = False
+    gt = paddle.to_tensor(np.array(
+        [[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]],
+         [[0.2, 0.3, 0.1, 0.2], [0.7, 0.7, 0.2, 0.1]]], np.float32))
+    lab = paddle.to_tensor(np.array([[1, 0], [0, 1]], np.int64))
+    loss = vo.yolo_loss(x, gt, lab, anchors=[10, 13, 16, 30],
+                        anchor_mask=[0, 1], class_num=2,
+                        ignore_thresh=0.5, downsample_ratio=32)
+    total = loss.sum()
+    total.backward()
+    assert np.isfinite(float(total.numpy()))
+    assert np.isfinite(x.grad.numpy()).all()
+    assert np.abs(x.grad.numpy()).max() > 0
+
+
+def test_edit_distance_and_accuracy_and_signal():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 4]], np.int64))
+    b = paddle.to_tensor(np.array([[1, 3, 4, 9]], np.int64))
+    d, n = paddle.edit_distance(a, b, normalized=False)
+    assert float(d.numpy()[0, 0]) == 2.0
+    sig = paddle.to_tensor(np.random.RandomState(1)
+                           .randn(2, 16).astype(np.float32))
+    fr = paddle.frame(sig, 4, 2)
+    assert list(fr.shape) == [2, 4, 7]
+    rec = paddle.overlap_add(fr, 2).numpy()
+    ref = np.zeros((2, 16), np.float32)
+    frn = fr.numpy()
+    for i in range(frn.shape[-1]):
+        ref[:, i * 2:i * 2 + 4] += frn[:, :, i]
+    np.testing.assert_allclose(rec, ref, rtol=1e-6)
+
+
+def test_functional_misc():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 6).astype(np.float32))
+    # spectral_norm: largest singular value becomes ~1
+    w = F.spectral_norm(x, power_iters=50).numpy()
+    s = np.linalg.svd(w, compute_uv=False)
+    assert s[0] == pytest.approx(1.0, abs=1e-3)
+    # rrelu eval = fixed mean slope
+    neg = paddle.to_tensor(np.full((3,), -2.0, np.float32))
+    out = F.rrelu(neg, 0.25, 0.25, training=False).numpy()
+    np.testing.assert_allclose(out, -0.5, rtol=1e-6)
+    # log_loss
+    p = paddle.to_tensor(np.array([0.9], np.float32))
+    y = paddle.to_tensor(np.array([1.0], np.float32))
+    assert float(F.log_loss(p, y, epsilon=0.0).numpy()) == \
+        pytest.approx(-np.log(0.9), rel=1e-5)
+    # margin_cross_entropy reduces to CE at zero margins
+    logits = paddle.to_tensor(rng.rand(3, 5).astype(np.float32) * 0.5)
+    lab = paddle.to_tensor(np.array([0, 2, 4], np.int64))
+    mce = F.margin_cross_entropy(logits, lab, margin1=1.0, margin2=0.0,
+                                 margin3=0.0, scale=1.0)
+    import jax.numpy as jnp
+    import jax
+    lp = jax.nn.log_softmax(logits._data, -1)
+    ref = -np.mean([lp[i, l] for i, l in enumerate([0, 2, 4])])
+    assert float(mce.numpy()) == pytest.approx(float(ref), rel=1e-4)
+    # gather_tree backtrace
+    ids = paddle.to_tensor(np.array(
+        [[[1, 2]], [[3, 4]], [[5, 6]]], np.int64))     # [T=3, B=1, K=2]
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]], [[0, 1]]], np.int64))
+    out = F.gather_tree(ids, parents).numpy()
+    # beam 0 at t=2: token 5, parent 0 -> t=1 beam0? parents[2,0,0]=0
+    # -> t=1 token ids[1,0,0]=3? backtrace: beam=0, tok 5; beam=par[2,0]=0
+    # t=1: tok ids[1,0,0]=3, beam=par[1,0,0]=1; t=0: tok ids[0,0,1]=2
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 3, 5])
+    # bilinear
+    x1 = paddle.to_tensor(rng.randn(2, 3).astype(np.float32))
+    x2 = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+    wt = paddle.to_tensor(rng.randn(5, 3, 4).astype(np.float32))
+    out = F.bilinear(x1, x2, wt).numpy()
+    ref = np.einsum("bi,kij,bj->bk", x1.numpy(), wt.numpy(), x2.numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)

@@ -12,42 +12,15 @@ Requires PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python (the in-image
 C++ protobuf lacks the xplane descriptor); set automatically below.
 """
 import collections
-import glob
 import os
 import re
 import sys
 
-os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-
-def load_xplane(trace_dir):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                             recursive=True), key=os.path.getmtime)
-    if not paths:
-        raise SystemExit(f"no .xplane.pb under {trace_dir}")
-    xs = xplane_pb2.XSpace()
-    with open(paths[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-    return xs
-
-
-def device_op_times(xs):
-    """{op_name: total_ns} over all TPU device planes' XLA Ops lines."""
-    out = collections.Counter()
-    for plane in xs.planes:
-        if "TPU" not in plane.name and "/device:" not in plane.name:
-            continue
-        ev_meta = plane.event_metadata
-        for line in plane.lines:
-            if line.name not in ("XLA Ops", "XLA Modules", "Steps"):
-                continue
-            if line.name != "XLA Ops":
-                continue
-            for ev in line.events:
-                name = ev_meta[ev.metadata_id].name
-                out[name] += ev.duration_ps // 1000
-    return out
+from paddle_tpu.profiler.xplane import (  # noqa: E402,F401
+    load_xplane, device_op_times)
 
 
 def bucket(name):
