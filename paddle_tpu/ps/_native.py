@@ -106,6 +106,18 @@ def get_lib():
         ctypes.c_int, ctypes.c_int, i32p, ctypes.c_int, ctypes.c_int,
         u64p, f32p]
     lib.pscore_dataset_next_batch.restype = ctypes.c_int
+    lib.pscore_dataset_extract_size.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    lib.pscore_dataset_extract_size.restype = ctypes.c_int64
+    lib.pscore_dataset_extract.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_char_p]
+    lib.pscore_dataset_extract.restype = ctypes.c_int64
+    lib.pscore_dataset_retain.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    lib.pscore_dataset_ingest.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
+    lib.pscore_dataset_ingest.restype = ctypes.c_int64
     _lib = lib
     return lib
 

@@ -180,3 +180,80 @@ class GeoCommunicator(AsyncCommunicator):
         merged = self._add(table, params - self._base[tid])
         self._base[tid] = merged.copy()
         return merged.copy()
+
+
+class PullDenseWorker:
+    """Background dense-parameter refresher.
+
+    Parity: `paddle/fluid/framework/pull_dense_worker.cc:1` — in async
+    PS training the dense params drift on the servers while trainers
+    compute; a background thread re-pulls them on an interval (or after
+    every `pull_every` trainer steps) so the training threads never
+    block on a dense pull in their cycle. The freshest copy is handed
+    out via `get()` (lock-free swap of an immutable array)."""
+
+    def __init__(self, pull_fn, interval_s=0.05, pull_every=0):
+        self._pull_fn = pull_fn
+        self._interval = float(interval_s)
+        self._pull_every = int(pull_every)
+        self._latest = None
+        self._version = 0
+        self._steps = 0
+        self._cv = threading.Condition()
+        self._running = False
+        self._thread = None
+        self._errors = []
+
+    def start(self):
+        if self._running:
+            return self
+        self._latest = np.asarray(self._pull_fn())
+        self._version = 1
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while self._running:
+            with self._cv:
+                self._cv.wait(timeout=self._interval)
+                if not self._running:
+                    return
+                if self._pull_every and self._steps < self._pull_every:
+                    continue
+                self._steps = 0
+            try:
+                fresh = np.asarray(self._pull_fn())
+            except Exception as e:  # noqa: BLE001 — surface on get()
+                self._errors.append(e)
+                continue
+            self._latest = fresh            # atomic ref swap
+            self._version += 1
+
+    def increase_thread_version(self):
+        """Trainer-step tick (pull_dense_worker IncreaseThreadVersion):
+        with pull_every>0 the refresh fires once that many ticks
+        accumulate instead of on the wall-clock interval."""
+        with self._cv:
+            self._steps += 1
+            if self._pull_every and self._steps >= self._pull_every:
+                self._cv.notify()
+
+    def get(self):
+        """Freshest dense params (never blocks on the network)."""
+        if self._errors:
+            raise self._errors.pop(0)
+        return self._latest
+
+    @property
+    def version(self):
+        return self._version
+
+    def stop(self):
+        self._running = False
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

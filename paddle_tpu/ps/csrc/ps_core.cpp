@@ -358,6 +358,14 @@ struct SparseTable {
   }
 
   void pull(const uint64_t* keys, int n, float* out) {
+    // kCtrDymf values are variable-length ([.., embed_w, mf...]); the
+    // fixed-stride generic path would read cfg.dim floats past embed_w
+    // (heap overflow on immature rows). Route to the dymf layout
+    // (stride = 1 + dim, matching RemoteSparseTable.row_width).
+    if (cfg.accessor == kCtrDymf) {
+      pull_dymf(keys, n, out, 1 + cfg.dim);
+      return;
+    }
     const int woff = w_off();
     parallel_for(n, [&](int i) {
       uint64_t k = keys[i];
@@ -371,6 +379,14 @@ struct SparseTable {
 
   void push(const uint64_t* keys, const float* grads, int n,
             const float* shows, const float* clicks) {
+    // see pull(): generic fixed-stride writes on kCtrDymf rows would
+    // overflow immature (mf-unallocated) values — route to the dymf
+    // path with the default mf dim.
+    if (cfg.accessor == kCtrDymf) {
+      push_dymf(keys, nullptr, grads, n, 1 + cfg.dim, shows, clicks,
+                nullptr);
+      return;
+    }
     const int woff = w_off();
     parallel_for(n, [&](int i) {
       uint64_t k = keys[i];
@@ -423,12 +439,13 @@ struct SparseTable {
       const float* grad = grads + (size_t)i * stride;
       apply_rule(v.data() + 5, v.data() + 6, grad, 1);  // embed_w
       int mf = dymf_mf(v);
-      if (mf == 0 && mf_dims[i] > 0 &&
+      const int mfd_i = mf_dims ? mf_dims[i] : cfg.dim;
+      if (mf == 0 && mfd_i > 0 &&
           score_of(v) >= cfg.embedx_threshold) {
         // clamp to the push stride (= table max dim): an oversized
         // slot config would otherwise allocate an mf block no push
         // could ever update
-        int want = std::min(mf_dims[i], stride - 1);
+        int want = std::min(mfd_i, stride - 1);
         dymf_extend(v, want, s);
         mf = want;
       }
@@ -913,6 +930,91 @@ int pscore_dataset_next_batch(int h, int batch, const int* slot_ids,
                               uint64_t* out_keys, float* out_labels) {
   return g_datasets[h]->next_batch(batch, slot_ids, n_slots, max_per_slot,
                                    out_keys, out_labels);
+}
+
+// ---- cross-worker global shuffle support (data_set.h:230
+// GlobalShuffle): records route to workers by a content hash so every
+// worker computes the same destination for the same record. Wire
+// format per record: f32 label, u32 nfeat, nfeat x (i32 slot, u64
+// sign).
+static uint64_t record_hash(const Record& r, uint64_t seed) {
+  uint64_t x = seed * 0x9E3779B97F4A7C15ull + 0xD1B54A32D192ED03ull;
+  x ^= (uint64_t)(int64_t)(r.label * 7919.0f) + 0x9E3779B97F4A7C15ull +
+       (x << 6) + (x >> 2);
+  for (auto& f : r.feats) {
+    uint64_t v = f.second * 0xBF58476D1CE4E5B9ull + (uint64_t)f.first;
+    v ^= v >> 31;
+    x ^= v + 0x9E3779B97F4A7C15ull + (x << 6) + (x >> 2);
+  }
+  return x;
+}
+
+static size_t record_bytes(const Record& r) {
+  return 4 + 4 + r.feats.size() * 12;
+}
+
+int64_t pscore_dataset_extract_size(int h, int dst, int n_workers,
+                                    uint64_t seed) {
+  auto* d = g_datasets[h];
+  size_t total = 0;
+  for (auto& r : d->records)
+    if ((int)(record_hash(r, seed) % (uint64_t)n_workers) == dst)
+      total += record_bytes(r);
+  return (int64_t)total;
+}
+
+int64_t pscore_dataset_extract(int h, int dst, int n_workers,
+                               uint64_t seed, char* buf) {
+  auto* d = g_datasets[h];
+  char* p = buf;
+  for (auto& r : d->records) {
+    if ((int)(record_hash(r, seed) % (uint64_t)n_workers) != dst)
+      continue;
+    std::memcpy(p, &r.label, 4); p += 4;
+    uint32_t nf = (uint32_t)r.feats.size();
+    std::memcpy(p, &nf, 4); p += 4;
+    for (auto& f : r.feats) {
+      int32_t slot = f.first;
+      std::memcpy(p, &slot, 4); p += 4;
+      std::memcpy(p, &f.second, 8); p += 8;
+    }
+  }
+  return (int64_t)(p - buf);
+}
+
+void pscore_dataset_retain(int h, int me, int n_workers, uint64_t seed) {
+  auto* d = g_datasets[h];
+  std::vector<Record> keep;
+  keep.reserve(d->records.size() / (n_workers ? n_workers : 1) + 1);
+  for (auto& r : d->records)
+    if ((int)(record_hash(r, seed) % (uint64_t)n_workers) == me)
+      keep.push_back(std::move(r));
+  d->records.swap(keep);
+  d->cursor = 0;
+}
+
+int64_t pscore_dataset_ingest(int h, const char* buf, int64_t nbytes) {
+  auto* d = g_datasets[h];
+  const char* p = buf;
+  const char* end = buf + nbytes;
+  int64_t added = 0;
+  while (p + 8 <= end) {
+    Record r;
+    std::memcpy(&r.label, p, 4); p += 4;
+    uint32_t nf;
+    std::memcpy(&nf, p, 4); p += 4;
+    if (p + (size_t)nf * 12 > end) return -1;  // truncated payload
+    r.feats.reserve(nf);
+    for (uint32_t i = 0; i < nf; i++) {
+      int32_t slot; uint64_t sign;
+      std::memcpy(&slot, p, 4); p += 4;
+      std::memcpy(&sign, p, 8); p += 8;
+      r.feats.emplace_back((int)slot, sign);
+    }
+    d->records.push_back(std::move(r));
+    added++;
+  }
+  return added;
 }
 
 }  // extern "C"

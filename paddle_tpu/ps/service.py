@@ -27,6 +27,12 @@ ops: 0 PULL_SPARSE (payload: u32 n, u64*n keys) -> f32 n*dim
        (server-side KV namespace: the FL coordinator's client-info /
        strategy exchange — CoordinatorClient/FLCommunicator parity —
        and a TCPStore-style rendezvous primitive)
+    11 PUSH_SPARSE_V2 (payload: u32 n, u32 width, u8 flags, u64*n keys,
+       f32 n*width grads, then per flags bit0..bit3: f32*n shows,
+       f32*n clicks, i32*n mf_dims, f32*n slots) -> u8 ok
+       (CTR accessor statistics travel with the gradient so remote
+       ctr_double/ctr_dymf tables mature exactly like local ones —
+       sendrecv.proto's PushSparseParam show/click semantics)
 
 Fault tolerance: the client transparently reconnects a broken server
 socket and retries the request ONCE (brpc_ps_client reconnect parity;
@@ -44,7 +50,7 @@ import numpy as np
 from .table import MemorySparseTable, MemoryDenseTable
 
 (PULL_SPARSE, PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, SAVE, BARRIER, STOP,
- DENSE_ADD, KV_SET, KV_GET, KV_LIST) = range(11)
+ DENSE_ADD, KV_SET, KV_GET, KV_LIST, PUSH_SPARSE_V2) = range(12)
 
 
 def _recv_exact(sock, n):
@@ -185,6 +191,25 @@ class PSServer:
                 n, width)
             table.push(keys.copy(), grads.copy())
             _send_msg(sock, b"\x01")
+        elif op == PUSH_SPARSE_V2:
+            n, width, flags = struct.unpack("<IIB", body[:9])
+            off = 9
+            keys = np.frombuffer(body[off:off + 8 * n], np.uint64)
+            off += 8 * n
+            grads = np.frombuffer(body[off:off + 4 * n * width],
+                                  np.float32).reshape(n, width)
+            off += 4 * n * width
+            extras = {}
+            for bit, name, dt in ((1, "shows", np.float32),
+                                  (2, "clicks", np.float32),
+                                  (4, "mf_dims", np.int32),
+                                  (8, "slots", np.float32)):
+                if flags & bit:
+                    extras[name] = np.frombuffer(
+                        body[off:off + 4 * n], dt).copy()
+                    off += 4 * n
+            table.push(keys.copy(), grads.copy(), **extras)
+            _send_msg(sock, b"\x01")
         elif op == PULL_DENSE:
             vals = table.pull()
             _send_msg(sock, struct.pack("<I", vals.size)
@@ -303,6 +328,39 @@ class PSClient:
                     g[idx].tobytes()
                 self._request(si, payload)
 
+    def push_sparse_v2(self, table_id, keys: np.ndarray,
+                       grads: np.ndarray, dim: int, shows=None,
+                       clicks=None, mf_dims=None, slots=None):
+        """PUSH_SPARSE_V2: gradient + CTR accessor statistics in one
+        message (show/click counts, per-key mf dims, slot ids)."""
+        flat = keys.reshape(-1).astype(np.uint64)
+        g = grads.reshape(flat.size, dim).astype(np.float32)
+        opt = [
+            (1, None if shows is None else np.asarray(
+                shows, np.float32).reshape(-1)),
+            (2, None if clicks is None else np.asarray(
+                clicks, np.float32).reshape(-1)),
+            (4, None if mf_dims is None else np.asarray(
+                mf_dims, np.int32).reshape(-1)),
+            (8, None if slots is None else np.asarray(
+                slots, np.float32).reshape(-1)),
+        ]
+        flags = sum(bit for bit, a in opt if a is not None)
+        assign = self._shard_of(flat)
+        with self._lock:
+            for si in range(len(self._socks)):
+                idx = np.where(assign == si)[0]
+                if idx.size == 0:
+                    continue
+                sub = flat[idx]
+                payload = struct.pack("<BIIIB", PUSH_SPARSE_V2, table_id,
+                                      sub.size, dim, flags)
+                payload += sub.tobytes() + g[idx].tobytes()
+                for bit, a in opt:
+                    if a is not None:
+                        payload += a[idx].tobytes()
+                self._request(si, payload)
+
     # -- KV namespace (FL coordinator exchange / rendezvous) ---------
     def kv_set(self, key: str, value: bytes, server=0):
         kb = key.encode()
@@ -418,6 +476,14 @@ class RemoteSparseTable:
 
     def push(self, keys, grads, shows=None, clicks=None, mf_dims=None,
              slots=None):
+        if any(x is not None for x in (shows, clicks, mf_dims, slots)):
+            # CTR statistics ride the v2 wire op so remote accessors
+            # mature identically to local tables (ADVICE r4 #2)
+            self.client.push_sparse_v2(
+                self.table_id, np.asarray(keys), np.asarray(grads),
+                self.row_width, shows=shows, clicks=clicks,
+                mf_dims=mf_dims, slots=slots)
+            return
         self.client.push_sparse(self.table_id, np.asarray(keys),
                                 np.asarray(grads), self.row_width)
 

@@ -271,10 +271,52 @@ class InMemoryDataset:
         finally:
             os.unlink(tmp.name)
 
-    def global_shuffle(self, fleet=None, seed=0):
+    def local_shuffle(self, fleet=None, seed=0):
         self._lib.pscore_dataset_shuffle(self._h, seed)
 
-    local_shuffle = global_shuffle
+    def global_shuffle(self, fleet=None, seed=0, client=None,
+                       worker_id=0, n_workers=1, key_prefix="gshuf"):
+        """Cross-worker global shuffle (`data_set.h:230` GlobalShuffle
+        parity): records route to workers by a shared content hash;
+        shards exchange over the PS service's KV namespace + barrier.
+        With one worker (or no client) it degrades to a local shuffle.
+
+        `client`: a ps.service.PSClient shared by all workers (or pass
+        a fleet whose `_ps_client`/worker info we can read)."""
+        import ctypes
+        if client is None and fleet is not None:
+            client = getattr(fleet, "_ps_client", None)
+            worker_id = getattr(fleet, "worker_index", lambda: 0)()
+            n_workers = getattr(fleet, "worker_num", lambda: 1)()
+        if client is None or n_workers <= 1:
+            self._lib.pscore_dataset_shuffle(self._h, seed)
+            return
+        lib = self._lib
+        # 1) publish every remote-bound shard
+        for dst in range(n_workers):
+            if dst == worker_id:
+                continue
+            nb = lib.pscore_dataset_extract_size(self._h, dst, n_workers,
+                                                 seed)
+            buf = ctypes.create_string_buffer(max(int(nb), 1))
+            lib.pscore_dataset_extract(self._h, dst, n_workers, seed, buf)
+            client.kv_set(f"{key_prefix}/{worker_id}/{dst}",
+                          buf.raw[:int(nb)])
+        client.barrier(n_workers)
+        # 2) keep only my records, ingest everyone else's shard for me
+        lib.pscore_dataset_retain(self._h, worker_id, n_workers, seed)
+        for src in range(n_workers):
+            if src == worker_id:
+                continue
+            blob = client.kv_get(f"{key_prefix}/{src}/{worker_id}")
+            if blob:
+                rc = lib.pscore_dataset_ingest(self._h, blob, len(blob))
+                if rc < 0:
+                    raise IOError("global_shuffle: truncated shard blob")
+        # 3) local order randomisation (seed varies per worker so ranks
+        # don't iterate in lockstep) + leave no stale blobs behind
+        self._lib.pscore_dataset_shuffle(self._h, seed + 1 + worker_id)
+        client.barrier(n_workers)
 
     def get_memory_data_size(self, fleet=None):
         return int(self._lib.pscore_dataset_size(self._h))

@@ -488,3 +488,23 @@ def test_data_generator_string_slots():
     p2.set_slots(["city"])
     p2.run_from_iterable(["x"], write=out2.append)
     assert out == out2
+
+
+def test_generic_push_pull_on_dymf_handle_safe():
+    """ADVICE r4 #3: the generic fixed-stride entry points must route
+    kCtrDymf handles to the dymf layout instead of overflowing the
+    variable-length values."""
+    from paddle_tpu.ps.table import MemorySparseTable
+    t = MemorySparseTable(4, "naive", 0.5, accessor="ctr_dymf",
+                          embedx_threshold=0.0)
+    keys = np.arange(1, 5, dtype=np.uint64)
+    # generic push/pull (no shows/clicks/mf_dims) — previously indexed
+    # cfg.dim floats past embed_w on immature rows
+    stride = 1 + 4
+    v0 = t.pull(keys)
+    assert v0.shape == (4, stride)
+    t.push(keys, np.ones((4, stride), np.float32))
+    v1 = t.pull(keys)
+    assert np.isfinite(v1).all()
+    # embed_w moved by the naive rule
+    np.testing.assert_allclose(v1[:, 0], v0[:, 0] - 0.5, rtol=1e-5)

@@ -309,3 +309,130 @@ def test_fl_coordinator_round_trip():
         clients[0].stop_server()
         for c in clients:
             c.close()
+
+
+def test_push_sparse_v2_matures_remote_ctr(tmp_path):
+    """ADVICE r4 #2: shows/clicks/mf_dims travel over the wire
+    (PUSH_SPARSE_V2) so a remote ctr_dymf table matures its mf block
+    exactly like a local one."""
+    from paddle_tpu.ps.table import MemorySparseTable
+
+    def drive(table):
+        keys = np.arange(1, 5, dtype=np.uint64)
+        g = np.ones((4, 5), np.float32) * 0.1
+        shows = np.full(4, 20.0, np.float32)   # crosses threshold 10
+        clicks = np.full(4, 5.0, np.float32)
+        for _ in range(3):
+            table.push(keys, g, shows=shows, clicks=clicks,
+                       mf_dims=np.full(4, 4, np.int32))
+        return table.pull(keys)
+
+    # local reference
+    local = MemorySparseTable(4, "naive", 0.5, accessor="ctr_dymf",
+                              embedx_threshold=10.0)
+    ref = drive(local)
+    assert np.abs(ref[:, 1:]).max() > 0, "local mf never matured"
+
+    # remote via v2 wire op
+    s = PSServer()
+    s.register_sparse_table(0, dim=4, sgd_rule="naive", learning_rate=0.5,
+                            accessor="ctr_dymf", embedx_threshold=10.0)
+    s.run()
+    client = PSClient([f"127.0.0.1:{s.port}"])
+    try:
+        remote = RemoteSparseTable(client, 0, dim=4, accessor="ctr_dymf")
+        got = drive(remote)
+        # maturation happened remotely (mf block nonzero);
+        # sgd updates on embed_w match the local run
+        assert np.abs(got[:, 1:]).max() > 0, \
+            "remote mf never matured (stats dropped on the wire)"
+        np.testing.assert_allclose(got[:, 0], ref[:, 0], rtol=1e-5)
+    finally:
+        client.stop_server()
+        client.close()
+
+
+def test_global_shuffle_across_workers(tmp_path):
+    """VERDICT r4 #7: true cross-worker global shuffle — two workers
+    exchange record shards over the PS service; union preserved, both
+    workers end with a content-hash-pure partition."""
+    import threading
+    from paddle_tpu.ps.table import InMemoryDataset
+
+    # two disjoint slot files
+    f1, f2 = tmp_path / "a.txt", tmp_path / "b.txt"
+    f1.write_text("".join(f"1 1:{k}\n" for k in range(1, 51)))
+    f2.write_text("".join(f"0 1:{k}\n" for k in range(51, 101)))
+
+    s = PSServer()
+    s.run()
+    client1 = PSClient([f"127.0.0.1:{s.port}"])
+    client2 = PSClient([f"127.0.0.1:{s.port}"])
+
+    ds = [InMemoryDataset(), InMemoryDataset()]
+    for d, f in zip(ds, (f1, f2)):
+        d.init(batch_size=16, slots=[1])
+        d.set_filelist([str(f)])
+        d.load_into_memory()
+
+    def collect(d):
+        keys = set()
+        for kb, lb in d:
+            keys.update(int(x) for x in kb.reshape(-1) if x != 0)
+        return keys
+
+    errs = []
+
+    def run(widx, d, cl):
+        try:
+            d.global_shuffle(seed=42, client=cl, worker_id=widx,
+                             n_workers=2)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t1 = threading.Thread(target=run, args=(0, ds[0], client1))
+    t2 = threading.Thread(target=run, args=(1, ds[1], client2))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errs, errs
+    try:
+        k0, k1 = collect(ds[0]), collect(ds[1])
+        # union preserved, partition disjoint, both non-trivial and
+        # different from the original file split
+        assert k0 | k1 == set(range(1, 101))
+        assert not (k0 & k1)
+        assert k0 and k1
+        assert k0 != set(range(1, 51))
+    finally:
+        client1.stop_server()
+        client1.close()
+        client2.close()
+
+
+def test_pull_dense_worker_refreshes_in_background():
+    """VERDICT r4 #7: pull_dense_worker parity — trainers read dense
+    params from a background refresher instead of pulling in-cycle."""
+    import time
+    from paddle_tpu.ps.communicator import PullDenseWorker
+
+    s = PSServer()
+    t = s.register_dense_table(1, 4, sgd_rule="naive", learning_rate=1.0)
+    s.run()
+    client = PSClient([f"127.0.0.1:{s.port}"])
+    try:
+        w = PullDenseWorker(lambda: client.pull_dense(1),
+                            interval_s=0.02).start()
+        v0 = w.get().copy()
+        # another "trainer" pushes a grad directly; the worker must
+        # pick the change up without any pull in our loop
+        client.push_dense(1, np.ones(4, np.float32))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if not np.allclose(w.get(), v0):
+                break
+            time.sleep(0.02)
+        np.testing.assert_allclose(w.get(), v0 - 1.0, rtol=1e-6)
+        assert w.version >= 2
+        w.stop()
+    finally:
+        client.stop_server()
+        client.close()
