@@ -52,18 +52,40 @@ class _LazyVjp:
     actually reaches this node. Ops are pure (randomness enters as
     explicit key inputs/closures), so the deferred re-trace reproduces
     the forward exactly; this is the remat trade the reference makes in
-    `fleet/recompute` applied to the eager tape."""
+    `fleet/recompute` applied to the eager tape.
 
-    __slots__ = ("fn", "arrays", "_vjp")
+    Mutable GLOBAL config an op might read inside fn (paddle flags, the
+    amp auto_cast state) is snapshotted at record time and restored
+    around the deferred trace, so a `set_flags`/amp-context change
+    between forward and .backward() cannot silently linearize a
+    different computation than the one that ran (ADVICE r4 #5)."""
+
+    __slots__ = ("fn", "arrays", "_vjp", "_flags", "_amp")
 
     def __init__(self, fn, arrays):
         self.fn = fn
         self.arrays = arrays
         self._vjp = None
+        from .. import flags as _flags
+        from ..amp.auto_cast import _state as _amp_state
+        self._flags = dict(_flags._FLAGS)
+        self._amp = dict(_amp_state)
 
     def __call__(self, ct):
         if self._vjp is None:
-            _, self._vjp = jax.vjp(self.fn, *self.arrays)
+            from .. import flags as _flags
+            from ..amp.auto_cast import _state as _amp_state
+            cur_flags = dict(_flags._FLAGS)
+            cur_amp = dict(_amp_state)
+            _flags._FLAGS.update(self._flags)
+            _amp_state.update(self._amp)
+            try:
+                _, self._vjp = jax.vjp(self.fn, *self.arrays)
+            finally:
+                _flags._FLAGS.clear()
+                _flags._FLAGS.update(cur_flags)
+                _amp_state.clear()
+                _amp_state.update(cur_amp)
             self.fn = self.arrays = None  # free after tracing
         return self._vjp(ct)
 

@@ -167,3 +167,33 @@ def test_grad_create_graph_raises():
     y = (x * x).sum()
     with pytest.raises(NotImplementedError):
         paddle.grad(y, x, create_graph=True)
+
+
+def test_lazy_vjp_snapshots_flags_and_amp():
+    """ADVICE r4 #5: a set_flags / amp-state change between forward and
+    backward must not alter the linearized computation."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch
+
+    seen = []
+
+    def op(a):
+        # an op that READS global config inside fn (worst case)
+        from paddle_tpu import flags
+        scale = 2.0 if flags.get_flags(
+            "FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] else 3.0
+        seen.append(scale)
+        return a * scale
+
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    x.stop_gradient = False
+    y = dispatch.apply("cfg_op", op, (x,))
+    # flip the flag BEFORE backward — grad must still use scale=3.0
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        y.sum().backward()
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
