@@ -1,12 +1,22 @@
-"""paddle.hub — parity for the local-source paths (`python/paddle/hub.py`).
-Zero-egress image: github sources are rejected with a clear error; local
-directories with a hubconf.py work fully.
+"""paddle.hub — local + remote sources (`python/paddle/hapi/hub.py:1`).
+
+Remote protocol parity: `github`/`gitee` sources resolve
+`owner/repo[:branch]` to an archive zip URL, download into
+`~/.cache/paddle/hub` (once, unless force_reload), unzip, and load the
+repo's `hubconf.py`. The download path is urllib-based and exercised in
+tests through `file://` archive URLs; real github fetches additionally
+need network egress (this image has none — the error is raised at
+download time by urllib, not pre-emptively by us).
 """
 from __future__ import annotations
 
 import importlib.util
 import os
-import sys
+import shutil
+import zipfile
+
+HUB_DIR = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_HUB_DIR", "~/.cache/paddle/hub"))
 
 
 def _load_hubconf(repo_dir):
@@ -19,26 +29,90 @@ def _load_hubconf(repo_dir):
     return mod
 
 
-def _check_source(source):
-    if source != "local":
-        raise RuntimeError(
-            "paddle_tpu.hub supports source='local' only in this "
-            "environment (no network egress); clone the repo and pass its "
-            "path")
+def _parse_repo(repo):
+    """'owner/name[:branch]' -> (owner, name, branch)."""
+    branch = "main"
+    if ":" in repo:
+        repo, branch = repo.split(":", 1)
+    if repo.count("/") != 1:
+        raise ValueError(
+            f"remote repo must be 'owner/name[:branch]', got {repo!r}")
+    owner, name = repo.split("/")
+    return owner, name, branch
+
+
+def _archive_url(repo, source):
+    if source.startswith(("http://", "https://", "file://")):
+        return repo, source  # direct archive URL (also the test path)
+    owner, name, branch = _parse_repo(repo)
+    if source == "github":
+        return (f"{owner}_{name}_{branch}",
+                f"https://github.com/{owner}/{name}/archive/{branch}.zip")
+    if source == "gitee":
+        return (f"{owner}_{name}_{branch}",
+                f"https://gitee.com/{owner}/{name}/repository/archive/"
+                f"{branch}.zip")
+    raise ValueError(f"unknown hub source {source!r} "
+                     "(expected 'github', 'gitee' or 'local')")
+
+
+def _fetch_repo(repo, source, force_reload):
+    """Download + unzip into the hub cache; returns the repo dir."""
+    import urllib.request
+    if source.startswith(("http://", "https://", "file://")):
+        cache_key = os.path.basename(source).replace(".zip", "")
+        url = source
+    else:
+        cache_key, url = _archive_url(repo, source)
+    hub_dir = os.path.expanduser(
+        os.environ.get("PADDLE_TPU_HUB_DIR", "~/.cache/paddle/hub"))
+    dest = os.path.join(hub_dir, cache_key)
+    if os.path.isdir(dest) and not force_reload:
+        return dest
+    os.makedirs(hub_dir, exist_ok=True)
+    zpath = dest + ".zip"
+    with urllib.request.urlopen(url) as r, open(zpath, "wb") as f:
+        shutil.copyfileobj(r, f)
+    if os.path.isdir(dest):
+        shutil.rmtree(dest)
+    tmp = dest + ".extract"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    with zipfile.ZipFile(zpath) as z:
+        # refuse entries escaping the extraction root (zip-slip)
+        for n in z.namelist():
+            p = os.path.normpath(n)
+            if p.startswith("..") or os.path.isabs(p):
+                raise ValueError(f"unsafe archive member {n!r}")
+        z.extractall(tmp)
+    os.unlink(zpath)
+    # archives contain a single top-level '<name>-<branch>/' dir
+    entries = [e for e in os.listdir(tmp) if not e.startswith(".")]
+    src_dir = os.path.join(tmp, entries[0]) if len(entries) == 1 and \
+        os.path.isdir(os.path.join(tmp, entries[0])) else tmp
+    shutil.move(src_dir, dest)
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def _repo_dir(repo_dir, source, force_reload):
+    if source == "local":
+        return repo_dir
+    return _fetch_repo(repo_dir, source, force_reload)
 
 
 def list(repo_dir, source="local", force_reload=False):
-    _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(_repo_dir(repo_dir, source, force_reload))
     return [n for n in dir(mod) if callable(getattr(mod, n))
             and not n.startswith("_")]
 
 
 def help(repo_dir, model, source="local", force_reload=False):
-    _check_source(source)
-    return getattr(_load_hubconf(repo_dir), model).__doc__
+    d = _repo_dir(repo_dir, source, force_reload)
+    return getattr(_load_hubconf(d), model).__doc__
 
 
 def load(repo_dir, model, source="local", force_reload=False, **kwargs):
-    _check_source(source)
-    return getattr(_load_hubconf(repo_dir), model)(**kwargs)
+    d = _repo_dir(repo_dir, source, force_reload)
+    return getattr(_load_hubconf(d), model)(**kwargs)

@@ -55,10 +55,13 @@ _masks = {}
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
-    """Apply 2:4 masks to every Linear/Conv weight in the model."""
+    """Apply 2:4 masks to every Linear/Conv weight in the model
+    (layers named via set_excluded_layers are skipped, asp.py parity)."""
     from ...nn.layers.common import Linear
     from ...nn.layers.conv import _ConvNd
     for name, layer in model.named_sublayers(include_self=True):
+        if name in _excluded:
+            continue
         if isinstance(layer, (Linear, _ConvNd)):
             w = layer.weight
             mask = create_mask(w, mask_algo, n, m)
@@ -68,9 +71,16 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
 
 
 def decorate(optimizer):
-    """Wrap optimizer.step to re-apply masks after each update (the ASP
-    OptimizerWithSparsityGuarantee capability)."""
+    """OptimizerWithSparsityGuarantee parity (`fluid/contrib/sparsity/
+    asp.py:1`): re-apply the pruning masks after every update so
+    sparsity survives training. Covers BOTH execution paths:
+    - eager: optimizer.step is wrapped;
+    - compiled (hapi fused step): the masks are published on
+      `optimizer._asp_masks`; jit/trainer.py multiplies them into each
+      updated parameter inside the compiled executable.
+    """
     orig_step = optimizer.step
+    optimizer._asp_masks = _masks
 
     def step():
         orig_step()
@@ -83,9 +93,14 @@ def decorate(optimizer):
     return optimizer
 
 
-def reset_excluded_layers(*a, **k):
-    pass
+_excluded = set()
 
 
-def set_excluded_layers(*a, **k):
-    pass
+def set_excluded_layers(param_names=None, main_program=None):
+    """Exclude sublayers (by named_sublayers name) from prune_model."""
+    for n in (param_names or []):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
