@@ -207,7 +207,25 @@ def run_bench(batch_size=512, dim=8, n=20000):
     epoch()  # warmup/compile
     t0 = time.perf_counter()
     seen = epoch()
-    return seen / (time.perf_counter() - t0)
+    eps = seen / (time.perf_counter() - t0)
+    # training AUC on a sample (BASELINE config 5's second metric) via
+    # the bucketed metric stack: a real quality signal, not just ex/s
+    from paddle_tpu.metric import Auc
+    auc = Auc(num_thresholds=2048)
+    ds.rewind()
+    it = iter(ds)
+    for _ in range(8):
+        batch = next(it, None)
+        if batch is None:
+            break
+        keys, labels = batch
+        acts, lab = pull_fn((keys, labels))
+        logits = net(paddle.to_tensor(jnp.asarray(acts)))
+        probs = 1.0 / (1.0 + np.exp(-np.asarray(logits.numpy(),
+                                                np.float64)))
+        preds = np.stack([1.0 - probs, probs], axis=1)
+        auc.update(preds, lab.reshape(-1, 1))
+    return eps, float(auc.accumulate())
 
 
 if __name__ == "__main__":

@@ -263,6 +263,21 @@ class Model:
                             list(arrs_),
                             mesh=getattr(self, "_dist_mesh", None))
                 except Exception as e:
+                    # ADVICE r4 #4: the grouped executable donates
+                    # params/accums — if it failed at EXECUTION time the
+                    # buffers may already be consumed, and a per-step
+                    # replay would read deleted arrays. Detect and raise
+                    # cleanly instead of crashing mid-replay.
+                    if any(getattr(p._data, "is_deleted",
+                                   lambda: False)()
+                           for p in self._train_step.p_tensors):
+                        raise RuntimeError(
+                            "grouped train step failed after buffer "
+                            "donation; parameter state was consumed and "
+                            "cannot be replayed. Re-initialise the "
+                            "model/optimizer (or set "
+                            "model._fit_group_max = 1 to train "
+                            "per-step)") from e
                     warnings.warn(
                         f"grouped train steps failed ({type(e).__name__}:"
                         f" {e}); replaying per-step and disabling "
