@@ -50,7 +50,7 @@ def bench_gpt(on_tpu):
                         # block remat, fused bf16 CE (chunked x2 for the
                         # freed logits memory), bf16 grads w/ f32 master
                         remat_policy="save_splash_residuals",
-                        fused_ce=True, ce_seq_chunks=2, bf16_grads=True,
+                        fused_ce=True, ce_seq_chunks=4, bf16_grads=True,
                         compute_dtype=jnp.bfloat16)
         batch, iters = 32, 12
     else:

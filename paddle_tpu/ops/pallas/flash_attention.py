@@ -70,20 +70,27 @@ def _splash_kernel(n_heads: int, seq_len: int, causal: bool,
     segment ids match, fused into the same kernel (the TPU answer to the
     reference's varlen `flash_attn_unpadded` cu_seqlens path,
     `python/paddle/nn/functional/flash_attention.py:327`)."""
+    import os
     block = next(b for b in (1024, 512, 256, 128) if seq_len % b == 0)
+    # experiment override: "bq,bkv,bkvc,bqd,bkvd,bkvdc"
+    env = os.environ.get("PADDLE_TPU_SPLASH_BLOCKS", "")
     key = (n_heads, seq_len, causal, block, segmented, residual_ckpt,
-           _INTERPRET)
+           env, _INTERPRET)
     if key not in _SPLASH_CACHE:
         from jax.experimental.pallas.ops.tpu.splash_attention import (
             splash_attention_kernel as sk, splash_attention_mask as smask)
-        # fwd: largest tile (1024 at S>=1024); bwd dq-block 512 with full
-        # kv tiles — r5 sweep: 11.0 vs 12.5 ms/layer fwd+bwd at
-        # [32,16,1024,64] for (dkv 512/1024) vs uniform 1024
-        bqd = min(512, block)
+        # r5 in-model sweep at [32,16,1024,64] (tools/gpt_microbench.py):
+        # fwd q-block 512 with full kv tiles but kv_compute 512, bwd
+        # dq-block 512 / full kv — 836.5 vs 853.6 ms/step for the old
+        # uniform-1024 fwd config; uniform 512 and q=256 were worse
+        bq = min(512, block)
+        sizes = [bq, block, bq, bq, block, block]
+        if env:
+            sizes = [min(int(x), block) for x in env.split(",")]
         bs = sk.BlockSizes(
-            block_q=block, block_kv=block, block_kv_compute=block,
-            block_q_dkv=bqd, block_kv_dkv=block,
-            block_kv_dkv_compute=block,
+            block_q=sizes[0], block_kv=sizes[1], block_kv_compute=sizes[2],
+            block_q_dkv=sizes[3], block_kv_dkv=sizes[4],
+            block_kv_dkv_compute=sizes[5],
             use_fused_bwd_kernel=True)
         m = (smask.CausalMask((seq_len, seq_len)) if causal
              else smask.FullMask((seq_len, seq_len)))

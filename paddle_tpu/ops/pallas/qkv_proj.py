@@ -1,0 +1,132 @@
+"""Fused QKV projection Pallas kernel: [B,S,d] x [d,3d] -> 3x [B,H,S,hd].
+
+Why a kernel: producing attention-layout ([B, H, S, 64]) projections
+with plain einsums forces XLA into matmuls whose output N-tile is the
+64-wide head dim — half the 128 MXU lanes idle, trace-measured ~94 TF/s
+vs fc1's 193 TF/s on v5e (docs/gpt_perf_analysis.md round-5 profile).
+This kernel computes a head *pair* per MXU pass (N=128, full lanes) and
+splits the accumulator across the two heads' [S, 64] output blocks on
+store, so the matmul runs at full rate and only the (unavoidable,
+bandwidth-cheap) half-lane stores touch 64-wide tiles.
+
+Parity: the reference fuses qkv into one GEMM inside
+`paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1` (qkv
+weight [3, H, hd, d]); same capability, TPU-shaped.
+
+Backward is plain einsums (custom_vjp): the transposed contractions
+have K=H*hd=d and N=d — full-lane shapes XLA already emits at peak.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Set by tests: run the kernel in Pallas interpret mode on CPU.
+_INTERPRET = False
+
+
+def _kernel(x_ref, wq_ref, wk_ref, wv_ref, bq_ref, bk_ref, bv_ref,
+            q_ref, k_ref, v_ref):
+    # x_ref [bb, S, d]; w*_ref [d, 128] (one head pair); b*_ref [1, 128]
+    # q/k/v_ref [bb, 2, S, 64]
+    bb, S, d = x_ref.shape
+    x = x_ref[...].reshape(bb * S, d)
+    for w_ref, b_ref, o_ref in ((wq_ref, bq_ref, q_ref),
+                                (wk_ref, bk_ref, k_ref),
+                                (wv_ref, bv_ref, v_ref)):
+        acc = jax.lax.dot_general(
+            x, w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc = acc + b_ref[0].astype(jnp.float32)[None, :]
+        hd = o_ref.shape[-1]
+        out = acc.astype(o_ref.dtype).reshape(bb, S, 2 * hd)
+        o_ref[:, 0] = out[:, :, :hd]
+        o_ref[:, 1] = out[:, :, hd:]
+
+
+def _qkv_proj_fwd_impl(x, w_qkv, b_qkv, n_heads):
+    B, S, d = x.shape
+    th = w_qkv.shape[1] // 3   # local width of each q/k/v third (mp-aware)
+    hd = th // n_heads
+    hp = n_heads // 2          # head pairs
+    dt = x.dtype
+    wq, wk, wv = (w_qkv[:, :th], w_qkv[:, th:2 * th], w_qkv[:, 2 * th:])
+    bq, bk, bv = (b_qkv[:th].reshape(1, th), b_qkv[th:2 * th].reshape(1, th),
+                  b_qkv[2 * th:].reshape(1, th))
+    # block a few batches per program so the weight tiles stay
+    # VMEM-resident across the inner head-pair sweep (grid order: h
+    # fastest -> x block cached; bb>1 amortizes the w refetch over bb
+    # batches)
+    # scoped vmem is 16MB and pallas double-buffers every block: bb=1
+    # is the largest batch block that fits at S=1024, d=1024 (bb=2
+    # measured 20.35M scoped > 16M limit)
+    bb = next(b for b in (2, 1) if B % b == 0
+              and b * S * d * 2 <= 2 * 2 ** 20)
+    out_shape = jax.ShapeDtypeStruct((B, n_heads, S, hd), dt)
+    w_spec = pl.BlockSpec((d, 2 * hd), lambda b, h: (0, h))
+    b_spec = pl.BlockSpec((1, 2 * hd), lambda b, h: (0, h))
+    o_spec = pl.BlockSpec((bb, 2, S, hd), lambda b, h: (b, h, 0, 0))
+    return pl.pallas_call(
+        _kernel,
+        grid=(B // bb, hp),
+        in_specs=[pl.BlockSpec((bb, S, d), lambda b, h: (b, 0, 0)),
+                  w_spec, w_spec, w_spec, b_spec, b_spec, b_spec],
+        out_specs=[o_spec, o_spec, o_spec],
+        out_shape=[out_shape, out_shape, out_shape],
+        interpret=_INTERPRET,
+    )(x, wq, wk, wv, bq, bk, bv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def qkv_proj(x, w_qkv, b_qkv, n_heads):
+    """x [B,S,d], w_qkv [d,3d], b_qkv [3d] -> (q, k, v) each
+    [B, n_heads, S, d/n_heads]. TPU Pallas fast path; the caller is
+    responsible for gating on `qkv_proj_supported`."""
+    return _qkv_proj_fwd_impl(x, w_qkv, b_qkv, n_heads)
+
+
+def _fwd(x, w_qkv, b_qkv, n_heads):
+    return _qkv_proj_fwd_impl(x, w_qkv, b_qkv, n_heads), (x, w_qkv)
+
+
+def _bwd(n_heads, res, g):
+    x, w_qkv = res
+    B, S, d = x.shape
+    th = w_qkv.shape[1] // 3
+    hd = th // n_heads
+    # stay in [B,H,S,hd]: dgrad contracts over (h,e) (K=th, full rate)
+    # and wgrad's N-tile is d — both shapes XLA emits at peak; a
+    # BHSD->BSD transpose here would reintroduce the 8-10ms relayout
+    # copies the forward kernel exists to avoid (r5 trace)
+    dx = jnp.zeros(x.shape, jnp.float32)
+    dws, dbs = [], []
+    for i, gi in enumerate(g):
+        wi = jax.lax.dynamic_slice_in_dim(
+            w_qkv, i * th, th, axis=1).reshape(d, n_heads, hd)
+        dx = dx + jnp.einsum("bhse,dhe->bsd", gi, wi,
+                             preferred_element_type=jnp.float32)
+        dws.append(jnp.einsum("bsd,bhse->dhe", x, gi,
+                              preferred_element_type=jnp.float32)
+                   .reshape(d, th))
+        dbs.append(jnp.sum(gi.astype(jnp.float32),
+                           axis=(0, 2)).reshape(th))
+    dw = jnp.concatenate(dws, axis=1).astype(w_qkv.dtype)
+    db = jnp.concatenate(dbs).astype(w_qkv.dtype)
+    return dx.astype(x.dtype), dw, db
+
+
+qkv_proj.defvjp(_fwd, _bwd)
+
+
+def qkv_proj_supported(n_heads, seq_len, local_width) -> bool:
+    """Gate: TPU backend, paired heads, and the 64-wide head dim that
+    makes the einsum path half-lane (hd=128 einsums are already full
+    rate)."""
+    from .flash_attention import _on_tpu_backend
+    hd = local_width // max(n_heads, 1)
+    return (_on_tpu_backend() and n_heads % 2 == 0 and n_heads >= 2
+            and n_heads * hd == local_width and hd == 64
+            and seq_len % 8 == 0)

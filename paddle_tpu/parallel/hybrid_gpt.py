@@ -74,6 +74,19 @@ class GPTConfig:
     # lax.scan: trades compile time for removing the scan-backward's
     # stacked-gradient dynamic-update-slice traffic
     unroll_layers: bool = False
+    # carry activations through the layer scan as [B*S, d] instead of
+    # [B, S, d]: layout experiment against the residual-add
+    # carry-layout-conversion tax (docs/gpt_perf_analysis.md r5 profile)
+    carry_2d: bool = False
+    # fused Pallas qkv projection (head-pair N=128 MXU tiles): measured
+    # NEUTRAL in isolation (1.82 vs 1.85 ms/application) and slightly
+    # negative in-model (848 vs 837 ms/step) — the einsum path's trace
+    # attribution overstated its cost; kept opt-in for other shapes
+    qkv_kernel: bool = False
+    # materialize the fc2 output before the residual add: r5 traces show
+    # XLA fusing fc2+both-residual-adds into a conv-emitter fusion
+    # (EmitAllBatchInSublanes); measured neutral (851.8 vs 853.6)
+    ffn_barrier: bool = False
     # AMP-O2-style step: cast params to compute_dtype once up front and
     # differentiate wrt the bf16 copies — gradients (and the scan-bwd
     # stacked-grad DUS traffic) stay bf16; Adam still updates the f32
@@ -201,21 +214,28 @@ def _attention(x, w_qkv, b_qkv, w_o, b_o, cfg: GPTConfig):
     fused attention, which never materializes the [S,S] probs either.
     """
     from ..ops.pallas.flash_attention import splash_mha
+    from ..ops.pallas.qkv_proj import qkv_proj, qkv_proj_supported
     B, S, d = x.shape
     h_loc = cfg.n_heads // cfg.mp
     hd = cfg.d_model // cfg.n_heads
     cd = cfg.compute_dtype
-    # [B, H, S, Dh] straight out of three per-tensor projections
-    # ("bsd,dhe->bhse"): r5 traces show the old plain-matmul + transpose
-    # pattern no longer fuses (6x ~8-10ms relayout copies per step)
-    wq, wk, wv = jnp.split(w_qkv.astype(cd), 3, axis=-1)
-    bq, bk, bv = jnp.split(b_qkv.astype(cd), 3, axis=-1)
     xc = x.astype(cd)
+    if cfg.qkv_kernel and qkv_proj_supported(h_loc, S, h_loc * hd):
+        # fused Pallas projection: head-PAIR (N=128) MXU tiles — the
+        # direct-BHSD einsums below run at ~94 TF/s (half lanes) because
+        # each head's output N-tile is 64 wide (r5 trace)
+        q, k_, v = qkv_proj(xc, w_qkv.astype(cd), b_qkv.astype(cd), h_loc)
+    else:
+        # [B, H, S, Dh] straight out of three per-tensor projections
+        # ("bsd,dhe->bhse"): r5 traces show the old plain-matmul +
+        # transpose pattern no longer fuses (6x ~8-10ms relayout copies)
+        wq, wk, wv = jnp.split(w_qkv.astype(cd), 3, axis=-1)
+        bq, bk, bv = jnp.split(b_qkv.astype(cd), 3, axis=-1)
 
-    def proj(w, b):
-        out = jnp.einsum("bsd,dhe->bhse", xc, w.reshape(d, h_loc, hd))
-        return out + b.reshape(h_loc, 1, hd)
-    q, k_, v = proj(wq, bq), proj(wk, bk), proj(wv, bv)
+        def proj(w, b):
+            out = jnp.einsum("bsd,dhe->bhse", xc, w.reshape(d, h_loc, hd))
+            return out + b.reshape(h_loc, 1, hd)
+        q, k_, v = proj(wq, bq), proj(wk, bk), proj(wv, bv)
     ctx = splash_mha(q, k_, v, causal=True, scale=1.0 / math.sqrt(hd),
                      save_residuals_for_remat=(
                          cfg.remat_policy == "save_splash_residuals"))
@@ -349,12 +369,15 @@ def _block(x, lp, cfg: GPTConfig):
     # carry, folded into the next block's fused add+LN) measured 37.0k
     # vs 39.5k tok/s -- the doubled remat carry outweighs the saved
     # residual-add fusions. Keep the plain add.
+    if cfg.ffn_barrier:
+        ff = jax.lax.optimization_barrier(ff)
     x = x + (ff + bias).astype(x.dtype)
     return x, aux
 
 
 def _stage_forward(x, blocks_local, cfg: GPTConfig):
     """Run this pp rank's layers (scan over the stacked layer dim)."""
+    B, S_loc, d = x.shape
     if cfg.remat:
         # default: full per-block remat — recompute the whole block in
         # backward. (The plain dots-saveable policy keeps the [B,H,S,S]
@@ -387,6 +410,14 @@ def _stage_forward(x, blocks_local, cfg: GPTConfig):
             x, aux = block_fn(x, lp)
             aux_tot = aux_tot + aux
         return x, aux_tot
+
+    if cfg.carry_2d:
+        def body2(carry, lp):
+            y, aux = block_fn(carry.reshape(B, S_loc, d), lp)
+            return y.reshape(B * S_loc, d), aux
+        x2, auxs = jax.lax.scan(body2, x.reshape(B * S_loc, d),
+                                blocks_local)
+        return x2.reshape(B, S_loc, d), jnp.sum(auxs)
 
     def body(carry, lp):
         y, aux = block_fn(carry, lp)

@@ -1,0 +1,74 @@
+"""Fused Pallas QKV projection kernel vs einsum oracle (interpret mode).
+
+The kernel computes head-PAIR (N=128) MXU tiles and lane-splits on
+store; these tests pin its numerics (fwd + custom-vjp backward) against
+the plain per-head einsum formulation it replaces.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops.pallas.qkv_proj as qp
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    qp._INTERPRET = True
+    yield
+    qp._INTERPRET = False
+
+
+def _oracle(x, w, b, H):
+    d3 = w.shape[1]
+    th = d3 // 3
+    hd = th // H
+    outs = []
+    for i in range(3):
+        wi = w[:, i * th:(i + 1) * th].reshape(-1, H, hd)
+        bi = b[i * th:(i + 1) * th].reshape(H, 1, hd)
+        outs.append(jnp.einsum("bsd,dhe->bhse", x, wi) + bi)
+    return tuple(outs)
+
+
+def test_qkv_proj_forward_matches_einsum():
+    rng = np.random.RandomState(0)
+    B, S, d, H = 2, 64, 256, 4
+    x = jnp.asarray(rng.randn(B, S, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, 3 * d) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.randn(3 * d) * 0.05, jnp.float32)
+    q, k, v = qp.qkv_proj(x, w, b, H)
+    rq, rk, rv = _oracle(x, w, b, H)
+    assert q.shape == (B, H, S, d // H)
+    np.testing.assert_allclose(q, rq, atol=1e-4)
+    np.testing.assert_allclose(k, rk, atol=1e-4)
+    np.testing.assert_allclose(v, rv, atol=1e-4)
+
+
+def test_qkv_proj_grads_match_einsum():
+    rng = np.random.RandomState(1)
+    B, S, d, H = 2, 32, 128, 2
+    x = jnp.asarray(rng.randn(B, S, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, 3 * d) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.randn(3 * d) * 0.05, jnp.float32)
+
+    def loss(f):
+        def inner(x, w, b):
+            q, k, v = f(x, w, b)
+            return jnp.sum(jnp.sin(q) + 2.0 * jnp.cos(k) + v ** 2)
+        return inner
+
+    g1 = jax.grad(loss(lambda *a: qp.qkv_proj(*a, H)),
+                  argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(loss(lambda *a: _oracle(*a, H)),
+                  argnums=(0, 1, 2))(x, w, b)
+    for a, r, name in zip(g1, g2, "xwb"):
+        np.testing.assert_allclose(a, r, atol=2e-4, err_msg=f"d{name}")
+
+
+def test_qkv_proj_supported_gate():
+    # CPU backend (no _INTERPRET bypass inside supported-check): the
+    # gate itself is static logic
+    assert not qp.qkv_proj_supported(3, 128, 3 * 64)     # odd heads
+    assert not qp.qkv_proj_supported(4, 128, 4 * 128)    # hd 128: einsum fine
