@@ -47,7 +47,7 @@ def bench_gpt(on_tpu):
                         micro_batches=1, remat=True, zero_stage=0,
                         # r5 levers (docs/gpt_perf_analysis.md): keep the
                         # splash kernel's (out, lse) residuals across the
-                        # block remat, fused bf16 CE (chunked x2 for the
+                        # block remat, fused bf16 CE (chunked x4 for the
                         # freed logits memory), bf16 grads w/ f32 master
                         remat_policy="save_splash_residuals",
                         fused_ce=True, ce_seq_chunks=4, bf16_grads=True,

@@ -86,7 +86,13 @@ def _splash_kernel(n_heads: int, seq_len: int, causal: bool,
         bq = min(512, block)
         sizes = [bq, block, bq, bq, block, block]
         if env:
-            sizes = [min(int(x), block) for x in env.split(",")]
+            parts = env.split(",")
+            if len(parts) != 6:
+                raise ValueError(
+                    "PADDLE_TPU_SPLASH_BLOCKS wants 6 comma-separated "
+                    "ints: bq,bkv,bkv_compute,bq_dkv,bkv_dkv,"
+                    f"bkv_dkv_compute (got {env!r})")
+            sizes = [min(int(x), block) for x in parts]
         bs = sk.BlockSizes(
             block_q=sizes[0], block_kv=sizes[1], block_kv_compute=sizes[2],
             block_q_dkv=sizes[3], block_kv_dkv=sizes[4],

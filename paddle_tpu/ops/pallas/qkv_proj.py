@@ -62,9 +62,10 @@ def _qkv_proj_fwd_impl(x, w_qkv, b_qkv, n_heads):
     # batches)
     # scoped vmem is 16MB and pallas double-buffers every block: bb=1
     # is the largest batch block that fits at S=1024, d=1024 (bb=2
-    # measured 20.35M scoped > 16M limit)
-    bb = next(b for b in (2, 1) if B % b == 0
-              and b * S * d * 2 <= 2 * 2 ** 20)
+    # measured 20.35M scoped > 16M limit); fall back to bb=1 for
+    # larger S*d (the supported() gate bounds the bb=1 block size)
+    bb = next((b for b in (2, 1) if B % b == 0
+               and b * S * d * 2 <= 2 * 2 ** 20), 1)
     out_shape = jax.ShapeDtypeStruct((B, n_heads, S, hd), dt)
     w_spec = pl.BlockSpec((d, 2 * hd), lambda b, h: (0, h))
     b_spec = pl.BlockSpec((1, 2 * hd), lambda b, h: (0, h))
@@ -121,12 +122,16 @@ def _bwd(n_heads, res, g):
 qkv_proj.defvjp(_fwd, _bwd)
 
 
-def qkv_proj_supported(n_heads, seq_len, local_width) -> bool:
-    """Gate: TPU backend, paired heads, and the 64-wide head dim that
-    makes the einsum path half-lane (hd=128 einsums are already full
-    rate)."""
+def qkv_proj_supported(n_heads, seq_len, local_width,
+                       x_width=None) -> bool:
+    """Gate: TPU backend, paired heads, the 64-wide head dim that makes
+    the einsum path half-lane (hd=128 einsums are already full rate),
+    and a bb=1 x-block that fits scoped vmem with double buffering
+    (sized for the bf16 compute path: 2 bytes/element)."""
     from .flash_attention import _on_tpu_backend
     hd = local_width // max(n_heads, 1)
+    xw = x_width if x_width is not None else local_width
     return (_on_tpu_backend() and n_heads % 2 == 0 and n_heads >= 2
             and n_heads * hd == local_width and hd == 64
-            and seq_len % 8 == 0)
+            and seq_len % 8 == 0
+            and seq_len * xw * 2 <= 4 * 2 ** 20)

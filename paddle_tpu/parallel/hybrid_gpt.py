@@ -74,19 +74,13 @@ class GPTConfig:
     # lax.scan: trades compile time for removing the scan-backward's
     # stacked-gradient dynamic-update-slice traffic
     unroll_layers: bool = False
-    # carry activations through the layer scan as [B*S, d] instead of
-    # [B, S, d]: layout experiment against the residual-add
-    # carry-layout-conversion tax (docs/gpt_perf_analysis.md r5 profile)
-    carry_2d: bool = False
     # fused Pallas qkv projection (head-pair N=128 MXU tiles): measured
     # NEUTRAL in isolation (1.82 vs 1.85 ms/application) and slightly
     # negative in-model (848 vs 837 ms/step) — the einsum path's trace
     # attribution overstated its cost; kept opt-in for other shapes
+    # (carry_2d / ffn_barrier experiment knobs from the same pass were
+    # measured no-change and removed: docs/gpt_perf_analysis.md)
     qkv_kernel: bool = False
-    # materialize the fc2 output before the residual add: r5 traces show
-    # XLA fusing fc2+both-residual-adds into a conv-emitter fusion
-    # (EmitAllBatchInSublanes); measured neutral (851.8 vs 853.6)
-    ffn_barrier: bool = False
     # AMP-O2-style step: cast params to compute_dtype once up front and
     # differentiate wrt the bf16 copies — gradients (and the scan-bwd
     # stacked-grad DUS traffic) stay bf16; Adam still updates the f32
@@ -220,7 +214,7 @@ def _attention(x, w_qkv, b_qkv, w_o, b_o, cfg: GPTConfig):
     hd = cfg.d_model // cfg.n_heads
     cd = cfg.compute_dtype
     xc = x.astype(cd)
-    if cfg.qkv_kernel and qkv_proj_supported(h_loc, S, h_loc * hd):
+    if cfg.qkv_kernel and qkv_proj_supported(h_loc, S, h_loc * hd, d):
         # fused Pallas projection: head-PAIR (N=128) MXU tiles — the
         # direct-BHSD einsums below run at ~94 TF/s (half lanes) because
         # each head's output N-tile is 64 wide (r5 trace)
@@ -369,15 +363,12 @@ def _block(x, lp, cfg: GPTConfig):
     # carry, folded into the next block's fused add+LN) measured 37.0k
     # vs 39.5k tok/s -- the doubled remat carry outweighs the saved
     # residual-add fusions. Keep the plain add.
-    if cfg.ffn_barrier:
-        ff = jax.lax.optimization_barrier(ff)
     x = x + (ff + bias).astype(x.dtype)
     return x, aux
 
 
 def _stage_forward(x, blocks_local, cfg: GPTConfig):
     """Run this pp rank's layers (scan over the stacked layer dim)."""
-    B, S_loc, d = x.shape
     if cfg.remat:
         # default: full per-block remat — recompute the whole block in
         # backward. (The plain dots-saveable policy keeps the [B,H,S,S]
@@ -410,14 +401,6 @@ def _stage_forward(x, blocks_local, cfg: GPTConfig):
             x, aux = block_fn(x, lp)
             aux_tot = aux_tot + aux
         return x, aux_tot
-
-    if cfg.carry_2d:
-        def body2(carry, lp):
-            y, aux = block_fn(carry.reshape(B, S_loc, d), lp)
-            return y.reshape(B * S_loc, d), aux
-        x2, auxs = jax.lax.scan(body2, x.reshape(B * S_loc, d),
-                                blocks_local)
-        return x2.reshape(B, S_loc, d), jnp.sum(auxs)
 
     def body(carry, lp):
         y, aux = block_fn(carry, lp)

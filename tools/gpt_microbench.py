@@ -21,6 +21,7 @@ def main():
 
     overrides = {}
     iters = 10
+    _string_keys = ("trace", "remat_policy")
     for arg in sys.argv[1:]:
         k, v = arg.split("=", 1)
         if k == "iters":
@@ -29,8 +30,12 @@ def main():
         try:
             v = int(v)
         except ValueError:
-            if v in ("True", "False"):
-                v = v == "True"
+            if v.lower() in ("true", "false"):
+                v = v.lower() == "true"
+            elif k not in _string_keys:
+                raise SystemExit(
+                    f"{k}={v}: expected int or true/false "
+                    f"(string values only for {_string_keys})")
         overrides[k] = v
 
     trace_dir = overrides.pop("trace", None)
