@@ -44,6 +44,74 @@ def _is_float(dtype) -> bool:
     )
 
 
+# ---- memoized jitted backward -------------------------------------------
+#
+# The deferred `jax.vjp` trace costs ~1.4 ms per node (the dominant
+# eager-training overhead, docs/eager_dispatch_analysis.md). Training
+# loops replay the same (op, shapes) every step, so the linearized
+# backward is memoized as a JITTED function keyed on the op's code
+# object + scalar closure constants + input/cotangent avals + the
+# flags/amp snapshot. Steps 2+ skip tracing entirely and dispatch a
+# compiled executable. Ops whose closures capture non-scalar state
+# (arrays, objects) safely fall back to the per-node trace.
+
+_VJP_JIT_CACHE = {}
+_VJP_JIT_CACHE_MAX = 1024
+
+
+def _scalar_const(v):
+    """Hashable fingerprint for a closure constant, or raise TypeError."""
+    if v is None or isinstance(v, (int, float, bool, str, bytes)):
+        return v
+    if isinstance(v, (tuple, frozenset)):
+        return tuple(_scalar_const(x) for x in v)
+    if isinstance(v, jnp.dtype) or (isinstance(v, type)
+                                    and issubclass(v, jnp.generic)):
+        return str(v)
+    if callable(v):
+        fp = _fn_fingerprint(v)
+        if fp is not None:
+            return fp
+    raise TypeError
+
+
+def _fn_fingerprint(fn):
+    """Hashable identity of fn's code + captured constants, or None when
+    the closure holds anything we can't safely key on."""
+    try:
+        if isinstance(fn, functools.partial):
+            sub = _fn_fingerprint(fn.func)
+            if sub is None:
+                return None
+            # args and kwargs tagged separately: partial(f, ('axis', 0))
+            # must not alias partial(f, axis=0)
+            return ("partial", sub, _scalar_const(tuple(fn.args)),
+                    _scalar_const(tuple(sorted(fn.keywords.items()))))
+        if getattr(fn, "__self__", None) is not None:
+            # bound method: __code__/__closure__ proxy the underlying
+            # function and would alias instances with different state
+            return None
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return None
+        # the code object itself (hashable) — id() could be reused
+        # after GC and alias two different ops to one cache entry
+        parts = [code]
+        for cell in fn.__closure__ or ():
+            parts.append(_scalar_const(cell.cell_contents))
+        for d in fn.__defaults__ or ():
+            parts.append(_scalar_const(d))
+        return ("fn", tuple(parts))
+    except (TypeError, ValueError):
+        return None
+
+
+def _aval_sig(tree):
+    return tuple(
+        (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x))))
+        for x in jax.tree.leaves(tree))
+
+
 class _LazyVjp:
     """Deferred vjp: the eager forward runs fn directly (one jax eager
     dispatch, ~50us) and the `jax.vjp` LINEARIZATION — measured ~1.4 ms
@@ -72,22 +140,54 @@ class _LazyVjp:
         self._amp = dict(_amp_state)
 
     def __call__(self, ct):
+        if self._vjp is None and self.fn is not None:
+            fp = _fn_fingerprint(self.fn)
+            if fp is not None:
+                key = (fp, _aval_sig(self.arrays), _aval_sig(ct),
+                       tuple(sorted(self._flags.items())),
+                       tuple(sorted(self._amp.items())))
+                try:
+                    jitted = _VJP_JIT_CACHE.get(key)
+                except TypeError:      # unhashable flag/amp value
+                    jitted = key = None
+                if key is not None:
+                    if jitted is None:
+                        if len(_VJP_JIT_CACHE) >= _VJP_JIT_CACHE_MAX:
+                            _VJP_JIT_CACHE.clear()
+                        fn = self.fn
+                        jitted = jax.jit(
+                            lambda arrays, ct:
+                            jax.vjp(fn, *arrays)[1](ct))
+                        _VJP_JIT_CACHE[key] = jitted
+                    # keep a reusable vjp (retain_graph contract): the
+                    # closure holds the arrays the jitted call replays
+                    arrays = self.arrays
+                    self._vjp = lambda c: self._with_snapshot(
+                        jitted, arrays, c)
+                    self.fn = self.arrays = None
+                    return self._vjp(ct)
         if self._vjp is None:
-            from .. import flags as _flags
-            from ..amp.auto_cast import _state as _amp_state
-            cur_flags = dict(_flags._FLAGS)
-            cur_amp = dict(_amp_state)
-            _flags._FLAGS.update(self._flags)
-            _amp_state.update(self._amp)
-            try:
-                _, self._vjp = jax.vjp(self.fn, *self.arrays)
-            finally:
-                _flags._FLAGS.clear()
-                _flags._FLAGS.update(cur_flags)
-                _amp_state.clear()
-                _amp_state.update(cur_amp)
+            _, self._vjp = self._with_snapshot(jax.vjp, self.fn,
+                                               *self.arrays)
             self.fn = self.arrays = None  # free after tracing
         return self._vjp(ct)
+
+    def _with_snapshot(self, f, *args):
+        """Run f under the record-time flags/amp snapshot (tracing must
+        see the state the forward saw; cheap dict swaps otherwise)."""
+        from .. import flags as _flags
+        from ..amp.auto_cast import _state as _amp_state
+        cur_flags = dict(_flags._FLAGS)
+        cur_amp = dict(_amp_state)
+        _flags._FLAGS.update(self._flags)
+        _amp_state.update(self._amp)
+        try:
+            return f(*args)
+        finally:
+            _flags._FLAGS.clear()
+            _flags._FLAGS.update(cur_flags)
+            _amp_state.clear()
+            _amp_state.update(cur_amp)
 
 
 def apply(name, fn, inputs, differentiable=True):

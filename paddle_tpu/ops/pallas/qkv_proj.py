@@ -90,11 +90,12 @@ def qkv_proj(x, w_qkv, b_qkv, n_heads):
 
 
 def _fwd(x, w_qkv, b_qkv, n_heads):
-    return _qkv_proj_fwd_impl(x, w_qkv, b_qkv, n_heads), (x, w_qkv)
+    return (_qkv_proj_fwd_impl(x, w_qkv, b_qkv, n_heads),
+            (x, w_qkv, b_qkv))
 
 
 def _bwd(n_heads, res, g):
-    x, w_qkv = res
+    x, w_qkv, b_qkv = res
     B, S, d = x.shape
     th = w_qkv.shape[1] // 3
     hd = th // n_heads
@@ -115,7 +116,7 @@ def _bwd(n_heads, res, g):
         dbs.append(jnp.sum(gi.astype(jnp.float32),
                            axis=(0, 2)).reshape(th))
     dw = jnp.concatenate(dws, axis=1).astype(w_qkv.dtype)
-    db = jnp.concatenate(dbs).astype(w_qkv.dtype)
+    db = jnp.concatenate(dbs).astype(b_qkv.dtype)
     return dx.astype(x.dtype), dw, db
 
 

@@ -3,6 +3,8 @@ the reference's OpTest.check_grad strategy (SURVEY.md §4)."""
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 import paddle_tpu as paddle
 
 
@@ -197,3 +199,100 @@ def test_lazy_vjp_snapshots_flags_and_amp():
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
     np.testing.assert_allclose(x.grad.numpy(), 3.0)
+
+
+def test_vjp_jit_cache_isolates_closure_constants():
+    """The memoized jitted backward must key on closure constants: two
+    ops sharing one code object but different captured axis values may
+    not alias to one cache entry (would silently produce wrong grads)."""
+    from paddle_tpu.core import dispatch
+
+    def run(axis):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        x.stop_gradient = False
+        y = dispatch.apply(
+            "sum_axis", lambda a: jnp.sum(a, axis=axis), (x,))
+        y.sum().backward()
+        return x.grad.numpy()
+
+    n0 = len(dispatch._VJP_JIT_CACHE)
+    g_ax0 = run(0)
+    g_ax1 = run(1)
+    np.testing.assert_allclose(g_ax0, np.ones((3, 4)))
+    np.testing.assert_allclose(g_ax1, np.ones((3, 4)))
+    # both backward passes were cacheable and got distinct entries
+    assert len(dispatch._VJP_JIT_CACHE) >= n0 + 2
+    # replay with the same axis: grads identical and no new entries
+    n1 = len(dispatch._VJP_JIT_CACHE)
+    np.testing.assert_allclose(run(0), g_ax0)
+    assert len(dispatch._VJP_JIT_CACHE) == n1
+
+
+def test_vjp_jit_cache_fallback_on_array_closure():
+    """Ops capturing arrays in their closure are not fingerprintable and
+    must fall back to the per-node trace (still-correct grads)."""
+    from paddle_tpu.core import dispatch
+
+    c = jnp.asarray(np.array([1.0, 2.0, 3.0], np.float32))
+    assert dispatch._fn_fingerprint(lambda a: a * c) is None
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    x.stop_gradient = False
+    y = dispatch.apply("mul_const", lambda a: a * c, (x,))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_vjp_jit_cache_retain_graph():
+    """retain_graph backward must be replayable through the jitted-cache
+    path (review r5: the fast path used to free fn/arrays without
+    storing a reusable vjp)."""
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.stop_gradient = False
+    from paddle_tpu.core import dispatch
+    y = dispatch.apply("sum_ax0", lambda a: jnp.sum(a, axis=0), (x,))
+    loss = y.sum()
+    loss.backward(retain_graph=True)
+    g1 = x.grad.numpy().copy()
+    x.clear_grad()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), g1)
+
+
+def test_vjp_jit_cache_rejects_bound_methods():
+    """Bound methods proxy __code__/__closure__ of the class function:
+    two instances with different state must not share a cache entry."""
+    from paddle_tpu.core import dispatch
+
+    class Op:
+        def __init__(self, axis):
+            self.axis = axis
+
+        def f(self, a):
+            return jnp.sum(a, axis=self.axis)
+
+    assert dispatch._fn_fingerprint(Op(0).f) is None
+
+    def run(axis):
+        x = paddle.to_tensor(np.arange(12, np.float32).reshape(3, 4)
+                             if False else
+                             np.arange(12, dtype=np.float32).reshape(3, 4))
+        x.stop_gradient = False
+        y = dispatch.apply("method_sum", Op(axis).f, (x,))
+        y.sum().backward()
+        return x.grad.numpy()
+
+    np.testing.assert_allclose(run(0), np.ones((3, 4)))
+    np.testing.assert_allclose(run(1), np.ones((3, 4)))
+
+
+def test_vjp_jit_cache_partial_args_vs_kwargs():
+    """partial(f, ('axis', 0)) must not alias partial(f, axis=0)."""
+    import functools
+    from paddle_tpu.core import dispatch
+
+    def f(a, axis=None):
+        return jnp.sum(a, axis=axis)
+
+    fp_pos = dispatch._fn_fingerprint(functools.partial(f, ("axis", 0)))
+    fp_kw = dispatch._fn_fingerprint(functools.partial(f, axis=0))
+    assert fp_pos != fp_kw
