@@ -14,14 +14,20 @@ below, which dispatch per call —
     into one XLA program instead of falling back to eager.
 
 Supported subset (transformed): `if`/`elif`/`else` whose branches only
-assign; `while`; `for i in range(...)`; `break`/`continue` anywhere in a
-loop body, possibly nested in `if`s (flag rewriting: the loop condition
-folds in `not break_flag`, statements after a potential break/continue
-are guarded — break_continue_transformer.py parity); `return` inside
-branches (single-exit rewriting by else-hoisting into a result var —
-return_transformer.py parity). Still python (eager fallback): `return`
-inside loops, partially-returning nested branches, try/with, non-range
-`for`.
+assign; `while`; `for i in range(...)` AND non-range `for x in seq`
+(indexed rewrite over `_jst.seq_len`; tensors iterate dim-0 slices
+under trace); `break`/`continue` anywhere in a loop body, possibly
+nested in `if`s (flag rewriting: the loop condition folds in `not
+break_flag`, statements after a potential break/continue are guarded —
+break_continue_transformer.py parity); `return` inside branches
+(single-exit rewriting by else-hoisting into a result var —
+return_transformer.py parity) and inside loops (shared flag + break +
+guarded return); control flow nested inside `with`/`try` bodies (the
+context/handler stays python — trace-time semantics — while the inner
+`if`/`for`/`while` lower to lax; tested). Still python (eager
+fallback): `return` statements physically inside a `with`/`try` block
+when code follows the block, and partially-returning nested branches
+past the else-hoisting size budget.
 
 Like `lax.cond` (and the reference's trace-both-branches behavior),
 Python side effects in both branches of a TRACED `if` execute at trace

@@ -3,6 +3,8 @@ whole-model comparisons)."""
 import numpy as np
 import pytest
 
+import jax
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 
@@ -635,3 +637,63 @@ def test_non_range_for_with_break():
     tf = dy2static.transform_function(f)
     x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
     assert float(np.asarray(tf(x))) == float(np.asarray(f(x)))
+
+
+def test_if_inside_with_block_traces():
+    """Control flow nested in a `with` body must still lower to lax
+    (the context manager itself runs at trace time)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import dy2static as d
+
+    def f(x):
+        with paddle.no_grad():
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                y = x - 1
+        return y
+
+    nf = d.transform_function(f)
+    assert nf is not f
+    jf = jax.jit(lambda a: nf(paddle.to_tensor(a))._data)
+    np.testing.assert_allclose(jf(np.ones((3,), np.float32)), 2.0)
+    np.testing.assert_allclose(jf(-np.ones((3,), np.float32)), -2.0)
+
+
+def test_for_with_break_inside_with_traces():
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import dy2static as d
+
+    def f(x):
+        with paddle.no_grad():
+            for _ in range(5):
+                if (x.sum() > 100):
+                    break
+                x = x + 1
+        return x
+
+    nf = d.transform_function(f)
+    assert nf is not f
+    jf = jax.jit(lambda a: nf(paddle.to_tensor(a))._data)
+    np.testing.assert_allclose(jf(np.ones((3,), np.float32)), 6.0)
+    # break fires immediately for a large input
+    np.testing.assert_allclose(jf(np.full((3,), 50.0, np.float32)), 50.0)
+
+
+def test_if_after_try_block_traces():
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import dy2static as d
+
+    def f(x):
+        try:
+            y = x * 3
+        except ValueError:     # trace-time exception semantics
+            y = x
+        if (y.sum() > 0):
+            y = y + 1
+        return y
+
+    nf = d.transform_function(f)
+    assert nf is not f
+    jf = jax.jit(lambda a: nf(paddle.to_tensor(a))._data)
+    np.testing.assert_allclose(jf(np.ones((3,), np.float32)), 4.0)
