@@ -8,7 +8,8 @@ from .table import (MemorySparseTable, MemoryDenseTable,  # noqa: F401
 from .embedding import SparseEmbedding  # noqa: F401
 from .runtime import get_ps_runtime, PSRuntime  # noqa: F401
 from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
-from .trainer import HogwildTrainer  # noqa: F401
+from .trainer import (HogwildTrainer, MultiTrainer,  # noqa: F401
+                      DistMultiTrainer)
 from .pass_cache import PassCache, PassCacheEmbedding  # noqa: F401
 from .graph import GraphTable  # noqa: F401
 from .pipeline import PullPushPipeline  # noqa: F401

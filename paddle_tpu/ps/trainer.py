@@ -1,24 +1,138 @@
-"""Multi-threaded PS training loop.
+"""Multi-threaded PS training loops — the trainer/DeviceWorker family.
 
-Parity: `exe.train_from_dataset` (`python/paddle/fluid/executor.py:2582` →
-`DistMultiTrainer` + `HogwildWorker::TrainFiles`
-(`framework/hogwild_worker.cc:223`)): N worker threads consume batches
-from the native Dataset channels, pull sparse embeddings, run the model,
-push gradients — Hogwild-style (lock-free on the shard-parallel native
-tables). Compiled steps release the GIL during XLA execution, so threads
-overlap host pull/push with device compute.
+Parity: `exe.train_from_dataset` (`python/paddle/fluid/executor.py:2582`)
+dispatching over the trainer hierarchy (`framework/trainer.h:59-341`):
+
+- `HogwildTrainer` — `MultiTrainer`+`HogwildWorker::TrainFiles`
+  (`framework/hogwild_worker.cc:223`): N threads share the model state,
+  lock-free on the shard-parallel native tables.
+- `MultiTrainer` — the thread-LOCAL-replica semantics of the reference's
+  local `MultiTrainer` (`trainer.h:105`, `MergeToRootScope`): each
+  worker trains its own dense-param copy; Finalize merges the replicas
+  back into the root params by mean.
+- `DistMultiTrainer` — `trainer.h:141`: Hogwild workers plus an
+  `AsyncCommunicator` lifecycle (start before training, flush barrier
+  per epoch, stop at finalize — `communicator.py` a_sync parity).
+
+All of them share the TrainerBase dump machinery
+(`trainer.h:88 dump_fields_path/DumpWork`): when `set_dump()` is
+configured, every worker appends instance lines to `part-<tid>` under
+the dump path — the reference's CTR feature-dump debugging flow.
+
+Compiled steps release the GIL during XLA execution, so threads overlap
+host pull/push with device compute.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 from .table import InMemoryDataset
 
 
-class HogwildTrainer:
+class TrainerBase:
+    """Dump-env plumbing shared by every trainer (`trainer.h:88`)."""
+
+    def __init__(self):
+        self._dump_path = None
+        self._dump_fields = None
+        self._dump_param = None
+        self._dump_files = {}
+        self._dump_lock = threading.Lock()
+
+    def set_dump(self, path, fields=True, param=None):
+        """Enable per-worker instance dumping (`dump_fields_path`).
+        `fields`: True dumps batch inputs; or a callable
+        (keys, labels, loss) -> str line. `param`: optional callable
+        () -> str appended once per epoch per worker."""
+        self._dump_path = path
+        self._dump_fields = fields
+        self._dump_param = param
+        os.makedirs(path, exist_ok=True)
+
+    def _dump_file(self, tid):
+        f = self._dump_files.get(tid)
+        if f is None:
+            f = open(os.path.join(self._dump_path, f"part-{tid}"), "a")
+            self._dump_files[tid] = f
+        return f
+
+    def _dump_batch(self, tid, keys, labels, loss):
+        if self._dump_path is None:
+            return
+        if callable(self._dump_fields):
+            line = self._dump_fields(keys, labels, loss)
+        else:
+            ks = " ".join(str(int(k)) for k in
+                          getattr(keys, "flat", keys))
+            ls = " ".join(str(float(v)) for v in
+                          getattr(labels, "flat", labels))
+            line = f"keys:{ks}\tlabels:{ls}\tloss:{float(loss):.6f}"
+        self._dump_file(tid).write(line + "\n")
+
+    def _dump_param_line(self, tid):
+        if self._dump_path is not None and self._dump_param is not None:
+            self._dump_file(tid).write(self._dump_param() + "\n")
+
+    def finalize_dump(self):
+        with self._dump_lock:
+            for f in self._dump_files.values():
+                f.close()
+            self._dump_files.clear()
+
+    # shared epoch scaffolding: shuffle/rewind, locked iterator fetch,
+    # N worker threads running a per-tid step over shared batches, dump
+    # lines, first-error propagation. finalize_dump always runs (error
+    # included) so the dump the user is debugging WITH is never left
+    # truncated in open buffers.
+    def _run_epochs(self, dataset, make_tid_step, epochs, shuffle,
+                    end_epoch=None):
+        try:
+            for epoch in range(epochs):
+                shuffle(epoch)
+                it = iter(dataset)
+                it_lock = threading.Lock()
+                errors = []
+
+                def worker(tid):
+                    step_fn = make_tid_step(tid)
+                    while True:
+                        with it_lock:
+                            batch = next(it, None)
+                        if batch is None:
+                            return
+                        try:
+                            loss = step_fn(*batch)
+                            with self.metrics_lock:
+                                self.losses.append(float(loss))
+                            self._dump_batch(tid, batch[0], batch[-1],
+                                             loss)
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(e)
+                            return
+
+                threads = [threading.Thread(target=worker, args=(tid,))
+                           for tid in range(self.num_threads)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                for tid in range(self.num_threads):
+                    self._dump_param_line(tid)
+                if end_epoch is not None:
+                    end_epoch(epoch)
+        finally:
+            self.finalize_dump()
+        return self.losses
+
+
+class HogwildTrainer(TrainerBase):
     """train_from_dataset(dataset, step_fn, num_threads)."""
 
     def __init__(self, num_threads=4):
+        super().__init__()
         self.num_threads = num_threads
         self.metrics_lock = threading.Lock()
         self.losses = []
@@ -27,38 +141,83 @@ class HogwildTrainer:
                            epochs=1, shuffle_seed=None):
         """step_fn(keys, labels) -> float loss. Called concurrently from
         worker threads; the PS tables underneath are shard-locked."""
-        for epoch in range(epochs):
+        def shuffle(epoch):
             if shuffle_seed is not None:
                 dataset.global_shuffle(seed=shuffle_seed + epoch)
             else:
                 dataset.rewind()
-            it = iter(dataset)
-            it_lock = threading.Lock()
-            errors = []
 
-            def fetch():
-                with it_lock:
-                    return next(it, None)
+        return self._run_epochs(dataset, lambda tid: step_fn, epochs,
+                                shuffle)
 
-            def worker():
-                while True:
-                    batch = fetch()
-                    if batch is None:
-                        return
-                    try:
-                        loss = step_fn(*batch)
-                        with self.metrics_lock:
-                            self.losses.append(float(loss))
-                    except Exception as e:  # noqa: BLE001
-                        errors.append(e)
-                        return
 
-            threads = [threading.Thread(target=worker)
-                       for _ in range(self.num_threads)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            if errors:
-                raise errors[0]
+class MultiTrainer(TrainerBase):
+    """Thread-local-replica trainer (`trainer.h:105 MultiTrainer` +
+    `MergeToRootScope`): every worker thread trains its OWN copy of the
+    dense params; after each epoch the replicas are merged back into
+    the root params by mean. Sparse state stays shared in the PS tables
+    (exactly the reference's split: dense in thread scopes, sparse in
+    the table service)."""
+
+    def __init__(self, num_threads=4):
+        super().__init__()
+        self.num_threads = num_threads
+        self.metrics_lock = threading.Lock()
+        self.losses = []
+
+    def train_from_dataset(self, dataset: InMemoryDataset, make_step,
+                           params, epochs=1, shuffle_seed=None):
+        """`params`: dict name -> np.ndarray (the root dense scope).
+        `make_step(local_params) -> step_fn(keys, labels) -> loss`
+        builds a worker closure over its REPLICA dict (same keys,
+        copies of the arrays, mutated in place by the step)."""
+        import numpy as np
+        replicas = []
+
+        def shuffle(epoch):
+            if shuffle_seed is not None:
+                dataset.local_shuffle(seed=shuffle_seed + epoch)
+            else:
+                dataset.rewind()
+            replicas[:] = [{k: np.array(v, copy=True)
+                            for k, v in params.items()}
+                           for _ in range(self.num_threads)]
+
+        def merge(epoch):
+            # MergeToRootScope: mean of the replicas into the root
+            for k in params:
+                params[k][...] = np.mean([r[k] for r in replicas],
+                                         axis=0)
+
+        return self._run_epochs(
+            dataset, lambda tid: make_step(replicas[tid]), epochs,
+            shuffle, end_epoch=merge)
+
+
+class DistMultiTrainer(HogwildTrainer):
+    """`trainer.h:141 DistMultiTrainer`: Hogwild workers plus the
+    AsyncCommunicator lifecycle — start() before training, a flush
+    barrier after every epoch (so merged sparse grads reach the
+    service), stop() at finalize."""
+
+    def __init__(self, num_threads=4, communicator=None):
+        super().__init__(num_threads)
+        self.communicator = communicator
+
+    def train_from_dataset(self, dataset, step_fn, epochs=1,
+                           shuffle_seed=None):
+        comm = self.communicator
+        if comm is not None:
+            comm.start()
+        try:
+            for epoch in range(epochs):
+                super().train_from_dataset(dataset, step_fn, epochs=1,
+                                           shuffle_seed=None
+                                           if shuffle_seed is None
+                                           else shuffle_seed + epoch)
+                if comm is not None:
+                    comm.flush()
+        finally:
+            if comm is not None:
+                comm.stop()
         return self.losses
