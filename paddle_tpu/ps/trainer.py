@@ -31,9 +31,12 @@ from .table import InMemoryDataset
 
 
 class TrainerBase:
-    """Dump-env plumbing shared by every trainer (`trainer.h:88`)."""
+    """Dump-env plumbing + shared epoch scaffolding (`trainer.h:59`)."""
 
-    def __init__(self):
+    def __init__(self, num_threads=4):
+        self.num_threads = num_threads
+        self.metrics_lock = threading.Lock()
+        self.losses = []
         self._dump_path = None
         self._dump_fields = None
         self._dump_param = None
@@ -44,18 +47,25 @@ class TrainerBase:
         """Enable per-worker instance dumping (`dump_fields_path`).
         `fields`: True dumps batch inputs; or a callable
         (keys, labels, loss) -> str line. `param`: optional callable
-        () -> str appended once per epoch per worker."""
+        () -> str appended once per epoch per worker. Existing part
+        files under `path` are removed — a re-run must not interleave
+        stale lines into the dump being debugged with."""
         self._dump_path = path
         self._dump_fields = fields
         self._dump_param = param
         os.makedirs(path, exist_ok=True)
+        for name in os.listdir(path):
+            if name.startswith("part-"):
+                os.unlink(os.path.join(path, name))
 
     def _dump_file(self, tid):
-        f = self._dump_files.get(tid)
-        if f is None:
-            f = open(os.path.join(self._dump_path, f"part-{tid}"), "a")
-            self._dump_files[tid] = f
-        return f
+        with self._dump_lock:
+            f = self._dump_files.get(tid)
+            if f is None:
+                f = open(os.path.join(self._dump_path, f"part-{tid}"),
+                         "a")
+                self._dump_files[tid] = f
+            return f
 
     def _dump_batch(self, tid, keys, labels, loss):
         if self._dump_path is None:
@@ -131,14 +141,9 @@ class TrainerBase:
 class HogwildTrainer(TrainerBase):
     """train_from_dataset(dataset, step_fn, num_threads)."""
 
-    def __init__(self, num_threads=4):
-        super().__init__()
-        self.num_threads = num_threads
-        self.metrics_lock = threading.Lock()
-        self.losses = []
-
     def train_from_dataset(self, dataset: InMemoryDataset, step_fn,
-                           epochs=1, shuffle_seed=None):
+                           epochs=1, shuffle_seed=None,
+                           end_epoch=None):
         """step_fn(keys, labels) -> float loss. Called concurrently from
         worker threads; the PS tables underneath are shard-locked."""
         def shuffle(epoch):
@@ -148,7 +153,7 @@ class HogwildTrainer(TrainerBase):
                 dataset.rewind()
 
         return self._run_epochs(dataset, lambda tid: step_fn, epochs,
-                                shuffle)
+                                shuffle, end_epoch=end_epoch)
 
 
 class MultiTrainer(TrainerBase):
@@ -158,12 +163,6 @@ class MultiTrainer(TrainerBase):
     the root params by mean. Sparse state stays shared in the PS tables
     (exactly the reference's split: dense in thread scopes, sparse in
     the table service)."""
-
-    def __init__(self, num_threads=4):
-        super().__init__()
-        self.num_threads = num_threads
-        self.metrics_lock = threading.Lock()
-        self.losses = []
 
     def train_from_dataset(self, dataset: InMemoryDataset, make_step,
                            params, epochs=1, shuffle_seed=None):
@@ -207,17 +206,13 @@ class DistMultiTrainer(HogwildTrainer):
     def train_from_dataset(self, dataset, step_fn, epochs=1,
                            shuffle_seed=None):
         comm = self.communicator
-        if comm is not None:
-            comm.start()
+        if comm is None:
+            return super().train_from_dataset(dataset, step_fn, epochs,
+                                              shuffle_seed)
+        comm.start()
         try:
-            for epoch in range(epochs):
-                super().train_from_dataset(dataset, step_fn, epochs=1,
-                                           shuffle_seed=None
-                                           if shuffle_seed is None
-                                           else shuffle_seed + epoch)
-                if comm is not None:
-                    comm.flush()
+            return super().train_from_dataset(
+                dataset, step_fn, epochs, shuffle_seed,
+                end_epoch=lambda epoch: comm.flush())
         finally:
-            if comm is not None:
-                comm.stop()
-        return self.losses
+            comm.stop()

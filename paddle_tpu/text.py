@@ -109,3 +109,146 @@ class UCIHousing(Dataset):
 
     def __len__(self):
         return len(self.x)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram LM dataset stand-in (`text/datasets/imikolov.py`):
+    samples are `N`-tuples of word ids — (n-1 context words, target) in
+    'NGRAM' mode, (src seq, trg seq) pairs in 'SEQ' mode."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 min_word_freq=50):
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        n = 2000 if mode == "train" else 400
+        vocab = 2074  # the real PTB cutoff-50 vocab size
+        self.data_type = data_type.upper()
+        if self.data_type == "NGRAM":
+            self.data = [tuple(rng.randint(0, vocab, (window_size,))
+                               .astype(np.int64))
+                         for _ in range(n)]
+        elif self.data_type == "SEQ":
+            self.data = [(rng.randint(0, vocab, (window_size,))
+                          .astype(np.int64),
+                          rng.randint(0, vocab, (window_size,))
+                          .astype(np.int64)) for _ in range(n)]
+        else:
+            raise ValueError("data_type must be NGRAM or SEQ")
+
+    def __getitem__(self, idx):
+        d = self.data[idx]
+        return tuple(np.array(x) for x in d)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """ml-1m stand-in (`text/datasets/movielens.py`): each sample is
+    (user_id, gender, age, job, movie_id, category_ids, title_ids,
+    rating) as int/float arrays — the reference's tuple-of-arrays
+    contract."""
+
+    def __init__(self, mode="train", test_ratio=0.1, rand_seed=0):
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train"
+                                                 else 1))
+        n = 2000 if mode == "train" else 200
+        self.data = []
+        for _ in range(n):
+            self.data.append((
+                np.array([rng.randint(1, 6041)], np.int64),   # user id
+                np.array([rng.randint(0, 2)], np.int64),      # gender
+                np.array([rng.randint(0, 7)], np.int64),      # age bucket
+                np.array([rng.randint(0, 21)], np.int64),     # job
+                np.array([rng.randint(1, 3953)], np.int64),   # movie id
+                rng.randint(0, 18, (rng.randint(1, 4),))
+                .astype(np.int64),                            # categories
+                rng.randint(1, 5175, (rng.randint(1, 9),))
+                .astype(np.int64),                            # title words
+                np.array([float(rng.randint(1, 6))], np.float32)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL stand-in (`text/datasets/conll05.py`): sample =
+    (pred_idx, mark, word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+    label) — the 9-field tuple the reference emits per instance."""
+
+    WORD_DICT = 44068
+    PRED_DICT = 3162
+    LABEL_DICT = 59
+
+    def __init__(self, mode="train"):
+        rng = np.random.RandomState(6 if mode == "train" else 7)
+        n = 1000 if mode == "train" else 200
+        self.data = []
+        for _ in range(n):
+            T = rng.randint(5, 40)
+            word = rng.randint(0, self.WORD_DICT, (T,)).astype(np.int64)
+            ctxs = [np.roll(word, s) for s in (2, 1, 0, -1, -2)]
+            self.data.append((
+                np.array([rng.randint(0, self.PRED_DICT)], np.int64),
+                (rng.rand(T) < 0.1).astype(np.int64),   # predicate mark
+                word, *[c.astype(np.int64) for c in ctxs],
+                rng.randint(0, self.LABEL_DICT, (T,)).astype(np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMT(Dataset):
+    """Seq2seq (src_ids, trg_ids, trg_ids_next) triples with <s>/<e>
+    framing — `text/datasets/wmt14.py` / `wmt16.py` contract."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, mode, dict_size, seed):
+        rng = np.random.RandomState(seed)
+        n = 1000 if mode == "train" else 200
+        self.dict_size = dict_size
+        self.data = []
+        for _ in range(n):
+            ls, lt = rng.randint(3, 30), rng.randint(3, 30)
+            src = rng.randint(3, dict_size, (ls,)).astype(np.int64)
+            trg = rng.randint(3, dict_size, (lt,)).astype(np.int64)
+            trg_in = np.concatenate([[self.BOS], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [self.EOS]]).astype(np.int64)
+            self.data.append((src, trg_in, trg_next))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMT):
+    def __init__(self, mode="train", dict_size=30000):
+        super().__init__(mode, dict_size, 8 if mode == "train" else 9)
+
+
+class WMT16(_WMT):
+    def __init__(self, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        super().__init__(mode, max(src_dict_size, trg_dict_size),
+                         10 if mode == "train" else 11)
+
+
+class ViterbiDecoder:
+    """`paddle.text.ViterbiDecoder` layer: holds the transition matrix
+    and decodes (potentials, lengths) -> (scores, paths)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = as_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -581,6 +581,15 @@ def test_hogwild_dump_fields(tmp_path):
         lines += p.read_text().strip().splitlines()
     assert len(lines) == 2  # 128 rows / batch 64
     assert all("keys:" in ln and "loss:0.5" in ln for ln in lines)
+    # a re-run with the same dump path must truncate, not interleave
+    tr2 = HogwildTrainer(num_threads=2)
+    tr2.set_dump(str(dump_dir))
+    tr2.train_from_dataset(ds, lambda keys, labels: 0.25, epochs=1)
+    lines2 = []
+    for p in dump_dir.iterdir():
+        lines2 += p.read_text().strip().splitlines()
+    assert len(lines2) == 2
+    assert all("loss:0.25" in ln for ln in lines2)
 
 
 def test_dist_multi_trainer_flushes_communicator(tmp_path):

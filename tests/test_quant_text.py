@@ -177,3 +177,46 @@ def test_faster_tokenizer():
     # "deeper" -> deep ##er ; "learning" -> learn ##ing ; "wat" -> UNK
     np.testing.assert_array_equal(ids.numpy()[1], [2, 6, 7, 8, 9, 1, 3])
     np.testing.assert_array_equal(lens.numpy(), [4, 7])
+
+
+def test_text_dataset_family_shapes():
+    """The 7-dataset paddle.text surface: every dataset yields the
+    reference's tuple-of-arrays contract and feeds a DataLoader."""
+    from paddle_tpu import text
+
+    ng = text.Imikolov(mode="train", data_type="NGRAM", window_size=5)
+    assert len(ng[0]) == 5 and all(np.asarray(x).dtype == np.int64
+                                   for x in ng[0])
+    sq = text.Imikolov(mode="test", data_type="SEQ")
+    assert len(sq[0]) == 2
+
+    ml = text.Movielens(mode="train")
+    s = ml[0]
+    assert len(s) == 8
+    assert s[-1].dtype == np.float32          # rating
+    assert s[5].ndim == 1 and s[6].ndim == 1  # categories/title varlen
+
+    srl = text.Conll05st(mode="test")
+    t = srl[0]
+    assert len(t) == 9
+    T = len(t[2])
+    assert all(len(x) == T for x in t[1:])    # aligned seq fields
+
+    for cls in (text.WMT14, text.WMT16):
+        src, trg_in, trg_next = cls(mode="train")[0]
+        assert trg_in[0] == 0                 # <s>
+        assert trg_next[-1] == 1              # <e>
+        np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+
+
+def test_viterbi_decoder_layer_matches_fn():
+    from paddle_tpu import text
+    rng = np.random.RandomState(0)
+    pot = rng.randn(2, 6, 4).astype(np.float32)
+    trans = rng.randn(6, 6).astype(np.float32)
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=True)
+    s1, p1 = dec(paddle.to_tensor(pot))
+    s2, p2 = text.viterbi_decode(paddle.to_tensor(pot),
+                                 paddle.to_tensor(trans))
+    np.testing.assert_allclose(s1.numpy(), s2.numpy())
+    np.testing.assert_array_equal(p1.numpy(), p2.numpy())
