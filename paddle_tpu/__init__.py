@@ -56,6 +56,10 @@ from . import strings  # noqa
 from . import utils  # noqa
 from . import audio  # noqa
 from . import geometric  # noqa
+from . import signal  # noqa
+from . import version  # noqa
+from .hapi import callbacks  # noqa — paddle.callbacks
+from .hapi.dynamic_flops import flops  # noqa — paddle.flops
 from .flags import set_flags, get_flags  # noqa
 from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa
                       ClipGradByGlobalNorm)

@@ -227,3 +227,120 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
                     "steps": steps, "verbose": verbose,
                     "metrics": metrics or []})
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """`hapi/callbacks.py ReduceLROnPlateau` parity: scale the LR by
+    `factor` when `monitor` stops improving for `patience` epochs."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.better = lambda a, b: a > b + self.min_delta
+            self.best = -np.inf
+        else:
+            self.better = lambda a, b: a < b - self.min_delta
+            self.best = np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _get_value(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0] if v else None
+        return v
+
+    def on_eval_end(self, logs=None):
+        self._maybe_reduce(self._get_value(logs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        # train-metric monitoring when there is no eval loop
+        if self.monitor in (logs or {}):
+            self._maybe_reduce(self._get_value(logs))
+
+    def _maybe_reduce(self, value):
+        if value is None:
+            return
+        if self.better(value, self.best):
+            self.best = value
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            # in cooldown: no waiting, no reductions
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait < self.patience:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        from ..optimizer.lr import LRScheduler as Sched
+        lr = opt._learning_rate
+        if isinstance(lr, Sched):
+            new = max(float(lr.last_lr) * self.factor, self.min_lr)
+            lr.base_lr = new
+            lr.last_lr = new
+        else:
+            new = max(float(lr) * self.factor, self.min_lr)
+            opt._learning_rate = new
+        if self.verbose:
+            print(f"ReduceLROnPlateau: lr -> {new:.3e}")
+        self.wait = 0
+        self.cooldown_counter = self.cooldown
+
+
+class WandbCallback(Callback):
+    """`hapi/callbacks.py WandbCallback` parity: logs train/eval scalars
+    to Weights & Biases. Requires the `wandb` package (same contract as
+    the reference: ModuleNotFoundError at construction without it)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ModuleNotFoundError(
+                "WandbCallback requires `pip install wandb`") from e
+        self.wandb = wandb
+        self.run = None
+        self._kwargs = dict(project=project, entity=entity, name=name,
+                            dir=dir, mode=mode, job_type=job_type,
+                            **kwargs)
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        if self.run is None:
+            self.run = self.wandb.init(
+                **{k: v for k, v in self._kwargs.items()
+                   if v is not None})
+
+    def _log(self, prefix, logs):
+        payload = {f"{prefix}/{k}": float(np.asarray(v).reshape(-1)[0])
+                   for k, v in (logs or {}).items()
+                   if not isinstance(v, str)}
+        if payload and self.run is not None:
+            self.run.log(payload, step=self._step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self.run is not None:
+            self.run.finish()
+            self.run = None

@@ -1,0 +1,77 @@
+"""`paddle.flops` parity (`python/paddle/hapi/dynamic_flops.py`):
+per-layer FLOP/param counting by a shape-capturing forward pass."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _count(layer, in_shape, out_shape):
+    """FLOPs for one leaf layer given captured shapes (2*MAC where a MAC
+    convention exists — the reference counts multiply-adds as 2)."""
+    import paddle_tpu.nn as nn
+    n_out = int(np.prod(out_shape))
+    if isinstance(layer, nn.Linear):
+        return 2 * n_out * layer.weight.shape[0]
+    if layer.__class__.__name__.startswith("Conv"):
+        w = layer.weight.shape          # [out_c, in_c/groups, *k]
+        k = int(np.prod(w[1:]))
+        return 2 * n_out * k
+    if layer.__class__.__name__ in ("BatchNorm1D", "BatchNorm2D",
+                                    "BatchNorm3D", "LayerNorm",
+                                    "GroupNorm", "InstanceNorm2D"):
+        return 2 * n_out
+    if layer.__class__.__name__ in ("ReLU", "GELU", "Sigmoid", "Tanh",
+                                    "Softmax", "LeakyReLU", "ReLU6",
+                                    "Hardswish", "Hardsigmoid", "SiLU"):
+        return n_out
+    if "Pool" in layer.__class__.__name__:
+        return n_out
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Run one forward on zeros of `input_size`, hook every leaf layer,
+    and report total FLOPs (also returns it). `custom_ops`: dict
+    layer_class -> fn(layer, in_shape, out_shape) -> flops."""
+    import paddle_tpu as paddle
+
+    rows = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(layer):
+        def hook(lyr, inputs, output):
+            in_shape = tuple(inputs[0].shape) if inputs else ()
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            out_shape = tuple(out.shape)
+            fn = custom_ops.get(type(lyr))
+            f = (fn(lyr, in_shape, out_shape) if fn
+                 else _count(lyr, in_shape, out_shape))
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr.parameters(include_sublayers=False))
+            rows.append((type(lyr).__name__, in_shape, out_shape,
+                         n_params, f))
+        return hook
+
+    for lyr in net.sublayers(include_self=True):
+        if not list(lyr.children()):            # leaves only
+            hooks.append(lyr.register_forward_post_hook(make_hook(lyr)))
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.zeros(list(input_size), dtype="float32")
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    total = sum(r[4] for r in rows)
+    total_params = sum(r[3] for r in rows)
+    if print_detail:
+        print(f"{'Layer':<20}{'Input':<22}{'Output':<22}"
+              f"{'Params':>10}{'FLOPs':>14}")
+        for name, i, o, p, f in rows:
+            print(f"{name:<20}{str(i):<22}{str(o):<22}{p:>10}{f:>14}")
+        print(f"Total params: {total_params}  Total FLOPs: {total}")
+    return total

@@ -7,6 +7,7 @@ from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from ..ops.extras3 import identity_loss  # noqa: F401
 from .optimizer import ModelAverage  # noqa: F401
 

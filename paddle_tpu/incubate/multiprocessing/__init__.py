@@ -1,0 +1,61 @@
+"""`paddle.incubate.multiprocessing` parity
+(`python/paddle/incubate/multiprocessing/__init__.py`): tensor-aware
+multiprocessing with shared-memory transport.
+
+TPU-native form: device arrays are host-fetched once and shipped via
+`multiprocessing.shared_memory` (the same transport the multiprocess
+DataLoader workers use, `io/__init__.py`); a reductions registry makes
+`paddle.Tensor` picklable across processes.
+"""
+from __future__ import annotations
+
+import multiprocessing
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import shared_memory
+
+import numpy as np
+
+
+def _rebuild_tensor(shm_name, shape, dtype):
+    # consumer owns the segment: copy out, then unlink (the io/
+    # DataLoader shm transport's ownership-transfer pattern) — without
+    # this every pickled tensor leaks a /dev/shm segment
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
+    finally:
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    from ...core.tensor import Tensor
+    return Tensor(arr)
+
+
+def _reduce_tensor(t):
+    arr = np.asarray(t._data)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    # ownership transfers to the consumer (which unlinks after copy-out)
+    # — unregister from THIS process's resource tracker, or the producer
+    # exiting first would unlink the segment out from under the consumer
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)[...] = arr
+    name = shm.name
+    shm.close()
+    return _rebuild_tensor, (name, arr.shape, arr.dtype.str)
+
+
+def init_reductions():
+    """Register the shared-memory pickler for paddle Tensors (the
+    reference calls this at import in its multiprocessing module)."""
+    from multiprocessing import reduction
+    from ...core.tensor import Tensor
+    reduction.ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()

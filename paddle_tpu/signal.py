@@ -1,0 +1,156 @@
+"""paddle.signal parity: stft / istft.
+
+Reference: `python/paddle/signal.py` (`stft` :134, `istft` :301) over the
+`frame`/`overlap_add` + fft kernels (`paddle/phi/kernels/stft_kernel.h`).
+TPU-native: framing is a gather, the FFT is XLA's, overlap-add is a
+scatter-add — all differentiable and jit-compatible.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dispatch
+from .ops._helpers import as_tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames along the time axis. axis=-1 (default):
+    [..., T] -> [..., frame_length, n_frames]; axis=0:
+    [T, ...] -> [n_frames, frame_length, ...] (the reference's two
+    layouts)."""
+    x = as_tensor(x)
+
+    def _fn(a):
+        if axis == 0:
+            a = jnp.moveaxis(a, 0, -1)                    # time last
+        T = a.shape[-1]
+        n = 1 + (T - frame_length) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])       # [n, L]
+        f = a[..., idx]                                   # [..., n, L]
+        if axis == 0:
+            # [..., n, L] -> [n, L, ...]
+            f = jnp.moveaxis(f, (-2, -1), (0, 1))
+            return f
+        return jnp.swapaxes(f, -1, -2)                    # [..., L, n]
+    return dispatch.apply("frame", _fn, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of `frame`: [..., L, n_frames] -> [..., T] via
+    scatter-add with hop_length."""
+    x = as_tensor(x)
+
+    def _fn(a):
+        if axis == 0:
+            # [n, L, ...] -> [..., L, n]
+            a = jnp.moveaxis(a, (0, 1), (-1, -2))
+        L, n = a.shape[-2], a.shape[-1]
+        T = L + hop_length * (n - 1)
+        frames = jnp.swapaxes(a, -1, -2)                  # [..., n, L]
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(L)[None, :])                  # [n, L]
+        out = jnp.zeros(a.shape[:-2] + (T,), a.dtype)
+        out = out.at[..., idx].add(frames)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return dispatch.apply("overlap_add", _fn, (x,))
+
+
+def _resolve_window(window, win_length, dtype=jnp.float32):
+    if window is None:
+        return jnp.ones((win_length,), dtype)
+    w = np.asarray(getattr(window, "_data", window))
+    if w.shape[-1] != win_length:
+        raise ValueError(
+            f"window length {w.shape[-1]} != win_length {win_length}")
+    return jnp.asarray(w, dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """x [B, T] (or [T]) real (complex supported when onesided=False).
+    Returns complex [B, n_fft//2+1 (or n_fft), n_frames] — the
+    reference's layout."""
+    x = as_tensor(x)
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    win = _resolve_window(window, wl)
+    if onesided and jnp.iscomplexobj(x._data):
+        raise ValueError(
+            "stft: onesided=True is undefined for complex input "
+            "(the reference raises too); pass onesided=False")
+
+    def _fn(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        w = win
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2,) * 2],
+                        mode=pad_mode)
+        T = a.shape[-1]
+        n = 1 + (T - n_fft) // hop
+        idx = (jnp.arange(n)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])
+        frames = a[..., idx] * w[None, None, :]           # [B, n, n_fft]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)                  # [B, bins, n]
+        return out[0] if squeeze else out
+    return dispatch.apply("stft", _fn, (x,))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse stft with window-envelope-normalized overlap-add
+    (the reference's NOLA reconstruction)."""
+    x = as_tensor(x)
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    win = _resolve_window(window, wl)
+
+    def _fn(spec):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        w = win
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        sp = jnp.swapaxes(spec, -1, -2)                   # [B, n, bins]
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(sp, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(sp, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[None, None, :]
+        n = frames.shape[-2]
+        T = n_fft + hop * (n - 1)
+        idx = (jnp.arange(n)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])
+        out = jnp.zeros(frames.shape[:-2] + (T,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        env = jnp.zeros((T,), jnp.float32).at[
+            idx.reshape(-1)].add(jnp.tile(w * w, (n,)))
+        out = out / jnp.maximum(env, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: T - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out[0] if squeeze else out
+    return dispatch.apply("istft", _fn, (x,))

@@ -1,1 +1,12 @@
 from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    """`paddle.utils.try_import` parity."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"Failed to import {module_name}. "
+                          f"Install it first.") from e

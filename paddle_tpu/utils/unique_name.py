@@ -1,0 +1,48 @@
+"""`paddle.utils.unique_name` parity
+(`python/paddle/utils/unique_name.py` over fluid's UniqueNameGenerator):
+process-wide name uniquifier with guard/switch scoping."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self.ids = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, key):
+        with self._lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return "_".join([self.prefix + key, str(n)]) if self.prefix \
+            else f"{key}_{n}"
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the global generator; returns the old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh generator (names restart inside the guard)."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,30 @@
+"""`paddle.version` parity (`python/paddle/version.py`, generated at
+build time in the reference). TPU build: static metadata + the live jax
+backend versions."""
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+istaged = False
+
+cuda_version = "False"      # reference prints 'False' on non-CUDA builds
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print(f"commit: {commit}")
+    import jax
+    print(f"jax: {jax.__version__}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

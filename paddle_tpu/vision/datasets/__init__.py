@@ -116,3 +116,88 @@ class FakeImageNet(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class DatasetFolder(Dataset):
+    """`paddle.vision.datasets.DatasetFolder`: class-per-subdirectory
+    sample tree (`python/paddle/vision/datasets/folder.py`). `loader`
+    defaults to a numpy image reader (PIL if importable, else raw
+    `np.load`/byte-shape heuristics kept simple)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.loader = loader or self._default_loader
+        self.samples = [
+            (p, self.class_to_idx[c]) for c in classes
+            for p in self._scan(os.path.join(root, c), extensions,
+                                is_valid_file)]
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError(
+                "DatasetFolder default loader needs PIL for image "
+                "files; pass loader= or use .npy samples") from e
+
+    @staticmethod
+    def _scan(root, extensions, is_valid_file):
+        import os
+        exts = tuple(e.lower() for e in (
+            extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")))
+        for dirpath, _, names in sorted(os.walk(root)):
+            for n in sorted(names):
+                p = os.path.join(dirpath, n)
+                ok = (is_valid_file(p) if is_valid_file
+                      else n.lower().endswith(exts))
+                if ok:
+                    yield p
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([target], np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """`paddle.vision.datasets.ImageFolder`: flat/recursive image list
+    WITHOUT labels (samples are just images)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        self.samples = list(DatasetFolder._scan(root, extensions,
+                                                is_valid_file))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)

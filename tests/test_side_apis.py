@@ -251,3 +251,165 @@ def test_asp_excluded_layers():
         assert asp.check_sparsity(net[1].weight.numpy())
     finally:
         asp.reset_excluded_layers()
+
+
+# ---------------------------------------------------------------- signal
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 512).astype(np.float32)
+    win = paddle.audio.functional.get_window("hann", 128)
+    S = paddle.signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                           window=win)
+    assert list(S.shape) == [2, 65, 17]
+    xr = paddle.signal.istft(S, n_fft=128, hop_length=32, window=win,
+                             length=512)
+    np.testing.assert_allclose(xr.numpy(), x, atol=1e-4)
+
+
+def test_stft_matches_naive_dft():
+    rng = np.random.RandomState(1)
+    x = rng.randn(256).astype(np.float32)
+    S = paddle.signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=64,
+                           center=False).numpy()   # [33, 4]
+    # frame 0 is x[:64] windowed by ones
+    ref = np.fft.rfft(x[:64])
+    np.testing.assert_allclose(S[:, 0], ref, atol=1e-3)
+
+
+def test_frame_overlap_add_inverse():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 100).astype(np.float32)
+    f = paddle.signal.frame(paddle.to_tensor(x), 20, 20)  # no overlap
+    assert list(f.shape) == [3, 20, 5]
+    back = paddle.signal.overlap_add(f, 20)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+
+def test_stft_differentiable():
+    x = paddle.to_tensor(np.random.RandomState(3)
+                         .randn(1, 256).astype(np.float32))
+    x.stop_gradient = False
+    S = paddle.signal.stft(x, n_fft=64, hop_length=32)
+    loss = (S.abs() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+# ----------------------------------------------------------- flops/misc
+
+
+def test_flops_lenet():
+    from paddle_tpu.vision.models import LeNet
+    f = paddle.flops(LeNet(), [1, 1, 28, 28])
+    assert 5e5 < f < 5e6
+
+
+def test_unique_name_guard():
+    un = paddle.utils.unique_name
+    a = un.generate("w")
+    with un.guard():
+        assert un.generate("w") == "w_0"
+    b = un.generate("w")
+    assert int(b.split("_")[-1]) == int(a.split("_")[-1]) + 1
+
+
+def test_dataset_folder(tmp_path):
+    import numpy as np
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.full((4, 4), float(i), np.float32))
+    ds = paddle.vision.datasets.DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, lab = ds[0]
+    assert img.shape == (4, 4) and lab.shape == (1,)
+    flat = paddle.vision.datasets.ImageFolder(str(tmp_path))
+    assert len(flat) == 6 and flat[2][0].shape == (4, 4)
+
+
+def test_reduce_lr_on_plateau():
+    import paddle_tpu.nn as nn
+    net = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, verbose=0)
+
+    class FakeModel:
+        _optimizer = opt
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"loss": 1.0})
+    cb.on_eval_end({"loss": 1.0})   # wait 1 -> reduce
+    assert abs(float(opt._learning_rate) - 0.05) < 1e-9
+
+
+def test_incubate_multiprocessing_tensor_pickle():
+    from multiprocessing.reduction import ForkingPickler
+    import pickle
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    blob = bytes(ForkingPickler.dumps(t))
+    t2 = pickle.loads(blob)
+    np.testing.assert_allclose(t2.numpy(), t.numpy())
+
+
+def test_distributed_fused_lamb_trains():
+    import paddle_tpu.nn as nn
+    net = nn.Linear(4, 2)
+    opt = paddle.incubate.optimizer.DistributedFusedLamb(
+        learning_rate=0.05, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(8, 4).astype(np.float32))
+    first = None
+    for _ in range(5):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    assert float(loss.numpy()) < first
+
+
+def test_frame_axis0_and_cooldown_and_complex_guard():
+    # frame axis=0 on [T, C] input: reference layout [n, L, C]
+    rng = np.random.RandomState(4)
+    x = rng.randn(100, 2).astype(np.float32)
+    f = paddle.signal.frame(paddle.to_tensor(x), 20, 20, axis=0)
+    assert list(f.shape) == [5, 20, 2]
+    back = paddle.signal.overlap_add(f, 20, axis=0)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+    # complex input + onesided must raise (reference contract)
+    z = paddle.to_tensor((x[:64, 0] + 1j * x[:64, 1]).astype(np.complex64))
+    with pytest.raises(ValueError, match="onesided"):
+        paddle.signal.stft(z, n_fft=32)
+
+    # cooldown suppresses reductions
+    import paddle_tpu.nn as nn
+    net = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, cooldown=3,
+                                            verbose=0)
+
+    class FakeModel:
+        _optimizer = opt
+    cb.set_model(FakeModel())
+    for _ in range(5):
+        cb.on_eval_end({"loss": 1.0})
+    # exactly one reduction at epoch 2; epochs 3-5 are cooldown —
+    # without the cooldown guard the LR would have halved every epoch
+    assert abs(float(opt._learning_rate) - 0.05) < 1e-9
+
+
+def test_fused_lamb_deepcopy():
+    import copy
+    import paddle_tpu.nn as nn
+    net = nn.Linear(2, 1)
+    opt = paddle.incubate.optimizer.DistributedFusedLamb(
+        parameters=net.parameters())
+    copy.deepcopy(opt)  # must not raise KeyError
