@@ -20,7 +20,14 @@ def _rebuild_tensor(shm_name, shape, dtype):
     # consumer owns the segment: copy out, then unlink (the io/
     # DataLoader shm transport's ownership-transfer pattern) — without
     # this every pickled tensor leaks a /dev/shm segment
-    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        shm = shared_memory.SharedMemory(name=shm_name)
+    except FileNotFoundError:
+        raise RuntimeError(
+            "paddle Tensor shm blob already consumed: each serialized "
+            "tensor is single-use (ownership transfers to the first "
+            "loader, which unlinks the segment); re-pickle for every "
+            "consumer") from None
     try:
         arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
     finally:

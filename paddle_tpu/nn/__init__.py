@@ -52,6 +52,8 @@ from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
+from . import utils  # noqa: F401 — paddle.nn.utils
+from . import quant  # noqa: F401 — paddle.nn.quant
 
 import sys as _sys
 # paddle code imports `paddle.nn.functional as F`

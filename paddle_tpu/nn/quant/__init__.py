@@ -1,0 +1,34 @@
+"""`paddle.nn.quant` parity (`python/paddle/nn/quant/`): quantization
+layers + the weight-only linear functional surface. The engines live in
+`paddle_tpu.quantization` (QAT/PTQ with fake-quant + STE) and the fused
+int8 serving stack (`incubate/nn/fused_transformer.py`); this package
+exposes them under the reference's nn.quant names."""
+from ...quantization import (  # noqa: F401
+    fake_quant, abs_max_scale, QuantedLinear, QuantConfig,
+    weight_quantize, weight_only_linear,
+)
+
+# reference quant_layers naming
+QuantizedLinear = QuantedLinear
+
+
+class Stub:
+    """`nn/quant/stub.py` parity: a placeholder layer the quantization
+    passes replace with observers/quanters; identity until then."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def __call__(self, x):
+        return x
+
+    forward = __call__
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """`quantized_linear.py llm_int8_linear` parity (same positional
+    signature): weight-only int8 matmul + bias (the outlier-threshold
+    decomposition is unnecessary on the MXU path — dequant fuses into
+    the bf16 dot)."""
+    return weight_only_linear(x, weight, weight_scale, bias=bias)

@@ -101,3 +101,87 @@ def remove_weight_norm(layer, name="weight"):
     layer._parameters.pop(name + "_g", None)
     layer._parameters.pop(name + "_v", None)
     return layer
+
+
+class _SpectralNormWrapper:
+    """Power-iteration pre-hook (`nn/utils/spectral_norm_hook.py:140`):
+    weight = weight_orig / sigma(weight_orig), sigma estimated by
+    n_power_iterations of u/v updates per forward (u persisted as a
+    buffer, updated without gradient — the reference semantics)."""
+
+    def __init__(self, layer, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        w = getattr(layer, name)
+        arr = w.numpy()
+        if dim is None:
+            cls = layer.__class__.__name__
+            # Linear/Embedding store weight [in, out]; transposed convs
+            # store [in_c, out_c/groups, *k] — the OUT axis is 1 for
+            # both (reference spectral_norm_hook default)
+            dim = 1 if (cls in ("Linear", "Embedding")
+                        or "Transpose" in cls) else 0
+        self.dim = dim
+        layer.add_parameter(name + "_orig", Parameter(arr))
+        rng = np.random.RandomState(0)
+        u = rng.randn(arr.shape[dim]).astype(arr.dtype)
+        layer.register_buffer(name + "_u",
+                              ops.to_tensor(u / np.linalg.norm(u)))
+        layer._parameters.pop(name, None)
+
+    def _mat(self, arr):
+        if self.dim != 0:
+            perm = [self.dim] + [i for i in range(arr.ndim)
+                                 if i != self.dim]
+            arr = np.transpose(np.asarray(arr), perm)
+        return np.asarray(arr).reshape(arr.shape[0], -1)
+
+    def __call__(self, layer, inputs):
+        w_orig = getattr(layer, self.name + "_orig")
+        u = np.asarray(getattr(layer, self.name + "_u")._data)
+        wm = self._mat(w_orig._data)         # numpy: no grad through
+        v = None                             # the power iteration
+        for _ in range(self.n):
+            v = wm.T @ u
+            v = v / (np.linalg.norm(v) + self.eps)
+            u = wm @ v
+            u = u / (np.linalg.norm(u) + self.eps)
+        layer._buffers[self.name + "_u"] = ops.to_tensor(u)
+        # sigma as a differentiable function of w_orig: u^T W v
+        ut = ops.to_tensor(u.astype(np.float32))
+        vt = ops.to_tensor(v.astype(np.float32))
+        worm = ops.reshape(
+            ops.transpose(w_orig, [self.dim] + [
+                i for i in range(w_orig.ndim) if i != self.dim])
+            if self.dim != 0 else w_orig, [wm.shape[0], -1])
+        sigma = ops.sum(ut * ops.squeeze(
+            ops.matmul(worm, ops.unsqueeze(vt, -1)), -1))
+        layer.__dict__[self.name] = w_orig / sigma
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization (`spectral_norm_hook.py:140`)."""
+    hook = _SpectralNormWrapper(layer, name, n_power_iterations, eps,
+                                dim)
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def remove_spectral_norm(layer, name="weight"):
+    """Re-materialize the CURRENT normalized weight as the plain
+    parameter (post-removal forwards must match the trained behavior),
+    then strip the hook/orig/u state."""
+    for hid, hook in list(layer._forward_pre_hooks.items()):
+        if isinstance(hook, _SpectralNormWrapper) and hook.name == name:
+            hook(layer, ())          # refresh layer.__dict__[name]
+            layer._forward_pre_hooks.pop(hid)
+    w = layer.__dict__.pop(name, None)
+    layer._parameters.pop(name + "_orig", None)
+    if w is not None:
+        layer.add_parameter(name, Parameter(w.numpy()))
+    layer._buffers.pop(name + "_u", None)
+    return layer

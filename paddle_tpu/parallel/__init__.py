@@ -9,8 +9,12 @@ from .env import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, all_reduce, all_gather, broadcast, reduce,
-    scatter, all_to_all, send, recv, wait,
+    scatter, all_to_all, wait,
 )
+from .comm_extras import (  # noqa: F401
+    all_gather_object, reduce_scatter, isend, irecv, send, recv, stream,
+)
+from . import moe_utils as utils  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,
     get_hybrid_communicate_group, set_hybrid_communicate_group,

@@ -1,0 +1,183 @@
+"""Eager communication API tail: object collectives, p2p tasks,
+reduce_scatter, and the per-op `stream` namespace.
+
+Parity: `python/paddle/distributed/collective.py` (`all_gather_object`
+:1052, `isend` :1622, `irecv` :1672, `reduce_scatter` :1858) and
+`distributed/communication/stream/`. TPU-native: object collectives ride
+the tensor all_gather (pickle -> uint8 tensor); p2p rides the
+jax.distributed coordination-service KV store (the same channel the
+reference's TCPStore provides); reduce_scatter composes
+all_reduce + local slice (one fused XLA collective when compiled).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as C
+from . import env as dist_env
+
+
+def _as_arr(t):
+    return np.asarray(t._data if isinstance(t, Tensor) else t)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather arbitrary picklable python objects from every rank."""
+    blob = np.frombuffer(pickle.dumps(obj), np.uint8)
+    # 1) agree on the max length, 2) gather padded payloads + lengths
+    ln = Tensor(np.array([blob.size], np.int64))
+    lens = []
+    C.all_gather(lens, ln, group=group)
+    lens = [int(_as_arr(v)[0]) for v in lens]
+    m = max(lens + [1])
+    payload = Tensor(np.pad(blob, (0, m - blob.size)))
+    outs = []
+    C.all_gather(outs, payload, group=group)
+    del object_list[:]
+    for v, k in zip(outs, lens):
+        object_list.append(pickle.loads(_as_arr(v)[:k].tobytes()))
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list, op=None, group=None,
+                   sync_op=True):
+    """Reduce a list of per-rank tensors, keep this rank's shard.
+    Composed as all_reduce + slice (GSPMD fuses the pair into one
+    reduce-scatter when this runs inside a compiled step)."""
+    op = op if op is not None else C.ReduceOp.SUM
+    import jax.numpy as jnp
+    stacked = Tensor(jnp.concatenate(
+        [jnp.asarray(_as_arr(t)) for t in tensor_list], axis=0))
+    C.all_reduce(stacked, op=op, group=group)
+    rank = dist_env.get_rank()
+    shard = _as_arr(tensor_list[0]).shape[0]
+    tensor._data = jnp.asarray(
+        _as_arr(stacked)[rank * shard:(rank + 1) * shard])
+    return tensor
+
+
+class _P2PTask:
+    def __init__(self, fn=None):
+        self._fn = fn
+        self._done = fn is None
+
+    def wait(self):
+        if not self._done:
+            self._fn()
+            self._done = True
+        return True
+
+    def is_completed(self):
+        return self._done
+
+
+_P2P_SEQ = {}
+
+
+def _kv_client():
+    from jax._src import distributed as _jd
+    client = getattr(_jd.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "isend/irecv need jax.distributed (init_parallel_env with "
+            "PADDLE_TRAINERS>1) — the coordination-service KV store is "
+            "the p2p transport")
+    return client
+
+
+def isend(tensor, dst, group=None):
+    """Async send via the coordination-service KV store. Returns a task
+    (completed eagerly: KV puts don't block on the receiver)."""
+    src = dist_env.get_rank()
+    seq = _P2P_SEQ.setdefault(("s", src, dst), [0])
+    key = f"paddle_p2p/{src}/{dst}/{seq[0]}"
+    seq[0] += 1
+    arr = _as_arr(tensor)
+    _kv_client().key_value_set_bytes(
+        key, pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes())))
+    return _P2PTask()
+
+
+def irecv(tensor, src=None, group=None):
+    """Async recv: task.wait() blocks on the matching isend key.
+    `src` must name a concrete rank (the KV keys are (src, dst)-scoped;
+    any-source receive has no transport here)."""
+    if src is None:
+        raise ValueError(
+            "irecv requires a concrete src rank on the KV-store "
+            "transport (any-source recv is unsupported)")
+    dst = dist_env.get_rank()
+    seq = _P2P_SEQ.setdefault(("r", src, dst), [0])
+    key = f"paddle_p2p/{src}/{dst}/{seq[0]}"
+    seq[0] += 1
+
+    def fetch():
+        blob = _kv_client().blocking_key_value_get_bytes(key, 60_000)
+        dt, shape, raw = pickle.loads(blob)
+        import jax.numpy as jnp
+        tensor._data = jnp.asarray(
+            np.frombuffer(raw, np.dtype(dt)).reshape(shape))
+    return _P2PTask(fetch)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    return isend(tensor, dst, group).wait()
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return irecv(tensor, src, group).wait()
+
+
+class _StreamNamespace:
+    """`paddle.distributed.stream.*` — per-op stream variants. XLA owns
+    streams on TPU; these are the sync collectives with the stream
+    arguments accepted for API parity."""
+
+    @staticmethod
+    def all_reduce(tensor, op=None, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return C.all_reduce(tensor, op=op if op is not None
+                            else C.ReduceOp.SUM, group=group)
+
+    @staticmethod
+    def all_gather(tensor_or_list, tensor, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return C.all_gather(tensor_or_list, tensor, group=group)
+
+    @staticmethod
+    def broadcast(tensor, src=0, group=None, sync_op=True,
+                  use_calc_stream=False):
+        return C.broadcast(tensor, src=src, group=group)
+
+    @staticmethod
+    def reduce(tensor, dst=0, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+        return C.reduce(tensor, dst=dst, op=op if op is not None
+                        else C.ReduceOp.SUM, group=group)
+
+    @staticmethod
+    def scatter(tensor, tensor_list=None, src=0, group=None,
+                sync_op=True, use_calc_stream=False):
+        return C.scatter(tensor, tensor_list=tensor_list, src=src,
+                         group=group)
+
+    @staticmethod
+    def reduce_scatter(tensor, tensor_list, op=None, group=None,
+                       sync_op=True, use_calc_stream=False):
+        return reduce_scatter(tensor, tensor_list, op=op, group=group)
+
+    @staticmethod
+    def send(tensor, dst=0, group=None, sync_op=True,
+             use_calc_stream=False):
+        return send(tensor, dst=dst, group=group)
+
+    @staticmethod
+    def recv(tensor, src=0, group=None, sync_op=True,
+             use_calc_stream=False):
+        return recv(tensor, src=src, group=group)
+
+
+stream = _StreamNamespace()

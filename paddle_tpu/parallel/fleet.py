@@ -25,6 +25,85 @@ class _RoleMakerStub:
         self._is_collective = is_collective
 
 
+class PaddleCloudRoleMaker:
+    """`fleet/base/role_maker.py:526` parity: derive this process's PS
+    role from the PaddleCloud env contract (TRAINING_ROLE,
+    PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINER_ENDPOINTS,
+    PADDLE_TRAINER_ID / POD_IP:PADDLE_PORT)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._servers = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        self._workers = [e for e in os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+        if self._role == "PSERVER":
+            me = (os.environ.get("POD_IP", "") + ":"
+                  + os.environ.get("PADDLE_PORT", ""))
+            self._cur = self._servers.index(me) if me in self._servers \
+                else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        else:
+            self._cur = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def _is_worker(self):
+        return self._role == "TRAINER"
+
+    def _is_server(self):
+        return self._role == "PSERVER"
+
+    is_worker = _is_worker
+    is_server = _is_server
+
+    def is_first_worker(self):
+        return self._is_worker() and self._cur == 0
+
+    def worker_index(self):
+        return self._cur if self._is_worker() else -1
+
+    def server_index(self):
+        return self._cur if self._is_server() else -1
+
+    def worker_num(self):
+        return max(len(self._workers),
+                   int(__import__("os").environ.get(
+                       "PADDLE_TRAINERS_NUM", 1)))
+
+    def server_num(self):
+        return len(self._servers)
+
+    def get_trainer_endpoints(self):
+        return list(self._workers)
+
+    def get_pserver_endpoints(self):
+        return list(self._servers)
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """`role_maker.py:1112` parity: explicit role wiring instead of env
+    parsing — kwargs: current_id, role ('worker'/'server'),
+    worker_num, server_endpoints."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        role = kwargs.get("role", "worker")
+        self._role = ("PSERVER" if str(role).lower() in
+                      ("server", "pserver", "2") else "TRAINER")
+        self._cur = int(kwargs.get("current_id", 0))
+        self._servers = list(kwargs.get("server_endpoints", []))
+        n = int(kwargs.get("worker_num", 1))
+        self._workers = list(kwargs.get("worker_endpoints",
+                                        [""] * n if n else []))
+
+    def worker_num(self):
+        # explicit wiring must NOT be overridden by leaked launcher env
+        # (PaddleCloudRoleMaker.worker_num consults PADDLE_TRAINERS_NUM)
+        return len(self._workers)
+
+
 class Fleet:
     def __init__(self):
         self._strategy = None

@@ -1,0 +1,98 @@
+"""`paddle.distributed.utils.global_scatter/global_gather` parity
+(`python/paddle/distributed/utils/moe_utils.py:21,144` over the
+`global_scatter/global_gather` CUDA ops).
+
+Count-based MoE token exchange: rows of x are grouped per
+(destination card, expert); each card keeps the rows routed to its own
+experts. Single-process world (world_size=1) runs the permutation
+directly; the multi-card compiled path is `incubate.distributed.models
+.moe` (capacity all_to_all inside the jitted step), which is how the
+TPU build actually trains MoE — these eager wrappers exist for the
+reference's dygraph API surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import env as dist_env
+
+
+def _counts(t):
+    return np.asarray(t._data if isinstance(t, Tensor) else t,
+                      np.int64).reshape(-1)
+
+
+def _world():
+    # eager per-"card" exchange: a card is a PROCESS under the
+    # single-controller SPMD model (the 8 local devices of one process
+    # are driven by one copy of this python code)
+    import jax
+    return jax.process_count()
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """x [B, d]; local_count/global_count [n_expert * world_size].
+    Returns the rows this card's experts receive (expert-major)."""
+    world = _world()
+    lc, gc = _counts(local_count), _counts(global_count)
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if world == 1:
+        # single card: receiving (card0, expert e) == sending bucket e;
+        # x is already bucket-ordered by local_count
+        if not np.array_equal(lc, gc):
+            raise ValueError(
+                "global_scatter single-card: local_count != global_count")
+        return Tensor(arr[:int(lc.sum())])
+    # multi-card eager: exchange the per-bucket segments over the object
+    # collective (CPU path; compiled MoE uses all_to_all on-device)
+    from .comm_extras import all_gather_object
+    n_e = lc.size // world
+    offs = np.concatenate([[0], np.cumsum(lc)])
+    segs = [arr[offs[i]:offs[i + 1]] for i in range(lc.size)]
+    everyone = []
+    all_gather_object(everyone, segs, group=group)
+    rank = dist_env.get_rank()
+    out = []
+    for src in range(world):               # global_count layout
+        for e in range(n_e):
+            out.append(everyone[src][rank * n_e + e])
+    got = np.concatenate([s for s in out if len(s)]) if any(
+        len(s) for s in out) else arr[:0]
+    if got.shape[0] != int(gc.sum()):
+        raise ValueError("global_scatter: global_count mismatch")
+    return Tensor(got)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter: return expert outputs to the cards
+    that sent the tokens."""
+    world = _world()
+    lc, gc = _counts(local_count), _counts(global_count)
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if world == 1:
+        if not np.array_equal(lc, gc):
+            raise ValueError(
+                "global_gather single-card: local_count != global_count")
+        return Tensor(arr[:int(gc.sum())])
+    from .comm_extras import all_gather_object
+    n_e = lc.size // world
+    rank = dist_env.get_rank()
+    offs = np.concatenate([[0], np.cumsum(gc)])
+    # my received buckets, keyed by (src card, expert)
+    segs = [arr[offs[i]:offs[i + 1]] for i in range(gc.size)]
+    everyone = []
+    all_gather_object(everyone, segs, group=group)
+    out = []
+    for dst in range(world):               # local_count layout
+        for e in range(n_e):
+            # the rows I sent to (dst, e) came back in dst's bucket
+            # indexed by my rank
+            out.append(everyone[dst][rank * n_e + e])
+    got = np.concatenate([s for s in out if len(s)]) if any(
+        len(s) for s in out) else arr[:0]
+    if got.shape[0] != int(lc.sum()):
+        raise ValueError("global_gather: local_count mismatch")
+    return Tensor(got)

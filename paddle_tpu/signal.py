@@ -25,6 +25,10 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
         if axis == 0:
             a = jnp.moveaxis(a, 0, -1)                    # time last
         T = a.shape[-1]
+        if T < frame_length:
+            raise ValueError(
+                f"frame: signal length {T} < frame_length "
+                f"{frame_length} (the reference errors here too)")
         n = 1 + (T - frame_length) // hop_length
         idx = (jnp.arange(n)[:, None] * hop_length
                + jnp.arange(frame_length)[None, :])       # [n, L]
@@ -96,6 +100,10 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
             a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2,) * 2],
                         mode=pad_mode)
         T = a.shape[-1]
+        if T < n_fft:
+            raise ValueError(
+                f"stft: signal length {T} (after centering) < n_fft "
+                f"{n_fft}")
         n = 1 + (T - n_fft) // hop
         idx = (jnp.arange(n)[:, None] * hop
                + jnp.arange(n_fft)[None, :])

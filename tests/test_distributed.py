@@ -253,3 +253,56 @@ def test_lamb_strategy_swaps_optimizer(reset_topology):
     opt = HybridParallelOptimizer(
         Momentum(0.01, parameters=lin.parameters()), strategy=strat)
     assert isinstance(opt._inner_opt, Lamb)
+
+
+def test_all_gather_object_and_reduce_scatter():
+    world = paddle.distributed.get_world_size()
+    objs = []
+    paddle.distributed.all_gather_object(objs, {"rank": 0, "xs": [1, 2]})
+    assert objs == [{"rank": 0, "xs": [1, 2]}] * world
+    t = paddle.zeros([3])
+    # rank 0 keeps the first shard; under the single controller the
+    # process's tensor IS the global value (all_reduce = identity)
+    paddle.distributed.reduce_scatter(
+        t, [paddle.to_tensor([1.0, 2.0, 3.0])] * world)
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_global_scatter_gather_roundtrip():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    lc = paddle.to_tensor(np.array([4, 2], np.int64))
+    out = paddle.distributed.utils.global_scatter(x, lc, lc)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+    back = paddle.distributed.utils.global_gather(out, lc, lc)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+def test_role_makers():
+    fleet_mod = paddle.distributed.fleet
+    rm = fleet_mod.UserDefinedRoleMaker(current_id=2, role="worker",
+                                        worker_num=4)
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_index() == 2 and rm.worker_num() == 4
+    srv = fleet_mod.UserDefinedRoleMaker(
+        current_id=0, role="server",
+        server_endpoints=["127.0.0.1:7000", "127.0.0.1:7001"])
+    assert srv.is_server() and srv.server_num() == 2
+
+    import os
+    old = dict(os.environ)
+    try:
+        os.environ["TRAINING_ROLE"] = "TRAINER"
+        os.environ["PADDLE_TRAINER_ID"] = "1"
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = "a:1,b:2,c:3"
+        cloud = fleet_mod.PaddleCloudRoleMaker()
+        assert cloud.is_worker() and cloud.worker_index() == 1
+        assert cloud.worker_num() == 3
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+
+
+def test_stream_namespace():
+    t = paddle.to_tensor([2.0])
+    paddle.distributed.stream.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), [2.0])

@@ -179,3 +179,42 @@ def test_max_unpool1d():
     un = nn.MaxUnPool1D(2, stride=2)(
         paddle.squeeze(out, 2), paddle.squeeze(mask, 2))
     assert un.shape == [1, 2, 8]
+
+
+def test_spectral_norm_unit_sigma_and_grads():
+    lin = nn.Linear(8, 6)
+    nn.utils.spectral_norm(lin, n_power_iterations=20)
+    eye = paddle.to_tensor(np.eye(8, dtype=np.float32))
+    zero = paddle.to_tensor(np.zeros((8, 8), np.float32))
+    w_eff = lin(eye).numpy() - lin(zero).numpy()
+    s = np.linalg.svd(w_eff, compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.05
+    y = lin(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    y.sum().backward()
+    assert lin.weight_orig.grad is not None
+    nn.utils.remove_spectral_norm(lin)
+    assert "weight" in lin._parameters
+
+
+def test_nn_quant_surface():
+    assert nn.quant.QuantizedLinear is not None
+    w = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    wq, scale = nn.quant.weight_quantize(w)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .rand(2, 4).astype(np.float32))
+    out = nn.quant.weight_only_linear(x, wq, scale)
+    ref = x.numpy() @ w.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=0.1)
+    assert nn.quant.Stub()(x) is x
+
+
+def test_remove_spectral_norm_preserves_behavior():
+    lin = nn.Linear(6, 4)
+    nn.utils.spectral_norm(lin, n_power_iterations=10)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(3, 6).astype(np.float32))
+    before = lin(x).numpy()
+    nn.utils.remove_spectral_norm(lin)
+    after = lin(x).numpy()
+    np.testing.assert_allclose(after, before, atol=1e-5)
