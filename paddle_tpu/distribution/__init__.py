@@ -469,6 +469,55 @@ class TanhTransform(Transform):
             (x,))
 
 
+class StickBreakingTransform(Transform):
+    """`distribution/transform.py StickBreakingTransform` parity:
+    unconstrained R^K <-> the (K+1)-simplex via the stick-breaking
+    construction (logit offsets against the remaining stick)."""
+
+    def forward(self, x):
+        x = as_tensor(x)
+
+        def _fn(v):
+            K = v.shape[-1]
+            offset = jnp.log(K - jnp.arange(K, dtype=v.dtype))
+            z = jax.nn.sigmoid(v - offset)
+            zpad = jnp.concatenate(
+                [z, jnp.ones(v.shape[:-1] + (1,), v.dtype)], axis=-1)
+            one_minus = jnp.concatenate(
+                [jnp.ones(v.shape[:-1] + (1,), v.dtype), 1 - z], axis=-1)
+            return zpad * jnp.cumprod(one_minus, axis=-1)
+        return dispatch.apply("stickbreaking_t", _fn, (x,))
+
+    def inverse(self, y):
+        y = as_tensor(y)
+
+        def _fn(p):
+            K = p.shape[-1] - 1
+            offset = jnp.log(K - jnp.arange(K, dtype=p.dtype))
+            cum = jnp.concatenate(
+                [jnp.zeros(p.shape[:-1] + (1,), p.dtype),
+                 jnp.cumsum(p[..., :-1], axis=-1)], axis=-1)[..., :K]
+            rest = 1.0 - cum
+            z = p[..., :K] / jnp.maximum(rest, 1e-30)
+            return jnp.log(z) - jnp.log1p(-z) + offset
+        return dispatch.apply("stickbreaking_inv", _fn, (y,))
+
+    def forward_log_det_jacobian(self, x):
+        x = as_tensor(x)
+
+        def _fn(v):
+            K = v.shape[-1]
+            offset = jnp.log(K - jnp.arange(K, dtype=v.dtype))
+            u = v - offset
+            z = jax.nn.sigmoid(u)
+            one_minus = jnp.concatenate(
+                [jnp.ones(v.shape[:-1] + (1,), v.dtype), 1 - z], axis=-1)
+            rest = jnp.cumprod(one_minus, axis=-1)[..., :K]
+            return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rest),
+                           axis=-1)
+        return dispatch.apply("stickbreaking_ldj", _fn, (x,))
+
+
 class ChainTransform(Transform):
     def __init__(self, transforms):
         self.transforms = list(transforms)

@@ -38,3 +38,33 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
         return jax.nn.softmax(jnp.where(m, a, -1e30), axis=-1)
     return dispatch.apply("softmax_mask_fuse_upper_triangle", f,
                           (as_tensor(x),))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """`incubate/operators/graph_send_recv.py:30` parity — the older
+    name for the geometric send_u_recv gather/scatter-reduce."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index,
+                       reduce_op=pool_type, out_size=out_size)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """`incubate/tensor/math.py` parity (re-exported geometric op)."""
+    from ..geometric import segment_sum as _f
+    return _f(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..geometric import segment_mean as _f
+    return _f(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..geometric import segment_max as _f
+    return _f(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..geometric import segment_min as _f
+    return _f(data, segment_ids)

@@ -21,3 +21,25 @@ from .fused_transformer import (  # noqa: F401
     FusedMoELayer,
 )
 from .generation import GenerationMixin, SamplingConfig  # noqa: F401
+
+
+from ... import nn as _nn
+
+
+class FusedLinear(_nn.Linear):
+    """`incubate/nn/layer/fused_linear.py:19` parity: matmul+bias as one
+    fused op. On TPU `nn.Linear` already compiles to a single fused XLA
+    matmul+bias (the reference needed the fused_gemm_epilogue CUDA
+    kernel). `transpose_weight=True` (a storage-order knob for that
+    kernel, which also transposes checkpoints) is refused rather than
+    silently producing transposed state_dict semantics."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 transpose_weight=False, bias_attr=None, name=None):
+        if transpose_weight:
+            raise NotImplementedError(
+                "FusedLinear(transpose_weight=True) stores the weight "
+                "as [out, in] in the reference checkpoints; load such "
+                "checkpoints by transposing, or use the default layout")
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, bias_attr=bias_attr)

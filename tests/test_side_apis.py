@@ -413,3 +413,32 @@ def test_fused_lamb_deepcopy():
     opt = paddle.incubate.optimizer.DistributedFusedLamb(
         parameters=net.parameters())
     copy.deepcopy(opt)  # must not raise KeyError
+
+
+def test_stickbreaking_transform():
+    t = paddle.distribution.StickBreakingTransform()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(5, 3).astype(np.float32))
+    y = t.forward(x)
+    s = y.numpy()
+    assert s.shape == (5, 4)
+    np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+    assert (s > 0).all()
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                               atol=1e-3)
+    assert np.isfinite(t.forward_log_det_jacobian(x).numpy()).all()
+
+
+def test_incubate_graph_and_segment_and_fused_linear():
+    g = paddle.incubate.graph_send_recv(
+        paddle.to_tensor(np.eye(3, dtype=np.float32)),
+        paddle.to_tensor(np.array([0, 1, 2])),
+        paddle.to_tensor(np.array([1, 1, 0])))
+    np.testing.assert_allclose(g.numpy(), [[0, 0, 1], [1, 1, 0]])
+    seg = paddle.incubate.segment_mean(
+        paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2)),
+        paddle.to_tensor(np.array([0, 0, 1, 1])))
+    np.testing.assert_allclose(seg.numpy(), [[1, 2], [5, 6]])
+    lin = paddle.incubate.nn.FusedLinear(4, 3)
+    out = lin(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert list(out.shape) == [2, 3]
