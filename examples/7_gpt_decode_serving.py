@@ -1,0 +1,42 @@
+"""Serving stack: compile-once autoregressive decode with the fused
+transformer (fixed-shape KV cache) — optionally weight-only int8.
+
+The reference's `FusedMultiTransformer` serving path
+(`incubate/nn/layer/fused_transformer.py:1016`, int8 :1464) — here the
+whole decode loop is ONE lax.scan executable; `quant_bits=8` stores
+int8 weights + per-channel scales and dequantizes inside the bf16
+matmul (`weight_only_linear_kernel.h` capability).
+"""
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForGeneration
+
+
+def main(quant_bits=0, batch=4, max_new=64):
+    paddle.seed(0)
+    net = GPTForGeneration(vocab_size=5000, hidden_size=256,
+                           num_layers=4, num_attention_heads=8,
+                           max_position_embeddings=256,
+                           weight_only=(quant_bits == 8))
+    net.eval()
+    prompt = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 5000, (batch, 16)))
+    t0 = time.perf_counter()
+    out, _ = net.generate(prompt, max_new_tokens=max_new)
+    first = time.perf_counter() - t0   # includes compile
+    t0 = time.perf_counter()
+    out, _ = net.generate(prompt, max_new_tokens=max_new)
+    steady = time.perf_counter() - t0
+    toks = batch * max_new
+    print(f"quant_bits={quant_bits}: first call {first:.1f}s "
+          f"(compile), steady {steady * 1e3:.0f} ms "
+          f"({toks / steady:,.0f} tok/s), out shape {out.shape}")
+    return out
+
+
+if __name__ == "__main__":
+    main(quant_bits=0)
+    main(quant_bits=8)
