@@ -68,7 +68,10 @@ def bench_gpt(on_tpu):
     lab = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, cfg.seq_len)),
                       jnp.int32)
     # compile + 2 warm steps: the relay's first post-compile dispatches
-    # run degraded (r4 note) and would bias the timed window low
+    # run degraded (r4 note) and would bias the timed window low.
+    # (A K-step grouped timed window via trainer.train_many measured
+    # SLOWER — 39.0k vs 39.4k tok/s: the scan-carried param/opt state
+    # costs more than the 12 saved dispatches. Per-step stays.)
     for w in range(3):
         params, opt, loss = trainer.train_step(params, opt, tok, lab,
                                                step_num=w + 1)

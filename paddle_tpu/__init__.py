@@ -13,7 +13,8 @@ from .core.dtype import (
 from .core.tensor import Tensor, Parameter
 from .core.lod import (LoDTensor, create_lod_tensor,  # noqa: F401
                        sequence_pool)
-from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled
+from .core.autograd import (no_grad, enable_grad, grad,  # noqa: F401
+                            is_grad_enabled, set_grad_enabled)
 from .core.place import (
     CPUPlace, TPUPlace, CUDAPlace, set_device, get_device,
     is_compiled_with_cuda, is_compiled_with_tpu,

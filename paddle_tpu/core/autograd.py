@@ -62,6 +62,22 @@ def enable_grad():
         _grad_enabled.pop()
 
 
+class set_grad_enabled:
+    """`paddle.set_grad_enabled` parity (`framework/framework.py:94`):
+    usable both as a context manager and as an immediate toggle."""
+
+    def __init__(self, mode):
+        self._prev = _grad_enabled[-1]
+        _grad_enabled[-1] = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled[-1] = self._prev
+        return False
+
+
 class Edge:
     """Edge from a consumer GradNode input slot back to its producer.
 

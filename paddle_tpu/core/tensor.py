@@ -120,6 +120,16 @@ class Tensor:
         perm = list(range(self.ndim))[::-1]
         return ops.transpose(self, perm)
 
+    def real(self, name=None):
+        # a METHOD, matching the reference Tensor.real(name=None) —
+        # property-style `.real` (torch-ism) would break ported calls
+        from ..ops.extras2 import real as _real
+        return _real(self)
+
+    def imag(self, name=None):
+        from ..ops.extras2 import imag as _imag
+        return _imag(self)
+
     # -- host interop ---------------------------------------------------
     def numpy(self):
         return np.asarray(self._data)

@@ -802,6 +802,22 @@ class HybridGPT:
         self._loss_sm = loss_sm
         self._loss_jit = jax.jit(loss_sm)
 
+        def steps_k(params, opt_state, tokens, labels, lr, t0, k):
+            """K training steps as ONE executable (lax.scan over the
+            step body) — the hapi run_many grouping applied to the
+            hybrid trainer: amortizes per-dispatch relay latency."""
+            def body(carry, i):
+                p, o = carry
+                p, o, loss = step(p, o, tokens, labels, lr, t0 + i)
+                return (p, o), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state),
+                jnp.arange(k, dtype=jnp.float32))
+            return params, opt_state, losses
+
+        self._steps_k = jax.jit(steps_k, static_argnums=(6,),
+                                donate_argnums=(0, 1))
+
     def init(self, key):
         with self.mesh:
             p_init = jax.jit(
@@ -829,3 +845,13 @@ class HybridGPT:
                          jnp.float32)
         t = jnp.asarray(step_num, jnp.float32)
         return self._step(params, opt_state, tokens, labels, lr, t)
+
+    def train_many(self, params, opt_state, tokens, labels, k, lr=None,
+                   start_step=1):
+        """Run k steps in one device dispatch; returns
+        (params, opt_state, losses[k])."""
+        lr = jnp.asarray(lr if lr is not None else self.cfg.learning_rate,
+                         jnp.float32)
+        t0 = jnp.asarray(start_step, jnp.float32)
+        return self._steps_k(params, opt_state, tokens, labels, lr, t0,
+                             int(k))

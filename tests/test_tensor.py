@@ -163,3 +163,24 @@ def test_lod_sequence_pool():
         paddle.sequence_pool(t, "mean").numpy(), [[2.0], [10.0], [5.0]])
     np.testing.assert_allclose(
         paddle.sequence_pool(t, "max").numpy(), [[3.0], [10.0], [6.0]])
+
+
+def test_set_grad_enabled_and_complex_properties():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.set_grad_enabled(False):
+        y = x * 2
+        assert y.stop_gradient
+    y2 = x * 2
+    assert not y2.stop_gradient
+    # immediate-toggle form (restore in finally: an assert failure must
+    # not leak grad-disabled state into the rest of the session)
+    try:
+        paddle.set_grad_enabled(False)
+        assert not paddle.is_grad_enabled()
+    finally:
+        paddle.set_grad_enabled(True)
+    assert paddle.is_grad_enabled()
+
+    z = paddle.to_tensor(np.array([1 + 2j], np.complex64))
+    np.testing.assert_allclose(z.real().numpy(), [1.0])
+    np.testing.assert_allclose(z.imag().numpy(), [2.0])
