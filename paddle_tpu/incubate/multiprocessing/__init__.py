@@ -57,12 +57,20 @@ def _reduce_tensor(t):
     return _rebuild_tensor, (name, arr.shape, arr.dtype.str)
 
 
+_registered = False
+
+
 def init_reductions():
-    """Register the shared-memory pickler for paddle Tensors (the
-    reference calls this at import in its multiprocessing module)."""
+    """Register the shared-memory pickler for paddle Tensors. EXPLICIT
+    opt-in (call before shipping Tensors through mp queues): the
+    registration is process-global and single-use-consume semantics
+    would surprise code using plain pickling — notably the in-tree
+    DataLoader workers, which have their own shm transport
+    (`io/__init__.py`)."""
+    global _registered
+    if _registered:
+        return
     from multiprocessing import reduction
     from ...core.tensor import Tensor
     reduction.ForkingPickler.register(Tensor, _reduce_tensor)
-
-
-init_reductions()
+    _registered = True

@@ -350,6 +350,7 @@ def test_reduce_lr_on_plateau():
 def test_incubate_multiprocessing_tensor_pickle():
     from multiprocessing.reduction import ForkingPickler
     import pickle
+    paddle.incubate.multiprocessing.init_reductions()  # explicit opt-in
     t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
     blob = bytes(ForkingPickler.dumps(t))
     t2 = pickle.loads(blob)
