@@ -260,25 +260,28 @@ def bench_decode():
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.models.gpt import GPTForGeneration
 
-    def _decode_tps(m, B, T=128):
+    def _decode_tps(m, B, T=128, reps=1):
         P = 128
         rng = np.random.RandomState(0)
         ids = Tensor(rng.randint(0, 50304, (B, P)).astype(np.int32))
         out, _ = m.generate(ids, max_new_tokens=T)  # compile + warm
         np.asarray(out.numpy())
-        t0 = time.perf_counter()
-        out, _ = m.generate(ids, max_new_tokens=T)
-        np.asarray(out.numpy())
-        return B * T / (time.perf_counter() - t0)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out, _ = m.generate(ids, max_new_tokens=T)
+            np.asarray(out.numpy())
+            best = min(best, time.perf_counter() - t0)
+        return B * T / best
 
-    def run(weight_only, B, T=128):
+    def run(weight_only, B, T=128, reps=1):
         m = GPTForGeneration(vocab_size=50304, hidden_size=1024,
                              num_layers=24, num_attention_heads=16,
                              max_position_embeddings=2048,
                              compute_dtype="bfloat16",
                              weight_only=weight_only)
         m.eval()
-        return m, _decode_tps(m, B, T)
+        return m, _decode_tps(m, B, T, reps)
 
     m64, tps = run(True, 64)
     # the weight-only-int8 REGIME win: B=1 serving is
@@ -291,11 +294,14 @@ def bench_decode():
     # (identical) prefill cost — measured 1.10x at T=64 vs 1.26x+
     # at T=128.
     try:
-        i8 = _decode_tps(m64, 1)  # same weights, new batch shape
+        # min-of-3 per side: the B=1 ratio is dispatch-latency-bound
+        # and a single host-load spike measured it at 1.03x (vs the
+        # quiet-machine 1.24-1.34x)
+        i8 = _decode_tps(m64, 1, reps=3)  # same weights, new batch
         del m64
         import gc
         gc.collect()
-        _, b16 = run(False, 1)
+        _, b16 = run(False, 1, reps=3)
         extra = {"metric": "gpt2_350m_decode_int8_speedup_b1",
                  "value": round(i8 / b16, 3), "unit": "x vs bf16"}
     except Exception as e:  # noqa: BLE001
