@@ -294,14 +294,14 @@ def bench_decode():
     # (identical) prefill cost — measured 1.10x at T=64 vs 1.26x+
     # at T=128.
     try:
-        # min-of-3 per side: the B=1 ratio is dispatch-latency-bound
+        # min-of-5 per side: the B=1 ratio is dispatch-latency-bound
         # and a single host-load spike measured it at 1.03x (vs the
         # quiet-machine 1.24-1.34x)
-        i8 = _decode_tps(m64, 1, reps=3)  # same weights, new batch
+        i8 = _decode_tps(m64, 1, reps=5)  # same weights, new batch
         del m64
         import gc
         gc.collect()
-        _, b16 = run(False, 1, reps=3)
+        _, b16 = run(False, 1, reps=5)
         extra = {"metric": "gpt2_350m_decode_int8_speedup_b1",
                  "value": round(i8 / b16, 3), "unit": "x vs bf16"}
     except Exception as e:  # noqa: BLE001
