@@ -59,6 +59,9 @@ from . import audio  # noqa
 from . import geometric  # noqa
 from . import signal  # noqa
 from . import version  # noqa
+from . import sysconfig  # noqa
+from .batch import batch  # noqa
+from .device import get_cudnn_version, disable_signal_handler  # noqa
 from .hapi import callbacks  # noqa — paddle.callbacks
 from .hapi.dynamic_flops import flops  # noqa — paddle.flops
 from .flags import set_flags, get_flags  # noqa

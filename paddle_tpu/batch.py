@@ -1,0 +1,18 @@
+"""`paddle.batch` parity (`python/paddle/batch.py`): decorate a sample
+reader into a batched reader (the legacy reader protocol)."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

@@ -90,3 +90,15 @@ class cuda:
     class Stream:
         def __init__(self, *a, **k):
             pass
+
+
+def get_cudnn_version():
+    """`device/__init__.py get_cudnn_version` parity: None on builds
+    without cuDNN (every TPU build)."""
+    return None
+
+
+def disable_signal_handler():
+    """Parity shim: the reference unhooks its C++ fault handlers; this
+    build installs none, so there is nothing to unhook."""
+    return None

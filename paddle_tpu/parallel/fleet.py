@@ -207,3 +207,9 @@ fleet = Fleet()
 init = fleet.init
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
+
+
+# fleet.utils namespace (`distributed/fleet/utils/`)
+from . import fleet_utils as utils  # noqa: E402,F401
+
+barrier_worker = fleet.barrier_worker

@@ -1,0 +1,17 @@
+"""`paddle.sysconfig` parity (`python/paddle/sysconfig.py`): include/lib
+directories — here the package's C ABI headers live beside the native
+PS engine sources."""
+import os
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the framework's C/C++ sources/headers
+    (the native PS engine csrc)."""
+    return os.path.join(_ROOT, "ps", "csrc")
+
+
+def get_lib():
+    """Directory containing the built native library (libps_core.so)."""
+    return os.path.join(_ROOT, "ps")

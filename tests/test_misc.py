@@ -315,7 +315,9 @@ def test_elastic_scale_out_reranks(tmp_path):
         results[node_id] = mgr.run([sys.executable, str(script)],
                                    elastic=True, poll_timeout=timeout)
 
-    def wait_for(cond, timeout=25):
+    def wait_for(cond, timeout=60):
+        # generous: the relaunch subprocesses re-import jax; on a
+        # loaded machine 25s flaked (passes alone in ~40s total)
         deadline = time.time() + timeout
         while time.time() < deadline:
             if cond():
@@ -404,3 +406,44 @@ def test_benchmark_timer_in_fit():
     assert rep["ips_avg"] > 0
     info = bm.step_info()
     assert "ips" in info and "batch_cost" in info
+
+
+def test_paddle_batch_and_sysconfig_and_fleet_utils(tmp_path):
+    import os
+    import paddle_tpu as paddle
+
+    # paddle.batch legacy reader decorator
+    def reader():
+        yield from range(7)
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == [
+        [0, 1, 2], [3, 4, 5]]
+
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert paddle.get_cudnn_version() is None
+    paddle.disable_signal_handler()
+
+    fs = paddle.distributed.fleet.utils.LocalFS()
+    d = tmp_path / "x"
+    fs.mkdirs(str(d))
+    fs.touch(str(d / "a.txt"))
+    assert fs.is_file(str(d / "a.txt"))
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["x"] and files == []
+    fs.mv(str(d / "a.txt"), str(d / "b.txt"))
+    assert fs.is_exist(str(d / "b.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+
+    # fused_allreduce_gradients: single-controller no-op reduction but
+    # the grads survive the pass
+    import numpy as np
+    import paddle_tpu.nn as nn
+    lin = nn.Linear(2, 2)
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    (lin(x) ** 2).sum().backward()
+    g0 = lin.weight.grad.numpy().copy()
+    paddle.distributed.fleet.utils.fused_allreduce_gradients(
+        list(lin.parameters()))
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g0)
