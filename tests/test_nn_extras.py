@@ -12,6 +12,7 @@ def t(x):
 
 
 def test_bilinear():
+    paddle.set_default_dtype("float32")  # defend against dtype leakage
     paddle.seed(0)
     b = nn.Bilinear(3, 4, 2)
     x1 = t(np.random.rand(5, 3).astype(np.float32))
@@ -19,7 +20,7 @@ def test_bilinear():
     out = b(x1, x2)
     ref = np.einsum("bi,oij,bj->bo", x1.numpy(), b.weight.numpy(),
                     x2.numpy()) + b.bias.numpy()
-    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
 
 
 def test_ctc_loss_matches_torch_style_oracle():
