@@ -87,6 +87,9 @@ def bench_gpt(on_tpu):
 
     toks = batch * cfg.seq_len * iters
     tps = toks / dt
+    from paddle_tpu.profiler import metrics as _metrics
+    if _metrics._enabled:
+        _metrics.TOKENS_PER_SEC.set(tps)
     # approx train FLOPs/token: 6*N (fwd+bwd weight flops) + causal
     # attention 6*L*S*d
     d, L, S, V = cfg.d_model, cfg.n_layers, cfg.seq_len, cfg.vocab_size
@@ -310,8 +313,38 @@ def bench_decode():
     return tps, None, extra  # bandwidth-bound; MFU not meaningful
 
 
+def _metrics_extra():
+    """Condensed observability snapshot for the benchmark JSON `extras`
+    (only when PADDLE_TPU_METRICS is set — instrumentation off keeps the
+    headline run unperturbed)."""
+    from paddle_tpu.profiler import metrics
+    if not metrics._enabled:
+        return None
+    snap = metrics.REGISTRY.snapshot()
+
+    def total(name):
+        return round(sum(
+            v for v in snap.get(name, {}).get("values", {}).values()
+            if isinstance(v, (int, float))), 3)
+
+    return {
+        "metric": "observability_snapshot",
+        "dispatch_ops": total("paddle_tpu_dispatch_ops_total"),
+        "jit_compiles": total("paddle_tpu_jit_compiles_total"),
+        "jit_compile_seconds": total(
+            "paddle_tpu_jit_compile_seconds_total"),
+        "collective_bytes": total("paddle_tpu_collective_bytes_total"),
+        "tokens_per_sec_gauge": round(metrics.TOKENS_PER_SEC.value, 1),
+    }
+
+
 def main():
+    import os
+
     import jax
+    if os.environ.get("PADDLE_TPU_METRICS"):
+        from paddle_tpu.profiler import metrics as _m
+        _m.enable()
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
 
@@ -364,6 +397,9 @@ def main():
             if extra_metric is not None:
                 result["extras"].append(extra_metric)
 
+    obs = _metrics_extra()
+    if obs is not None:
+        result["extras"].append(obs)
     print(json.dumps(result))
 
 

@@ -14,6 +14,7 @@ registry. Autograd recording (the `eager_gen.py` grad-node wiring) happens in
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -21,10 +22,13 @@ import numpy as np
 
 from . import autograd
 from .autograd import Edge, GradNode
+from ..profiler import metrics as _metrics
 
 
 def _nan_inf_callback(x, op_name):
     if not np.isfinite(np.asarray(x)).all():
+        if _metrics._enabled:
+            _metrics.NAN_INF_EVENTS.labels(op_name).inc()
         raise FloatingPointError(
             f"NaN/Inf detected in output of op '{op_name}' "
             f"(shape {getattr(x, 'shape', ())}) inside a compiled step")
@@ -128,19 +132,32 @@ class _LazyVjp:
     between forward and .backward() cannot silently linearize a
     different computation than the one that ran (ADVICE r4 #5)."""
 
-    __slots__ = ("fn", "arrays", "_vjp", "_flags", "_amp")
+    __slots__ = ("fn", "arrays", "_vjp", "_flags", "_amp", "_mode")
 
     def __init__(self, fn, arrays):
         self.fn = fn
         self.arrays = arrays
         self._vjp = None
+        self._mode = "replay"   # repeat calls replay the kept vjp
         from .. import flags as _flags
         from ..amp.auto_cast import _state as _amp_state
         self._flags = dict(_flags._FLAGS)
         self._amp = dict(_amp_state)
 
     def __call__(self, ct):
-        if self._vjp is None and self.fn is not None:
+        if not _metrics._enabled:
+            return self._run(ct)
+        t0 = time.perf_counter()
+        out = self._run(ct)
+        _metrics.VJP_BACKWARD_SECONDS.labels(self._mode).observe(
+            time.perf_counter() - t0)
+        return out
+
+    def _run(self, ct):
+        if self._vjp is not None:
+            self._mode = "replay"
+            return self._vjp(ct)
+        if self.fn is not None:
             fp = _fn_fingerprint(self.fn)
             if fp is not None:
                 key = (fp, _aval_sig(self.arrays), _aval_sig(ct),
@@ -152,13 +169,28 @@ class _LazyVjp:
                     jitted = key = None
                 if key is not None:
                     if jitted is None:
+                        self._mode = "trace"
+                        if _metrics._enabled:
+                            _metrics.VJP_CACHE.labels("miss").inc()
                         if len(_VJP_JIT_CACHE) >= _VJP_JIT_CACHE_MAX:
+                            # full flush on overflow (a per-entry LRU
+                            # would need an ordered dict walk per hit);
+                            # the eviction counter makes a thrashing
+                            # cache visible instead of silent
+                            evicted = len(_VJP_JIT_CACHE)
                             _VJP_JIT_CACHE.clear()
+                            if _metrics._enabled:
+                                _metrics.VJP_CACHE.labels(
+                                    "eviction").inc(evicted)
                         fn = self.fn
                         jitted = jax.jit(
                             lambda arrays, ct:
                             jax.vjp(fn, *arrays)[1](ct))
                         _VJP_JIT_CACHE[key] = jitted
+                    else:
+                        self._mode = "replay"
+                        if _metrics._enabled:
+                            _metrics.VJP_CACHE.labels("hit").inc()
                     # keep a reusable vjp (retain_graph contract): the
                     # closure holds the arrays the jitted call replays
                     arrays = self.arrays
@@ -167,6 +199,9 @@ class _LazyVjp:
                     self.fn = self.arrays = None
                     return self._vjp(ct)
         if self._vjp is None:
+            self._mode = "fallback"
+            if _metrics._enabled:
+                _metrics.VJP_CACHE.labels("fallback").inc()
             _, self._vjp = self._with_snapshot(jax.vjp, self.fn,
                                                *self.arrays)
             self.fn = self.arrays = None  # free after tracing
@@ -195,6 +230,8 @@ def apply(name, fn, inputs, differentiable=True):
     GradNode when grad is enabled and any input requires grad."""
     from .tensor import Tensor
 
+    if _metrics._enabled:
+        _metrics.DISPATCH_OPS.labels(name).inc()
     arrays = tuple(t._data for t in inputs)
     need_grad = (
         differentiable
@@ -238,6 +275,8 @@ def apply(name, fn, inputs, differentiable=True):
                 jax.debug.callback(
                     functools.partial(_nan_inf_callback, op_name=name), o)
             elif not bool(jnp.isfinite(o).all()):
+                if _metrics._enabled:
+                    _metrics.NAN_INF_EVENTS.labels(name).inc()
                 raise FloatingPointError(
                     f"NaN/Inf detected in output of op '{name}' "
                     f"(shape {o.shape}, dtype {o.dtype})")

@@ -192,6 +192,48 @@ class EarlyStopping(Callback):
                 self.model.stop_training = True
 
 
+class MetricsExporter(Callback):
+    """Feeds the profiler metrics registry from the fit loop: epoch and
+    batch counters plus a rolling steps/sec gauge (the gauge is also set
+    by profiler.timer.Benchmark, which sees the grouped-dispatch step
+    count; this callback covers non-fit drivers that only fire
+    callbacks). Appended by `config_callbacks` when metrics are
+    enabled; every hook is a no-op when they are off."""
+
+    def __init__(self, window=20):
+        super().__init__()
+        self.window = window
+        self._times = []
+
+    def on_train_begin(self, logs=None):
+        self._times = []
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..profiler import metrics as _metrics
+        if not _metrics._enabled:
+            return
+        _metrics.HAPI_BATCHES.labels("train").inc()
+        now = time.perf_counter()
+        self._times.append(now)
+        if len(self._times) > self.window:
+            self._times.pop(0)
+        if len(self._times) >= 2:
+            dt = self._times[-1] - self._times[0]
+            if dt > 0:
+                _metrics.STEPS_PER_SEC.set(
+                    (len(self._times) - 1) / dt)
+
+    def on_eval_batch_end(self, step, logs=None):
+        from ..profiler import metrics as _metrics
+        if _metrics._enabled:
+            _metrics.HAPI_BATCHES.labels("eval").inc()
+
+    def on_epoch_end(self, epoch, logs=None):
+        from ..profiler import metrics as _metrics
+        if _metrics._enabled:
+            _metrics.HAPI_EPOCHS.inc()
+
+
 class VisualDL(Callback):
     """Placeholder parity shim — logs scalars to a jsonl file."""
 
@@ -214,11 +256,15 @@ class VisualDL(Callback):
 def config_callbacks(callbacks=None, model=None, batch_size=None,
                      epochs=None, steps=None, log_freq=2, verbose=2,
                      save_freq=1, save_dir=None, metrics=None, mode="train"):
+    from ..profiler import metrics as _metrics
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
         cbks.append(ProgBarLogger(log_freq, verbose=verbose))
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks.append(LRScheduler())
+    if _metrics._enabled and not any(isinstance(c, MetricsExporter)
+                                     for c in cbks):
+        cbks.append(MetricsExporter())
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks.append(ModelCheckpoint(save_freq, save_dir))
     lst = CallbackList(cbks)

@@ -338,7 +338,8 @@ class Model:
                             is_last:
                         _n = len(pending)
                         flush()
-                        _bm.after_step(num_samples=_n * _bs)
+                        _bm.after_step(num_samples=_n * _bs,
+                                       num_steps=_n)
                     if is_last:
                         break
                     continue

@@ -16,6 +16,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..core import autograd
 from ..core import random as rng_mod
+from . import functional
 from .functional import bind_arrays, split_state
 from .trainer import CompiledTrainStep, CompiledEvalStep  # noqa: F401
 from . import dy2static  # noqa: F401
@@ -65,7 +66,9 @@ class StaticFunction:
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 return [o._data if isinstance(o, Tensor) else o
                         for o in outs], not isinstance(out, (list, tuple))
-            jit_run = jax.jit(run, static_argnums=())
+            jit_run = functional.instrumented_jit(
+                run, f"to_static/{type(layer).__name__}",
+                static_argnums=())
             self._p_tensors, self._b_tensors = p_tensors, b_tensors
 
             def call(*args):
@@ -86,7 +89,8 @@ class StaticFunction:
             outs = out if isinstance(out, (list, tuple)) else [out]
             return [o._data if isinstance(o, Tensor) else o
                     for o in outs], not isinstance(out, (list, tuple))
-        jit_run = jax.jit(run)
+        jit_run = functional.instrumented_jit(
+            run, f"to_static/{getattr(self._fn, '__name__', 'fn')}")
 
         def call(*args):
             arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)

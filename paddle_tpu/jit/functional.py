@@ -10,6 +10,8 @@ layer's Parameters/buffers and trace the ordinary eager forward under
 from __future__ import annotations
 
 import contextlib
+import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +19,43 @@ import jax.numpy as jnp
 from ..core import autograd
 from ..core import random as rng_mod
 from ..core.tensor import Tensor
+from ..profiler import metrics as _metrics
+
+
+def instrumented_jit(fn, name, **jit_kwargs):
+    """`jax.jit` with compile accounting: when profiler metrics are
+    enabled, calls that trigger a fresh trace+compile (detected via the
+    jitted callable's compilation-cache size) increment
+    paddle_tpu_jit_compiles_total{fn=name} and add their wall time to
+    paddle_tpu_jit_compile_seconds_total{fn=name}. Disabled, the wrapper
+    is one branch over the plain jitted call."""
+    jitted = jax.jit(fn, **jit_kwargs)
+    cache_size = getattr(jitted, "_cache_size", None)
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        if not _metrics._enabled or cache_size is None:
+            return jitted(*args, **kwargs)
+        try:
+            before = cache_size()
+        except Exception:
+            return jitted(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        try:
+            compiled = cache_size() - before
+        except Exception:
+            compiled = 0
+        if compiled > 0:
+            _metrics.JIT_COMPILES.labels(name).inc(compiled)
+            # dt spans trace+compile+first execution — the honest cost
+            # of hitting an uncompiled signature
+            _metrics.JIT_COMPILE_SECONDS.labels(name).inc(dt)
+        return out
+
+    call._jitted = jitted
+    return call
 
 
 @contextlib.contextmanager

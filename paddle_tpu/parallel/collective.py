@@ -15,6 +15,9 @@ the performance path fuses collectives inside jitted steps (pjit/GSPMD).
 """
 from __future__ import annotations
 
+import functools
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -22,7 +25,51 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..ops._helpers import as_tensor
+from ..profiler import metrics as _metrics
 from . import env as dist_env
+
+
+def _payload_nbytes(x):
+    """Best-effort payload size of one collective argument."""
+    if x is None:
+        return 0
+    if isinstance(x, Tensor):
+        x = x._data
+    if isinstance(x, (list, tuple)):
+        return sum(_payload_nbytes(v) for v in x)
+    size = getattr(x, "size", None)
+    dtype = getattr(x, "dtype", None)
+    if size is not None and dtype is not None:
+        return int(size) * np.dtype(dtype).itemsize
+    try:
+        return np.asarray(x).nbytes
+    except Exception:
+        return 0
+
+
+def _instrumented(kind, payload_arg=0, payload_kw="tensor",
+                  count_bytes=True):
+    """Count calls / payload bytes / wall seconds per collective when
+    metrics are enabled; one branch per call when off. count_bytes=False
+    for pure synchronization calls (wait) that move no data."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrap(*args, **kwargs):
+            if not _metrics._enabled:
+                return fn(*args, **kwargs)
+            _metrics.COLLECTIVE_CALLS.labels(kind).inc()
+            if count_bytes:
+                payload = args[payload_arg] if len(args) > payload_arg \
+                    else kwargs.get(payload_kw)
+                _metrics.COLLECTIVE_BYTES.labels(kind).inc(
+                    _payload_nbytes(payload))
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            _metrics.COLLECTIVE_SECONDS.labels(kind).observe(
+                time.perf_counter() - t0)
+            return out
+        return wrap
+    return deco
 
 
 class ReduceOp:
@@ -162,6 +209,7 @@ def _mp_collect(local_arr, kind, src=0):
     return np.asarray(jax.device_get(fn(garr)))
 
 
+@_instrumented("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In the single-controller SPMD view, an eager all_reduce over the
     device world is an identity on a replicated tensor; for tensors carrying
@@ -189,6 +237,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return t
 
 
+@_instrumented("all_gather", payload_arg=1)
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     t = as_tensor(tensor)
     g = _get_group(group)
@@ -203,6 +252,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@_instrumented("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     t = as_tensor(tensor)
     if _multiproc():
@@ -215,9 +265,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # not decorated: delegates to all_reduce, which does the accounting
     return all_reduce(tensor, op, group)
 
 
+@_instrumented("scatter", payload_arg=1, payload_kw="tensor_list")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
         rank = dist_env.get_rank()
@@ -225,6 +277,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented("all_to_all", payload_arg=1, payload_kw="in_tensor_list")
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     for t in in_tensor_list:
         out_tensor_list.append(as_tensor(t))
@@ -243,6 +296,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
         "backend; within one host use pipeline_parallel (ppermute)")
 
 
+@_instrumented("wait", count_bytes=False)
 def wait(tensor, group=None, use_calc_stream=True):
     jax.block_until_ready(as_tensor(tensor)._data)
 

@@ -34,6 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..jit.functional import instrumented_jit
+from ..profiler import metrics as _metrics
+
 
 @dataclasses.dataclass
 class GPTConfig:
@@ -746,6 +749,39 @@ def _apply_updates(cfg: GPTConfig, mesh, params, grads, opt_state, lr, t):
 # --------------------------------------------------------------- driver
 
 
+def collective_bytes_per_step(cfg: GPTConfig, batch: int):
+    """Analytic LOGICAL payload bytes per train step for the collectives
+    GSPMD/shard_map compiles into the hybrid step (the compiled path
+    fuses them into the executable, so the eager accounting in
+    parallel/collective.py never sees them). Returns {label: bytes};
+    wire bytes differ by the usual ring factors (all-reduce moves
+    ~2(n-1)/n of payload over ICI). Single-chip configs (dp=pp=mp=1,
+    zero off) honestly report no collective traffic."""
+    d, L, S, V = cfg.d_model, cfg.n_layers, cfg.seq_len, cfg.vocab_size
+    act_bytes = jnp.dtype(cfg.compute_dtype).itemsize
+    n_params = 12 * L * d * d + V * d + S * d
+    out = {}
+    if cfg.mp > 1:
+        # fwd: embedding psum + 2 psums/layer (attn out, mlp out) +
+        # vocab-parallel CE psums; bwd mirrors them (x2)
+        fwd = (2 * L + 1) * batch * S * d * act_bytes \
+            + 3 * batch * S * 4
+        out["mp_psum_est"] = 2 * fwd
+    if cfg.dp > 1:
+        g_bytes = act_bytes if cfg.bf16_grads else 4
+        out["dp_grad_allreduce_est"] = n_params * g_bytes
+    if cfg.pp > 1:
+        # per-tick activation ppermute over the pp ring, fwd + bwd
+        Bm = max(batch // max(cfg.micro_batches, 1), 1)
+        out["pp_ppermute_est"] = (2 * cfg.micro_batches * cfg.pp
+                                  * Bm * S * d * act_bytes)
+    if cfg.zero_stage >= 1 and cfg.dp * cfg.pp * cfg.mp > 1:
+        # optimizer update: grads reduce-scatter in, params all-gather
+        # out, fp32 flat buffers; a world of 1 shards nothing
+        out["zero_shard_est"] = 2 * n_params * 4
+    return out
+
+
 class HybridGPT:
     """Builds the mesh + ONE compiled hybrid train step.
 
@@ -798,9 +834,10 @@ class HybridGPT:
                                                grads, opt_state, lr, t)
             return params, opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._step = instrumented_jit(step, "HybridGPT.train_step",
+                                      donate_argnums=(0, 1))
         self._loss_sm = loss_sm
-        self._loss_jit = jax.jit(loss_sm)
+        self._loss_jit = instrumented_jit(loss_sm, "HybridGPT.loss")
 
         def steps_k(params, opt_state, tokens, labels, lr, t0, k):
             """K training steps as ONE executable (lax.scan over the
@@ -815,8 +852,9 @@ class HybridGPT:
                 jnp.arange(k, dtype=jnp.float32))
             return params, opt_state, losses
 
-        self._steps_k = jax.jit(steps_k, static_argnums=(6,),
-                                donate_argnums=(0, 1))
+        self._steps_k = instrumented_jit(steps_k, "HybridGPT.train_many",
+                                         static_argnums=(6,),
+                                         donate_argnums=(0, 1))
 
     def init(self, key):
         with self.mesh:
@@ -839,11 +877,22 @@ class HybridGPT:
     def loss(self, params, tokens, labels):
         return self._loss_jit(params, tokens, labels)
 
+    def collective_bytes_per_step(self, batch):
+        return collective_bytes_per_step(self.cfg, batch)
+
+    def _record_collectives(self, tokens, steps=1):
+        batch = int(tokens.shape[0])
+        for label, nbytes in self.collective_bytes_per_step(batch).items():
+            _metrics.COLLECTIVE_CALLS.labels(label).inc(steps)
+            _metrics.COLLECTIVE_BYTES.labels(label).inc(nbytes * steps)
+
     def train_step(self, params, opt_state, tokens, labels, lr=None,
                    step_num=1):
         lr = jnp.asarray(lr if lr is not None else self.cfg.learning_rate,
                          jnp.float32)
         t = jnp.asarray(step_num, jnp.float32)
+        if _metrics._enabled:
+            self._record_collectives(tokens)
         return self._step(params, opt_state, tokens, labels, lr, t)
 
     def train_many(self, params, opt_state, tokens, labels, k, lr=None,
@@ -853,5 +902,7 @@ class HybridGPT:
         lr = jnp.asarray(lr if lr is not None else self.cfg.learning_rate,
                          jnp.float32)
         t0 = jnp.asarray(start_step, jnp.float32)
+        if _metrics._enabled:
+            self._record_collectives(tokens, steps=int(k))
         return self._steps_k(params, opt_state, tokens, labels, lr, t0,
                              int(k))

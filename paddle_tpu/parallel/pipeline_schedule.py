@@ -52,6 +52,7 @@ buffer of the elementwise-max shape.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,38 @@ from ..core import random as rng_mod
 from ..core.tensor import Tensor
 from ..jit.functional import bind_arrays
 from ..nn.layer_base import Layer
+from ..profiler import metrics as _metrics
+
+
+def schedule_bubble_ticks(schedule, pp, v, M):
+    """Per-stage idle schedule ticks, host-side mirror of the compiled
+    decode formulas (module doc): returns ([bubble_ticks_per_stage], T).
+    A stage's bubble is the ticks where neither its forward nor its
+    backward slot decodes to a live (chunk, microbatch) pair — the
+    fill/drain cost the 1F1B interleave amortises by 1/v."""
+    if schedule == "gpipe":
+        T = M + pp - 1
+        return [T - M] * pp, T
+    gM, rM = (M - 1) // pp, (M - 1) % pp
+    beta_max = (pp * v - 1) + gM * pp * v + (v - 1) * pp + rM + (pp - 1)
+    T = 2 * beta_max + 2
+    bubbles = []
+    for d in range(pp):
+        active = 0
+        for t in range(T):
+            if t % 2 == 0:
+                u = t // 2 - d
+            else:
+                u = (t - 1) // 2 - (pp * v - 1) - (pp - 1 - d)
+            if u < 0:
+                continue
+            r = u % pp
+            q = (u - r) // pp
+            g = (q - q % v) // v
+            if g >= 0 and g * pp + r < M:
+                active += 1
+        bubbles.append(T - active)
+    return bubbles, T
 
 
 def _stage_param_tensors(stage_layers):
@@ -178,6 +211,13 @@ class CompiledPipeline:
         if self.stage_local:
             self._build_flat_layout()
         self._compiled = {}
+        if _metrics._enabled:
+            bubbles, T = schedule_bubble_ticks(self.schedule, self.pp,
+                                               self.v, self.M)
+            for d, b in enumerate(bubbles):
+                _metrics.PIPELINE_BUBBLE_TICKS.labels(str(d)).set(b)
+            _metrics.PIPELINE_BUBBLE_RATIO.set(
+                sum(bubbles) / max(T * self.pp, 1))
 
     # ---------------------------------------------- stage-local layout
 
@@ -624,6 +664,15 @@ class CompiledPipeline:
         """Returns (loss: float, grads: per-chunk lists of arrays).
         Train-mode buffer updates (BN running stats) are written back to
         the layer's buffer tensors."""
+        if _metrics._enabled:
+            t0 = time.perf_counter()
+            out = self._loss_and_grads(x, labels)
+            _metrics.PIPELINE_STEP_SECONDS.observe(
+                time.perf_counter() - t0)
+            return out
+        return self._loss_and_grads(x, labels)
+
+    def _loss_and_grads(self, x, labels):
         x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         labels = labels._data if isinstance(labels, Tensor) \
             else jnp.asarray(labels)

@@ -8,12 +8,16 @@ chrome-trace/perfetto file.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import threading
 import time
 from enum import Enum
+
+from . import metrics
+from .metrics import REGISTRY as metrics_registry  # noqa: F401
 
 
 class ProfilerTarget(Enum):
@@ -30,14 +34,28 @@ class ProfilerState(Enum):
 
 
 class _HostEventRecorder:
-    """Ring-buffer span recorder (host_event_recorder.h parity)."""
+    """Bounded ring-buffer span recorder (host_event_recorder.h parity).
 
-    def __init__(self):
-        self.events = []
+    Keeps the newest `maxlen` spans; when full, the oldest span is
+    dropped and counted (`.dropped` + the
+    paddle_tpu_profiler_host_events_dropped_total metric) — an
+    unbounded recorder would grow without limit across a long fit."""
+
+    def __init__(self, maxlen=None):
+        if maxlen is None:
+            maxlen = int(os.environ.get(
+                "PADDLE_TPU_PROFILER_EVENTS_MAX", 65536))
+        self.maxlen = max(1, int(maxlen))
+        self.events = collections.deque(maxlen=self.maxlen)
+        self.dropped = 0
         self.lock = threading.Lock()
 
     def add(self, name, start, end, tid):
         with self.lock:
+            if len(self.events) == self.maxlen:
+                self.dropped += 1
+                if metrics._enabled:
+                    metrics.HOST_EVENTS_DROPPED.inc()
             self.events.append(
                 {"name": name, "ph": "X", "ts": start * 1e6,
                  "dur": (end - start) * 1e6, "pid": os.getpid(),
@@ -45,7 +63,8 @@ class _HostEventRecorder:
 
     def clear(self):
         with self.lock:
-            self.events = []
+            self.events.clear()
+            self.dropped = 0
 
 
 _recorder = _HostEventRecorder()
@@ -58,14 +77,20 @@ class RecordEvent:
     def __init__(self, name, event_type=None):
         self.name = name
         self._start = None
+        self._tid = None
 
     def begin(self):
         self._start = time.perf_counter()
+        # the OPENING thread's real id: spans begun on worker threads
+        # must land on their own trace row, and a span handed across
+        # threads belongs to the thread that started it
+        self._tid = threading.get_ident()
 
     def end(self):
         if self._start is not None and _recording[0]:
             _recorder.add(self.name, self._start, time.perf_counter(),
-                          threading.get_ident())
+                          self._tid if self._tid is not None
+                          else threading.get_ident())
         self._start = None
 
     def __enter__(self):
@@ -98,8 +123,14 @@ def export_chrome_tracing(dir_name, worker_name=None):
         os.makedirs(dir_name, exist_ok=True)
         path = os.path.join(
             dir_name, f"{worker_name or 'worker'}_{int(time.time())}.json")
+        # host spans + one counter-event sample per metric series, so
+        # the trace viewer shows dispatch/cache/collective counters on
+        # the same timeline as the RecordEvent rows
+        events = list(_recorder.events)
+        if metrics._enabled:
+            events += metrics.REGISTRY.chrome_counter_events()
         with open(path, "w") as f:
-            json.dump({"traceEvents": _recorder.events}, f)
+            json.dump({"traceEvents": events}, f)
     return handler
 
 
@@ -178,13 +209,34 @@ from .timer import benchmark, Benchmark  # noqa: E402,F401
 
 def _full_summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                   time_unit="ms"):
-    """profiler_statistic.py-parity tables: host spans + device ops."""
+    """profiler_statistic.py-parity tables: host spans + device ops +
+    (when instrumentation is on) the metrics registry snapshot."""
     out = statistic_report(
-        _recorder.events,
+        list(_recorder.events),
         trace_dir=self._trace_dir,
         sorted_by=sorted_by or SortedKeys.CPUTotal)
+    if metrics._enabled:
+        out = out + "\n\n" + metrics.REGISTRY.render_table()
     print(out)
     return out
 
 
 Profiler.summary = _full_summary
+
+
+def summary(sorted_by=None, trace_dir=None, top_k=30):
+    """ONE merged observability report: host RecordEvent span tables,
+    the metrics registry snapshot (dispatch counts, VJP-jit cache hit
+    rate, jit compile time, collective bytes, throughput gauges), and —
+    when `trace_dir` points at a jax.profiler capture — the device-plane
+    op table. Module-level counterpart of `Profiler.summary` that works
+    without a Profiler instance."""
+    parts = [statistic_report(list(_recorder.events),
+                              trace_dir=trace_dir,
+                              sorted_by=sorted_by or SortedKeys.CPUTotal,
+                              top_k=top_k)]
+    if _recorder.dropped:
+        parts.append(f"(host ring buffer dropped {_recorder.dropped} "
+                     f"spans; raise PADDLE_TPU_PROFILER_EVENTS_MAX)")
+    parts.append(metrics.REGISTRY.render_table())
+    return "\n\n".join(parts)

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import time
 
+from . import metrics as _metrics
+
 
 class _Stat:
     def __init__(self):
@@ -57,13 +59,22 @@ class Benchmark:
         if self._reader_start is not None:
             self._reader.add(time.perf_counter() - self._reader_start)
 
-    def after_step(self, num_samples=None):
+    def after_step(self, num_samples=None, num_steps=1):
+        """num_steps > 1 when one dispatch covered a grouped flush of
+        several train steps (hapi's run_many path)."""
         now = time.perf_counter()
         if self._last is not None:
             dt = now - self._last
             self._batch.add(dt)
             if num_samples and dt > 0:
                 self._ips.add(num_samples / dt)
+            if _metrics._enabled:
+                # every fit path funnels through this hook, so the
+                # throughput gauges cover per-step AND grouped dispatch
+                b = self._batch.window_avg
+                if b > 0:
+                    _metrics.STEPS_PER_SEC.set(max(num_steps, 1) / b)
+                _metrics.SAMPLES_PER_SEC.set(self._ips.window_avg)
         self._last = now
         self._reader_start = now
 
