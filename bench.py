@@ -313,6 +313,93 @@ def bench_decode():
     return tps, None, extra  # bandwidth-bound; MFU not meaningful
 
 
+def bench_serving():
+    """Continuous batching (paddle_tpu.serving) vs sequential
+    one-request-at-a-time generation.py on the SAME synthetic Poisson
+    request stream (tiny GPT — runs on CPU too). Driver contract:
+    speedup_vs_sequential >= 2.0 sustained, mixed_step_compiles == 1
+    across the whole run (admissions/evictions never retrace)."""
+    import time as _time
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.batcher import next_pow2
+    from paddle_tpu.serving.engine import ServingEngine, STEP_FN_NAME
+
+    rng = np.random.RandomState(0)
+    V, T_new, N = 1024, 16, 24
+    m = GPTForGeneration(vocab_size=V, hidden_size=128, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=512,
+                         compute_dtype="float32")
+    m.eval()
+    lens = rng.randint(4, 40, N)
+    prompts = [rng.randint(1, V, int(n)).astype(np.int32) for n in lens]
+    arrivals = np.cumsum(rng.exponential(0.002, N))  # Poisson stream
+    arrivals -= arrivals[0]
+
+    was_enabled = pm._enabled
+    pm.enable()
+    try:
+        eng = ServingEngine(m, max_slots=8, block_size=16,
+                            max_seq_len=128, cache_dtype="float32",
+                            seed=0)
+        # warm: compiles the ONE mixed step; the timed stream reuses it
+        eng.generate_batch([prompts[0]], max_new_tokens=2)
+
+        t0 = _time.perf_counter()
+        pending = list(zip(prompts, arrivals))
+        reqs = []
+        while pending or eng.scheduler.has_work:
+            now = _time.perf_counter() - t0
+            while pending and pending[0][1] <= now:
+                p, _ = pending.pop(0)
+                reqs.append(eng.submit(p, T_new))
+            if not eng.step() and pending:
+                _time.sleep(max(0.0, pending[0][1]
+                                 - (_time.perf_counter() - t0)))
+        serve_wall = _time.perf_counter() - t0
+        served_tokens = sum(len(r.output) for r in reqs)
+        lat = sorted(r.finish_time - r.submit_time for r in reqs)
+        compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+        preempts = eng.scheduler.preemption_count
+    finally:
+        if not was_enabled:
+            pm.disable()
+
+    # sequential baseline: one generate() per request in arrival order,
+    # started at max(arrival, previous finish); warm each prompt bucket
+    # so neither side pays compiles inside the timed region
+    for b in sorted({next_pow2(len(p)) for p in prompts}):
+        m.generate(Tensor(np.ones((1, b), np.int32)),
+                   max_new_tokens=T_new, cache_dtype="float32")
+    t = 0.0
+    finish = []
+    for p, a in zip(prompts, arrivals):
+        s0 = _time.perf_counter()
+        out, _ = m.generate(Tensor(np.asarray(p)[None]),
+                            max_new_tokens=T_new, cache_dtype="float32")
+        np.asarray(out.numpy())
+        dt = _time.perf_counter() - s0
+        t = max(t, a) + dt
+        finish.append(t)
+    seq_tokens = N * T_new
+    seq_tput = float(seq_tokens / (finish[-1] - arrivals[0]))
+    serve_tput = float(served_tokens / serve_wall)
+    return {
+        "metric": "serving_continuous_batching",
+        "value": round(serve_tput, 1), "unit": "tokens/sec",
+        "sequential_tokens_per_sec": round(seq_tput, 1),
+        "speedup_vs_sequential": round(serve_tput / seq_tput, 3),
+        "p50_latency_s": round(lat[len(lat) // 2], 4),
+        "p99_latency_s": round(lat[min(len(lat) - 1,
+                                       int(len(lat) * 0.99))], 4),
+        "requests": N, "mixed_step_compiles": int(compiles),
+        "preemptions": int(preempts),
+    }
+
+
 def _metrics_extra():
     """Condensed observability snapshot for the benchmark JSON `extras`
     (only when PADDLE_TPU_METRICS is set — instrumentation off keeps the
@@ -396,6 +483,19 @@ def main():
                 "mfu": round(mfu, 4) if mfu else None})
             if extra_metric is not None:
                 result["extras"].append(extra_metric)
+
+    # serving extra runs on every platform (CPU tiny GPT): the
+    # continuous-batching >= 2x-vs-sequential contract
+    if _budget_left() < 60:
+        result["extras"].append({"metric": "serving_continuous_batching",
+                                 "skipped": "time budget"})
+    else:
+        try:
+            result["extras"].append(bench_serving())
+        except Exception as e:  # noqa: BLE001
+            result["extras"].append(
+                {"metric": "serving_continuous_batching",
+                 "error": f"{type(e).__name__}: {e}"})
 
     obs = _metrics_extra()
     if obs is not None:

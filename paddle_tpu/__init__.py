@@ -50,6 +50,7 @@ from . import models  # noqa
 from . import autograd_api as autograd  # noqa — paddle.autograd
 from . import onnx  # noqa
 from . import inference  # noqa
+from . import serving  # noqa — continuous-batching engine
 from . import hub  # noqa
 from . import quantization  # noqa
 from . import text  # noqa

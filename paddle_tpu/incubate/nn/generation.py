@@ -18,56 +18,21 @@ TPU-native shape discipline — everything is compiled exactly once:
 """
 from __future__ import annotations
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
 from ...ops._helpers import as_tensor
-
-
-@dataclasses.dataclass(frozen=True)
-class SamplingConfig:
-    strategy: str = "greedy"       # "greedy" | "sampling"
-    temperature: float = 1.0
-    top_k: int = 0                 # 0 = off
-    top_p: float = 1.0             # 1.0 = off
-
-
-def _select_token(logits, key, sc: SamplingConfig):
-    """logits [B, V] -> token [B] int32 (device-side sampling)."""
-    logits = logits.astype(jnp.float32)
-    if sc.strategy == "greedy":
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if sc.temperature != 1.0:
-        logits = logits / max(sc.temperature, 1e-6)
-    if sc.top_k and sc.top_k > 0:
-        kth = jax.lax.top_k(logits, sc.top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -1e9, logits)
-    if sc.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest prefix with cumulative prob >= top_p; the
-        # cutoff is the SMALLEST kept logit
-        keep = cum - probs < sc.top_p
-        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
-                      keepdims=True)
-        logits = jnp.where(logits < kth, -1e9, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
-
-
-def _next_pow2(n, lo=16):
-    p = lo
-    while p < n:
-        p *= 2
-    return p
-
-
-def _round_up(n, m):
-    return ((n + m - 1) // m) * m
+# the sampling head + shape-bucket discipline are shared with the
+# continuous-batching engine; they live in serving.batcher (kept
+# importable here under their historical names)
+from ...serving.batcher import (
+    SamplingConfig,
+    next_pow2 as _next_pow2,
+    round_up as _round_up,
+    select_token as _select_token,
+)
 
 
 class GenerationMixin:
@@ -153,8 +118,12 @@ class GenerationMixin:
                  decode_strategy="greedy", temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, seed=None, use_scan=True,
                  cache_dtype=None, seq_lens=None):
-        """Returns (ids [B, max_new_tokens], scores=None). Greedy or
-        sampling; compiled prefill + compiled decode (see module doc).
+        """Returns (ids [B, max_new_tokens], gen_lens [B]). `gen_lens`
+        is each row's ACTUAL generated length — up to and including its
+        first EOS (max_new_tokens when the row never emits EOS or no
+        `eos_token_id` is given); positions past it are EOS padding.
+        Greedy or sampling; compiled prefill + compiled decode (see
+        module doc).
 
         `seq_lens` [B] gives each row's true (unpadded) prompt length for
         ragged right-padded batches; without it every row is assumed to
@@ -219,15 +188,21 @@ class GenerationMixin:
         tok, kv = fns["prefill"](arrays, jnp.asarray(padded), seq_lens,
                                  sub)
         if max_new_tokens == 1:
-            return Tensor(tok[:, None]), None
+            ids = tok[:, None]
+            return Tensor(ids), Tensor(_gen_lens_jnp(ids, eos_token_id))
         if use_scan:
             toks, _ = fns["decode_scan"](arrays, kv, tok, seq_lens, rng)
-            return Tensor(toks), None
-        # python loop (streaming / early-exit) over the one jitted step
+            return Tensor(toks), Tensor(_gen_lens_jnp(toks,
+                                                      eos_token_id))
+        # python loop (streaming / early-exit) over the one jitted step:
+        # stops as soon as EVERY row has emitted EOS (checked before
+        # each step, so an all-EOS prefill token runs zero decode steps)
         out = [np.asarray(tok)]
         finished = (out[0] == eos_token_id) if eos_token_id is not None \
             else np.zeros((B,), bool)
         for i in range(max_new_tokens - 1):
+            if eos_token_id is not None and finished.all():
+                break
             rng, sub = jax.random.split(rng)
             pos = (seq_lens[0] + jnp.int32(i)) if uniform \
                 else seq_lens + jnp.int32(i)
@@ -237,11 +212,31 @@ class GenerationMixin:
                 t_np = np.where(finished, eos_token_id, t_np)
                 finished |= t_np == eos_token_id
             out.append(t_np)
-            if eos_token_id is not None and finished.all():
-                break
         toks = np.stack(out, axis=1)
         if toks.shape[1] < max_new_tokens and eos_token_id is not None:
             pad = np.full((B, max_new_tokens - toks.shape[1]),
                           eos_token_id, np.int32)
             toks = np.concatenate([toks, pad], axis=1)
-        return Tensor(jnp.asarray(toks)), None
+        return Tensor(jnp.asarray(toks)), \
+            Tensor(jnp.asarray(_gen_lens_np(toks, eos_token_id)))
+
+
+def _gen_lens_np(toks, eos_id):
+    """[B, M] generated ids -> [B] int32 actual lengths (first EOS
+    inclusive; M when absent)."""
+    B, M = toks.shape
+    if eos_id is None:
+        return np.full((B,), M, np.int32)
+    hit = toks == eos_id
+    first = np.argmax(hit, axis=1)
+    return np.where(hit.any(axis=1), first + 1, M).astype(np.int32)
+
+
+def _gen_lens_jnp(toks, eos_id):
+    """Device-side twin of `_gen_lens_np` (scan path: no host sync)."""
+    B, M = toks.shape
+    if eos_id is None:
+        return jnp.full((B,), M, jnp.int32)
+    hit = toks == eos_id
+    first = jnp.argmax(hit, axis=1)
+    return jnp.where(hit.any(axis=1), first + 1, M).astype(jnp.int32)

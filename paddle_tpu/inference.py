@@ -16,13 +16,37 @@ from .core.tensor import Tensor
 
 
 class Config:
-    """AnalysisConfig parity (the knobs that are meaningful on TPU)."""
+    """AnalysisConfig parity (the knobs that are meaningful on TPU),
+    plus the continuous-batching serving knobs
+    (`enable_continuous_batching` -> `create_serving_engine`)."""
 
     def __init__(self, model_prefix=None, params_file=None):
         self.model_prefix = model_prefix
         self._use_tpu = True
         self._threads = 1
         self._ir_optim = True
+        self._serving = None
+
+    # -- continuous batching (paddle_tpu.serving) -------------------------
+    def enable_continuous_batching(self, max_slots=None, block_size=None,
+                                   num_blocks=None, max_seq_len=None,
+                                   token_budget=None, eos_token_id=None,
+                                   cache_dtype=None):
+        """Opt the predictor surface into the paged-KV continuous
+        batching engine (docs/SERVING.md). The knobs mirror
+        `serving.ServingEngine`; None keeps the engine default."""
+        self._serving = dict(
+            max_slots=max_slots, block_size=block_size,
+            num_blocks=num_blocks, max_seq_len=max_seq_len,
+            token_budget=token_budget, eos_token_id=eos_token_id,
+            cache_dtype=cache_dtype)
+        return self
+
+    def continuous_batching_enabled(self):
+        return self._serving is not None
+
+    def serving_config(self):
+        return dict(self._serving) if self._serving else None
 
     # gpu/trt/mkldnn switches accepted as no-ops: XLA owns optimization
     def enable_use_gpu(self, memory_mb=100, device_id=0):
@@ -101,3 +125,19 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_engine(config: Config, model, sampling=None, seed=0):
+    """Build a continuous-batching `serving.ServingEngine` from an
+    `enable_continuous_batching()` config and a causal-LM serving model
+    (`models.gpt.GPTForGeneration` or anything exposing the same
+    `_gen_tensors`/decoder contract). This is the batch-serving mode of
+    the AnalysisPredictor surface: one resident engine, many concurrent
+    requests, instead of one `Predictor.run` per fixed-shape batch."""
+    if not config.continuous_batching_enabled():
+        raise ValueError(
+            "call config.enable_continuous_batching(...) first")
+    from .serving.engine import ServingEngine
+    kw = {k: v for k, v in config.serving_config().items()
+          if v is not None}
+    return ServingEngine(model, sampling=sampling, seed=seed, **kw)

@@ -37,6 +37,9 @@ DEFAULT_BLOCK_K = 256
 
 _SPLASH_CACHE = {}
 
+# (seq_len, head_dim) combos the installed kernel refused at trace time
+_SPLASH_REFUSED = set()
+
 # Set by tests to run the splash kernel in Pallas interpret mode on the
 # CPU mesh (exercises the real mask/segment plumbing without a TPU).
 _INTERPRET = False
@@ -150,18 +153,25 @@ def splash_mha(q, k, v, *, causal=True, scale=None, kv_keep=None,
             f"got q H={h}, k H={k.shape[1]}, v H={v.shape[1]}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if splash_supported(s, d):
-        qs = (q * scale).astype(q.dtype)
-        rc = SPLASH_RESIDUAL_NAME if save_residuals_for_remat else None
-        if kv_keep is not None:
-            from jax.experimental.pallas.ops.tpu.splash_attention import (
-                splash_attention_kernel as sk)
-            seg = kv_keep.astype(jnp.int32)
-            kern = _splash_kernel(h, s, causal, segmented=True,
-                                  residual_ckpt=rc)
-            return kern(qs, k, v, sk.SegmentIds(q=seg, kv=seg))
-        kern = _splash_kernel(h, s, causal, residual_ckpt=rc)
-        return kern(qs, k, v)
+    if splash_supported(s, d) and (s, d) not in _SPLASH_REFUSED:
+        try:
+            qs = (q * scale).astype(q.dtype)
+            rc = SPLASH_RESIDUAL_NAME if save_residuals_for_remat \
+                else None
+            if kv_keep is not None:
+                from jax.experimental.pallas.ops.tpu.splash_attention \
+                    import splash_attention_kernel as sk
+                seg = kv_keep.astype(jnp.int32)
+                kern = _splash_kernel(h, s, causal, segmented=True,
+                                      residual_ckpt=rc)
+                return kern(qs, k, v, sk.SegmentIds(q=seg, kv=seg))
+            kern = _splash_kernel(h, s, causal, residual_ckpt=rc)
+            return kern(qs, k, v)
+        except NotImplementedError:
+            # the installed kernel refused the shape at trace time
+            # (e.g. jax 0.4.x tiles head_dim by 128 where newer
+            # kernels pad 64) — remember and take the XLA path
+            _SPLASH_REFUSED.add((s, d))
     mask = None
     if kv_keep is not None:
         mask = (kv_keep != 0)[:, None, None, :]  # [B, 1, 1(q), S]
@@ -297,3 +307,78 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
                       bool(causal), block_q, block_k)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# paged attention (block-paged KV cache — the serving engine's kernel)
+# ---------------------------------------------------------------------------
+
+
+def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
+                           positions, *, scale=None):
+    """Flat-token attention over a block-paged KV cache — the kernel of
+    the continuous-batching mixed step (`paddle_tpu.serving.engine`),
+    following the Ragged-Paged-Attention shape discipline: ONE fixed
+    `[T]` token axis carries an arbitrary mix of decode tokens and
+    prefill chunks, so the compiled step never retraces as requests
+    come and go.
+
+    q            [T, H, Dh]  — one query per flat token
+    k_pool/v_pool [NB, BS, H, Dh] — one layer's paged pools
+    block_tables [S, MB] int32 — per-slot block lists, NULL-padded
+    slot_ids     [T] int32 — owning slot per token (-1 = padding)
+    positions    [T] int32 — token's position in its sequence
+
+    Token t attends keys at positions <= positions[t] of its own slot
+    (padding blocks beyond the sequence are masked by construction, so
+    the NULL-block garbage is never read through).
+
+    Pure-XLA gather reference path — runs under JAX_PLATFORMS=cpu and
+    is the parity oracle; on TPU, XLA fuses the table gather into the
+    attention einsums (a hand-tiled Pallas ragged kernel can slot in
+    behind the same signature later)."""
+    T, H, Dh = q.shape
+    BS = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
+    safe_slot = jnp.clip(slot_ids, 0, block_tables.shape[0] - 1)
+    bt = block_tables[safe_slot]                      # [T, MB]
+    S = bt.shape[1] * BS
+    k = k_pool[bt].reshape(T, S, H, Dh).astype(q.dtype)
+    v = v_pool[bt].reshape(T, S, H, Dh).astype(q.dtype)
+    logits = jnp.einsum("thd,tshd->ths", q, k).astype(jnp.float32)
+    logits = logits * scale
+    keep = jnp.arange(S)[None, :] <= positions[:, None]   # [T, S]
+    logits = jnp.where(keep[:, None, :], logits, -1e9)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("ths,tshd->thd", p, v)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
+                    scale=None):
+    """Decode-shaped paged attention: q [B, H, Dh], one query per
+    sequence, attending its first `context_lens[b]` cached tokens.
+
+    On a TPU backend with lane-aligned shapes this dispatches to jax's
+    Pallas paged-attention kernel (the production path); everywhere
+    else it runs the pure-XLA gather reference above."""
+    B, H, Dh = q.shape
+    MB = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
+    if _on_tpu_backend() and not _INTERPRET and Dh % 128 == 0 \
+            and k_pool.shape[1] % 16 == 0:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _kernel)
+        ppcb = next(d for d in (8, 4, 2, 1) if MB % d == 0)
+        out = _kernel(
+            (q * scale).astype(q.dtype),
+            jnp.transpose(k_pool, (2, 0, 1, 3)),
+            jnp.transpose(v_pool, (2, 0, 1, 3)),
+            context_lens.astype(jnp.int32), block_tables,
+            pages_per_compute_block=ppcb)
+        return out
+    return ragged_paged_attention(
+        q, k_pool, v_pool, block_tables,
+        jnp.arange(B, dtype=jnp.int32),
+        context_lens.astype(jnp.int32) - 1, scale=scale)

@@ -27,6 +27,7 @@ from ..core.tensor import Tensor
 from ..ops._helpers import as_tensor
 from ..profiler import metrics as _metrics
 from . import env as dist_env
+from . import shard_map as _shard_map
 
 
 def _payload_nbytes(x):
@@ -127,7 +128,7 @@ def get_group(gid=0):
 def _spmd(fn, x, n):
     """Run fn over a length-n leading 'rank' axis with an axis name."""
     mesh = dist_env.global_mesh({"r": n})
-    return jax.shard_map(fn, mesh=mesh, in_specs=P("r"), out_specs=P("r"))(x)
+    return _shard_map(fn, mesh=mesh, in_specs=P("r"), out_specs=P("r"))(x)
 
 
 # --------------------------------------------------------------------------

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..jit.functional import instrumented_jit
 from ..profiler import metrics as _metrics
+from . import shard_map as _shard_map
 
 
 @dataclasses.dataclass
@@ -806,7 +807,7 @@ class HybridGPT:
         mesh = self.mesh
         data_spec = P("dp", None)
 
-        loss_sm = jax.shard_map(
+        loss_sm = _shard_map(
             lambda p, tok, lab: _loss_fn(p, tok, lab, cfg_ref),
             mesh=mesh, in_specs=(self.pspecs, data_spec, data_spec),
             out_specs=P(), check_vma=False)

@@ -65,6 +65,7 @@ from ..core.tensor import Tensor
 from ..jit.functional import bind_arrays
 from ..nn.layer_base import Layer
 from ..profiler import metrics as _metrics
+from . import shard_map as _shard_map
 
 
 def schedule_bubble_ticks(schedule, pp, v, M):
@@ -629,7 +630,7 @@ class CompiledPipeline:
         rep = P()
         if self.schedule == "gpipe" or (pp == 1 and v == 1
                                         and not stage_local):
-            loss_sm = jax.shard_map(
+            loss_sm = _shard_map(
                 gpipe_loss, mesh=self.mesh,
                 in_specs=(rep, rep, rep, rep, rep),
                 out_specs=(rep, rep), check_vma=False)
@@ -645,7 +646,7 @@ class CompiledPipeline:
         else:
             fl_spec = tuple(P("pp") for _ in range(len(
                 self._flat_dtypes))) if stage_local else rep
-            f1b_sm = jax.shard_map(
+            f1b_sm = _shard_map(
                 f1b_loss_and_grads, mesh=self.mesh,
                 in_specs=(rep, fl_spec, rep, rep, rep, rep),
                 out_specs=(rep, fl_spec if stage_local else rep, rep),

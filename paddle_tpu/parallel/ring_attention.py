@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import shard_map as _shard_map
+
 
 def _block_attn(q, k, v, scale, mask):
     """Online-softmax partials for one (q_block, k_block) pair.
@@ -94,6 +96,6 @@ def ring_attention(q, k, v, mesh=None, axis_name="cp", causal=True,
     body = functools.partial(_ring_attention_local, axis_name=axis_name,
                              cp=cp, causal=causal, scale=scale)
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

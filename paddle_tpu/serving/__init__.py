@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — paged KV-cache + continuous-batching engine.
+
+The first multi-request subsystem: a block-paged KV cache with fixed
+slot tables (`kv_cache`), a FIFO/preemption scheduler (`scheduler`),
+token-budget batching + sampling heads (`batcher`), serving metrics
+(`metrics`), and the single-compile mixed-step `ServingEngine`
+(`engine`). See docs/SERVING.md for the slot protocol.
+
+`engine` (and its model deps) load lazily so the light modules here
+can be imported from `incubate/nn/generation.py` without cycles.
+"""
+from . import batcher  # noqa: F401
+from . import kv_cache  # noqa: F401
+from . import metrics  # noqa: F401
+from . import scheduler  # noqa: F401
+from .batcher import SamplingConfig  # noqa: F401
+from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+
+__all__ = [
+    "SamplingConfig", "BlockAllocator", "PagedKVCache", "Request",
+    "Scheduler", "ServingEngine", "batcher", "kv_cache", "metrics",
+    "scheduler", "engine",
+]
+
+
+def __getattr__(name):
+    if name in ("ServingEngine", "engine"):
+        import importlib
+        import sys
+        mod = importlib.import_module(__name__ + ".engine")
+        pkg = sys.modules[__name__]
+        pkg.engine = mod
+        pkg.ServingEngine = mod.ServingEngine
+        return getattr(pkg, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

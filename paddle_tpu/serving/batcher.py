@@ -1,0 +1,166 @@
+"""Token-budget batching + sampling heads for the serving engine.
+
+This module owns the pieces `incubate/nn/generation.py` and the
+continuous-batching engine share (generation.py imports them from here):
+
+* `SamplingConfig` / `select_token` — the greedy/sampling head applied
+  to one step's logits, device-side.
+* `next_pow2` / `round_up` — the power-of-two shape discipline every
+  compiled entry point uses so shapes come from a tiny closed set.
+* `pack_step` — pack one engine iteration (decode tokens + prefill
+  chunks) into the FIXED `[token_budget]` flat-token layout of the
+  mixed step, so admission/eviction never changes a compiled shape.
+
+The flat-token step protocol (the "Ragged Paged Attention" shape
+discipline — one compiled program serves a churning request mix):
+
+    token_ids    [T] int32  — decode tokens and prefill-chunk tokens,
+                              concatenated; 0 past num_tokens
+    slot_ids     [T] int32  — owning slot per token; -1 = padding
+    positions    [T] int32  — position of the token in its sequence
+    sample_index [S] int32  — per slot, the index in [0, T) of the
+                              token whose hidden state samples that
+                              slot's next token; -1 = no sample this
+                              step (mid-prefill)
+
+Every array has the same shape every step; `block_tables` (from the
+paged KV cache) rides next to them.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    strategy: str = "greedy"       # "greedy" | "sampling"
+    temperature: float = 1.0
+    top_k: int = 0                 # 0 = off
+    top_p: float = 1.0             # 1.0 = off
+
+
+def select_token(logits, key, sc: SamplingConfig):
+    """logits [B, V] -> token [B] int32 (device-side sampling)."""
+    import jax
+    import jax.numpy as jnp
+    logits = logits.astype(jnp.float32)
+    if sc.strategy == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if sc.temperature != 1.0:
+        logits = logits / max(sc.temperature, 1e-6)
+    if sc.top_k and sc.top_k > 0:
+        kth = jax.lax.top_k(logits, sc.top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if sc.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p; the
+        # cutoff is the SMALLEST kept logit
+        keep = cum - probs < sc.top_p
+        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                      keepdims=True)
+        logits = jnp.where(logits < kth, -1e9, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def next_pow2(n, lo=16):
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+def round_up(n, m):
+    return ((n + m - 1) // m) * m
+
+
+def choose_token_budget(max_slots, block_size, requested=None):
+    """Per-step token budget: a power of two >= max(max_slots,
+    2*block_size) so a full decode round always fits and prefill chunks
+    cover at least two KV blocks per step (generation.py's bucket
+    discipline applied to the step axis). An explicit `requested`
+    budget is rounded up to a power of two and floored at `max_slots`
+    (a budget below the slot count would stall resident requests
+    forever while they hold KV blocks)."""
+    if requested is not None:
+        return next_pow2(max(int(requested), max_slots), lo=1)
+    return next_pow2(max(max_slots, 2 * block_size))
+
+
+def prefill_chunk(remaining, budget_left):
+    """Chunk size for one prefill slice under the remaining budget:
+    the whole remainder when it fits, else the largest power of two
+    <= budget_left (keeps chunk boundaries bucket-aligned so a long
+    prompt is consumed in a handful of predictable slices)."""
+    remaining = int(remaining)
+    budget_left = int(budget_left)
+    if budget_left <= 0 or remaining <= 0:
+        return 0
+    if remaining <= budget_left:
+        return remaining
+    p = 1
+    while p * 2 <= budget_left:
+        p *= 2
+    return p
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """Host-side plan for one mixed step (fixed-shape numpy arrays)."""
+    token_ids: np.ndarray       # [T] int32
+    slot_ids: np.ndarray        # [T] int32, -1 pad
+    positions: np.ndarray       # [T] int32
+    sample_index: np.ndarray    # [max_slots] int32, -1 = no sample
+    num_tokens: int             # real tokens this step
+    decode_slots: list          # slots that fed one decode token
+    prefill_done: list          # slots whose prompt completed this step
+    prefill_tokens: int
+    decode_tokens: int
+
+
+def pack_step(token_budget, max_slots, decode, prefills) -> StepPlan:
+    """Pack decode entries + prefill chunks into the flat-token layout.
+
+    decode: [(slot, token, position)] — one entry per running decode.
+    prefills: [(slot, chunk_tokens: ndarray, start_pos, completes)] —
+        `completes` marks the chunk that reaches the end of the prompt
+        (its last token's hidden state samples the slot's first output).
+    """
+    n = len(decode) + sum(len(c[1]) for c in prefills)
+    if n > token_budget:
+        raise ValueError(f"plan of {n} tokens exceeds token budget "
+                         f"{token_budget}")
+    token_ids = np.zeros(token_budget, np.int32)
+    slot_ids = np.full(token_budget, -1, np.int32)
+    positions = np.zeros(token_budget, np.int32)
+    sample_index = np.full(max_slots, -1, np.int32)
+    i = 0
+    decode_slots = []
+    for slot, tok, pos in decode:
+        token_ids[i] = tok
+        slot_ids[i] = slot
+        positions[i] = pos
+        sample_index[slot] = i
+        decode_slots.append(slot)
+        i += 1
+    prefill_done = []
+    n_prefill = 0
+    for slot, chunk, start, completes in prefills:
+        m = len(chunk)
+        token_ids[i:i + m] = chunk
+        slot_ids[i:i + m] = slot
+        positions[i:i + m] = np.arange(start, start + m, dtype=np.int32)
+        if completes:
+            sample_index[slot] = i + m - 1
+            prefill_done.append(slot)
+        i += m
+        n_prefill += m
+    return StepPlan(token_ids=token_ids, slot_ids=slot_ids,
+                    positions=positions, sample_index=sample_index,
+                    num_tokens=i, decode_slots=decode_slots,
+                    prefill_done=prefill_done,
+                    prefill_tokens=n_prefill,
+                    decode_tokens=len(decode))

@@ -1,0 +1,259 @@
+"""ServingEngine — paged-KV continuous batching over the fused GPT stack.
+
+One jitted **mixed step** serves a churning mix of requests: every
+input is a fixed-shape slot tensor (flat token ids, positions, block
+tables, per-slot sample indices), so admissions, completions,
+preemptions and ragged prompt lengths never change a compiled shape —
+the step compiles exactly ONCE per engine (asserted by
+tests/test_serving.py via the PR 1 `instrumented_jit` compile counter).
+
+The step runs the same math as `GPTForGeneration`'s compiled
+prefill/decode (`incubate/nn/generation.py`) — same `_ln`/`_mm`/
+`_qkv`/`_ffn_dense` cores from `incubate/nn/fused_transformer.py`,
+attention through `ops.pallas.flash_attention.ragged_paged_attention`
+— so serving output is token-identical to single-request
+`generate()` for the same prompts (the parity test).
+
+Host loop per `step()`:
+  scheduler.plan()  →  pack_step()  →  jitted mixed step  →  sample
+  bookkeeping (TTFT / inter-token metrics, EOS + length termination,
+  block release).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..jit.functional import instrumented_jit
+from ..profiler import metrics as _pmetrics
+from . import batcher
+from . import metrics as smetrics
+from .batcher import SamplingConfig, pack_step, select_token
+from .kv_cache import PagedKVCache
+from .scheduler import Scheduler
+
+STEP_FN_NAME = "serving_mixed_step"
+
+
+class ServingEngine:
+    def __init__(self, model, *, max_slots=8, block_size=16,
+                 num_blocks=None, max_seq_len=None, token_budget=None,
+                 sampling=None, eos_token_id=None, cache_dtype=None,
+                 seed=0, clock=time.monotonic):
+        import jax
+        import jax.numpy as jnp
+        model.eval()
+        self.model = model
+        dec = model.decoder
+        if getattr(dec, "_num_experts", 0):
+            raise NotImplementedError(
+                "MoE decoder stacks are not paged yet; serve the dense "
+                "or weight-only FusedMultiTransformer stacks")
+        L, H, Dh = dec.num_layers, dec.num_heads, dec.head_dim
+        maxpos = model.max_position_embeddings
+        max_seq_len = min(max_seq_len or maxpos, maxpos)
+        self.block_size = int(block_size)
+        mbps = -(-max_seq_len // self.block_size)
+        if num_blocks is None:
+            # full residency for every slot, + the reserved null block
+            num_blocks = max_slots * mbps + 1
+        self.token_budget = batcher.choose_token_budget(
+            max_slots, self.block_size, token_budget)
+        dtype = cache_dtype or getattr(model, "_gen_cache_dtype",
+                                       "bfloat16")
+        self.kv = PagedKVCache(
+            L, H, Dh, num_blocks=num_blocks,
+            block_size=self.block_size, max_slots=max_slots,
+            max_blocks_per_slot=mbps, dtype=dtype)
+        self.scheduler = Scheduler(self.kv, max_slots=max_slots,
+                                   token_budget=self.token_budget,
+                                   clock=clock)
+        self.sampling = sampling or SamplingConfig()
+        self.eos_token_id = eos_token_id
+        self.clock = clock
+        self._rng = jax.random.PRNGKey(int(seed))
+        # cast float params to the compute dtype ONCE (same discipline
+        # as generation.generate: a per-step astype re-reads the full
+        # parameter set every token)
+        cdt = jnp.dtype(getattr(model, "_compute_dtype", "float32"))
+        self._arrays = [a.astype(cdt)
+                        if a.dtype in (jnp.float32, jnp.float64) else a
+                        for a in (t._data for t in model._gen_tensors())]
+        self._step_fn = instrumented_jit(
+            self._build_step(), STEP_FN_NAME, donate_argnums=(1, 2))
+        self._preempt_seen = 0
+        self.steps_run = 0
+
+    # ------------------------------------------------------- mixed step
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..incubate.nn.fused_transformer import (
+            _ffn_dense, _ln, _mm, _qkv)
+        from ..ops.pallas.flash_attention import ragged_paged_attention
+
+        model = self.model
+        cfg = model.decoder._cfg()
+        names = list(model._dec_names) if hasattr(model, "_dec_names") \
+            else None
+        if names is None:
+            names, _ = model.decoder._param_tensors()
+        L = cfg.num_layers
+        BS = self.block_size
+        T = self.token_budget
+        sc = self.sampling
+
+        def step(arrays, k_pool, v_pool, token_ids, slot_ids, positions,
+                 block_tables, sample_index, rng):
+            we, pe, dec_arrays, lnw, lnb, head = \
+                model._split_arrays(arrays)
+            params = dict(zip(names, dec_arrays))
+            valid = slot_ids >= 0
+            pos = jnp.where(valid, positions, 0)
+            x = model._embed(we, pe, token_ids, pos)          # [T, D]
+            safe_slot = jnp.where(valid, slot_ids, 0)
+            # padding tokens write into the reserved NULL block
+            wb = jnp.where(valid, block_tables[safe_slot, pos // BS], 0)
+            wo = pos % BS
+
+            def layer(carry, xs):
+                h, kp, vp = carry
+                pl, li = xs
+                hn = _ln(h, pl["ln_s"], pl["ln_b"], cfg.epsilon)
+                q, k, v = _qkv(cfg, pl, hn[None])
+                q, k, v = q[0], k[0], v[0]                  # [T, H, Dh]
+                kp = kp.at[li, wb, wo].set(k.astype(kp.dtype))
+                vp = vp.at[li, wb, wo].set(v.astype(vp.dtype))
+                attn = ragged_paged_attention(
+                    q, kp[li], vp[li], block_tables, slot_ids, pos)
+                attn = attn.reshape(T, cfg.num_heads * cfg.head_dim)
+                out = _mm(cfg, attn, pl["out_w"], pl.get("out_s"))
+                out = out + pl["out_b"].astype(out.dtype)
+                h = h + out
+                hn = _ln(h, pl["ffn_ln_s"], pl["ffn_ln_b"], cfg.epsilon)
+                h = h + _ffn_dense(cfg, pl, hn)
+                return (h, kp, vp), None
+
+            (x, k_pool, v_pool), _ = jax.lax.scan(
+                layer, (x, k_pool, v_pool),
+                (params, jnp.arange(L)))
+            xf = _ln(x, lnw, lnb, cfg.epsilon)
+            sidx = jnp.clip(sample_index, 0, T - 1)
+            h_last = xf[sidx]                          # [max_slots, D]
+            logits = jnp.matmul(h_last, head.astype(h_last.dtype))
+            tok = select_token(logits, rng, sc)
+            return tok, k_pool, v_pool
+
+        return step
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt_ids, max_new_tokens=32, deadline=None):
+        """Queue one request. Returns the scheduler's Request handle
+        (read `.output` / `.state` as the engine advances)."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        maxpos = self.model.max_position_embeddings
+        if len(prompt) + max_new_tokens > maxpos:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_position_embeddings "
+                f"({maxpos})")
+        req = self.scheduler.submit(prompt, max_new_tokens,
+                                    eos_token_id=self.eos_token_id,
+                                    deadline=deadline)
+        if _pmetrics._enabled:
+            smetrics.SERVING_QUEUE_DEPTH.set(len(self.scheduler.queue))
+        return req
+
+    # -------------------------------------------------------------- run
+    def step(self):
+        """One engine iteration. Returns True when any work (tokens or
+        expiries) happened, False when the engine is idle/starved."""
+        import jax
+        import jax.numpy as jnp
+        sch = self.scheduler
+        plan = sch.plan()
+        if _pmetrics._enabled and plan.expired:
+            for _ in plan.expired:
+                smetrics.SERVING_REQUESTS.labels("expired").inc()
+        if plan.empty:
+            return bool(plan.expired)
+        sp = pack_step(self.token_budget, self.kv.max_slots,
+                       plan.decode, plan.prefills)
+        self._rng, sub = jax.random.split(self._rng)
+        tok, self.kv.k_pool, self.kv.v_pool = self._step_fn(
+            self._arrays, self.kv.k_pool, self.kv.v_pool,
+            jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
+            jnp.asarray(sp.positions),
+            jnp.asarray(self.kv.block_tables),
+            jnp.asarray(sp.sample_index), sub)
+        sch.note_fed(plan)
+        self.steps_run += 1
+        tok_np = np.asarray(tok)
+        now = self.clock()
+        for slot in sp.prefill_done + sp.decode_slots:
+            req = sch.slots[slot]
+            if req is None:
+                continue
+            t = int(tok_np[slot])
+            if req.state == "prefill":
+                req.state = "decode"
+            if req.first_token_time is None:
+                req.first_token_time = now
+                if _pmetrics._enabled:
+                    smetrics.SERVING_TTFT_SECONDS.observe(
+                        now - req.submit_time)
+            elif _pmetrics._enabled and req._last_token_time is not None:
+                smetrics.SERVING_INTER_TOKEN_SECONDS.observe(
+                    now - req._last_token_time)
+            req._last_token_time = now
+            req.output.append(t)
+            if len(req.output) >= req.max_new_tokens or \
+                    (req.eos_token_id is not None
+                     and t == req.eos_token_id):
+                sch.finish(req, now)
+                if _pmetrics._enabled:
+                    smetrics.SERVING_REQUESTS.labels("finished").inc()
+        if _pmetrics._enabled:
+            smetrics.SERVING_STEPS.inc()
+            smetrics.SERVING_TOKENS.labels("prefill").inc(
+                sp.prefill_tokens)
+            smetrics.SERVING_TOKENS.labels("decode").inc(
+                sp.decode_tokens)
+            smetrics.SERVING_QUEUE_DEPTH.set(len(sch.queue))
+            smetrics.SERVING_ACTIVE_SLOTS.set(sch.num_active)
+            smetrics.SERVING_KV_BLOCKS_IN_USE.set(self.kv.blocks_in_use)
+            smetrics.SERVING_KV_BLOCK_UTILIZATION.set(
+                self.kv.utilization)
+            new_p = sch.preemption_count - self._preempt_seen
+            if new_p:
+                smetrics.SERVING_PREEMPTIONS.inc(new_p)
+                self._preempt_seen = sch.preemption_count
+        return True
+
+    def run(self, max_steps=None):
+        """Drive until every submitted request reaches a terminal
+        state (or max_steps)."""
+        steps = 0
+        while self.scheduler.has_work:
+            if max_steps is not None and steps >= max_steps:
+                break
+            if not self.step():
+                raise RuntimeError(
+                    "serving engine stalled: requests remain but no "
+                    "step can be planned — the KV block pool "
+                    f"({self.kv.allocator.capacity} blocks of "
+                    f"{self.block_size}) cannot cover the resident "
+                    "working set; raise num_blocks or lower max_slots")
+            steps += 1
+        return steps
+
+    def generate_batch(self, prompts, max_new_tokens=32):
+        """Submit a batch and drive to completion. Returns one list of
+        generated token ids per prompt (stops at EOS inclusive)."""
+        reqs = [self.submit(p, max_new_tokens) for p in prompts]
+        self.run()
+        return [list(r.output) for r in reqs]

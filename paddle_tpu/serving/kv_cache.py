@@ -1,0 +1,143 @@
+"""Block-paged KV cache for continuous batching.
+
+The fixed-shape backbone of the serving engine (the TPU translation of
+vLLM-style PagedAttention, per the "Ragged Paged Attention" shape
+discipline): one `[L, num_blocks, block_size, H, Dh]` pool per K and V
+covers EVERY request; a request owns an ordered list of blocks and the
+per-slot block table is padded to a fixed `max_blocks_per_slot` width,
+so the compiled mixed step sees identical shapes no matter which
+requests are resident.
+
+Block 0 is reserved as the NULL block: padding entries in block tables
+and the cache writes of padding tokens all land there, and the
+attention mask (`key position <= query position`) guarantees it is
+never read through. The allocator hands out blocks `1..num_blocks-1`
+LIFO so tests can observe free-list reuse directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """LIFO free-list over block ids [reserved, num_blocks)."""
+
+    def __init__(self, num_blocks, reserved=1):
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"num_blocks={num_blocks} leaves no allocatable blocks "
+                f"past the {reserved} reserved null block(s)")
+        self.num_blocks = int(num_blocks)
+        self.reserved = int(reserved)
+        self._free = list(range(self.num_blocks - 1,
+                                self.reserved - 1, -1))
+        self._used = set()
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return len(self._used)
+
+    @property
+    def capacity(self):
+        return self.num_blocks - self.reserved
+
+    def alloc(self, n):
+        """n blocks, or None when the pool can't cover the request —
+        the caller decides whether to preempt (never partial)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._used.update(out)
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(f"double free of block {b}")
+            self._used.remove(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """Paged pools + per-slot block tables + the slot length ledger."""
+
+    def __init__(self, num_layers, num_heads, head_dim, *, num_blocks,
+                 block_size, max_slots, max_blocks_per_slot,
+                 dtype="float32"):
+        import jax.numpy as jnp
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_slots = int(max_slots)
+        self.max_blocks_per_slot = int(max_blocks_per_slot)
+        self.dtype = str(dtype)
+        shape = (num_layers, self.num_blocks, self.block_size,
+                 num_heads, head_dim)
+        self.k_pool = jnp.zeros(shape, jnp.dtype(self.dtype))
+        self.v_pool = jnp.zeros(shape, jnp.dtype(self.dtype))
+        self.allocator = BlockAllocator(self.num_blocks)
+        self.block_tables = np.zeros(
+            (self.max_slots, self.max_blocks_per_slot), np.int32)
+        self._slot_blocks = [[] for _ in range(self.max_slots)]
+        self.slot_lens = np.zeros(self.max_slots, np.int32)
+
+    # ------------------------------------------------------------ sizing
+    @property
+    def max_slot_tokens(self):
+        return self.max_blocks_per_slot * self.block_size
+
+    def blocks_for(self, n_tokens):
+        return -(-int(n_tokens) // self.block_size)
+
+    def blocks_missing(self, slot, new_len):
+        return max(0, self.blocks_for(new_len)
+                   - len(self._slot_blocks[slot]))
+
+    def slot_num_blocks(self, slot):
+        return len(self._slot_blocks[slot])
+
+    # --------------------------------------------------------- lifecycle
+    def ensure_capacity(self, slot, new_len) -> bool:
+        """Grow `slot`'s block table to cover `new_len` tokens. False
+        (state unchanged) when the free list can't supply the blocks."""
+        if new_len > self.max_slot_tokens:
+            raise ValueError(
+                f"slot needs {new_len} tokens but max_blocks_per_slot="
+                f"{self.max_blocks_per_slot} x block_size="
+                f"{self.block_size} caps it at {self.max_slot_tokens}")
+        need = self.blocks_missing(slot, new_len)
+        if need == 0:
+            return True
+        got = self.allocator.alloc(need)
+        if got is None:
+            return False
+        row = self._slot_blocks[slot]
+        for b in got:
+            self.block_tables[slot, len(row)] = b
+            row.append(b)
+        return True
+
+    def release_slot(self, slot):
+        row = self._slot_blocks[slot]
+        if row:
+            self.allocator.free(row)
+        self._slot_blocks[slot] = []
+        self.block_tables[slot, :] = NULL_BLOCK
+        self.slot_lens[slot] = 0
+
+    # ----------------------------------------------------------- metrics
+    @property
+    def blocks_in_use(self):
+        return self.allocator.num_used
+
+    @property
+    def utilization(self):
+        return self.allocator.num_used / max(1, self.allocator.capacity)

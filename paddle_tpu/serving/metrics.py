@@ -1,0 +1,63 @@
+"""Serving metrics — registered in the framework-wide PR 1 registry.
+
+Exported names are part of the observability contract
+(docs/SERVING.md, tools/serving_smoke.py greps them the same way
+tools/metrics_dump.py greps the training-side names). Recording
+follows the hot-path discipline: the engine records only when
+`profiler.metrics._enabled` is on, so a serving loop with
+observability off pays one branch per step.
+"""
+from __future__ import annotations
+
+from ..profiler.metrics import REGISTRY, exponential_buckets
+
+# 100us .. ~100s in x4 steps: TTFT on a loaded queue can sit behind
+# whole prefill rounds, far above the dispatch-scale default buckets
+_LATENCY_BUCKETS = exponential_buckets(1e-4, 4.0, 10)
+
+SERVING_TTFT_SECONDS = REGISTRY.histogram(
+    "paddle_tpu_serving_ttft_seconds",
+    "Submit-to-first-token latency per request",
+    buckets=_LATENCY_BUCKETS)
+SERVING_INTER_TOKEN_SECONDS = REGISTRY.histogram(
+    "paddle_tpu_serving_inter_token_seconds",
+    "Gap between consecutive generated tokens of one request",
+    buckets=_LATENCY_BUCKETS)
+SERVING_QUEUE_DEPTH = REGISTRY.gauge(
+    "paddle_tpu_serving_queue_depth",
+    "Requests waiting for a slot (admission queue length)")
+SERVING_ACTIVE_SLOTS = REGISTRY.gauge(
+    "paddle_tpu_serving_active_slots",
+    "Slots holding a resident (prefill or decode) request")
+SERVING_KV_BLOCKS_IN_USE = REGISTRY.gauge(
+    "paddle_tpu_serving_kv_blocks_in_use",
+    "Allocated KV-cache blocks")
+SERVING_KV_BLOCK_UTILIZATION = REGISTRY.gauge(
+    "paddle_tpu_serving_kv_block_utilization",
+    "Allocated fraction of the allocatable KV block pool")
+SERVING_PREEMPTIONS = REGISTRY.counter(
+    "paddle_tpu_serving_preemptions_total",
+    "Decode requests evicted (blocks reclaimed, request requeued)")
+SERVING_REQUESTS = REGISTRY.counter(
+    "paddle_tpu_serving_requests_total",
+    "Requests by terminal outcome", ("outcome",))   # finished|expired
+SERVING_TOKENS = REGISTRY.counter(
+    "paddle_tpu_serving_tokens_total",
+    "Tokens processed by the mixed step", ("kind",))  # prefill|decode
+SERVING_STEPS = REGISTRY.counter(
+    "paddle_tpu_serving_steps_total",
+    "Mixed-step invocations")
+
+#: every name above, for the smoke-tool contract check
+CONTRACT_METRICS = (
+    "paddle_tpu_serving_ttft_seconds",
+    "paddle_tpu_serving_inter_token_seconds",
+    "paddle_tpu_serving_queue_depth",
+    "paddle_tpu_serving_active_slots",
+    "paddle_tpu_serving_kv_blocks_in_use",
+    "paddle_tpu_serving_kv_block_utilization",
+    "paddle_tpu_serving_preemptions_total",
+    "paddle_tpu_serving_requests_total",
+    "paddle_tpu_serving_tokens_total",
+    "paddle_tpu_serving_steps_total",
+)

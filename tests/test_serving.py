@@ -1,0 +1,390 @@
+"""paddle_tpu.serving tests: paged KV allocator, continuous-batching
+scheduler, single-compile mixed step, and token parity against the
+single-request generation.py path.
+
+The subsystem's contract (docs/SERVING.md): one compiled mixed step
+over fixed slot tensors serves a churning mix of requests; the block
+allocator + scheduler + step agree on the flat-token protocol.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving.batcher import (SamplingConfig, pack_step,
+                                        prefill_chunk)
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+from paddle_tpu.serving.kv_cache import (NULL_BLOCK, BlockAllocator,
+                                         PagedKVCache)
+from paddle_tpu.serving.scheduler import Scheduler
+
+
+# --------------------------------------------------------------- allocator
+
+
+class TestBlockAllocator:
+    def test_reserves_null_block(self):
+        a = BlockAllocator(8)
+        got = a.alloc(7)
+        assert got is not None and NULL_BLOCK not in got
+        assert sorted(got) == list(range(1, 8))
+
+    def test_exhaustion_returns_none_never_partial(self):
+        a = BlockAllocator(5)      # 4 allocatable
+        first = a.alloc(3)
+        assert a.alloc(2) is None  # only 1 left: refuse, don't split
+        assert a.num_free == 1     # refused alloc left state untouched
+        assert a.alloc(1) is not None
+        a.free(first)
+        assert a.num_free == 3
+
+    def test_free_list_reuse_lifo(self):
+        a = BlockAllocator(10)
+        blocks = a.alloc(4)
+        a.free(blocks[:2])
+        again = a.alloc(2)
+        assert set(again) == set(blocks[:2])  # freed blocks reused
+
+    def test_double_free_rejected(self):
+        a = BlockAllocator(4)
+        b = a.alloc(1)
+        a.free(b)
+        with pytest.raises(ValueError):
+            a.free(b)
+
+
+class TestPagedKVCache:
+    def _kv(self, num_blocks=9, block_size=4, max_slots=2, mbps=4):
+        return PagedKVCache(2, 2, 8, num_blocks=num_blocks,
+                            block_size=block_size, max_slots=max_slots,
+                            max_blocks_per_slot=mbps)
+
+    def test_ensure_grows_table_in_block_units(self):
+        kv = self._kv()
+        assert kv.ensure_capacity(0, 5)      # 2 blocks
+        assert kv.slot_num_blocks(0) == 2
+        assert (kv.block_tables[0, :2] != NULL_BLOCK).all()
+        assert (kv.block_tables[0, 2:] == NULL_BLOCK).all()
+        assert kv.ensure_capacity(0, 8)      # still 2 blocks
+        assert kv.slot_num_blocks(0) == 2
+
+    def test_ensure_fails_clean_when_pool_dry(self):
+        kv = self._kv(num_blocks=4)          # 3 allocatable
+        assert kv.ensure_capacity(0, 12)     # takes all 3
+        before = kv.block_tables.copy()
+        assert not kv.ensure_capacity(1, 4)
+        assert (kv.block_tables == before).all()
+
+    def test_release_returns_blocks(self):
+        kv = self._kv()
+        kv.ensure_capacity(0, 16)
+        assert kv.blocks_in_use == 4
+        kv.release_slot(0)
+        assert kv.blocks_in_use == 0
+        assert (kv.block_tables[0] == NULL_BLOCK).all()
+        assert kv.ensure_capacity(1, 16)     # whole pool available again
+
+    def test_over_capacity_raises(self):
+        kv = self._kv()
+        with pytest.raises(ValueError):
+            kv.ensure_capacity(0, 17)        # > mbps * block_size
+
+
+# --------------------------------------------------------------- batcher
+
+
+def test_prefill_chunk_discipline():
+    assert prefill_chunk(10, 32) == 10       # fits: take it all
+    assert prefill_chunk(100, 24) == 16      # pow2 <= budget
+    assert prefill_chunk(100, 16) == 16
+    assert prefill_chunk(5, 0) == 0
+
+
+def test_pack_step_layout():
+    plan = pack_step(16, 4,
+                     decode=[(2, 42, 7), (0, 43, 3)],
+                     prefills=[(1, np.arange(5, dtype=np.int32), 0,
+                                True)])
+    assert plan.num_tokens == 7
+    assert plan.token_ids[:7].tolist() == [42, 43, 0, 1, 2, 3, 4]
+    assert plan.slot_ids.tolist() == [2, 0, 1, 1, 1, 1, 1] + [-1] * 9
+    assert plan.positions[:7].tolist() == [7, 3, 0, 1, 2, 3, 4]
+    # decode samples at their own token, the completing prefill at its
+    # last chunk token, idle slot 3 not at all
+    assert plan.sample_index.tolist() == [1, 6, 0, -1]
+    with pytest.raises(ValueError):
+        pack_step(4, 4, decode=[], prefills=[
+            (0, np.arange(5, dtype=np.int32), 0, True)])
+
+
+# ------------------------------------------------------------- scheduler
+
+
+def _sched(num_blocks=9, block_size=4, max_slots=2, budget=16,
+           clock=None):
+    kv = PagedKVCache(1, 1, 8, num_blocks=num_blocks,
+                      block_size=block_size, max_slots=max_slots,
+                      max_blocks_per_slot=8)
+    kw = {"clock": clock} if clock else {}
+    return Scheduler(kv, max_slots=max_slots, token_budget=budget, **kw)
+
+
+class TestScheduler:
+    def test_fifo_admission_under_full_queue(self):
+        """More requests than slots: admission strictly follows
+        submission order, later requests wait their turn."""
+        s = _sched(num_blocks=17, max_slots=2)
+        reqs = [s.submit([1, 2, 3], 4) for _ in range(5)]
+        plan = s.plan()
+        assert [s.slots[i].req_id for i in range(2)] == [0, 1]
+        assert [r.req_id for r in s.queue] == [2, 3, 4]
+        assert {p[0] for p in plan.prefills} == {0, 1}
+        # finish slot 0's request -> NEXT queued request (2) admitted
+        s.note_fed(plan)
+        s.finish(reqs[0])
+        s.plan()
+        assert s.slots[0].req_id == 2
+        assert [r.req_id for r in s.queue] == [3, 4]
+
+    def test_decode_preempts_longest_when_blocks_dry(self):
+        """Block exhaustion evicts the decode holding the MOST blocks
+        (never one already planned this step — decodes are served
+        oldest-first); the victim requeues at the FRONT with its
+        progress folded into the prompt."""
+        s = _sched(num_blocks=7, block_size=2, max_slots=3, budget=16)
+        a = s.submit([1, 2], 8)                    # 1 block
+        b = s.submit([3, 4, 5], 8)                 # 2 blocks
+        c = s.submit([6, 7, 8, 9, 10, 11], 8)      # 3 blocks
+        plan = s.plan()                            # all prefill fully
+        s.note_fed(plan)
+        assert s.kv.allocator.num_free == 0        # pool exactly full
+        for r, tok in ((a, 20), (b, 21), (c, 22)):
+            r.state = "decode"
+            r.output.append(tok)
+        plan = s.plan()
+        # a (oldest) crosses a block boundary with the pool dry ->
+        # the longest decode (c, 3 blocks) is evicted, b survives
+        assert c.state == "queued" and c.preemptions == 1
+        assert s.queue[0] is c                     # front of the queue
+        assert c.runtime_prompt == [6, 7, 8, 9, 10, 11, 22]
+        assert b.state == "decode"
+        assert sorted(p[0] for p in plan.decode) == \
+            sorted([a.slot, b.slot])
+        assert s.preemption_count == 1
+
+    def test_deadline_expiry(self):
+        now = [0.0]
+        s = _sched(num_blocks=17, max_slots=1, clock=lambda: now[0])
+        a = s.submit([1, 2], 4)
+        b = s.submit([3, 4], 4, deadline=5.0)
+        plan = s.plan()
+        s.note_fed(plan)
+        now[0] = 10.0
+        plan = s.plan()                    # b expired while queued
+        assert b.state == "expired" and b in plan.expired
+        assert a.state == "prefill" and not s.queue
+
+    def test_prefill_chunked_under_budget(self):
+        s = _sched(num_blocks=33, max_slots=1, budget=8)
+        r = s.submit(list(range(1, 21)), 4)
+        plan = s.plan()
+        (slot, chunk, start, completes), = plan.prefills
+        assert len(chunk) == 8 and start == 0 and not completes
+        s.note_fed(plan)
+        plan = s.plan()
+        (slot, chunk, start, completes), = plan.prefills
+        assert len(chunk) == 8 and start == 8 and not completes
+        s.note_fed(plan)
+        plan = s.plan()
+        (slot, chunk, start, completes), = plan.prefills
+        assert len(chunk) == 4 and start == 16 and completes
+        assert r.fed == 20
+
+
+# ---------------------------------------------------------------- engine
+
+
+def _model(vocab=193, layers=2, heads=4, hidden=32, maxpos=128, **kw):
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=vocab, hidden_size=hidden,
+                         num_layers=layers, num_attention_heads=heads,
+                         max_position_embeddings=maxpos,
+                         compute_dtype="float32", **kw)
+    m.eval()
+    return m
+
+
+class TestServingEngine:
+    def test_parity_with_generation(self):
+        """Serving output must be token-identical to single-request
+        generate() for the same prompts (greedy, float32)."""
+        m = _model()
+        prompts = [[3, 14, 15, 9, 2], [7, 8], list(range(1, 12)), [42]]
+        eng = ServingEngine(m, max_slots=4, block_size=8,
+                            max_seq_len=64, cache_dtype="float32")
+        outs = eng.generate_batch(prompts, max_new_tokens=6)
+        for p, o in zip(prompts, outs):
+            solo, _ = m.generate(Tensor(np.array([p], np.int64)),
+                                 max_new_tokens=6,
+                                 cache_dtype="float32")
+            assert o == solo.numpy()[0].tolist()
+
+    def test_parity_survives_preemption(self):
+        """Evicted-and-resumed sequences must still match generate()
+        exactly (re-prefill of prompt+generated is lossless)."""
+        m = _model()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 193, n).tolist()
+                   for n in (9, 5, 12, 3, 7, 10)]
+        eng = ServingEngine(m, max_slots=4, block_size=4, num_blocks=8,
+                            max_seq_len=32, cache_dtype="float32")
+        outs = eng.generate_batch(prompts, max_new_tokens=8)
+        assert eng.scheduler.preemption_count > 0  # pressure was real
+        for p, o in zip(prompts, outs):
+            solo, _ = m.generate(Tensor(np.array([p], np.int64)),
+                                 max_new_tokens=8,
+                                 cache_dtype="float32")
+            assert o == solo.numpy()[0].tolist()
+
+    def test_single_compile_across_admissions(self):
+        """The mixed step compiles exactly once for the engine's
+        lifetime — admissions, ragged lengths, preemptions and
+        evictions never retrace (PR 1 jit compile counter)."""
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            eng = ServingEngine(m, max_slots=4, block_size=4,
+                                num_blocks=8, max_seq_len=32,
+                                cache_dtype="float32")
+            rng = np.random.RandomState(1)
+            for wave in range(3):       # three separate admission waves
+                prompts = [rng.randint(1, 193, int(n)).tolist()
+                           for n in rng.randint(2, 14, 3)]
+                eng.generate_batch(prompts, max_new_tokens=4)
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value == 1
+            assert eng.steps_run > 3
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_eos_stops_request_early(self):
+        m = _model()
+        eng = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=64, cache_dtype="float32",
+                            eos_token_id=0)
+        solo, lens = m.generate(Tensor(np.array([[5, 6, 7]], np.int64)),
+                                max_new_tokens=10, eos_token_id=0,
+                                cache_dtype="float32", use_scan=False)
+        (out,) = eng.generate_batch([[5, 6, 7]], max_new_tokens=10)
+        want = solo.numpy()[0][:int(lens.numpy()[0])].tolist()
+        assert out == want
+        assert len(out) <= 10
+
+    def test_blocks_released_on_completion(self):
+        m = _model()
+        eng = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=64, cache_dtype="float32")
+        eng.generate_batch([[1, 2, 3], [4, 5]], max_new_tokens=4)
+        assert eng.kv.blocks_in_use == 0
+        assert eng.scheduler.num_active == 0
+
+    def test_weight_only_stack_serves(self):
+        m = _model(weight_only=True)
+        eng = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=64, cache_dtype="float32")
+        (out,) = eng.generate_batch([[3, 1, 4, 1, 5]],
+                                    max_new_tokens=4)
+        solo, _ = m.generate(Tensor(np.array([[3, 1, 4, 1, 5]],
+                                             np.int64)),
+                             max_new_tokens=4, cache_dtype="float32")
+        assert out == solo.numpy()[0].tolist()
+
+    def test_oversized_request_rejected(self):
+        m = _model()
+        eng = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=32, cache_dtype="float32")
+        with pytest.raises(ValueError):
+            eng.submit(list(range(1, 40)), max_new_tokens=8)
+
+
+# -------------------------------------------------- generation satellites
+
+
+def test_generate_returns_actual_lengths():
+    m = _model()
+    ids = Tensor(np.array([[5, 6, 7], [8, 9, 1]], np.int64))
+    for use_scan in (True, False):
+        out, lens = m.generate(ids, max_new_tokens=6, eos_token_id=0,
+                               cache_dtype="float32",
+                               use_scan=use_scan)
+        out, lens = out.numpy(), lens.numpy()
+        assert lens.shape == (2,)
+        for row, n in zip(out, lens):
+            assert 1 <= n <= 6
+            if n < 6:
+                assert row[n - 1] == 0 and (row[n:] == 0).all()
+                assert (row[:n - 1] != 0).all()
+    # no eos_token_id -> full horizon
+    _, lens = m.generate(ids, max_new_tokens=5, cache_dtype="float32")
+    assert lens.numpy().tolist() == [5, 5]
+
+
+def test_streaming_loop_stops_on_all_eos(monkeypatch):
+    """The python-loop path must stop stepping once every row is
+    finished instead of running to max_new_tokens."""
+    m = _model()
+    ids = Tensor(np.array([[5, 6, 7]], np.int64))
+    out, _ = m.generate(ids, max_new_tokens=50, cache_dtype="float32",
+                        use_scan=False)
+    first = int(out.numpy()[0, 0])
+    calls = {"n": 0}
+    fns = m._gen_fns((1, 16, 128, "float32"),
+                     SamplingConfig("greedy", 1.0, 0, 1.0),
+                     first, 50, False, True)
+    real = fns["decode_step"]
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setitem(fns, "decode_step", counting)
+    out2, lens = m.generate(ids, max_new_tokens=50,
+                            eos_token_id=first,
+                            cache_dtype="float32", use_scan=False)
+    # prefill token IS the eos -> zero decode steps, length 1
+    assert calls["n"] == 0
+    assert lens.numpy().tolist() == [1]
+    assert (out2.numpy()[0] == first).all()
+
+
+# ------------------------------------------------------- smoke-tool wiring
+
+
+def test_serving_smoke_tool(capsys):
+    """tools/serving_smoke.py is the serving CI contract: tiny GPT, 8
+    mixed-length requests, every serving metric name present, exactly
+    one mixed-step compile, no leaked blocks."""
+    import importlib.util
+    import os
+
+    pm.REGISTRY.reset()
+    was = pm._enabled
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serving_smoke.py")
+    spec = importlib.util.spec_from_file_location("serving_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        rc = mod.main()
+        out = capsys.readouterr().out
+        assert rc == 0
+        from paddle_tpu.serving.metrics import CONTRACT_METRICS
+        for name in CONTRACT_METRICS:
+            assert name in out
+    finally:
+        pm.REGISTRY.reset()
+        if not was:
+            pm.disable()
