@@ -313,6 +313,69 @@ def bench_decode():
     return tps, None, extra  # bandwidth-bound; MFU not meaningful
 
 
+def bench_decode_speculative():
+    """ISSUE 3 extra: latency-bound decode with the scanned fused step
+    and n-gram speculative verification, B=1 and B=8, on repetitive/
+    greedy text (cyclic prompt pattern -> the prompt-lookup draft can
+    actually land; acceptance is reported so the number can't hide a
+    draft that never hits). tokens/sec counts GENERATED tokens over the
+    full generate() wall time, same convention as bench_decode. The r5
+    B=1 bf16 baseline for this config was 465 tok/s with the unrolled
+    decode step."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTForGeneration
+
+    m = GPTForGeneration(vocab_size=50304, hidden_size=1024,
+                         num_layers=24, num_attention_heads=16,
+                         max_position_embeddings=2048,
+                         compute_dtype="bfloat16")
+    m.eval()
+    P, T = 128, 128
+    pattern = np.arange(7, 23, dtype=np.int32)     # 16-token cycle
+
+    def run(B, draft_k, reps=3):
+        ids = Tensor(np.tile(pattern, (B, P // len(pattern))))
+        kw = dict(max_new_tokens=T, draft_k=draft_k)
+        out, _ = m.generate(ids, **kw)             # compile + warm
+        np.asarray(out.numpy())
+        best = float("inf")
+        accept = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out, _ = m.generate(ids, **kw)
+            np.asarray(out.numpy())
+            best = min(best, time.perf_counter() - t0)
+            if draft_k:
+                steps = m.last_accept_counts
+                tot = sum(sum(s) for s in steps)
+                accept = tot / max(1, sum(len(s) for s in steps))
+        return B * T / best, accept
+
+    b1_scan, _ = run(1, 0)             # scanned fused step, no drafts
+    b1_spec, b1_acc = run(1, 7)
+    extra = {
+        "metric": "gpt2_350m_decode_speculative_detail",
+        "b1_scan_tokens_per_sec": round(b1_scan, 1),
+        "b1_spec_tokens_per_sec": round(b1_spec, 1),
+        "b1_mean_accept": round(b1_acc, 2) if b1_acc else None,
+        "b1_vs_r5_unrolled_465": round(max(b1_scan, b1_spec) / 465.0, 3),
+    }
+    if _budget_left() > 120:           # B=8 pair is two more compiles
+        b8_scan, _ = run(8, 0)
+        b8_spec, b8_acc = run(8, 7)
+        extra.update(
+            b8_scan_tokens_per_sec=round(b8_scan, 1),
+            b8_spec_tokens_per_sec=round(b8_spec, 1),
+            b8_mean_accept=round(b8_acc, 2) if b8_acc else None)
+    else:
+        extra["b8_skipped"] = "time budget"
+    # headline = the SPECULATIVE number (the metric's name): a draft
+    # path slower than plain scan must show up as a regression, not be
+    # papered over by max(); the scan baseline and the best-of ratio
+    # ride in the detail extra
+    return b1_spec, None, extra
+
+
 def bench_serving():
     """Continuous batching (paddle_tpu.serving) vs sequential
     one-request-at-a-time generation.py on the SAME synthetic Poisson
@@ -446,6 +509,17 @@ def main():
         "extras": [],
     }
 
+    # serving extra runs on every platform (CPU tiny GPT) and carries
+    # the continuous-batching >= 2x-vs-sequential driver contract —
+    # run it BEFORE the TPU extras so a long compile tail (e.g. the
+    # speculative decode extra) can't starve it out of the budget
+    try:
+        result["extras"].append(bench_serving())
+    except Exception as e:  # noqa: BLE001
+        result["extras"].append(
+            {"metric": "serving_continuous_batching",
+             "error": f"{type(e).__name__}: {e}"})
+
     if on_tpu:
         for name, fn, unit in (
                 ("resnet50_train_imgs_per_sec_per_chip", bench_resnet50,
@@ -456,7 +530,9 @@ def main():
                 ("wide_deep_ps_examples_per_sec", bench_wide_deep,
                  "examples/sec"),
                 ("gpt2_350m_decode_tokens_per_sec_per_chip", bench_decode,
-                 "tokens/sec")):
+                 "tokens/sec"),
+                ("gpt2_350m_decode_speculative_b1_tokens_per_sec",
+                 bench_decode_speculative, "tokens/sec")):
             # drop the previous config's device buffers: trainers hold
             # reference cycles (mesh/jit closures), so HBM is only
             # reclaimed after a cycle collection
@@ -483,19 +559,6 @@ def main():
                 "mfu": round(mfu, 4) if mfu else None})
             if extra_metric is not None:
                 result["extras"].append(extra_metric)
-
-    # serving extra runs on every platform (CPU tiny GPT): the
-    # continuous-batching >= 2x-vs-sequential contract
-    if _budget_left() < 60:
-        result["extras"].append({"metric": "serving_continuous_batching",
-                                 "skipped": "time budget"})
-    else:
-        try:
-            result["extras"].append(bench_serving())
-        except Exception as e:  # noqa: BLE001
-            result["extras"].append(
-                {"metric": "serving_continuous_batching",
-                 "error": f"{type(e).__name__}: {e}"})
 
     obs = _metrics_extra()
     if obs is not None:

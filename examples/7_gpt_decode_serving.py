@@ -37,6 +37,33 @@ def main(quant_bits=0, batch=4, max_new=64):
     return out
 
 
+def main_speculative(batch=1, max_new=64, draft_k=4):
+    """Speculative decoding demo: n-gram prompt-lookup drafts + one
+    compiled verify step (greedy, token-identical to plain decode).
+    Repetitive prompts are the favourable regime — each accepted draft
+    token skips one whole latency-bound decode step."""
+    paddle.seed(0)
+    net = GPTForGeneration(vocab_size=5000, hidden_size=256,
+                           num_layers=4, num_attention_heads=8,
+                           max_position_embeddings=256)
+    net.eval()
+    prompt = paddle.to_tensor(
+        np.tile(np.arange(10, 26, dtype=np.int32), (batch, 2)))
+    base, _ = net.generate(prompt, max_new_tokens=max_new)
+    for _ in range(2):  # compile, then steady
+        t0 = time.perf_counter()
+        out, _ = net.generate(prompt, max_new_tokens=max_new,
+                              draft_k=draft_k)
+        dt = time.perf_counter() - t0
+    steps = len(net.last_accept_counts)
+    assert out.numpy().tolist() == base.numpy().tolist()
+    print(f"speculative draft_k={draft_k}: {batch * max_new} tokens in "
+          f"{steps} verify steps ({batch * max_new / dt:,.0f} tok/s), "
+          "token-identical to plain greedy")
+    return out
+
+
 if __name__ == "__main__":
     main(quant_bits=0)
     main(quant_bits=8)
+    main_speculative()

@@ -255,7 +255,8 @@ class VisualDL(Callback):
 
 def config_callbacks(callbacks=None, model=None, batch_size=None,
                      epochs=None, steps=None, log_freq=2, verbose=2,
-                     save_freq=1, save_dir=None, metrics=None, mode="train"):
+                     save_freq=1, save_dir=None, metrics=None,
+                     mode="train", do_eval=False):
     from ..profiler import metrics as _metrics
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
@@ -271,7 +272,7 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
     lst.set_model(model)
     lst.set_params({"batch_size": batch_size, "epochs": epochs,
                     "steps": steps, "verbose": verbose,
-                    "metrics": metrics or []})
+                    "metrics": metrics or [], "do_eval": bool(do_eval)})
     return lst
 
 
@@ -298,6 +299,7 @@ class ReduceLROnPlateau(Callback):
             self.best = np.inf
         self.wait = 0
         self.cooldown_counter = 0
+        self._saw_eval = False
 
     def _get_value(self, logs):
         v = (logs or {}).get(self.monitor)
@@ -305,11 +307,26 @@ class ReduceLROnPlateau(Callback):
             v = v[0] if v else None
         return v
 
+    def on_eval_begin(self, logs=None):
+        # remember that an eval loop exists so the train-side hook
+        # stays quiet for the rest of the run (fit fires on_epoch_end
+        # BEFORE the epoch's eval pass)
+        self._saw_eval = True
+
     def on_eval_end(self, logs=None):
+        self._saw_eval = True
         self._maybe_reduce(self._get_value(logs))
 
     def on_epoch_end(self, epoch, logs=None):
-        # train-metric monitoring when there is no eval loop
+        # train-metric monitoring ONLY when there is no eval loop:
+        # with one, monitoring both hooks would advance wait/cooldown
+        # twice per epoch and mix train and eval losses into `best`
+        # (the double-firing bug). fit() declares the eval loop via the
+        # `do_eval` callback param; `_saw_eval` covers callers that
+        # drive evaluate() by hand without going through fit().
+        if self.params.get("do_eval") or getattr(self, "_saw_eval",
+                                                 False):
+            return
         if self.monitor in (logs or {}):
             self._maybe_reduce(self._get_value(logs))
 

@@ -222,7 +222,8 @@ class Model:
                                 steps=steps, log_freq=log_freq,
                                 verbose=verbose, save_freq=save_freq,
                                 save_dir=save_dir,
-                                metrics=self._metrics_name())
+                                metrics=self._metrics_name(),
+                                do_eval=eval_data is not None)
         cbks.on_train_begin()
         # throughput timer (python/paddle/profiler/timer.py parity):
         # paddle.profiler.benchmark().step_info() reports reader/batch

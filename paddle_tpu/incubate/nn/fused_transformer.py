@@ -323,49 +323,50 @@ def _layer_body(cfg, x, pl, cache_l, mode, step, seq_lens, attn_mask,
     return x, new_cache, aux
 
 
-def _decode_layer(cfg, x, pl, ckf, cvf, i, step):
-    """One decode layer operating on the FULL stacked caches.
+def _decode_layer(cfg, x, pl, ckf, cvf, li, step, valid):
+    """One decode/verify layer operating on the FULL stacked caches.
 
-    Decode is unrolled over layers (not `lax.scan`): with the cache as
-    scan xs/ys every step re-reads and re-writes the whole cache
-    (measured ~4x the useful traffic on a 350M config). Here the new
-    K/V column is written straight into `ckf`/`cvf` at (layer, step)
-    via dynamic_update_slice — O(column) writes, reads fuse into the
-    attention einsums."""
+    `x` is [B, K, D]: K == 1 is plain decode, K > 1 is the multi-token
+    speculative *verify* step — K consecutive positions starting at
+    `step` are written and scored in one pass (each query attends keys
+    at positions <= its own, so draft token j sees drafts 0..j-1 —
+    exactly the sequential-greedy semantics).
+
+    The fresh K/V columns are written straight into `ckf`/`cvf` at
+    (layer, step..step+K-1) via dynamic_update_slice (scalar step) or a
+    batched scatter (per-row steps) — O(K columns) writes; the layer
+    reads fuse into the attention einsums."""
+    B, K = x.shape[0], x.shape[1]
     residual = x
     h = _ln(x, pl["ln_s"], pl["ln_b"], cfg.epsilon) \
         if cfg.normalize_before else x
-    q, k, v = _qkv(cfg, pl, h)
-    B = q.shape[0]
-    S_max = ckf.shape[-1]
-    li = jnp.int32(i)
+    q, k, v = _qkv(cfg, pl, h)                      # [B, K, H, Dh]
     if step.ndim == 0:
         kcol = k.transpose(0, 2, 3, 1)[None].astype(ckf.dtype)
         vcol = v.transpose(0, 2, 1, 3)[None].astype(cvf.dtype)
         ckf = jax.lax.dynamic_update_slice(ckf, kcol, (li, 0, 0, 0, step))
         cvf = jax.lax.dynamic_update_slice(cvf, vcol, (li, 0, 0, step, 0))
-        valid = jnp.arange(S_max)[None, :] <= step
     else:
-        # per-row positions: scatter ONE column per row into the full
+        # per-row positions: scatter K columns per row into the full
         # cache (a gather + whole-slice rewrite would move the entire
         # layer cache per token)
-        rows = jnp.arange(B)
-        # advanced indices (rows, step) broadcast to [B] and land first:
-        # both targets index as [B, H, Dh], matching k/v[:, 0]
-        ckf = ckf.at[li, rows, :, :, step].set(
-            k[:, 0].astype(ckf.dtype))
-        cvf = cvf.at[li, rows, :, step, :].set(
-            v[:, 0].astype(cvf.dtype))
-        valid = jnp.arange(S_max)[None, :] <= step[:, None]
+        rows = jnp.arange(B)[:, None]
+        pos = step[:, None] + jnp.arange(K)[None, :]      # [B, K]
+        # advanced indices (li, rows, pos) broadcast to [B, K] and land
+        # first: both targets index as [B, K, H, Dh], matching k/v
+        ckf = ckf.at[li, rows, :, :, pos].set(k.astype(ckf.dtype))
+        cvf = cvf.at[li, rows, :, pos, :].set(v.astype(cvf.dtype))
     scale = 1.0 / math.sqrt(q.shape[-1])
-    ck = ckf[i].astype(q.dtype)                 # [B, H, Dh, S_max]
-    cv = cvf[i].astype(q.dtype)                 # [B, H, S_max, Dh]
-    logits = jnp.einsum("bhd,bhds->bhs", q[:, 0], ck)
+    ck = jax.lax.dynamic_index_in_dim(ckf, li, 0, keepdims=False)
+    cv = jax.lax.dynamic_index_in_dim(cvf, li, 0, keepdims=False)
+    ck = ck.astype(q.dtype)                     # [B, H, Dh, S_max]
+    cv = cv.astype(q.dtype)                     # [B, H, S_max, Dh]
+    logits = jnp.einsum("bkhd,bhds->bhks", q, ck)
     logits = logits.astype(jnp.float32) * scale
-    logits = jnp.where(valid[:, None, :], logits, -1e9)
+    logits = jnp.where(valid[:, None], logits, -1e9)
     p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    attn = jnp.einsum("bhs,bhsd->bhd", p, cv)[:, None]
-    attn = attn.reshape(B, 1, cfg.num_heads * cfg.head_dim)
+    attn = jnp.einsum("bhks,bhsd->bkhd", p, cv)
+    attn = attn.reshape(B, K, cfg.num_heads * cfg.head_dim)
     out = _mm(cfg, attn, pl["out_w"], pl.get("out_s"))
     out = _maybe_psum(cfg, out)
     out = out + pl["out_b"].astype(out.dtype)
@@ -387,13 +388,60 @@ def _decode_layer(cfg, x, pl, ckf, cvf, i, step):
 
 
 def _decode_stack(cfg, params, x, cache, step):
+    """Run the decode/verify stack as ONE `lax.scan` over layers.
+
+    The round-5 roofline analysis (docs/decode_int8_analysis.md) showed
+    the unrolled decode step — 24 layers x ~15 tiny [B, 1, D] ops, ~360
+    dispatched micro-ops per token — running ~2x above its HBM roofline
+    at B<=8: latency-bound, not bandwidth-bound. Scanning the stacked
+    weights collapses the step into one compiled loop body (the same
+    shape discipline the training stack and the serving mixed step
+    already use).
+
+    The full stacked caches ride in the scan CARRY (aliased in place by
+    XLA) and each iteration touches only its own layer: the K/V column
+    write is a dynamic_update_slice / scatter at (layer, step..step+K-1)
+    and the attention read is a dynamic_index_in_dim of that layer's
+    slice. Passing per-layer cache slices as scan xs/ys instead would
+    re-stack the whole cache every step (measured ~4x the useful
+    traffic on a 350M config — the reason the old stack was unrolled).
+
+    `PADDLE_TPU_DECODE_UNROLL=1` restores the unrolled stack for A/B
+    measurement. The flag is read at TRACE time and is not part of any
+    jit cache key: set it before the process's first decode trace (run
+    each A/B side in its own process) — toggling it after an executable
+    is cached has no effect."""
+    import os
     ckf, cvf = cache
-    aux_total = jnp.zeros((), jnp.float32)
-    for i in range(cfg.num_layers):
-        pl = {kk: vv[i] for kk, vv in params.items()}
-        x, ckf, cvf, aux = _decode_layer(cfg, x, pl, ckf, cvf, i, step)
-        aux_total = aux_total + aux
-    return x, (ckf, cvf), aux_total
+    B, K = x.shape[0], x.shape[1]
+    S_max = ckf.shape[-1]
+    offs = jnp.arange(K)
+    if step.ndim == 0:
+        last = step + offs                                  # [K]
+        valid = jnp.arange(S_max)[None, None, :] <= last[None, :, None]
+    else:
+        last = step[:, None] + offs[None, :]                # [B, K]
+        valid = jnp.arange(S_max)[None, None, :] <= last[:, :, None]
+    if os.environ.get("PADDLE_TPU_DECODE_UNROLL"):
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            pli = {kk: vv[i] for kk, vv in params.items()}
+            x, ckf, cvf, aux = _decode_layer(cfg, x, pli, ckf, cvf,
+                                             jnp.int32(i), step, valid)
+            aux_total = aux_total + aux
+        return x, (ckf, cvf), aux_total
+
+    def body(carry, xs):
+        h, ckf, cvf = carry
+        pli, li = xs
+        h, ckf, cvf, aux = _decode_layer(cfg, h, pli, ckf, cvf, li,
+                                         step, valid)
+        return (h, ckf, cvf), aux
+
+    (x, ckf, cvf), auxs = jax.lax.scan(
+        body, (x, ckf, cvf),
+        (params, jnp.arange(cfg.num_layers)))
+    return x, (ckf, cvf), jnp.sum(auxs)
 
 
 def _run_stack(cfg, params, x, cache, mode, step, seq_lens, attn_mask,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...jit.functional import instrumented_jit
 from ...ops._helpers import as_tensor
 # the sampling head + shape-bucket discipline are shared with the
 # continuous-batching engine; they live in serving.batcher (kept
@@ -32,6 +33,10 @@ from ...serving.batcher import (
     next_pow2 as _next_pow2,
     round_up as _round_up,
     select_token as _select_token,
+)
+from ...serving.draft import (
+    accept_length as _accept_length,
+    ngram_propose as _ngram_propose,
 )
 
 
@@ -48,7 +53,7 @@ class GenerationMixin:
     """
 
     def _gen_fns(self, shape_key, sc, eos_id, max_new_tokens, use_scan,
-                 uniform):
+                 uniform, draft_k=0):
         cache = getattr(self, "_gen_fn_cache", None)
         if cache is None:
             cache = self._gen_fn_cache = {}
@@ -56,7 +61,8 @@ class GenerationMixin:
         # keying them on max_new_tokens/eos would recompile multi-second
         # XLA executables when only the generation length changes
         base_key = (shape_key, sc, uniform)
-        key = (shape_key, sc, eos_id, max_new_tokens, use_scan, uniform)
+        key = (shape_key, sc, eos_id, max_new_tokens, use_scan, uniform,
+               draft_k)
         if key in cache:
             return cache[key]
         B, s_bucket, s_max, cache_dtype = shape_key
@@ -100,8 +106,9 @@ class GenerationMixin:
         shared = cache.get(("base", base_key))
         if shared is None:
             shared = {
-                "prefill": jax.jit(prefill),
-                "decode_step": jax.jit(decode_step, donate_argnums=(1,)),
+                "prefill": instrumented_jit(prefill, "gen_prefill"),
+                "decode_step": instrumented_jit(
+                    decode_step, "gen_decode_step", donate_argnums=(1,)),
             }
             cache[("base", base_key)] = shared
         fns = {
@@ -109,15 +116,38 @@ class GenerationMixin:
             # donate the cache: without it XLA must preserve the input
             # buffer and copies the full cache into the scan carry
             # (measured as a GB-scale `copy(kv)` temp on a 350M config)
-            "decode_scan": jax.jit(decode_scan, donate_argnums=(1,)),
+            "decode_scan": instrumented_jit(
+                decode_scan, "gen_decode_scan", donate_argnums=(1,)),
         }
+        if draft_k > 0:
+            # verify_step depends only on shapes — like prefill/decode
+            # it is cached independently of max_new_tokens/eos so a
+            # generation-length change never re-compiles it
+            vkey = ("verify", shape_key, draft_k)
+            vfn = cache.get(vkey)
+            if vfn is None:
+                def verify_step(arrays, kv, tokens, positions):
+                    # tokens [B, K] at positions[b] + j; greedy argmax
+                    # over every scored position — the host accepts the
+                    # longest prefix where draft j+1 equals the argmax
+                    # after j
+                    logits, kv = self._verify_core(arrays, tokens,
+                                                   positions, kv)
+                    nxt = jnp.argmax(logits.astype(jnp.float32),
+                                     axis=-1).astype(jnp.int32)  # [B,K]
+                    return kv, nxt
+
+                vfn = cache[vkey] = instrumented_jit(
+                    verify_step, "gen_verify_step", donate_argnums=(1,))
+            fns["verify_step"] = vfn
         cache[key] = fns
         return fns
 
     def generate(self, input_ids, max_new_tokens=32,
                  decode_strategy="greedy", temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, seed=None, use_scan=True,
-                 cache_dtype=None, seq_lens=None):
+                 cache_dtype=None, seq_lens=None, draft_k=0,
+                 draft_ngram=3):
         """Returns (ids [B, max_new_tokens], gen_lens [B]). `gen_lens`
         is each row's ACTUAL generated length — up to and including its
         first EOS (max_new_tokens when the row never emits EOS or no
@@ -127,7 +157,17 @@ class GenerationMixin:
 
         `seq_lens` [B] gives each row's true (unpadded) prompt length for
         ragged right-padded batches; without it every row is assumed to
-        span the full prompt width (pad tokens would be attended)."""
+        span the full prompt width (pad tokens would be attended).
+
+        `draft_k > 0` turns on speculative decoding (greedy only): a
+        host-side prompt-lookup draft (`serving.draft.ngram_propose`,
+        trailing n-grams up to `draft_ngram`) proposes `draft_k` tokens
+        per step and ONE compiled verify step scores all of them,
+        emitting the longest sequential-greedy prefix plus the model's
+        correction — between 1 and draft_k+1 tokens per step, always
+        token-identical to `draft_k=0`. The win scales with how
+        repetitive the text is (each accepted draft token saves one
+        full latency-bound decode step)."""
         ids = as_tensor(input_ids)
         ids_np = np.asarray(ids.numpy(), np.int32)
         if ids_np.ndim == 1:
@@ -139,9 +179,18 @@ class GenerationMixin:
                 f"prompt ({S}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_position_embeddings ({maxpos}); late "
                 "positions would silently share one position embedding")
+        draft_k = int(draft_k)
+        if draft_k > 0 and decode_strategy != "greedy":
+            raise ValueError(
+                "speculative decoding (draft_k > 0) verifies against the "
+                "greedy continuation; sampling strategies need rejection "
+                "sampling, which is not implemented — use "
+                "decode_strategy='greedy' or draft_k=0")
         s_bucket = _next_pow2(S)
-        # 128 keeps the sequence-minor cache layout pad-free (lane dim)
-        s_max = _round_up(s_bucket + max_new_tokens, 128)
+        # 128 keeps the sequence-minor cache layout pad-free (lane dim);
+        # speculation needs draft_k columns of slack past the horizon
+        # (the last verify step writes draft K/V beyond the final token)
+        s_max = _round_up(s_bucket + max_new_tokens + draft_k, 128)
         dt = cache_dtype or getattr(self, "_gen_cache_dtype", "bfloat16")
         sc = SamplingConfig("greedy" if decode_strategy == "greedy"
                             else "sampling", float(temperature),
@@ -164,7 +213,7 @@ class GenerationMixin:
         uniform = bool((lens_np == lens_np[0]).all())
         shape_key = (B, s_bucket, s_max, str(dt))
         fns = self._gen_fns(shape_key, sc, eos_token_id, max_new_tokens,
-                            use_scan, uniform)
+                            use_scan, uniform, draft_k)
         # cast float params to the compute dtype ONCE — an .astype left
         # inside the decode step re-converts (and re-reads) the full
         # array every token (measured: the f32 lm_head alone is ~100MB
@@ -190,6 +239,10 @@ class GenerationMixin:
         if max_new_tokens == 1:
             ids = tok[:, None]
             return Tensor(ids), Tensor(_gen_lens_jnp(ids, eos_token_id))
+        if draft_k > 0:
+            return self._generate_speculative(
+                fns, arrays, kv, tok, ids_np, lens_np, max_new_tokens,
+                eos_token_id, draft_k, draft_ngram)
         if use_scan:
             toks, _ = fns["decode_scan"](arrays, kv, tok, seq_lens, rng)
             return Tensor(toks), Tensor(_gen_lens_jnp(toks,
@@ -217,6 +270,70 @@ class GenerationMixin:
             pad = np.full((B, max_new_tokens - toks.shape[1]),
                           eos_token_id, np.int32)
             toks = np.concatenate([toks, pad], axis=1)
+        return Tensor(jnp.asarray(toks)), \
+            Tensor(jnp.asarray(_gen_lens_np(toks, eos_token_id)))
+
+    def _generate_speculative(self, fns, arrays, kv, tok, ids_np,
+                              lens_np, max_new_tokens, eos_token_id,
+                              draft_k, draft_ngram):
+        """Greedy speculative loop over the ONE compiled verify step.
+
+        Every iteration feeds [last_token, d_1..d_draft_k] at per-row
+        positions and emits the longest prefix where draft j equals the
+        model's argmax after j-1, plus the model's own next token — so
+        each row advances 1..draft_k+1 tokens and the output is exactly
+        the sequential greedy continuation. Rejected draft K/V columns
+        need no explicit rollback: the next step's draft_k+1-wide write
+        starts at the first invalid position and always covers them
+        before any query can attend that range."""
+        B = ids_np.shape[0]
+        tok_np = np.asarray(tok)
+        outs = [[int(tok_np[b])] for b in range(B)]
+        seqs = [[int(t) for t in ids_np[b, :int(lens_np[b])]]
+                + [outs[b][0]] for b in range(B)]
+        finished = [eos_token_id is not None
+                    and outs[b][0] == eos_token_id for b in range(B)]
+
+        def active(b):
+            return not finished[b] and len(outs[b]) < max_new_tokens
+
+        K = draft_k + 1
+        step_toks = np.zeros((B, K), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        self.last_accept_counts = []   # per-step emitted counts (bench)
+        while any(active(b) for b in range(B)):
+            for b in range(B):
+                pos0[b] = len(seqs[b]) - 1
+                step_toks[b, 0] = seqs[b][-1]
+                if active(b):
+                    step_toks[b, 1:] = _ngram_propose(
+                        seqs[b], draft_k, max_ngram=draft_ngram)
+                else:
+                    # frozen rows re-feed their last token in place
+                    step_toks[b, 1:] = seqs[b][-1]
+            kv, nxt = fns["verify_step"](arrays, kv,
+                                         jnp.asarray(step_toks),
+                                         jnp.asarray(pos0))
+            nxt_np = np.asarray(nxt)
+            emitted = []
+            for b in range(B):
+                if not active(b):
+                    continue
+                g = nxt_np[b]
+                m = _accept_length(step_toks[b], g)
+                emit = [int(t) for t in g[:m + 1]]
+                emit = emit[:max_new_tokens - len(outs[b])]
+                if eos_token_id is not None and eos_token_id in emit:
+                    emit = emit[:emit.index(eos_token_id) + 1]
+                    finished[b] = True
+                outs[b].extend(emit)
+                seqs[b].extend(emit)
+                emitted.append(len(emit))
+            self.last_accept_counts.append(emitted)
+        pad = eos_token_id if eos_token_id is not None else 0
+        toks = np.asarray(
+            [outs[b] + [pad] * (max_new_tokens - len(outs[b]))
+             for b in range(B)], np.int32)
         return Tensor(jnp.asarray(toks)), \
             Tensor(jnp.asarray(_gen_lens_np(toks, eos_token_id)))
 

@@ -31,15 +31,21 @@ class Config:
     def enable_continuous_batching(self, max_slots=None, block_size=None,
                                    num_blocks=None, max_seq_len=None,
                                    token_budget=None, eos_token_id=None,
-                                   cache_dtype=None):
+                                   cache_dtype=None, draft_k=None,
+                                   draft_ngram=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
-        `serving.ServingEngine`; None keeps the engine default."""
+        `serving.ServingEngine`; None keeps the engine default.
+        `draft_k > 0` turns on speculative multi-token decoding (greedy
+        only): an n-gram prompt-lookup draft proposes up to `draft_k`
+        tokens per decode and one verify pass scores them all — see the
+        speculative section of docs/SERVING.md."""
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
             token_budget=token_budget, eos_token_id=eos_token_id,
-            cache_dtype=cache_dtype)
+            cache_dtype=cache_dtype, draft_k=draft_k,
+            draft_ngram=draft_ngram)
         return self
 
     def continuous_batching_enabled(self):

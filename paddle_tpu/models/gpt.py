@@ -234,6 +234,31 @@ class GPTForGeneration(nn.Layer, GenerationMixin):
         logits = jnp.matmul(out, head.astype(out.dtype))
         return logits, cache
 
+    def _verify_core(self, arrays, tokens, positions, cache):
+        """Speculative verify: score K consecutive tokens in one pass.
+
+        tokens [B, K] int32, positions [B] (or scalar) — the position of
+        tokens[:, 0]; token j lands at positions + j. Returns
+        (logits [B, K, V], new_cache): logits[:, j] scores the
+        next-token distribution AFTER token j, so a greedy argmax over
+        axis -1 yields the sequential-greedy continuation for every
+        accepted prefix (see incubate/nn/generation.py)."""
+        import jax.numpy as jnp
+        from ..incubate.nn.fused_transformer import _run_stack, _ln
+        we, pe, dec, lnw, lnb, head = self._split_arrays(arrays)
+        K = tokens.shape[1]
+        offs = jnp.arange(K, dtype=jnp.int32)
+        pos = (positions + offs)[None, :] if positions.ndim == 0 \
+            else positions[:, None] + offs[None, :]
+        x = self._embed(we, pe, tokens, pos)
+        params = dict(zip(self._dec_names, dec))
+        cfg = self.decoder._cfg()
+        out, cache, _ = _run_stack(cfg, params, x, cache, "decode",
+                                   positions, None, None, None, False)
+        out = _ln(out, lnw, lnb, 1e-5)                    # [B, K, D]
+        logits = jnp.matmul(out, head.astype(out.dtype))
+        return logits, cache
+
     @classmethod
     def from_pretraining(cls, model: "GPTForPretraining",
                          compute_dtype="float32", weight_only=False):

@@ -354,6 +354,48 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     return jnp.einsum("ths,tshd->thd", p, v)
 
 
+def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
+                           positions, *, scale=None):
+    """Verify-shaped paged attention: q `[B, K, H, Dh]` — K queries per
+    slot (the speculative draft window: the last accepted token plus
+    the proposed draft tokens), each attending its own slot's paged
+    keys at positions <= its own.
+
+    q            [B, K, H, Dh] — K consecutive queries per slot
+    k_pool/v_pool [NB, BS, H, Dh] — one layer's paged pools
+    block_tables [S, MB] int32 — per-slot block lists, NULL-padded
+    slot_ids     [B] int32 — owning slot per query GROUP (-1 = padding)
+    positions    [B, K] int32 — per-query positions in the sequence
+
+    The row-granular sibling of `ragged_paged_attention`: the block
+    table is gathered ONCE per slot instead of once per flat token, so
+    the K-wide verify window costs one decode-shaped gather rather than
+    K of them — this is the entry the serving engine's speculative
+    mixed step uses for its fixed `[max_slots, K]` verify region.
+    Causality across the window is the position mask itself: draft
+    query j sees drafts 0..j-1 and nothing later, which is exactly the
+    sequential-greedy semantics the verifier needs.
+
+    Pure-XLA gather path (CPU-safe parity oracle); on TPU XLA fuses
+    the table gather into the attention einsums — a hand-tiled Pallas
+    multi-query paged kernel can slot in behind the same signature."""
+    B, K, H, Dh = q.shape
+    BS = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
+    safe_slot = jnp.clip(slot_ids, 0, block_tables.shape[0] - 1)
+    bt = block_tables[safe_slot]                      # [B, MB]
+    S = bt.shape[1] * BS
+    k = k_pool[bt].reshape(B, S, H, Dh).astype(q.dtype)
+    v = v_pool[bt].reshape(B, S, H, Dh).astype(q.dtype)
+    logits = jnp.einsum("bkhd,bshd->bhks", q, k).astype(jnp.float32)
+    logits = logits * scale
+    keep = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+    logits = jnp.where(keep[:, None], logits, -1e9)    # [B, H, K, S]
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhks,bshd->bkhd", p, v)
+
+
 def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
                     scale=None):
     """Decode-shaped paged attention: q [B, H, Dh], one query per

@@ -45,14 +45,31 @@ def all_gather_object(object_list, obj, group=None):
 def reduce_scatter(tensor, tensor_list, op=None, group=None,
                    sync_op=True):
     """Reduce a list of per-rank tensors, keep this rank's shard.
-    Composed as all_reduce + slice (GSPMD fuses the pair into one
-    reduce-scatter when this runs inside a compiled step)."""
+
+    Single controller (one process): the cross-rank reduction is an
+    identity on the replicated per-shard values — the result is simply
+    `tensor_list[rank]`, sliced DIRECTLY. Routing the concatenated
+    list through `all_reduce` instead would trip its per-rank
+    leading-axis heuristic whenever the concat's dim0 happens to equal
+    the rank count — e.g. nranks shards of shape [1, d] concatenate to
+    [nranks, d] and get summed away (ADVICE r5).
+
+    Multi-process (jax.distributed eager mode): concat -> real
+    all_reduce -> slice this rank's shard (GSPMD fuses the pair into
+    one reduce-scatter when this runs inside a compiled step)."""
     op = op if op is not None else C.ReduceOp.SUM
     import jax.numpy as jnp
+    rank = dist_env.get_rank()
+    if not C._multiproc():
+        if not (0 <= rank < len(tensor_list)):
+            raise ValueError(
+                f"reduce_scatter needs one input shard per rank; got "
+                f"{len(tensor_list)} shards for rank {rank}")
+        tensor._data = jnp.asarray(_as_arr(tensor_list[rank]))
+        return tensor
     stacked = Tensor(jnp.concatenate(
         [jnp.asarray(_as_arr(t)) for t in tensor_list], axis=0))
     C.all_reduce(stacked, op=op, group=group)
-    rank = dist_env.get_rank()
     shard = _as_arr(tensor_list[0]).shape[0]
     tensor._data = jnp.asarray(
         _as_arr(stacked)[rank * shard:(rank + 1) * shard])

@@ -170,11 +170,20 @@ def fused_allreduce_gradients(parameter_list, hcg=None,
     all_reduce's per-rank-leading-axis heuristic must NOT run here (a
     grad whose dim0 happens to equal the device count would be summed
     away). Cross-PROCESS reduction (jax.distributed multi-host eager
-    mode) still applies."""
+    mode) still applies, and there `scale` defaults to the
+    data-parallel world size: the reference's
+    `_apply_collective_grads` divides the summed gradients by nranks
+    (an unscaled sum would step with grads nranks(x) too large)."""
     import jax
     from ..core.tensor import Tensor
     from . import collective as C
     multi_process = jax.process_count() > 1
+    if scale is None and multi_process:
+        if hcg is not None:
+            scale = hcg.get_data_parallel_world_size()
+        else:
+            scale = jax.process_count()
+        scale = float(scale) if scale and scale > 1 else None
     for p in parameter_list:
         g = getattr(p, "grad", None)
         if g is None:

@@ -77,17 +77,29 @@ def round_up(n, m):
     return ((n + m - 1) // m) * m
 
 
-def choose_token_budget(max_slots, block_size, requested=None):
+def choose_token_budget(max_slots, block_size, requested=None,
+                        verify_width=1):
     """Per-step token budget: a power of two >= max(max_slots,
     2*block_size) so a full decode round always fits and prefill chunks
     cover at least two KV blocks per step (generation.py's bucket
     discipline applied to the step axis). An explicit `requested`
     budget is rounded up to a power of two and floored at `max_slots`
     (a budget below the slot count would stall resident requests
-    forever while they hold KV blocks)."""
+    forever while they hold KV blocks).
+
+    With speculation on (`verify_width` = draft_k + 1 > 1) the first
+    `max_slots * verify_width` flat tokens are the RESERVED verify
+    region (see `pack_step`), so the floor rises to that region plus
+    prefill room — a budget that left prefill zero tokens would starve
+    admission forever."""
+    vw = int(verify_width)
+    region = max_slots * vw
     if requested is not None:
-        return next_pow2(max(int(requested), max_slots), lo=1)
-    return next_pow2(max(max_slots, 2 * block_size))
+        floor = max_slots if vw == 1 else region + 1
+        return next_pow2(max(int(requested), floor), lo=1)
+    if vw == 1:
+        return next_pow2(max(max_slots, 2 * block_size))
+    return next_pow2(region + 2 * block_size)
 
 
 def prefill_chunk(remaining, budget_left):
@@ -115,37 +127,73 @@ class StepPlan:
     positions: np.ndarray       # [T] int32
     sample_index: np.ndarray    # [max_slots] int32, -1 = no sample
     num_tokens: int             # real tokens this step
-    decode_slots: list          # slots that fed one decode token
+    decode_slots: list          # slots that fed decode/verify tokens
     prefill_done: list          # slots whose prompt completed this step
     prefill_tokens: int
     decode_tokens: int
+    verify_width: int = 1       # 1 + draft_k (1 = no speculation)
+    decode_entries: list = dataclasses.field(default_factory=list)
+    #                         [(slot, [tokens], position)] as planned —
+    #                         the engine replays these against the
+    #                         verify logits to compute accept lengths
 
 
-def pack_step(token_budget, max_slots, decode, prefills) -> StepPlan:
+def pack_step(token_budget, max_slots, decode, prefills,
+              verify_width=1) -> StepPlan:
     """Pack decode entries + prefill chunks into the flat-token layout.
 
-    decode: [(slot, token, position)] — one entry per running decode.
+    decode: [(slot, token_or_tokens, position)] — one entry per running
+        decode. A scalar token is the plain one-token decode; a list
+        [last, d_1..d_k] is a speculative verify group (k <= draft_k
+        proposed tokens after the last accepted one).
     prefills: [(slot, chunk_tokens: ndarray, start_pos, completes)] —
         `completes` marks the chunk that reaches the end of the prompt
         (its last token's hidden state samples the slot's first output).
-    """
-    n = len(decode) + sum(len(c[1]) for c in prefills)
-    if n > token_budget:
-        raise ValueError(f"plan of {n} tokens exceeds token budget "
-                         f"{token_budget}")
+
+    Layout: with `verify_width == 1` decode tokens pack densely from
+    index 0 and prefill chunks follow (the PR 2 layout, unchanged).
+    With speculation (`verify_width` = draft_k + 1 > 1) the first
+    `max_slots * verify_width` flat tokens are a FIXED verify region —
+    slot s owns indices [s*vw, (s+1)*vw) — so the compiled step can
+    reshape it to `[max_slots, vw]` and run the verify-shaped paged
+    attention + per-position logits without any gather indices that
+    change shape as the decode mix churns; prefill packs after the
+    region."""
+    vw = int(verify_width)
+    region = max_slots * vw if vw > 1 else 0
     token_ids = np.zeros(token_budget, np.int32)
     slot_ids = np.full(token_budget, -1, np.int32)
     positions = np.zeros(token_budget, np.int32)
     sample_index = np.full(max_slots, -1, np.int32)
     i = 0
     decode_slots = []
+    decode_entries = []
+    n_decode = 0
     for slot, tok, pos in decode:
-        token_ids[i] = tok
-        slot_ids[i] = slot
-        positions[i] = pos
-        sample_index[slot] = i
+        toks = [int(tok)] if np.isscalar(tok) or getattr(
+            tok, "ndim", None) == 0 else [int(t) for t in tok]
+        if len(toks) > max(vw, 1):
+            raise ValueError(
+                f"decode group of {len(toks)} tokens exceeds the "
+                f"verify width {max(vw, 1)}")
+        base = slot * vw if vw > 1 else i
+        token_ids[base:base + len(toks)] = toks
+        slot_ids[base:base + len(toks)] = slot
+        positions[base:base + len(toks)] = np.arange(
+            pos, pos + len(toks), dtype=np.int32)
+        if vw == 1:
+            sample_index[slot] = i
+            i += 1
         decode_slots.append(slot)
-        i += 1
+        decode_entries.append((slot, toks, int(pos)))
+        n_decode += len(toks)
+    if vw > 1:
+        i = region
+    n = n_decode + sum(len(c[1]) for c in prefills) \
+        + (region - n_decode if vw > 1 else 0)
+    if n > token_budget:
+        raise ValueError(f"plan of {n} tokens exceeds token budget "
+                         f"{token_budget}")
     prefill_done = []
     n_prefill = 0
     for slot, chunk, start, completes in prefills:
@@ -163,4 +211,5 @@ def pack_step(token_budget, max_slots, decode, prefills) -> StepPlan:
                     num_tokens=i, decode_slots=decode_slots,
                     prefill_done=prefill_done,
                     prefill_tokens=n_prefill,
-                    decode_tokens=len(decode))
+                    decode_tokens=n_decode, verify_width=vw,
+                    decode_entries=decode_entries)

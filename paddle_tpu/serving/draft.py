@@ -1,0 +1,63 @@
+"""Self-drafting proposers for speculative decoding.
+
+The cheapest draft model is the sequence itself: natural (and
+especially code/log-like) text repeats, so the continuation of the
+most recent earlier occurrence of the current tail n-gram is a strong
+guess for the next few tokens — "prompt lookup" decoding. No second
+model, no device work: the proposer runs host-side over the request's
+token list between engine steps.
+
+Correctness never depends on draft quality: the verify step
+(`incubate/nn/generation.py` speculative path, `serving/engine.py`
+mixed step) scores every proposed token against the real model and
+emits only the sequential-greedy prefix, so a bad draft costs speed,
+not output fidelity.
+"""
+from __future__ import annotations
+
+
+def accept_length(fed_tokens, scored_tokens):
+    """Longest accepted draft prefix for one verify group.
+
+    `fed_tokens` = [last_accepted, d_1..d_k] as fed to the verify step;
+    `scored_tokens[j]` = the model's greedy next token after fed token
+    j. Returns m: d_1..d_m matched the model exactly, so the emitter
+    takes `scored_tokens[:m + 1]` (the accepted drafts re-derived from
+    the model's own outputs, plus its correction after the last match).
+    This off-by-one contract lives HERE, once — the generate() loop and
+    the serving engine must never disagree on it."""
+    m = 0
+    while m < len(fed_tokens) - 1 and \
+            int(fed_tokens[m + 1]) == int(scored_tokens[m]):
+        m += 1
+    return m
+
+
+def ngram_propose(tokens, k, max_ngram=3, min_ngram=1):
+    """Propose `k` draft tokens for the sequence `tokens`.
+
+    Finds the longest trailing n-gram (n from `max_ngram` down to
+    `min_ngram`) with an earlier occurrence in the sequence — the MOST
+    RECENT occurrence wins, matching the local context — and copies the
+    k tokens that followed it. Short continuations (or no match at all)
+    are padded by repeating the last available token, so the caller
+    always gets exactly `k` proposals (the verify step's shape never
+    depends on draft luck)."""
+    k = int(k)
+    if k <= 0:
+        return []
+    toks = [int(t) for t in tokens]
+    n_t = len(toks)
+    out = []
+    for n in range(min(int(max_ngram), n_t - 1), int(min_ngram) - 1, -1):
+        tail = toks[n_t - n:]
+        for s in range(n_t - n - 1, -1, -1):
+            if toks[s:s + n] == tail:
+                out = toks[s + n:s + n + k]
+                break
+        if out:
+            break
+    pad = out[-1] if out else (toks[-1] if toks else 0)
+    while len(out) < k:
+        out.append(pad)
+    return out

@@ -18,6 +18,15 @@ Host loop per `step()`:
   scheduler.plan()  →  pack_step()  →  jitted mixed step  →  sample
   bookkeeping (TTFT / inter-token metrics, EOS + length termination,
   block release).
+
+With `draft_k > 0` (greedy only) each decode feeds a verify group —
+the last accepted token plus up to draft_k n-gram prompt-lookup
+proposals (`serving.draft`) — through a fixed `[max_slots, draft_k+1]`
+verify region scored by `verify_paged_attention`; the host accepts the
+longest sequential-greedy prefix, emits 1..draft_k+1 tokens, and rolls
+back KV blocks the rejected tail had claimed. Output stays
+token-identical to `draft_k=0`, and the step still compiles exactly
+once (docs/SERVING.md).
 """
 from __future__ import annotations
 
@@ -40,7 +49,10 @@ class ServingEngine:
     def __init__(self, model, *, max_slots=8, block_size=16,
                  num_blocks=None, max_seq_len=None, token_budget=None,
                  sampling=None, eos_token_id=None, cache_dtype=None,
-                 seed=0, clock=time.monotonic):
+                 seed=0, clock=time.monotonic, draft_k=0,
+                 draft_ngram=3):
+        import functools
+
         import jax
         import jax.numpy as jnp
         model.eval()
@@ -58,18 +70,29 @@ class ServingEngine:
         if num_blocks is None:
             # full residency for every slot, + the reserved null block
             num_blocks = max_slots * mbps + 1
+        self.draft_k = int(draft_k)
+        self.sampling = sampling or SamplingConfig()
+        if self.draft_k > 0 and self.sampling.strategy != "greedy":
+            raise ValueError(
+                "speculative serving (draft_k > 0) verifies against the "
+                "greedy continuation; sampling strategies need rejection "
+                "sampling, which is not implemented")
         self.token_budget = batcher.choose_token_budget(
-            max_slots, self.block_size, token_budget)
+            max_slots, self.block_size, token_budget,
+            verify_width=self.draft_k + 1)
         dtype = cache_dtype or getattr(model, "_gen_cache_dtype",
                                        "bfloat16")
         self.kv = PagedKVCache(
             L, H, Dh, num_blocks=num_blocks,
             block_size=self.block_size, max_slots=max_slots,
             max_blocks_per_slot=mbps, dtype=dtype)
-        self.scheduler = Scheduler(self.kv, max_slots=max_slots,
-                                   token_budget=self.token_budget,
-                                   clock=clock)
-        self.sampling = sampling or SamplingConfig()
+        from .draft import ngram_propose
+        self.scheduler = Scheduler(
+            self.kv, max_slots=max_slots,
+            token_budget=self.token_budget, clock=clock,
+            draft_k=self.draft_k,
+            draft_fn=functools.partial(ngram_propose, k=self.draft_k,
+                                       max_ngram=int(draft_ngram)))
         self.eos_token_id = eos_token_id
         self.clock = clock
         self._rng = jax.random.PRNGKey(int(seed))
@@ -92,7 +115,8 @@ class ServingEngine:
 
         from ..incubate.nn.fused_transformer import (
             _ffn_dense, _ln, _mm, _qkv)
-        from ..ops.pallas.flash_attention import ragged_paged_attention
+        from ..ops.pallas.flash_attention import (
+            ragged_paged_attention, verify_paged_attention)
 
         model = self.model
         cfg = model.decoder._cfg()
@@ -103,6 +127,9 @@ class ServingEngine:
         L = cfg.num_layers
         BS = self.block_size
         T = self.token_budget
+        S = self.kv.max_slots
+        K = self.draft_k + 1          # verify width (1 = no speculation)
+        R = S * K                     # reserved verify region (K > 1)
         sc = self.sampling
 
         def step(arrays, k_pool, v_pool, token_ids, slot_ids, positions,
@@ -126,8 +153,26 @@ class ServingEngine:
                 q, k, v = q[0], k[0], v[0]                  # [T, H, Dh]
                 kp = kp.at[li, wb, wo].set(k.astype(kp.dtype))
                 vp = vp.at[li, wb, wo].set(v.astype(vp.dtype))
-                attn = ragged_paged_attention(
-                    q, kp[li], vp[li], block_tables, slot_ids, pos)
+                if K == 1:
+                    attn = ragged_paged_attention(
+                        q, kp[li], vp[li], block_tables, slot_ids, pos)
+                else:
+                    # the fixed verify region (slot s owns flat tokens
+                    # [s*K, (s+1)*K)) runs through the verify-shaped
+                    # entry — ONE block-table gather per slot instead of
+                    # one per flat token; prefill chunks keep the
+                    # flat-token ragged path
+                    qv = q[:R].reshape(S, K, cfg.num_heads, cfg.head_dim)
+                    av = verify_paged_attention(
+                        qv, kp[li], vp[li], block_tables,
+                        jnp.arange(S, dtype=jnp.int32),
+                        pos[:R].reshape(S, K))
+                    ap = ragged_paged_attention(
+                        q[R:], kp[li], vp[li], block_tables,
+                        slot_ids[R:], pos[R:])
+                    attn = jnp.concatenate(
+                        [av.reshape(R, cfg.num_heads, cfg.head_dim),
+                         ap], axis=0)
                 attn = attn.reshape(T, cfg.num_heads * cfg.head_dim)
                 out = _mm(cfg, attn, pl["out_w"], pl.get("out_s"))
                 out = out + pl["out_b"].astype(out.dtype)
@@ -144,7 +189,16 @@ class ServingEngine:
             h_last = xf[sidx]                          # [max_slots, D]
             logits = jnp.matmul(h_last, head.astype(h_last.dtype))
             tok = select_token(logits, rng, sc)
-            return tok, k_pool, v_pool
+            if K == 1:
+                return tok, k_pool, v_pool
+            # greedy scores for EVERY verify-region position: tok_v[s, j]
+            # is the model's next token after slot s's j-th fed token —
+            # the host accepts the longest draft prefix matching it
+            hv = xf[:R].reshape(S, K, -1)
+            logits_v = jnp.matmul(hv, head.astype(hv.dtype))
+            tok_v = jnp.argmax(logits_v.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)
+            return (tok, tok_v), k_pool, v_pool
 
         return step
 
@@ -182,9 +236,10 @@ class ServingEngine:
         if plan.empty:
             return bool(plan.expired)
         sp = pack_step(self.token_budget, self.kv.max_slots,
-                       plan.decode, plan.prefills)
+                       plan.decode, plan.prefills,
+                       verify_width=self.draft_k + 1)
         self._rng, sub = jax.random.split(self._rng)
-        tok, self.kv.k_pool, self.kv.v_pool = self._step_fn(
+        out, self.kv.k_pool, self.kv.v_pool = self._step_fn(
             self._arrays, self.kv.k_pool, self.kv.v_pool,
             jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
             jnp.asarray(sp.positions),
@@ -192,13 +247,15 @@ class ServingEngine:
             jnp.asarray(sp.sample_index), sub)
         sch.note_fed(plan)
         self.steps_run += 1
-        tok_np = np.asarray(tok)
+        if self.draft_k:
+            tok_np, tokv_np = (np.asarray(t) for t in out)
+        else:
+            tok_np, tokv_np = np.asarray(out), None
         now = self.clock()
-        for slot in sp.prefill_done + sp.decode_slots:
-            req = sch.slots[slot]
-            if req is None:
-                continue
-            t = int(tok_np[slot])
+
+        def emit(req, tokens):
+            """Append generated tokens; returns True when the request
+            reached a terminal state (EOS / horizon)."""
             if req.state == "prefill":
                 req.state = "decode"
             if req.first_token_time is None:
@@ -210,13 +267,50 @@ class ServingEngine:
                 smetrics.SERVING_INTER_TOKEN_SECONDS.observe(
                     now - req._last_token_time)
             req._last_token_time = now
-            req.output.append(t)
-            if len(req.output) >= req.max_new_tokens or \
-                    (req.eos_token_id is not None
-                     and t == req.eos_token_id):
-                sch.finish(req, now)
+            for t in tokens:
+                req.output.append(t)
+                if len(req.output) >= req.max_new_tokens or \
+                        (req.eos_token_id is not None
+                         and t == req.eos_token_id):
+                    sch.finish(req, now)
+                    if _pmetrics._enabled:
+                        smetrics.SERVING_REQUESTS.labels(
+                            "finished").inc()
+                    return True
+            return False
+
+        for slot in sp.prefill_done:
+            req = sch.slots[slot]
+            if req is not None:
+                emit(req, [int(tok_np[slot])])
+        if self.draft_k:
+            from .draft import accept_length
+            for slot, toks, pos in sp.decode_entries:
+                req = sch.slots[slot]
+                if req is None:
+                    continue
+                g = tokv_np[slot]
+                m = accept_length(toks, g)
                 if _pmetrics._enabled:
-                    smetrics.SERVING_REQUESTS.labels("finished").inc()
+                    smetrics.SERVING_ACCEPT_LENGTH.observe(m + 1)
+                    if len(toks) > 1:
+                        smetrics.SERVING_DRAFT_TOKENS.labels(
+                            "proposed").inc(len(toks) - 1)
+                        smetrics.SERVING_DRAFT_TOKENS.labels(
+                            "accepted").inc(m)
+                done = emit(req, [int(t) for t in g[:m + 1]])
+                if not done:
+                    # roll back blocks whose only contents were
+                    # rejected-draft K/V columns
+                    freed = sch.note_accept(slot, pos + m + 1)
+                    if freed and _pmetrics._enabled:
+                        smetrics.SERVING_SPEC_ROLLBACKS.inc()
+                        smetrics.SERVING_SPEC_ROLLBACK_BLOCKS.inc(freed)
+        else:
+            for slot in sp.decode_slots:
+                req = sch.slots[slot]
+                if req is not None:
+                    emit(req, [int(tok_np[slot])])
         if _pmetrics._enabled:
             smetrics.SERVING_STEPS.inc()
             smetrics.SERVING_TOKENS.labels("prefill").inc(

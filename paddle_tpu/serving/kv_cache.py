@@ -125,6 +125,26 @@ class PagedKVCache:
             row.append(b)
         return True
 
+    def truncate_slot(self, slot, new_len):
+        """Roll back `slot` to cover only `new_len` tokens: blocks past
+        `blocks_for(new_len)` go back to the free list and their table
+        entries reset to NULL. Returns the number of blocks freed.
+
+        This is the speculative-decode rollback: rejected draft tokens
+        may have forced block allocations their K/V never ended up
+        needing; the garbage they DID write into still-owned blocks
+        needs no cleanup (the position mask hides it and the next
+        accepted tokens overwrite it)."""
+        keep = self.blocks_for(new_len)
+        row = self._slot_blocks[slot]
+        if len(row) <= keep:
+            return 0
+        extra = row[keep:]
+        self.allocator.free(extra)
+        self._slot_blocks[slot] = row[:keep]
+        self.block_tables[slot, keep:] = NULL_BLOCK
+        return len(extra)
+
     def release_slot(self, slot):
         row = self._slot_blocks[slot]
         if row:

@@ -48,6 +48,22 @@ SERVING_STEPS = REGISTRY.counter(
     "paddle_tpu_serving_steps_total",
     "Mixed-step invocations")
 
+# ---- speculative decoding (draft_k > 0) --------------------------------
+SERVING_ACCEPT_LENGTH = REGISTRY.histogram(
+    "paddle_tpu_serving_accept_length",
+    "Tokens emitted per verify group (accepted draft prefix + the "
+    "model's own next token: 1 .. draft_k+1)",
+    buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+SERVING_DRAFT_TOKENS = REGISTRY.counter(
+    "paddle_tpu_serving_draft_tokens_total",
+    "Draft tokens by verify outcome", ("outcome",))  # proposed|accepted
+SERVING_SPEC_ROLLBACKS = REGISTRY.counter(
+    "paddle_tpu_serving_spec_rollbacks_total",
+    "Verify groups whose rejected draft tokens forced a KV rollback")
+SERVING_SPEC_ROLLBACK_BLOCKS = REGISTRY.counter(
+    "paddle_tpu_serving_spec_rollback_blocks_total",
+    "KV blocks returned to the free list by draft rollbacks")
+
 #: every name above, for the smoke-tool contract check
 CONTRACT_METRICS = (
     "paddle_tpu_serving_ttft_seconds",
@@ -60,4 +76,18 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_requests_total",
     "paddle_tpu_serving_tokens_total",
     "paddle_tpu_serving_steps_total",
+    "paddle_tpu_serving_accept_length",
+    "paddle_tpu_serving_draft_tokens_total",
+    "paddle_tpu_serving_spec_rollbacks_total",
+    "paddle_tpu_serving_spec_rollback_blocks_total",
 )
+
+#: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —
+#: exported as a plain function so dashboards and the smoke tool agree
+#: on the definition
+def draft_hit_ratio():
+    ch = dict(SERVING_DRAFT_TOKENS.samples())
+    prop = ch.get(("proposed",))
+    acc = ch.get(("accepted",))
+    p = prop.value if prop else 0.0
+    return (acc.value if acc else 0.0) / p if p else 0.0

@@ -76,7 +76,7 @@ class Plan:
 
 class Scheduler:
     def __init__(self, kv_cache, *, max_slots, token_budget,
-                 clock=time.monotonic):
+                 clock=time.monotonic, draft_k=0, draft_fn=None):
         self.kv = kv_cache
         self.max_slots = max_slots
         self.token_budget = token_budget
@@ -85,6 +85,12 @@ class Scheduler:
         self.slots = [None] * max_slots
         self._ids = itertools.count()
         self.preemption_count = 0
+        # speculative decoding: each decode may carry up to draft_k
+        # proposed tokens (draft_fn(seq) -> list of draft_k ints); the
+        # engine verifies them and advances slot_lens itself, so
+        # note_fed leaves decode lengths alone when draft_k > 0
+        self.draft_k = int(draft_k)
+        self.draft_fn = draft_fn
 
     # ---------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
@@ -163,6 +169,33 @@ class Scheduler:
         self.queue.appendleft(victim)
         return victim
 
+    # ------------------------------------------------- speculative draft
+    def _draft_tokens(self, req, pos):
+        """[last_token, d_1..d_k] for one decode's verify group.
+
+        k starts at draft_k and shrinks to what is actually worth
+        feeding: never past the request's remaining horizon (a draft
+        beyond max_new_tokens could only emit discarded tokens), never
+        past the slot's token capacity, and never past what FREE blocks
+        can back — draft tokens extend only with free blocks, exactly
+        like prefill chunks, so a speculative burst can't preempt a
+        neighbour's accepted work."""
+        k = min(self.draft_k,
+                req.max_new_tokens - len(req.output) - 1,
+                self.kv.max_slot_tokens - (pos + 1))
+        if k > 0:
+            # free-block extension only: shrink k to the free coverage
+            while k > 0 and not self.kv.ensure_capacity(
+                    req.slot, pos + 1 + k):
+                fit = (self.kv.slot_num_blocks(req.slot)
+                       + self.kv.allocator.num_free) \
+                    * self.kv.block_size - (pos + 1)
+                k = min(k - 1, fit) if fit > 0 else 0
+        if k <= 0:
+            return [req.output[-1]]
+        draft = self.draft_fn(req.prompt + req.output)
+        return [req.output[-1]] + [int(t) for t in draft[:k]]
+
     # ------------------------------------------------------------ plan
     def plan(self) -> Plan:
         """One engine iteration's work. Mutates scheduler/cache state
@@ -192,9 +225,17 @@ class Scheduler:
             if req.slot < 0:
                 continue
             protected.add(req)
-            decode.append((req.slot, req.output[-1], pos))
+            if self.draft_k > 0:
+                decode.append((req.slot,
+                               self._draft_tokens(req, pos), pos))
+            else:
+                decode.append((req.slot, req.output[-1], pos))
 
-        budget_left = self.token_budget - len(decode)
+        # with speculation the verify region is RESERVED up front (see
+        # batcher.pack_step) — prefill budget never depends on the mix
+        reserved = len(decode) if self.draft_k == 0 \
+            else self.max_slots * (self.draft_k + 1)
+        budget_left = self.token_budget - reserved
         prefills = []
         prefillers = sorted(
             (r for r in self.slots
@@ -225,11 +266,23 @@ class Scheduler:
 
     # ------------------------------------------------- post-step hooks
     def note_fed(self, plan: Plan):
-        """Advance slot lengths for every token the step consumed."""
-        for slot, _tok, pos in plan.decode:
-            self.kv.slot_lens[slot] = pos + 1
+        """Advance slot lengths for every token the step consumed.
+
+        Speculative decodes are NOT advanced here: how far a verify
+        group really got is only known after the engine reads the
+        accept length back, so `note_accept` owns that bookkeeping."""
+        if self.draft_k == 0:
+            for slot, _tok, pos in plan.decode:
+                self.kv.slot_lens[slot] = pos + 1
         for slot, chunk, start, _ in plan.prefills:
             self.kv.slot_lens[slot] = start + len(chunk)
+
+    def note_accept(self, slot, new_len):
+        """Record a verify group's outcome: `new_len` tokens of the
+        slot are cached and valid; blocks allocated for rejected draft
+        tokens beyond it are rolled back. Returns blocks freed."""
+        self.kv.slot_lens[slot] = new_len
+        return self.kv.truncate_slot(slot, new_len)
 
     def finish(self, req, now=None):
         req.state = "finished"

@@ -123,8 +123,16 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
           center=True, normalized=False, onesided=True, length=None,
           return_complex=False, name=None):
     """Inverse stft with window-envelope-normalized overlap-add
-    (the reference's NOLA reconstruction)."""
+    (the reference's NOLA reconstruction). A `length` beyond the
+    reconstructable span is zero-padded (reference contract: the
+    caller asked for that many samples, the frames simply end
+    earlier)."""
     x = as_tensor(x)
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False — a "
+            "onesided spectrum is irfft'd to a REAL signal, so a "
+            "complex return is undefined (the reference raises too)")
     hop = hop_length if hop_length is not None else n_fft // 4
     wl = win_length if win_length is not None else n_fft
     win = _resolve_window(window, wl)
@@ -159,6 +167,11 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         if center:
             out = out[..., n_fft // 2: T - n_fft // 2]
         if length is not None:
-            out = out[..., :length]
+            have = out.shape[-1]
+            if length > have:
+                out = jnp.pad(out, [(0, 0)] * (out.ndim - 1)
+                              + [(0, length - have)])
+            else:
+                out = out[..., :length]
         return out[0] if squeeze else out
     return dispatch.apply("istft", _fn, (x,))

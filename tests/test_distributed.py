@@ -268,6 +268,32 @@ def test_all_gather_object_and_reduce_scatter():
     np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])
 
 
+def test_reduce_scatter_one_row_and_1d_shards():
+    """ADVICE r5 regression: the single-process branch must slice
+    tensor_list[rank] directly. The old concat->all_reduce composition
+    summed [1, d] shards away whenever the concat's dim0 hit the rank
+    count (all_reduce's per-rank leading-axis heuristic)."""
+    world = paddle.distributed.get_world_size()   # 8 on the test mesh
+    # [1, d] shards: the world-sized concat's dim0 == nranks, exactly
+    # the shape that tripped the heuristic. Result = rank-0 shard.
+    shards = [paddle.to_tensor(np.full((1, 3), float(i + 1), np.float32))
+              for i in range(world)]
+    t = paddle.zeros([1, 3])
+    paddle.distributed.reduce_scatter(t, shards)
+    assert list(t.shape) == [1, 3]
+    np.testing.assert_allclose(t.numpy(), np.ones((1, 3), np.float32))
+    # 1-D shards: rank-0 shard, not a sum or a slice artifact
+    shards = [paddle.to_tensor(np.array([2.0 * i + 1, 2.0 * i + 2],
+                                        np.float32))
+              for i in range(world)]
+    t = paddle.zeros([2])
+    paddle.distributed.reduce_scatter(t, shards)
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0])
+    # empty shard list is a usage error, not an IndexError
+    with pytest.raises(ValueError):
+        paddle.distributed.reduce_scatter(paddle.zeros([1]), [])
+
+
 def test_global_scatter_gather_roundtrip():
     x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
     lc = paddle.to_tensor(np.array([4, 2], np.int64))

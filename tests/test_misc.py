@@ -447,3 +447,45 @@ def test_paddle_batch_and_sysconfig_and_fleet_utils(tmp_path):
     paddle.distributed.fleet.utils.fused_allreduce_gradients(
         list(lin.parameters()))
     np.testing.assert_allclose(lin.weight.grad.numpy(), g0)
+
+
+def test_fused_allreduce_gradients_scales_by_dp_world(monkeypatch):
+    """ADVICE r5 regression: in the multi-process branch `scale` must
+    default to the DP world size (reference `_apply_collective_grads`
+    divides the summed grads by nranks) — without it every DP step ran
+    with grads nranks(x) too large."""
+    import jax
+    import numpy as np
+    import paddle_tpu.nn as nn
+    from paddle_tpu.parallel import collective as C
+
+    lin = nn.Linear(2, 2)
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    (lin(x) ** 2).sum().backward()
+    g0 = lin.weight.grad.numpy().copy()
+
+    # simulate a 2-process DP world: process_count says 2 and the
+    # cross-process all_reduce sums two identical replicas (2x)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    def fake_all_reduce(t, *a, **k):
+        t._data = t._data * 2
+        return t
+
+    monkeypatch.setattr(C, "all_reduce", fake_all_reduce)
+    paddle.distributed.fleet.utils.fused_allreduce_gradients(
+        list(lin.parameters()))
+    # sum(2 replicas) / default scale(=2) == the true data-parallel grad
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g0, rtol=1e-6)
+
+    # an hcg wins over process_count for the divisor
+    class FakeHcg:
+        def get_data_parallel_world_size(self):
+            return 4
+
+    (lin(x) ** 2).sum().backward()
+    g1 = lin.weight.grad.numpy().copy()
+    paddle.distributed.fleet.utils.fused_allreduce_gradients(
+        list(lin.parameters()), hcg=FakeHcg())
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g1 * 2.0 / 4.0,
+                               rtol=1e-6)

@@ -347,6 +347,84 @@ def test_reduce_lr_on_plateau():
     assert abs(float(opt._learning_rate) - 0.05) < 1e-9
 
 
+def test_reduce_lr_on_plateau_no_double_fire_with_eval():
+    """With an eval loop, each epoch fires on_epoch_end (train logs)
+    AND on_eval_end (eval logs). The callback must monitor exactly one
+    of them — eval — so wait advances once per epoch and `best` never
+    mixes train and eval losses."""
+    import paddle_tpu.nn as nn
+    net = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=2, verbose=0)
+
+    class FakeModel:
+        _optimizer = opt
+    cb.set_model(FakeModel())
+    cb.set_params({"do_eval": True})
+    # train loss "improves" every epoch while eval loss plateaus: only
+    # the eval series may drive the schedule. Two flat eval epochs
+    # after the best must NOT reduce yet (patience=2 -> reduce on the
+    # 3rd), and the improving train values must not reset wait.
+    for epoch in range(2):
+        cb.on_epoch_end(epoch, {"loss": 1.0 - 0.3 * epoch})
+        cb.on_eval_end({"loss": 0.5})
+    assert abs(float(opt._learning_rate) - 0.1) < 1e-9  # wait=1 only
+    cb.on_epoch_end(2, {"loss": 0.01})
+    cb.on_eval_end({"loss": 0.5})                       # wait=2 -> fire
+    assert abs(float(opt._learning_rate) - 0.05) < 1e-9
+    # standalone evaluate() (no do_eval param) still monitors eval and
+    # permanently silences the train hook once seen
+    cb2 = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                             patience=1, verbose=0)
+    opt2 = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+    class FakeModel2:
+        _optimizer = opt2
+    cb2.set_model(FakeModel2())
+    cb2.on_eval_end({"loss": 1.0})
+    cb2.on_epoch_end(0, {"loss": 0.1})   # ignored: eval loop exists
+    cb2.on_eval_end({"loss": 1.0})       # wait=1 -> reduce
+    assert abs(float(opt2._learning_rate) - 0.05) < 1e-9
+    # without any eval loop the train hook still works
+    cb3 = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                             patience=1, verbose=0)
+    opt3 = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+    class FakeModel3:
+        _optimizer = opt3
+    cb3.set_model(FakeModel3())
+    cb3.on_epoch_end(0, {"loss": 1.0})
+    cb3.on_epoch_end(1, {"loss": 1.0})
+    assert abs(float(opt3._learning_rate) - 0.05) < 1e-9
+
+
+def test_istft_length_pad_and_complex_guard():
+    """Reference istft contract: `length` past the reconstructable
+    span zero-pads instead of silently returning fewer samples, and
+    return_complex=True with onesided=True raises."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(1, 256).astype(np.float32)
+    win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+    S = paddle.signal.stft(paddle.to_tensor(x), n_fft=128,
+                           hop_length=32, window=win)
+    # reconstructable span (center=True) is 256; ask for more
+    xr = paddle.signal.istft(S, n_fft=128, hop_length=32, window=win,
+                             length=300)
+    assert xr.shape[-1] == 300
+    np.testing.assert_allclose(np.asarray(xr.numpy())[0, 256:],
+                               np.zeros(44, np.float32), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(xr.numpy())[0, 32:224],
+                               x[0, 32:224], atol=1e-4)
+    # truncation still works
+    xr2 = paddle.signal.istft(S, n_fft=128, hop_length=32, window=win,
+                              length=200)
+    assert xr2.shape[-1] == 200
+    with pytest.raises(ValueError, match="return_complex"):
+        paddle.signal.istft(S, n_fft=128, hop_length=32, window=win,
+                            return_complex=True, onesided=True)
+
+
 def test_incubate_multiprocessing_tensor_pickle():
     from multiprocessing.reduction import ForkingPickler
     import pickle

@@ -1,0 +1,279 @@
+"""Speculative decoding parity suite.
+
+Contract (ISSUE 3 / docs/SERVING.md): speculative decoding is a pure
+latency optimization — greedy outputs are token-identical with and
+without it, in both the single-request `generate()` path and the
+continuous-batching serving engine (including across preemptions and
+draft rejections), and every compiled entry point (prefill, decode,
+verify, the serving mixed step) compiles exactly once.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving import metrics as sm
+from paddle_tpu.serving.batcher import choose_token_budget, pack_step
+from paddle_tpu.serving.draft import ngram_propose
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+from paddle_tpu.serving.kv_cache import NULL_BLOCK, PagedKVCache
+
+
+def _model(vocab=193, layers=2, heads=4, hidden=32, maxpos=256):
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=vocab, hidden_size=hidden,
+                         num_layers=layers, num_attention_heads=heads,
+                         max_position_embeddings=maxpos,
+                         compute_dtype="float32")
+    m.eval()
+    return m
+
+
+# ------------------------------------------------------------ drafting
+
+
+def test_ngram_propose_prompt_lookup():
+    # trailing [7, 8] re-occurs earlier; its continuation is copied
+    assert ngram_propose([1, 7, 8, 9, 5, 7, 8], 2) == [9, 5]
+    # most RECENT earlier occurrence wins
+    assert ngram_propose([7, 8, 1, 7, 8, 2, 7, 8], 1) == [2]
+    # no match: pad by repeating the last token, always k long
+    assert ngram_propose([1, 2, 3], 3) == [3, 3, 3]
+    # short continuation padded to k (continuation [9, 4], then pad)
+    assert ngram_propose([4, 9, 4], 3, max_ngram=1) == [9, 4, 4]
+    assert ngram_propose([5], 0) == []
+
+
+# ------------------------------------------------- generate() parity
+
+
+class TestGenerateSpeculative:
+    def test_token_identity_with_and_without(self):
+        """Greedy outputs must be byte-identical for draft_k 0 vs >0 —
+        repetitive prompts (drafts accept) and unstructured ones
+        (drafts mostly reject) alike."""
+        m = _model()
+        prompts = [[3, 14, 15, 9, 2, 6, 3, 14, 15, 9],    # repetitive
+                   [7, 8],                                 # short
+                   list(range(1, 12)),                     # structured
+                   [42]]                                   # single token
+        for p in prompts:
+            ids = Tensor(np.array([p], np.int64))
+            base, bl = m.generate(ids, max_new_tokens=20,
+                                  cache_dtype="float32")
+            for k in (1, 3, 4):
+                spec, sl = m.generate(ids, max_new_tokens=20,
+                                      cache_dtype="float32", draft_k=k)
+                assert spec.numpy().tolist() == base.numpy().tolist()
+                assert sl.numpy().tolist() == bl.numpy().tolist()
+
+    def test_ragged_batch_with_eos(self):
+        m = _model()
+        ids = Tensor(np.array([[5, 6, 7, 0, 0], [8, 9, 1, 2, 3]],
+                              np.int64))
+        kw = dict(max_new_tokens=12, eos_token_id=3,
+                  cache_dtype="float32", seq_lens=[3, 5])
+        base, bl = m.generate(ids, **kw)
+        spec, sl = m.generate(ids, draft_k=3, **kw)
+        assert spec.numpy().tolist() == base.numpy().tolist()
+        assert sl.numpy().tolist() == bl.numpy().tolist()
+
+    def test_accepts_multiple_tokens_on_repetitive_output(self):
+        """Greedy continuations of a tiny model fall into cycles the
+        n-gram draft picks up: fewer verify steps than a sequential
+        decode would take (i.e. some drafts were accepted)."""
+        m = _model()
+        p = [3, 14, 15, 9, 2, 6, 5, 3, 14, 15, 9, 2]
+        ids = Tensor(np.array([p], np.int64))
+        out, _ = m.generate(ids, max_new_tokens=24,
+                            cache_dtype="float32", draft_k=4)
+        steps = len(m.last_accept_counts)
+        assert out.numpy().shape == (1, 24)
+        assert steps < 22  # sequential decode would take 23 steps
+
+    def test_sampling_rejected(self):
+        m = _model()
+        ids = Tensor(np.array([[1, 2, 3]], np.int64))
+        with pytest.raises(ValueError, match="greedy"):
+            m.generate(ids, max_new_tokens=4, draft_k=2,
+                       decode_strategy="sampling",
+                       cache_dtype="float32")
+
+    def test_compile_counts(self):
+        """prefill, decode and verify entries each compile exactly once
+        across repeated calls with the same shape bucket."""
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            ids = Tensor(np.array([[5, 6, 7]], np.int64))
+            for _ in range(2):
+                m.generate(ids, max_new_tokens=8, cache_dtype="float32",
+                           draft_k=3)
+            # a generation-length change within the same shape bucket
+            # must NOT recompile the shape-only verify/prefill entries
+            m.generate(ids, max_new_tokens=12, cache_dtype="float32",
+                       draft_k=3)
+            for _ in range(2):
+                m.generate(ids, max_new_tokens=8, cache_dtype="float32",
+                           use_scan=False)
+            assert pm.JIT_COMPILES.labels("gen_prefill").value == 1
+            assert pm.JIT_COMPILES.labels("gen_verify_step").value == 1
+            assert pm.JIT_COMPILES.labels("gen_decode_step").value == 1
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+
+# ------------------------------------------------- serving-side layout
+
+
+def test_choose_token_budget_reserves_verify_region():
+    # non-speculative floors unchanged (PR 2 behavior)
+    assert choose_token_budget(8, 16) == 32
+    # speculative: region (8 slots x 4 wide = 32) + prefill room
+    assert choose_token_budget(8, 16, verify_width=4) == 64
+    # explicit budgets are floored above the region
+    assert choose_token_budget(4, 8, 8, verify_width=4) >= 4 * 4 + 1
+
+
+def test_pack_step_verify_region_layout():
+    plan = pack_step(32, 4,
+                     decode=[(2, [42, 50, 51], 7), (0, [43], 3)],
+                     prefills=[(1, np.arange(5, dtype=np.int32), 0,
+                                True)],
+                     verify_width=4)
+    # slot 2's verify group sits at flat [8, 11); slot 0's at [0, 1)
+    assert plan.token_ids[8:11].tolist() == [42, 50, 51]
+    assert plan.slot_ids[8:11].tolist() == [2, 2, 2]
+    assert plan.positions[8:11].tolist() == [7, 8, 9]
+    assert plan.token_ids[0] == 43 and plan.slot_ids[0] == 0
+    assert (plan.slot_ids[1:8] == -1).all()   # region padding
+    # prefill packs after the reserved region (4 slots x 4)
+    assert plan.slot_ids[16:21].tolist() == [1] * 5
+    assert plan.sample_index.tolist() == [-1, 20, -1, -1]
+    assert plan.decode_tokens == 4
+    assert plan.decode_entries == [(2, [42, 50, 51], 7), (0, [43], 3)]
+    # oversized verify group refused
+    with pytest.raises(ValueError):
+        pack_step(32, 4, decode=[(0, [1, 2, 3, 4, 5], 0)], prefills=[],
+                  verify_width=4)
+
+
+def test_kv_truncate_slot_rolls_back_blocks():
+    kv = PagedKVCache(1, 1, 8, num_blocks=9, block_size=4, max_slots=2,
+                      max_blocks_per_slot=8)
+    assert kv.ensure_capacity(0, 15)          # 4 blocks
+    assert kv.slot_num_blocks(0) == 4
+    freed = kv.truncate_slot(0, 6)            # keep 2 blocks
+    assert freed == 2 and kv.slot_num_blocks(0) == 2
+    assert (kv.block_tables[0, 2:] == NULL_BLOCK).all()
+    assert kv.truncate_slot(0, 6) == 0        # idempotent
+    # freed blocks are reusable immediately
+    assert kv.ensure_capacity(1, 8)
+
+
+# ---------------------------------------------------- serving parity
+
+
+class TestServingSpeculative:
+    def test_parity_with_generation(self):
+        m = _model()
+        prompts = [[3, 14, 15, 9, 2, 3, 14, 15], [7, 8],
+                   list(range(1, 12)), [42]]
+        eng = ServingEngine(m, max_slots=4, block_size=8,
+                            max_seq_len=64, cache_dtype="float32",
+                            draft_k=4)
+        outs = eng.generate_batch(prompts, max_new_tokens=10)
+        for p, o in zip(prompts, outs):
+            solo, _ = m.generate(Tensor(np.array([p], np.int64)),
+                                 max_new_tokens=10,
+                                 cache_dtype="float32")
+            assert o == solo.numpy()[0].tolist()
+        assert eng.kv.blocks_in_use == 0
+
+    def test_parity_survives_preemption_and_rejections(self):
+        """Small pool forces preemption mid-draft; random prompts force
+        draft rejections — outputs still match generate() exactly."""
+        m = _model()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 193, n).tolist()
+                   for n in (9, 5, 12, 3, 7, 10)]
+        eng = ServingEngine(m, max_slots=4, block_size=4, num_blocks=10,
+                            max_seq_len=32, cache_dtype="float32",
+                            draft_k=3)
+        outs = eng.generate_batch(prompts, max_new_tokens=8)
+        assert eng.scheduler.preemption_count > 0
+        for p, o in zip(prompts, outs):
+            solo, _ = m.generate(Tensor(np.array([p], np.int64)),
+                                 max_new_tokens=8,
+                                 cache_dtype="float32")
+            assert o == solo.numpy()[0].tolist()
+        assert eng.kv.blocks_in_use == 0
+
+    def test_eos_inside_accepted_run(self):
+        """An EOS emitted mid-verify-group must terminate the request
+        at the EOS, discarding the rest of the accepted run."""
+        m = _model()
+        solo, lens = m.generate(Tensor(np.array([[5, 6, 7]], np.int64)),
+                                max_new_tokens=10, eos_token_id=0,
+                                cache_dtype="float32", use_scan=False)
+        eng = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=64, cache_dtype="float32",
+                            eos_token_id=0, draft_k=4)
+        (out,) = eng.generate_batch([[5, 6, 7]], max_new_tokens=10)
+        want = solo.numpy()[0][:int(lens.numpy()[0])].tolist()
+        assert out == want
+
+    def test_single_compile_and_spec_metrics(self):
+        """The speculative mixed step still compiles exactly once, and
+        the accept-length / draft-hit / rollback metrics record."""
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            eng = ServingEngine(m, max_slots=4, block_size=4,
+                                num_blocks=10, max_seq_len=32,
+                                cache_dtype="float32", draft_k=3)
+            rng = np.random.RandomState(1)
+            for _ in range(3):
+                prompts = [rng.randint(1, 193, int(n)).tolist()
+                           for n in rng.randint(2, 14, 3)]
+                eng.generate_batch(prompts, max_new_tokens=6)
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value == 1
+            assert sm.SERVING_ACCEPT_LENGTH.count > 0
+            proposed = dict(sm.SERVING_DRAFT_TOKENS.samples())
+            assert proposed[("proposed",)].value > 0
+            assert 0.0 <= sm.draft_hit_ratio() <= 1.0
+            text = pm.REGISTRY.to_prometheus()
+            for name in ("paddle_tpu_serving_accept_length",
+                         "paddle_tpu_serving_draft_tokens_total",
+                         "paddle_tpu_serving_spec_rollbacks_total",
+                         "paddle_tpu_serving_spec_rollback_blocks_total"):
+                assert name in text
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_sampling_engine_rejected(self):
+        from paddle_tpu.serving.batcher import SamplingConfig
+        m = _model()
+        with pytest.raises(ValueError, match="greedy"):
+            ServingEngine(m, max_slots=2, block_size=8, max_seq_len=64,
+                          cache_dtype="float32", draft_k=2,
+                          sampling=SamplingConfig("sampling"))
+
+    def test_inference_config_passthrough(self):
+        import paddle_tpu.inference as infer
+        m = _model()
+        cfg = infer.Config().enable_continuous_batching(
+            max_slots=2, block_size=8, max_seq_len=64,
+            cache_dtype="float32", draft_k=2)
+        eng = infer.create_serving_engine(cfg, m)
+        assert eng.draft_k == 2
+        (out,) = eng.generate_batch([[1, 2, 3]], max_new_tokens=4)
+        solo, _ = m.generate(Tensor(np.array([[1, 2, 3]], np.int64)),
+                             max_new_tokens=4, cache_dtype="float32")
+        assert out == solo.numpy()[0].tolist()

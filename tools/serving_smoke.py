@@ -26,6 +26,7 @@ def run_smoke():
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTForGeneration
     from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving import metrics as sm
     from paddle_tpu.serving.engine import ServingEngine, STEP_FN_NAME
 
     pm.enable()
@@ -52,14 +53,42 @@ def run_smoke():
     if engine.kv.blocks_in_use != 0:
         failures.append(f"{engine.kv.blocks_in_use} blocks leaked "
                         "after all requests finished")
-    return engine, failures
+
+    # ---- speculative phase: same model, draft_k=3 verify engine ----
+    spec = ServingEngine(model, max_slots=4, block_size=4,
+                         num_blocks=12, max_seq_len=48,
+                         cache_dtype="float32", seed=0, draft_k=3)
+    spec_out = spec.generate_batch(prompts, max_new_tokens=6)
+    if spec_out != outputs:
+        failures.append("speculative outputs diverge from the "
+                        "non-speculative engine (greedy must be "
+                        "token-identical)")
+    spec_compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - compiles
+    if spec_compiles != 1:
+        failures.append(f"speculative mixed step compiled "
+                        f"{spec_compiles} times, want 1")
+    if spec.kv.blocks_in_use != 0:
+        failures.append(f"{spec.kv.blocks_in_use} blocks leaked by the "
+                        "speculative engine")
+    if sm.SERVING_ACCEPT_LENGTH.count <= 0:
+        failures.append("no verify groups recorded in the "
+                        "accept-length histogram")
+    proposed = dict(sm.SERVING_DRAFT_TOKENS.samples())
+    if not proposed.get(("proposed",)) or \
+            proposed[("proposed",)].value <= 0:
+        failures.append("no draft tokens recorded as proposed")
+    ratio = sm.draft_hit_ratio()
+    if not 0.0 <= ratio <= 1.0:
+        failures.append(f"draft hit ratio {ratio} out of [0, 1]")
+    return engine, spec, failures
 
 
 def main():
     from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving import metrics as sm
     from paddle_tpu.serving.metrics import CONTRACT_METRICS
 
-    engine, failures = run_smoke()
+    engine, spec, failures = run_smoke()
     text = pm.REGISTRY.to_prometheus()
     print(text)
     for name in CONTRACT_METRICS:
@@ -69,8 +98,12 @@ def main():
         for f in failures:
             print(f"SMOKE FAILURE: {f}", file=sys.stderr)
         return 1
+    groups = max(1, sm.SERVING_ACCEPT_LENGTH.count)
     print(f"serving smoke OK: 8 requests, {engine.steps_run} mixed "
-          f"steps, {engine.scheduler.preemption_count} preemptions",
+          f"steps, {engine.scheduler.preemption_count} preemptions; "
+          f"speculative: {spec.steps_run} steps, mean accept "
+          f"{sm.SERVING_ACCEPT_LENGTH.sum / groups:.2f} tok/group, "
+          f"draft hit ratio {sm.draft_hit_ratio():.2f}",
           file=sys.stderr)
     return 0
 
