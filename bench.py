@@ -509,6 +509,16 @@ def main():
         "extras": [],
     }
 
+    # layout mode of record for the vision configs (ISSUE 4): which
+    # path produced the resnet/lenet numbers in this run
+    from paddle_tpu.core import layout as _layout_mod
+    result["extras"].append({
+        "metric": "layout_mode",
+        "value": ("nhwc_propagated" if _layout_mod.enabled()
+                  else "nchw_per_op"),
+        "s2d_stem": _layout_mod.s2d_stem_enabled(),
+    })
+
     # serving extra runs on every platform (CPU tiny GPT) and carries
     # the continuous-batching >= 2x-vs-sequential driver contract —
     # run it BEFORE the TPU extras so a long compile tail (e.g. the

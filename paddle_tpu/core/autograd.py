@@ -127,6 +127,9 @@ def _accumulate_leaf(tensor, grad_array, leaf_targets=None):
     g = grad_array
     if tensor.grad is None:
         tensor._grad = Tensor(g, stop_gradient=True)
+        # grads arrive in the tensor's PHYSICAL layout; carry the tag so
+        # .grad presents the same logical facade as the tensor itself
+        tensor._grad._layout = tensor._layout
     else:
         tensor._grad._data = tensor._grad._data + g
     for hook in tensor._grad_hooks:
@@ -186,6 +189,15 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             g_arr = jnp.ones_like(t._data)
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+            # align the cotangent's physical layout with the root's
+            # (core/layout.py): seeds must enter in t's PHYSICAL layout
+            g_tag = g._layout if isinstance(g, Tensor) else None
+            if t._layout is not None and g_tag is None:
+                from . import layout as _lay
+                g_arr = jnp.transpose(g_arr, _lay.TO_NHWC_PERM)
+            elif t._layout is None and g_tag is not None:
+                from . import layout as _lay
+                g_arr = jnp.transpose(g_arr, _lay.TO_NCHW_PERM)
         node = t._grad_node
         if node is None:
             _accumulate_leaf(t, g_arr, leaf_targets)
@@ -333,6 +345,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             if t._grad_node is not None:
                 g = capture.get((id(t._grad_node), t._out_slot))
                 got = None if g is None else _T(g, stop_gradient=True)
+                if got is not None:
+                    # captured cotangent is in t's PHYSICAL layout — tag
+                    # it so .shape/.numpy() present the logical facade
+                    got._layout = t._layout
             else:
                 got = t._grad
             if got is None:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd
+from . import layout as _layout
 from .autograd import Edge, GradNode
 from ..profiler import metrics as _metrics
 
@@ -58,6 +59,19 @@ def _is_float(dtype) -> bool:
 # flags/amp snapshot. Steps 2+ skip tracing entirely and dispatch a
 # compiled executable. Ops whose closures capture non-scalar state
 # (arrays, objects) safely fall back to the per-node trace.
+#
+# INVARIANT (every op fn passed to apply() must obey): the fingerprint
+# hashes the code object, closure cells, defaults, and the FLAGS/amp
+# snapshot — it does NOT hash anything the fn reads from its
+# `__globals__`. An op fn that reads a *mutable* module global inside
+# its body would replay a stale compiled backward after that global
+# changes. All per-call variability must therefore flow through closure
+# variables, defaults, functools.partial args, or the paddle
+# FLAGS/amp-state snapshot (which IS part of the cache key). The repo's
+# op library follows this convention everywhere (e.g. conv closes over
+# strides/pad/dimension-spec booleans); tests/test_pass_cache.py
+# asserts it for a representative op and demonstrates the aliasing that
+# motivates the rule.
 
 _VJP_JIT_CACHE = {}
 _VJP_JIT_CACHE_MAX = 1024
@@ -81,7 +95,12 @@ def _scalar_const(v):
 
 def _fn_fingerprint(fn):
     """Hashable identity of fn's code + captured constants, or None when
-    the closure holds anything we can't safely key on."""
+    the closure holds anything we can't safely key on.
+
+    GUARD: values fn reads from `__globals__` are deliberately NOT part
+    of the fingerprint (hashing a module dict per dispatch would cost
+    more than the trace it saves) — see the INVARIANT note above. Keep
+    op fns free of mutable-global reads."""
     try:
         if isinstance(fn, functools.partial):
             sub = _fn_fingerprint(fn.func)
@@ -232,6 +251,25 @@ def apply(name, fn, inputs, differentiable=True):
 
     if _metrics._enabled:
         _metrics.DISPATCH_OPS.labels(name).inc()
+
+    # ---- layout funnel (core/layout.py) --------------------------------
+    # Tagged (physically-NHWC) inputs: layout-AWARE ops pass through
+    # untouched (their functional built fn for the tag), TRANSPARENT
+    # elementwise ops run physically and propagate the tag, everything
+    # else materializes back to the logical layout first — correctness
+    # never depends on an op being layout-aware.
+    out_tag = None
+    for t in inputs:
+        if t._layout is not None:
+            if name in _layout.AWARE_OPS:
+                break
+            if name in _layout.TRANSPARENT_OPS and \
+                    _layout._transparent_ok(inputs):
+                out_tag = _layout.NHWC
+                break
+            inputs = tuple(_layout.materialize(i) for i in inputs)
+            break
+
     arrays = tuple(t._data for t in inputs)
     need_grad = (
         differentiable
@@ -287,5 +325,7 @@ def apply(name, fn, inputs, differentiable=True):
         if need_grad and _is_float(o.dtype):
             t._grad_node = node
             t._out_slot = i
+        if out_tag is not None and o.ndim == 4:
+            t._layout = out_tag    # transparent op: tag rides through
         results.append(t)
     return tuple(results) if multi else results[0]

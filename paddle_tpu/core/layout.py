@@ -1,0 +1,118 @@
+"""Channels-last layout propagation (layout autotune).
+
+Parity: the reference's imperative layout-autotune pass
+(`paddle/fluid/imperative/layout_autotune.cc` + `layout_transformer.h`):
+vision models are written channel-first (NCHW) but matrix-unit hardware
+wants channels-last (NHWC), so the framework rewrites the *interior* of
+the graph to NHWC and keeps the public API/checkpoints NCHW.
+
+TPU translation: instead of a graph pass, the layout is a physical tag
+carried on the eager `Tensor` wrapper and resolved at dispatch time —
+which also covers jit tracing, because the compiled train step traces
+the model through the same `dispatch.apply` funnel.
+
+  - A tensor with ``_layout == "NHWC"`` is *logically* NCHW but its
+    backing array is stored as NHWC (axes permuted by `TO_NHWC_PERM`).
+    Tags are only ever applied to 4-D image tensors.
+  - Layout-AWARE ops (conv2d, batch_norm, pooling, interpolate, pad)
+    consume and produce tagged tensors natively — no edge transposes.
+  - Layout-TRANSPARENT ops (elementwise: relu/add/mul/cast/...) run
+    directly on the tagged physical array and propagate the tag.
+  - Every other op hits the default policy in `dispatch.apply`:
+    `materialize()` back to logical NCHW first. Correctness never
+    depends on an op knowing about layouts.
+
+Net effect: an NCHW user model runs its whole conv/BN/pool interior in
+NHWC with exactly one transpose at each graph edge (the first conv's
+input, and the materialize at the pool->flatten/fc boundary).
+
+Gate: ``PADDLE_TPU_LAYOUT_AUTOTUNE`` (default ON; ``=0`` restores the
+per-op edge transposes bit-for-bit for A/B). The optional
+space-to-depth ResNet stem rewrite is gated by ``PADDLE_TPU_S2D_STEM``
+(default OFF; see nn/functional/conv.py).
+"""
+from __future__ import annotations
+
+import os
+
+NHWC = "NHWC"              # the only physical tag (4-D logical-NCHW only)
+TO_NHWC_PERM = (0, 2, 3, 1)
+TO_NCHW_PERM = (0, 3, 1, 2)
+
+
+def enabled() -> bool:
+    v = os.environ.get("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    return v.lower() not in ("0", "false", "off", "no")
+
+
+def s2d_stem_enabled() -> bool:
+    v = os.environ.get("PADDLE_TPU_S2D_STEM", "0")
+    return v.lower() in ("1", "true", "on", "yes")
+
+
+# Ops that handle tags themselves (their functional inspects input tags
+# and builds the right fn): dispatch.apply must pass tagged inputs
+# through untouched. The layout_to_* transposes are here too — they ARE
+# the materialization, recursing would never terminate.
+AWARE_OPS = frozenset({
+    "conv2d", "batch_norm_train", "batch_norm_infer", "pool",
+    "adaptive_pool", "interpolate", "pad",
+    "layout_to_nchw", "layout_to_nhwc",
+})
+
+# Shape-preserving elementwise ops where physical layout is irrelevant:
+# run on the raw NHWC array and keep the tag. An op may only live here
+# if its semantics carry NO axis meaning (a reduction, an axis= arg, or
+# broadcasting against a non-scalar untagged operand all disqualify —
+# see _transparent_ok for the runtime guard on operands).
+TRANSPARENT_OPS = frozenset({
+    # activations (nn/functional/activation.py)
+    "relu", "relu6", "sigmoid", "tanh", "gelu", "silu", "swish", "mish",
+    "leaky_relu", "elu", "selu", "celu", "hardswish", "hardsigmoid",
+    "hardtanh", "hardshrink", "softshrink", "softplus", "softsign",
+    "tanhshrink", "swiglu",
+    # elementwise math (ops/math.py)
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "pow", "scale", "clip", "nan_to_num", "abs", "square", "sqrt",
+    "rsqrt", "exp", "expm1", "log", "log1p", "sign", "floor", "ceil",
+    "round", "heaviside", "logaddexp",
+    # plumbing ("dropout" here is the axis=None form only — the
+    # functional materializes first when axis= is given)
+    "cast", "assign", "dropout", "dropout_scale",
+})
+
+
+def _transparent_ok(inputs) -> bool:
+    """A transparent op may run physically only when every operand is
+    either tagged (4-D, consistently permuted) or layout-free (scalar /
+    single element, which broadcasts identically under any axis
+    permutation). An untagged multi-element operand would broadcast
+    against the wrong trailing axis — fall back to materialization."""
+    for t in inputs:
+        if t._layout is None and t._data.ndim != 0 and t._data.size != 1:
+            return False
+    return True
+
+
+def materialize(t):
+    """Return `t` in its logical (untagged, NCHW) layout, recording the
+    transpose on the autograd tape / trace like any other op."""
+    if t._layout is None:
+        return t
+    from . import dispatch
+    import jax.numpy as jnp
+    out = dispatch.apply("layout_to_nchw",
+                         lambda a: jnp.transpose(a, TO_NCHW_PERM), (t,))
+    return out
+
+
+def to_nhwc(t):
+    """Tagged (physically NHWC) view of a logically-NCHW tensor."""
+    if t._layout == NHWC:
+        return t
+    from . import dispatch
+    import jax.numpy as jnp
+    out = dispatch.apply("layout_to_nhwc",
+                         lambda a: jnp.transpose(a, TO_NHWC_PERM), (t,))
+    out._layout = NHWC
+    return out

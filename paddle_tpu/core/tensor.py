@@ -27,6 +27,7 @@ class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_grad_node", "_out_slot",
         "name", "persistable", "_grad_hooks", "trainable", "dist_spec",
+        "_layout",
     )
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
@@ -57,6 +58,10 @@ class Tensor:
         if isinstance(place, place_mod.Place):
             arr = jax.device_put(arr, place.jax_device())
         self._data = arr
+        # physical-layout tag (core/layout.py): None = logical layout;
+        # "NHWC" = logically-NCHW image stored channels-last. Inherited
+        # when wrapping another Tensor (same backing array).
+        self._layout = data._layout if isinstance(data, Tensor) else None
         self.stop_gradient = bool(stop_gradient)
         self._grad = None
         self._grad_node = None
@@ -70,6 +75,10 @@ class Tensor:
     # -- basic metadata -------------------------------------------------
     @property
     def shape(self):
+        if self._layout is not None:       # physical NHWC -> logical NCHW
+            from . import layout as layout_mod
+            s = self._data.shape
+            return [s[i] for i in layout_mod.TO_NCHW_PERM]
         return list(self._data.shape)
 
     @property
@@ -132,10 +141,14 @@ class Tensor:
 
     # -- host interop ---------------------------------------------------
     def numpy(self):
-        return np.asarray(self._data)
+        a = np.asarray(self._data)
+        if self._layout is not None:       # hand back the logical layout
+            from . import layout as layout_mod
+            a = a.transpose(*layout_mod.TO_NCHW_PERM)
+        return a
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._data)
+        a = self.numpy()
         return a.astype(dtype) if dtype is not None else a
 
     def item(self, *args):
@@ -151,6 +164,7 @@ class Tensor:
 
     # -- autograd -------------------------------------------------------
     def backward(self, grad_tensor=None, retain_graph=False):
+        # run_backward converts logical-NCHW cotangents for tagged roots
         autograd.run_backward([self], [grad_tensor], retain_graph)
 
     def clear_grad(self):
@@ -171,6 +185,7 @@ class Tensor:
 
     def detach(self):
         t = Tensor(self._data, stop_gradient=True)
+        t._layout = self._layout
         t.name = self.name
         return t
 
@@ -216,6 +231,7 @@ class Tensor:
                 out = Tensor(jax.device_put(t._data, dev.jax_device()),
                              stop_gradient=t.stop_gradient)
                 out._grad_node, out._out_slot = t._grad_node, t._out_slot
+                out._layout = t._layout
                 t = out
             else:
                 t = t.astype(a)
@@ -283,6 +299,7 @@ class Tensor:
         self._grad_node = out._grad_node
         self._out_slot = out._out_slot
         self.stop_gradient = out.stop_gradient
+        self._layout = out._layout  # setitem materialized a tagged self
 
 
 def _make_binop(opname, reverse=False):

@@ -25,6 +25,7 @@ def relu_(x, name=None):
     out = relu(x)
     x._data, x._grad_node, x._out_slot = out._data, out._grad_node, \
         out._out_slot
+    x._layout = out._layout
     return x
 
 

@@ -40,6 +40,11 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     x = as_tensor(x)
+    if axis is not None and x._layout is not None:
+        # axis semantics are logical — the tag-transparent fast path in
+        # dispatch would broadcast the mask over the wrong physical axes
+        from ...core import layout as _layout
+        x = _layout.materialize(x)
     if not training or p == 0.0:
         if mode == "downscale_in_infer" and not training:
             return unary("dropout_scale", lambda a: a * (1 - p), x)
@@ -205,8 +210,18 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
+    from ...core import layout as _layout
     x = as_tensor(x)
     nchw = data_format in ("NCHW", "NCDHW", "NCL")
+
+    # layout propagation: resize the tagged (physically NHWC) array in
+    # place — jax.image.resize is layout-agnostic given the full shape
+    tagged = (data_format == "NCHW" and x._layout is not None
+              and _layout.enabled())
+    if x._layout is not None and not tagged:
+        x = _layout.materialize(x)
+    if tagged:
+        nchw = False
 
     def _fn(a):
         spatial = a.shape[2:] if nchw else a.shape[1:-1]
@@ -225,7 +240,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         else:
             full = [a.shape[0]] + tgt + [a.shape[-1]]
         return jax.image.resize(a, full, method=jmode)
-    return unary("interpolate", _fn, x)
+    out = unary("interpolate", _fn, x)
+    if tagged:
+        out._layout = _layout.NHWC
+    return out
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",

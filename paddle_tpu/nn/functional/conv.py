@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import layout as _layout
 from ...ops._helpers import as_tensor
 
 
@@ -24,17 +25,21 @@ def _tuple(v, n):
 
 
 def _conv_padding(padding, n, strides=None):
+    # tuples, not lists: this value lands in op-fn closures and a list
+    # would knock the op out of the memoized-vjp cache (dispatch.py
+    # fingerprint INVARIANT)
     if isinstance(padding, str):
         return padding.upper()  # SAME / VALID
     if isinstance(padding, int):
-        return [(padding, padding)] * n
+        return ((padding, padding),) * n
     padding = list(padding)
     if len(padding) == n and all(isinstance(p, int) for p in padding):
-        return [(p, p) for p in padding]
+        return tuple((p, p) for p in padding)
     if len(padding) == 2 * n:
-        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+        return tuple((padding[2 * i], padding[2 * i + 1])
+                     for i in range(n))
     if all(isinstance(p, (list, tuple)) for p in padding):
-        return [tuple(p) for p in padding]
+        return tuple(tuple(p) for p in padding)
     raise ValueError(f"bad padding {padding}")
 
 
@@ -48,14 +53,28 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
     pad = _conv_padding(padding, n)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     # layout autotune (imperative/layout_autotune.cc capability): TPU convs
-    # run ~20x faster channels-last, so compute internally in N...C and
-    # transpose at the facade edges (XLA cancels transposes between
-    # stacked channel-first layers)
+    # run ~20x faster channels-last, so compute internally in N...C.
+    # 2-D NCHW convs under PADDLE_TPU_LAYOUT_AUTOTUNE additionally keep
+    # the output PHYSICALLY NHWC (tagged, core/layout.py) so the whole
+    # conv/BN/pool interior runs channels-last with one transpose per
+    # graph edge; with the gate off, transposes sit at this op's edges
+    # as before and XLA is left to cancel what it can.
     spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
             3: ("NDHWC", "OIDHW", "NDHWC")}[n]
+    propagate = n == 2 and not channel_last and _layout.enabled()
+    if x._layout is not None and not propagate:
+        x = _layout.materialize(x)   # gate off / exotic format: logical in
+    in_nhwc = propagate and x._layout is not None
+    out_nhwc = propagate
+
+    if propagate and not in_nhwc and groups == 1 and \
+            _layout.s2d_stem_enabled():
+        s2d = _s2d_stem(x, weight, bias, strides, pad, dilations)
+        if s2d is not None:
+            return s2d
 
     def _fn(a, w, *b):
-        if not channel_last:
+        if not channel_last and not in_nhwc:
             a = jnp.moveaxis(a, 1, -1)
         dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, spec)
         out = jax.lax.conv_general_dilated(
@@ -66,13 +85,67 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
         if b:
             out = out + b[0].reshape((1,) * (out.ndim - 1)
                                      + (-1,)).astype(out.dtype)
-        if not channel_last:
+        if not channel_last and not out_nhwc:
             out = jnp.moveaxis(out, -1, 1)
         return out
     if bias is not None:
         bias = as_tensor(bias)
-        return dispatch.apply(f"conv{n}d", _fn, (x, weight, bias))
-    return dispatch.apply(f"conv{n}d", _fn, (x, weight))
+        out = dispatch.apply(f"conv{n}d", _fn, (x, weight, bias))
+    else:
+        out = dispatch.apply(f"conv{n}d", _fn, (x, weight))
+    if out_nhwc:
+        out._layout = _layout.NHWC
+    return out
+
+
+def _s2d_stem(x, weight, bias, strides, pad, dilations):
+    """Space-to-depth rewrite of the classic 3-channel 7x7/s2 ResNet stem
+    (PADDLE_TPU_S2D_STEM=1; MLPerf-ResNet TPU trick). C_in=3 leaves the
+    128-lane MXU ~97% idle; regrouping 2x2 pixel blocks into channels
+    runs the SAME convolution as a 4x4/s1 conv over 12 channels:
+
+        out[f,i,j] = sum_{c,p,q<7} x[c, 2i+p-3, 2j+q-3] w[f,c,p,q]
+                   = sum_{c,r,t,a,b<4} y[(r,t,c), i+a-2, j+b-2]
+                     * w8[f,c,2a+r,2b+t]
+
+    with y = space_to_depth(x, 2) and w8 the kernel zero-padded to 8x8
+    at the (top,left) so p=2a+r spans it exactly. The stored checkpoint
+    weight stays [F,3,7,7]; the transform is traced into the step.
+    Returns None when the conv doesn't match the stem pattern."""
+    xs = x._data.shape        # physically NCHW here (untagged input)
+    ws = weight._data.shape
+    if not (len(ws) == 4 and ws[1] == 3 and ws[2:] == (7, 7)
+            and strides == (2, 2) and dilations == (1, 1)
+            and pad == ((3, 3), (3, 3))
+            and xs[2] % 2 == 0 and xs[3] % 2 == 0):
+        return None
+
+    def _fn(a, w, *b):
+        n_, c, h, wd = a.shape
+        y = jnp.moveaxis(a, 1, -1)                     # N,H,W,C edge in
+        y = y.reshape(n_, h // 2, 2, wd // 2, 2, c)
+        y = jnp.transpose(y, (0, 1, 3, 2, 4, 5))       # N,H2,W2,r,t,C
+        y = y.reshape(n_, h // 2, wd // 2, 4 * c)      # (r,t,c) channels
+        w8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+        w4 = w8.reshape(w.shape[0], c, 4, 2, 4, 2)     # [F,c,a,r,b,t]
+        w4 = jnp.transpose(w4, (0, 3, 5, 1, 2, 4))     # [F,r,t,c,a,b]
+        w4 = w4.reshape(w.shape[0], 4 * c, 4, 4)
+        dn = jax.lax.conv_dimension_numbers(
+            y.shape, w4.shape, ("NHWC", "OIHW", "NHWC"))
+        out = jax.lax.conv_general_dilated(
+            y, w4, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=dn)
+        if b:
+            out = out + b[0].reshape((1, 1, 1, -1)).astype(out.dtype)
+        return out
+
+    if bias is not None:
+        out = dispatch.apply("conv2d", _fn,
+                             (x, weight, as_tensor(bias)))
+    else:
+        out = dispatch.apply("conv2d", _fn, (x, weight))
+    out._layout = _layout.NHWC
+    return out
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import layout as _layout
 from ...core.tensor import Tensor
 from ...ops._helpers import as_tensor
 
@@ -85,8 +86,18 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                data_format="NCHW", use_global_stats=None, name=None):
     x = as_tensor(x)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
-    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    # layout propagation (core/layout.py): a tagged input is physically
+    # NHWC — reduce over the leading axes and keep the output tagged, so
+    # conv->BN->conv chains never transpose. Per-channel running stats /
+    # affine params are 1-D and layout-free.
+    tagged = (not channel_last and x._layout is not None
+              and _layout.enabled())
+    if x._layout is not None and not tagged:
+        x = _layout.materialize(x)
+    phys_cl = channel_last or tagged
+    nd = x._data.ndim
+    ch_axis = nd - 1 if phys_cl else (1 if nd > 1 else 0)
+    reduce_axes = tuple(i for i in range(nd) if i != ch_axis)
     use_stats = (not training) if use_global_stats is None else \
         use_global_stats
 
@@ -99,8 +110,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         b_idx = len(inputs)
         inputs.append(as_tensor(bias))
 
-    bshape = [1] * x.ndim
-    bshape[ch_axis] = x.shape[ch_axis]
+    # tuple, not list: lists aren't hashable so a list bshape would
+    # knock this op out of the memoized-vjp cache (dispatch.py)
+    bshape = tuple(x._data.shape[i] if i == ch_axis else 1
+                   for i in range(nd))
 
     if use_stats:
         rm, rv = as_tensor(running_mean), as_tensor(running_var)
@@ -116,7 +129,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             if b_idx is not None:
                 out = out + arrs[b_idx].reshape(bshape)
             return out.astype(a.dtype)
-        return dispatch.apply("batch_norm_infer", _fn, tuple(inputs))
+        out = dispatch.apply("batch_norm_infer", _fn, tuple(inputs))
+        if tagged:
+            out._layout = _layout.NHWC
+        return out
 
     # training: compute batch stats; update running stats (stateful, on the
     # Tensor wrappers — traced arrays flow through during functional mode).
@@ -150,6 +166,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     out, batch_mean, batch_var = dispatch.apply(
         "batch_norm_train", _fn, tuple(inputs))
+    if tagged:
+        out._layout = _layout.NHWC
     if running_mean is not None:
         rm, rv = as_tensor(running_mean), as_tensor(running_var)
         # The reference kernel updates running_var with the *biased*

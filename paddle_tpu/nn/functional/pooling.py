@@ -9,8 +9,21 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...core import layout as _layout
 from ...ops._helpers import as_tensor, unary
 from .conv import _tuple
+
+
+def _ceil_extra(size, k, s, lo, hi):
+    """Extra high-side padding so the last (partial) window is emitted:
+    ceil((size+lo+hi-k)/s)+1 outputs instead of floor (PHI pool kernels'
+    AdaptStartEndIndex ceil branch)."""
+    span = size + lo + hi
+    out_floor = (span - k) // s + 1
+    out_ceil = -((span - k) // -s) + 1
+    if out_ceil <= out_floor:
+        return 0
+    return (out_ceil - 1) * s + k - span
 
 
 def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
@@ -26,26 +39,45 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
         pad_mode = None
         p = _tuple(padding, n) if not isinstance(padding, (list, tuple)) or \
             all(isinstance(v, int) for v in padding) else padding
-        if isinstance(p, tuple) and len(p) == n:
-            pads = [(v, v) for v in p]
+        # tuple, not list: pads lands in _fn's closure and must stay
+        # hashable for the memoized-vjp cache (dispatch.py)
+        if isinstance(p, tuple) and len(p) == n and \
+                all(isinstance(v, int) for v in p):
+            pads = tuple((v, v) for v in p)
         else:
-            pads = [tuple(v) for v in p]
+            pads = tuple(tuple(v) for v in p)
+
+    # layout propagation (core/layout.py): a tagged input is already
+    # physically channels-last — pool it in place and keep the tag.
+    tagged = (n == 2 and not channel_last and x._layout is not None
+              and _layout.enabled())
+    if x._layout is not None and not tagged:
+        x = _layout.materialize(x)
+    to_cl = not channel_last and not tagged
 
     def _fn(a):
         # channels-last internally (layout autotune; see conv.py)
-        to_cl = not channel_last
         if to_cl:
             a = jnp.moveaxis(a, 1, -1)
         window = (1,) + k + (1,)
         strides_full = (1,) + s + (1,)
-        pad_full = [(0, 0)] + (pads or [(0, 0)] * n) + [(0, 0)]
+        eff_pads = pads
+        if ceil_mode and pads is not None:
+            # pad the high side so ceil-mode's extra partial window
+            # exists; the pad region stays out of avg divisors below
+            eff_pads = tuple(
+                (lo, hi + _ceil_extra(a.shape[1 + i], k[i], s[i], lo, hi))
+                for i, (lo, hi) in enumerate(pads))
+        pad_full = [(0, 0)] + list(eff_pads or [(0, 0)] * n) + [(0, 0)]
         pad_cfg = pad_mode if pad_mode is not None else pad_full
         out = jax.lax.reduce_window(
             a, init(a.dtype), reducer, window, strides_full,
             pad_cfg if isinstance(pad_cfg, str) else pad_cfg)
         if average:
-            if exclusive and pads is not None and any(
-                    p_ != (0, 0) for p_ in (pads or [])):
+            if exclusive and eff_pads is not None and any(
+                    p_ != (0, 0) for p_ in eff_pads):
+                # padding contributes the 0-init, so counts = number of
+                # REAL elements per window (paddle exclusive=True)
                 ones = jnp.ones_like(a)
                 counts = jax.lax.reduce_window(
                     ones, 0.0 if not jnp.issubdtype(a.dtype, jnp.integer)
@@ -56,7 +88,10 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, channel_last,
         if to_cl:
             out = jnp.moveaxis(out, -1, 1)
         return out
-    return unary("pool", _fn, x)
+    out = unary("pool", _fn, x)
+    if tagged:
+        out._layout = _layout.NHWC
+    return out
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -77,25 +112,35 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
                      init, channel_last=(data_format == "NHWC"),
                      ceil_mode=ceil_mode)
-    assert data_format == "NCHW" and not ceil_mode, \
-        "return_mask supports NCHW, ceil_mode=False"
+    assert data_format in ("NCHW", "NHWC"), \
+        "return_mask supports NCHW / NHWC"
+    nhwc_in = data_format == "NHWC"
     k = _tuple(kernel_size, 2)
     s = _tuple(stride if stride is not None else kernel_size, 2)
     p = _tuple(padding, 2)
 
     def _pool_with_mask(a):
         """One pass producing (pooled max, flat H*W argmax index) — the
-        MaxPoolWithIndex kernel role, feeding max_unpool2d."""
+        MaxPoolWithIndex kernel role, feeding max_unpool2d. The mask
+        indexes the logical (unpadded, NCHW-ordered) H*W plane for both
+        data formats; ceil_mode pads the high side with -inf so the
+        partial windows exist but never win an argmax over real data."""
+        if nhwc_in:
+            a = jnp.moveaxis(a, -1, 1)
         n, c, h, w = a.shape
+        ph = (p[0], p[0] + (_ceil_extra(h, k[0], s[0], p[0], p[0])
+                            if ceil_mode else 0))
+        pw = (p[1], p[1] + (_ceil_extra(w, k[1], s[1], p[1], p[1])
+                            if ceil_mode else 0))
         av = jnp.pad(a.astype(jnp.float32),
-                     ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                     ((0, 0), (0, 0), ph, pw),
                      constant_values=-jnp.inf)
         iv = jnp.pad(jnp.arange(h * w, dtype=jnp.int32
                                 ).reshape(1, 1, h, w),
-                     ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                     ((0, 0), (0, 0), ph, pw),
                      constant_values=-1)
-        oh = (h + 2 * p[0] - k[0]) // s[0] + 1
-        ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+        oh = (h + ph[0] + ph[1] - k[0]) // s[0] + 1
+        ow = (w + pw[0] + pw[1] - k[1]) // s[1] + 1
         pv, pi = [], []
         for i in range(k[0]):
             for j in range(k[1]):
@@ -110,6 +155,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
         bi = jnp.broadcast_to(stacked_i,
                               (n, c) + stacked_i.shape[2:])
         mask = jnp.take_along_axis(bi, am, axis=2)[:, :, 0]
+        if nhwc_in:
+            out = jnp.moveaxis(out, 1, -1)
+            mask = jnp.moveaxis(mask, 1, -1)
         return out, mask
 
     from ...core import dispatch
@@ -120,14 +168,20 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCHW", name=None):
     """Inverse of max_pool2d(return_mask=True): scatter values back to
-    their argmax positions (`paddle/phi/kernels/unpool_kernel.h`)."""
+    their argmax positions (`paddle/phi/kernels/unpool_kernel.h`).
+    Accepts the same data_format as the pooling that produced the mask
+    (the mask always addresses the logical H*W plane)."""
     from ...core import dispatch
     x = as_tensor(x)
     indices = as_tensor(indices)
+    nhwc_in = data_format == "NHWC"
     k = _tuple(kernel_size, 2)
     s = _tuple(stride if stride is not None else kernel_size, 2)
     p = _tuple(padding, 2)
-    n, c, ih, iw = x.shape
+    if nhwc_in:
+        n, ih, iw, c = x.shape
+    else:
+        n, c, ih, iw = x.shape
     if output_size is None:
         if p[0] or p[1]:
             # the mask addresses the ORIGINAL input plane; the padded
@@ -139,15 +193,23 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         oh = (ih - 1) * s[0] - 2 * p[0] + k[0]
         ow = (iw - 1) * s[1] - 2 * p[1] + k[1]
     else:
-        oh, ow = [int(v) for v in output_size[-2:]]
+        spatial = output_size[1:3] if nhwc_in and len(output_size) == 4 \
+            else output_size[-2:]
+        oh, ow = [int(v) for v in spatial]
 
     def _fn(a, idx):
+        if nhwc_in:
+            a = jnp.moveaxis(a, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
         flat_v = a.reshape(n * c, ih * iw)
         flat_i = idx.reshape(n * c, ih * iw).astype(jnp.int32)
         out = jnp.zeros((n * c, oh * ow), a.dtype)
         rows = jnp.arange(n * c)[:, None]
         out = out.at[rows, flat_i].set(flat_v)
-        return out.reshape(n, c, oh, ow)
+        out = out.reshape(n, c, oh, ow)
+        if nhwc_in:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
 
     return dispatch.apply("max_unpool2d", _fn, (x, indices))
 
@@ -213,6 +275,15 @@ def _adaptive(x, output_size, n, mode, channel_last):
     x = as_tensor(x)
     out_sz = _tuple(output_size, n)
 
+    # layout propagation: pool the tagged (physically NHWC) array in
+    # place; the (N,1,1,C)-physical output stays tagged and the
+    # flatten/fc graph edge materializes it (a trivially small copy).
+    tagged = (n == 2 and not channel_last and x._layout is not None
+              and _layout.enabled())
+    if x._layout is not None and not tagged:
+        x = _layout.materialize(x)
+    channel_last = channel_last or tagged
+
     def _fn(a):
         spatial = a.shape[2:2 + n] if not channel_last else a.shape[1:1 + n]
         # exact adaptive pooling when divisible; else mean over variable bins
@@ -256,4 +327,7 @@ def _adaptive(x, output_size, n, mode, channel_last):
                 (0,) + tuple(range(2, 2 + n)) + (1,))
         nb, c = a.shape[0], a.shape[1]
         return stacked.reshape((nb, c) + tuple(out_sz))
-    return unary("adaptive_pool", _fn, x)
+    out = unary("adaptive_pool", _fn, x)
+    if tagged:
+        out._layout = _layout.NHWC
+    return out

@@ -148,6 +148,7 @@ def assign(x, output=None):
         output._grad_node = out._grad_node
         output._out_slot = out._out_slot
         output.stop_gradient = out.stop_gradient
+        output._layout = out._layout
         return output
     return out
 

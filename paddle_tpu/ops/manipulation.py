@@ -452,7 +452,11 @@ def getitem(x, idx):
         and any(isinstance(i, jax.Array) and i.dtype == jnp.bool_
                 for i in jidx))
     if has_dyn:
-        # boolean masks produce dynamic shapes: eager-only, no grad
+        # boolean masks produce dynamic shapes: eager-only, no grad.
+        # This path reads x._data directly, so it must materialize a
+        # tagged (physically-NHWC) tensor itself — the mask is logical
+        from ..core import layout as _layout
+        x = _layout.materialize(x)
         return Tensor(x._data[jidx])
     return unary("getitem", lambda a: a[jidx], x)
 
@@ -475,11 +479,18 @@ def setitem(x, idx, value):
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     """paddle.nn.functional.pad semantics (PHI pad kernels)."""
+    from ..core import layout as _layout
     x = as_tensor(x)
     if isinstance(pad, Tensor):
         pad = pad.tolist()
     pad = [int(p) for p in pad]
     nd = x.ndim
+    # layout propagation: pad the tagged (physically NHWC) array in
+    # place — widths are computed in logical NCHW terms, then permuted
+    tagged = (x._layout is not None and data_format == "NCHW"
+              and _layout.enabled())
+    if x._layout is not None and not tagged:
+        x = _layout.materialize(x)
 
     def _fn(a):
         if len(pad) == 2 * nd:
@@ -496,12 +507,17 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
                 dims = list(range(nd - n_spec, nd))
             for j, d in enumerate(reversed(dims)):
                 widths[d] = (pad[2 * j], pad[2 * j + 1])
+        if tagged:
+            widths = [widths[i] for i in _layout.TO_NHWC_PERM]
         jmode = {"constant": "constant", "reflect": "reflect",
                  "replicate": "edge", "circular": "wrap"}[mode]
         if jmode == "constant":
             return jnp.pad(a, widths, mode=jmode, constant_values=value)
         return jnp.pad(a, widths, mode=jmode)
-    return unary("pad", _fn, x)
+    out = unary("pad", _fn, x)
+    if tagged:
+        out._layout = _layout.NHWC
+    return out
 
 
 def shape(x):

@@ -411,6 +411,7 @@ def cummin(x, axis=None, dtype="int64", name=None):
 
 def _rebind(x, out):
     x._data = out._data
+    x._layout = out._layout  # the op may have materialized a tagged x
     if out._grad_node is not None:
         x._grad_node, x._out_slot = out._grad_node, out._out_slot
     else:

@@ -1,5 +1,13 @@
 """ResNet family — parity: `python/paddle/vision/models/resnet.py`
 (ResNet-18/34/50/101/152, wide variants, resnext). BASELINE config 2.
+
+Layout: the model is written NCHW; under PADDLE_TPU_LAYOUT_AUTOTUNE
+(default on) the whole conv/BN/pool interior runs physically NHWC via
+the tag-propagation pass in core/layout.py — no model changes needed,
+one transpose per graph edge. PADDLE_TPU_S2D_STEM=1 additionally
+rewrites conv1 (3-channel 7x7/s2, ~3% MXU utilization at C=3) into an
+equivalent space-to-depth 12-channel 4x4/s1 conv inside the traced
+step (docs/layout_analysis.md); checkpoint layout is unchanged.
 """
 from __future__ import annotations
 

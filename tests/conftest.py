@@ -12,6 +12,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy compile/e2e tests excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

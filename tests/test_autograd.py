@@ -228,6 +228,61 @@ def test_vjp_jit_cache_isolates_closure_constants():
     assert len(dispatch._VJP_JIT_CACHE) == n1
 
 
+_MUTABLE_GLOBAL = 1.0
+
+
+def test_fn_fingerprint_globals_invariant():
+    """dispatch.py INVARIANT (ADVICE r5): the memoized-backward
+    fingerprint hashes the code object + closure cells + defaults — it
+    deliberately does NOT hash values the fn reads from `__globals__`.
+    (a) demonstrates the blind spot the invariant exists for: a fn
+    reading a mutable module global keeps ONE fingerprint across global
+    mutations, so such an op would replay a stale compiled backward.
+    (b) asserts the convention on a representative real op: conv2d's
+    per-call variability (strides/padding/layout booleans) flows through
+    closure cells and lands in the cache key."""
+    from paddle_tpu.core import dispatch
+    import paddle_tpu.nn.functional as F
+
+    # (a) the documented hazard — why op fns must not read mutable
+    # globals: the fingerprint cannot see the change
+    global _MUTABLE_GLOBAL
+
+    def reads_global(a):
+        return a * _MUTABLE_GLOBAL
+
+    _MUTABLE_GLOBAL = 1.0
+    fp_before = dispatch._fn_fingerprint(reads_global)
+    _MUTABLE_GLOBAL = 2.0
+    fp_after = dispatch._fn_fingerprint(reads_global)
+    _MUTABLE_GLOBAL = 1.0
+    assert fp_before is not None and fp_before == fp_after
+
+    # (b) the convention holds for conv2d: capture the fn it dispatches
+    # and check different strides produce different fingerprints
+    captured = []
+    real_apply = dispatch.apply
+
+    def spy(name, fn, inputs, differentiable=True):
+        if name == "conv2d":
+            captured.append(fn)
+        return real_apply(name, fn, inputs, differentiable)
+
+    x = paddle.to_tensor(np.ones((1, 3, 8, 8), np.float32))
+    w = paddle.to_tensor(np.ones((4, 3, 3, 3), np.float32))
+    try:
+        dispatch.apply = spy
+        F.conv2d(x, w, stride=1, padding=1)
+        F.conv2d(x, w, stride=2, padding=1)
+    finally:
+        dispatch.apply = real_apply
+    assert len(captured) == 2
+    fps = [dispatch._fn_fingerprint(f) for f in captured]
+    assert None not in fps, "conv2d fn must stay fingerprintable"
+    assert fps[0] != fps[1], \
+        "conv2d stride must enter the fingerprint via its closure"
+
+
 def test_vjp_jit_cache_fallback_on_array_closure():
     """Ops capturing arrays in their closure are not fingerprintable and
     must fall back to the per-node trace (still-correct grads)."""

@@ -1,0 +1,472 @@
+"""Channels-last layout propagation (ISSUE 4): parity of the
+NHWC-propagated interior vs the per-op NCHW path, tag bookkeeping,
+NHWC/ceil_mode pooling, the space-to-depth stem, and the HLO
+transpose-count contract."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core import layout
+
+
+RNG = np.random.RandomState(11)
+
+
+@pytest.fixture
+def autotune_off(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "0")
+
+
+def _conv_chain(x_np, w_np, g_np, b_np):
+    """conv -> bn(train) -> relu -> maxpool -> adaptive_avg_pool, with
+    grads to every input; returns (out, grads, running_mean)."""
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    w = paddle.to_tensor(w_np, stop_gradient=False)
+    g = paddle.to_tensor(g_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    rm = paddle.to_tensor(np.zeros(w_np.shape[0], np.float32))
+    rv = paddle.to_tensor(np.ones(w_np.shape[0], np.float32))
+    y = F.conv2d(x, w, stride=1, padding=1)
+    y = F.batch_norm(y, rm, rv, g, b, training=True)
+    y = F.relu(y)
+    y = F.max_pool2d(y, 2, 2)
+    y = F.adaptive_avg_pool2d(y, (1, 1))
+    paddle.sum(y * y).backward()
+    return (y.numpy(), [t.grad.numpy() for t in (x, w, g, b)],
+            rm.numpy())
+
+
+def test_propagated_chain_matches_nchw(monkeypatch):
+    x_np = RNG.randn(2, 3, 16, 16).astype(np.float32)
+    w_np = (RNG.randn(8, 3, 3, 3) * 0.2).astype(np.float32)
+    g_np = RNG.rand(8).astype(np.float32) + 0.5
+    b_np = RNG.randn(8).astype(np.float32)
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    out_on, grads_on, rm_on = _conv_chain(x_np, w_np, g_np, b_np)
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "0")
+    out_off, grads_off, rm_off = _conv_chain(x_np, w_np, g_np, b_np)
+    np.testing.assert_allclose(out_on, out_off, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(rm_on, rm_off, rtol=1e-5, atol=1e-7)
+    for a, b in zip(grads_on, grads_off):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_tag_bookkeeping_logical_facade():
+    x = paddle.to_tensor(RNG.randn(2, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(RNG.randn(4, 3, 1, 1).astype(np.float32))
+    y = F.conv2d(x, w)
+    assert y._layout == layout.NHWC
+    assert y.shape == [2, 4, 8, 8]          # logical NCHW facade
+    assert tuple(y._data.shape) == (2, 8, 8, 4)
+    assert y.numpy().shape == (2, 4, 8, 8)
+    d = y.detach()
+    assert d._layout == layout.NHWC
+    # a layout-oblivious op sees the logical value via materialization
+    flat = paddle.flatten(y, 1)
+    assert flat._layout is None
+    np.testing.assert_allclose(flat.numpy(),
+                               y.numpy().reshape(2, -1), rtol=1e-6)
+
+
+def test_transparent_ops_keep_tag_and_values():
+    x = paddle.to_tensor(RNG.randn(2, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(RNG.randn(4, 3, 1, 1).astype(np.float32))
+    y = F.conv2d(x, w)
+    ref = y.numpy()
+    z = F.relu(y * 2.0 + 0.5)
+    assert z._layout == layout.NHWC
+    np.testing.assert_allclose(z.numpy(), np.maximum(ref * 2 + 0.5, 0),
+                               rtol=1e-6)
+    # two tagged operands broadcast consistently (SE-block pattern)
+    s = F.adaptive_avg_pool2d(y, (1, 1))
+    assert s._layout == layout.NHWC
+    prod = y * s
+    assert prod._layout == layout.NHWC
+    np.testing.assert_allclose(prod.numpy(), ref * s.numpy(), rtol=1e-5)
+    # an untagged multi-element operand forces materialization but
+    # yields logical-broadcast semantics
+    vec = paddle.to_tensor(np.arange(8, dtype=np.float32))  # W axis
+    mixed = y + vec
+    assert mixed._layout is None
+    np.testing.assert_allclose(mixed.numpy(), ref + np.arange(8.0,
+                               dtype=np.float32), rtol=1e-6)
+
+
+def test_autotune_off_produces_no_tags(autotune_off):
+    x = paddle.to_tensor(RNG.randn(2, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(RNG.randn(4, 3, 3, 3).astype(np.float32))
+    y = F.conv2d(x, w, padding=1)
+    assert y._layout is None
+    p = F.max_pool2d(y, 2, 2)
+    assert p._layout is None
+
+
+def test_interpolate_and_pad_propagate(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    x = paddle.to_tensor(RNG.randn(2, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(RNG.randn(4, 3, 1, 1).astype(np.float32))
+    y = F.conv2d(x, w)
+    ref = y.numpy()
+    up = F.interpolate(y, scale_factor=2, mode="nearest")
+    assert up._layout == layout.NHWC
+    np.testing.assert_allclose(up.numpy(),
+                               ref.repeat(2, axis=2).repeat(2, axis=3),
+                               rtol=1e-6)
+    pd = F.pad(y, [1, 2, 3, 4])          # (left,right,top,bottom) on W,H
+    assert pd._layout == layout.NHWC
+    ref_pad = np.pad(ref, ((0, 0), (0, 0), (3, 4), (1, 2)))
+    np.testing.assert_allclose(pd.numpy(), ref_pad, rtol=1e-6)
+
+
+# ---------------------------------------------------------------- pooling
+
+
+def _np_maxpool(x, k, s, p, ceil=False):
+    n, c, h, w = x.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (p, p), (p, p)),
+                constant_values=-np.inf)
+    span_h, span_w = h + 2 * p, w + 2 * p
+    if ceil:
+        oh = -(-(span_h - k) // s) + 1
+        ow = -(-(span_w - k) // s) + 1
+        eh = (oh - 1) * s + k - span_h
+        ew = (ow - 1) * s + k - span_w
+        if eh > 0 or ew > 0:
+            xp = np.pad(xp, ((0, 0), (0, 0), (0, max(eh, 0)),
+                             (0, max(ew, 0))), constant_values=-np.inf)
+    else:
+        oh = (span_h - k) // s + 1
+        ow = (span_w - k) // s + 1
+    out = np.full((n, c, oh, ow), -np.inf, x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = xp[:, :, i * s:i * s + k,
+                                 j * s:j * s + k].max(axis=(2, 3))
+    return out
+
+
+def _np_avgpool(x, k, s, p, ceil=False, exclusive=True):
+    n, c, h, w = x.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (p, p), (p, p)))
+    cnt = np.pad(np.ones_like(x), ((0, 0), (0, 0), (p, p), (p, p)))
+    span_h, span_w = h + 2 * p, w + 2 * p
+    if ceil:
+        oh = -(-(span_h - k) // s) + 1
+        ow = -(-(span_w - k) // s) + 1
+        eh = max((oh - 1) * s + k - span_h, 0)
+        ew = max((ow - 1) * s + k - span_w, 0)
+        xp = np.pad(xp, ((0, 0), (0, 0), (0, eh), (0, ew)))
+        cnt = np.pad(cnt, ((0, 0), (0, 0), (0, eh), (0, ew)))
+    else:
+        oh = (span_h - k) // s + 1
+        ow = (span_w - k) // s + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * s:i * s + k, j * s:j * s + k]
+            if exclusive and (p > 0 or ceil):
+                d = cnt[:, :, i * s:i * s + k,
+                        j * s:j * s + k].sum(axis=(2, 3))
+            else:
+                d = float(k * k)
+            out[:, :, i, j] = win.sum(axis=(2, 3)) / d
+    return out
+
+
+@pytest.mark.parametrize("k,s,p", [(3, 2, 1), (2, 2, 0), (3, 3, 1)])
+def test_max_pool2d_ceil_mode(k, s, p):
+    x = RNG.randn(2, 4, 7, 9).astype(np.float32)
+    out = F.max_pool2d(paddle.to_tensor(x), k, s, p, ceil_mode=True)
+    np.testing.assert_allclose(out.numpy(),
+                               _np_maxpool(x, k, s, p, ceil=True),
+                               rtol=1e-6)
+    out_f = F.max_pool2d(paddle.to_tensor(x), k, s, p, ceil_mode=False)
+    np.testing.assert_allclose(out_f.numpy(),
+                               _np_maxpool(x, k, s, p), rtol=1e-6)
+
+
+@pytest.mark.parametrize("exclusive", [True, False])
+def test_avg_pool2d_ceil_mode(exclusive):
+    x = RNG.randn(2, 3, 7, 7).astype(np.float32)
+    out = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1, ceil_mode=True,
+                       exclusive=exclusive)
+    np.testing.assert_allclose(
+        out.numpy(), _np_avgpool(x, 3, 2, 1, ceil=True,
+                                 exclusive=exclusive), rtol=1e-5)
+
+
+def test_pool_nhwc_matches_nchw():
+    x = RNG.randn(2, 5, 10, 12).astype(np.float32)
+    x_nhwc = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    for fn, kw in ((F.max_pool2d, {}), (F.avg_pool2d, {}),
+                   (F.max_pool2d, {"ceil_mode": True}),
+                   (F.avg_pool2d, {"ceil_mode": True})):
+        ref = fn(paddle.to_tensor(x), 3, 2, 1, **kw).numpy()
+        got = fn(paddle.to_tensor(x_nhwc), 3, 2, 1,
+                 data_format="NHWC", **kw).numpy()
+        np.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-5, err_msg=str((fn, kw)))
+
+
+def test_max_pool2d_mask_nhwc_and_ceil():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    ref_out, ref_mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                     return_mask=True)
+    x_nhwc = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    out, mask = F.max_pool2d(paddle.to_tensor(x_nhwc), 2, 2,
+                             return_mask=True, data_format="NHWC")
+    np.testing.assert_allclose(out.numpy().transpose(0, 3, 1, 2),
+                               ref_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy().transpose(0, 3, 1, 2),
+                                  ref_mask.numpy())
+    # ceil_mode mask: partial windows appear, indices stay in-plane
+    xo = RNG.randn(1, 2, 7, 7).astype(np.float32)
+    out_c, mask_c = F.max_pool2d(paddle.to_tensor(xo), 3, 2,
+                                 return_mask=True, ceil_mode=True)
+    np.testing.assert_allclose(out_c.numpy(),
+                               _np_maxpool(xo, 3, 2, 0, ceil=True),
+                               rtol=1e-6)
+    assert mask_c.numpy().min() >= 0 and mask_c.numpy().max() < 49
+
+
+@pytest.mark.parametrize("nd", [1, 3])
+def test_pool_ceil_mode_1d_3d(nd):
+    if nd == 1:
+        x = RNG.randn(2, 3, 9).astype(np.float32)
+        out = F.max_pool1d(paddle.to_tensor(x), 2, 2, 0, ceil_mode=True)
+        assert out.shape[-1] == 5           # ceil((9-2)/2)+1
+        last = x[:, :, 8:9].max(axis=-1)
+        np.testing.assert_allclose(out.numpy()[:, :, -1], last,
+                                   rtol=1e-6)
+    else:
+        x = RNG.randn(1, 2, 5, 5, 5).astype(np.float32)
+        out = F.max_pool3d(paddle.to_tensor(x), 2, 2, 0, ceil_mode=True)
+        assert list(out.shape[2:]) == [3, 3, 3]
+
+
+def test_tagged_pool_matches_untagged(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    x = paddle.to_tensor(RNG.randn(2, 3, 9, 9).astype(np.float32))
+    w = paddle.to_tensor(RNG.randn(4, 3, 1, 1).astype(np.float32))
+    y = F.conv2d(x, w)
+    assert y._layout == layout.NHWC
+    got = F.max_pool2d(y, 3, 2, 1, ceil_mode=True)
+    assert got._layout == layout.NHWC
+    np.testing.assert_allclose(
+        got.numpy(), _np_maxpool(y.numpy(), 3, 2, 1, ceil=True),
+        rtol=1e-5)
+
+
+def test_grad_api_and_inplace_on_tagged(monkeypatch):
+    """paddle.grad / explicit-cotangent backward / in-place rebind all
+    present the logical NCHW facade for tagged tensors (review fixes)."""
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    x = paddle.to_tensor(RNG.randn(2, 3, 6, 8).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(RNG.randn(4, 3, 1, 1).astype(np.float32))
+    feat = F.relu(F.conv2d(x, w))
+    assert feat._layout == layout.NHWC
+    score = paddle.sum(feat * feat)
+    # Grad-CAM pattern: grad of a non-leaf tagged tensor
+    (g,) = paddle.grad([score], [feat], retain_graph=True)
+    assert g.shape == [2, 4, 6, 8]                 # logical, not physical
+    np.testing.assert_allclose(g.numpy(), 2 * feat.numpy(), rtol=1e-5)
+    # explicit logical-NCHW cotangent into a tagged output
+    seed = RNG.randn(2, 4, 6, 8).astype(np.float32)
+    feat.backward(paddle.to_tensor(seed))
+    # d(feat)/dx contracted with seed: conv1x1 transpose = w^T seed
+    ref = np.einsum("oihw,nohw->nihw", w.numpy(),
+                    seed * (feat.numpy() > 0))
+    np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-4,
+                               atol=1e-5)
+    # in-place op on a tagged tensor with an untagged 4-D operand:
+    # dispatch materializes, the rebind must drop the stale tag
+    t = F.relu(F.conv2d(paddle.to_tensor(
+        RNG.randn(1, 3, 4, 4).astype(np.float32)), w))
+    before = t.numpy()
+    other = np.arange(64, dtype=np.float32).reshape(1, 4, 4, 4)
+    t.add_(paddle.to_tensor(other))
+    assert t._layout is None
+    np.testing.assert_allclose(t.numpy(), before + other, rtol=1e-6)
+    # .grad of a tagged trainable leaf keeps the logical facade too
+    leaf = F.conv2d(paddle.to_tensor(
+        RNG.randn(1, 3, 4, 4).astype(np.float32)), w).detach()
+    leaf.stop_gradient = False
+    paddle.sum(leaf * leaf).backward()
+    assert leaf.grad.shape == [1, 4, 4, 4]
+    np.testing.assert_allclose(leaf.grad.numpy(), 2 * leaf.numpy(),
+                               rtol=1e-5)
+
+
+def test_bool_mask_getitem_and_unpool_nhwc(monkeypatch):
+    """Review fixes: the dynamic-shape boolean-mask getitem path must
+    materialize tagged tensors; max_unpool2d round-trips NHWC masks; a
+    tagged grad seeding an untagged root is untransposed."""
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    x = paddle.to_tensor(RNG.randn(2, 3, 4, 6).astype(np.float32))
+    w = paddle.to_tensor(RNG.randn(5, 3, 1, 1).astype(np.float32))
+    y = F.conv2d(x, w)                      # tagged, H=4 != C=5
+    m = y > 0                               # materialized logical mask
+    np.testing.assert_allclose(y[m].numpy(), y.numpy()[m.numpy()],
+                               rtol=1e-6)
+    # NHWC unpool inverts NHWC pool(return_mask=True)
+    xp = RNG.randn(1, 6, 6, 2).astype(np.float32)   # physical NHWC
+    pooled, mask = F.max_pool2d(paddle.to_tensor(xp), 2, 2,
+                                return_mask=True, data_format="NHWC")
+    restored = F.max_unpool2d(pooled, mask, 2, 2,
+                              data_format="NHWC").numpy()
+    assert restored.shape == (1, 6, 6, 2)
+    np.testing.assert_allclose(np.sort(restored[restored != 0]),
+                               np.sort(pooled.numpy().reshape(-1)),
+                               rtol=1e-6)
+    # tagged cotangent into an untagged root: physical layouts align
+    feat = F.conv2d(paddle.to_tensor(
+        RNG.randn(2, 3, 4, 6).astype(np.float32), stop_gradient=False),
+        w)
+    (g,) = paddle.grad([paddle.sum(feat * feat)], [feat],
+                       retain_graph=True)
+    assert g._layout == layout.NHWC
+    logical = paddle.flatten(feat, 0, 0)    # materialized copy, untagged
+    assert logical._layout is None
+    root = logical * 1.0
+    root.backward(g)                        # must untranspose g
+    # d(root)/d(logical) = 1 -> upstream grad equals g logically; check
+    # via the chain into feat's producer input shape (no crash + finite)
+    assert np.isfinite(g.numpy()).all()
+
+
+# ------------------------------------------------------------- s2d stem
+
+
+def test_s2d_stem_parity(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LAYOUT_AUTOTUNE", "1")
+    xn = RNG.randn(2, 3, 32, 32).astype(np.float32)
+    wn = (RNG.randn(16, 3, 7, 7) * 0.05).astype(np.float32)
+    bn = RNG.randn(16).astype(np.float32)
+
+    def run():
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        w = paddle.to_tensor(wn, stop_gradient=False)
+        b = paddle.to_tensor(bn, stop_gradient=False)
+        out = F.conv2d(x, w, b, stride=2, padding=3)
+        paddle.sum(out * out).backward()
+        return out.numpy(), x.grad.numpy(), w.grad.numpy(), \
+            b.grad.numpy()
+
+    ref = run()
+    monkeypatch.setenv("PADDLE_TPU_S2D_STEM", "1")
+    got = run()
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+    # odd spatial dims fall back to the plain conv
+    x_odd = paddle.to_tensor(RNG.randn(1, 3, 31, 31).astype(np.float32))
+    w = paddle.to_tensor(wn)
+    assert F.conv2d(x_odd, w, stride=2, padding=3).shape == \
+        [1, 16, 16, 16]
+
+
+# --------------------------------------------------- compiled-step parity
+
+
+class _TinyCNN(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = paddle.nn.Conv2D(3, 8, 3, padding=1,
+                                      bias_attr=False)
+        self.bn1 = paddle.nn.BatchNorm2D(8)
+        self.relu = paddle.nn.ReLU()
+        self.pool = paddle.nn.MaxPool2D(2, 2)
+        self.conv2 = paddle.nn.Conv2D(8, 8, 3, padding=1,
+                                      bias_attr=False)
+        self.bn2 = paddle.nn.BatchNorm2D(8)
+        self.avg = paddle.nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = paddle.nn.Linear(8, 10)
+
+    def forward(self, x):
+        y = self.pool(self.relu(self.bn1(self.conv1(x))))
+        y = self.relu(self.bn2(self.conv2(y)) + y)   # residual add
+        y = self.avg(y)
+        from paddle_tpu.ops.manipulation import flatten
+        return self.fc(flatten(y, 1))
+
+
+def _compiled_step_losses(mode):
+    os.environ["PADDLE_TPU_LAYOUT_AUTOTUNE"] = mode
+    try:
+        paddle.seed(7)
+        net = _TinyCNN()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Momentum(0.01,
+                                        parameters=model.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.rand(4, 3, 16, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 10, (4, 1)).astype(np.int32))
+        out = []
+        for _ in range(2):
+            losses, _ = model._train_batch_inner([x], [y])
+            out.append(float(losses[0].numpy().reshape(-1)[0]))
+        assert model._jit_ok, "compiled path fell back to eager"
+        return out
+    finally:
+        os.environ.pop("PADDLE_TPU_LAYOUT_AUTOTUNE", None)
+
+
+def test_compiled_train_step_parity():
+    on = _compiled_step_losses("1")
+    off = _compiled_step_losses("0")
+    np.testing.assert_allclose(on, off, rtol=5e-4)
+
+
+# --------------------------------------------------------- HLO contract
+
+
+def test_emitted_transpose_contract():
+    """Fast in-tier contract: a jitted conv->bn->relu->pool->conv chain
+    emits at most 2 layout transposes per direction (the full-ResNet
+    optimized-HLO check is the slow test below)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.layout_smoke import count_emitted_transposes
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.core import autograd
+
+    os.environ["PADDLE_TPU_LAYOUT_AUTOTUNE"] = "1"
+    try:
+        wn = jnp.asarray(RNG.randn(8, 3, 3, 3), jnp.float32)
+        w2n = jnp.asarray(RNG.randn(8, 8, 3, 3), jnp.float32)
+
+        def fwd(xa):
+            with autograd.no_grad():
+                y = F.conv2d(Tensor(xa), Tensor(wn), padding=1)
+                y = F.relu(y)
+                y = F.max_pool2d(y, 2, 2)
+                y = F.conv2d(y, Tensor(w2n), padding=1)
+                return jnp.sum(F.adaptive_avg_pool2d(y, (1, 1))._data)
+
+        def step(xa):
+            return jax.value_and_grad(fwd)(xa)
+
+        xa = jnp.asarray(RNG.rand(2, 3, 16, 16), jnp.float32)
+        n = count_emitted_transposes(jax.jit(step).lower(xa).as_text())
+        assert n <= 4, f"interior transposes leaked: {n}"
+    finally:
+        os.environ.pop("PADDLE_TPU_LAYOUT_AUTOTUNE", None)
+
+
+@pytest.mark.slow
+def test_layout_smoke_contract():
+    """Full ResNet-18 optimized-HLO contract (tools/layout_smoke.py)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools import layout_smoke
+    n_on, e_on = layout_smoke.run("1")
+    assert n_on <= layout_smoke.MAX_TAGGED_TRANSPOSES
+    assert e_on <= 4

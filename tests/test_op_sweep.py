@@ -115,3 +115,377 @@ def test_reduce_sweep(name, pfn, nfn):
     np.testing.assert_allclose(
         pfn(paddle.to_tensor(x), axis=[0, 2], keepdim=True).numpy(),
         nfn(x, axis=(0, 2), keepdims=True), rtol=1e-4)
+
+
+# ===================================================================
+# Kernel-FAMILY sweep (ISSUE 4 satellite; VERDICT r5: only ~30 of the
+# 293 manifest families were swept). One numpy-oracle check per PHI
+# kernel family from tools/kernel_coverage.py's manifest, prioritizing
+# the layout-sensitive conv/norm/pool/interpolate families. Family
+# names match the PARITY_KERNELS.md table; test_family_sweep_manifest
+# gates the total swept-family count.
+# ===================================================================
+
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+# families exercised by the original unary/binary/reduce sweeps above
+BASE_FAMILIES = {
+    "activation", "abs", "compare", "cum", "elementwise", "arg_min_max",
+    "atan2", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod",
+}
+
+
+def _np_conv2d(x, w, stride=1, pad=0, groups=1):
+    n, cin, h, wd = x.shape
+    co, cig, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, co, oh, ow), np.float32)
+    cpg = co // groups
+    for f in range(co):
+        g = f // cpg
+        src = xp[:, g * cig:(g + 1) * cig]
+        for i in range(oh):
+            for j in range(ow):
+                win = src[:, :, i * stride:i * stride + kh,
+                          j * stride:j * stride + kw]
+                out[:, f, i, j] = (win * w[f]).sum(axis=(1, 2, 3))
+    return out
+
+
+def _family_conv():
+    x = RNG.randn(2, 4, 8, 8).astype(np.float32)
+    w = (RNG.randn(6, 4, 3, 3) * 0.3).astype(np.float32)
+    t, tw = paddle.to_tensor(x, stop_gradient=False), \
+        paddle.to_tensor(w, stop_gradient=False)
+    out = F.conv2d(t, tw, stride=2, padding=1)
+    np.testing.assert_allclose(out.numpy(), _np_conv2d(x, w, 2, 1),
+                               rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    assert t.grad.shape == list(x.shape) and tw.grad.shape == list(w.shape)
+
+
+def _family_depthwise_conv():
+    x = RNG.randn(2, 4, 6, 6).astype(np.float32)
+    w = (RNG.randn(4, 1, 3, 3) * 0.3).astype(np.float32)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   padding=1, groups=4)
+    np.testing.assert_allclose(out.numpy(),
+                               _np_conv2d(x, w, 1, 1, groups=4),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _family_conv_transpose():
+    x = RNG.randn(1, 3, 5, 5).astype(np.float32)
+    w = (RNG.randn(3, 4, 3, 3) * 0.3).astype(np.float32)  # [in,out,k,k]
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2).numpy()
+    # oracle: scatter-accumulate x into the upsampled grid
+    ref = np.zeros((1, 4, 11, 11), np.float32)
+    for i in range(5):
+        for j in range(5):
+            for f in range(4):
+                ref[0, f, 2 * i:2 * i + 3, 2 * j:2 * j + 3] += (
+                    x[0, :, i, j][:, None, None] * w[:, f]).sum(axis=0)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _family_batch_norm():
+    x = RNG.randn(4, 3, 5, 5).astype(np.float32)
+    g = RNG.rand(3).astype(np.float32) + 0.5
+    b = RNG.randn(3).astype(np.float32)
+    rm = np.zeros(3, np.float32)
+    rv = np.ones(3, np.float32)
+    out = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(rm),
+                       paddle.to_tensor(rv), paddle.to_tensor(g),
+                       paddle.to_tensor(b), training=True).numpy()
+    mu = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * g.reshape(1, 3, 1, 1) + \
+        b.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _family_layer_norm():
+    x = RNG.randn(4, 6).astype(np.float32)
+    g = RNG.rand(6).astype(np.float32)
+    out = F.layer_norm(paddle.to_tensor(x), 6,
+                       paddle.to_tensor(g)).numpy()
+    mu = x.mean(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * g
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _family_group_norm():
+    x = RNG.randn(2, 4, 3, 3).astype(np.float32)
+    out = F.group_norm(paddle.to_tensor(x), 2).numpy()
+    xr = x.reshape(2, 2, 2, 3, 3)
+    mu = xr.mean(axis=(2, 3, 4), keepdims=True)
+    var = xr.var(axis=(2, 3, 4), keepdims=True)
+    ref = ((xr - mu) / np.sqrt(var + 1e-5)).reshape(x.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _family_instance_norm():
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    out = F.instance_norm(paddle.to_tensor(x)).numpy()
+    mu = x.mean(axis=(2, 3), keepdims=True)
+    ref = (x - mu) / np.sqrt(x.var(axis=(2, 3), keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _family_pool():
+    x = RNG.randn(2, 3, 6, 6).astype(np.float32)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    out_a = F.avg_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(
+        out_a, x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5)), rtol=1e-5)
+
+
+def _family_unpool():
+    x = RNG.randn(1, 2, 6, 6).astype(np.float32)
+    pooled, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                return_mask=True)
+    restored = F.max_unpool2d(pooled, mask, 2, 2).numpy()
+    # every pooled max lands back at its argmax position
+    np.testing.assert_allclose(np.sort(restored[restored != 0]),
+                               np.sort(pooled.numpy().reshape(-1)),
+                               rtol=1e-6)
+
+
+def _family_interpolate():
+    x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+    out = F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                        mode="nearest").numpy()
+    np.testing.assert_allclose(out,
+                               x.repeat(2, axis=2).repeat(2, axis=3),
+                               rtol=1e-6)
+    # bilinear keeps a constant field constant
+    c = np.full((1, 1, 3, 3), 2.5, np.float32)
+    outb = F.interpolate(paddle.to_tensor(c), size=[6, 6],
+                         mode="bilinear").numpy()
+    np.testing.assert_allclose(outb, np.full((1, 1, 6, 6), 2.5),
+                               rtol=1e-5)
+
+
+def _family_pad():
+    x = RNG.randn(1, 2, 3, 3).astype(np.float32)
+    out = F.pad(paddle.to_tensor(x), [1, 2, 0, 1], value=7.0).numpy()
+    ref = np.pad(x, ((0, 0), (0, 0), (0, 1), (1, 2)),
+                 constant_values=7.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def _family_pixel_shuffle():
+    x = RNG.randn(1, 8, 2, 2).astype(np.float32)
+    out = F.pixel_shuffle(paddle.to_tensor(x), 2).numpy()
+    ref = x.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, 2, 4, 4)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def _family_pixel_unshuffle():
+    x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+    down = F.pixel_unshuffle(paddle.to_tensor(x), 2)
+    back = F.pixel_shuffle(down, 2).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def _family_unfold_fold():
+    x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+    col = F.unfold(paddle.to_tensor(x), 2, strides=2)
+    assert col.shape == [1, 8, 4]
+    back = F.fold(col, [4, 4], 2, strides=2).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def _family_softmax():
+    x = RNG.randn(3, 5).astype(np.float32)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(F.softmax(paddle.to_tensor(x)).numpy(),
+                               e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+
+def _family_log_softmax():
+    x = RNG.randn(3, 5).astype(np.float32)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    ref = np.log(e / e.sum(-1, keepdims=True))
+    np.testing.assert_allclose(
+        F.log_softmax(paddle.to_tensor(x)).numpy(), ref, rtol=1e-4,
+        atol=1e-5)
+
+
+def _family_cross_entropy():
+    logits = RNG.randn(4, 5).astype(np.float32)
+    lab = RNG.randint(0, 5, (4, 1)).astype(np.int64)
+    out = float(F.cross_entropy(paddle.to_tensor(logits),
+                                paddle.to_tensor(lab)).numpy())
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), lab.reshape(-1)]).mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def _family_embedding():
+    w = RNG.randn(10, 4).astype(np.float32)
+    idx = RNG.randint(0, 10, (3, 2)).astype(np.int64)
+    out = F.embedding(paddle.to_tensor(idx), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out, w[idx], rtol=1e-6)
+
+
+def _family_one_hot():
+    idx = np.array([0, 2, 1], np.int64)
+    out = F.one_hot(paddle.to_tensor(idx), 4).numpy()
+    np.testing.assert_allclose(out, np.eye(4, dtype=np.float32)[idx])
+
+
+def _family_top_k():
+    x = RNG.randn(3, 6).astype(np.float32)
+    vals, idx = paddle.topk(paddle.to_tensor(x), 2)
+    ref_idx = np.argsort(-x, axis=-1)[:, :2]
+    np.testing.assert_array_equal(idx.numpy(), ref_idx)
+    np.testing.assert_allclose(vals.numpy(),
+                               np.take_along_axis(x, ref_idx, -1),
+                               rtol=1e-6)
+
+
+def _family_gather():
+    x = RNG.randn(5, 3).astype(np.float32)
+    idx = np.array([3, 0, 4], np.int64)
+    out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[idx], rtol=1e-6)
+
+
+def _family_gather_nd():
+    x = RNG.randn(3, 4).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]], rtol=1e-6)
+
+
+def _family_scatter():
+    x = np.zeros((4, 2), np.float32)
+    idx = np.array([1, 3], np.int64)
+    upd = RNG.randn(2, 2).astype(np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(upd)).numpy()
+    ref = x.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def _family_where():
+    c = np.array([[True, False], [False, True]])
+    a = np.ones((2, 2), np.float32)
+    b = np.zeros((2, 2), np.float32)
+    out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a),
+                       paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(out, np.where(c, a, b))
+
+
+def _family_concat_split_stack():
+    x = RNG.randn(2, 3).astype(np.float32)
+    y = RNG.randn(2, 3).astype(np.float32)
+    cat = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], 0)
+    np.testing.assert_allclose(cat.numpy(), np.concatenate([x, y], 0))
+    a, b = paddle.split(cat, 2, axis=0)
+    np.testing.assert_allclose(a.numpy(), x)
+    st = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], 0)
+    np.testing.assert_allclose(st.numpy(), np.stack([x, y], 0))
+
+
+def _family_tile_expand():
+    x = RNG.randn(1, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.tile(paddle.to_tensor(x), [2, 2]).numpy(),
+        np.tile(x, (2, 2)))
+    np.testing.assert_allclose(
+        paddle.expand(paddle.to_tensor(x), [4, 3]).numpy(),
+        np.broadcast_to(x, (4, 3)))
+
+
+def _family_transpose_flip_roll():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.transpose(paddle.to_tensor(x), [2, 0, 1]).numpy(),
+        x.transpose(2, 0, 1))
+    np.testing.assert_allclose(
+        paddle.flip(paddle.to_tensor(x), [1]).numpy(), x[:, ::-1])
+    np.testing.assert_allclose(
+        paddle.roll(paddle.to_tensor(x), 1, 0).numpy(),
+        np.roll(x, 1, 0))
+
+
+def _family_dropout():
+    x = np.ones((64, 64), np.float32)
+    out = F.dropout(paddle.to_tensor(x), p=0.25, training=True).numpy()
+    kept = out != 0
+    assert abs(kept.mean() - 0.75) < 0.05          # keep ratio
+    np.testing.assert_allclose(out[kept], 1.0 / 0.75, rtol=1e-5)
+    np.testing.assert_allclose(
+        F.dropout(paddle.to_tensor(x), p=0.25, training=False).numpy(),
+        x)
+
+
+FAMILY_CASES = [
+    ("conv", _family_conv),
+    ("depthwise_conv", _family_depthwise_conv),
+    ("conv_transpose", _family_conv_transpose),
+    ("batch_norm", _family_batch_norm),
+    ("layer_norm", _family_layer_norm),
+    ("group_norm", _family_group_norm),
+    ("instance_norm", _family_instance_norm),
+    ("pool", _family_pool),
+    ("unpool", _family_unpool),
+    ("interpolate", _family_interpolate),
+    ("pad", _family_pad),
+    ("pixel_shuffle", _family_pixel_shuffle),
+    ("pixel_unshuffle", _family_pixel_unshuffle),
+    ("unfold", _family_unfold_fold),
+    ("fold", _family_unfold_fold),
+    ("softmax", _family_softmax),
+    ("log_softmax", _family_log_softmax),
+    ("cross_entropy", _family_cross_entropy),
+    ("embedding", _family_embedding),
+    ("one_hot", _family_one_hot),
+    ("top_k", _family_top_k),
+    ("gather", _family_gather),
+    ("gather_nd", _family_gather_nd),
+    ("scatter", _family_scatter),
+    ("where", _family_where),
+    ("concat", _family_concat_split_stack),
+    ("split", _family_concat_split_stack),
+    ("stack", _family_concat_split_stack),
+    ("tile", _family_tile_expand),
+    ("expand", _family_tile_expand),
+    ("transpose", _family_transpose_flip_roll),
+    ("flip", _family_transpose_flip_roll),
+    ("roll", _family_transpose_flip_roll),
+    ("dropout", _family_dropout),
+]
+
+
+@pytest.mark.parametrize("family,case", FAMILY_CASES,
+                         ids=[c[0] for c in FAMILY_CASES])
+def test_family_sweep(family, case):
+    case()
+
+
+def test_family_sweep_manifest():
+    """The sweep must cover >= 45 distinct manifest families (ISSUE 4
+    acceptance; VERDICT r5 counted ~30) and every family name must be a
+    real row of the PARITY_KERNELS.md manifest table."""
+    import os
+    md = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PARITY_KERNELS.md")
+    with open(md) as f:
+        manifest = {line.split("|")[1].strip() for line in f
+                    if line.startswith("| ")}
+    swept = BASE_FAMILIES | {name for name, _ in FAMILY_CASES}
+    unknown = {s for s in swept if s not in manifest}
+    assert not unknown, f"not manifest families: {sorted(unknown)}"
+    assert len(swept) >= 45, f"only {len(swept)} families swept"

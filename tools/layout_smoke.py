@@ -1,0 +1,140 @@
+"""Layout-propagation contract smoke (ISSUE 4 CI check).
+
+Lowers a jitted ResNet train step (fwd + bwd, the same trace path
+`jit/trainer.py` compiles) to OPTIMIZED HLO and counts layout
+transposes on the image-tensor paths: rank-4 transpose instructions
+whose leading dim is the batch size (weight transposes like OIHW->HWIO
+have no batch-leading dim and are excluded).
+
+Contract (PADDLE_TPU_LAYOUT_AUTOTUNE=1, the default): at most 2 layout
+transposes per image-tensor path — one at the input edge (inside the
+first conv) and one at the pool->flatten boundary — i.e. <= 2 in the
+forward direction and <= 2 transposed counterparts in the backward,
+<= MAX_TAGGED_TRANSPOSES total. The NCHW per-op mode (=0) is reported
+alongside for comparison but not gated.
+
+Run: JAX_PLATFORMS=cpu python tools/layout_smoke.py
+(also wired into tests/test_layout.py::test_layout_smoke_contract)
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MAX_TAGGED_TRANSPOSES = 4   # 2 per direction (fwd edge-in + edge-out)
+BATCH = 2
+HW = 32
+
+_TRANSPOSE_RE = re.compile(
+    r"= [a-z0-9]+\[([0-9,]+)\]\S* transpose\([^)]*\), "
+    r"dimensions=\{([0-9,]+)\}")
+
+# the two layout permutations this pass is about; anything else (e.g.
+# the CPU conv emitter's internal spatial shuffles) is not a layout
+# ping-pong and not gated
+_LAYOUT_PERMS = {(0, 2, 3, 1), (0, 3, 1, 2)}
+
+
+def count_image_transposes(hlo_text: str, batch: int) -> int:
+    n = 0
+    for m in _TRANSPOSE_RE.finditer(hlo_text):
+        shape = [int(d) for d in m.group(1).split(",") if d]
+        perm = tuple(int(d) for d in m.group(2).split(",") if d)
+        if len(shape) == 4 and shape[0] == batch and \
+                perm in _LAYOUT_PERMS:
+            n += 1
+    return n
+
+
+def lower_train_step():
+    """Optimized-HLO text of one ResNet-18 fwd+bwd step, traced exactly
+    the way CompiledTrainStep traces it (bind_arrays + no_grad +
+    jax.value_and_grad over the dispatch funnel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd
+    from paddle_tpu.core import random as rng_mod
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.functional import bind_arrays, split_state
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    net = resnet18(num_classes=10)
+    net.train()
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    p_names, p_tensors, b_names, b_tensors = split_state(net)
+    key = rng_mod.next_key()
+
+    def loss_of(plist, blist, xa, ya):
+        with bind_arrays(p_tensors, plist), \
+                bind_arrays(b_tensors, blist), \
+                rng_mod.functional_rng(key), autograd.no_grad():
+            out = net(Tensor(xa))
+            loss = loss_fn(out, Tensor(ya))
+        return loss._data.astype(jnp.float32)
+
+    def step(plist, blist, xa, ya):
+        loss, grads = jax.value_and_grad(loss_of)(plist, blist, xa, ya)
+        return loss, grads
+
+    rng = np.random.RandomState(0)
+    xa = jnp.asarray(rng.rand(BATCH, 3, HW, HW), jnp.float32)
+    ya = jnp.asarray(rng.randint(0, 10, (BATCH, 1)), jnp.int32)
+    plist = [p._data for p in p_tensors]
+    blist = [b._data for b in b_tensors]
+    lowered = jax.jit(step).lower(plist, blist, xa, ya)
+    return lowered.compile().as_text(), lowered.as_text()
+
+
+_STABLEHLO_RE = re.compile(
+    r"stablehlo\.transpose[^\n]*dims = \[([0-9, ]+)\]")
+
+
+def count_emitted_transposes(stablehlo_text: str) -> int:
+    """Layout transposes the FRAMEWORK emitted (pre-XLA-cleanup
+    StableHLO) — what the propagation pass itself removes, independent
+    of how well a given backend's compiler cancels leftovers."""
+    n = 0
+    for m in _STABLEHLO_RE.finditer(stablehlo_text):
+        perm = tuple(int(d) for d in m.group(1).replace(" ", "")
+                     .split(",") if d)
+        if perm in _LAYOUT_PERMS:
+            n += 1
+    return n
+
+
+def run(mode: str):
+    os.environ["PADDLE_TPU_LAYOUT_AUTOTUNE"] = mode
+    try:
+        hlo, stablehlo = lower_train_step()
+        return (count_image_transposes(hlo, BATCH),
+                count_emitted_transposes(stablehlo))
+    finally:
+        os.environ.pop("PADDLE_TPU_LAYOUT_AUTOTUNE", None)
+
+
+def main():
+    n_on, e_on = run("1")
+    print(f"layout_smoke: autotune=1 optimized-HLO image transposes = "
+          f"{n_on} (contract: <= {MAX_TAGGED_TRANSPOSES}), "
+          f"framework-emitted = {e_on}")
+    n_off, e_off = run("0")
+    print(f"layout_smoke: autotune=0 optimized-HLO image transposes = "
+          f"{n_off}, framework-emitted = {e_off}")
+    if n_on > MAX_TAGGED_TRANSPOSES:
+        print("layout_smoke: FAIL — propagated mode leaks interior "
+              "transposes")
+        return 1
+    print("layout_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
