@@ -26,26 +26,32 @@ class Config:
         self._threads = 1
         self._ir_optim = True
         self._serving = None
+        self._max_pending = None
 
     # -- continuous batching (paddle_tpu.serving) -------------------------
     def enable_continuous_batching(self, max_slots=None, block_size=None,
                                    num_blocks=None, max_seq_len=None,
                                    token_budget=None, eos_token_id=None,
                                    cache_dtype=None, draft_k=None,
-                                   draft_ngram=None):
+                                   draft_ngram=None, prefix_caching=None,
+                                   max_pending=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
         `draft_k > 0` turns on speculative multi-token decoding (greedy
         only): an n-gram prompt-lookup draft proposes up to `draft_k`
-        tokens per decode and one verify pass scores them all — see the
-        speculative section of docs/SERVING.md."""
+        tokens per decode and one verify pass scores them all.
+        `prefix_caching=True` enables the radix-tree prefix KV cache
+        (cross-request reuse of shared prompt heads). `max_pending`
+        bounds the async frontend's admission queue
+        (`create_serving_frontend`) — see docs/SERVING.md."""
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
             token_budget=token_budget, eos_token_id=eos_token_id,
             cache_dtype=cache_dtype, draft_k=draft_k,
-            draft_ngram=draft_ngram)
+            draft_ngram=draft_ngram, prefix_caching=prefix_caching)
+        self._max_pending = max_pending
         return self
 
     def continuous_batching_enabled(self):
@@ -147,3 +153,20 @@ def create_serving_engine(config: Config, model, sampling=None, seed=0):
     kw = {k: v for k, v in config.serving_config().items()
           if v is not None}
     return ServingEngine(model, sampling=sampling, seed=seed, **kw)
+
+
+def create_serving_frontend(config: Config, model, sampling=None,
+                            seed=0):
+    """Build the asyncio multi-tenant ingress over a fresh serving
+    engine: `await frontend.start()` (or `async with frontend:`) spawns
+    the background step-loop task; `submit()`/`stream()` are the
+    per-request API (bounded admission, per-tenant fairness, deadlines,
+    cancellation — docs/SERVING.md). `max_pending` from
+    `enable_continuous_batching` bounds the admission queue."""
+    engine = create_serving_engine(config, model, sampling=sampling,
+                                   seed=seed)
+    from .serving.frontend import ServingFrontend
+    kw = {}
+    if config._max_pending is not None:
+        kw["max_pending"] = int(config._max_pending)
+    return ServingFrontend(engine, **kw)

@@ -858,12 +858,22 @@ class HybridGPT:
                                          donate_argnums=(0, 1))
 
     def init(self, key):
+        # Generate the full logical params UNSHARDED, then device_put
+        # into the mesh. Jitting the threefry generation with GSPMD
+        # out_shardings is NOT value-stable across mesh topologies on
+        # jax 0.4.x (jax_threefry_partitionable=False): the same key
+        # yielded different w_qkv/w_fc/tok_emb values on multi-axis
+        # meshes (maxdiff ~0.1), which is what broke the combined-mesh
+        # loss-parity tests — the divergence was in init, not in the
+        # training reduction order. Materializing on one device first
+        # costs a transient full-params footprint, acceptable until a
+        # partitionable-threefry jax is the floor.
+        p_specs = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        p_full = jax.jit(functools.partial(init_params, self.cfg))(key)
+        p_init = jax.device_put(p_full, p_specs)
         with self.mesh:
-            p_init = jax.jit(
-                functools.partial(init_params, self.cfg),
-                out_shardings=jax.tree.map(
-                    lambda s: NamedSharding(self.mesh, s), self.pspecs,
-                    is_leaf=lambda x: isinstance(x, P)))(key)
             o_init = jax.jit(
                 functools.partial(init_opt_state, self.cfg),
                 out_shardings=jax.tree.map(

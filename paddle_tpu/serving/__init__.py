@@ -1,36 +1,53 @@
 """paddle_tpu.serving — paged KV-cache + continuous-batching engine.
 
-The first multi-request subsystem: a block-paged KV cache with fixed
-slot tables (`kv_cache`), a FIFO/preemption scheduler (`scheduler`),
-token-budget batching + sampling heads (`batcher`), serving metrics
-(`metrics`), and the single-compile mixed-step `ServingEngine`
-(`engine`). See docs/SERVING.md for the slot protocol.
+The multi-request serving subsystem: a block-paged, refcounted KV
+cache (`kv_cache`), a radix-tree prefix cache for cross-request KV
+reuse (`prefix_cache`), a FIFO/preemption scheduler (`scheduler`),
+token-budget batching + sampling heads + the tenant-fair admission
+queue (`batcher`), serving metrics (`metrics`), the single-compile
+mixed-step `ServingEngine` (`engine`), and the asyncio multi-tenant
+ingress `ServingFrontend` (`frontend`). See docs/SERVING.md for the
+slot protocol and prefix-cache semantics.
 
-`engine` (and its model deps) load lazily so the light modules here
-can be imported from `incubate/nn/generation.py` without cycles.
+`engine`/`frontend` (and their model deps) load lazily so the light
+modules here can be imported from `incubate/nn/generation.py` without
+cycles.
 """
 from . import batcher  # noqa: F401
 from . import kv_cache  # noqa: F401
 from . import metrics  # noqa: F401
+from . import prefix_cache  # noqa: F401
 from . import scheduler  # noqa: F401
-from .batcher import SamplingConfig  # noqa: F401
+from .batcher import FairQueue, SamplingConfig  # noqa: F401
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .prefix_cache import RadixPrefixCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 
 __all__ = [
     "SamplingConfig", "BlockAllocator", "PagedKVCache", "Request",
-    "Scheduler", "ServingEngine", "batcher", "kv_cache", "metrics",
-    "scheduler", "engine",
+    "Scheduler", "ServingEngine", "ServingFrontend", "FairQueue",
+    "RadixPrefixCache", "batcher", "kv_cache", "metrics", "scheduler",
+    "prefix_cache", "engine", "frontend",
 ]
+
+_LAZY = {
+    "ServingEngine": ("engine", "ServingEngine"),
+    "engine": ("engine", None),
+    "ServingFrontend": ("frontend", "ServingFrontend"),
+    "frontend": ("frontend", None),
+}
 
 
 def __getattr__(name):
-    if name in ("ServingEngine", "engine"):
+    entry = _LAZY.get(name)
+    if entry is not None:
         import importlib
         import sys
-        mod = importlib.import_module(__name__ + ".engine")
+        modname, attr = entry
+        mod = importlib.import_module(f"{__name__}.{modname}")
         pkg = sys.modules[__name__]
-        pkg.engine = mod
-        pkg.ServingEngine = mod.ServingEngine
+        setattr(pkg, modname, mod)
+        if attr is not None:
+            setattr(pkg, attr, getattr(mod, attr))
         return getattr(pkg, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

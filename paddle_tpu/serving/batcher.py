@@ -28,6 +28,7 @@ paged KV cache) rides next to them.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 
 import numpy as np
@@ -117,6 +118,77 @@ def prefill_chunk(remaining, budget_left):
     while p * 2 <= budget_left:
         p *= 2
     return p
+
+
+class FairQueue:
+    """Bounded round-robin admission queue across tenants.
+
+    The frontend's backpressure + fairness primitive: each tenant gets
+    its own FIFO lane, `pop()` serves lanes round-robin so one chatty
+    tenant cannot starve the others, and the TOTAL size is bounded —
+    `push` refuses above `max_pending` and the async frontend turns
+    that refusal into awaiting-for-space. Pure host-side and
+    synchronous; all coordination lives in the frontend's event loop.
+    """
+
+    def __init__(self, max_pending=256):
+        self.max_pending = int(max_pending)
+        self._lanes = collections.OrderedDict()   # tenant -> deque
+        self._size = 0
+
+    def __len__(self):
+        return self._size
+
+    @property
+    def full(self):
+        return self._size >= self.max_pending
+
+    def push(self, tenant, item):
+        """False (item NOT queued) when the queue is at capacity."""
+        if self.full:
+            return False
+        lane = self._lanes.get(tenant)
+        if lane is None:
+            lane = self._lanes[tenant] = collections.deque()
+        lane.append(item)
+        self._size += 1
+        return True
+
+    def pop(self):
+        """Next item, rotating across tenants; None when empty. A
+        tenant whose lane still has items goes to the BACK of the
+        rotation after serving one, so K tenants each get ~1/K of
+        admissions regardless of lane depth."""
+        while self._lanes:
+            tenant, lane = next(iter(self._lanes.items()))
+            self._lanes.move_to_end(tenant)
+            if not lane:
+                del self._lanes[tenant]
+                continue
+            item = lane.popleft()
+            self._size -= 1
+            if not lane:
+                del self._lanes[tenant]
+            return item
+        return None
+
+    def items(self):
+        """Iterate queued items across all lanes (inspection only)."""
+        for lane in self._lanes.values():
+            yield from lane
+
+    def remove(self, item):
+        """Drop a queued item (cancellation before admission)."""
+        for tenant, lane in list(self._lanes.items()):
+            try:
+                lane.remove(item)
+            except ValueError:
+                continue
+            self._size -= 1
+            if not lane:
+                del self._lanes[tenant]
+            return True
+        return False
 
 
 @dataclasses.dataclass

@@ -50,7 +50,7 @@ class ServingEngine:
                  num_blocks=None, max_seq_len=None, token_budget=None,
                  sampling=None, eos_token_id=None, cache_dtype=None,
                  seed=0, clock=time.monotonic, draft_k=0,
-                 draft_ngram=3):
+                 draft_ngram=3, prefix_caching=False):
         import functools
 
         import jax
@@ -86,13 +86,21 @@ class ServingEngine:
             L, H, Dh, num_blocks=num_blocks,
             block_size=self.block_size, max_slots=max_slots,
             max_blocks_per_slot=mbps, dtype=dtype)
+        # radix prefix cache: cross-request KV reuse for shared prompt
+        # heads (system prompts, few-shot templates, chat history) —
+        # registers itself as the kv cache's eviction backstop
+        self.prefix_cache = None
+        if prefix_caching:
+            from .prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(self.kv)
         from .draft import ngram_propose
         self.scheduler = Scheduler(
             self.kv, max_slots=max_slots,
             token_budget=self.token_budget, clock=clock,
             draft_k=self.draft_k,
             draft_fn=functools.partial(ngram_propose, k=self.draft_k,
-                                       max_ngram=int(draft_ngram)))
+                                       max_ngram=int(draft_ngram)),
+            prefix_cache=self.prefix_cache)
         self.eos_token_id = eos_token_id
         self.clock = clock
         self._rng = jax.random.PRNGKey(int(seed))
@@ -106,6 +114,7 @@ class ServingEngine:
         self._step_fn = instrumented_jit(
             self._build_step(), STEP_FN_NAME, donate_argnums=(1, 2))
         self._preempt_seen = 0
+        self._prefix_seen = (0, 0, 0)    # hit / miss / evicted deltas
         self.steps_run = 0
 
     # ------------------------------------------------------- mixed step
@@ -203,7 +212,8 @@ class ServingEngine:
         return step
 
     # ------------------------------------------------------------ intake
-    def submit(self, prompt_ids, max_new_tokens=32, deadline=None):
+    def submit(self, prompt_ids, max_new_tokens=32, deadline=None,
+               tenant="default"):
         """Queue one request. Returns the scheduler's Request handle
         (read `.output` / `.state` as the engine advances)."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -217,10 +227,18 @@ class ServingEngine:
                 f"({maxpos})")
         req = self.scheduler.submit(prompt, max_new_tokens,
                                     eos_token_id=self.eos_token_id,
-                                    deadline=deadline)
+                                    deadline=deadline, tenant=tenant)
         if _pmetrics._enabled:
             smetrics.SERVING_QUEUE_DEPTH.set(len(self.scheduler.queue))
         return req
+
+    def cancel(self, req):
+        """Abort a request (frontend cancellation). Blocks and prefix
+        locks are reclaimed immediately."""
+        ok = self.scheduler.cancel(req)
+        if ok and _pmetrics._enabled:
+            smetrics.SERVING_REQUESTS.labels("cancelled").inc()
+        return ok
 
     # -------------------------------------------------------------- run
     def step(self):
@@ -326,6 +344,20 @@ class ServingEngine:
             if new_p:
                 smetrics.SERVING_PREEMPTIONS.inc(new_p)
                 self._preempt_seen = sch.preemption_count
+            if self.prefix_cache is not None:
+                pc = self.prefix_cache
+                h0, m0, e0 = self._prefix_seen
+                if pc.hit_tokens > h0:
+                    smetrics.SERVING_PREFIX_HIT_TOKENS.inc(
+                        pc.hit_tokens - h0)
+                if pc.miss_tokens > m0:
+                    smetrics.SERVING_PREFIX_MISS_TOKENS.inc(
+                        pc.miss_tokens - m0)
+                if pc.evictions > e0:
+                    smetrics.SERVING_PREFIX_EVICTIONS.inc(
+                        pc.evictions - e0)
+                self._prefix_seen = (pc.hit_tokens, pc.miss_tokens,
+                                     pc.evictions)
         return True
 
     def run(self, max_steps=None):

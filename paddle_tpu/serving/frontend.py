@@ -1,0 +1,330 @@
+"""Async multi-tenant serving frontend over the continuous-batching engine.
+
+The ingress layer the engine was missing: PR 2-3 built a synchronous
+`ServingEngine` that a single caller drives (`generate_batch` blocks
+until every request finishes). `ServingFrontend` turns it into a
+service: an asyncio API (`submit()` awaits the full completion,
+`stream()` yields per-token) over ONE background step-loop task that
+drives the engine's single compiled mixed step, with
+
+* **admission + backpressure** — a bounded `batcher.FairQueue`;
+  `submit`/`stream` await for space when the frontend is saturated
+  instead of growing an unbounded queue, and lanes are served
+  round-robin per tenant so one chatty tenant cannot starve the rest;
+* **cancellation** — cancelling the consumer (or `handle.cancel()`)
+  reclaims the request's slot, KV blocks and prefix-cache locks at the
+  next step boundary;
+* **deadlines** — `timeout=` maps to the scheduler's absolute deadline;
+  expiry surfaces as `DeadlineExceeded` on the awaiting caller.
+
+Threading model: ALL frontend and engine state is mutated from the
+event-loop thread, except `engine.step()` itself which runs in the
+default executor so the loop stays responsive during device work.
+While a step is in flight the loop only ever *flags* intent
+(submissions land in the fair queue, cancellations set a bool); the
+step-loop task applies those flags between steps. That keeps the
+engine single-threaded in effect — no locks, and the mixed step still
+compiles exactly once.
+
+Outputs are token-identical to the cache-off, single-request
+`generate()` path: the frontend adds scheduling, never math
+(tests/test_frontend.py asserts parity and the single compile).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from .batcher import FairQueue
+
+_DONE = object()
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before it finished."""
+
+
+class RequestCancelled(Exception):
+    """The request was cancelled before it finished."""
+
+
+class FrontendClosed(Exception):
+    """The frontend was stopped while the request was in flight."""
+
+
+class FrontendHandle:
+    """One in-flight request as seen by a caller."""
+
+    def __init__(self, prompt, max_new_tokens, tenant, deadline):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tenant = tenant
+        self.deadline = deadline
+        self.req = None               # scheduler Request once admitted
+        self.queue = asyncio.Queue()  # tokens, then _DONE / exception
+        self.published = 0
+        self.cancel_requested = False
+        self.terminal = False
+
+    @property
+    def tokens(self):
+        """Tokens generated so far (live view once admitted)."""
+        return list(self.req.output) if self.req is not None else []
+
+    def cancel(self):
+        """Request cancellation; applied at the next step boundary."""
+        self.cancel_requested = True
+
+
+class ServingFrontend:
+    """Bounded async ingress over one `ServingEngine`.
+
+    Usage::
+
+        frontend = ServingFrontend(engine, max_pending=64)
+        async with frontend:
+            toks = await frontend.submit(prompt, max_new_tokens=64)
+            async for tok in frontend.stream(prompt2, tenant="b"):
+                ...
+    """
+
+    def __init__(self, engine, *, max_pending=256, engine_queue_depth=None):
+        self.engine = engine
+        self._fair = FairQueue(max_pending)
+        # how many requests may sit in the ENGINE's FIFO beyond the
+        # resident slots: deep enough to keep every slot busy the
+        # moment one frees, shallow enough that fairness (which lives
+        # in the frontend lanes) still governs admission order
+        self._engine_depth = (engine.kv.max_slots if engine_queue_depth
+                              is None else int(engine_queue_depth))
+        self._live = []               # handles admitted to the engine
+        self._wake = asyncio.Event()
+        self._space = asyncio.Event()
+        self._task = None
+        self._closed = False
+
+    # ---------------------------------------------------------- lifecycle
+    async def start(self):
+        if self._task is None:
+            self._closed = False
+            self._task = asyncio.get_running_loop().create_task(
+                self._step_loop())
+        return self
+
+    async def stop(self):
+        """Stop the step loop; in-flight requests get FrontendClosed."""
+        self._closed = True
+        self._wake.set()
+        self._space.set()     # release backpressure waiters to fail
+        if self._task is not None:
+            try:
+                await self._task
+            finally:
+                self._task = None
+        err = FrontendClosed("frontend stopped")
+        while True:
+            handle = self._fair.pop()
+            if handle is None:
+                break
+            self._finish_handle(handle, err)
+        for handle in list(self._live):
+            if handle.req is not None:
+                self.engine.cancel(handle.req)
+            self._finish_handle(handle, err)
+        self._live.clear()
+
+    async def __aenter__(self):
+        return await self.start()
+
+    async def __aexit__(self, *exc):
+        await self.stop()
+
+    # ------------------------------------------------------------ intake
+    async def _enqueue(self, prompt, max_new_tokens, tenant, timeout):
+        if self._closed or self._task is None:
+            raise FrontendClosed("frontend is not running")
+        deadline = (self.engine.clock() + float(timeout)
+                    if timeout is not None else None)
+        handle = FrontendHandle(list(prompt), int(max_new_tokens),
+                                str(tenant), deadline)
+        while not self._fair.push(handle.tenant, handle):
+            # bounded queue full: wait until the step loop drains
+            # space — but never past the request's own deadline (a
+            # handle not yet in the fair queue is invisible to the
+            # admission-time expiry checks)
+            self._space.clear()
+            if deadline is not None:
+                remaining = deadline - self.engine.clock()
+                if remaining <= 0:
+                    raise DeadlineExceeded()
+                try:
+                    await asyncio.wait_for(self._space.wait(), remaining)
+                except asyncio.TimeoutError:
+                    raise DeadlineExceeded() from None
+            else:
+                await self._space.wait()
+            if self._closed:
+                raise FrontendClosed("frontend stopped while waiting")
+        self._wake.set()
+        return handle
+
+    async def submit(self, prompt, max_new_tokens=32, *,
+                     tenant="default", timeout=None):
+        """Run one request to completion; returns its generated token
+        ids. Cancelling the awaiting task cancels the request."""
+        out = []
+        async for tok in self.stream(prompt, max_new_tokens,
+                                     tenant=tenant, timeout=timeout):
+            out.append(tok)
+        return out
+
+    async def stream(self, prompt, max_new_tokens=32, *,
+                     tenant="default", timeout=None):
+        """Async generator of generated tokens, one per decode step
+        (speculative acceptance can deliver several per step). Closing
+        the generator — or cancelling its consumer — cancels the
+        request and reclaims its resources."""
+        handle = await self._enqueue(prompt, max_new_tokens, tenant,
+                                     timeout)
+        try:
+            while True:
+                item = await handle.queue.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            if not handle.terminal:
+                handle.cancel()
+                self._wake.set()
+
+    # --------------------------------------------------------- step loop
+    def _finish_handle(self, handle, outcome):
+        """Publish the terminal outcome (sentinel or exception)."""
+        if handle.terminal:
+            return
+        handle.terminal = True
+        handle.queue.put_nowait(outcome)
+
+    def _apply_cancellations(self):
+        # cancelled before admission: drop from the fair queue now so
+        # the slot of backpressure it held frees immediately
+        queued = [h for h in self._fair.items() if h.cancel_requested]
+        for handle in queued:
+            self._fair.remove(handle)
+            self._finish_handle(handle, RequestCancelled())
+        if queued:
+            self._space.set()
+        for handle in list(self._live):
+            if handle.cancel_requested and not handle.terminal:
+                self.engine.cancel(handle.req)
+                self._live.remove(handle)
+                self._finish_handle(handle, RequestCancelled())
+
+    def _admit_pending(self):
+        """Fair-drain the frontend queue into the engine, keeping its
+        FIFO shallow so frontend fairness governs admission order."""
+        sch = self.engine.scheduler
+        now = self.engine.clock()
+        while len(sch.queue) < self._engine_depth:
+            handle = self._fair.pop()
+            if handle is None:
+                break
+            if handle.cancel_requested:
+                self._finish_handle(handle, RequestCancelled())
+                continue
+            if handle.deadline is not None and now > handle.deadline:
+                self._finish_handle(handle, DeadlineExceeded())
+                continue
+            try:
+                handle.req = self.engine.submit(
+                    handle.prompt, handle.max_new_tokens,
+                    deadline=handle.deadline, tenant=handle.tenant)
+            except ValueError as e:      # oversized / empty prompt
+                self._finish_handle(handle, e)
+                continue
+            self._live.append(handle)
+        self._space.set()
+
+    def _publish(self):
+        """Push newly generated tokens + terminal states to waiters."""
+        for handle in list(self._live):
+            req = handle.req
+            n = len(req.output)
+            if n > handle.published:
+                for tok in req.output[handle.published:n]:
+                    handle.queue.put_nowait(tok)
+                handle.published = n
+            if req.done:
+                self._live.remove(handle)
+                if req.state == "finished":
+                    self._finish_handle(handle, _DONE)
+                elif req.state == "expired":
+                    self._finish_handle(handle, DeadlineExceeded())
+                else:
+                    self._finish_handle(handle, RequestCancelled())
+
+    def _next_pending_deadline(self):
+        # handles waiting in the frontend queue never reach the
+        # scheduler's expiry sweep, so the idle wait must wake for them
+        soonest = None
+        for h in self._fair.items():
+            if h.deadline is not None and \
+                    (soonest is None or h.deadline < soonest):
+                soonest = h.deadline
+        return soonest
+
+    async def _step_loop(self):
+        try:
+            await self._step_loop_inner()
+        except Exception as e:  # noqa: BLE001 — step/engine failure
+            # a dying step loop must not strand awaiting callers on
+            # queues nobody will ever fill: fail every handle with the
+            # error and close the frontend
+            self._closed = True
+            self._space.set()
+            while True:
+                handle = self._fair.pop()
+                if handle is None:
+                    break
+                self._finish_handle(handle, e)
+            for handle in list(self._live):
+                self._finish_handle(handle, e)
+            self._live.clear()
+
+    async def _step_loop_inner(self):
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            self._apply_cancellations()
+            self._admit_pending()
+            if self.engine.scheduler.has_work:
+                did = await loop.run_in_executor(None, self.engine.step)
+                self._publish()
+                if not did and self.engine.scheduler.has_work:
+                    # engine stall: the block pool cannot cover the
+                    # resident working set (ServingEngine.run raises
+                    # here) — fail the affected requests rather than
+                    # spin
+                    err = RuntimeError(
+                        "serving engine stalled: KV block pool too "
+                        "small for the resident working set")
+                    for handle in list(self._live):
+                        self.engine.cancel(handle.req)
+                        self._live.remove(handle)
+                        self._finish_handle(handle, err)
+                continue
+            # idle: the engine has no work, which means _admit_pending
+            # drained the fair queue (engine FIFO empty => depth free),
+            # so sleep until a submission or cancel wakes us — or the
+            # soonest frontend-held deadline passes (those handles
+            # never reach the scheduler's expiry sweep)
+            self._wake.clear()
+            soonest = self._next_pending_deadline()
+            try:
+                if soonest is not None:
+                    delay = max(0.0, soonest - self.engine.clock())
+                    await asyncio.wait_for(self._wake.wait(), delay)
+                else:
+                    await self._wake.wait()
+            except asyncio.TimeoutError:
+                pass

@@ -22,7 +22,12 @@ NULL_BLOCK = 0
 
 
 class BlockAllocator:
-    """LIFO free-list over block ids [reserved, num_blocks)."""
+    """LIFO free-list over block ids [reserved, num_blocks), with
+    per-block reference counts so the prefix cache can SHARE a block
+    between several slot tables (and its own radix tree): `alloc` hands
+    a block out at refcount 1, `incref` adds an owner, and `free`
+    decrements — the block returns to the free list only when its last
+    owner lets go. Allocation is still all-or-nothing."""
 
     def __init__(self, num_blocks, reserved=1):
         if num_blocks <= reserved:
@@ -33,7 +38,7 @@ class BlockAllocator:
         self.reserved = int(reserved)
         self._free = list(range(self.num_blocks - 1,
                                 self.reserved - 1, -1))
-        self._used = set()
+        self._refs = {}                      # block id -> owner count
 
     @property
     def num_free(self):
@@ -41,27 +46,58 @@ class BlockAllocator:
 
     @property
     def num_used(self):
-        return len(self._used)
+        return len(self._refs)
 
     @property
     def capacity(self):
         return self.num_blocks - self.reserved
 
+    def refcount(self, block):
+        return self._refs.get(block, 0)
+
+    @property
+    def invariant_ok(self):
+        """allocated + free + reserved == pool size, with no overlap —
+        the ledger the prefix-cache meta-test asserts after random
+        alloc/share/CoW/truncate/free sequences."""
+        allocated = set(self._refs)
+        free = set(self._free)
+        return (not (allocated & free)
+                and len(self._free) == len(free)
+                and len(allocated) + len(free) + self.reserved
+                == self.num_blocks
+                and all(c > 0 for c in self._refs.values()))
+
     def alloc(self, n):
-        """n blocks, or None when the pool can't cover the request —
-        the caller decides whether to preempt (never partial)."""
+        """n blocks (each at refcount 1), or None when the pool can't
+        cover the request — the caller decides whether to preempt
+        (never partial)."""
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
-        self._used.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, blocks):
+    def incref(self, blocks):
+        """Add an owner to already-allocated blocks (prefix sharing)."""
         for b in blocks:
-            if b not in self._used:
+            if b not in self._refs:
+                raise ValueError(f"incref of unallocated block {b}")
+            self._refs[b] += 1
+
+    def free(self, blocks):
+        """Drop one owner per block; a block whose count hits zero goes
+        back on the free list."""
+        for b in blocks:
+            c = self._refs.get(b, 0)
+            if c <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._used.remove(b)
-            self._free.append(b)
+            if c == 1:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = c - 1
 
 
 class PagedKVCache:
@@ -88,6 +124,11 @@ class PagedKVCache:
             (self.max_slots, self.max_blocks_per_slot), np.int32)
         self._slot_blocks = [[] for _ in range(self.max_slots)]
         self.slot_lens = np.zeros(self.max_slots, np.int32)
+        # optional radix prefix cache (serving.prefix_cache): when the
+        # free list runs dry, refcount-0 cached leaves are evicted
+        # before an allocation is refused
+        self.prefix_cache = None
+        self._copy_fn = None
 
     # ------------------------------------------------------------ sizing
     @property
@@ -104,7 +145,22 @@ class PagedKVCache:
     def slot_num_blocks(self, slot):
         return len(self._slot_blocks[slot])
 
+    def slot_blocks(self, slot):
+        """The slot's ordered block list (a copy)."""
+        return list(self._slot_blocks[slot])
+
     # --------------------------------------------------------- lifecycle
+    def _alloc(self, n):
+        """Allocator alloc with the prefix-cache backstop: a dry free
+        list first evicts LRU refcount-0 cached leaves, then retries —
+        so cached-but-idle blocks never cause a preemption the pool
+        could have absorbed."""
+        got = self.allocator.alloc(n)
+        if got is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.allocator.num_free)
+            got = self.allocator.alloc(n)
+        return got
+
     def ensure_capacity(self, slot, new_len) -> bool:
         """Grow `slot`'s block table to cover `new_len` tokens. False
         (state unchanged) when the free list can't supply the blocks."""
@@ -116,7 +172,7 @@ class PagedKVCache:
         need = self.blocks_missing(slot, new_len)
         if need == 0:
             return True
-        got = self.allocator.alloc(need)
+        got = self._alloc(need)
         if got is None:
             return False
         row = self._slot_blocks[slot]
@@ -124,6 +180,64 @@ class PagedKVCache:
             self.block_tables[slot, len(row)] = b
             row.append(b)
         return True
+
+    # ---------------------------------------------------- prefix sharing
+    def adopt_blocks(self, slot, blocks):
+        """Append already-allocated (cached) blocks to `slot`'s table,
+        taking one reference per block. Used at admission when the
+        prefix cache matched the head of the prompt — the slot reads
+        these blocks but never writes them (its first uncached token
+        lands in the next, privately-allocated block)."""
+        row = self._slot_blocks[slot]
+        if len(row) + len(blocks) > self.max_blocks_per_slot:
+            raise ValueError("adopted prefix exceeds max_blocks_per_slot")
+        self.allocator.incref(blocks)
+        for b in blocks:
+            self.block_tables[slot, len(row)] = b
+            row.append(b)
+
+    def cow_block(self, slot, index):
+        """Copy-on-write `slot`'s table entry at `index`: allocate a
+        private block, device-copy the shared block's K/V columns into
+        it, swap the table entry and drop the slot's reference on the
+        original. Returns True on success, False (state unchanged) when
+        no block could be allocated even after cache eviction.
+
+        This is how a request EXTENDS a shared block: the matched
+        prefix may end mid-block (e.g. the prompt's last token falls
+        inside a fully-cached block, and the last prompt token must
+        always be re-fed to sample the first output). Writing there
+        would corrupt every other reader, so the writer gets its own
+        copy first."""
+        row = self._slot_blocks[slot]
+        src = row[index]
+        got = self._alloc(1)
+        if got is None:
+            return False
+        dst = got[0]
+        self._copy_block_data(src, dst)
+        row[index] = dst
+        self.block_tables[slot, index] = dst
+        self.allocator.free([src])
+        return True
+
+    def _copy_block_data(self, src, dst):
+        """pool[:, dst] = pool[:, src] for K and V, as ONE jitted
+        fixed-shape copy (block ids ride as traced scalars, so every
+        CoW reuses the same executable; pools are donated in place)."""
+        import jax.numpy as jnp
+
+        if self._copy_fn is None:
+            from ..jit.functional import instrumented_jit
+
+            def copy(kp, vp, src, dst):
+                return (kp.at[:, dst].set(kp[:, src]),
+                        vp.at[:, dst].set(vp[:, src]))
+
+            self._copy_fn = instrumented_jit(
+                copy, "serving_prefix_cow", donate_argnums=(0, 1))
+        self.k_pool, self.v_pool = self._copy_fn(
+            self.k_pool, self.v_pool, jnp.int32(src), jnp.int32(dst))
 
     def truncate_slot(self, slot, new_len):
         """Roll back `slot` to cover only `new_len` tokens: blocks past

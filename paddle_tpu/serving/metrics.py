@@ -40,13 +40,26 @@ SERVING_PREEMPTIONS = REGISTRY.counter(
     "Decode requests evicted (blocks reclaimed, request requeued)")
 SERVING_REQUESTS = REGISTRY.counter(
     "paddle_tpu_serving_requests_total",
-    "Requests by terminal outcome", ("outcome",))   # finished|expired
+    "Requests by terminal outcome",
+    ("outcome",))   # finished|expired|cancelled
 SERVING_TOKENS = REGISTRY.counter(
     "paddle_tpu_serving_tokens_total",
     "Tokens processed by the mixed step", ("kind",))  # prefill|decode
 SERVING_STEPS = REGISTRY.counter(
     "paddle_tpu_serving_steps_total",
     "Mixed-step invocations")
+
+# ---- radix prefix cache (prefix_caching=True) --------------------------
+SERVING_PREFIX_HIT_TOKENS = REGISTRY.counter(
+    "paddle_tpu_serving_prefix_cache_hit_tokens_total",
+    "Prompt tokens whose KV was served from the radix prefix cache "
+    "(never re-prefilled)")
+SERVING_PREFIX_MISS_TOKENS = REGISTRY.counter(
+    "paddle_tpu_serving_prefix_cache_miss_tokens_total",
+    "Prompt tokens that had to be prefilled (no cached prefix)")
+SERVING_PREFIX_EVICTIONS = REGISTRY.counter(
+    "paddle_tpu_serving_prefix_cache_evictions_total",
+    "Cached KV blocks reclaimed by LRU eviction under pool pressure")
 
 # ---- speculative decoding (draft_k > 0) --------------------------------
 SERVING_ACCEPT_LENGTH = REGISTRY.histogram(
@@ -80,6 +93,9 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_draft_tokens_total",
     "paddle_tpu_serving_spec_rollbacks_total",
     "paddle_tpu_serving_spec_rollback_blocks_total",
+    "paddle_tpu_serving_prefix_cache_hit_tokens_total",
+    "paddle_tpu_serving_prefix_cache_miss_tokens_total",
+    "paddle_tpu_serving_prefix_cache_evictions_total",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

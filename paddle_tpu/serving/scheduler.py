@@ -42,11 +42,14 @@ class Request:                     # in sets/queues across state moves
     eos_token_id: Optional[int] = None
     deadline: Optional[float] = None  # absolute time.monotonic()
     arrival: float = 0.0
-    state: str = "queued"   # queued|prefill|decode|finished|expired
+    state: str = "queued"
+    # queued|prefill|decode|finished|expired|cancelled
     slot: int = -1
     output: list = dataclasses.field(default_factory=list)
     fed: int = 0                      # runtime-prompt tokens fed so far
     preemptions: int = 0
+    cache_hit_tokens: int = 0         # prefix-cache tokens skipped
+    tenant: str = "default"           # frontend fairness bucket
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -60,7 +63,7 @@ class Request:                     # in sets/queues across state moves
 
     @property
     def done(self):
-        return self.state in ("finished", "expired")
+        return self.state in ("finished", "expired", "cancelled")
 
 
 @dataclasses.dataclass
@@ -76,7 +79,8 @@ class Plan:
 
 class Scheduler:
     def __init__(self, kv_cache, *, max_slots, token_budget,
-                 clock=time.monotonic, draft_k=0, draft_fn=None):
+                 clock=time.monotonic, draft_k=0, draft_fn=None,
+                 prefix_cache=None):
         self.kv = kv_cache
         self.max_slots = max_slots
         self.token_budget = token_budget
@@ -91,10 +95,14 @@ class Scheduler:
         # note_fed leaves decode lengths alone when draft_k > 0
         self.draft_k = int(draft_k)
         self.draft_fn = draft_fn
+        # radix prefix cache (serving.prefix_cache): admission skips
+        # cached prompt heads, prefill completion / finish publish the
+        # written blocks for later requests
+        self.prefix_cache = prefix_cache
 
     # ---------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
-               deadline=None):
+               deadline=None, tenant="default"):
         total = len(prompt) + max_new_tokens - 1  # last token never fed
         if total > self.kv.max_slot_tokens:
             raise ValueError(
@@ -104,7 +112,7 @@ class Scheduler:
         req = Request(req_id=next(self._ids), prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=eos_token_id, deadline=deadline,
-                      arrival=now, submit_time=now)
+                      arrival=now, submit_time=now, tenant=str(tenant))
         self.queue.append(req)
         return req
 
@@ -118,6 +126,8 @@ class Scheduler:
 
     # ------------------------------------------------------- internals
     def _free_slot(self, req):
+        if self.prefix_cache is not None:
+            self.prefix_cache.unlock_slot(req.slot)
         self.kv.release_slot(req.slot)
         self.slots[req.slot] = None
         req.slot = -1
@@ -149,6 +159,18 @@ class Scheduler:
                 req.state = "prefill"
                 req.fed = 0
                 self.slots[slot] = req
+                if self.prefix_cache is not None:
+                    # cached prompt head: adopt the shared blocks, mark
+                    # their K/V as already resident, and start chunked
+                    # prefill at the first uncached token. Re-admission
+                    # after a preemption rides the same path — the
+                    # victim's own published blocks usually cover most
+                    # of its re-prefill.
+                    hit = self.prefix_cache.lookup_and_adopt(
+                        slot, req.runtime_prompt)
+                    req.fed = hit
+                    req.cache_hit_tokens += hit
+                    self.kv.slot_lens[slot] = hit
         return
 
     def _preempt_victim(self, exclude):
@@ -274,8 +296,14 @@ class Scheduler:
         if self.draft_k == 0:
             for slot, _tok, pos in plan.decode:
                 self.kv.slot_lens[slot] = pos + 1
-        for slot, chunk, start, _ in plan.prefills:
+        for slot, chunk, start, completes in plan.prefills:
             self.kv.slot_lens[slot] = start + len(chunk)
+            if completes and self.prefix_cache is not None:
+                # the whole prompt's K/V is resident now — publish its
+                # full blocks so concurrent same-prefix requests hit
+                req = self.slots[slot]
+                if req is not None:
+                    self.prefix_cache.insert(slot, req.runtime_prompt)
 
     def note_accept(self, slot, new_len):
         """Record a verify group's outcome: `new_len` tokens of the
@@ -287,4 +315,29 @@ class Scheduler:
     def finish(self, req, now=None):
         req.state = "finished"
         req.finish_time = self.clock() if now is None else now
+        if self.prefix_cache is not None and req.slot >= 0:
+            # publish prompt + generated history (chat-turn reuse);
+            # only tokens whose K/V was actually written count — the
+            # last emitted token never fed the step
+            n = int(self.kv.slot_lens[req.slot])
+            self.prefix_cache.insert(req.slot,
+                                     (req.prompt + req.output)[:n])
         self._free_slot(req)
+
+    def cancel(self, req, now=None):
+        """Abort a queued or resident request: its blocks (and prefix
+        locks) are reclaimed and it never produces another token.
+        Returns False when the request already reached a terminal
+        state."""
+        if req.done:
+            return False
+        if req.state == "queued":
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                return False
+        elif req.slot >= 0:
+            self._free_slot(req)
+        req.state = "cancelled"
+        req.finish_time = self.clock() if now is None else now
+        return True

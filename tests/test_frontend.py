@@ -1,0 +1,327 @@
+"""Async serving frontend: parity with generate(), per-token
+streaming, cancellation, deadlines, bounded admission + tenant
+fairness, and the single-compile contract.
+
+Every test drives a real engine through the asyncio step loop
+(asyncio.run), so the frontend's threading model — engine mutations
+only between executor steps — is exercised for real.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving.batcher import FairQueue
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+from paddle_tpu.serving.frontend import (DeadlineExceeded,
+                                         FrontendClosed,
+                                         RequestCancelled,
+                                         ServingFrontend)
+
+
+# ------------------------------------------------------------ FairQueue
+
+
+class TestFairQueue:
+    def test_round_robin_across_tenants(self):
+        q = FairQueue(max_pending=16)
+        for i in range(3):
+            q.push("a", f"a{i}")
+        q.push("b", "b0")
+        q.push("c", "c0")
+        order = [q.pop() for _ in range(5)]
+        assert order == ["a0", "b0", "c0", "a1", "a2"]
+        assert q.pop() is None
+
+    def test_bounded(self):
+        q = FairQueue(max_pending=2)
+        assert q.push("a", 1) and q.push("b", 2)
+        assert not q.push("a", 3)
+        q.pop()
+        assert q.push("a", 3)
+
+    def test_remove(self):
+        q = FairQueue(max_pending=8)
+        q.push("a", "x")
+        q.push("a", "y")
+        assert q.remove("x")
+        assert not q.remove("x")
+        assert q.pop() == "y" and len(q) == 0
+
+
+# --------------------------------------------------------------- engine
+
+
+def _model():
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=193, hidden_size=32, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=128,
+                         compute_dtype="float32")
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", "float32")
+    return ServingEngine(m, **kw)
+
+
+def _solo(m, prompt, n=6):
+    out, _ = m.generate(Tensor(np.array([prompt], np.int64)),
+                        max_new_tokens=n, cache_dtype="float32")
+    return out.numpy()[0].tolist()
+
+
+class TestServingFrontend:
+    def test_submit_parity_with_generation(self):
+        """Concurrent async submissions are token-identical to the
+        cache-off single-request generate() path."""
+        m = _model()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 193, n).tolist()
+                   for n in (5, 9, 3, 12, 7, 4)]
+
+        async def run():
+            eng = _engine(m, prefix_caching=True)
+            async with ServingFrontend(eng, max_pending=8) as fe:
+                return await asyncio.gather(*[
+                    fe.submit(p, max_new_tokens=6,
+                              tenant=f"t{i % 3}")
+                    for i, p in enumerate(prompts)])
+
+        outs = asyncio.run(run())
+        for p, o in zip(prompts, outs):
+            assert o == _solo(m, p)
+
+    def test_stream_yields_incrementally(self):
+        m = _model()
+        prompt = [3, 14, 15, 9, 2]
+
+        async def run():
+            eng = _engine(m)
+            async with ServingFrontend(eng) as fe:
+                toks = []
+                async for t in fe.stream(prompt, max_new_tokens=6):
+                    toks.append(int(t))
+                return toks
+
+        assert asyncio.run(run()) == _solo(m, prompt)
+
+    def test_cancellation_reclaims_resources(self):
+        """Breaking out of a stream cancels the request: its slot and
+        KV blocks are reclaimed while other requests keep running."""
+        m = _model()
+        rng = np.random.RandomState(1)
+        p_long = rng.randint(1, 193, 9).tolist()
+        p_other = rng.randint(1, 193, 5).tolist()
+
+        async def run():
+            eng = _engine(m, max_slots=2)
+            async with ServingFrontend(eng) as fe:
+                async def consume_two():
+                    got = []
+                    async for t in fe.stream(p_long, max_new_tokens=30):
+                        got.append(t)
+                        if len(got) == 2:
+                            break
+                    return got
+                two, other = await asyncio.gather(
+                    consume_two(),
+                    fe.submit(p_other, max_new_tokens=6))
+                # let the loop apply the cancellation
+                for _ in range(20):
+                    if eng.scheduler.num_active == 0:
+                        break
+                    await asyncio.sleep(0.02)
+                return two, other, eng.scheduler.num_active, \
+                    eng.kv.blocks_in_use
+
+        two, other, active, blocks = asyncio.run(run())
+        assert len(two) == 2
+        assert two == _solo(m, p_long, 30)[:2]
+        assert other == _solo(m, p_other)
+        assert active == 0 and blocks == 0
+
+    def test_handle_cancel_surfaces_exception(self):
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+            async with ServingFrontend(eng) as fe:
+                gen = fe.stream([5, 6, 7], max_new_tokens=40)
+                handle_holder = {}
+                orig = fe._enqueue
+
+                async def spy(*a, **k):
+                    h = await orig(*a, **k)
+                    handle_holder["h"] = h
+                    return h
+                fe._enqueue = spy
+                tok = await gen.__anext__()       # running now
+                handle_holder["h"].cancel()
+                fe._wake.set()
+                with pytest.raises(RequestCancelled):
+                    while True:
+                        await gen.__anext__()
+                return tok
+
+        assert asyncio.run(run()) is not None
+
+    def test_deadline_expiry_raises(self):
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+            async with ServingFrontend(eng) as fe:
+                with pytest.raises(DeadlineExceeded):
+                    # deadline already in the past at admission
+                    await fe.submit([1, 2, 3], max_new_tokens=4,
+                                    timeout=-1.0)
+
+        asyncio.run(run())
+
+    def test_backpressure_bounded_queue_waits_not_rejects(self):
+        """max_pending=1: extra submitters wait for space and all
+        complete (backpressure, not load shedding)."""
+        m = _model()
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(1, 193, 4).tolist() for _ in range(5)]
+
+        async def run():
+            eng = _engine(m, max_slots=2)
+            async with ServingFrontend(eng, max_pending=1) as fe:
+                return await asyncio.gather(*[
+                    fe.submit(p, max_new_tokens=4) for p in prompts])
+
+        outs = asyncio.run(run())
+        for p, o in zip(prompts, outs):
+            assert o == _solo(m, p, 4)
+
+    def test_tenant_fairness_arrival_order(self):
+        """A flood from tenant A must not starve tenant B: admission
+        alternates lanes, so B's request reaches the engine near the
+        front, not behind A's whole backlog."""
+        m = _model()
+        rng = np.random.RandomState(3)
+        a_prompts = [rng.randint(1, 193, 4).tolist() for _ in range(6)]
+        b_prompt = rng.randint(1, 193, 4).tolist()
+        order = []
+
+        async def run():
+            eng = _engine(m, max_slots=1)
+            real_submit = eng.submit
+
+            def spying(prompt_ids, *a, **kw):
+                req = real_submit(prompt_ids, *a, **kw)
+                order.append(kw.get("tenant", "default"))
+                return req
+            eng.submit = spying
+            fe = ServingFrontend(eng, max_pending=16,
+                                 engine_queue_depth=1)
+            async with fe:
+                tasks = [asyncio.ensure_future(
+                    fe.submit(p, max_new_tokens=3, tenant="a"))
+                    for p in a_prompts]
+                await asyncio.sleep(0)           # A's flood lands first
+                tasks.append(asyncio.ensure_future(
+                    fe.submit(b_prompt, max_new_tokens=3, tenant="b")))
+                await asyncio.gather(*tasks)
+
+        asyncio.run(run())
+        assert order.index("b") <= 2, order      # not behind A's backlog
+
+    def test_single_compile_across_frontend_traffic(self):
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            rng = np.random.RandomState(4)
+
+            async def run():
+                eng = _engine(m, prefix_caching=True)
+                async with ServingFrontend(eng) as fe:
+                    for wave in range(3):
+                        prompts = [rng.randint(1, 193, int(n)).tolist()
+                                   for n in rng.randint(2, 14, 3)]
+                        await asyncio.gather(*[
+                            fe.submit(p, max_new_tokens=4)
+                            for p in prompts])
+
+            asyncio.run(run())
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value == 1
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_step_loop_failure_fails_handles_not_hangs(self):
+        """An engine error inside the background step loop must
+        surface on the awaiting callers, never strand them."""
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+
+            def boom():
+                raise RuntimeError("device exploded")
+            eng.step = boom
+            async with ServingFrontend(eng) as fe:
+                with pytest.raises(RuntimeError, match="exploded"):
+                    await asyncio.wait_for(
+                        fe.submit([1, 2, 3], max_new_tokens=4), 10)
+
+        asyncio.run(run())
+
+    def test_backpressure_wait_respects_deadline(self):
+        """A submit with a timeout must get DeadlineExceeded even while
+        stuck behind a saturated admission queue."""
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+            # depth 0: nothing ever admits, so the 1-deep queue stays
+            # full and the second submit blocks on backpressure
+            fe = ServingFrontend(eng, max_pending=1,
+                                 engine_queue_depth=0)
+            async with fe:
+                blocker = asyncio.ensure_future(
+                    fe.submit([1, 2], max_new_tokens=2))
+                await asyncio.sleep(0.05)
+                t0 = eng.clock()
+                with pytest.raises(DeadlineExceeded):
+                    await fe.submit([3, 4], max_new_tokens=2,
+                                    timeout=0.2)
+                assert eng.clock() - t0 < 5.0
+                blocker.cancel()
+                try:
+                    await blocker
+                except (asyncio.CancelledError, FrontendClosed,
+                        RequestCancelled):
+                    pass
+
+        asyncio.run(run())
+
+    def test_stop_fails_inflight_with_frontend_closed(self):
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+            fe = ServingFrontend(eng)
+            await fe.start()
+            task = asyncio.ensure_future(
+                fe.submit([5, 6, 7], max_new_tokens=40))
+            await asyncio.sleep(0.05)
+            await fe.stop()
+            with pytest.raises((FrontendClosed, RequestCancelled)):
+                await task
+            with pytest.raises(FrontendClosed):
+                await fe.submit([1, 2], max_new_tokens=2)
+
+        asyncio.run(run())

@@ -1,0 +1,398 @@
+"""Radix-tree prefix KV cache: refcounted allocator, tree mechanics
+(match/insert/split/evict), copy-on-write, the free-list invariant
+meta-test, and engine-level parity + prefilled-token savings.
+
+The subsystem contract (docs/SERVING.md): outputs with prefix caching
+ON are token-identical to cache-off serving AND single-request
+generate(); the cache only removes prefill work, never changes math.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving import metrics as sm
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+from paddle_tpu.serving.kv_cache import NULL_BLOCK, BlockAllocator, \
+    PagedKVCache
+from paddle_tpu.serving.prefix_cache import RadixPrefixCache
+
+
+# ---------------------------------------------------------- refcounts
+
+
+class TestRefcounts:
+    def test_incref_defers_free(self):
+        a = BlockAllocator(8)
+        b = a.alloc(2)
+        a.incref(b)
+        a.free(b)                       # one owner left
+        assert a.num_used == 2 and a.num_free == 5
+        a.free(b)                       # last owner
+        assert a.num_used == 0 and a.num_free == 7
+
+    def test_incref_unallocated_rejected(self):
+        a = BlockAllocator(8)
+        with pytest.raises(ValueError):
+            a.incref([3])
+
+    def test_overfree_rejected(self):
+        a = BlockAllocator(8)
+        b = a.alloc(1)
+        a.free(b)
+        with pytest.raises(ValueError):
+            a.free(b)
+
+    def test_invariant_property(self):
+        a = BlockAllocator(10)
+        assert a.invariant_ok
+        x = a.alloc(4)
+        a.incref(x[:2])
+        a.free(x[:3])
+        assert a.invariant_ok
+        assert a.num_used == 3          # 2 shared-once + 1 untouched
+
+
+# ------------------------------------------------------- tree mechanics
+
+
+def _kv(num_blocks=33, block_size=4, max_slots=4, mbps=8):
+    return PagedKVCache(1, 1, 4, num_blocks=num_blocks,
+                        block_size=block_size, max_slots=max_slots,
+                        max_blocks_per_slot=mbps)
+
+
+def _fill(kv, slot, n_tokens):
+    """Simulate a prefill: allocate blocks and set the length ledger."""
+    assert kv.ensure_capacity(slot, n_tokens)
+    kv.slot_lens[slot] = n_tokens
+
+
+class TestRadixTree:
+    def test_miss_then_hit_block_aligned(self):
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        toks = list(range(100, 119))           # 19 tokens, 4 full blocks
+        assert pc.lookup_and_adopt(0, toks) == 0
+        _fill(kv, 0, 19)
+        assert pc.insert(0, toks) == 4         # 16 cached tokens
+        # same prompt on another slot: full blocks shared, tail re-fed
+        hit = pc.lookup_and_adopt(1, toks)
+        assert hit == 16
+        assert kv.slot_blocks(1) == kv.slot_blocks(0)[:4]
+        for b in kv.slot_blocks(1):
+            assert kv.allocator.refcount(b) == 3   # 2 slots + tree
+
+    def test_divergent_suffix_splits_node(self):
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        a = list(range(10, 26))                # 4 blocks
+        _fill(kv, 0, 16)
+        pc.insert(0, a)
+        b = a[:8] + list(range(50, 58))        # shares 2 blocks
+        hit = pc.lookup_and_adopt(1, b)
+        assert hit == 8
+        _fill(kv, 1, 16)                       # grows past the shared 2
+        assert pc.insert(1, b) == 2            # only the new suffix
+        # both full sequences still match after the split
+        nodes_a, blocks_a, got_a = pc._walk(a, 4)
+        nodes_b, blocks_b, got_b = pc._walk(b, 4)
+        assert got_a == 4 and got_b == 4
+        assert blocks_a[:2] == blocks_b[:2]
+        assert blocks_a[2:] != blocks_b[2:]
+
+    def test_cow_when_prompt_fully_cached(self):
+        """A prompt whose FULL length is cached must still re-feed its
+        last token — into a private copy of the shared block."""
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        toks = list(range(30, 46))             # exactly 4 blocks
+        _fill(kv, 0, 16)
+        pc.insert(0, toks)
+        shared = kv.slot_blocks(0)
+        hit = pc.lookup_and_adopt(1, toks)
+        assert hit == 15                       # 16 - the re-fed token
+        row = kv.slot_blocks(1)
+        assert row[:3] == shared[:3]
+        assert row[3] != shared[3]             # CoW'd private copy
+        assert pc.cow_copies == 1
+        assert kv.allocator.refcount(row[3]) == 1
+        assert kv.allocator.refcount(shared[3]) == 2   # slot0 + tree
+
+    def test_cow_copies_device_columns(self):
+        import jax.numpy as jnp
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        toks = list(range(60, 68))             # 2 blocks
+        _fill(kv, 0, 8)
+        # write a recognizable pattern into slot 0's blocks
+        b0 = kv.slot_blocks(0)
+        kv.k_pool = kv.k_pool.at[:, b0[1]].set(7.25)
+        kv.v_pool = kv.v_pool.at[:, b0[1]].set(-3.5)
+        pc.insert(0, toks)
+        hit = pc.lookup_and_adopt(1, toks)
+        assert hit == 7 and pc.cow_copies == 1
+        copy = kv.slot_blocks(1)[1]
+        assert copy != b0[1]
+        assert float(jnp.max(jnp.abs(kv.k_pool[:, copy] - 7.25))) == 0.0
+        assert float(jnp.max(jnp.abs(kv.v_pool[:, copy] + 3.5))) == 0.0
+
+    def test_lru_eviction_frees_oldest_leaf_first(self):
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        seqs = [[t + 100 * i for t in range(8)] for i in range(3)]
+        for i, s in enumerate(seqs):
+            _fill(kv, i, 8)
+            pc.insert(i, s)
+            kv.release_slot(i)
+            pc.unlock_slot(i)
+        assert pc.cached_blocks == 6
+        # touch seq 0 so seq 1 becomes LRU
+        pc.lookup_and_adopt(0, seqs[0])
+        freed = pc.evict(1)
+        assert freed == 2                      # whole leaf node
+        _, _, got1 = pc._walk(seqs[1], 2)
+        _, _, got0 = pc._walk(seqs[0], 2)
+        assert got1 == 0 and got0 == 2         # LRU victim was seq 1
+        assert kv.allocator.invariant_ok
+
+    def test_locked_nodes_never_evicted(self):
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        toks = list(range(8))
+        _fill(kv, 0, 8)
+        pc.insert(0, toks)
+        kv.release_slot(0)
+        pc.unlock_slot(0)
+        pc.lookup_and_adopt(1, toks)           # slot 1 locks the path
+        assert pc.evict(100) == 0
+        kv.release_slot(1)
+        pc.unlock_slot(1)
+        assert pc.evict(100) >= 2
+
+    def test_dry_pool_evicts_before_refusing(self):
+        """ensure_capacity must reclaim idle cached blocks instead of
+        failing (the free-list integration)."""
+        kv = _kv(num_blocks=9)                 # 8 allocatable
+        pc = RadixPrefixCache(kv)
+        toks = list(range(16))
+        _fill(kv, 0, 16)                       # 4 blocks
+        pc.insert(0, toks)
+        kv.release_slot(0)
+        pc.unlock_slot(0)
+        assert kv.allocator.num_free == 4      # 4 cached + 4 free
+        assert kv.ensure_capacity(1, 32)       # needs all 8
+        assert pc.evictions == 4
+        assert kv.allocator.invariant_ok
+
+    def test_truncate_slot_respects_shared_blocks(self):
+        """Speculative rollback on a slot holding shared prefix blocks
+        must drop only the slot's references."""
+        kv = _kv()
+        pc = RadixPrefixCache(kv)
+        toks = list(range(12))                 # 3 blocks
+        _fill(kv, 0, 12)
+        pc.insert(0, toks)
+        hit = pc.lookup_and_adopt(1, toks + [99, 98])
+        assert hit == 12
+        _fill(kv, 1, 20)                       # 2 private blocks on top
+        freed = kv.truncate_slot(1, 13)        # roll back to 4 blocks
+        assert freed == 1
+        kv.release_slot(1)
+        pc.unlock_slot(1)
+        # the shared prefix survived both truncate and release
+        _, _, got = pc._walk(toks, 3)
+        assert got == 3
+        assert kv.allocator.invariant_ok
+
+
+# ------------------------------------------------- invariant meta-test
+
+
+def test_allocator_invariant_under_random_ops():
+    """allocated + free + NULL == pool size after arbitrary
+    alloc/share/CoW/truncate/free sequences (satellite contract)."""
+    rng = np.random.RandomState(42)
+    kv = _kv(num_blocks=25, block_size=4, max_slots=4, mbps=6)
+    pc = RadixPrefixCache(kv)
+    next_tok = [0]
+
+    def fresh_tokens(n):
+        next_tok[0] += n
+        return list(range(next_tok[0] - n, next_tok[0]))
+
+    shared_pool = [fresh_tokens(8) for _ in range(3)]
+    lens = [0] * 4
+    toks = [None] * 4
+    for _ in range(400):
+        slot = rng.randint(4)
+        op = rng.randint(5)
+        if lens[slot] == 0 and op != 4:
+            # admit: half the time reuse a shared prefix
+            base = list(shared_pool[rng.randint(3)]) \
+                if rng.rand() < 0.5 else []
+            toks[slot] = base + fresh_tokens(rng.randint(1, 8))
+            hit = pc.lookup_and_adopt(slot, toks[slot])
+            want = min(len(toks[slot]) + rng.randint(0, 6),
+                       kv.max_slot_tokens)
+            if kv.ensure_capacity(slot, want):
+                lens[slot] = want
+                kv.slot_lens[slot] = want
+                pc.insert(slot, toks[slot][:want])
+            else:                     # pool dry: give the blocks back
+                kv.release_slot(slot)
+                pc.unlock_slot(slot)
+                lens[slot] = 0
+        elif op == 1 and lens[slot] > 0:
+            keep = rng.randint(max(1, lens[slot] // 2), lens[slot] + 1)
+            kv.truncate_slot(slot, keep)
+            lens[slot] = keep
+            kv.slot_lens[slot] = keep
+        elif op == 2 and lens[slot] > 0:
+            kv.release_slot(slot)
+            pc.unlock_slot(slot)
+            lens[slot] = 0
+        elif op == 3:
+            pc.evict(rng.randint(1, 5))
+        assert kv.allocator.invariant_ok, "ledger corrupted"
+        # every NULL table entry past a slot's blocks, never within
+        for s in range(4):
+            nb = kv.slot_num_blocks(s)
+            assert (kv.block_tables[s, :nb] != NULL_BLOCK).all()
+            assert (kv.block_tables[s, nb:] == NULL_BLOCK).all()
+    for s in range(4):
+        if lens[s]:
+            kv.release_slot(s)
+            pc.unlock_slot(s)
+    pc.evict_all()
+    assert kv.allocator.num_used == 0
+    assert kv.allocator.invariant_ok
+
+
+# --------------------------------------------------------- engine level
+
+
+def _model():
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=193, hidden_size=32, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=128,
+                         compute_dtype="float32")
+    m.eval()
+    return m
+
+
+def _solo(m, prompt, n=6):
+    out, _ = m.generate(Tensor(np.array([prompt], np.int64)),
+                        max_new_tokens=n, cache_dtype="float32")
+    return out.numpy()[0].tolist()
+
+
+class TestEnginePrefixCache:
+    def test_shared_prefix_parity_and_savings(self):
+        """Staggered same-prefix requests: outputs identical to
+        generate(), and >= 50% of prompt tokens served from cache."""
+        m = _model()
+        rng = np.random.RandomState(0)
+        common = rng.randint(1, 193, 24).tolist()
+        prompts = [common + rng.randint(1, 193, 4).tolist()
+                   for _ in range(8)]
+        eng = ServingEngine(m, max_slots=2, block_size=4,
+                            max_seq_len=64, cache_dtype="float32",
+                            prefix_caching=True)
+        outs = eng.generate_batch(prompts, max_new_tokens=6)
+        for p, o in zip(prompts, outs):
+            assert o == _solo(m, p)
+        pc = eng.prefix_cache
+        total = sum(len(p) for p in prompts)
+        assert pc.hit_tokens + pc.miss_tokens == total
+        # 2 slots admit the first wave cold; the other 6 requests hit
+        assert pc.hit_tokens >= total * 0.5
+        assert eng.scheduler.preemption_count == 0
+
+    def test_parity_under_preemption_with_cache(self):
+        """Preemption + prefix cache: the victim's re-prefill rides the
+        cache (its own published blocks) and stays token-identical."""
+        m = _model()
+        rng = np.random.RandomState(1)
+        common = rng.randint(1, 193, 8).tolist()
+        prompts = [common + rng.randint(1, 193, n).tolist()
+                   for n in (3, 5, 2, 6, 4, 7)]
+        eng = ServingEngine(m, max_slots=4, block_size=4, num_blocks=13,
+                            max_seq_len=32, cache_dtype="float32",
+                            prefix_caching=True)
+        outs = eng.generate_batch(prompts, max_new_tokens=8)
+        assert eng.scheduler.preemption_count > 0
+        for p, o in zip(prompts, outs):
+            assert o == _solo(m, p, 8)
+        assert eng.kv.allocator.invariant_ok
+
+    def test_full_prompt_replay_uses_cow(self):
+        """Identical full prompts (chat replay): the second request
+        re-feeds ONE token via a CoW'd block, never a shared write."""
+        m = _model()
+        prompt = list(range(1, 17))            # 16 = 4 full blocks
+        eng = ServingEngine(m, max_slots=2, block_size=4,
+                            max_seq_len=64, cache_dtype="float32",
+                            prefix_caching=True)
+        (o1,) = eng.generate_batch([prompt], max_new_tokens=6)
+        (o2,) = eng.generate_batch([prompt], max_new_tokens=6)
+        assert o1 == o2 == _solo(m, prompt)
+        assert eng.prefix_cache.cow_copies >= 1
+
+    def test_speculative_with_prefix_cache(self):
+        """draft_k > 0 + prefix caching: rollback over shared prefixes
+        stays refcount-correct and greedy-identical."""
+        m = _model()
+        rng = np.random.RandomState(2)
+        common = rng.randint(1, 193, 12).tolist()
+        prompts = [common + rng.randint(1, 193, n).tolist()
+                   for n in (3, 5, 4, 6)]
+        base = ServingEngine(m, max_slots=2, block_size=4,
+                             max_seq_len=64, cache_dtype="float32")
+        want = base.generate_batch(prompts, max_new_tokens=6)
+        spec = ServingEngine(m, max_slots=2, block_size=4,
+                             max_seq_len=64, cache_dtype="float32",
+                             draft_k=3, prefix_caching=True)
+        got = spec.generate_batch(prompts, max_new_tokens=6)
+        assert got == want
+        assert spec.prefix_cache.hit_tokens > 0
+        assert spec.kv.allocator.invariant_ok
+
+    def test_single_compile_and_metrics(self):
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            eng = ServingEngine(m, max_slots=2, block_size=4,
+                                max_seq_len=64, cache_dtype="float32",
+                                prefix_caching=True)
+            common = list(range(50, 66))
+            for wave in range(3):
+                prompts = [common + [90 + wave, 91 + wave]]
+                eng.generate_batch(prompts, max_new_tokens=4)
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value == 1
+            assert sm.SERVING_PREFIX_HIT_TOKENS.value > 0
+            assert sm.SERVING_PREFIX_MISS_TOKENS.value > 0
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_eviction_under_block_pressure_stays_correct(self):
+        """A pool too small to cache everything: LRU eviction churns,
+        outputs stay identical, nothing leaks."""
+        m = _model()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(1, 193, 12).tolist() for _ in range(6)]
+        eng = ServingEngine(m, max_slots=2, block_size=4, num_blocks=11,
+                            max_seq_len=32, cache_dtype="float32",
+                            prefix_caching=True)
+        for p in prompts:                      # sequential: cache churns
+            (o,) = eng.generate_batch([p], max_new_tokens=6)
+            assert o == _solo(m, p)
+        assert eng.prefix_cache.evictions > 0
+        assert eng.kv.allocator.invariant_ok
+        eng.prefix_cache.evict_all()
+        assert eng.kv.blocks_in_use == 0

@@ -7,9 +7,14 @@ under a deliberately small KV block pool (so admission, chunked
 prefill, preemption and free-list reuse all fire), then every serving
 metric name in `serving.metrics.CONTRACT_METRICS` must appear in the
 Prometheus-text dump, the mixed step must have compiled exactly once,
-and every request must have finished. Exit status is non-zero on any
-violation, so the tool doubles as a wiring check for the serving
-observability contract.
+and every request must have finished. A speculative (`draft_k=3`)
+phase replays the same prompts and must be token-identical. A
+shared-prefix phase then serves staggered requests with a common
+prompt head through the radix prefix cache: outputs must stay
+identical to the cache-off engine while prefilling AT LEAST 50% fewer
+tokens, with its own single compile and no leaked blocks once the
+cache is drained. Exit status is non-zero on any violation, so the
+tool doubles as a wiring check for the serving observability contract.
 
 Usage: JAX_PLATFORMS=cpu python tools/serving_smoke.py
 """
@@ -80,7 +85,51 @@ def run_smoke():
     ratio = sm.draft_hit_ratio()
     if not 0.0 <= ratio <= 1.0:
         failures.append(f"draft hit ratio {ratio} out of [0, 1]")
-    return engine, spec, failures
+
+    # ---- shared-prefix phase: radix prefix cache on vs off ----
+    # 8 requests share a 24-token system-prompt head; 2 slots stagger
+    # admission so later arrivals find the head cached. The cache-off
+    # engine is the parity + prefilled-token baseline.
+    common = rng.randint(1, 211, 24).tolist()
+    shared = [common + rng.randint(1, 211, 4).tolist()
+              for _ in range(8)]
+    c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+    p0 = sm.SERVING_TOKENS.labels("prefill").value
+    cache_off = ServingEngine(model, max_slots=2, block_size=4,
+                              max_seq_len=48, cache_dtype="float32",
+                              seed=0)
+    off_out = cache_off.generate_batch(shared, max_new_tokens=6)
+    prefilled_off = sm.SERVING_TOKENS.labels("prefill").value - p0
+    p1 = sm.SERVING_TOKENS.labels("prefill").value
+    cache_on = ServingEngine(model, max_slots=2, block_size=4,
+                             max_seq_len=48, cache_dtype="float32",
+                             seed=0, prefix_caching=True)
+    on_out = cache_on.generate_batch(shared, max_new_tokens=6)
+    prefilled_on = sm.SERVING_TOKENS.labels("prefill").value - p1
+    if on_out != off_out:
+        failures.append("prefix-cached outputs diverge from the "
+                        "cache-off engine (reuse must be lossless)")
+    if prefilled_on > 0.5 * prefilled_off:
+        failures.append(
+            f"prefix cache saved too little prefill: {prefilled_on} "
+            f"tokens vs {prefilled_off} cache-off (need >= 50% fewer)")
+    pc_compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0
+    if pc_compiles != 2:
+        failures.append(f"shared-prefix phase compiled {pc_compiles} "
+                        "mixed steps, want 2 (one per engine)")
+    hr = cache_on.prefix_cache.hit_ratio()
+    if not 0.0 < hr <= 1.0:
+        failures.append(f"prefix hit ratio {hr} not in (0, 1]")
+    if sm.SERVING_PREFIX_HIT_TOKENS.value <= 0:
+        failures.append("no prefix-cache hit tokens recorded")
+    cache_on.prefix_cache.evict_all()
+    if cache_on.kv.blocks_in_use != 0:
+        failures.append(f"{cache_on.kv.blocks_in_use} blocks leaked by "
+                        "the prefix-cached engine after evict_all")
+    prefix_stats = {"prefilled_off": int(prefilled_off),
+                    "prefilled_on": int(prefilled_on),
+                    "hit_ratio": hr}
+    return engine, spec, prefix_stats, failures
 
 
 def main():
@@ -88,7 +137,7 @@ def main():
     from paddle_tpu.serving import metrics as sm
     from paddle_tpu.serving.metrics import CONTRACT_METRICS
 
-    engine, spec, failures = run_smoke()
+    engine, spec, prefix_stats, failures = run_smoke()
     text = pm.REGISTRY.to_prometheus()
     print(text)
     for name in CONTRACT_METRICS:
@@ -99,11 +148,17 @@ def main():
             print(f"SMOKE FAILURE: {f}", file=sys.stderr)
         return 1
     groups = max(1, sm.SERVING_ACCEPT_LENGTH.count)
+    saved = 1.0 - prefix_stats["prefilled_on"] / max(
+        1, prefix_stats["prefilled_off"])
     print(f"serving smoke OK: 8 requests, {engine.steps_run} mixed "
           f"steps, {engine.scheduler.preemption_count} preemptions; "
           f"speculative: {spec.steps_run} steps, mean accept "
           f"{sm.SERVING_ACCEPT_LENGTH.sum / groups:.2f} tok/group, "
-          f"draft hit ratio {sm.draft_hit_ratio():.2f}",
+          f"draft hit ratio {sm.draft_hit_ratio():.2f}; "
+          f"prefix cache: {prefix_stats['prefilled_on']} vs "
+          f"{prefix_stats['prefilled_off']} prefilled tokens "
+          f"({saved:.0%} saved, hit ratio "
+          f"{prefix_stats['hit_ratio']:.2f})",
           file=sys.stderr)
     return 0
 
