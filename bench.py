@@ -241,9 +241,7 @@ def bench_lenet():
 # ----------------------------------------------------------- wide&deep
 
 
-def bench_wide_deep():
-    """Config 5: embedding pull -> dense train -> push through the native
-    PS engine (C++ sharded tables), examples/sec + training AUC."""
+def _load_wd_example():
     import importlib.util
     import os
     spec = importlib.util.spec_from_file_location(
@@ -252,6 +250,13 @@ def bench_wide_deep():
                      "examples", "5_wide_deep_ps.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def bench_wide_deep():
+    """Config 5: embedding pull -> dense train -> push through the native
+    PS engine (C++ sharded tables), examples/sec + training AUC."""
+    mod = _load_wd_example()
     if not hasattr(mod, "run_bench"):
         return None, None
     # min-of-2 full runs (host-variance hardening per BASELINE.md:
@@ -264,6 +269,22 @@ def bench_wide_deep():
             eps, auc = eps2, auc2
     return eps, None, {"metric": "wide_deep_train_auc",
                        "value": round(auc, 4), "unit": "auc"}
+
+
+def bench_wide_deep_heter():
+    """HeterPS-style embedding engine (ps/heter: hot-ID cache +
+    prefetch pipeline + dedup-merged background push) vs the direct
+    RemoteSparseTable lane, both against real parameter servers over
+    localhost RPC on a zipf key stream. CPU-capable; the driver
+    contract is engine >= 1.3x direct."""
+    engine_eps, direct_eps, stats = _load_wd_example().run_bench_heter()
+    return {"metric": "wide_deep_heter_examples_per_sec",
+            "value": round(engine_eps, 1), "unit": "examples/sec",
+            "direct_examples_per_sec": round(direct_eps, 1),
+            "speedup_vs_direct": round(engine_eps / direct_eps, 3),
+            "cache_hit_ratio": stats["cache_hit_ratio"],
+            "dedup_ratio": stats["dedup_ratio"],
+            "prefetch": stats["prefetch"]}
 
 
 # -------------------------------------------------------------- decode
@@ -613,6 +634,20 @@ def main():
         result["extras"].append(
             {"metric": "serving_prefix_cache",
              "error": f"{type(e).__name__}: {e}"})
+
+    # embedding-engine extra: every-platform (localhost PS servers +
+    # CPU dense step) with the >= 1.3x-vs-direct driver contract
+    if _budget_left() > 60:
+        try:
+            result["extras"].append(bench_wide_deep_heter())
+        except Exception as e:  # noqa: BLE001
+            result["extras"].append(
+                {"metric": "wide_deep_heter_examples_per_sec",
+                 "error": f"{type(e).__name__}: {e}"})
+    else:
+        result["extras"].append(
+            {"metric": "wide_deep_heter_examples_per_sec",
+             "skipped": "time budget"})
 
     if on_tpu:
         for name, fn, unit in (

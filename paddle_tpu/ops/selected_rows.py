@@ -38,17 +38,62 @@ class SelectedRows:
 
     def merge_rows(self):
         """Merge duplicate rows (scatter-add into unique rows) —
-        `merge_selected_rows` / MergeAdd kernel."""
+        `merge_selected_rows` / MergeAdd kernel.
+
+        `jnp.unique(..., size=n)` pads its output when duplicates are
+        present. The padding must not leak: the old `fill_value=-1`
+        OverflowError'd on unsigned row dtypes and emitted phantom
+        rows with id -1 (a table-push consumer would turn those into
+        garbage uint64-max keys). The sentinel is now `height` — out
+        of range by contract, so a scatter via `.at[...]` drops it —
+        and eager calls compact the padding away entirely (the
+        sentinel only survives under jit, where shapes are fixed)."""
         rows = self.rows._data
-        uniq, inv = jnp.unique(rows, return_inverse=True,
-                               size=rows.shape[0], fill_value=-1)
-        summed = jax.ops.segment_sum(self.values._data, inv,
-                                     num_segments=rows.shape[0])
+        n = rows.shape[0]
+        if n == 0:
+            return SelectedRows(self.rows, self.values, self.height)
+        if not isinstance(rows, jax.core.Tracer):
+            # concrete: merge in numpy with an exact-sized output (no
+            # padding at all). Slicing a jax array to the data-dependent
+            # unique count would compile a fresh slice kernel per
+            # distinct count — the embedding push path hits a new count
+            # every batch.
+            rows_np = np.asarray(rows)
+            vals_np = np.asarray(self.values._data)
+            uniq, inv = np.unique(rows_np, return_inverse=True)
+            out = merge_with_inverse(inv, vals_np, uniq.size)
+            return SelectedRows(Tensor(uniq), Tensor(out), self.height)
+        fill = jnp.asarray(self.height).astype(rows.dtype)
+        uniq, inv = jnp.unique(rows, return_inverse=True, size=n,
+                               fill_value=fill)
+        summed = jax.ops.segment_sum(self.values._data,
+                                     inv.reshape(-1), num_segments=n)
         return SelectedRows(Tensor(uniq), Tensor(summed), self.height)
 
     def map_fn(self, fn, name):
         return SelectedRows(self.rows, Tensor(fn(self.values._data)),
                             self.height)
+
+
+def merge_with_inverse(inv: np.ndarray, values: np.ndarray,
+                       num_uniq: int) -> np.ndarray:
+    """The MergeAdd segment-sum given a PRECOMPUTED inverse index
+    (`merge_rows` = unique + this): out[u] = sum of values whose inv
+    is u. Callers that already dedup'd their keys (the embedding
+    engine's push path) skip the redundant O(n log n) re-sort."""
+    values = np.asarray(values)
+    if values.ndim == 2 and values.shape[1] <= 256 and \
+            np.issubdtype(values.dtype, np.floating):
+        # segment-sum via per-column bincount: ~3x faster than
+        # np.add.at on embedding-push shapes ([8k, 8..64])
+        out = np.empty((num_uniq, values.shape[1]), values.dtype)
+        for d in range(values.shape[1]):
+            out[:, d] = np.bincount(inv, weights=values[:, d],
+                                    minlength=num_uniq)
+        return out
+    out = np.zeros((num_uniq,) + values.shape[1:], values.dtype)
+    np.add.at(out, inv, values)
+    return out
 
 
 def add_n(inputs):
@@ -115,7 +160,9 @@ def adam_sparse(param, grad: SelectedRows, moment1, moment2, lr,
     g = grad.merge_rows()
     rows = g.rows._data
     gv = g.values._data.astype(jnp.float32)
-    ok = (rows >= 0)
+    # merge_rows pads with the out-of-range sentinel `height` under
+    # jit (and compacts eagerly); mask both that and any negative id
+    ok = (rows >= 0) & (rows < p.shape[0])
     rws = jnp.clip(rows, 0, p.shape[0] - 1)
     m1r = m1[rws]
     m2r = m2[rws]
@@ -126,8 +173,13 @@ def adam_sparse(param, grad: SelectedRows, moment1, moment2, lr,
     upd = lr * mhat / (jnp.sqrt(vhat) + epsilon)
     okf = ok.reshape(-1, *([1] * (gv.ndim - 1))).astype(jnp.float32)
     new_p = p.at[rws].add((-upd * okf).astype(p.dtype))
-    new_m1 = m1.at[rws].set(jnp.where(okf > 0, nm1, m1r))
-    new_m2 = m2.at[rws].set(jnp.where(okf > 0, nm2, m2r))
+    # scatter-ADD masked deltas for the moments too: the clipped
+    # padding rows alias a real row index, and a scatter-SET with
+    # duplicate indices picks an arbitrary winner (the real update
+    # could lose to the padding's old-value write); adds of zero are
+    # order-independent
+    new_m1 = m1.at[rws].add(((nm1 - m1r) * okf).astype(m1.dtype))
+    new_m2 = m2.at[rws].add(((nm2 - m2r) * okf).astype(m2.dtype))
     return Tensor(new_p), Tensor(new_m1), Tensor(new_m2)
 
 

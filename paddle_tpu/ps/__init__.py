@@ -19,3 +19,5 @@ from .data_generator import (DataGenerator,  # noqa: F401
 from .coordinator import (Coordinator, FLClient,  # noqa: F401
                           ClientSelector, CapacityClientSelector,
                           FLStrategy)
+from .heter import (ShardedSparseTable, HotIdCache,  # noqa: F401
+                    HeterEmbeddingEngine, LookupService)

@@ -23,16 +23,30 @@ from .table import MemorySparseTable
 
 
 class SparseEmbedding(Layer):
+    """`engine` (default None = direct-table parity path) switches the
+    layer onto a `ps.heter.HeterEmbeddingEngine`: pulls ride the
+    sharded/cached/pipelined path, pushes are dedup-merged — the leaf
+    grad-hook contract below is unchanged either way."""
+
     def __init__(self, dim=8, sgd_rule="adagrad", learning_rate=0.05,
                  initial_range=0.02, table=None, communicator=None,
-                 name=None):
+                 engine=None, name=None):
         super().__init__()
         self.dim = dim
+        self.engine = engine
+        if engine is not None:
+            if table is not None and table is not engine.table:
+                raise ValueError(
+                    "pass either table= or engine=, not both")
+            table = engine.table
         self.table = table if table is not None else MemorySparseTable(
             dim, sgd_rule, learning_rate, initial_range)
         # a_sync mode: pushes go through the background communicator
         self.communicator = communicator
         if communicator is not None:
+            if engine is not None:
+                raise ValueError(
+                    "communicator and engine are exclusive push paths")
             communicator.start()
 
     def forward(self, keys):
@@ -42,7 +56,13 @@ class SparseEmbedding(Layer):
         keys_np = keys.numpy() if isinstance(keys, Tensor) \
             else np.asarray(keys)
         keys_np = keys_np.astype(np.uint64)
-        values = self.table.pull(keys_np)
+        if self.engine is not None:
+            # eval pulls are side traffic: they must not consume (or
+            # retire) a prefetch the training loop has in flight
+            values = self.engine.pull(keys_np, train=self.training,
+                                      use_prefetch=self.training)
+        else:
+            values = self.table.pull(keys_np)
         t = Tensor(values, stop_gradient=not self.training)
         if self.training:
             table = self.table
@@ -52,18 +72,32 @@ class SparseEmbedding(Layer):
             state = {"pushed": None}
 
             comm = self.communicator
+            eng = self.engine
 
             def push_hook(grad, _keys=keys_np, _table=table, _s=state,
-                          _comm=comm):
+                          _comm=comm, _eng=eng):
                 g = grad.numpy()
                 delta = g if _s["pushed"] is None else g - _s["pushed"]
                 _s["pushed"] = g.copy()
-                if _comm is not None:
+                if _eng is not None:
+                    _eng.push(_keys, delta)
+                elif _comm is not None:
                     _comm.push_sparse(_table, _keys, delta)
                 else:
                     _table.push(_keys, delta)
             t.register_hook(push_hook)
         return t
 
+    def flush(self):
+        """Drain the async push paths (engine pipeline / communicator)
+        — the barrier before save/eval."""
+        if self.engine is not None:
+            self.engine.flush()
+        if self.communicator is not None:
+            self.communicator.flush()
+
     def state(self):
-        return {"size": len(self.table)}
+        s = {"size": len(self.table)}
+        if self.engine is not None:
+            s["engine"] = self.engine.state()
+        return s

@@ -1,0 +1,22 @@
+"""paddle_tpu.ps.heter — HeterPS-style sharded embedding engine.
+
+The recommender-scale path between the native PS tables and the TPU
+step (ROADMAP item 4, `fleet/heter_ps/` + `ps_gpu_wrapper.h` parity):
+
+* `ShardedSparseTable` — one logical table key-hash-partitioned over N
+  native `MemorySparseTable` shards with parallel pull/push fan-out.
+* `HotIdCache` — fixed-capacity dense row cache with refcounted pins,
+  LRU/frequency eviction and dirty-row write-back.
+* `HeterEmbeddingEngine` — per-batch dedup, background prefetch with
+  strict-mode repair, merged gradient push (strict = coherent/parity,
+  stream = online training with a bounded staleness window).
+* `LookupService` — read-only inference lookups through the same cache.
+
+`SparseEmbedding(engine=...)` switches the layer onto the engine while
+keeping the leaf-hook autograd contract (docs/EMBEDDING.md).
+"""
+from .sharded import ShardedSparseTable, splitmix64  # noqa: F401
+from .cache import HotIdCache  # noqa: F401
+from .engine import HeterEmbeddingEngine  # noqa: F401
+from .service import LookupService  # noqa: F401
+from . import metrics  # noqa: F401

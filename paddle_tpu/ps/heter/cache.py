@@ -1,0 +1,378 @@
+"""Hot-ID cache: a fixed-capacity dense row cache in front of the shards.
+
+The HeterPS idea (`fleet/heter_ps/`, PAPER.md): the hot head of the key
+distribution lives accelerator-adjacent in a dense `[capacity, width]`
+buffer; reads hit the cache, misses fall through to the sharded tables.
+The ledger adapts the refcount/LRU machinery proven in
+`serving/prefix_cache.py` / `kv_cache.BlockAllocator`, but every ledger
+is a flat numpy array (stamps, frequencies, pins, dirty flags) so batch
+operations stay vectorized — a 2k-key batch costs a few array ops, not
+2k heap pushes:
+
+* **Rows are the unit of ownership.** A LIFO free list hands rows out;
+  `len(free) + len(index) == capacity` is the ledger invariant the
+  soak test asserts after every random op (the allocator's
+  `allocated + free == pool` in cache clothing).
+* **Pins** are refcounts held by in-flight steps: a pulled batch pins
+  the rows backing its keys until its gradient push lands (or the
+  engine flushes), so eviction can never reuse a row mid-step. Pinned
+  rows are skipped by the evictor — if everything is pinned the caller
+  falls through to the shards without caching (bypass), which is
+  always correct.
+* **Eviction is batched LRU with a frequency second chance**: victims
+  are the lowest-stamp unpinned rows (one `argpartition` per admission
+  wave); a victim whose id accumulated >= 2 hits since admission gets
+  its frequency halved and its recency refreshed once instead of dying
+  — hot ids survive bursts of cold ones.
+* **Dirty rows carry pending gradient deltas** (streaming mode): the
+  delta accumulates in a parallel `[capacity, width]` buffer and is
+  ALWAYS written back through the `writeback` callback before the row
+  is reused or dropped — eviction cannot lose an update.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class HotIdCache:
+    """Fixed-capacity dense row cache with a hash-map index."""
+
+    def __init__(self, capacity, width, writeback=None):
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity} must be >= 1")
+        self.capacity = int(capacity)
+        self.width = int(width)
+        self.values = np.zeros((self.capacity, self.width), np.float32)
+        self.dirty = np.zeros((self.capacity, self.width), np.float32)
+        self.writeback = writeback        # fn(keys_u64 [n], deltas [n,w])
+        self._index = {}                  # key (int) -> row
+        self._rowkey = {}                 # row -> key
+        # the hot lookup path is a SORTED key array + aligned rows so a
+        # whole batch resolves in one vectorized searchsorted; the
+        # dicts above stay authoritative and are only walked on
+        # admission/eviction (a few hundred keys, not every lookup)
+        self._skeys = np.empty(0, np.uint64)
+        self._srows = np.empty(0, np.int64)
+        self._free = list(range(self.capacity - 1, -1, -1))   # LIFO
+        self._used = np.zeros(self.capacity, bool)
+        self._pin = np.zeros(self.capacity, np.int32)
+        self._stamp = np.zeros(self.capacity, np.int64)
+        self._freq = np.zeros(self.capacity, np.int64)
+        self._dirtymask = np.zeros(self.capacity, bool)
+        self._birth = np.zeros(self.capacity, np.int64)
+        self._tick = 0                    # bumped once per batch op
+        # raw counters (always on; the engine mirrors deltas into the
+        # metrics registry under the one-branch discipline)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.writebacks = 0
+
+    # -------------------------------------------------------------- state
+    @property
+    def num_rows(self):
+        return len(self._index)
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_dirty(self):
+        return int(self._dirtymask.sum())
+
+    @property
+    def num_pinned(self):
+        return int((self._pin > 0).sum())
+
+    @property
+    def invariant_ok(self):
+        """allocated + free == capacity with no overlap, a consistent
+        key<->row mapping, and pins/dirt only on allocated rows."""
+        rows = set(self._rowkey)
+        free = set(self._free)
+        used = set(np.nonzero(self._used)[0].tolist())
+        return (len(self._index) == len(self._rowkey)
+                and all(self._index[k] in self._rowkey
+                        and self._rowkey[self._index[k]] == k
+                        for k in self._index)
+                and self._skeys.size == len(self._index)
+                and (self._skeys[:-1] < self._skeys[1:]).all()
+                and all(self._index.get(int(k)) == int(r)
+                        for k, r in zip(self._skeys, self._srows))
+                and rows == used
+                and not (rows & free)
+                and len(self._free) == len(free)
+                and len(rows) + len(free) == self.capacity
+                and not (self._pin > 0)[~self._used].any()
+                and not self._dirtymask[~self._used].any())
+
+    def hit_ratio(self):
+        t = self.hits + self.misses
+        return self.hits / t if t else 0.0
+
+    # ------------------------------------------------------------- lookup
+    def lookup(self, keys, count=True) -> np.ndarray:
+        """-> int64 rows, -1 per miss. Touches LRU recency + hit
+        frequency for hits; `count=False` skips all accounting
+        (internal coherence reads, e.g. the push-side refresh)."""
+        ks = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                  np.uint64)
+        n = self._skeys.size
+        if n == 0:
+            rows = np.full(ks.size, -1, np.int64)
+        else:
+            pos = np.minimum(np.searchsorted(self._skeys, ks), n - 1)
+            rows = np.where(self._skeys[pos] == ks,
+                            self._srows[pos], -1)
+        if count:
+            hit = rows[rows >= 0]
+            self.hits += hit.size
+            self.misses += rows.size - hit.size
+            if hit.size:
+                self._tick += 1
+                self._stamp[hit] = self._tick
+                self._freq[hit] += 1
+        return rows
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        return self.values[rows]
+
+    def _sorted_insert(self, new_keys, new_rows):
+        nk = np.asarray(new_keys, np.uint64)
+        nr = np.asarray(new_rows, np.int64)
+        order = np.argsort(nk, kind="stable")
+        nk, nr = nk[order], nr[order]
+        pos = np.searchsorted(self._skeys, nk)
+        self._skeys = np.insert(self._skeys, pos, nk)
+        self._srows = np.insert(self._srows, pos, nr)
+
+    def _sorted_delete(self, dead_keys):
+        dk = np.sort(np.asarray(dead_keys, np.uint64))
+        pos = np.searchsorted(self._skeys, dk)
+        self._skeys = np.delete(self._skeys, pos)
+        self._srows = np.delete(self._srows, pos)
+
+    # ---------------------------------------------------------- admission
+    def _evict_batch(self, want):
+        """Reclaim up to `want` unpinned rows into the free list
+        (batched LRU + one frequency second chance per victim set).
+        Returns the number reclaimed; dirty victims are written back
+        FIRST — never dropped."""
+        reclaimed = 0
+        for _ in range(2):            # pass 2 re-selects after chances
+            need = want - reclaimed
+            if need <= 0:
+                break
+            cand = np.nonzero(self._used & (self._pin == 0))[0]
+            if cand.size == 0:
+                break
+            stamps = self._stamp[cand]
+            if cand.size > need:
+                part = np.argpartition(stamps, need - 1)[:need]
+                part = part[np.argsort(stamps[part], kind="stable")]
+                victims = cand[part]
+            else:
+                victims = cand[np.argsort(stamps, kind="stable")]
+            hot = self._freq[victims] >= 2
+            spare = victims[hot]
+            if spare.size:
+                # hot ids: one second chance instead of death
+                self._freq[spare] //= 2
+                self._tick += 1
+                self._stamp[spare] = self._tick
+            victims = victims[~hot]
+            if victims.size:
+                self._reclaim(victims)
+                reclaimed += victims.size
+        if reclaimed < want:
+            # everything left is hot (already spent its chance) —
+            # force-evict coldest regardless of frequency
+            cand = np.nonzero(self._used & (self._pin == 0))[0]
+            need = want - reclaimed
+            if cand.size:
+                stamps = self._stamp[cand]
+                take = min(need, cand.size)
+                part = np.argpartition(stamps, take - 1)[:take] \
+                    if cand.size > take else np.arange(cand.size)
+                self._reclaim(cand[part])
+                reclaimed += take
+        return reclaimed
+
+    def _reclaim(self, rows):
+        """Write back + unmap a batch of resident, unpinned rows. The
+        dirty deltas are captured while the key mapping is still live
+        but DELIVERED after the unmap, so the engine's writeback skips
+        its freshness re-pull for rows that no longer exist."""
+        rows = np.asarray(rows, np.int64)
+        wb = self.take_dirty(rows)
+        dead = []
+        for r in rows.tolist():
+            key = self._rowkey.pop(r)
+            del self._index[key]
+            dead.append(key)
+        self._sorted_delete(dead)
+        self._used[rows] = False
+        self._stamp[rows] = 0
+        self._freq[rows] = 0
+        if wb is not None:
+            # delivered BEFORE the rows re-enter the free list: an
+            # eviction can never lose (or reorder past reuse) a delta
+            if self.writeback is not None:
+                self.writeback(*wb)
+            self.writebacks += int(wb[0].size)
+        self._free.extend(rows.tolist())
+        self.evictions += rows.size
+
+    def admit(self, keys, values, step=0) -> np.ndarray:
+        """Install rows for `keys` (absent ones only), evicting as
+        needed. -> int64 rows, -1 where the key could not be admitted
+        (cache saturated with pinned rows — the caller serves the
+        value straight from the shards)."""
+        keys = np.asarray(keys)
+        values = np.asarray(values)
+        rows = self.lookup(keys, count=False)
+        have = rows >= 0
+        if have.any():
+            # refresh already-resident keys NOW, while this mapping is
+            # still valid — the eviction below may reassign these very
+            # rows to fresh keys, and a late write-through would then
+            # plant one key's values under another key's row
+            self.values[rows[have]] = values[have]
+        fresh = np.nonzero(~have)[0]
+        if fresh.size:
+            shortfall = fresh.size - len(self._free)
+            if shortfall > 0:
+                self._evict_batch(shortfall)
+            self._tick += 1
+            added_k, added_r = [], []
+            for i in fresh.tolist():
+                k = int(keys.reshape(-1)[i])
+                row = self._index.get(k, -1)   # dup key within call
+                if row < 0:
+                    if not self._free:
+                        rows[i] = -1
+                        continue
+                    row = self._free.pop()
+                    self._index[k] = row
+                    self._rowkey[row] = k
+                    self._used[row] = True
+                    self._freq[row] = 0
+                    self._stamp[row] = self._tick
+                    added_k.append(k)
+                    added_r.append(row)
+                rows[i] = row
+            if added_k:
+                self._sorted_insert(added_k, added_r)
+            got = rows[fresh]
+            ok = got >= 0
+            if ok.any():
+                self.values[got[ok]] = values[fresh[ok]]
+        return rows
+
+    # ----------------------------------------------------------- pinning
+    def pin(self, rows):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        if rows.size == 0:
+            return
+        if not self._used[rows].all():
+            raise ValueError("pin of unallocated row")
+        np.add.at(self._pin, rows, 1)
+
+    def unpin(self, rows):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        if rows.size == 0:
+            return
+        pins = self._pin.copy()
+        np.subtract.at(pins, rows, 1)
+        if (pins[rows] < 0).any():
+            raise ValueError("unpin of unpinned row")
+        self._pin = pins
+
+    # ------------------------------------------------------ dirty ledger
+    def set_values(self, rows: np.ndarray, values: np.ndarray):
+        """Coherence refresh (strict mode: fresh table values after a
+        push)."""
+        self.values[rows] = values
+
+    def add_delta(self, rows: np.ndarray, deltas: np.ndarray, step=0,
+                  unique_rows=False):
+        """Accumulate pending gradient deltas (streaming mode).
+        `unique_rows=True` (rows already dedup'd, the engine's merged
+        push) takes the vectorized fancy-index path instead of
+        np.add.at."""
+        rows = np.asarray(rows, np.int64)
+        if unique_rows:
+            self.dirty[rows] += deltas
+        else:
+            np.add.at(self.dirty, rows, deltas)
+        newly = rows[~self._dirtymask[rows]]
+        self._dirtymask[rows] = True
+        self._birth[newly] = step
+        return rows
+
+    def stale_rows(self, before_step):
+        """Dirty rows whose first pending delta is older than
+        `before_step` (the engine's staleness bound)."""
+        return np.nonzero(self._dirtymask
+                          & (self._birth < before_step))[0]
+
+    def take_dirty(self, rows):
+        """Extract (keys, deltas) for the dirty subset of `rows`,
+        clearing their dirty state WITHOUT invoking the writeback
+        callback — the caller delivers the deltas (e.g. through a
+        background push lane). None when nothing is dirty."""
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        todo = rows[self._dirtymask[rows]] if rows.size else rows
+        todo = np.unique(todo)
+        if todo.size == 0:
+            return None
+        keys = np.asarray([self._rowkey[int(r)] for r in todo],
+                          np.uint64)
+        deltas = self.dirty[todo].copy()
+        # clear BEFORE handing out: a re-entrant add_delta during the
+        # delivery must open a fresh delta, not re-dirty this one
+        self.dirty[todo] = 0.0
+        self._dirtymask[todo] = False
+        return keys, deltas
+
+    def flush_rows(self, rows):
+        """Write back the pending deltas of `rows` (dirty ones only)
+        through the writeback callback; clears their dirty state.
+        Returns the number of rows written back."""
+        wb = self.take_dirty(rows)
+        if wb is None:
+            return 0
+        if self.writeback is not None:
+            self.writeback(*wb)
+        self.writebacks += int(wb[0].size)
+        return int(wb[0].size)
+
+    def flush_all(self):
+        return self.flush_rows(np.nonzero(self._dirtymask)[0])
+
+    # ------------------------------------------------------------- admin
+    def drop(self, keys):
+        """Invalidate keys (writes back dirty state first)."""
+        rows = np.fromiter(
+            (self._index.get(int(k), -1) for k in keys), np.int64,
+            count=len(keys))
+        rows = rows[rows >= 0]
+        rows = rows[self._pin[rows] == 0]
+        if rows.size:
+            self._reclaim(np.unique(rows))
+
+    def clear(self):
+        self.flush_all()
+        if self.num_pinned:
+            raise RuntimeError(
+                f"clear() with {self.num_pinned} pinned rows")
+        self._index.clear()
+        self._rowkey.clear()
+        self._skeys = np.empty(0, np.uint64)
+        self._srows = np.empty(0, np.int64)
+        self._used[:] = False
+        self._stamp[:] = 0
+        self._freq[:] = 0
+        self._dirtymask[:] = False
+        self._free = list(range(self.capacity - 1, -1, -1))

@@ -1,0 +1,521 @@
+"""HeterPS-style embedding engine: dedup -> hot-ID cache -> shards.
+
+The pull/push cycle of `fleet/ps_gpu_wrapper.h` (PullSparse /
+PushSparseGrad) rebuilt between the native PS tables and the TPU step:
+
+* **Per-batch key dedup.** A batch's `[batch, slots, per_slot]` keys
+  collapse to unique ids (`np.unique` + inverse index); the cache and
+  the shards see each id once, and the dense `[*, dim]` activation is
+  an inverse-index gather. The gradient push walks the same inverse
+  index through `ops/selected_rows.py` merge, so duplicate keys are
+  combined ONCE before any table sees them (the reference's merge_add).
+* **Hot-ID cache** (`cache.py`): reads hit the dense row cache, misses
+  fall through to the shards and are admitted (LRU + frequency
+  eviction, refcounted pins while a step is in flight).
+* **Async prefetch pipeline.** `prefetch(next_keys)` resolves batch
+  N+1's unique ids on a background thread while the jitted dense step
+  runs batch N (double-buffered: one pending prefetch). Strict mode
+  repairs the prefetched block at consume time: any id pushed between
+  the prefetch snapshot and the consuming pull is re-read so the
+  pipelined schedule stays NUMERICALLY IDENTICAL to the sequential
+  pull -> step -> push order.
+* **Two push modes.**
+  - ``strict`` (default): push applies the merged gradients to the
+    shards synchronously and refreshes the cached rows from the table,
+    so the cache is always coherent — bit-identical to the direct
+    `MemorySparseTable` path (the engine-on parity contract).
+  - ``stream``: online training. Resident ids accumulate their deltas
+    in the cache's dirty buffer and are written back when evicted,
+    when older than ``staleness_bound`` steps, or on `flush()`;
+    non-resident ids ride a bounded background push queue. Reads may
+    be up to the staleness bound behind — the reference
+    AsyncCommunicator's async-SGD window.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...profiler import metrics as _pm
+from . import metrics as _m
+from .cache import HotIdCache
+
+
+def _merge_grads(uniq_size, inv, grads_2d):
+    """Combine duplicate-key gradients through the SelectedRows
+    MergeAdd kernel (`ops/selected_rows.py`): segment i sums every
+    occurrence of unique key i. The inverse index comes from the
+    pull-side dedup, so the merge skips the redundant re-sort."""
+    from ...ops.selected_rows import merge_with_inverse
+    return merge_with_inverse(inv, grads_2d.astype(np.float32,
+                                                   copy=False),
+                              uniq_size)
+
+
+class HeterEmbeddingEngine:
+    """Sharded + cached + pipelined embedding engine.
+
+    `table` is anything with the `MemorySparseTable` pull/push surface
+    — one native table or a `ShardedSparseTable` fan-out."""
+
+    def __init__(self, table, cache_capacity=4096, mode="strict",
+                 staleness_bound=4, prefetch=True):
+        if mode not in ("strict", "stream"):
+            raise ValueError(f"mode={mode!r} not in ('strict','stream')")
+        if getattr(table, "row_width", None) is not None and \
+                table.row_width != table.dim:
+            raise ValueError(
+                "engine requires row_width == dim tables (dymf rows "
+                "are variable-width; pull them directly)")
+        self.table = table
+        self.dim = table.dim
+        self.mode = mode
+        self.staleness_bound = int(staleness_bound)
+        self._lock = threading.RLock()
+        self.cache = HotIdCache(cache_capacity, self.dim,
+                                writeback=self._writeback)
+        self._step = 0                 # pull clock (staleness ages)
+        self._dedup_memo = {}          # raw-key bytes -> (uniq, inv)
+        self._dedup_order = deque()
+        self._push_version = 0         # strict-mode repair clock
+        self._pushed_sets = deque()    # (version, frozenset)
+        self._pushed_floor = 0         # versions <= floor were dropped
+        self._open_steps = deque()     # {sig, uniq, rows} pinned pulls
+        # one pending prefetch (double buffering)
+        self._pf_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="emb-prefetch") \
+            if prefetch else None
+        self._pf_pending = None
+        # stream mode: bounded background push lane for non-resident ids
+        self._push_q = None
+        self._push_thread = None
+        self._push_errors = []
+        self._push_inflight = 0
+        self._push_cv = threading.Condition()
+        if mode == "stream":
+            self._push_q = queue.Queue(maxsize=max(1, staleness_bound))
+            self._push_thread = threading.Thread(
+                target=self._push_loop, daemon=True)
+            self._push_thread.start()
+        # raw counters (bench/tests read these without the registry)
+        self.raw_keys = 0
+        self.uniq_keys = 0
+        self.prefetch_hits = 0
+        self.prefetch_repairs = 0
+        self.prefetch_unused = 0
+
+    # ======================================================== pull side
+    def pull(self, keys, train=False, use_prefetch=True):
+        """keys: int/uint array [batch, slots, per_slot] (any shape)
+        -> float32 [*, dim]. `train=True` pins the backing cache rows
+        until the matching `push` lands. `use_prefetch=False` bypasses
+        the prefetch buffer entirely (read-only side traffic, e.g.
+        LookupService — a mismatching side pull must not retire the
+        trainer's pending prefetch)."""
+        t0 = time.perf_counter()
+        keys = np.asarray(keys)
+        shape = keys.shape
+        flat = np.ascontiguousarray(keys.reshape(-1), np.uint64)
+        got = self._consume_prefetch(flat) if use_prefetch else None
+        if got is None:
+            uniq, inv = np.unique(flat, return_inverse=True)
+            vals, rows = self._resolve(uniq, pin=train)
+        else:
+            # the prefetch worker already dedup'd and resolved; the
+            # critical path is one raw-key compare + the final gather
+            uniq, inv, vals, rows = got
+            if train:
+                # re-derive the row mapping under the lock: the
+                # prefetch thread may have evicted/remapped rows since
+                # the background resolve (the values were copied then)
+                with self._lock:
+                    rows = self.cache.lookup(uniq, count=False)
+                    self.cache.pin(rows[rows >= 0])
+        self.raw_keys += flat.size
+        self.uniq_keys += uniq.size
+        # remember the dedup so the matching push (possibly several
+        # batches later on a drain thread) skips its re-sort. A
+        # repeated key set only refreshes its entry — the order deque
+        # holds each key once, so trimming by ITS length is exact
+        # insertion-order LRU and nothing grows unboundedly.
+        b = flat.tobytes()
+        if b not in self._dedup_memo:
+            self._dedup_order.append(b)
+            if len(self._dedup_order) > 16:
+                self._dedup_memo.pop(self._dedup_order.popleft(), None)
+        self._dedup_memo[b] = (uniq, inv)
+        if train:
+            with self._lock:       # _close_step may scan from a
+                self._open_steps.append(   # drain thread
+                    {"sig": uniq.tobytes(), "uniq": uniq,
+                     "rows": rows})
+        with self._lock:
+            self._step += 1
+            if self.mode == "stream":
+                stale = self.cache.stale_rows(
+                    self._step - self.staleness_bound)
+                if stale.size:
+                    # _writeback ships these through the BACKGROUND
+                    # push lane (put_nowait): a synchronous table
+                    # round trip here would stall the very critical
+                    # path stream mode exists to protect
+                    self.cache.flush_rows(stale)
+        if _pm._enabled:
+            _m.EMB_PULL_SECONDS.observe(time.perf_counter() - t0)
+            _m.EMB_DEDUP_KEYS.labels("raw").inc(int(flat.size))
+            _m.EMB_DEDUP_KEYS.labels("unique").inc(int(uniq.size))
+            _m.EMB_CACHE_ROWS.set(self.cache.num_rows)
+        return vals[inv].reshape(*shape, self.dim)
+
+    def _resolve(self, uniq, pin=False, count=True):
+        """Unique ids -> (values [U, dim], cache rows [U] or -1).
+        Cache hits gather; misses fan out to the shards and are
+        admitted (or bypassed when every row is pinned)."""
+        with self._lock:
+            rows = self.cache.lookup(uniq, count=count)
+            hit = rows >= 0
+            vals = np.empty((uniq.size, self.dim), np.float32)
+            if hit.any():
+                vals[hit] = self.cache.gather(rows[hit])
+        miss = ~hit
+        if miss.any():
+            pulled = self.table.pull(uniq[miss])     # outside the lock
+            with self._lock:
+                vals[miss] = pulled
+                rows[miss] = self.cache.admit(uniq[miss], pulled,
+                                              step=self._step)
+        if pin:
+            # re-derive rows at pin time: concurrent admissions may
+            # have evicted what the lookup above saw (values are
+            # copies, so only the pin bookkeeping needs freshness)
+            with self._lock:
+                rows = self.cache.lookup(uniq, count=False)
+                self.cache.pin(rows[rows >= 0])
+        if _pm._enabled and count:
+            nh = int(hit.sum())
+            _m.EMB_CACHE_LOOKUPS.labels("hit").inc(nh)
+            _m.EMB_CACHE_LOOKUPS.labels("miss").inc(
+                int(uniq.size) - nh)
+        return vals, rows
+
+    # ---------------------------------------------------------- prefetch
+    def prefetch(self, keys):
+        """Resolve the NEXT batch's unique ids on the background thread
+        while the current dense step runs. One prefetch may be pending
+        (double buffering); an unconsumed older one is retired (its
+        stale admissions repaired) first."""
+        if self._pf_pool is None:
+            return
+        keys = np.asarray(keys)
+        flat = np.ascontiguousarray(keys.reshape(-1), np.uint64)
+        self._retire_prefetch()
+        self._pf_pending = {
+            "raw": flat,
+            "version": self._push_version,
+            "future": self._pf_pool.submit(self._pf_job, flat),
+        }
+
+    def _pf_job(self, flat):
+        """Background half of a prefetch: dedup + resolve (the main
+        thread pays only the raw-key signature compare at consume)."""
+        uniq, inv = np.unique(flat, return_inverse=True)
+        vals, rows = self._resolve(uniq, pin=False, count=True)
+        return uniq, inv, vals, rows
+
+    def _conflicts_since(self, version, uniq):
+        """Ids in `uniq` pushed after `version` (strict-mode repair
+        set). A snapshot older than the retained history conservatively
+        conflicts on every id."""
+        if version < self._pushed_floor:
+            return uniq.copy()
+        touched = [ks for v, ks in self._pushed_sets if v > version]
+        if not touched:
+            return np.empty(0, np.uint64)
+        return uniq[np.isin(uniq, np.concatenate(touched))]
+
+    def _repair(self, version, uniq, vals):
+        """Re-read every id of a prefetched block that was pushed after
+        the prefetch snapshot: patch the handed-out values AND the
+        cache rows the prefetch admitted, so the pipelined schedule is
+        indistinguishable from sequential pull-after-push."""
+        conf = self._conflicts_since(version, uniq)
+        if conf.size == 0:
+            return False
+        fresh = self.table.pull(conf)
+        pos = np.searchsorted(uniq, conf)
+        if vals is not None:
+            vals[pos] = fresh
+        with self._lock:
+            crow = self.cache.lookup(conf, count=False)
+            ok = crow >= 0
+            if ok.any():
+                self.cache.set_values(crow[ok], fresh[ok])
+        return True
+
+    def _consume_prefetch(self, flat):
+        """Take a pending prefetch if it matches the raw key array;
+        None otherwise. Strict mode repairs push conflicts either
+        way."""
+        pf = self._pf_pending
+        if pf is None:
+            return None
+        if pf["raw"].size != flat.size or \
+                not np.array_equal(pf["raw"], flat):
+            self._retire_prefetch()
+            return None
+        self._pf_pending = None
+        uniq, inv, vals, rows = pf["future"].result()
+        repaired = self.mode == "strict" and \
+            self._repair(pf["version"], uniq, vals)
+        if repaired:
+            self.prefetch_repairs += 1
+        else:
+            self.prefetch_hits += 1
+        if _pm._enabled:
+            _m.EMB_PREFETCH.labels(
+                "repair" if repaired else "hit").inc()
+        return uniq, inv, vals, rows
+
+    def _retire_prefetch(self):
+        """Drop an unconsumed prefetch, repairing any stale admissions
+        it made (strict mode) so the cache never serves pre-push
+        values."""
+        pf = self._pf_pending
+        if pf is None:
+            return
+        self._pf_pending = None
+        uniq, _, _, _ = pf["future"].result()
+        if self.mode == "strict":
+            self._repair(pf["version"], uniq, None)
+        self.prefetch_unused += 1
+        if _pm._enabled:
+            _m.EMB_PREFETCH.labels("unused").inc()
+
+    # ======================================================== push side
+    def push(self, keys, grads):
+        """Gradient push for a previous pull: dedup-merge duplicate
+        keys (SelectedRows), then strict-apply or stream-accumulate.
+        Matches and unpins the corresponding in-flight pull."""
+        t0 = time.perf_counter()
+        keys = np.asarray(keys)
+        flat = np.ascontiguousarray(keys.reshape(-1), np.uint64)
+        grads_2d = np.asarray(grads, np.float32).reshape(flat.size,
+                                                        self.dim)
+        memo = self._dedup_memo.get(flat.tobytes())
+        if memo is not None:
+            uniq, inv = memo         # the pull already dedup'd these
+        else:
+            uniq, inv = np.unique(flat, return_inverse=True)
+        merged = _merge_grads(uniq.size, inv, grads_2d)
+        if self.mode == "strict":
+            self._push_strict(uniq, merged)
+        else:
+            self._push_stream(uniq, merged)
+        self._close_step(uniq)
+        if _pm._enabled:
+            _m.EMB_PUSH_SECONDS.observe(time.perf_counter() - t0)
+        return uniq.size
+
+    def _refresh_resident(self, keys):
+        """Coherence refresh after a table write: re-read the fresh
+        values for every id of `keys` that is resident in the cache
+        (the re-lookup under the second lock matters — rows may have
+        been evicted/remapped during the unlocked table pull)."""
+        with self._lock:
+            rows = self.cache.lookup(keys, count=False)
+        resident = rows >= 0
+        if not resident.any():
+            return
+        fresh = self.table.pull(keys[resident])
+        with self._lock:
+            rr = self.cache.lookup(keys[resident], count=False)
+            ok = rr >= 0
+            if ok.any():
+                self.cache.set_values(rr[ok], fresh[ok])
+
+    def _push_strict(self, uniq, merged):
+        self.table.push(uniq, merged)
+        # the in-table SGD rule ran on push: resident ids must re-read
+        self._refresh_resident(uniq)
+        self._push_version += 1
+        if self._pf_pool is not None:
+            # repair history is only ever read by the prefetch paths
+            self._pushed_sets.append((self._push_version, uniq.copy()))
+            while len(self._pushed_sets) > 64:
+                # remember how far back the retained history reaches,
+                # so a repair against a dropped snapshot degrades to
+                # re-reading EVERYTHING instead of missing conflicts
+                self._pushed_floor = self._pushed_sets.popleft()[0]
+
+    def _push_stream(self, uniq, merged):
+        if self._push_errors:
+            raise self._push_errors.pop(0)
+        with self._lock:
+            rows = self.cache.lookup(uniq, count=False)
+            resident = rows >= 0
+            if resident.any():
+                self.cache.add_delta(rows[resident], merged[resident],
+                                     step=self._step,
+                                     unique_rows=True)
+        cold = ~resident
+        if cold.any():
+            # bounded queue: blocks when the push lane is
+            # staleness_bound batches behind (backpressure, not loss)
+            with self._push_cv:
+                self._push_inflight += 1
+            self._push_q.put((uniq[cold].copy(), merged[cold].copy()))
+
+    def _push_loop(self):
+        while True:
+            item = self._push_q.get()
+            if item is None:
+                return
+            try:
+                wb_keys, grads = item
+                self.table.push(wb_keys, grads)
+                # a key queued as COLD may have been admitted (from a
+                # pre-push table read) while it sat in the queue: the
+                # resident row would otherwise serve the stale value
+                # forever, not just for the staleness window
+                self._refresh_resident(wb_keys)
+            except Exception as e:  # noqa: BLE001 — surface on flush
+                self._push_errors.append(e)
+            finally:
+                with self._push_cv:
+                    self._push_inflight -= 1
+                    if self._push_inflight == 0:
+                        self._push_cv.notify_all()
+
+    def _writeback(self, wb_keys, deltas):
+        """Cache dirty-row write-back (eviction / staleness / flush):
+        apply the accumulated delta to the shards, then refresh any
+        still-resident row so reads converge to the table. Often
+        invoked UNDER the engine lock (evictions fire inside admit),
+        so in stream mode the table round trips ride the background
+        push lane when it has room — put_nowait, never a blocking put,
+        because the lane's worker needs this same lock for its
+        refreshes (a blocking put under the lock would deadlock)."""
+        if self._push_q is not None:
+            with self._push_cv:
+                self._push_inflight += 1
+            try:
+                self._push_q.put_nowait((wb_keys, deltas))
+            except queue.Full:
+                with self._push_cv:
+                    self._push_inflight -= 1
+                    if self._push_inflight == 0:
+                        self._push_cv.notify_all()
+            else:
+                if _pm._enabled:
+                    _m.EMB_CACHE_WRITEBACKS.inc(int(len(wb_keys)))
+                return
+        self.table.push(wb_keys, deltas)
+        # the sync path skips the freshness pull for evicted (now
+        # non-resident) keys automatically
+        self._refresh_resident(wb_keys)
+        if _pm._enabled:
+            _m.EMB_CACHE_WRITEBACKS.inc(int(len(wb_keys)))
+
+    def _close_step(self, uniq):
+        """Unpin the in-flight pull this push answers (FIFO by key
+        signature)."""
+        sig = uniq.tobytes()
+        with self._lock:           # pull() appends concurrently
+            for i, st in enumerate(self._open_steps):
+                if st["sig"] == sig:
+                    self.cache.unpin(st["rows"][st["rows"] >= 0])
+                    del self._open_steps[i]
+                    return
+        # push without a recorded pull (e.g. eval-mode pull or direct
+        # use): nothing pinned, nothing to do
+
+    # ========================================================== control
+    def flush(self):
+        """Barrier: retire the prefetch, drain the stream push lane,
+        write back every dirty row, release leftover pins. After
+        flush() the shards hold every update and the cache is clean."""
+        self._retire_prefetch()
+        with self._lock:
+            while self._open_steps:
+                st = self._open_steps.popleft()
+                self.cache.unpin(st["rows"][st["rows"] >= 0])
+        with self._lock:
+            # stream mode: these write-backs ENQUEUE on the push lane,
+            # so the drain below must come after
+            self.cache.flush_all()
+        if self.mode == "stream":
+            with self._push_cv:
+                done = self._push_cv.wait_for(
+                    lambda: self._push_inflight == 0
+                    or self._push_errors, timeout=60)
+            if not done:
+                raise TimeoutError("embedding push lane stalled")
+        if self._push_errors:
+            raise self._push_errors.pop(0)
+        if _pm._enabled:
+            self.metrics_sync()
+        return self
+
+    def close(self):
+        self.flush()
+        if self._push_q is not None:
+            self._push_q.put(None)
+            self._push_thread.join(timeout=10)
+            self._push_q = None
+        if self._pf_pool is not None:
+            self._pf_pool.shutdown(wait=True)
+            self._pf_pool = None
+
+    # ------------------------------------------------------------ stats
+    def hit_ratio(self):
+        return self.cache.hit_ratio()
+
+    def dedup_ratio(self):
+        return 1.0 - self.uniq_keys / self.raw_keys \
+            if self.raw_keys else 0.0
+
+    def state(self):
+        s = {"mode": self.mode,
+             "cache_rows": self.cache.num_rows,
+             "cache_capacity": self.cache.capacity,
+             "cache_hit_ratio": round(self.hit_ratio(), 4),
+             "dedup_ratio": round(self.dedup_ratio(), 4),
+             "evictions": self.cache.evictions,
+             "writebacks": self.cache.writebacks,
+             "prefetch": {"hits": self.prefetch_hits,
+                          "repairs": self.prefetch_repairs,
+                          "unused": self.prefetch_unused}}
+        try:
+            s["table_size"] = len(self.table)
+        except (NotImplementedError, TypeError):
+            pass          # RemoteSparseTable has no size query yet
+        sizes = getattr(self.table, "shard_sizes", None)
+        if sizes is not None:
+            s["shard_sizes"] = sizes()
+        return s
+
+    def metrics_sync(self):
+        """Mirror the cache-internal raw counters into the PR 1
+        registry (hot paths record incrementally when metrics are on;
+        evictions happen inside the cache, so they are mirrored as a
+        delta here and at flush())."""
+        delta = self.cache.evictions - getattr(
+            self, "_mirrored_evictions", 0)
+        if delta > 0:
+            _m.EMB_CACHE_EVICTIONS.inc(delta)
+        self._mirrored_evictions = self.cache.evictions
+        _m.EMB_CACHE_ROWS.set(self.cache.num_rows)
+        sizes = getattr(self.table, "shard_sizes", None)
+        if sizes is not None:
+            for s, n in enumerate(sizes()):
+                _m.EMB_SHARD_KEYS.labels(str(s)).set(n)
+        else:
+            try:
+                _m.EMB_SHARD_KEYS.labels("0").set(len(self.table))
+            except (NotImplementedError, TypeError):
+                _m.EMB_SHARD_KEYS.labels("0").set(0)

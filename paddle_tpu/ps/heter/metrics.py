@@ -1,0 +1,88 @@
+"""Embedding-engine metrics — registered in the framework-wide PR 1
+registry.
+
+Exported names are part of the observability contract
+(docs/EMBEDDING.md, tools/embedding_smoke.py greps them the same way
+tools/serving_smoke.py greps the serving names). Recording follows the
+hot-path discipline: the engine keeps raw python counters always on
+(cheap ints) and mirrors them into the registry only when
+`profiler.metrics._enabled` is set, so a training loop with
+observability off pays one branch per pull/push.
+"""
+from __future__ import annotations
+
+from ...profiler.metrics import REGISTRY, exponential_buckets
+
+# 10us .. ~2.6s in x4 steps: a cached pull is a numpy gather (~100us),
+# a cold sharded pull fans out to native tables, a spill-backed pull
+# can touch disk
+_LATENCY_BUCKETS = exponential_buckets(1e-5, 4.0, 9)
+
+EMB_PULL_SECONDS = REGISTRY.histogram(
+    "paddle_tpu_embedding_pull_seconds",
+    "Latency of one engine pull (dedup + cache gather + shard misses)",
+    buckets=_LATENCY_BUCKETS)
+EMB_PUSH_SECONDS = REGISTRY.histogram(
+    "paddle_tpu_embedding_push_seconds",
+    "Latency of one engine push (merge + shard fan-out + refresh)",
+    buckets=_LATENCY_BUCKETS)
+EMB_CACHE_LOOKUPS = REGISTRY.counter(
+    "paddle_tpu_embedding_cache_lookups_total",
+    "Hot-ID cache lookups by result", ("result",))   # hit|miss
+EMB_CACHE_EVICTIONS = REGISTRY.counter(
+    "paddle_tpu_embedding_cache_evictions_total",
+    "Cache rows reclaimed by LRU/frequency eviction")
+EMB_CACHE_WRITEBACKS = REGISTRY.counter(
+    "paddle_tpu_embedding_cache_writebacks_total",
+    "Dirty rows whose pending gradient delta was pushed to the shards")
+EMB_CACHE_ROWS = REGISTRY.gauge(
+    "paddle_tpu_embedding_cache_rows",
+    "Resident hot-ID cache rows")
+EMB_DEDUP_KEYS = REGISTRY.counter(
+    "paddle_tpu_embedding_dedup_keys_total",
+    "Lookup keys before/after per-batch dedup", ("kind",))  # raw|unique
+EMB_PREFETCH = REGISTRY.counter(
+    "paddle_tpu_embedding_prefetch_total",
+    "Prefetch consumption by outcome",
+    ("result",))   # hit|repair|unused
+EMB_SHARD_KEYS = REGISTRY.gauge(
+    "paddle_tpu_embedding_shard_keys",
+    "Features resident per table shard", ("shard",))
+EMB_LOOKUPS_SERVED = REGISTRY.counter(
+    "paddle_tpu_embedding_lookups_served_total",
+    "Read-only LookupService requests served")
+
+#: every name above, for the smoke-tool contract check
+CONTRACT_METRICS = (
+    "paddle_tpu_embedding_pull_seconds",
+    "paddle_tpu_embedding_push_seconds",
+    "paddle_tpu_embedding_cache_lookups_total",
+    "paddle_tpu_embedding_cache_evictions_total",
+    "paddle_tpu_embedding_cache_writebacks_total",
+    "paddle_tpu_embedding_cache_rows",
+    "paddle_tpu_embedding_dedup_keys_total",
+    "paddle_tpu_embedding_prefetch_total",
+    "paddle_tpu_embedding_shard_keys",
+    "paddle_tpu_embedding_lookups_served_total",
+)
+
+
+def cache_hit_ratio():
+    """hit / (hit + miss) from the registry — exported as a plain
+    function so dashboards and the smoke tool agree on the definition."""
+    ch = dict(EMB_CACHE_LOOKUPS.samples())
+    hit = ch.get(("hit",))
+    miss = ch.get(("miss",))
+    h = hit.value if hit else 0.0
+    t = h + (miss.value if miss else 0.0)
+    return h / t if t else 0.0
+
+
+def dedup_ratio():
+    """1 - unique/raw: the fraction of lookup traffic removed by
+    per-batch key dedup."""
+    ch = dict(EMB_DEDUP_KEYS.samples())
+    raw = ch.get(("raw",))
+    uniq = ch.get(("unique",))
+    r = raw.value if raw else 0.0
+    return 1.0 - (uniq.value if uniq else 0.0) / r if r else 0.0

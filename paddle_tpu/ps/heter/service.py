@@ -1,0 +1,43 @@
+"""Read-only lookup serving over the embedding engine.
+
+The inference half of the streaming story: an online-trained model
+serves user/item embedding lookups through the SAME hot-ID cache the
+trainer keeps warm (`fleet/heter_ps` serves its GPU tables to both the
+train and the predict pass). Lookups never push, never pin past the
+gather, and never mutate the SGD state — in ``stream`` mode they see
+at most the engine's staleness window; in ``strict`` mode they are
+exact table reads.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...profiler import metrics as _pm
+from . import metrics as _m
+
+
+class LookupService:
+    """`lookup(keys) -> [*, dim]` through the engine's cache."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.served = 0          # raw counter (requests)
+
+    def lookup(self, keys) -> np.ndarray:
+        """keys: any-shape id array -> float32 [*, dim]. Read-only:
+        misses are admitted to the shared cache (warming it for the
+        trainer too), but nothing is pushed or pinned — and the
+        trainer's pending prefetch is left untouched (side traffic
+        must not retire the pipeline's double buffer)."""
+        out = self.engine.pull(keys, train=False, use_prefetch=False)
+        self.served += 1
+        if _pm._enabled:
+            _m.EMB_LOOKUPS_SERVED.inc()
+        return out
+
+    def lookup_one(self, key) -> np.ndarray:
+        return self.lookup(np.asarray([key], np.uint64))[0]
+
+    def state(self):
+        return {"served": self.served,
+                "cache_hit_ratio": round(self.engine.hit_ratio(), 4)}

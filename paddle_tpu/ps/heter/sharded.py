@@ -1,0 +1,155 @@
+"""Sharded table manager: one logical sparse table over N shards.
+
+Parity: the HeterPS partitioned tables (`fleet/heter_ps/heter_ps_base.h`,
+`graph_gpu_ps_table.h` — keys are hash-partitioned over table shards and
+pull/push fan out per shard). Here every shard is a native
+`MemorySparseTable`, so one logical table can exceed any single shard's
+memory budget (each shard can spill independently via
+`enable_spill`), and the per-shard ctypes calls release the GIL, so the
+fan-out threads give real parallelism on the host.
+
+Routing is `splitmix64(key) % num_shards`: raw CTR signs are slot-
+prefixed (`slot * 100000 + sign`), so an unmixed modulo would send whole
+slots to one shard.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..table import MemorySparseTable
+from ...profiler import metrics as _pm
+from . import metrics as _m
+
+
+def splitmix64(keys: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 keys."""
+    z = keys.astype(np.uint64, copy=True)
+    z ^= z >> np.uint64(30)
+    z *= np.uint64(0xBF58476D1CE4E5B9)
+    z ^= z >> np.uint64(27)
+    z *= np.uint64(0x94D049BB133111EB)
+    z ^= z >> np.uint64(31)
+    return z
+
+
+class ShardedSparseTable:
+    """Key-hash-partitioned logical table, duck-compatible with
+    `MemorySparseTable` (pull/push/__len__/save/load/row_width), so it
+    drops into `SparseEmbedding(table=...)` even without the engine."""
+
+    def __init__(self, num_shards=2, dim=8, sgd_rule="adagrad",
+                 learning_rate=0.05, initial_range=0.02, accessor="ctr",
+                 table_factory=None, parallel=True):
+        if num_shards < 1:
+            raise ValueError(f"num_shards={num_shards} must be >= 1")
+        self.num_shards = int(num_shards)
+        self.dim = dim
+        if table_factory is None:
+            def table_factory():
+                return MemorySparseTable(dim, sgd_rule, learning_rate,
+                                         initial_range, accessor)
+        self.shards = [table_factory() for _ in range(self.num_shards)]
+        self.accessor = self.shards[0].accessor
+        # the executor exists only for num_shards > 1; ctypes releases
+        # the GIL inside the native calls, so the fan-out is parallel
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.num_shards,
+            thread_name_prefix="ps-shard") \
+            if parallel and self.num_shards > 1 else None
+
+    # ------------------------------------------------------------ routing
+    def route(self, flat_keys: np.ndarray) -> np.ndarray:
+        """Shard id per key."""
+        return (splitmix64(flat_keys)
+                % np.uint64(self.num_shards)).astype(np.int64)
+
+    def _partition(self, flat_keys):
+        """-> list of index arrays, one per shard (empty allowed)."""
+        sid = self.route(flat_keys)
+        return [np.nonzero(sid == s)[0] for s in range(self.num_shards)]
+
+    def _fan_out(self, jobs):
+        """jobs: list of (callable, args) per shard; runs them in
+        parallel when the pool exists. Returns results in shard order."""
+        if self._pool is None:
+            return [fn(*args) for fn, args in jobs]
+        futs = [self._pool.submit(fn, *args) for fn, args in jobs]
+        return [f.result() for f in futs]
+
+    # ---------------------------------------------------------- pull/push
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """keys: uint64 (any shape) -> float32 [*, row_width], exactly
+        like `MemorySparseTable.pull` but fanned out per shard."""
+        shape = keys.shape
+        flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        parts = self._partition(flat)
+        out = np.empty((flat.size, self.row_width), np.float32)
+        jobs, targets = [], []
+        for s, idx in enumerate(parts):
+            if idx.size:
+                jobs.append((self.shards[s].pull, (flat[idx],)))
+                targets.append(idx)
+        for idx, res in zip(targets, self._fan_out(jobs)):
+            out[idx] = res
+        return out.reshape(*shape, self.row_width)
+
+    def push(self, keys: np.ndarray, grads: np.ndarray, shows=None,
+             clicks=None):
+        flat = np.ascontiguousarray(keys.reshape(-1), dtype=np.uint64)
+        g = np.ascontiguousarray(
+            grads.reshape(flat.size, self.row_width), np.float32)
+        sp = None if shows is None else \
+            np.asarray(shows, np.float32).reshape(-1)
+        cp = None if clicks is None else \
+            np.asarray(clicks, np.float32).reshape(-1)
+        jobs = []
+        for s, idx in enumerate(self._partition(flat)):
+            if idx.size:
+                jobs.append((self.shards[s].push,
+                             (flat[idx], g[idx],
+                              sp[idx] if sp is not None else None,
+                              cp[idx] if cp is not None else None)))
+        self._fan_out(jobs)
+        if _pm._enabled:
+            for s, t in enumerate(self.shards):
+                _m.EMB_SHARD_KEYS.labels(str(s)).set(len(t))
+
+    # ------------------------------------------------------------ budgets
+    def enable_spill(self, directory: str, max_mem_keys: int):
+        """Per-shard capacity budgets: the logical budget is divided
+        evenly; each shard spills its own overflow to disk."""
+        import os
+        per = max(1, int(max_mem_keys) // self.num_shards)
+        for s, t in enumerate(self.shards):
+            t.enable_spill(os.path.join(directory, f"shard{s}"), per)
+
+    # -------------------------------------------------------------- state
+    @property
+    def row_width(self):
+        return self.shards[0].row_width
+
+    def shard_sizes(self):
+        return [len(t) for t in self.shards]
+
+    def __len__(self):
+        return sum(self.shard_sizes())
+
+    def mem_size(self):
+        return sum(t.mem_size() for t in self.shards)
+
+    def spill_size(self):
+        return sum(t.spill_size() for t in self.shards)
+
+    def shrink(self, threshold=0.0, max_unseen_days=30):
+        return sum(t.shrink(threshold, max_unseen_days)
+                   for t in self.shards)
+
+    def save(self, path: str):
+        for s, t in enumerate(self.shards):
+            t.save(f"{path}.shard{s}")
+
+    def load(self, path: str):
+        for s, t in enumerate(self.shards):
+            t.load(f"{path}.shard{s}")

@@ -325,3 +325,92 @@ class TestServingFrontend:
                 await fe.submit([1, 2], max_new_tokens=2)
 
         asyncio.run(run())
+
+
+# ----------------------------------------------- multi-tenant soak (CI)
+
+
+_GEN_SCRIPT = r"""
+import json, random, sys
+seed, tenant, n, rate = (int(sys.argv[1]), sys.argv[2],
+                         int(sys.argv[3]), float(sys.argv[4]))
+rng = random.Random(seed)
+t, events = 0.0, []
+for i in range(n):
+    t += rng.expovariate(rate)          # Poisson arrivals
+    events.append({
+        "t": round(t, 4),
+        "tenant": tenant,
+        "prompt": [rng.randint(1, 192)
+                   for _ in range(rng.randint(2, 12))],
+        "max_new": rng.randint(2, 5),
+    })
+print(json.dumps(events))
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_poisson_multi_tenant_soak():
+    """ROADMAP follow-on: multi-process frontend stress as a CI
+    contract. Three load-generator PROCESSES each emit an independent
+    Poisson arrival schedule (exponential inter-arrival gaps); the
+    merged burst replays against one ServingFrontend in real time.
+    Every request must finish with its exact token budget, outputs
+    must stay parity-identical to solo generate() on a sample, no
+    tenant may be starved, and the engine must come out clean (no
+    resident slots, no leaked KV blocks)."""
+    import json
+    import subprocess
+    import sys
+
+    procs = [subprocess.run(
+        [sys.executable, "-c", _GEN_SCRIPT, str(100 + i), f"tenant{i}",
+         "20", "40.0"],
+        capture_output=True, text=True, timeout=60, check=True)
+        for i in range(3)]
+    events = sorted(
+        (e for p in procs for e in json.loads(p.stdout)),
+        key=lambda e: e["t"])
+    assert len(events) == 60
+    m = _model()
+
+    async def run():
+        eng = _engine(m, max_slots=3, num_blocks=40, max_seq_len=32,
+                      prefix_caching=True)
+        t0 = None
+
+        async def fire(ev, fe):
+            # replay the generator's arrival schedule in real time
+            delay = ev["t"] - (asyncio.get_event_loop().time() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            toks = await fe.submit(ev["prompt"],
+                                   max_new_tokens=ev["max_new"],
+                                   tenant=ev["tenant"])
+            return ev, toks
+
+        async with ServingFrontend(eng, max_pending=8) as fe:
+            t0 = asyncio.get_event_loop().time()
+            done = await asyncio.gather(
+                *[fire(ev, fe) for ev in events])
+        return done, eng
+
+    done, eng = asyncio.run(run())
+    assert len(done) == 60
+    by_tenant = {}
+    for ev, toks in done:
+        assert len(toks) == ev["max_new"], ev
+        by_tenant.setdefault(ev["tenant"], 0)
+        by_tenant[ev["tenant"]] += 1
+    assert by_tenant == {"tenant0": 20, "tenant1": 20, "tenant2": 20}
+    # parity spot-check on a sample of the soak traffic
+    rng = np.random.RandomState(0)
+    for ev, toks in [done[i] for i in
+                     rng.choice(len(done), 6, replace=False)]:
+        assert toks == _solo(m, ev["prompt"], ev["max_new"])
+    # the engine came out clean
+    assert eng.scheduler.num_active == 0
+    assert eng.kv.blocks_in_use == 0 or (
+        eng.prefix_cache is not None
+        and eng.prefix_cache.evict_all() >= 0
+        and eng.kv.blocks_in_use == 0)

@@ -1,0 +1,115 @@
+"""SelectedRows merge regressions (satellite of the embedding engine).
+
+`merge_rows` used `jnp.unique(..., size=n, fill_value=-1)`, which
+OverflowError'd on unsigned row dtypes and kept phantom padding rows
+with id -1 in the merged output — a table-push consumer would turn
+those into garbage uint64-max keys. The engine's push path routes
+every merged gradient through here, so these are contract tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import selected_rows as sr
+
+
+def _sr(rows, vals, height):
+    return sr.SelectedRows(Tensor(rows), Tensor(vals), height)
+
+
+class TestMergeRows:
+    def test_duplicate_keys_sum_once(self):
+        s = _sr(np.array([7, 7, 0, 2, 0, 0]),
+                np.arange(12.0).reshape(6, 2), 10)
+        m = s.merge_rows()
+        assert m.rows.numpy().tolist() == [0, 2, 7]
+        assert m.values.numpy().tolist() == [
+            [4.0 + 8.0 + 10.0, 5.0 + 9.0 + 11.0],   # row 0
+            [6.0, 7.0],                               # row 2
+            [0.0 + 2.0, 1.0 + 3.0]]                   # row 7
+
+    def test_padded_case_drops_padding_rows(self):
+        """Eager merges compact the jnp.unique padding entirely: no
+        sentinel id, no zero phantom rows."""
+        s = _sr(np.array([5, 5, 5, 5]), np.ones((4, 3)), 9)
+        m = s.merge_rows()
+        assert m.rows.numpy().tolist() == [5]
+        assert m.values.numpy().tolist() == [[4.0, 4.0, 4.0]]
+        assert m.shape == [9, 3]
+
+    def test_no_duplicates_identity(self):
+        s = _sr(np.array([4, 1, 3]), np.arange(6.0).reshape(3, 2), 6)
+        m = s.merge_rows()
+        assert m.rows.numpy().tolist() == [1, 3, 4]
+        assert m.values.numpy().tolist() == [[2, 3], [4, 5], [0, 1]]
+
+    def test_unsigned_row_dtype(self):
+        """uint rows (embedding keys) used to OverflowError on the -1
+        fill value."""
+        s = _sr(jnp.array([5, 5, 1], dtype=jnp.uint32),
+                jnp.ones((3, 2)), 8)
+        m = s.merge_rows()
+        assert m.rows.numpy().tolist() == [1, 5]
+        assert m.values.numpy().tolist() == [[1, 1], [2, 2]]
+
+    def test_empty(self):
+        s = _sr(np.zeros((0,), np.int64), np.zeros((0, 2)), 4)
+        m = s.merge_rows()
+        assert m.rows.numpy().shape[0] == 0
+
+    def test_under_jit_sentinel_never_lands(self):
+        """Traced merges keep fixed shapes; the out-of-range sentinel
+        padding must scatter to nothing on densify."""
+        def f(rows, vals):
+            return _sr(rows, vals, 4).merge_rows().to_dense()._data
+        out = jax.jit(f)(jnp.array([3, 3, 0]), jnp.ones((3, 2)))
+        assert out.tolist() == [[1, 1], [0, 0], [0, 0], [2, 2]]
+
+    def test_merged_then_to_dense_equals_direct_dense(self):
+        rng = np.random.RandomState(0)
+        rows = rng.randint(0, 6, 20)
+        vals = rng.randn(20, 3).astype(np.float32)
+        s = _sr(rows, vals, 6)
+        np.testing.assert_allclose(np.asarray(s.to_dense().numpy()),
+                                   np.asarray(
+                                       s.merge_rows().to_dense().numpy()),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_add_n_then_merge(self):
+        a = _sr(np.array([1, 2]), np.ones((2, 2)), 5)
+        b = _sr(np.array([2, 1]), np.full((2, 2), 2.0), 5)
+        m = sr.add_n([a, b]).merge_rows()
+        assert m.rows.numpy().tolist() == [1, 2]
+        assert m.values.numpy().tolist() == [[3, 3], [3, 3]]
+
+
+class TestAdamSparsePadding:
+    def test_jit_padding_never_clobbers_last_row(self):
+        """Under jit the sentinel padding rows clip onto height-1; a
+        REAL update for height-1 must survive the aliased scatter (the
+        old scatter-set picked an arbitrary winner)."""
+        def f(rows, vals, p, m1, m2):
+            g = _sr(rows, vals, 4)
+            out = sr.adam_sparse(Tensor(p), g, Tensor(m1), Tensor(m2),
+                                 0.1)
+            return out[0]._data, out[1]._data
+        z = np.zeros((4, 2), np.float32)
+        # rows [3, 0, 0]: dup 0 -> padding present; real row 3 is the
+        # clip target of the sentinel
+        newp, newm1 = jax.jit(f)(jnp.array([3, 0, 0]),
+                                 jnp.ones((3, 2)), z, z, z)
+        assert (np.asarray(newm1)[3] != 0).all()     # (1-b1)*g landed
+        assert (np.asarray(newp)[3] != 0).all()
+        assert (np.asarray(newm1)[[1, 2]] == 0).all()
+
+    def test_duplicate_rows_update_once_with_merged_grad(self):
+        p = Tensor(np.zeros((5, 2), np.float32))
+        m1 = Tensor(np.zeros((5, 2), np.float32))
+        m2 = Tensor(np.zeros((5, 2), np.float32))
+        g = _sr(np.array([1, 1, 3]), np.ones((3, 2), np.float32), 5)
+        np_, _, _ = sr.adam_sparse(p, g, m1, m2, 0.1)
+        out = np.asarray(np_.numpy())
+        # rows 1 and 3 moved, everything else untouched (no phantom
+        # row -1 wrapping to the last row, no sentinel row landing)
+        assert (out[[0, 2, 4]] == 0).all()
+        assert (out[[1, 3]] != 0).all()
