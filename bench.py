@@ -77,16 +77,26 @@ def bench_gpt(on_tpu):
                                                step_num=w + 1)
         float(jax.device_get(loss))
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, opt, loss = trainer.train_step(params, opt, tok, lab,
-                                               step_num=i + 4)
-    final_loss = float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
+    # min-of-k timed windows (r6 BASELINE.md host-variance hardening,
+    # extended to this lane per ISSUE 7): a host-load spike inside a
+    # single window is indistinguishable from a code regression
+    step_num = 4
+    best = float("inf")
+    final_loss = None
+    for k in range(3 if on_tpu else 2):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            params, opt, loss = trainer.train_step(params, opt, tok, lab,
+                                                   step_num=step_num)
+            step_num += 1
+        final_loss = float(jax.device_get(loss))
+        best = min(best, time.perf_counter() - t0)
+        if k and _budget_left() < 300:
+            break
     assert np.isfinite(final_loss)
 
     toks = batch * cfg.seq_len * iters
-    tps = toks / dt
+    tps = toks / best
     from paddle_tpu.profiler import metrics as _metrics
     if _metrics._enabled:
         _metrics.TOKENS_PER_SEC.set(tps)
@@ -96,7 +106,50 @@ def bench_gpt(on_tpu):
     n_params = 12 * L * d * d + V * d + S * d
     flops_tok = 6 * n_params + 6 * L * S * d
     mfu = tps * flops_tok / PEAK_FLOPS
-    return tps, mfu
+    step_seconds = best / iters
+    return tps, mfu, _tuner_plan_extra(mfu if on_tpu else None,
+                                       step_seconds if on_tpu else None)
+
+
+def _tuner_plan_extra(measured_mfu, measured_step_seconds):
+    """auto_tuner placement-search extra (ISSUE 7 acceptance: record the
+    tuner's predicted MFU NEXT TO the measured one). The search prices
+    the GPT-350M bench config on the 8-chip v5e-ish ClusterSpec;
+    calibration uses THIS run's measured single-chip step on TPU, or
+    the recorded BENCH_r05 measurement (MFU 0.456) on CPU where the
+    tiny smoke config says nothing about the 350M model."""
+    try:
+        from paddle_tpu.parallel.auto_tuner import (ClusterSpec,
+                                                    CostModel, ModelSpec,
+                                                    Strategy, tune)
+        mspec = ModelSpec(n_layers=24, d_model=1024, seq_len=1024,
+                          vocab_size=50304, global_batch=32, n_heads=16)
+        single = Strategy()
+        meas = {"strategy": single}
+        if measured_step_seconds:
+            meas["step_seconds"] = measured_step_seconds
+            calib_src = "this_run"
+        else:
+            meas["mfu"] = 0.456          # BENCH_r05 measured single-chip
+            calib_src = "bench_r05"
+        plan = tune(mspec, cluster=ClusterSpec(), measurements=meas)
+        cm = CostModel(plan.cluster)
+        pred_single = cm.predicted_mfu(mspec, single)
+        return {
+            "metric": "auto_tuner_plan",
+            "chosen_config": plan.strategy.as_hybrid_configs(),
+            "predicted_mfu_8chip": round(plan.predicted_mfu, 4),
+            "predicted_step_seconds_8chip": round(plan.step_time, 5),
+            "predicted_single_chip_mfu": round(pred_single, 4),
+            "measured_single_chip_mfu": (round(measured_mfu, 4)
+                                         if measured_mfu else None),
+            "calibration_source": calib_src,
+            "calibrated_mxu_efficiency": round(
+                plan.cluster.mxu_efficiency, 4),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"metric": "auto_tuner_plan",
+                "error": f"{type(e).__name__}: {e}"}
 
 
 # -------------------------------------------------------------- resnet
@@ -127,15 +180,21 @@ def bench_resnet50():
     float(jax.device_get(losses[0]._data))
     assert model._jit_ok, "ResNet-50 compiled path fell back to eager"
 
+    # min-of-2 timed windows (BASELINE.md host-variance hardening,
+    # extended to this lane per ISSUE 7)
     iters = 20
-    t0 = time.perf_counter()
-    last = None
-    for _ in range(iters):
-        losses, _ = model._train_batch_inner([x], [y])  # lazy loss
-        last = losses[0]
-    float(jax.device_get(last._data))  # single honest barrier
-    dt = time.perf_counter() - t0
-    ips = B * iters / dt
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        last = None
+        for _i in range(iters):
+            losses, _ = model._train_batch_inner([x], [y])  # lazy loss
+            last = losses[0]
+        float(jax.device_get(last._data))  # single honest barrier
+        best = min(best, time.perf_counter() - t0)
+        if _budget_left() < 120:
+            break
+    ips = B * iters / best
     # ResNet-50@224 fwd = 4.1 GMACs = 8.2 GFLOPs (2*MAC, same convention
     # as the GPT/BERT 6N formulas); train ~3x fwd. The r1/r2 benches used
     # 4.1e9 here — counting MACs as FLOPs — and so understated MFU 2x.
@@ -580,6 +639,11 @@ def _metrics_extra():
         "jit_compile_seconds": total(
             "paddle_tpu_jit_compile_seconds_total"),
         "collective_bytes": total("paddle_tpu_collective_bytes_total"),
+        "grad_buckets": total("paddle_tpu_grad_buckets"),
+        "pipeline_bubble_ticks": total(
+            "paddle_tpu_pipeline_stage_bubble_ticks"),
+        "pipeline_bubble_ratio": round(
+            metrics.PIPELINE_BUBBLE_RATIO.value, 4),
         "tokens_per_sec_gauge": round(metrics.TOKENS_PER_SEC.value, 1),
     }
 
@@ -594,7 +658,7 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
 
-    tps, gpt_mfu = bench_gpt(on_tpu)
+    tps, gpt_mfu, tuner_extra = bench_gpt(on_tpu)
     result = {
         "metric": ("gpt2_350m_train_tokens_per_sec_per_chip" if on_tpu
                    else "gpt_tiny_cpu_smoke_tokens_per_sec"),
@@ -604,6 +668,9 @@ def main():
         "mfu": round(gpt_mfu, 4) if on_tpu else None,
         "extras": [],
     }
+    # placement-search extra (ISSUE 7): the tuner's predicted MFU rides
+    # next to the measured number so the prediction gap is in the record
+    result["extras"].append(tuner_extra)
 
     # layout mode of record for the vision configs (ISSUE 4): which
     # path produced the resnet/lenet numbers in this run

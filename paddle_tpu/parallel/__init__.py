@@ -122,7 +122,7 @@ from .env import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, all_reduce, all_gather, broadcast, reduce,
-    scatter, all_to_all, wait,
+    scatter, all_to_all, all_reduce_coalesced, wait,
 )
 from .comm_extras import (  # noqa: F401
     all_gather_object, reduce_scatter, isend, irecv, send, recv, stream,
@@ -137,6 +137,7 @@ from .data_parallel import DataParallel, shard_batch  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from .auto_tuner import (  # noqa: F401
     ClusterSpec, CostModel, ModelSpec, Strategy, StrategyTuner,
+    TunedResult, tune,
 )
 from . import fleet  # noqa: F401
 

@@ -6,22 +6,40 @@ search). TPU-native re-design: instead of simulating a serialized Program
 op-graph, the model prices a transformer-family training step analytically
 from the hardware roofline —
 
-  comp  = step FLOPs / (MXU peak x efficiency)
-  comm  = bytes moved per collective / ICI bandwidth  (ring allreduce =
+  comp  = step FLOPs / (MXU peak x efficiency), stretched by the ACTUAL
+          schedule's bubble fraction (tick decode via
+          `pipeline_schedule.schedule_bubble_ticks`, so gpipe / 1f1b /
+          zero_bubble price differently; zero_bubble additionally pays
+          its extra forward recompute)
+  comm  = bytes moved per collective / ICI bandwidth (ring allreduce =
           2 (n-1)/n x bytes, all_gather/reduce_scatter = (n-1)/n x bytes)
-  pp    = bubble factor (pp-1)/(M + pp - 1) on the compute term
+          + a per-collective dispatch latency, so the dp grad sync is
+          priced per BUCKET: bucket_size=0 models the per-parameter
+          eager path (n_param_tensors collectives), bucket_size>0 models
+          the fused path, whose reductions overlap the backward except
+          for the tail bucket
   mem   = params + grads + optimizer state (/ zero shard factor)
-          + activations (/ pp mp, x remat factor); configs over the HBM
-          budget are infeasible
+          + activations (/ pp mp, x remat factor; zero_bubble holds its
+          O(M) act+cotangent stashes); configs over the HBM budget are
+          infeasible
 
-and the tuner brute-force scores every (dp, mp, pp, zero, micro) mesh
-factorization — the search space is tiny (divisors of n_devices), so
-beam search is unnecessary on TPU pods.
+and the tuner brute-force scores every (dp, mp, pp, zero, micro,
+schedule, bucket_size) mesh factorization — the search space is tiny
+(divisors of n_devices x a few schedules/buckets), so beam search is
+unnecessary on TPU pods.
+
+`tune()` is the measurement-driven entry (the "Integrated Hardware
+Architecture and Device Placement Search" direction, PAPERS.md): feed it
+a short profiled run's numbers (PR 1 metrics registry: step seconds or
+measured MFU, eager collective bytes/seconds) and it calibrates the
+cluster's `mxu_efficiency` / `ici_bw` terms before searching, then
+reports the chosen config WITH its predicted MFU so the prediction can
+be checked against the next measurement (bench.py records both).
 """
 from __future__ import annotations
 
 import dataclasses
-import itertools
+import math
 from typing import Optional
 
 
@@ -34,6 +52,7 @@ class ClusterSpec:
     ici_bw: float = 9e10             # bytes/s per direction per link
     dcn_bw: float = 2.5e10
     mxu_efficiency: float = 0.4      # achievable fraction of peak
+    collective_latency: float = 2e-5  # dispatch+setup per collective
 
 
 @dataclasses.dataclass
@@ -45,6 +64,7 @@ class ModelSpec:
     vocab_size: int
     d_ff: int = 0
     global_batch: int = 32
+    n_heads: int = 0                 # 0 = no head-divisibility constraint
     param_bytes: int = 2             # bf16 params
     grad_bytes: int = 4
     opt_state_bytes: int = 8         # Adam m+v fp32... per param elem
@@ -62,14 +82,29 @@ class ModelSpec:
         return (4 * d * d + 2 * d * self.d_ff) * L \
             + self.vocab_size * d + self.seq_len * d
 
+    @property
+    def n_param_tensors(self) -> int:
+        """Parameter-tensor count estimate (12 per block + embeddings/
+        final LN/head): the collective count of an UNbucketed
+        per-parameter grad reduction."""
+        return 12 * self.n_layers + 4
+
     def step_flops(self) -> float:
         """fwd+bwd (+recompute) matmul FLOPs for one global batch."""
         toks = self.global_batch * self.seq_len
-        base = 6.0 * self.n_params * toks \
-            + 6.0 * self.n_layers * self.seq_len * self.d_model * toks
+        base = self.useful_flops()
         if self.remat:
             base *= 4.0 / 3.0  # one extra forward
         return base
+
+    def useful_flops(self) -> float:
+        """Model FLOPs for one global batch WITHOUT recompute overhead —
+        the MFU numerator (same 6N + 6*L*S*d per-token convention as
+        bench.py)."""
+        toks = self.global_batch * self.seq_len
+        return (6.0 * self.n_params
+                + 6.0 * self.n_layers * self.seq_len * self.d_model) \
+            * toks
 
 
 @dataclasses.dataclass
@@ -79,6 +114,9 @@ class Strategy:
     pp: int = 1
     micro_batches: int = 1
     zero_stage: int = 0
+    schedule: str = "1f1b"           # gpipe | 1f1b | zero_bubble
+    virtual_stages: int = 1
+    bucket_size: int = 0             # 0 = per-parameter grad reduction
 
     def degree(self):
         return self.dp * self.mp * self.pp
@@ -87,7 +125,10 @@ class Strategy:
         return {"dp_degree": self.dp, "mp_degree": self.mp,
                 "pp_degree": self.pp, "sharding_degree": 1,
                 "micro_batches": self.micro_batches,
-                "zero_stage": self.zero_stage}
+                "zero_stage": self.zero_stage,
+                "schedule": self.schedule,
+                "virtual_stages": self.virtual_stages,
+                "bucket_size": self.bucket_size}
 
 
 def _ring_allreduce_time(bytes_, n, bw):
@@ -101,6 +142,12 @@ def _shard_xfer_time(bytes_, n, bw):
     if n <= 1 or bytes_ <= 0:
         return 0.0
     return (n - 1) / n * bytes_ / bw
+
+
+# fraction of the compute step a bucketed+overlapped dp reduction can
+# hide behind (the backward half of fwd+bwd issues buckets as layers
+# retire); the tail bucket is always exposed
+_OVERLAP_WINDOW = 0.5
 
 
 class CostModel:
@@ -130,32 +177,64 @@ class CostModel:
         act_per_layer = b_local * m.seq_len * m.d_model * m.act_bytes
         layers_local = max(m.n_layers // s.pp, 1)
         live_factor = 2.0 if m.remat else 14.0   # resid vs full act set
-        # gpipe keeps micro_batches in flight; 1f1b keeps <= pp
-        in_flight = min(s.micro_batches, s.pp)
+        # gpipe keeps micro_batches in flight; 1f1b keeps <= pp;
+        # zero_bubble stashes EVERY micro's input AND cotangent until
+        # its deferred W sub-tick (pipeline_schedule module doc)
+        if s.pp > 1 and s.schedule == "zero_bubble":
+            in_flight = 2 * s.micro_batches
+        else:
+            in_flight = min(s.micro_batches, s.pp)
         a_bytes = act_per_layer * layers_local * live_factor * in_flight \
             / max(s.mp, 1)
         return p_bytes + g_bytes + o_bytes + a_bytes
 
     # ------------------------------------------------------------- time
-    def step_time(self, m: ModelSpec, s: Strategy) -> float:
-        c = self.cluster
-        flops = m.step_flops() / s.degree()
-        comp = flops / (c.peak_flops * c.mxu_efficiency)
-        # pipeline bubble stretches compute
-        if s.pp > 1:
-            bubble = (s.pp - 1) / max(s.micro_batches + s.pp - 1, 1)
-            comp = comp / (1.0 - bubble)
+    def _bubble_stretch(self, s: Strategy) -> float:
+        """Schedule-tick stretch T / active_ticks from the real decode
+        formulas: the factor pure compute inflates by when the device
+        idles in fill/drain slots."""
+        if s.pp <= 1:
+            return 1.0
+        from .pipeline_schedule import schedule_bubble_ticks
+        bubbles, T = schedule_bubble_ticks(
+            s.schedule, s.pp, s.virtual_stages, s.micro_batches)
+        active = T - bubbles[0]
+        return T / max(active, 1)
 
+    def comp_time(self, m: ModelSpec, s: Strategy,
+                  efficiency: Optional[float] = None) -> float:
+        c = self.cluster
+        eff = c.mxu_efficiency if efficiency is None else efficiency
+        flops = m.step_flops() / s.degree()
+        if s.pp > 1 and s.schedule == "zero_bubble":
+            # B and W each replay the stage forward from the stash: one
+            # recompute more than the remat baseline
+            flops *= (10.0 / 8.0) if m.remat else (8.0 / 6.0)
+        return flops / (c.peak_flops * eff) * self._bubble_stretch(s)
+
+    def comm_time(self, m: ModelSpec, s: Strategy) -> float:
+        c = self.cluster
         P = float(m.n_params)
         comm = 0.0
         # dp grad sync: allreduce (zero=0) or RS+AG (zero>=1) of the
         # mp/pp-local shard
         g_local = P * m.grad_bytes / (s.mp * s.pp)
-        if s.zero_stage >= 1:
-            comm += 2.0 * _shard_xfer_time(g_local, s.dp, c.ici_bw)
-        else:
-            comm += _ring_allreduce_time(g_local, s.dp, c.ici_bw)
-        if s.zero_stage >= 3:
+        if s.dp > 1:
+            if s.zero_stage >= 1:
+                comm += 2.0 * _shard_xfer_time(g_local, s.dp, c.ici_bw) \
+                    + 2.0 * c.collective_latency
+            elif s.bucket_size > 0:
+                n_buckets = max(1, math.ceil(g_local / s.bucket_size))
+                ring = _ring_allreduce_time(g_local, s.dp, c.ici_bw)
+                tail = _ring_allreduce_time(
+                    min(float(s.bucket_size), g_local), s.dp, c.ici_bw)
+                hide = _OVERLAP_WINDOW * self.comp_time(m, s)
+                comm += max(tail, ring - hide) \
+                    + n_buckets * c.collective_latency
+            else:
+                comm += _ring_allreduce_time(g_local, s.dp, c.ici_bw) \
+                    + m.n_param_tensors * c.collective_latency
+        if s.zero_stage >= 3 and s.dp > 1:
             # params stored sharded: all-gather them for fwd AND for the
             # recomputing bwd
             p_local = P * m.param_bytes / (s.mp * s.pp)
@@ -165,14 +244,62 @@ class CostModel:
             b_local = max(m.global_batch // s.dp, 1)
             act = b_local * m.seq_len * m.d_model * m.act_bytes
             layers_local = max(m.n_layers // s.pp, 1)
-            comm += 4.0 * layers_local * _ring_allreduce_time(
-                act, s.mp, c.ici_bw)
+            comm += 4.0 * layers_local * (_ring_allreduce_time(
+                act, s.mp, c.ici_bw) + c.collective_latency)
         # pp: p2p activation sends per microbatch tick (fwd+bwd)
         if s.pp > 1:
             b_micro = max(m.global_batch // (s.dp * s.micro_batches), 1)
             act = b_micro * m.seq_len * m.d_model * m.act_bytes
             comm += 2.0 * s.micro_batches * act / c.ici_bw
-        return comp + comm
+        return comm
+
+    def step_time(self, m: ModelSpec, s: Strategy) -> float:
+        return self.comp_time(m, s) + self.comm_time(m, s)
+
+    def predicted_mfu(self, m: ModelSpec, s: Strategy) -> float:
+        """Useful-FLOPs MFU per chip at the predicted step time (same
+        numerator convention as bench.py's measured MFU)."""
+        t = self.step_time(m, s)
+        return m.useful_flops() / (t * s.degree() * self.cluster.peak_flops)
+
+    # ------------------------------------------------------ calibration
+    def calibrate(self, m: ModelSpec, measurements: dict) -> ClusterSpec:
+        """Fit cluster terms from a measured run (PR 1 metrics registry
+        numbers) and return a NEW ClusterSpec.
+
+        measurements keys:
+          strategy           Strategy (or dict of its fields) the
+                             measurement ran under; default Strategy()
+          step_seconds       measured wall seconds per train step, OR
+          mfu                measured useful-FLOPs MFU per chip
+          collective_bytes   + collective_seconds: eager wire totals
+                             (fits ici_bw = bytes/seconds)
+
+        mxu_efficiency solves comp_time(eff) = t_meas - comm_pred (the
+        comp term is linear in 1/eff); clamped to [0.02, 0.95].
+        """
+        strat = measurements.get("strategy") or Strategy()
+        if isinstance(strat, dict):
+            strat = Strategy(**{k: v for k, v in strat.items()
+                                if k in {f.name for f in
+                                         dataclasses.fields(Strategy)}})
+        cluster = dataclasses.replace(self.cluster)
+        cb = measurements.get("collective_bytes")
+        cs = measurements.get("collective_seconds")
+        if cb and cs:
+            cluster.ici_bw = float(cb) / float(cs)
+        cm = CostModel(cluster)
+        t_meas = measurements.get("step_seconds")
+        if t_meas is None and measurements.get("mfu"):
+            t_meas = m.useful_flops() / (
+                float(measurements["mfu"]) * strat.degree()
+                * cluster.peak_flops)
+        if t_meas:
+            unit = cm.comp_time(m, strat, efficiency=1.0)
+            comp_budget = float(t_meas) - cm.comm_time(m, strat)
+            eff = unit / max(comp_budget, unit / 0.95)
+            cluster.mxu_efficiency = min(max(eff, 0.02), 0.95)
+        return cluster
 
 
 class StrategyTuner:
@@ -194,27 +321,50 @@ class StrategyTuner:
                 yield dp, mp, rest // mp
 
     def search(self, model: ModelSpec, n_devices: Optional[int] = None,
-               top_k: int = 1):
+               top_k: int = 1, schedules=("1f1b",), bucket_sizes=(0,),
+               zero_stages=(0, 1, 2, 3)):
         n = n_devices or self.cluster.n_devices
         scored = []
         for dp, mp, pp in self._factorizations(n):
             if model.n_layers % pp or model.global_batch % dp:
                 continue
+            if model.n_heads and (mp > model.n_heads
+                                  or model.n_heads % mp):
+                continue
+            if model.vocab_size % mp:
+                continue
             micro_opts = {1} if pp == 1 else {
                 mb for mb in (pp, 2 * pp, 4 * pp)
                 if model.global_batch % (dp * mb) == 0}
+            sched_opts = schedules if pp > 1 else ("1f1b",)
+            # bucketed grad reduction exists only on the pure dense-DP
+            # executor path (hybrid_gpt's grad_bucket_bytes contract):
+            # scoring buckets on an mp/pp mesh would rank a config no
+            # executor can run and let a near-tie flip the mesh choice
+            buck_opts = bucket_sizes if (dp > 1 and mp == 1
+                                         and pp == 1) else (0,)
             for micro in sorted(micro_opts):
-                for zero in (0, 1, 2, 3):
-                    s = Strategy(dp=dp, mp=mp, pp=pp,
-                                 micro_batches=micro, zero_stage=zero)
-                    mem = self.cost_model.memory_per_device(model, s)
-                    if mem > self.cluster.hbm_bytes:
-                        continue
-                    t = self.cost_model.step_time(model, s)
-                    # prefer simpler configs on near-ties (zero adds
-                    # collectives; mp/pp add failure surface)
-                    tie_break = (zero, mp, pp)
-                    scored.append((t, tie_break, s, mem))
+                for zero in zero_stages:
+                    for sched in sched_opts:
+                        for bucket in buck_opts:
+                            if bucket and zero >= 1:
+                                continue  # RS/AG path, nothing to bucket
+                            s = Strategy(dp=dp, mp=mp, pp=pp,
+                                         micro_batches=micro,
+                                         zero_stage=zero,
+                                         schedule=sched,
+                                         bucket_size=bucket)
+                            mem = self.cost_model.memory_per_device(
+                                model, s)
+                            if mem > self.cluster.hbm_bytes:
+                                continue
+                            t = self.cost_model.step_time(model, s)
+                            # prefer simpler configs on near-ties (zero
+                            # adds collectives; mp/pp/zb add failure
+                            # surface)
+                            tie_break = (zero, mp, pp,
+                                         sched != "1f1b", bucket)
+                            scored.append((t, tie_break, s, mem))
         if not scored:
             raise ValueError(
                 "no feasible parallel strategy: model does not fit "
@@ -223,3 +373,50 @@ class StrategyTuner:
         if top_k == 1:
             return scored[0][2]
         return [r[2] for r in scored[:top_k]]
+
+
+@dataclasses.dataclass
+class TunedResult:
+    """`tune()` output: the chosen strategy plus the prediction that a
+    later measured run is checked against (bench.py records
+    predicted_mfu next to the measured MFU)."""
+    strategy: Strategy
+    step_time: float
+    predicted_mfu: float
+    memory_bytes: float
+    cluster: ClusterSpec
+    calibrated: bool = False
+    candidates: list = dataclasses.field(default_factory=list)
+
+
+def tune(model: ModelSpec, cluster: Optional[ClusterSpec] = None,
+         n_devices: Optional[int] = None, measurements: Optional[dict] = None,
+         schedules=("1f1b", "zero_bubble"),
+         bucket_sizes=(0, 1 << 24, 1 << 27), top_k=8,
+         zero_stages=(0, 1, 2, 3)) -> TunedResult:
+    """Measurement-driven placement search: optionally calibrate the
+    cluster from a profiled run, then score every (dp, mp, pp, zero,
+    micro, schedule, bucket_size) config and return the winner with its
+    predicted MFU. Callers whose executor supports only a subset of
+    ZeRO stages must pass that subset as `zero_stages` — clamping the
+    WINNER after the search would execute a config the HBM-feasibility
+    gate never admitted."""
+    cluster = cluster or ClusterSpec()
+    calibrated = False
+    if measurements:
+        cluster = CostModel(cluster).calibrate(model, measurements)
+        calibrated = True
+    tuner = StrategyTuner(cluster)
+    ranked = tuner.search(model, n_devices, top_k=max(int(top_k), 2),
+                          schedules=schedules, bucket_sizes=bucket_sizes,
+                          zero_stages=zero_stages)
+    best = ranked[0]
+    cm = tuner.cost_model
+    return TunedResult(
+        strategy=best,
+        step_time=cm.step_time(model, best),
+        predicted_mfu=cm.predicted_mfu(model, best),
+        memory_bytes=cm.memory_per_device(model, best),
+        cluster=cluster,
+        calibrated=calibrated,
+        candidates=ranked)

@@ -285,6 +285,44 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     return out_tensor_list
 
 
+@_instrumented("all_reduce_coalesced", payload_kw="tensor_list")
+def all_reduce_coalesced(tensor_list, op=ReduceOp.SUM, group=None,
+                         sync_op=True):
+    """ONE collective over many tensors: flatten every tensor in
+    `tensor_list` (same dtype required) into a single 1-D payload,
+    all-reduce it once, and scatter the reduced slices back into the
+    input tensors in place. This is the wire primitive behind gradient
+    bucketing (`fleet_utils.fused_allreduce_gradients`): n tensors cost
+    one collective's latency instead of n.
+
+    Like `all_reduce`, the single-controller path is an identity (grads
+    of replicated params are already globally reduced inside the
+    compiled step); the cross-process path moves one fused buffer."""
+    tensors = [as_tensor(t) for t in tensor_list]
+    if not tensors:
+        return tensor_list
+    dt = tensors[0]._data.dtype
+    for t in tensors[1:]:
+        if t._data.dtype != dt:
+            raise ValueError(
+                "all_reduce_coalesced needs one dtype per call; got "
+                f"{dt} and {t._data.dtype} (bucket per dtype)")
+    if not _multiproc():
+        return tensor_list
+    # one fused 1-D payload through the ordinary all_reduce (its
+    # multi-process branch; the single-controller rank-axis heuristic
+    # never sees this path), then scatter the reduced slices back
+    flat = Tensor(jnp.concatenate([t._data.ravel() for t in tensors])) \
+        if len(tensors) > 1 else Tensor(tensors[0]._data.ravel())
+    all_reduce(flat, op, group)
+    off = 0
+    for t in tensors:
+        n = int(t._data.size)
+        t._data = flat._data[off:off + n].reshape(t._data.shape)
+        off += n
+    return tensor_list
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
     raise NotImplementedError(
         "point-to-point send/recv across processes requires the multi-host "

@@ -29,6 +29,18 @@ Schedules:
   per-(tick, device) decoding is unique (r = residue mod pp, j = residue
   mod v, g = quotient). The last chunk's backward lands one tick after
   its forward — the 1F1B property.
+- ``"zero_bubble"``: ZB-style split backward (Qi et al., "Zero Bubble
+  Pipeline Parallelism"). F and B keep the exact 1f1b formulas above,
+  but B computes ONLY the input gradient (the dx chain is the critical
+  path) and each (chunk, micro)'s weight gradient runs as a separate W
+  sub-tick scheduled host-side (`_zb_w_schedule`, greedy) into the
+  ticks where the 1f1b decode leaves the device idle — the fill/drain
+  bubble does the dw work instead of idling. Costs: one extra forward
+  recompute per micro (B and W each replay the stage forward from the
+  stash) and O(M)-deep activation + cotangent stashes (the deferred W
+  must see its micro's input and arriving cotangent). Parity-tested
+  against 1f1b/eager at the same rtol; `schedule_bubble_ticks` reports
+  strictly fewer bubble ticks than 1f1b whenever pp >= 2.
 
 Features on the 1f1b path:
 
@@ -68,35 +80,96 @@ from ..profiler import metrics as _metrics
 from . import shard_map as _shard_map
 
 
-def schedule_bubble_ticks(schedule, pp, v, M):
-    """Per-stage idle schedule ticks, host-side mirror of the compiled
-    decode formulas (module doc): returns ([bubble_ticks_per_stage], T).
-    A stage's bubble is the ticks where neither its forward nor its
-    backward slot decodes to a live (chunk, microbatch) pair — the
-    fill/drain cost the 1F1B interleave amortises by 1/v."""
-    if schedule == "gpipe":
-        T = M + pp - 1
-        return [T - M] * pp, T
+def _decode_grid(pp, v, M):
+    """Vectorized host-side mirror of the compiled 1f1b decode formulas
+    (module doc) over the full (tick, device) grid. Returns
+    (fwd_live [T, pp], bwd_live [T, pp], bwd_chunk [T, pp],
+    bwd_micro [T, pp], T) — one numpy broadcast instead of the former
+    O(T*pp) Python loops."""
     gM, rM = (M - 1) // pp, (M - 1) % pp
     beta_max = (pp * v - 1) + gM * pp * v + (v - 1) * pp + rM + (pp - 1)
     T = 2 * beta_max + 2
-    bubbles = []
-    for d in range(pp):
-        active = 0
-        for t in range(T):
-            if t % 2 == 0:
-                u = t // 2 - d
-            else:
-                u = (t - 1) // 2 - (pp * v - 1) - (pp - 1 - d)
-            if u < 0:
-                continue
-            r = u % pp
-            q = (u - r) // pp
-            g = (q - q % v) // v
-            if g >= 0 and g * pp + r < M:
-                active += 1
-        bubbles.append(T - active)
-    return bubbles, T
+    t = np.arange(T)[:, None]
+    d = np.arange(pp)[None, :]
+
+    def decode(u, flip_j):
+        r = np.mod(u, pp)
+        q = (u - r) // pp
+        j = np.mod(q, v)
+        g = (q - j) // v
+        if flip_j:
+            j = v - 1 - j
+        m = g * pp + r
+        live = (u >= 0) & (g >= 0) & (m < M)
+        return live, j * pp + d, np.clip(m, 0, M - 1)
+
+    f_live, _, _ = decode(t // 2 - d, False)
+    f_live &= t % 2 == 0
+    b_live, b_c, b_m = decode(
+        (t - 1) // 2 - (pp * v - 1) - (pp - 1 - d), True)
+    b_live &= t % 2 == 1
+    return f_live, b_live, b_c, b_m, T
+
+
+def _zb_w_schedule(pp, v, M, grid=None):
+    """Greedy host-side schedule for the W (weight-grad) sub-ticks of
+    the zero-bubble schedule. F and B(=input-grad only) keep the exact
+    1f1b decode formulas — the dx chain is the critical path — and each
+    (chunk c, micro m)'s W runs on its owner device at the earliest
+    WHOLLY-IDLE tick after its B sub-tick (so a tick never does two
+    slots of work); leftovers drain in ticks appended past the 1f1b
+    window. Returns (w_sched int32 [T_ext, pp] holding c*M + m or -1,
+    T_ext). The schedule is static, so the compiled scan consumes it as
+    a constant array. `grid` takes a precomputed `_decode_grid` result
+    (the auto-tuner scores many candidates through here)."""
+    f_live, b_live, b_c, b_m, T = grid if grid is not None \
+        else _decode_grid(pp, v, M)
+    idle = ~(f_live | b_live)
+    per_dev = []
+    for dd in range(pp):
+        b_ticks = np.where(b_live[:, dd])[0]
+        idle_ticks = np.concatenate(
+            [np.where(idle[:, dd])[0], np.arange(T, T + v * M)])
+        assigned = {}
+        ptr = 0
+        for bt in b_ticks:
+            while idle_ticks[ptr] <= bt:
+                ptr += 1
+            assigned[int(idle_ticks[ptr])] = (
+                int(b_c[bt, dd]) * M + int(b_m[bt, dd]))
+            ptr += 1
+        per_dev.append(assigned)
+    T_ext = max([T] + [max(a) + 1 for a in per_dev if a])
+    w = np.full((T_ext, pp), -1, np.int32)
+    for dd, a in enumerate(per_dev):
+        for t_, code in a.items():
+            w[t_, dd] = code
+    return w, T_ext
+
+
+def schedule_bubble_ticks(schedule, pp, v, M):
+    """Per-stage idle schedule ticks, host-side mirror of the compiled
+    decode formulas (module doc): returns ([bubble_ticks_per_stage], T).
+    A stage's bubble is the ticks where none of its slots decode to a
+    live (chunk, microbatch) work item — the fill/drain cost the 1F1B
+    interleave amortises by 1/v and the zero-bubble W sub-ticks fill.
+
+    Units: one tick = one slot of work (a forward, an input-grad
+    backward, or — zero_bubble only — a weight-grad sub-tick), so
+    zero_bubble runs 3vM active ticks per stage where gpipe/1f1b run
+    2vM (their backward slot does the dx AND dw work in one tick).
+    Compare bubble TICKS at matched (pp, v, M), not wall seconds."""
+    if schedule == "gpipe":
+        T = M + pp - 1
+        return [T - M] * pp, T
+    if schedule == "zero_bubble":
+        grid = _decode_grid(pp, v, M)
+        _, T_ext = _zb_w_schedule(pp, v, M, grid=grid)
+        active = (grid[0] | grid[1]).sum(axis=0) + v * M
+        return [int(T_ext - a) for a in active], T_ext
+    f_live, b_live, _, _, T = _decode_grid(pp, v, M)
+    active = (f_live | b_live).sum(axis=0)
+    return [int(T - a) for a in active], T
 
 
 def _stage_param_tensors(stage_layers):
@@ -160,7 +233,7 @@ class CompiledPipeline:
     def __init__(self, pipeline_layer, micro_batches=1, schedule="1f1b",
                  devices=None, num_virtual_stages=1,
                  stage_local_params=False):
-        if schedule not in ("gpipe", "1f1b"):
+        if schedule not in ("gpipe", "1f1b", "zero_bubble"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.layer = pipeline_layer
         self.M = int(micro_batches)
@@ -169,8 +242,9 @@ class CompiledPipeline:
         self.stage_local = bool(stage_local_params)
         C = pipeline_layer._num_stages
         if self.v > 1:
-            if schedule != "1f1b":
-                raise ValueError("num_virtual_stages>1 requires 1f1b")
+            if schedule == "gpipe":
+                raise ValueError(
+                    "num_virtual_stages>1 requires 1f1b or zero_bubble")
             if C % self.v != 0:
                 raise ValueError(
                     f"num_virtual_stages ({self.v}) must divide "
@@ -180,8 +254,9 @@ class CompiledPipeline:
                     "interleaved 1F1B needs micro_batches divisible by "
                     f"pp ({C // self.v}) — the reference has the same "
                     "constraint")
-        if self.stage_local and schedule != "1f1b":
-            raise ValueError("stage_local_params requires 1f1b")
+        if self.stage_local and schedule == "gpipe":
+            raise ValueError(
+                "stage_local_params requires 1f1b or zero_bubble")
         self.pp = C // self.v
         self.chunks = C
         loss_layer = pipeline_layer._loss_fn
@@ -348,6 +423,12 @@ class CompiledPipeline:
             for bts, sl in zip(self.stage_buffers, self._stage_layers)]
         if stage_local:
             place = self._flat_place
+        zb = self.schedule == "zero_bubble"
+        if zb:
+            # static W sub-tick schedule (host-greedy): the scan consumes
+            # it as a constant [T_ext, pp] array
+            w_sched_np, T_zb = _zb_w_schedule(pp, v, M)
+            w_sched_arr = jnp.asarray(w_sched_np)
 
         def zeros_act():
             return jnp.zeros(act_shape, act_dtype)
@@ -452,7 +533,15 @@ class CompiledPipeline:
             beta_max = (pp * v - 1) + gM * pp * v + (v - 1) * pp + rM \
                 + (pp - 1)
             T = 2 * beta_max + 2
-            Dst = min(M, 4 * pp)   # stash ring depth (in-flight < 3*pp)
+            if zb:
+                # zero-bubble: W (weight-grad) sub-ticks may consume a
+                # micro's input/cotangent long after its B, so stashes
+                # hold the full micro depth — the documented ZB memory
+                # trade (O(M) activations) for the smaller bubble
+                T = T_zb
+                Dst = M
+            else:
+                Dst = min(M, 4 * pp)   # stash ring (in-flight < 3*pp)
 
             def key_for(c, m):
                 return jax.random.fold_in(base_key, c * 8192 + m)
@@ -464,6 +553,8 @@ class CompiledPipeline:
                 flats_local = None
                 grads0 = jax.tree.map(jnp.zeros_like, all_params)
             stash0 = jnp.zeros((v, Dst) + act_shape, act_dtype)
+            cot_stash0 = jnp.zeros((v, M) + act_shape, act_dtype) \
+                if zb else None
 
             def decode_fwd(t, d):
                 u = t // 2 - d
@@ -487,8 +578,13 @@ class CompiledPipeline:
                 return active, j, jnp.clip(m, 0, M - 1)
 
             def tick(carry, t):
-                (act_buf, cot_buf, act_in, cot_in, stash, bufs, grads,
-                 loss_sum) = carry
+                if zb:
+                    (act_buf, cot_buf, act_in, cot_in, stash, cot_stash,
+                     bufs, grads, loss_sum) = carry
+                else:
+                    (act_buf, cot_buf, act_in, cot_in, stash, bufs,
+                     grads, loss_sum) = carry
+                    cot_stash = None
                 # fwd sends leave on even ticks -> arrive odd; cotangent
                 # sends leave on odd -> arrive even
                 odd = t % 2 == 1
@@ -601,24 +697,161 @@ class CompiledPipeline:
                     return jax.lax.switch(cidx,
                                           [mk(c) for c in range(C)])
 
-                dx_send, grads, l_add = jax.lax.cond(
-                    b_act, bwd_phase,
-                    lambda: (zeros_act(), grads,
-                             jnp.zeros((), jnp.float32)))
+                # ------------------- zero-bubble: B = input-grad only
+                def bwd_phase_zb():
+                    def mk(c):
+                        jj = c // pp
+
+                        def br():
+                            if c == 0:
+                                x = jax.lax.dynamic_index_in_dim(
+                                    data, b_m, keepdims=False)
+                            else:
+                                x = slice_act(
+                                    jax.lax.dynamic_index_in_dim(
+                                        jax.lax.dynamic_index_in_dim(
+                                            stash, jj, keepdims=False),
+                                        b_m % Dst, keepdims=False),
+                                    in_shapes[c])
+                            ps = params_of(all_params, flats_local, c)
+
+                            def run_x(xx):
+                                return stage_fns[c](ps, bufs[c], xx,
+                                                    key_for(c, b_m))[0]
+                            # stash the arriving cotangent: this chunk's
+                            # W sub-tick replays it later
+                            lvl = jax.lax.dynamic_update_index_in_dim(
+                                jax.lax.dynamic_index_in_dim(
+                                    cot_stash, jj, keepdims=False),
+                                cot_buf, b_m, 0)
+                            cst = jax.lax.dynamic_update_index_in_dim(
+                                cot_stash, lvl, jj, 0)
+                            if c == C - 1:
+                                lab = jax.lax.dynamic_index_in_dim(
+                                    labels, b_m, keepdims=False)
+
+                                def f(xx):
+                                    return loss_arr(run_x(xx), lab)
+
+                                lval, vjp = jax.vjp(f, x)
+                                dx, = vjp(jnp.asarray(1.0 / M,
+                                                      jnp.float32))
+                            else:
+                                _, vjp = jax.vjp(run_x, x)
+                                cot = slice_act(cot_buf,
+                                                stage_outs[c].shape)
+                                dx, = vjp(cot)
+                                lval = jnp.zeros((), jnp.float32)
+                            if c == 0:
+                                dx_send = zeros_act()
+                            else:
+                                dx_send = pad_act(dx.astype(act_dtype))
+                            return dx_send, cst, lval
+                        return br
+                    cidx = b_j * pp + d_idx
+                    return jax.lax.switch(cidx,
+                                          [mk(c) for c in range(C)])
+
+                # -------------------- zero-bubble: W = weight-grad slot
+                def w_phase(cst, w_c, w_m):
+                    def mk(c):
+                        jj = c // pp
+
+                        def br():
+                            if c == 0:
+                                x = jax.lax.dynamic_index_in_dim(
+                                    data, w_m, keepdims=False)
+                            else:
+                                x = slice_act(
+                                    jax.lax.dynamic_index_in_dim(
+                                        jax.lax.dynamic_index_in_dim(
+                                            stash, jj, keepdims=False),
+                                        w_m % Dst, keepdims=False),
+                                    in_shapes[c])
+                            if stage_local:
+                                def run_w(fl):
+                                    ps = params_of(None, fl, c)
+                                    return stage_fns[c](
+                                        ps, bufs[c], x,
+                                        key_for(c, w_m))[0]
+                                wrt = flats_local
+                            else:
+                                def run_w(ps):
+                                    return stage_fns[c](
+                                        ps, bufs[c], x,
+                                        key_for(c, w_m))[0]
+                                wrt = all_params[c]
+                            if c == C - 1:
+                                lab = jax.lax.dynamic_index_in_dim(
+                                    labels, w_m, keepdims=False)
+
+                                def f(w):
+                                    return loss_arr(run_w(w), lab)
+
+                                _, vjp = jax.vjp(f, wrt)
+                                dps, = vjp(jnp.asarray(1.0 / M,
+                                                       jnp.float32))
+                            else:
+                                _, vjp = jax.vjp(run_w, wrt)
+                                cot = slice_act(
+                                    jax.lax.dynamic_index_in_dim(
+                                        jax.lax.dynamic_index_in_dim(
+                                            cst, jj, keepdims=False),
+                                        w_m, keepdims=False),
+                                    stage_outs[c].shape)
+                                dps, = vjp(cot)
+                            if stage_local:
+                                return tuple(g + d_ for g, d_ in
+                                             zip(grads, dps))
+                            new_grads = list(grads)
+                            new_grads[c] = [g + d_ for g, d_ in
+                                            zip(grads[c], dps)]
+                            return tuple(new_grads)
+                        return br
+                    return jax.lax.switch(w_c,
+                                          [mk(c) for c in range(C)])
+
+                if zb:
+                    dx_send, cot_stash, l_add = jax.lax.cond(
+                        b_act, bwd_phase_zb,
+                        lambda: (zeros_act(), cot_stash,
+                                 jnp.zeros((), jnp.float32)))
+                    wcode = w_sched_arr[t][d_idx]
+                    wsafe = jnp.maximum(wcode, 0)
+                    grads = jax.lax.cond(
+                        wcode >= 0,
+                        lambda: w_phase(cot_stash, wsafe // M,
+                                        wsafe % M),
+                        lambda: grads)
+                else:
+                    dx_send, grads, l_add = jax.lax.cond(
+                        b_act, bwd_phase,
+                        lambda: (zeros_act(), grads,
+                                 jnp.zeros((), jnp.float32)))
                 loss_sum = loss_sum + l_add
 
                 act_next = jax.lax.ppermute(
                     y_send, "pp", [(i, (i + 1) % pp) for i in range(pp)])
                 cot_next = jax.lax.ppermute(
                     dx_send, "pp", [(i, (i - 1) % pp) for i in range(pp)])
+                if zb:
+                    return (act_buf, cot_buf, act_next, cot_next, stash,
+                            cot_stash, bufs, grads, loss_sum), None
                 return (act_buf, cot_buf, act_next, cot_next, stash,
                         bufs, grads, loss_sum), None
 
-            carry0 = (zeros_act(), zeros_act(), zeros_act(), zeros_act(),
-                      stash0, all_bufs, grads0,
-                      jnp.zeros((), jnp.float32))
-            (_, _, _, _, _, bufs, grads, loss_sum), _ = jax.lax.scan(
-                tick, carry0, jnp.arange(T))
+            if zb:
+                carry0 = (zeros_act(), zeros_act(), zeros_act(),
+                          zeros_act(), stash0, cot_stash0, all_bufs,
+                          grads0, jnp.zeros((), jnp.float32))
+                (_, _, _, _, _, _, bufs, grads, loss_sum), _ = \
+                    jax.lax.scan(tick, carry0, jnp.arange(T))
+            else:
+                carry0 = (zeros_act(), zeros_act(), zeros_act(),
+                          zeros_act(), stash0, all_bufs, grads0,
+                          jnp.zeros((), jnp.float32))
+                (_, _, _, _, _, bufs, grads, loss_sum), _ = jax.lax.scan(
+                    tick, carry0, jnp.arange(T))
             if not stage_local:
                 # each leaf is owned by exactly one device (zeros
                 # elsewhere): psum broadcasts the owner's grad
@@ -628,7 +861,8 @@ class CompiledPipeline:
             return loss, grads, bufs_home(bufs, d_idx)
 
         rep = P()
-        if self.schedule == "gpipe" or (pp == 1 and v == 1
+        if self.schedule == "gpipe" or (self.schedule == "1f1b"
+                                        and pp == 1 and v == 1
                                         and not stage_local):
             loss_sm = _shard_map(
                 gpipe_loss, mesh=self.mesh,
@@ -695,7 +929,8 @@ class CompiledPipeline:
         all_bufs = tuple(
             [b._data for b in bts] for bts in self.stage_buffers)
         base_key = rng_mod.next_key()
-        if self.schedule == "gpipe" or (self.pp == 1 and self.v == 1
+        if self.schedule == "gpipe" or (self.schedule == "1f1b"
+                                        and self.pp == 1 and self.v == 1
                                         and not self.stage_local):
             all_params = tuple(
                 [p._data for p in pts] for pts in self.stage_params)

@@ -488,6 +488,11 @@ COLLECTIVE_BYTES = REGISTRY.counter(
 COLLECTIVE_SECONDS = REGISTRY.histogram(
     "paddle_tpu_collective_seconds",
     "Eager collective wall time", ("collective",))
+GRAD_BUCKETS = REGISTRY.gauge(
+    "paddle_tpu_grad_buckets",
+    "Gradient all-reduce buckets per step for the bucketed reduction "
+    "paths (eager fused_allreduce_gradients / compiled hybrid DP step)",
+    ("path",))
 PIPELINE_BUBBLE_TICKS = REGISTRY.gauge(
     "paddle_tpu_pipeline_stage_bubble_ticks",
     "Idle schedule ticks per pipeline stage for the compiled schedule",

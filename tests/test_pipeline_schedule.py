@@ -37,7 +37,7 @@ def _eager_loss_and_grads(model, x, y):
     return float(loss), {id(p): p.grad.numpy() for p in model.parameters()}
 
 
-@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zero_bubble"])
 @pytest.mark.parametrize("pp,micro", [(2, 2), (4, 4), (2, 4)])
 def test_pipeline_parity_mlp(schedule, pp, micro):
     model = _build_model()
@@ -205,6 +205,153 @@ def test_stage_local_interleaved_combo():
                 np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
 
 
+def test_zero_bubble_matches_1f1b_exactly():
+    """ISSUE 7 acceptance: the split-backward schedule must produce the
+    SAME loss and grads as 1f1b (not just the eager reference) — B+W
+    replay the identical per-(chunk, micro) computation."""
+    pp, micro = 2, 4
+    results = {}
+    for schedule in ("1f1b", "zero_bubble"):
+        model = _build_model(seed=31)
+        model._num_stages = pp
+        n = len(model.run_function)
+        model.segment_parts = [0, int(np.ceil(n / pp)), n]
+        rng = np.random.RandomState(9)
+        x = rng.rand(8, 4).astype(np.float32)
+        y = rng.rand(8, 8).astype(np.float32)
+        runner = CompiledPipeline(model, micro_batches=micro,
+                                  schedule=schedule)
+        loss, grads = runner.loss_and_grads(x, y)
+        results[schedule] = (
+            float(loss),
+            [np.asarray(g) for gs in grads for g in gs])
+    l1, g1 = results["1f1b"]
+    lz, gz = results["zero_bubble"]
+    np.testing.assert_allclose(lz, l1, rtol=1e-6)
+    for a, b in zip(gz, g1):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("pp,v,micro", [(2, 2, 4), (2, 3, 4)])
+def test_zero_bubble_interleaved_parity(pp, v, micro):
+    """Interleaved virtual stages + zero-bubble W sub-ticks: loss AND
+    grads must still match the single-device eager run."""
+    model = _build_model(seed=11)
+    C = pp * v
+    model._num_stages = C
+    n = len(model.run_function)
+    model.segment_parts = [round(i * n / C) for i in range(C + 1)]
+    rng = np.random.RandomState(2)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 8).astype(np.float32)
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+    runner = CompiledPipeline(model, micro_batches=micro,
+                              schedule="zero_bubble",
+                              num_virtual_stages=v)
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+
+def test_zero_bubble_stage_local_parity():
+    model = _build_model(seed=13)
+    pp = 2
+    model._num_stages = pp
+    n = len(model.run_function)
+    model.segment_parts = [0, int(np.ceil(n / pp)), n]
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 8).astype(np.float32)
+    ref_loss, ref_grads = _eager_loss_and_grads(model, x, y)
+    runner = CompiledPipeline(model, micro_batches=4,
+                              schedule="zero_bubble",
+                              stage_local_params=True)
+    loss, grads = runner.loss_and_grads(x, y)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    for pts, gs in zip(runner.stage_params, grads):
+        for p, g in zip(pts, gs):
+            np.testing.assert_allclose(
+                np.asarray(g), ref_grads[id(p)], rtol=2e-4, atol=2e-6)
+
+
+def test_zero_bubble_fewer_bubbles_than_1f1b():
+    """Acceptance: strictly fewer bubble ticks for pp >= 2, M >= 2*pp."""
+    from paddle_tpu.parallel.pipeline_schedule import schedule_bubble_ticks
+    for pp in (2, 3, 4):
+        for v in (1, 2):
+            for M in (2 * pp, 4 * pp):
+                fb, _ = schedule_bubble_ticks("1f1b", pp, v, M)
+                zbb, _ = schedule_bubble_ticks("zero_bubble", pp, v, M)
+                assert all(z < f for z, f in zip(zbb, fb)), \
+                    (pp, v, M, zbb, fb)
+
+
+def test_bubble_ticks_match_live_slot_decode():
+    """Property test (ISSUE 7 satellite): the vectorized
+    schedule_bubble_ticks totals must equal a literal live-slot decode
+    of the compiled schedule formulas over a (pp, v, M) grid, and the
+    zero_bubble totals must equal T_ext minus the per-stage live F/B/W
+    slot count of the emitted W schedule."""
+    from paddle_tpu.parallel.pipeline_schedule import (
+        _decode_grid, _zb_w_schedule, schedule_bubble_ticks)
+
+    def live_slot_reference(pp, v, M):
+        gM, rM = (M - 1) // pp, (M - 1) % pp
+        beta_max = (pp * v - 1) + gM * pp * v + (v - 1) * pp + rM \
+            + (pp - 1)
+        T = 2 * beta_max + 2
+        bubbles = []
+        for d in range(pp):
+            active = 0
+            for t in range(T):
+                if t % 2 == 0:
+                    u = t // 2 - d
+                else:
+                    u = (t - 1) // 2 - (pp * v - 1) - (pp - 1 - d)
+                if u < 0:
+                    continue
+                r = u % pp
+                q = (u - r) // pp
+                g = (q - q % v) // v
+                if g >= 0 and g * pp + r < M:
+                    active += 1
+            bubbles.append(T - active)
+        return bubbles, T
+
+    for pp in (1, 2, 3, 4):
+        for v in (1, 2, 3):
+            for M in (pp, 2 * pp, 3 * pp, 8 * pp):
+                assert schedule_bubble_ticks("1f1b", pp, v, M) == \
+                    live_slot_reference(pp, v, M), (pp, v, M)
+                # zero_bubble: every (chunk, micro) W appears exactly
+                # once on its owner device, strictly after its B tick
+                f_live, b_live, b_c, b_m, T = _decode_grid(pp, v, M)
+                w, T_ext = _zb_w_schedule(pp, v, M)
+                zbb, Tz = schedule_bubble_ticks("zero_bubble", pp, v, M)
+                assert Tz == T_ext
+                for d in range(pp):
+                    codes = [int(c) for c in w[:, d] if c >= 0]
+                    assert sorted(codes) == sorted(
+                        c * M + m for c in range(d, pp * v, pp)
+                        for m in range(M))
+                    # strictly after B; never on a live F/B tick
+                    b_tick = {int(b_c[t, d]) * M + int(b_m[t, d]): t
+                              for t in range(T) if b_live[t, d]}
+                    for t in range(T_ext):
+                        code = int(w[t, d])
+                        if code < 0:
+                            continue
+                        assert t > b_tick[code]
+                        if t < T:
+                            assert not (f_live[t, d] or b_live[t, d])
+                    live = int((f_live[:, d] | b_live[:, d]).sum()) \
+                        + len(codes)
+                    assert zbb[d] == T_ext - live
+
+
 def _bn_model(seed):
     paddle.seed(seed)
     return PipelineLayer(
@@ -220,7 +367,7 @@ def _bn_model(seed):
         loss_fn=nn.MSELoss())
 
 
-@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zero_bubble"])
 def test_train_mode_buffers_update_and_match_micro_eager(schedule):
     """BN-bearing model trains pipelined: running stats update per
     microbatch (the reference PipelineParallel semantics) and match an

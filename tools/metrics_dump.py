@@ -22,6 +22,7 @@ EXPECTED_METRICS = (
     "paddle_tpu_jit_compile_seconds_total",
     "paddle_tpu_collective_calls_total",
     "paddle_tpu_collective_bytes_total",
+    "paddle_tpu_grad_buckets",
     "paddle_tpu_train_steps_per_sec",
     "paddle_tpu_hapi_batches_total",
 )
@@ -47,6 +48,14 @@ def run_tiny_loop():
     # eager collective (identity at world_size 1; accounting still runs)
     collective.all_reduce(paddle.to_tensor(
         np.ones((16, 4), np.float32)))
+
+    # bucketed grad reduction: the bucket-plan gauge publishes even on
+    # the single-controller identity path
+    from paddle_tpu.parallel.fleet_utils import fused_allreduce_gradients
+    lin = paddle.nn.Linear(4, 4)
+    (lin(paddle.to_tensor(np.ones((2, 4), np.float32))) ** 2) \
+        .sum().backward()
+    fused_allreduce_gradients(list(lin.parameters()))
 
     class DS(paddle.io.Dataset):
         def __len__(self):
