@@ -558,6 +558,86 @@ def bench_serving():
     }
 
 
+def bench_serving_router():
+    """ISSUE 8 extra: 2-replica `ReplicaRouter` under a Poisson
+    multi-tenant shared-prefix stream (tiny GPT, every platform) —
+    aggregate tokens/sec across replicas, prefix-affinity hit ratio,
+    and the failover count after a forced replica crash at ~60% of the
+    stream (the surviving replica must finish the in-flight requests
+    with greedy-identical outputs, so served tokens stay exact)."""
+    import asyncio
+    import time as _time
+
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.serving.distributed import ReplicaRouter
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.frontend import ServingFrontend
+
+    rng = np.random.RandomState(0)
+    V, T_new, N = 1024, 16, 24
+    m = GPTForGeneration(vocab_size=V, hidden_size=128, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=512,
+                         compute_dtype="float32")
+    m.eval()
+    heads = [rng.randint(1, V, 32).tolist() for _ in range(2)]
+    fams = rng.randint(0, 2, N)
+    prompts = [heads[f] + rng.randint(1, V, int(n)).tolist()
+               for f, n in zip(fams, rng.randint(4, 24, N))]
+    arrivals = np.cumsum(rng.exponential(0.004, N))
+    arrivals -= arrivals[0]
+
+    fes = []
+    for _ in range(2):
+        eng = ServingEngine(m, max_slots=6, block_size=16,
+                            max_seq_len=128, cache_dtype="float32",
+                            seed=0, prefix_caching=True)
+        eng.generate_batch([prompts[0][:4]], max_new_tokens=2)  # warm
+        fes.append(ServingFrontend(eng, max_pending=32))
+    router = ReplicaRouter(fes, probe_interval=0.02)
+    kill_at = arrivals[int(N * 0.6)]
+
+    async def drive():
+        async def fire(i, t0):
+            delay = arrivals[i] - (_time.perf_counter() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return await router.submit(prompts[i],
+                                       max_new_tokens=T_new,
+                                       tenant=f"t{i % 3}")
+
+        async def crash(t0):
+            await asyncio.sleep(max(0.0, kill_at
+                                    - (_time.perf_counter() - t0)))
+            victim = max(range(2), key=router.queue_depth)
+
+            def boom():
+                raise RuntimeError("bench-injected replica crash")
+            fes[victim].engine.step = boom
+
+        async with router:
+            t0 = _time.perf_counter()
+            outs, _ = await asyncio.gather(
+                asyncio.gather(*[fire(i, t0) for i in range(N)]),
+                crash(t0))
+            wall = _time.perf_counter() - t0
+        return outs, wall
+
+    outs, wall = asyncio.run(drive())
+    served = sum(len(o) for o in outs)
+    stats = router.stats()
+    hit_ratio = stats["affinity_hits"] / max(1, stats["dispatches"])
+    return {
+        "metric": "serving_router",
+        "value": round(served / wall, 1), "unit": "tokens/sec",
+        "replicas": 2, "requests": N,
+        "served_tokens": int(served),
+        "affinity_hit_ratio": round(float(hit_ratio), 3),
+        "failovers": int(stats["failovers"]),
+        "replicas_up_after": len(stats["health"]["up"]),
+    }
+
+
 def bench_serving_prefix_cache():
     """Radix prefix-cache extra (ISSUE 5 acceptance): N requests with a
     shared system-prompt head, cache-on vs cache-off on the SAME
@@ -700,6 +780,15 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["extras"].append(
             {"metric": "serving_prefix_cache",
+             "error": f"{type(e).__name__}: {e}"})
+
+    # multi-replica router extra: every-platform (2 CPU-capable tiny
+    # replicas, Poisson multi-tenant stream, forced mid-stream crash)
+    try:
+        result["extras"].append(bench_serving_router())
+    except Exception as e:  # noqa: BLE001
+        result["extras"].append(
+            {"metric": "serving_router",
              "error": f"{type(e).__name__}: {e}"})
 
     # embedding-engine extra: every-platform (localhost PS servers +

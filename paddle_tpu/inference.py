@@ -27,6 +27,10 @@ class Config:
         self._ir_optim = True
         self._serving = None
         self._max_pending = None
+        self._tensor_parallel = None
+        self._num_replicas = None
+        self._router_policy = None
+        self._sampling = None
 
     # -- continuous batching (paddle_tpu.serving) -------------------------
     def enable_continuous_batching(self, max_slots=None, block_size=None,
@@ -34,7 +38,10 @@ class Config:
                                    token_budget=None, eos_token_id=None,
                                    cache_dtype=None, draft_k=None,
                                    draft_ngram=None, prefix_caching=None,
-                                   max_pending=None):
+                                   max_pending=None, sampling=None,
+                                   tensor_parallel=None,
+                                   num_replicas=None,
+                                   router_policy=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
@@ -44,7 +51,17 @@ class Config:
         `prefix_caching=True` enables the radix-tree prefix KV cache
         (cross-request reuse of shared prompt heads). `max_pending`
         bounds the async frontend's admission queue
-        (`create_serving_frontend`) — see docs/SERVING.md."""
+        (`create_serving_frontend`) — see docs/SERVING.md.
+
+        Distributed serving (docs/SERVING.md "Distributed serving"):
+        `sampling` is a `serving.SamplingConfig` (or a dict of its
+        fields — strategy/temperature/top_k/top_p; speculation
+        auto-disables for non-greedy strategies). `tensor_parallel > 1`
+        shards the mixed step + KV pools over an `mp` mesh
+        (`serving.distributed.TPServingEngine`); `num_replicas > 1`
+        plus `create_serving_router` puts a prefix-affinity
+        `ReplicaRouter` in front of that many frontends
+        (`router_policy`: "affinity" | "round_robin")."""
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
@@ -52,6 +69,10 @@ class Config:
             cache_dtype=cache_dtype, draft_k=draft_k,
             draft_ngram=draft_ngram, prefix_caching=prefix_caching)
         self._max_pending = max_pending
+        self._tensor_parallel = tensor_parallel
+        self._num_replicas = num_replicas
+        self._router_policy = router_policy
+        self._sampling = sampling
         return self
 
     def continuous_batching_enabled(self):
@@ -139,20 +160,85 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-def create_serving_engine(config: Config, model, sampling=None, seed=0):
+def _resolve_sampling(config: Config, sampling):
+    if sampling is not None:
+        return sampling
+    sc = config._sampling
+    if sc is None:
+        return None
+    if isinstance(sc, dict):
+        from .serving.batcher import SamplingConfig
+        return SamplingConfig(**sc)
+    return sc
+
+
+def create_serving_engine(config: Config, model, sampling=None, seed=0,
+                          mesh=None):
     """Build a continuous-batching `serving.ServingEngine` from an
     `enable_continuous_batching()` config and a causal-LM serving model
     (`models.gpt.GPTForGeneration` or anything exposing the same
     `_gen_tensors`/decoder contract). This is the batch-serving mode of
     the AnalysisPredictor surface: one resident engine, many concurrent
-    requests, instead of one `Predictor.run` per fixed-shape batch."""
+    requests, instead of one `Predictor.run` per fixed-shape batch.
+
+    With `tensor_parallel > 1` on the config the engine is a
+    `serving.distributed.TPServingEngine`: same host loop, mixed step
+    and KV pools sharded over an `mp` mesh (`mesh` overrides the
+    default `parallel.mp_layers.tp_mesh` device pick)."""
     if not config.continuous_batching_enabled():
         raise ValueError(
             "call config.enable_continuous_batching(...) first")
-    from .serving.engine import ServingEngine
     kw = {k: v for k, v in config.serving_config().items()
           if v is not None}
+    sampling = _resolve_sampling(config, sampling)
+    tp = config._tensor_parallel
+    if tp is not None and int(tp) > 1:
+        from .serving.distributed.tp_engine import TPServingEngine
+        return TPServingEngine(model, tensor_parallel=int(tp),
+                               mesh=mesh, sampling=sampling, seed=seed,
+                               **kw)
+    from .serving.engine import ServingEngine
     return ServingEngine(model, sampling=sampling, seed=seed, **kw)
+
+
+def create_serving_router(config: Config, model, sampling=None, seed=0):
+    """Build the multi-replica serving stack: `num_replicas` engines
+    (tensor-parallel when `tensor_parallel > 1`; replica r takes the
+    next `tp` local devices, wrapping around) each behind a
+    `ServingFrontend`, fronted by a prefix-affinity
+    `serving.distributed.ReplicaRouter`. `async with router:` starts
+    every replica's step loop plus the health prober;
+    `submit()`/`stream()` dispatch with affinity, load balancing and
+    failover (docs/SERVING.md "Distributed serving")."""
+    if not config.continuous_batching_enabled():
+        raise ValueError(
+            "call config.enable_continuous_batching(...) first")
+    n = int(config._num_replicas or 1)
+    if n < 1:
+        raise ValueError(f"num_replicas must be >= 1, got {n}")
+    from .serving.distributed.router import ReplicaRouter
+    from .serving.frontend import ServingFrontend
+    tp = int(config._tensor_parallel or 1)
+    meshes = [None] * n
+    if tp > 1:
+        import jax
+
+        from .parallel.mp_layers import tp_mesh
+        devices = jax.devices()
+        meshes = [tp_mesh(tp, devices=[
+            devices[(r * tp + i) % len(devices)] for i in range(tp)])
+            for r in range(n)]
+    fkw = {}
+    if config._max_pending is not None:
+        fkw["max_pending"] = int(config._max_pending)
+    frontends = [ServingFrontend(
+        create_serving_engine(config, model, sampling=sampling,
+                              seed=seed, mesh=meshes[r]), **fkw)
+        for r in range(n)]
+    rkw = {}
+    if config._router_policy is not None:
+        rkw["policy"] = config._router_policy
+    return ReplicaRouter(frontends, **rkw)
 
 
 def create_serving_frontend(config: Config, model, sampling=None,

@@ -314,6 +314,21 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
 # ---------------------------------------------------------------------------
 
 
+def _check_pool_heads(name, h_q, k_pool, v_pool):
+    """Queries and pools must carry the SAME head count. Under tensor
+    parallelism both are the per-shard slice (`H // tp`); a mismatch
+    means a caller handed a sharded pool to unsharded queries (or vice
+    versa), which the einsums would otherwise mis-broadcast into
+    garbage attention instead of failing."""
+    if k_pool.shape[-2] != h_q or v_pool.shape[-2] != h_q:
+        raise ValueError(
+            f"{name}: q has {h_q} heads but k_pool/v_pool have "
+            f"{k_pool.shape[-2]}/{v_pool.shape[-2]} — under tensor "
+            "parallelism every operand must be the per-shard head "
+            "slice (serving.distributed.tp_engine shards q and the "
+            "pools together on the 'mp' axis)")
+
+
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
                            positions, *, scale=None):
     """Flat-token attention over a block-paged KV cache — the kernel of
@@ -336,8 +351,15 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     Pure-XLA gather reference path — runs under JAX_PLATFORMS=cpu and
     is the parity oracle; on TPU, XLA fuses the table gather into the
     attention einsums (a hand-tiled Pallas ragged kernel can slot in
-    behind the same signature later)."""
+    behind the same signature later).
+
+    Tensor parallelism: the TP serving engine
+    (`serving.distributed.tp_engine`) calls this INSIDE shard_map with
+    the head axis partitioned on `mp` — q and the pools both arrive as
+    the per-shard head slice, and per-head attention needs no
+    cross-shard communication. The head counts must agree."""
     T, H, Dh = q.shape
+    _check_pool_heads("ragged_paged_attention", H, k_pool, v_pool)
     BS = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
@@ -378,8 +400,11 @@ def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
 
     Pure-XLA gather path (CPU-safe parity oracle); on TPU XLA fuses
     the table gather into the attention einsums — a hand-tiled Pallas
-    multi-query paged kernel can slot in behind the same signature."""
+    multi-query paged kernel can slot in behind the same signature.
+    Under tensor parallelism q and the pools are the per-shard head
+    slice, like `ragged_paged_attention`."""
     B, K, H, Dh = q.shape
+    _check_pool_heads("verify_paged_attention", H, k_pool, v_pool)
     BS = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
@@ -403,8 +428,10 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
 
     On a TPU backend with lane-aligned shapes this dispatches to jax's
     Pallas paged-attention kernel (the production path); everywhere
-    else it runs the pure-XLA gather reference above."""
+    else it runs the pure-XLA gather reference above. Under tensor
+    parallelism q and the pools are the per-shard head slice."""
     B, H, Dh = q.shape
+    _check_pool_heads("paged_attention", H, k_pool, v_pool)
     MB = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)

@@ -142,6 +142,86 @@ class TensorParallel(Layer):
         return self._layers.set_state_dict(sd, *a, **k)
 
 
+def tp_mesh(tensor_parallel, devices=None):
+    """1-D `("mp",)` mesh over `tensor_parallel` devices — the mesh the
+    TP serving engine (`serving.distributed.tp_engine`) shards its
+    mixed step and KV block pools over. `devices` defaults to the
+    process-local `jax.devices()` (on the CPU test harness those are
+    the virtual `--xla_force_host_platform_device_count` devices)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    tp = int(tensor_parallel)
+    if tp < 1:
+        raise ValueError(f"tensor_parallel must be >= 1, got {tp}")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor_parallel={tp} needs {tp} devices, have "
+            f"{len(devices)}")
+    return Mesh(np.array(devices[:tp]), ("mp",))
+
+
+def shard_major_qkv(arr, tp, num_heads, head_dim):
+    """Permute a fused-QKV last axis from `(3, H, Dh)` order into
+    shard-major `(tp, 3, H//tp, Dh)` order, flat shape unchanged.
+
+    The fused stack stores q/k/v concatenated along the out axis, so a
+    contiguous split of that axis over `mp` would hand shard 0 all of q
+    plus part of k — NOT a head split. After this permutation each
+    contiguous 1/tp chunk is exactly `(3, H//tp, Dh)` — one shard's q,
+    k and v head slice in the layout `_qkv` expects when its cfg says
+    `num_heads = H//tp` — so a plain `P(..., "mp")` sharding of the
+    flat axis IS head partitioning. Applies to `qkv_w [L, D, 3*H*Dh]`,
+    `qkv_b [L, 3*H*Dh]` and the weight-only `qkv_s` scales alike."""
+    import jax.numpy as jnp
+    tp = int(tp)
+    lead = arr.shape[:-1]
+    flat = arr.shape[-1]
+    if flat != 3 * num_heads * head_dim:
+        raise ValueError(
+            f"fused-QKV axis {flat} != 3*{num_heads}*{head_dim}")
+    if num_heads % tp:
+        raise ValueError(f"num_heads={num_heads} not divisible by "
+                         f"tensor_parallel={tp}")
+    x = arr.reshape(*lead, 3, tp, num_heads // tp, head_dim)
+    x = jnp.moveaxis(x, -4, -3)          # [..., tp, 3, H_loc, Dh]
+    return x.reshape(*lead, flat)
+
+
+#: decoder-stack param name -> (PartitionSpec, needs shard-major QKV
+#: permutation) for head-partitioned tensor-parallel serving. Column-
+#: parallel weights (qkv, ffn1) shard their OUT axis; row-parallel
+#: weights (attn out, ffn2) shard their IN axis and the step body
+#: psums the partial products; norms, biases-after-psum and the
+#: weight-only per-out-channel scales of row-parallel mats replicate.
+SERVING_TP_SPECS = {
+    "ln_s": (P(), False), "ln_b": (P(), False),
+    "qkv_w": (P(None, None, "mp"), True),
+    "qkv_b": (P(None, "mp"), True),
+    "qkv_s": (P(None, "mp"), True),
+    "out_w": (P(None, "mp", None), False),
+    "out_b": (P(), False), "out_s": (P(), False),
+    "ffn_ln_s": (P(), False), "ffn_ln_b": (P(), False),
+    "ffn1_w": (P(None, None, "mp"), False),
+    "ffn1_b": (P(None, "mp"), False),
+    "ffn1_s": (P(None, "mp"), False),
+    "ffn2_w": (P(None, "mp"), False),
+    "ffn2_b": (P(), False), "ffn2_s": (P(), False),
+}
+
+
+def serving_tp_spec(name):
+    """PartitionSpec + permute flag for one decoder param under the TP
+    serving engine. Unknown names (e.g. MoE gates) raise so new stack
+    variants fail loudly instead of silently replicating."""
+    try:
+        return SERVING_TP_SPECS[name]
+    except KeyError:
+        raise ValueError(
+            f"no tensor-parallel sharding rule for decoder param "
+            f"{name!r} — add it to parallel.mp_layers.SERVING_TP_SPECS")
+
+
 def place_model_on_mesh(model, mesh):
     """device_put every parameter/buffer to its dist_spec sharding
     (replicated by default) so compiled steps run SPMD over the mesh."""

@@ -5,9 +5,11 @@ cache (`kv_cache`), a radix-tree prefix cache for cross-request KV
 reuse (`prefix_cache`), a FIFO/preemption scheduler (`scheduler`),
 token-budget batching + sampling heads + the tenant-fair admission
 queue (`batcher`), serving metrics (`metrics`), the single-compile
-mixed-step `ServingEngine` (`engine`), and the asyncio multi-tenant
-ingress `ServingFrontend` (`frontend`). See docs/SERVING.md for the
-slot protocol and prefix-cache semantics.
+mixed-step `ServingEngine` (`engine`), the asyncio multi-tenant
+ingress `ServingFrontend` (`frontend`), and the distributed layer
+(`distributed`): the tensor-parallel `TPServingEngine` and the
+multi-replica prefix-affinity `ReplicaRouter`. See docs/SERVING.md
+for the slot protocol, prefix-cache and distributed semantics.
 
 `engine`/`frontend` (and their model deps) load lazily so the light
 modules here can be imported from `incubate/nn/generation.py` without
@@ -27,7 +29,8 @@ __all__ = [
     "SamplingConfig", "BlockAllocator", "PagedKVCache", "Request",
     "Scheduler", "ServingEngine", "ServingFrontend", "FairQueue",
     "RadixPrefixCache", "batcher", "kv_cache", "metrics", "scheduler",
-    "prefix_cache", "engine", "frontend",
+    "prefix_cache", "engine", "frontend", "distributed",
+    "TPServingEngine", "ReplicaRouter",
 ]
 
 _LAZY = {
@@ -35,6 +38,9 @@ _LAZY = {
     "engine": ("engine", None),
     "ServingFrontend": ("frontend", "ServingFrontend"),
     "frontend": ("frontend", None),
+    "distributed": ("distributed", None),
+    "TPServingEngine": ("distributed", "TPServingEngine"),
+    "ReplicaRouter": ("distributed", "ReplicaRouter"),
 }
 
 

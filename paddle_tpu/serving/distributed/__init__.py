@@ -1,0 +1,23 @@
+"""paddle_tpu.serving.distributed — multi-chip / multi-replica serving.
+
+Two layers over the single-process serving stack (docs/SERVING.md,
+"Distributed serving"):
+
+* `tp_engine.TPServingEngine` — the ONE compiled mixed step and the
+  paged KV block pools sharded over a 1-D `("mp",)` tensor-parallel
+  mesh: heads partitioned, block tables replicated, token-identical to
+  the TP=1 engine and still exactly one compile per engine.
+* `router.ReplicaRouter` — asyncio ingress over N `ServingFrontend`
+  replicas with prefix-affinity dispatch (a router-side shadow radix
+  index estimates each replica's cached prefixes), queue-depth load
+  balancing, health probes (`health.ReplicaHealth`) and lossless
+  failover: a dead replica's in-flight requests re-submit elsewhere
+  (prompts are re-prefillable; greedy outputs are identical).
+"""
+from .health import ReplicaHealth  # noqa: F401
+from .router import (NoReplicaAvailable, ReplicaRouter,  # noqa: F401
+                     ShadowRadixIndex)
+from .tp_engine import TPServingEngine  # noqa: F401
+
+__all__ = ["TPServingEngine", "ReplicaRouter", "ReplicaHealth",
+           "ShadowRadixIndex", "NoReplicaAvailable"]

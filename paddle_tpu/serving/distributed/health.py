@@ -1,0 +1,113 @@
+"""Replica health tracking for the multi-replica router.
+
+A replica is one `ServingFrontend` (its background step-loop task
+drives one engine). Health here is deliberately simple and fully
+in-process: a replica is DISPATCHABLE while its step-loop task exists,
+has not finished, and the frontend has not been closed — exactly the
+conditions under which a submitted request will eventually be served.
+A step-loop that died on an engine exception, a frontend that was
+stopped, or a task that was cancelled outright all probe as down.
+
+Two consumers:
+
+* the router's dispatch path calls `alive(i)` synchronously per
+  request, so a death is noticed at the very next dispatch even
+  between prober ticks;
+* the async prober (`run()`) sweeps every `interval` seconds and fires
+  the per-replica `down_event` — the router's in-flight streams wait
+  on that event alongside their token queue, which is what rescues
+  requests stranded on a replica that died WITHOUT failing its
+  handles (e.g. a hard-cancelled task).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ..metrics import ROUTER_REPLICAS_UP
+
+
+class ReplicaHealth:
+    def __init__(self, frontends):
+        self.frontends = list(frontends)
+        n = len(self.frontends)
+        self._down = [False] * n
+        self._events = [None] * n      # created lazily (need a loop)
+        self.probes = 0
+
+    # ----------------------------------------------------------- state
+    def __len__(self):
+        return len(self.frontends)
+
+    def probe(self, i):
+        """True when replica `i`'s step loop is running right now."""
+        self.probes += 1
+        fe = self.frontends[i]
+        task = fe._task
+        return (not fe._closed and task is not None
+                and not task.done())
+
+    def alive(self, i):
+        """Dispatchable: not marked down AND probing healthy. A failed
+        probe marks the replica down as a side effect, so dispatch
+        never races the async prober."""
+        if self._down[i]:
+            return False
+        if not self.probe(i):
+            self.mark_down(i)
+            return False
+        return True
+
+    @property
+    def num_up(self):
+        return sum(self.alive(i) for i in range(len(self.frontends)))
+
+    def mark_down(self, i):
+        if not self._down[i]:
+            self._down[i] = True
+            ev = self._events[i]
+            if ev is not None:
+                ev.set()
+        self._export()
+
+    def mark_up(self, i):
+        """Manual revive (a restarted frontend re-enters rotation)."""
+        self._down[i] = False
+        ev = self._events[i]
+        if ev is not None:
+            # clear the SAME Event object rather than discarding it:
+            # in-flight streams' watchers hold a reference, and a
+            # fresh Event would orphan them — a later death would fire
+            # the replacement while they wait forever on the old one
+            ev.clear()
+        self._export()
+
+    def down_event(self, i):
+        """The asyncio.Event fired when replica `i` goes down; router
+        streams race it against their token queue."""
+        ev = self._events[i]
+        if ev is None:
+            ev = self._events[i] = asyncio.Event()
+            if self._down[i]:
+                ev.set()
+        return ev
+
+    def snapshot(self):
+        return {"up": [i for i in range(len(self.frontends))
+                       if not self._down[i]],
+                "down": [i for i, d in enumerate(self._down) if d],
+                "probes": self.probes}
+
+    def _export(self):
+        ROUTER_REPLICAS_UP.set(
+            sum(1 for d in self._down if not d))
+
+    # ---------------------------------------------------------- prober
+    async def run(self, interval=0.05):
+        """Background sweep: fire down events for replicas whose step
+        loop died without failing its handles. Cancelled by the router
+        on stop."""
+        while True:
+            for i in range(len(self.frontends)):
+                if not self._down[i] and not self.probe(i):
+                    self.mark_down(i)
+            await asyncio.sleep(interval)

@@ -1,0 +1,440 @@
+"""Multi-replica router with prefix-affinity dispatch.
+
+`ReplicaRouter` fronts N `ServingFrontend` replicas (each one engine —
+plain or tensor-parallel) with the same asyncio `submit()`/`stream()`
+surface a single frontend exposes, adding the scale-out policies:
+
+* **Prefix-affinity dispatch.** Each replica's radix prefix cache only
+  pays off when same-prefix requests LAND on it, so the router keeps a
+  `ShadowRadixIndex` — a block-aligned token trie per replica,
+  recording the prompts (and chat-turn outputs) it has dispatched
+  there. A new request is routed to the replica whose shadow tree
+  holds its longest cached-prefix estimate (>= one full KV block),
+  ties and misses falling back to least-loaded. The shadow tree is an
+  ESTIMATE — the replica may have evicted the blocks — but a stale hit
+  only costs a normal prefill, never correctness.
+* **Queue-depth load balancing.** Load per replica = frontend
+  admission queue + engine FIFO + resident slots + router dispatches
+  not yet admitted; exported per replica as
+  `paddle_tpu_serving_router_replica_queue_depth`.
+* **Health + lossless failover.** `ReplicaHealth` probes each
+  frontend's step-loop task; dispatch skips dead replicas, and an
+  in-flight stream races its token queue against the replica's down
+  event. On a replica death the request re-submits elsewhere and the
+  router suppresses the tokens the caller already received — prompts
+  are re-prefillable, so nothing is lost; with greedy sampling the
+  re-generated tokens are identical (sampled requests may diverge
+  after a failover, same as any re-submission).
+
+Everything is in-process asyncio (the CPU test harness runs 2+
+replicas in one process); the replica boundary is the
+`ServingFrontend` API, so a multi-host transport can slot in behind
+the same router later.
+"""
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+
+from ...profiler import metrics as _pmetrics
+from .. import metrics as smetrics
+from ..frontend import (DeadlineExceeded, FrontendClosed,
+                        RequestCancelled)
+from .health import ReplicaHealth
+
+
+class NoReplicaAvailable(Exception):
+    """Every replica is down (or none was configured)."""
+
+
+class _ReplicaDied(Exception):
+    """Internal: the dispatch replica died mid-stream (down event)."""
+
+
+#: exceptions that MAY mean "the REPLICA failed", not "the REQUEST
+#: failed": a stopped/crashed frontend (FrontendClosed) or an
+#: engine/step-loop error (RuntimeError — e.g. a crashed mixed step;
+#: the step loop fails every handle of that replica with it). The
+#: router confirms with a health probe before failing over: a live
+#: replica can raise RuntimeError for ONE request (the engine-stall
+#: path fails the affected handles and keeps serving), and treating
+#: that as replica death would let a single oversized request mark
+#: every healthy replica down in turn.
+_FAILOVER_ERRORS = (FrontendClosed, RuntimeError, _ReplicaDied)
+
+
+class _ShadowNode:
+    __slots__ = ("children", "stamp", "parent", "key")
+
+    def __init__(self, stamp=0, parent=None, key=None):
+        self.children = {}          # block token tuple -> _ShadowNode
+        self.stamp = stamp
+        self.parent = parent        # None once evicted (and for roots)
+        self.key = key              # this node's chunk in parent.children
+
+
+class ShadowRadixIndex:
+    """Router-side estimate of each replica's radix prefix cache.
+
+    One trie per replica over BLOCK-ALIGNED token chunks (the same
+    granularity `serving.prefix_cache` caches at — partial tail blocks
+    are never cached, so they never count toward affinity either).
+    Bounded: beyond `capacity_blocks` nodes per replica, the
+    oldest-stamped leaves are evicted — mirroring, approximately, the
+    LRU the real cache applies under pool pressure."""
+
+    def __init__(self, block_size, capacity_blocks=4096):
+        self.bs = int(block_size)
+        self.cap = int(capacity_blocks)
+        self._roots = {}                   # replica -> _ShadowNode
+        self._counts = {}                  # replica -> node count
+        self._heaps = {}                   # replica -> [(stamp, seq, node)]
+        self._tick = itertools.count(1)
+        self._seq = itertools.count()      # heap tie-breaker
+
+    def _chunks(self, tokens):
+        toks = [int(t) for t in tokens]
+        return [tuple(toks[i:i + self.bs])
+                for i in range(0, len(toks) - self.bs + 1, self.bs)]
+
+    def match(self, replica, tokens):
+        """Longest cached-prefix estimate, in TOKENS (block multiple)."""
+        node = self._roots.get(replica)
+        if node is None:
+            return 0
+        stamp = next(self._tick)
+        n = 0
+        for chunk in self._chunks(tokens):
+            nxt = node.children.get(chunk)
+            if nxt is None:
+                break
+            nxt.stamp = stamp             # touch: affinity reads keep
+            n += self.bs                  # hot paths resident
+            node = nxt
+        if n and not node.children:
+            # the touched tail is a leaf: record the fresh stamp in the
+            # eviction heap so the touch actually protects it
+            self._push(replica, node)
+        return n
+
+    def insert(self, replica, tokens):
+        root = self._roots.get(replica)
+        if root is None:
+            root = self._roots[replica] = _ShadowNode()
+            self._counts[replica] = 0
+            self._heaps[replica] = []
+        stamp = next(self._tick)
+        node = root
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                child = node.children[chunk] = _ShadowNode(
+                    stamp, node, chunk)
+                self._counts[replica] += 1
+            child.stamp = stamp
+            node = child
+        if node is not root and not node.children:
+            self._push(replica, node)
+        self._evict(replica)
+
+    def drop(self, replica):
+        """Forget a replica's whole tree (it died; its cache is gone)."""
+        self._roots.pop(replica, None)
+        self._counts.pop(replica, None)
+        self._heaps.pop(replica, None)
+
+    def size(self, replica):
+        return self._counts.get(replica, 0)
+
+    def _push(self, replica, node):
+        heapq.heappush(self._heaps[replica],
+                       (node.stamp, next(self._seq), node))
+
+    def _evict(self, replica):
+        # lazy-deletion min-heap over leaf stamps: every live leaf's
+        # LATEST stamp has an entry (pushed on creation and on every
+        # touch), so popping until a valid one is amortized O(log n)
+        # per eviction — this runs on the per-request dispatch path,
+        # where the old full-trie rescan per evicted leaf was O(cap)
+        heap = self._heaps.get(replica)
+        root = self._roots.get(replica)
+        while self._counts.get(replica, 0) > self.cap and heap:
+            stamp, _, node = heapq.heappop(heap)
+            parent = node.parent
+            if (node.stamp != stamp or node.children or parent is None
+                    or parent.children.get(node.key) is not node):
+                continue                  # stale entry: touched,
+            del parent.children[node.key]  # re-parented or already gone
+            node.parent = None
+            self._counts[replica] -= 1
+            if parent is not root and not parent.children:
+                # the parent just became an evictable leaf
+                self._push(replica, parent)
+
+
+class ReplicaRouter:
+    """Prefix-affinity dispatch over N serving frontends.
+
+    Usage::
+
+        router = ReplicaRouter([fe0, fe1])
+        async with router:
+            toks = await router.submit(prompt, max_new_tokens=32)
+            async for tok in router.stream(prompt2, tenant="b"):
+                ...
+
+    `policy` is "affinity" (shadow-radix longest-prefix, falling back
+    to least-loaded) or "round_robin" (the baseline the affinity
+    contract in tools/router_smoke.py is measured against).
+    """
+
+    def __init__(self, frontends, *, policy="affinity",
+                 shadow_capacity=4096, probe_interval=0.05):
+        if not frontends:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self.frontends = list(frontends)
+        self.policy = policy
+        self.health = ReplicaHealth(self.frontends)
+        bs = {fe.engine.block_size for fe in self.frontends}
+        if len(bs) != 1:
+            raise ValueError(
+                f"replicas disagree on block_size: {sorted(bs)}")
+        self.shadow = ShadowRadixIndex(bs.pop(),
+                                       capacity_blocks=shadow_capacity)
+        self.clock = self.frontends[0].engine.clock
+        self.probe_interval = float(probe_interval)
+        self._inflight = [0] * len(self.frontends)
+        self._rr = itertools.count()
+        self._prober = None
+        # raw counters (always on; mirrored into the metrics registry
+        # only when observability is enabled)
+        self.dispatches = 0
+        self.affinity_hits = 0
+        self.failovers = 0
+
+    # ---------------------------------------------------------- lifecycle
+    async def start(self):
+        for fe in self.frontends:
+            await fe.start()
+        if self._prober is None:
+            self._prober = asyncio.get_running_loop().create_task(
+                self.health.run(self.probe_interval))
+        return self
+
+    async def stop(self):
+        if self._prober is not None:
+            self._prober.cancel()
+            try:
+                await self._prober
+            except asyncio.CancelledError:
+                pass
+            self._prober = None
+        for i, fe in enumerate(self.frontends):
+            if self.health.probe(i):
+                await fe.stop()
+
+    async def __aenter__(self):
+        return await self.start()
+
+    async def __aexit__(self, *exc):
+        await self.stop()
+
+    # ----------------------------------------------------------- dispatch
+    def queue_depth(self, i):
+        """The load the balancer compares: everything queued or
+        resident on replica `i`, plus router dispatches in flight that
+        its frontend may not have admitted yet."""
+        fe = self.frontends[i]
+        sch = fe.engine.scheduler
+        return (len(fe._fair) + len(sch.queue) + sch.num_active
+                + self._inflight[i])
+
+    def _export_depths(self):
+        if _pmetrics._enabled:
+            for i in range(len(self.frontends)):
+                smetrics.ROUTER_REPLICA_QUEUE_DEPTH.labels(str(i)).set(
+                    self.queue_depth(i))
+
+    def _pick(self, prompt):
+        """(replica index, affinity_hit) for one dispatch. Raises
+        NoReplicaAvailable when every replica is down."""
+        live = [i for i in range(len(self.frontends))
+                if self.health.alive(i)]
+        if not live:
+            raise NoReplicaAvailable(
+                f"all {len(self.frontends)} replicas are down")
+        self.dispatches += 1
+        if self.policy == "round_robin":
+            idx = live[next(self._rr) % len(live)]
+            self.shadow.insert(idx, prompt)
+            self._export_depths()
+            return idx, False
+        hits = {i: self.shadow.match(i, prompt) for i in live}
+        best = max(hits.values())
+        affinity = best >= self.shadow.bs        # >= one full KV block
+        cands = [i for i in live if hits[i] == best] if affinity \
+            else live
+        idx = min(cands, key=lambda i: (self.queue_depth(i), i))
+        if affinity:
+            self.affinity_hits += 1
+            if _pmetrics._enabled:
+                smetrics.ROUTER_AFFINITY_HITS.inc()
+        # record at DISPATCH time (not completion): concurrent requests
+        # with the same head must converge on the same replica even
+        # before the first one finishes prefill
+        self.shadow.insert(idx, prompt)
+        self._export_depths()
+        return idx, affinity
+
+    # ------------------------------------------------------------ serving
+    async def submit(self, prompt, max_new_tokens=32, *,
+                     tenant="default", timeout=None):
+        """Run one request to completion (with transparent failover);
+        returns its generated token ids."""
+        out = []
+        async for tok in self.stream(prompt, max_new_tokens,
+                                     tenant=tenant, timeout=timeout):
+            out.append(tok)
+        return out
+
+    async def stream(self, prompt, max_new_tokens=32, *,
+                     tenant="default", timeout=None):
+        """Async generator of generated tokens. On a replica death the
+        request transparently re-submits to a live replica; tokens the
+        caller already received are suppressed from the re-run."""
+        deadline = (self.clock() + float(timeout)
+                    if timeout is not None else None)
+        delivered = 0
+        while True:
+            idx, _ = self._pick(prompt)
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    self._count(idx, "expired")
+                    raise DeadlineExceeded()
+            # count the dispatch in the load estimate only until the
+            # replica's frontend admits it into its fair queue — from
+            # then on queue_depth sees it there (then in the engine
+            # FIFO / resident slots), and keeping _inflight held for
+            # the whole request would double-count every admitted
+            # request against that replica
+            self._inflight[idx] += 1
+            pending = [True]
+
+            def _admitted(idx=idx, pending=pending):
+                if pending[0]:
+                    pending[0] = False
+                    self._inflight[idx] -= 1
+                    self._export_depths()
+
+            attempt_out = []
+            try:
+                async for tok in self._attempt(idx, prompt,
+                                               max_new_tokens, tenant,
+                                               remaining, attempt_out,
+                                               _admitted):
+                    if len(attempt_out) > delivered:
+                        delivered += 1
+                        yield tok
+                # replica finished the request: publish the chat turn
+                # to its shadow tree (the engine's finish-insert did
+                # the same with the real blocks)
+                self.shadow.insert(idx, list(prompt) + attempt_out)
+                self._count(idx, "finished")
+                return
+            except _FAILOVER_ERRORS as e:
+                if not isinstance(e, _ReplicaDied) \
+                        and self.health.probe(idx):
+                    # the replica is still serving: this was a
+                    # per-REQUEST failure (e.g. the engine-stall
+                    # RuntimeError for a working set its pool can't
+                    # hold) — surface it; re-submitting the same
+                    # request to identical replicas would just stall
+                    # them one by one
+                    self._count(idx, "error")
+                    raise
+                self.health.mark_down(idx)
+                self.shadow.drop(idx)
+                self.failovers += 1
+                self._count(idx, "failover")
+                if _pmetrics._enabled:
+                    smetrics.ROUTER_FAILOVERS.inc()
+                continue                      # re-dispatch elsewhere
+            except DeadlineExceeded:
+                self._count(idx, "expired")
+                raise
+            except RequestCancelled:
+                self._count(idx, "cancelled")
+                raise
+            except Exception:
+                self._count(idx, "error")
+                raise
+            finally:
+                if pending[0]:
+                    pending[0] = False
+                    self._inflight[idx] -= 1
+                self._export_depths()
+
+    async def _attempt(self, idx, prompt, max_new_tokens, tenant,
+                       timeout, attempt_out, on_admitted):
+        """One dispatch to replica `idx`: forward its stream, racing
+        the replica's down event (rescues requests stranded on a
+        step-loop that died without failing its handles)."""
+        fe = self.frontends[idx]
+        q = asyncio.Queue()
+        agen = fe.stream(prompt, max_new_tokens, tenant=tenant,
+                         timeout=timeout, on_admitted=on_admitted)
+
+        async def pump():
+            try:
+                async for tok in agen:
+                    q.put_nowait(("tok", tok))
+                q.put_nowait(("done", None))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                q.put_nowait(("err", e))
+
+        async def watch():
+            await self.health.down_event(idx).wait()
+            q.put_nowait(("down", None))
+
+        loop = asyncio.get_running_loop()
+        tasks = (loop.create_task(pump()), loop.create_task(watch()))
+        try:
+            while True:
+                kind, val = await q.get()
+                if kind == "tok":
+                    attempt_out.append(val)
+                    yield val
+                elif kind == "done":
+                    return
+                elif kind == "err":
+                    raise val
+                else:                          # down event fired
+                    raise _ReplicaDied(f"replica {idx} died mid-stream")
+        finally:
+            # no awaits here: this finally also runs under GeneratorExit
+            # when the caller abandons the stream. Cancelling the pump
+            # closes fe.stream, whose own finally cancels the engine
+            # request.
+            for t in tasks:
+                t.cancel()
+
+    # ------------------------------------------------------------ helpers
+    def _count(self, idx, outcome):
+        if _pmetrics._enabled:
+            smetrics.ROUTER_REQUESTS.labels(str(idx), outcome).inc()
+
+    def stats(self):
+        """Router-side counters (always on, registry-independent)."""
+        return {"dispatches": self.dispatches,
+                "affinity_hits": self.affinity_hits,
+                "failovers": self.failovers,
+                "health": self.health.snapshot(),
+                "queue_depths": [self.queue_depth(i) for i in
+                                 range(len(self.frontends))]}

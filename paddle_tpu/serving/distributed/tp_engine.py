@@ -1,0 +1,142 @@
+"""Tensor-parallel serving engine: the ONE mixed step, sharded.
+
+`TPServingEngine` runs the exact host loop of `serving.engine`
+(scheduler, paged KV bookkeeping, speculation, prefix cache — all
+inherited unchanged) while the compiled mixed step executes SPMD over
+a 1-D `("mp",)` mesh (`parallel.mp_layers.tp_mesh`):
+
+* **Heads partitioned on `mp`** — the fused QKV out axis is permuted
+  host-side into shard-major order (`mp_layers.shard_major_qkv`) so a
+  plain `P(..., "mp")` sharding IS a head split; each shard's step
+  body runs `_qkv`/attention with `cfg.num_heads = H // tp` and the
+  `ops.pallas.flash_attention` ragged/verify/paged entries see
+  per-shard head slices of q and of the pools.
+* **KV block pools sharded on the head axis** — `[L, NB, BS, H, Dh]`
+  pools carry `P(None, None, None, "mp", None)`, so each chip holds
+  `1/tp` of the KV bytes; block TABLES stay replicated host-side
+  numpy exactly as in the single-chip engine (identical block ids on
+  every shard — the allocator remains one logical free list).
+* **Row-parallel reductions in the body** — the attention out
+  projection and ffn2 each hold a head/ff shard of their IN axis; the
+  shared `_step_body` (engine.py) emits `lax.psum(..., "mp")` for both
+  via `cfg.mp_axis`, after which hidden states are replicated and the
+  sampling head runs identically on every shard.
+
+Contracts (tests/test_tp_serving.py): token parity with the TP=1
+engine on the CPU virtual-device mesh (speculation on and off), still
+exactly ONE compile per engine, allocator/CoW/truncate/prefix-cache
+invariants unchanged per shard.
+"""
+from __future__ import annotations
+
+from ...parallel import shard_map as _shard_map
+from ...parallel.mp_layers import (serving_tp_spec, shard_major_qkv,
+                                   tp_mesh)
+from ..engine import ServingEngine
+
+
+class TPServingEngine(ServingEngine):
+    """`ServingEngine` with the mixed step sharded over an `mp` mesh.
+
+    `tensor_parallel=1` degrades to a 1-device mesh (useful for
+    exercising the shard_map plumbing without parallelism); the host
+    API is identical to the base engine.
+    """
+
+    def __init__(self, model, *, tensor_parallel=2, mesh=None, **kw):
+        dec = model.decoder
+        if getattr(dec, "_num_experts", 0):
+            raise NotImplementedError(
+                "MoE decoder stacks are not tensor-parallel-served yet")
+        tp = int(tensor_parallel)
+        if dec.num_heads % tp:
+            raise ValueError(
+                f"num_heads={dec.num_heads} not divisible by "
+                f"tensor_parallel={tp}")
+        if dec.dim_feedforward % tp:
+            raise ValueError(
+                f"dim_feedforward={dec.dim_feedforward} not divisible "
+                f"by tensor_parallel={tp}")
+        self.tensor_parallel = tp
+        self.mesh = mesh if mesh is not None else tp_mesh(tp)
+        if tuple(self.mesh.axis_names) != ("mp",):
+            raise ValueError(
+                f"TP serving mesh must be 1-D ('mp',), got "
+                f"{self.mesh.axis_names}")
+        super().__init__(model, **kw)
+        self._shard_state()
+
+    # ------------------------------------------------------- sharding
+    def _pool_spec(self):
+        # head axis (index 3) of the [L, NB, BS, H, Dh] pools; trailing
+        # None deliberately OMITTED — jax normalizes step-output specs
+        # by trimming trailing Nones, and a spec-different-but-
+        # placement-identical initial device_put would make the SECOND
+        # step miss the jit cache and recompile (the PR 7 hybrid-step
+        # lesson, re-learned here by contract test)
+        from jax.sharding import PartitionSpec as P
+        return P(None, None, None, "mp")
+
+    def _array_specs(self):
+        """One PartitionSpec per entry of `self._arrays` (the order
+        `_gen_tensors` fixes: we, pe, decoder params, ln_f w/b, head —
+        embeddings and the lm head replicate; decoder params follow
+        `mp_layers.SERVING_TP_SPECS`)."""
+        from jax.sharding import PartitionSpec as P
+        names = self.model._dec_names
+        return ([P(), P()]
+                + [serving_tp_spec(n)[0] for n in names]
+                + [P(), P(), P()])
+
+    def _shard_state(self):
+        """Re-lay out the cast param arrays (shard-major QKV) and
+        device_put params + KV pools to their mesh shardings, so the
+        first step call compiles against the final layouts and never
+        pays a resharding copy."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        dec = self.model.decoder
+        names = self.model._dec_names
+        H, Dh = dec.num_heads, dec.head_dim
+        specs = self._array_specs()
+        permute = ([False, False]
+                   + [serving_tp_spec(n)[1] for n in names]
+                   + [False, False, False])
+        out = []
+        for arr, spec, perm in zip(self._arrays, specs, permute):
+            if perm:
+                arr = shard_major_qkv(arr, self.tensor_parallel, H, Dh)
+            out.append(jax.device_put(
+                arr, NamedSharding(self.mesh, spec)))
+        self._arrays = out
+        psh = NamedSharding(self.mesh, self._pool_spec())
+        self.kv.k_pool = jax.device_put(self.kv.k_pool, psh)
+        self.kv.v_pool = jax.device_put(self.kv.v_pool, psh)
+
+    # ------------------------------------------------------ mixed step
+    def _step_cfg(self):
+        """Per-shard decoder config: local head count + the psum axis
+        (engine._step_body emits the row-parallel reductions off it)."""
+        import dataclasses
+        cfg = self.model.decoder._cfg()
+        return dataclasses.replace(
+            cfg, num_heads=cfg.num_heads // self.tensor_parallel,
+            mp_axis="mp")
+
+    def _build_step(self):
+        from jax.sharding import PartitionSpec as P
+
+        body = self._step_body(self._step_cfg())
+        pool = self._pool_spec()
+        rep = P()
+        # flat-token inputs, block tables and the rng key replicate;
+        # sampled tokens come off the replicated post-psum hidden state
+        # so the token outputs replicate too (check_vma=False: 0.4.x's
+        # checker can't see through the scanned psum)
+        data_in = (rep,) * 6
+        tok_out = (rep, rep) if self.draft_k else rep
+        return _shard_map(
+            body, mesh=self.mesh,
+            in_specs=(self._array_specs(), pool, pool) + data_in,
+            out_specs=(tok_out, pool, pool), check_vma=False)

@@ -72,11 +72,15 @@ class ServingEngine:
             num_blocks = max_slots * mbps + 1
         self.draft_k = int(draft_k)
         self.sampling = sampling or SamplingConfig()
+        self.speculation_disabled = False
         if self.draft_k > 0 and self.sampling.strategy != "greedy":
-            raise ValueError(
-                "speculative serving (draft_k > 0) verifies against the "
-                "greedy continuation; sampling strategies need rejection "
-                "sampling, which is not implemented")
+            # speculation verifies against the GREEDY continuation;
+            # sampled requests would need rejection sampling, so the
+            # engine auto-disables the draft path rather than refuse
+            # the sampling config (ROADMAP: non-greedy sampling in the
+            # serving engine; docs/SERVING.md)
+            self.draft_k = 0
+            self.speculation_disabled = True
         self.token_budget = batcher.choose_token_budget(
             max_slots, self.block_size, token_budget,
             verify_width=self.draft_k + 1)
@@ -118,17 +122,26 @@ class ServingEngine:
         self.steps_run = 0
 
     # ------------------------------------------------------- mixed step
+    def _step_cfg(self):
+        """The decoder config the step body runs under. The TP engine
+        (`serving.distributed.tp_engine`) overrides this with the
+        per-shard head count and an `mp_axis`, and `_step_body` then
+        emits the matching psums — same math, sharded."""
+        return self.model.decoder._cfg()
+
     def _build_step(self):
+        return self._step_body(self._step_cfg())
+
+    def _step_body(self, cfg):
         import jax
         import jax.numpy as jnp
 
         from ..incubate.nn.fused_transformer import (
-            _ffn_dense, _ln, _mm, _qkv)
+            _ffn_dense, _ln, _maybe_psum, _mm, _qkv)
         from ..ops.pallas.flash_attention import (
             ragged_paged_attention, verify_paged_attention)
 
         model = self.model
-        cfg = model.decoder._cfg()
         names = list(model._dec_names) if hasattr(model, "_dec_names") \
             else None
         if names is None:
@@ -184,6 +197,11 @@ class ServingEngine:
                          ap], axis=0)
                 attn = attn.reshape(T, cfg.num_heads * cfg.head_dim)
                 out = _mm(cfg, attn, pl["out_w"], pl.get("out_s"))
+                # row-parallel reduction under TP (no-op when
+                # cfg.mp_axis is None): each shard holds the partial
+                # product of its own head slice; _ffn_dense below does
+                # the same for its row-parallel ffn2
+                out = _maybe_psum(cfg, out)
                 out = out + pl["out_b"].astype(out.dtype)
                 h = h + out
                 hn = _ln(h, pl["ffn_ln_s"], pl["ffn_ln_b"], cfg.epsilon)

@@ -87,8 +87,16 @@ class ServingFrontend:
                 ...
     """
 
+    #: backoff for unproductive iterations (engine reported no work
+    #: done while work remained — e.g. expiry-only rounds): the loop
+    #: sleeps IDLE_BACKOFF_S doubling up to IDLE_BACKOFF_MAX_S instead
+    #: of hammering the executor with no-op engine.step calls
+    IDLE_BACKOFF_S = 0.001
+    IDLE_BACKOFF_MAX_S = 0.05
+
     def __init__(self, engine, *, max_pending=256, engine_queue_depth=None):
         self.engine = engine
+        self.step_calls = 0           # executor dispatches of engine.step
         self._fair = FairQueue(max_pending)
         # how many requests may sit in the ENGINE's FIFO beyond the
         # resident slots: deep enough to keep every slot busy the
@@ -178,13 +186,18 @@ class ServingFrontend:
         return out
 
     async def stream(self, prompt, max_new_tokens=32, *,
-                     tenant="default", timeout=None):
+                     tenant="default", timeout=None, on_admitted=None):
         """Async generator of generated tokens, one per decode step
         (speculative acceptance can deliver several per step). Closing
         the generator — or cancelling its consumer — cancels the
-        request and reclaims its resources."""
+        request and reclaims its resources. `on_admitted` (if given)
+        is called once the request is in the fair queue — i.e. visible
+        to this frontend's own accounting; the router uses it to stop
+        double-counting the dispatch in its load estimate."""
         handle = await self._enqueue(prompt, max_new_tokens, tenant,
                                      timeout)
+        if on_admitted is not None:
+            on_admitted()
         try:
             while True:
                 item = await handle.queue.get()
@@ -233,7 +246,11 @@ class ServingFrontend:
             if handle.cancel_requested:
                 self._finish_handle(handle, RequestCancelled())
                 continue
-            if handle.deadline is not None and now > handle.deadline:
+            # >= (not >): the idle wait below sleeps max(0, deadline -
+            # now), so a handle expiring exactly NOW must be expired on
+            # this pass — a strict > would zero-delay-loop until the
+            # clock ticks past it (forever under a frozen test clock)
+            if handle.deadline is not None and now >= handle.deadline:
                 self._finish_handle(handle, DeadlineExceeded())
                 continue
             try:
@@ -294,13 +311,17 @@ class ServingFrontend:
 
     async def _step_loop_inner(self):
         loop = asyncio.get_running_loop()
+        backoff = 0.0
         while not self._closed:
             self._apply_cancellations()
             self._admit_pending()
             if self.engine.scheduler.has_work:
+                self.step_calls += 1
                 did = await loop.run_in_executor(None, self.engine.step)
                 self._publish()
-                if not did and self.engine.scheduler.has_work:
+                if did:
+                    backoff = 0.0
+                elif self.engine.scheduler.has_work:
                     # engine stall: the block pool cannot cover the
                     # resident working set (ServingEngine.run raises
                     # here) — fail the affected requests rather than
@@ -312,6 +333,13 @@ class ServingFrontend:
                         self.engine.cancel(handle.req)
                         self._live.remove(handle)
                         self._finish_handle(handle, err)
+                else:
+                    # unproductive round (no tokens, no expiries, and
+                    # the work drained between the check and the step):
+                    # back off instead of spinning the executor
+                    backoff = min(backoff * 2 or self.IDLE_BACKOFF_S,
+                                  self.IDLE_BACKOFF_MAX_S)
+                    await asyncio.sleep(backoff)
                 continue
             # idle: the engine has no work, which means _admit_pending
             # drained the fair queue (engine FIFO empty => depth free),

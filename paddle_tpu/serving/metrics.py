@@ -61,6 +61,27 @@ SERVING_PREFIX_EVICTIONS = REGISTRY.counter(
     "paddle_tpu_serving_prefix_cache_evictions_total",
     "Cached KV blocks reclaimed by LRU eviction under pool pressure")
 
+# ---- multi-replica router (serving.distributed.router) -----------------
+ROUTER_REQUESTS = REGISTRY.counter(
+    "paddle_tpu_serving_router_requests_total",
+    "Router dispatches by replica and outcome",
+    ("replica", "outcome"))   # finished|failover|expired|cancelled|error
+ROUTER_AFFINITY_HITS = REGISTRY.counter(
+    "paddle_tpu_serving_router_affinity_hits_total",
+    "Dispatches routed to a replica whose shadow radix index already "
+    "held at least one full block of the prompt")
+ROUTER_FAILOVERS = REGISTRY.counter(
+    "paddle_tpu_serving_router_failovers_total",
+    "In-flight requests re-submitted to another replica after their "
+    "replica died")
+ROUTER_REPLICA_QUEUE_DEPTH = REGISTRY.gauge(
+    "paddle_tpu_serving_router_replica_queue_depth",
+    "Per-replica load the router balances on: frontend admission "
+    "queue + engine FIFO + resident slots", ("replica",))
+ROUTER_REPLICAS_UP = REGISTRY.gauge(
+    "paddle_tpu_serving_router_replicas_up",
+    "Replicas the health layer currently considers dispatchable")
+
 # ---- speculative decoding (draft_k > 0) --------------------------------
 SERVING_ACCEPT_LENGTH = REGISTRY.histogram(
     "paddle_tpu_serving_accept_length",
@@ -96,6 +117,11 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_prefix_cache_hit_tokens_total",
     "paddle_tpu_serving_prefix_cache_miss_tokens_total",
     "paddle_tpu_serving_prefix_cache_evictions_total",
+    "paddle_tpu_serving_router_requests_total",
+    "paddle_tpu_serving_router_affinity_hits_total",
+    "paddle_tpu_serving_router_failovers_total",
+    "paddle_tpu_serving_router_replica_queue_depth",
+    "paddle_tpu_serving_router_replicas_up",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

@@ -326,6 +326,44 @@ class TestServingFrontend:
 
         asyncio.run(run())
 
+    def test_idle_frontend_performs_no_engine_steps(self):
+        """ISSUE 8 satellite regression: the step loop must WAIT when
+        the engine is empty — zero engine.step executor dispatches
+        while idle, both before any traffic and after the last request
+        drains (the PR 6 Poisson soak spends most wall time idle)."""
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+            async with ServingFrontend(eng, max_pending=8) as fe:
+                await asyncio.sleep(0.2)          # idle, no traffic
+                pre_calls = fe.step_calls
+                out = await fe.submit([5, 6, 7], max_new_tokens=4)
+                busy_calls = fe.step_calls
+                await asyncio.sleep(0.2)          # idle again
+                return pre_calls, busy_calls, fe.step_calls, out, eng
+
+        pre, busy, after, out, eng = asyncio.run(run())
+        assert pre == 0                    # no steps before traffic
+        assert busy > 0 and len(out) == 4  # the request ran
+        assert after == busy               # and none after it drained
+        assert eng.steps_run <= busy
+
+    def test_deadline_equal_now_expires_without_spin(self):
+        """A frontend-held handle whose deadline equals the current
+        clock tick must expire on the next pass (>= not >) — a strict
+        comparison would zero-delay-loop until the clock moves."""
+        m = _model()
+
+        async def run():
+            eng = _engine(m)
+            async with ServingFrontend(eng, max_pending=1) as fe:
+                with pytest.raises(DeadlineExceeded):
+                    await fe.submit([3, 4, 5], max_new_tokens=4,
+                                    timeout=0.0)
+
+        asyncio.run(run())
+
 
 # ----------------------------------------------- multi-tenant soak (CI)
 

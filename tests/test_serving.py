@@ -360,6 +360,104 @@ def test_streaming_loop_stops_on_all_eos(monkeypatch):
     assert (out2.numpy()[0] == first).all()
 
 
+# ------------------------------------------------------------- sampling
+
+
+class TestServingSampling:
+    """Non-greedy sampling in the mixed step's select_token path
+    (ISSUE 8 satellite): top-k / top-p / temperature honored,
+    seed-deterministic, speculation auto-disabled."""
+
+    def _model(self):
+        paddle.seed(1234)
+        m = GPTForGeneration(vocab_size=193, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+        m.eval()
+        return m
+
+    def _engine(self, m, **kw):
+        kw.setdefault("max_slots", 4)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("cache_dtype", "float32")
+        return ServingEngine(m, **kw)
+
+    def _prompts(self, lens=(5, 9, 3, 12)):
+        rng = np.random.RandomState(0)
+        return [rng.randint(1, 193, n).tolist() for n in lens]
+
+    def test_sampling_is_seed_deterministic(self):
+        m = self._model()
+        sc = SamplingConfig(strategy="sampling", temperature=1.2,
+                            top_k=40, top_p=0.9)
+        prompts = self._prompts()
+        a = self._engine(m, sampling=sc, seed=7).generate_batch(
+            prompts, max_new_tokens=8)
+        b = self._engine(m, sampling=sc, seed=7).generate_batch(
+            prompts, max_new_tokens=8)
+        c = self._engine(m, sampling=sc, seed=8).generate_batch(
+            prompts, max_new_tokens=8)
+        assert a == b                    # same seed, same tokens
+        assert a != c                    # different seed diverges
+
+    def test_top_k_one_matches_greedy(self):
+        """top_k=1 keeps only the argmax candidate: categorical
+        sampling over it must equal the greedy engine exactly."""
+        m = self._model()
+        prompts = self._prompts()
+        greedy = self._engine(m, seed=0).generate_batch(
+            prompts, max_new_tokens=8)
+        k1 = self._engine(m, sampling=SamplingConfig(
+            strategy="sampling", top_k=1), seed=0).generate_batch(
+            prompts, max_new_tokens=8)
+        assert k1 == greedy
+
+    def test_temperature_changes_distribution(self):
+        m = self._model()
+        prompts = self._prompts()
+        greedy = self._engine(m, seed=0).generate_batch(
+            prompts, max_new_tokens=8)
+        hot = self._engine(m, sampling=SamplingConfig(
+            strategy="sampling", temperature=5.0), seed=0) \
+            .generate_batch(prompts, max_new_tokens=8)
+        assert hot != greedy             # hot sampling leaves the argmax
+
+    def test_speculation_auto_disables_for_sampling(self):
+        """draft_k > 0 with a non-greedy strategy silently falls back
+        to plain decode (greedy-only verify) instead of refusing."""
+        m = self._model()
+        sc = SamplingConfig(strategy="sampling", temperature=1.5)
+        eng = self._engine(m, sampling=sc, seed=3, draft_k=3)
+        assert eng.draft_k == 0
+        assert eng.speculation_disabled
+        ref = self._engine(m, sampling=sc, seed=3).generate_batch(
+            self._prompts(), max_new_tokens=6)
+        out = eng.generate_batch(self._prompts(), max_new_tokens=6)
+        assert out == ref                # identical to a draft_k=0 engine
+        # greedy engines keep speculation on
+        spec = self._engine(m, seed=0, draft_k=3)
+        assert spec.draft_k == 3 and not spec.speculation_disabled
+
+    def test_config_sampling_knob(self):
+        from paddle_tpu import inference
+        m = self._model()
+        cfg = inference.Config().enable_continuous_batching(
+            max_slots=2, block_size=4, max_seq_len=48,
+            cache_dtype="float32",
+            sampling=dict(strategy="sampling", temperature=1.1,
+                          top_k=20))
+        eng = inference.create_serving_engine(cfg, m, seed=5)
+        assert eng.sampling.strategy == "sampling"
+        assert eng.sampling.top_k == 20
+        ref = self._engine(m, sampling=eng.sampling, max_slots=2,
+                           max_seq_len=48, seed=5).generate_batch(
+            self._prompts((4, 7)), max_new_tokens=5)
+        assert eng.generate_batch(self._prompts((4, 7)),
+                                  max_new_tokens=5) == ref
+
+
 # ------------------------------------------------------- smoke-tool wiring
 
 

@@ -257,13 +257,19 @@ class TestServingSpeculative:
             pm.REGISTRY.reset()
             pm.disable()
 
-    def test_sampling_engine_rejected(self):
+    def test_sampling_engine_auto_disables_speculation(self):
+        """Speculation verifies the GREEDY continuation only; a
+        non-greedy sampling config used to be refused outright — since
+        ISSUE 8 it auto-disables the draft path instead (the sampled
+        engine still serves, just without speculation)."""
         from paddle_tpu.serving.batcher import SamplingConfig
         m = _model()
-        with pytest.raises(ValueError, match="greedy"):
-            ServingEngine(m, max_slots=2, block_size=8, max_seq_len=64,
-                          cache_dtype="float32", draft_k=2,
-                          sampling=SamplingConfig("sampling"))
+        eng = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=64, cache_dtype="float32",
+                            draft_k=2,
+                            sampling=SamplingConfig("sampling"))
+        assert eng.draft_k == 0
+        assert eng.speculation_disabled
 
     def test_inference_config_passthrough(self):
         import paddle_tpu.inference as infer

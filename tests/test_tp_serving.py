@@ -1,0 +1,217 @@
+"""Tensor-parallel serving engine tests (ISSUE 8 tentpole a).
+
+Contracts: the TP=2 sharded mixed step is token-identical to the TP=1
+engine on the CPU virtual-device mesh (speculation on and off), still
+compiles exactly ONCE per engine, and the PR 5/6 paged-KV invariants
+(allocator ledger, copy-on-write, speculative truncate, prefix-cache
+adoption) hold with the pools sharded on the head axis.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.parallel.mp_layers import (serving_tp_spec,
+                                           shard_major_qkv, tp_mesh)
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving.distributed import TPServingEngine
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+
+
+def _model(vocab=211, heads=4):
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=vocab, hidden_size=32, num_layers=2,
+                         num_attention_heads=heads,
+                         max_position_embeddings=128,
+                         compute_dtype="float32")
+    m.eval()
+    return m
+
+
+def _prompts(vocab=211, lens=(3, 9, 17, 5)):
+    rng = np.random.RandomState(7)
+    return [rng.randint(1, vocab, n).tolist() for n in lens]
+
+
+def _engine(cls, m, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", "float32")
+    kw.setdefault("seed", 0)
+    return cls(m, **kw)
+
+
+def _compiles():
+    return pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+
+
+# ------------------------------------------------------- mesh/spec helpers
+
+
+class TestTPHelpers:
+    def test_tp_mesh_shape_and_axis(self):
+        mesh = tp_mesh(2)
+        assert mesh.axis_names == ("mp",)
+        assert mesh.devices.shape == (2,)
+        with pytest.raises(ValueError):
+            tp_mesh(0)
+        with pytest.raises(ValueError):
+            tp_mesh(3, devices=[object(), object()])
+
+    def test_shard_major_qkv_is_head_partition(self):
+        """After the permutation, contiguous 1/tp chunks of the flat
+        axis are exactly (3, H//tp, Dh) blocks — shard s's q, k and v
+        head slice in `_qkv` layout."""
+        import jax.numpy as jnp
+        L, D, H, Dh, tp = 2, 6, 4, 5, 2
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.rand(L, D, 3 * H * Dh).astype(np.float32))
+        out = shard_major_qkv(w, tp, H, Dh)
+        ref = np.asarray(w).reshape(L, D, 3, H, Dh)
+        got = np.asarray(out).reshape(L, D, tp, 3, H // tp, Dh)
+        for s in range(tp):
+            np.testing.assert_array_equal(
+                got[:, :, s],
+                ref[:, :, :, s * (H // tp):(s + 1) * (H // tp)])
+
+    def test_shard_major_qkv_validates(self):
+        import jax.numpy as jnp
+        w = jnp.zeros((2, 6, 3 * 4 * 5))
+        with pytest.raises(ValueError):
+            shard_major_qkv(w, 2, 4, 7)     # wrong flat size
+        with pytest.raises(ValueError):
+            shard_major_qkv(w, 3, 4, 5)     # heads % tp != 0
+
+    def test_serving_tp_spec_unknown_name_raises(self):
+        assert serving_tp_spec("qkv_w")[1] is True
+        assert serving_tp_spec("out_w")[1] is False
+        with pytest.raises(ValueError):
+            serving_tp_spec("gate_w")
+
+
+# ---------------------------------------------------------------- engine
+
+
+class TestTPServingEngine:
+    def test_tp2_token_parity_and_single_compile(self):
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            prompts = _prompts()
+            ref = _engine(ServingEngine, m).generate_batch(
+                prompts, max_new_tokens=8)
+            c0 = _compiles()
+            tp = _engine(TPServingEngine, m, tensor_parallel=2)
+            out = tp.generate_batch(prompts, max_new_tokens=8)
+            assert out == ref
+            assert _compiles() - c0 == 1  # exactly one compile, TP=2
+            assert tp.kv.blocks_in_use == 0
+            # pools stayed sharded on the head axis through the steps
+            assert "mp" in str(tp.kv.k_pool.sharding.spec)
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_tp2_speculative_parity_and_single_compile(self):
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            prompts = _prompts()
+            ref = _engine(ServingEngine, m).generate_batch(
+                prompts, max_new_tokens=8)
+            c0 = _compiles()
+            tp = _engine(TPServingEngine, m, tensor_parallel=2,
+                         draft_k=3)
+            out = tp.generate_batch(prompts, max_new_tokens=8)
+            assert out == ref
+            assert _compiles() - c0 == 1
+            assert tp.kv.blocks_in_use == 0  # truncate rolled back
+            assert tp.kv.allocator.invariant_ok
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_tp2_prefix_cache_adoption_cow_parity(self):
+        """Prefix-cache adoption + copy-on-write on SHARDED pools:
+        shared-head requests stay token-identical to the cache-off
+        TP=1 engine, the allocator ledger invariant holds per-shard,
+        and eviction drains to zero."""
+        m = _model()
+        rng = np.random.RandomState(3)
+        common = rng.randint(1, 211, 24).tolist()
+        shared = [common + rng.randint(1, 211, 4).tolist()
+                  for _ in range(6)]
+        ref = _engine(ServingEngine, m, max_slots=2,
+                      max_seq_len=48).generate_batch(
+            shared, max_new_tokens=6)
+        tp = _engine(TPServingEngine, m, tensor_parallel=2, max_slots=2,
+                     max_seq_len=48, prefix_caching=True)
+        out = tp.generate_batch(shared, max_new_tokens=6)
+        assert out == ref
+        assert tp.prefix_cache.hit_tokens > 0       # adoption happened
+        assert tp.kv.allocator.invariant_ok
+        tp.prefix_cache.evict_all()
+        assert tp.kv.blocks_in_use == 0
+        assert "mp" in str(tp.kv.k_pool.sharding.spec)  # CoW kept it
+
+    def test_tp2_preemption_parity(self):
+        """A pool too small for full residency forces preemption +
+        re-prefill; the sharded engine must still match TP=1."""
+        m = _model()
+        prompts = _prompts(lens=(3, 9, 17, 5, 12, 7, 21, 4))
+        ref = _engine(ServingEngine, m, num_blocks=10,
+                      max_seq_len=48).generate_batch(
+            prompts, max_new_tokens=6)
+        tp = _engine(TPServingEngine, m, tensor_parallel=2,
+                     num_blocks=10, max_seq_len=48)
+        out = tp.generate_batch(prompts, max_new_tokens=6)
+        assert out == ref
+        assert tp.scheduler.preemption_count > 0
+        assert tp.kv.allocator.invariant_ok
+
+    def test_tp1_degenerate_mesh_matches(self):
+        m = _model()
+        prompts = _prompts(lens=(4, 11))
+        ref = _engine(ServingEngine, m).generate_batch(
+            prompts, max_new_tokens=5)
+        tp = _engine(TPServingEngine, m, tensor_parallel=1)
+        assert tp.generate_batch(prompts, max_new_tokens=5) == ref
+
+    def test_indivisible_heads_rejected(self):
+        m = _model(heads=4)
+        with pytest.raises(ValueError, match="num_heads"):
+            _engine(TPServingEngine, m, tensor_parallel=3)
+
+    def test_wrong_mesh_axis_rejected(self):
+        import jax
+        from jax.sharding import Mesh
+        m = _model()
+        bad = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        with pytest.raises(ValueError, match="mp"):
+            _engine(TPServingEngine, m, tensor_parallel=2, mesh=bad)
+
+
+# ------------------------------------------------- paged-entry head guard
+
+
+class TestPagedHeadGuard:
+    def test_ragged_head_mismatch_raises(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import (
+            ragged_paged_attention, verify_paged_attention)
+        q = jnp.zeros((4, 2, 8))              # 2 heads (a TP shard)
+        pool = jnp.zeros((3, 4, 4, 8))        # 4 heads (unsharded)
+        bt = jnp.zeros((2, 3), jnp.int32)
+        with pytest.raises(ValueError, match="per-shard head"):
+            ragged_paged_attention(q, pool, pool, bt,
+                                   jnp.zeros(4, jnp.int32),
+                                   jnp.zeros(4, jnp.int32))
+        qv = jnp.zeros((2, 2, 2, 8))
+        with pytest.raises(ValueError, match="per-shard head"):
+            verify_paged_attention(qv, pool, pool, bt,
+                                   jnp.zeros(2, jnp.int32),
+                                   jnp.zeros((2, 2), jnp.int32))

@@ -77,6 +77,14 @@ def run_tiny_loop():
 def main(argv=None):
     from paddle_tpu.profiler import metrics
 
+    # the serving-side contract names (engine, prefix cache, router —
+    # serving.metrics.CONTRACT_METRICS) must be REGISTERED by import
+    # alone: a renamed metric would silently break the dashboards and
+    # the serving/router smoke greps, so this dump greps them too
+    # (registration prints their TYPE lines; activity is the smokes'
+    # job)
+    from paddle_tpu.serving.metrics import CONTRACT_METRICS
+
     metrics.enable()
     try:
         run_tiny_loop()
@@ -84,7 +92,8 @@ def main(argv=None):
     finally:
         metrics.disable()
     print(text)
-    missing = [name for name in EXPECTED_METRICS if name not in text]
+    missing = [name for name in EXPECTED_METRICS + tuple(CONTRACT_METRICS)
+               if name not in text]
     if missing:
         print(f"MISSING METRICS: {missing}", file=sys.stderr)
         return 1
