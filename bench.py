@@ -698,6 +698,108 @@ def bench_serving_prefix_cache():
     return extra
 
 
+def bench_serving_kv_int8():
+    """ISSUE 9 extra: fp32 vs int8 KV block pools on the SAME Poisson
+    request stream at an EQUAL HBM budget (tiny GPT, every platform).
+    Reports tokens/sec both sides, the max concurrent residents each
+    pool held before its first preemption, KV bytes/token (the
+    `paddle_tpu_serving_kv_bytes_per_token` gauge value) and the
+    greedy token-agreement ratio — so the capacity win can't hide a
+    divergence break."""
+    import time as _time
+
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.serving.engine import ServingEngine
+
+    rng = np.random.RandomState(0)
+    V, T_new, N = 1024, 12, 24
+    m = GPTForGeneration(vocab_size=V, hidden_size=128, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=512,
+                         compute_dtype="float32")
+    m.eval()
+    prompts = [rng.randint(1, V, int(n)).astype(np.int32)
+               for n in rng.randint(8, 56, N)]
+    arrivals = np.cumsum(rng.exponential(0.002, N))
+    arrivals -= arrivals[0]
+    warm = rng.randint(1, V, 8).astype(np.int32)
+
+    # equal HBM budget: what 24 fp32 blocks cost, both pools must fit
+    # in — tight enough that the fp32 side preempts under the stream.
+    # block_bytes is a pure function of the cache geometry, so size
+    # the pools from throwaway minimal PagedKVCaches instead of full
+    # engine constructions.
+    from paddle_tpu.serving.kv_cache import PagedKVCache
+
+    def _block_bytes(kv_dtype):
+        return PagedKVCache(
+            2, 4, 32, num_blocks=2, block_size=16, max_slots=1,
+            max_blocks_per_slot=1, dtype="float32",
+            kv_dtype=kv_dtype).block_bytes
+
+    budget = 24 * _block_bytes(None)
+
+    def run(kv_dtype):
+        nb = int(budget // _block_bytes(kv_dtype))
+        eng = ServingEngine(m, max_slots=8, block_size=16,
+                            num_blocks=nb + 1, max_seq_len=128,
+                            cache_dtype="float32", kv_dtype=kv_dtype,
+                            seed=0)
+        eng.generate_batch([warm], max_new_tokens=2)      # compile
+        t0 = _time.perf_counter()
+        pending = list(zip(prompts, arrivals))
+        reqs = []
+        residents_pre = 0
+        while pending or eng.scheduler.has_work:
+            now = _time.perf_counter() - t0
+            while pending and pending[0][1] <= now:
+                p, _ = pending.pop(0)
+                reqs.append(eng.submit(p, T_new))
+            if eng.scheduler.preemption_count == 0:
+                residents_pre = max(residents_pre,
+                                    eng.scheduler.num_active)
+            if not eng.step() and pending:
+                _time.sleep(max(0.0, pending[0][1]
+                                 - (_time.perf_counter() - t0)))
+        wall = _time.perf_counter() - t0
+        served = sum(len(r.output) for r in reqs)
+        return {
+            "blocks": nb,
+            "tokens_per_sec": round(served / wall, 1),
+            "kv_bytes_per_token": int(eng.kv.kv_bytes_per_token),
+            "max_residents_before_preemption": int(residents_pre),
+            "preemptions": int(eng.scheduler.preemption_count),
+            "outputs": [list(r.output) for r in reqs],
+        }
+
+    fp = run(None)
+    q8 = run("int8")
+    total = sum(len(o) for o in fp["outputs"])
+    agree = sum(a == b for x, y in zip(fp["outputs"], q8["outputs"])
+                for a, b in zip(x, y))
+    # cascade-aware: positionwise agreement punishes every token after
+    # a single flip (the context legitimately diverged); the prefix
+    # metric counts tokens up to each request's first mismatch
+    prefix = 0
+    for x, y in zip(fp["outputs"], q8["outputs"]):
+        for a, b in zip(x, y):
+            if a != b:
+                break
+            prefix += 1
+    for r in (fp, q8):
+        del r["outputs"]
+    return {
+        "metric": "serving_kv_int8",
+        "value": q8["tokens_per_sec"], "unit": "tokens/sec",
+        "fp32": fp, "int8": q8,
+        "hbm_budget_bytes": int(budget),
+        "capacity_ratio": round(q8["blocks"] / fp["blocks"], 3),
+        "greedy_agreement": round(agree / max(1, total), 4),
+        "greedy_prefix_agreement": round(prefix / max(1, total), 4),
+        "requests": N,
+    }
+
+
 def _metrics_extra():
     """Condensed observability snapshot for the benchmark JSON `extras`
     (only when PADDLE_TPU_METRICS is set — instrumentation off keeps the
@@ -789,6 +891,15 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["extras"].append(
             {"metric": "serving_router",
+             "error": f"{type(e).__name__}: {e}"})
+
+    # int8-KV extra: every-platform (fp32 vs int8 pools at equal HBM
+    # budget on the same Poisson stream — capacity + agreement record)
+    try:
+        result["extras"].append(bench_serving_kv_int8())
+    except Exception as e:  # noqa: BLE001
+        result["extras"].append(
+            {"metric": "serving_kv_int8",
              "error": f"{type(e).__name__}: {e}"})
 
     # embedding-engine extra: every-platform (localhost PS servers +

@@ -63,6 +63,36 @@ def main_speculative(batch=1, max_new=64, draft_k=4):
     return out
 
 
+def main_kv_int8(n_req=8, max_new=16):
+    """Int8 quantized KV block pools (PR 9): same continuous-batching
+    engine, pools stored int8 with per-entry-per-head fp32 scales —
+    ~2.7-3.8x the resident tokens per chip at a bounded greedy
+    divergence (docs/SERVING.md "KV quantization")."""
+    from paddle_tpu.serving.engine import ServingEngine
+    paddle.seed(0)
+    net = GPTForGeneration(vocab_size=5000, hidden_size=256,
+                           num_layers=4, num_attention_heads=8,
+                           max_position_embeddings=256)
+    net.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 5000, int(n)).tolist()
+               for n in rng.randint(8, 48, n_req)]
+    outs = {}
+    for dt in (None, "int8"):
+        eng = ServingEngine(net, max_slots=4, block_size=16,
+                            max_seq_len=128, cache_dtype="float32",
+                            kv_dtype=dt, seed=0)
+        outs[dt] = eng.generate_batch(prompts, max_new_tokens=max_new)
+        print(f"kv_dtype={dt or 'float32'}: "
+              f"{eng.kv.kv_bytes_per_token} KV bytes/token, "
+              f"{eng.kv.allocator.capacity} blocks")
+    total = sum(len(o) for o in outs[None])
+    agree = sum(a == b for x, y in zip(outs[None], outs["int8"])
+                for a, b in zip(x, y))
+    print(f"int8 greedy agreement: {agree}/{total} tokens")
+    return outs["int8"]
+
+
 def main_async_frontend(n_users=6, max_new=24):
     """Multi-tenant async serving demo: every "user" sends the same
     system prompt plus their own short question through the asyncio
@@ -170,5 +200,6 @@ if __name__ == "__main__":
     main(quant_bits=0)
     main(quant_bits=8)
     main_speculative()
+    main_kv_int8()
     main_async_frontend()
     main_router()

@@ -36,7 +36,8 @@ class Config:
     def enable_continuous_batching(self, max_slots=None, block_size=None,
                                    num_blocks=None, max_seq_len=None,
                                    token_budget=None, eos_token_id=None,
-                                   cache_dtype=None, draft_k=None,
+                                   cache_dtype=None, kv_dtype=None,
+                                   draft_k=None,
                                    draft_ngram=None, prefix_caching=None,
                                    max_pending=None, sampling=None,
                                    tensor_parallel=None,
@@ -49,7 +50,11 @@ class Config:
         only): an n-gram prompt-lookup draft proposes up to `draft_k`
         tokens per decode and one verify pass scores them all.
         `prefix_caching=True` enables the radix-tree prefix KV cache
-        (cross-request reuse of shared prompt heads). `max_pending`
+        (cross-request reuse of shared prompt heads).
+        `kv_dtype="int8"` stores the paged KV pools quantized with
+        per-entry-per-head fp32 scales — roughly 2.7x the resident
+        tokens per chip vs fp32 pools at a documented bounded logit
+        divergence (docs/SERVING.md "KV quantization"). `max_pending`
         bounds the async frontend's admission queue
         (`create_serving_frontend`) — see docs/SERVING.md.
 
@@ -66,7 +71,7 @@ class Config:
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
             token_budget=token_budget, eos_token_id=eos_token_id,
-            cache_dtype=cache_dtype, draft_k=draft_k,
+            cache_dtype=cache_dtype, kv_dtype=kv_dtype, draft_k=draft_k,
             draft_ngram=draft_ngram, prefix_caching=prefix_caching)
         self._max_pending = max_pending
         self._tensor_parallel = tensor_parallel

@@ -52,11 +52,48 @@ def _on_tpu_backend() -> bool:
     return on_tpu_backend()
 
 
+_SPLASH_DIM_QUANTUM = None
+
+
+def splash_head_dim_quantum() -> int:
+    """head_dim multiple the INSTALLED splash kernel accepts.
+
+    jax 0.4.x's kernel refuses head_dim % 128 != 0 at trace time
+    ("head_dim=64 should be a multiple of 128") where newer kernels
+    pad 64-multiples — probed ONCE by `jax.eval_shape`-tracing a
+    minimal kernel at head_dim 64 (abstract eval only: no device work,
+    no compile), so `splash_supported` can gate unsupported shapes to
+    the XLA path at the callsite instead of relying on the
+    trace-and-refuse `_SPLASH_REFUSED` machinery below (which stays as
+    the belt-and-braces net for refusals this probe can't predict)."""
+    global _SPLASH_DIM_QUANTUM
+    if _SPLASH_DIM_QUANTUM is None:
+        try:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (
+                splash_attention_kernel as sk,
+                splash_attention_mask as smask)
+            mask = smask.MultiHeadMask([smask.CausalMask((128, 128))])
+            kern = jax.vmap(sk.make_splash_mha(
+                mask, head_shards=1, q_seq_shards=1, interpret=True))
+            probe = jax.ShapeDtypeStruct((1, 1, 128, 64), jnp.float32)
+            jax.eval_shape(kern, probe, probe, probe)
+            _SPLASH_DIM_QUANTUM = 64
+        except Exception:  # noqa: BLE001 — the gate must never raise:
+            # NotImplementedError is the known 0.4.x refusal, but a
+            # moved module path (ImportError) or a different refusal
+            # type must also degrade to "128-multiples only", keeping
+            # splash_supported a pure fallback decision.
+            _SPLASH_DIM_QUANTUM = 128
+    return _SPLASH_DIM_QUANTUM
+
+
 def splash_supported(seq_len: int, head_dim: int) -> bool:
     """Static gate for the splash kernel: lane-aligned sequence and a
-    head_dim the kernel tiles without padding waste."""
+    head_dim the installed kernel actually tiles (64-multiples only
+    where the kernel pads them — jax 0.4.x wants 128)."""
     return (_on_tpu_backend() and seq_len % 128 == 0
-            and head_dim % 64 == 0 and seq_len >= 128)
+            and head_dim % splash_head_dim_quantum() == 0
+            and seq_len >= 128)
 
 
 def _splash_kernel(n_heads: int, seq_len: int, causal: bool,
@@ -329,8 +366,24 @@ def _check_pool_heads(name, h_q, k_pool, v_pool):
             "pools together on the 'mp' axis)")
 
 
+def _paged_kernel_enabled(head_dim, block_size):
+    from . import paged_attention as _pk
+    return _pk.paged_pallas_enabled(head_dim, block_size)
+
+
+def _gather_dequant(pool, scale_pool, bt, q_dtype):
+    """pool[bt] as q.dtype, dequantized by the per-entry-per-head
+    scales when the pool is int8 (`serving.kv_cache` layout:
+    pool [NB, BS, H, Dh], scales [NB, BS, H])."""
+    g = pool[bt].astype(q_dtype)
+    if scale_pool is not None:
+        g = g * scale_pool[bt].astype(q_dtype)[..., None]
+    return g
+
+
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
-                           positions, *, scale=None):
+                           positions, k_scale=None, v_scale=None, *,
+                           scale=None):
     """Flat-token attention over a block-paged KV cache — the kernel of
     the continuous-batching mixed step (`paddle_tpu.serving.engine`),
     following the Ragged-Paged-Attention shape discipline: ONE fixed
@@ -348,10 +401,16 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     (padding blocks beyond the sequence are masked by construction, so
     the NULL-block garbage is never read through).
 
-    Pure-XLA gather reference path — runs under JAX_PLATFORMS=cpu and
-    is the parity oracle; on TPU, XLA fuses the table gather into the
-    attention einsums (a hand-tiled Pallas ragged kernel can slot in
-    behind the same signature later).
+    With `k_scale`/`v_scale` (`[NB, BS, H]` fp32) the pools are int8
+    and dequantized per entry per head — on the gather path right
+    after the gather, in the Pallas kernels inside the KV tile load.
+
+    On a TPU backend (or under kernel-test interpret mode) this
+    dispatches to the block-table-native Pallas kernel
+    (`ops.pallas.paged_attention.ragged_attend`) — no gathered
+    contiguous KV copy is ever materialized; `PADDLE_TPU_PAGED_PALLAS=0`
+    or a CPU backend keeps the pure-XLA gather path below, which runs
+    under JAX_PLATFORMS=cpu and is the parity oracle.
 
     Tensor parallelism: the TP serving engine
     (`serving.distributed.tp_engine`) calls this INSIDE shard_map with
@@ -363,11 +422,17 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     BS = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
+    if _paged_kernel_enabled(Dh, BS):
+        from .paged_attention import ragged_attend
+        return ragged_attend(q, k_pool, v_pool, block_tables, slot_ids,
+                             positions, k_scale, v_scale, scale=scale)
     safe_slot = jnp.clip(slot_ids, 0, block_tables.shape[0] - 1)
     bt = block_tables[safe_slot]                      # [T, MB]
     S = bt.shape[1] * BS
-    k = k_pool[bt].reshape(T, S, H, Dh).astype(q.dtype)
-    v = v_pool[bt].reshape(T, S, H, Dh).astype(q.dtype)
+    k = _gather_dequant(k_pool, k_scale, bt, q.dtype).reshape(
+        T, S, H, Dh)
+    v = _gather_dequant(v_pool, v_scale, bt, q.dtype).reshape(
+        T, S, H, Dh)
     logits = jnp.einsum("thd,tshd->ths", q, k).astype(jnp.float32)
     logits = logits * scale
     keep = jnp.arange(S)[None, :] <= positions[:, None]   # [T, S]
@@ -377,7 +442,8 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
 
 
 def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
-                           positions, *, scale=None):
+                           positions, k_scale=None, v_scale=None, *,
+                           scale=None):
     """Verify-shaped paged attention: q `[B, K, H, Dh]` — K queries per
     slot (the speculative draft window: the last accepted token plus
     the proposed draft tokens), each attending its own slot's paged
@@ -398,9 +464,11 @@ def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     query j sees drafts 0..j-1 and nothing later, which is exactly the
     sequential-greedy semantics the verifier needs.
 
-    Pure-XLA gather path (CPU-safe parity oracle); on TPU XLA fuses
-    the table gather into the attention einsums — a hand-tiled Pallas
-    multi-query paged kernel can slot in behind the same signature.
+    On a TPU backend (or kernel-test interpret mode) this dispatches
+    to the block-table-native Pallas kernel
+    (`ops.pallas.paged_attention.verify_attend`); otherwise the
+    pure-XLA gather path below is the CPU-safe parity oracle. With
+    `k_scale`/`v_scale` the int8 pools dequantize per entry per head.
     Under tensor parallelism q and the pools are the per-shard head
     slice, like `ragged_paged_attention`."""
     B, K, H, Dh = q.shape
@@ -408,11 +476,17 @@ def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     BS = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
+    if _paged_kernel_enabled(Dh, BS):
+        from .paged_attention import verify_attend
+        return verify_attend(q, k_pool, v_pool, block_tables, slot_ids,
+                             positions, k_scale, v_scale, scale=scale)
     safe_slot = jnp.clip(slot_ids, 0, block_tables.shape[0] - 1)
     bt = block_tables[safe_slot]                      # [B, MB]
     S = bt.shape[1] * BS
-    k = k_pool[bt].reshape(B, S, H, Dh).astype(q.dtype)
-    v = v_pool[bt].reshape(B, S, H, Dh).astype(q.dtype)
+    k = _gather_dequant(k_pool, k_scale, bt, q.dtype).reshape(
+        B, S, H, Dh)
+    v = _gather_dequant(v_pool, v_scale, bt, q.dtype).reshape(
+        B, S, H, Dh)
     logits = jnp.einsum("bkhd,bshd->bhks", q, k).astype(jnp.float32)
     logits = logits * scale
     keep = jnp.arange(S)[None, None, :] <= positions[:, :, None]
@@ -421,33 +495,36 @@ def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     return jnp.einsum("bhks,bshd->bkhd", p, v)
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, context_lens, *,
-                    scale=None):
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                    k_scale=None, v_scale=None, *, scale=None):
     """Decode-shaped paged attention: q [B, H, Dh], one query per
     sequence, attending its first `context_lens[b]` cached tokens.
 
-    On a TPU backend with lane-aligned shapes this dispatches to jax's
-    Pallas paged-attention kernel (the production path); everywhere
-    else it runs the pure-XLA gather reference above. Under tensor
-    parallelism q and the pools are the per-shard head slice."""
+    On a TPU backend (or kernel-test interpret mode) this dispatches
+    to our block-table-native Pallas kernel
+    (`ops.pallas.paged_attention.decode_attend` — handles fp AND int8
+    pools); everywhere else — CPU, shapes the gate refuses, or the
+    `PADDLE_TPU_PAGED_PALLAS=0` kill-switch — the pure-XLA gather
+    reference above runs. (jax's library paged kernel, the TPU path
+    before the grouped kernel landed, accepted only a strict subset
+    of the shapes our gate takes, so it can no longer be reached and
+    was dropped.) Under tensor parallelism q and the pools are the
+    per-shard head slice. `context_lens` must be >= 1 per row: an
+    empty context has no defined attention output (the kernel yields
+    ~0, the gather reference a uniform average — neither meaningful),
+    and the serving engine never decodes an empty slot."""
     B, H, Dh = q.shape
     _check_pool_heads("paged_attention", H, k_pool, v_pool)
-    MB = block_tables.shape[1]
+    BS = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
-    if _on_tpu_backend() and not _INTERPRET and Dh % 128 == 0 \
-            and k_pool.shape[1] % 16 == 0:
-        from jax.experimental.pallas.ops.tpu.paged_attention import (
-            paged_attention as _kernel)
-        ppcb = next(d for d in (8, 4, 2, 1) if MB % d == 0)
-        out = _kernel(
-            (q * scale).astype(q.dtype),
-            jnp.transpose(k_pool, (2, 0, 1, 3)),
-            jnp.transpose(v_pool, (2, 0, 1, 3)),
-            context_lens.astype(jnp.int32), block_tables,
-            pages_per_compute_block=ppcb)
-        return out
+    if _paged_kernel_enabled(Dh, BS):
+        from .paged_attention import decode_attend
+        return decode_attend(q, k_pool, v_pool, block_tables,
+                             context_lens, k_scale, v_scale,
+                             scale=scale)
     return ragged_paged_attention(
         q, k_pool, v_pool, block_tables,
         jnp.arange(B, dtype=jnp.int32),
-        context_lens.astype(jnp.int32) - 1, scale=scale)
+        context_lens.astype(jnp.int32) - 1, k_scale, v_scale,
+        scale=scale)

@@ -40,13 +40,61 @@ class SamplingConfig:
     temperature: float = 1.0
     top_k: int = 0                 # 0 = off
     top_p: float = 1.0             # 1.0 = off
+    repetition_penalty: float = 1.0   # 1.0 = off (HF semantics)
+    presence_penalty: float = 0.0     # 0.0 = off (additive, one-shot)
+    penalty_window: int = 128      # tokens of context the penalties see
 
 
-def select_token(logits, key, sc: SamplingConfig):
-    """logits [B, V] -> token [B] int32 (device-side sampling)."""
+def needs_history(sc: SamplingConfig) -> bool:
+    """True when `select_token` wants the per-slot token-history input
+    (any logit processor active) — the engine then packs a fixed
+    `[max_slots, penalty_window]` history tensor into the mixed step."""
+    return sc.repetition_penalty != 1.0 or sc.presence_penalty != 0.0
+
+
+def apply_logit_penalties(logits, history, sc: SamplingConfig):
+    """Repetition / presence logit processors, fixed-shape.
+
+    logits [B, V]; history [B, W] int32 — each row the last W context
+    tokens (prompt + generated) of its slot, -1-padded. Seen-token
+    membership is ONE scatter-add into a [B, V] mask (duplicates
+    coalesce; -1 padding scatters weight 0), so the processors ride
+    inside the compiled mixed step without any shape that depends on
+    how much each request has generated.
+
+    * repetition (HF semantics): seen tokens' logits are divided by
+      the penalty when positive, multiplied when negative.
+    * presence: a flat subtraction per seen token (one-shot, not
+      count-scaled — the frequency variant would use `counts`)."""
+    import jax.numpy as jnp
+    valid = history >= 0
+    idx = jnp.where(valid, history, 0)
+    counts = jnp.zeros_like(logits).at[
+        jnp.arange(logits.shape[0])[:, None], idx].add(
+        valid.astype(logits.dtype))
+    seen = counts > 0
+    if sc.repetition_penalty != 1.0:
+        rp = float(sc.repetition_penalty)
+        logits = jnp.where(
+            seen, jnp.where(logits > 0, logits / rp, logits * rp),
+            logits)
+    if sc.presence_penalty != 0.0:
+        logits = logits - float(sc.presence_penalty) * seen.astype(
+            logits.dtype)
+    return logits
+
+
+def select_token(logits, key, sc: SamplingConfig, history=None):
+    """logits [B, V] -> token [B] int32 (device-side sampling).
+
+    `history` [B, W] int32 (-1 pad) feeds the repetition/presence
+    logit processors; they compose with greedy AND the top-k/top-p/
+    temperature path (penalties first, then the strategy)."""
     import jax
     import jax.numpy as jnp
     logits = logits.astype(jnp.float32)
+    if history is not None and needs_history(sc):
+        logits = apply_logit_penalties(logits, history, sc)
     if sc.strategy == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if sc.temperature != 1.0:

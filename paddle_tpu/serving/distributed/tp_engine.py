@@ -113,6 +113,12 @@ class TPServingEngine(ServingEngine):
         psh = NamedSharding(self.mesh, self._pool_spec())
         self.kv.k_pool = jax.device_put(self.kv.k_pool, psh)
         self.kv.v_pool = jax.device_put(self.kv.v_pool, psh)
+        if self.kv.quantized:
+            # the [L, NB, BS, H] scale pools shard on the same (head)
+            # axis — trailing-None-trimmed, P(None, None, None, "mp")
+            # happens to be the pool spec verbatim
+            self.kv.k_scale = jax.device_put(self.kv.k_scale, psh)
+            self.kv.v_scale = jax.device_put(self.kv.v_scale, psh)
 
     # ------------------------------------------------------ mixed step
     def _step_cfg(self):
@@ -127,16 +133,23 @@ class TPServingEngine(ServingEngine):
     def _build_step(self):
         from jax.sharding import PartitionSpec as P
 
+        from .. import batcher
+
         body = self._step_body(self._step_cfg())
         pool = self._pool_spec()
         rep = P()
-        # flat-token inputs, block tables and the rng key replicate;
-        # sampled tokens come off the replicated post-psum hidden state
-        # so the token outputs replicate too (check_vma=False: 0.4.x's
-        # checker can't see through the scanned psum)
-        data_in = (rep,) * 6
+        # int8 pools ride (k_scale, v_scale) right after the pools,
+        # sharded on the same head axis; the step returns them too
+        pools = (pool,) * (4 if self.kv.quantized else 2)
+        # flat-token inputs, block tables, the optional logit-processor
+        # history and the rng key replicate; sampled tokens come off
+        # the replicated post-psum hidden state so the token outputs
+        # replicate too (check_vma=False: 0.4.x's checker can't see
+        # through the scanned psum)
+        n_data = 6 + (1 if batcher.needs_history(self.sampling) else 0)
+        data_in = (rep,) * n_data
         tok_out = (rep, rep) if self.draft_k else rep
         return _shard_map(
             body, mesh=self.mesh,
-            in_specs=(self._array_specs(), pool, pool) + data_in,
-            out_specs=(tok_out, pool, pool), check_vma=False)
+            in_specs=(self._array_specs(),) + pools + data_in,
+            out_specs=(tok_out,) + pools, check_vma=False)

@@ -49,8 +49,8 @@ class ServingEngine:
     def __init__(self, model, *, max_slots=8, block_size=16,
                  num_blocks=None, max_seq_len=None, token_budget=None,
                  sampling=None, eos_token_id=None, cache_dtype=None,
-                 seed=0, clock=time.monotonic, draft_k=0,
-                 draft_ngram=3, prefix_caching=False):
+                 kv_dtype=None, seed=0, clock=time.monotonic,
+                 draft_k=0, draft_ngram=3, prefix_caching=False):
         import functools
 
         import jax
@@ -73,12 +73,14 @@ class ServingEngine:
         self.draft_k = int(draft_k)
         self.sampling = sampling or SamplingConfig()
         self.speculation_disabled = False
-        if self.draft_k > 0 and self.sampling.strategy != "greedy":
-            # speculation verifies against the GREEDY continuation;
-            # sampled requests would need rejection sampling, so the
-            # engine auto-disables the draft path rather than refuse
-            # the sampling config (ROADMAP: non-greedy sampling in the
-            # serving engine; docs/SERVING.md)
+        if self.draft_k > 0 and (self.sampling.strategy != "greedy"
+                                 or batcher.needs_history(self.sampling)):
+            # speculation verifies against the GREEDY UNPENALIZED
+            # continuation; sampled requests would need rejection
+            # sampling and penalized ones a per-draft-position history,
+            # so the engine auto-disables the draft path rather than
+            # refuse the sampling config (ROADMAP: non-greedy sampling
+            # in the serving engine; docs/SERVING.md)
             self.draft_k = 0
             self.speculation_disabled = True
         self.token_budget = batcher.choose_token_budget(
@@ -89,7 +91,7 @@ class ServingEngine:
         self.kv = PagedKVCache(
             L, H, Dh, num_blocks=num_blocks,
             block_size=self.block_size, max_slots=max_slots,
-            max_blocks_per_slot=mbps, dtype=dtype)
+            max_blocks_per_slot=mbps, dtype=dtype, kv_dtype=kv_dtype)
         # radix prefix cache: cross-request KV reuse for shared prompt
         # heads (system prompts, few-shot templates, chat history) —
         # registers itself as the kv cache's eviction backstop
@@ -115,8 +117,11 @@ class ServingEngine:
         self._arrays = [a.astype(cdt)
                         if a.dtype in (jnp.float32, jnp.float64) else a
                         for a in (t._data for t in model._gen_tensors())]
+        # int8 pools: the scale arrays are donated alongside the pools
+        # so the quantize-on-append writes alias in place too
+        donate = (1, 2, 3, 4) if self.kv.quantized else (1, 2)
         self._step_fn = instrumented_jit(
-            self._build_step(), STEP_FN_NAME, donate_argnums=(1, 2))
+            self._build_step(), STEP_FN_NAME, donate_argnums=donate)
         self._preempt_seen = 0
         self._prefix_seen = (0, 0, 0)    # hit / miss / evicted deltas
         self.steps_run = 0
@@ -153,9 +158,35 @@ class ServingEngine:
         K = self.draft_k + 1          # verify width (1 = no speculation)
         R = S * K                     # reserved verify region (K > 1)
         sc = self.sampling
+        quant = self.kv.quantized
+        use_hist = batcher.needs_history(sc)
 
-        def step(arrays, k_pool, v_pool, token_ids, slot_ids, positions,
-                 block_tables, sample_index, rng):
+        def quantize(x):
+            """[T, H, Dh] fp -> (int8 values, [T, H] fp32 scales):
+            symmetric per-token-per-head amax scaling. A pure function
+            of the token's own K/V, so quantization is independent of
+            append order, chunking and block sharing (the property the
+            prefix-cache/preemption parity tests rely on)."""
+            xf = x.astype(jnp.float32)
+            s = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+            q8 = jnp.round(xf / jnp.maximum(s, 1e-20)[..., None])
+            return jnp.clip(q8, -127, 127).astype(jnp.int8), s
+
+        def step(arrays, k_pool, v_pool, *rest):
+            # static signature variants (one compile each way): int8
+            # pools add (k_scale, v_scale) after the pools; active
+            # logit processors add the [S, W] history before the rng
+            rest = list(rest)
+            k_scale = v_scale = history = None
+            if quant:
+                k_scale, v_scale = rest[:2]
+                rest = rest[2:]
+            (token_ids, slot_ids, positions, block_tables,
+             sample_index) = rest[:5]
+            rest = rest[5:]
+            if use_hist:
+                history = rest.pop(0)
+            (rng,) = rest
             we, pe, dec_arrays, lnw, lnb, head = \
                 model._split_arrays(arrays)
             params = dict(zip(names, dec_arrays))
@@ -168,16 +199,33 @@ class ServingEngine:
             wo = pos % BS
 
             def layer(carry, xs):
-                h, kp, vp = carry
+                if quant:
+                    h, kp, vp, ksc, vsc = carry
+                else:
+                    h, kp, vp = carry
+                    ksc = vsc = None
                 pl, li = xs
                 hn = _ln(h, pl["ln_s"], pl["ln_b"], cfg.epsilon)
                 q, k, v = _qkv(cfg, pl, hn[None])
                 q, k, v = q[0], k[0], v[0]                  # [T, H, Dh]
-                kp = kp.at[li, wb, wo].set(k.astype(kp.dtype))
-                vp = vp.at[li, wb, wo].set(v.astype(vp.dtype))
+                if quant:
+                    # quantize-on-append: int8 payload + per-entry
+                    # scales land at the same (block, offset) coords
+                    kq, ks_new = quantize(k)
+                    vq, vs_new = quantize(v)
+                    kp = kp.at[li, wb, wo].set(kq)
+                    vp = vp.at[li, wb, wo].set(vq)
+                    ksc = ksc.at[li, wb, wo].set(ks_new)
+                    vsc = vsc.at[li, wb, wo].set(vs_new)
+                    ks_l, vs_l = ksc[li], vsc[li]
+                else:
+                    kp = kp.at[li, wb, wo].set(k.astype(kp.dtype))
+                    vp = vp.at[li, wb, wo].set(v.astype(vp.dtype))
+                    ks_l = vs_l = None
                 if K == 1:
                     attn = ragged_paged_attention(
-                        q, kp[li], vp[li], block_tables, slot_ids, pos)
+                        q, kp[li], vp[li], block_tables, slot_ids, pos,
+                        ks_l, vs_l)
                 else:
                     # the fixed verify region (slot s owns flat tokens
                     # [s*K, (s+1)*K)) runs through the verify-shaped
@@ -188,10 +236,10 @@ class ServingEngine:
                     av = verify_paged_attention(
                         qv, kp[li], vp[li], block_tables,
                         jnp.arange(S, dtype=jnp.int32),
-                        pos[:R].reshape(S, K))
+                        pos[:R].reshape(S, K), ks_l, vs_l)
                     ap = ragged_paged_attention(
                         q[R:], kp[li], vp[li], block_tables,
-                        slot_ids[R:], pos[R:])
+                        slot_ids[R:], pos[R:], ks_l, vs_l)
                     attn = jnp.concatenate(
                         [av.reshape(R, cfg.num_heads, cfg.head_dim),
                          ap], axis=0)
@@ -206,18 +254,27 @@ class ServingEngine:
                 h = h + out
                 hn = _ln(h, pl["ffn_ln_s"], pl["ffn_ln_b"], cfg.epsilon)
                 h = h + _ffn_dense(cfg, pl, hn)
+                if quant:
+                    return (h, kp, vp, ksc, vsc), None
                 return (h, kp, vp), None
 
-            (x, k_pool, v_pool), _ = jax.lax.scan(
-                layer, (x, k_pool, v_pool),
-                (params, jnp.arange(L)))
+            if quant:
+                (x, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
+                    layer, (x, k_pool, v_pool, k_scale, v_scale),
+                    (params, jnp.arange(L)))
+                pools = (k_pool, v_pool, k_scale, v_scale)
+            else:
+                (x, k_pool, v_pool), _ = jax.lax.scan(
+                    layer, (x, k_pool, v_pool),
+                    (params, jnp.arange(L)))
+                pools = (k_pool, v_pool)
             xf = _ln(x, lnw, lnb, cfg.epsilon)
             sidx = jnp.clip(sample_index, 0, T - 1)
             h_last = xf[sidx]                          # [max_slots, D]
             logits = jnp.matmul(h_last, head.astype(h_last.dtype))
-            tok = select_token(logits, rng, sc)
+            tok = select_token(logits, rng, sc, history)
             if K == 1:
-                return tok, k_pool, v_pool
+                return (tok,) + pools
             # greedy scores for EVERY verify-region position: tok_v[s, j]
             # is the model's next token after slot s's j-th fed token —
             # the host accepts the longest draft prefix matching it
@@ -225,7 +282,7 @@ class ServingEngine:
             logits_v = jnp.matmul(hv, head.astype(hv.dtype))
             tok_v = jnp.argmax(logits_v.astype(jnp.float32),
                                axis=-1).astype(jnp.int32)
-            return (tok, tok_v), k_pool, v_pool
+            return ((tok, tok_v),) + pools
 
         return step
 
@@ -258,6 +315,22 @@ class ServingEngine:
             smetrics.SERVING_REQUESTS.labels("cancelled").inc()
         return ok
 
+    def _penalty_history(self):
+        """Fixed `[max_slots, penalty_window]` int32 context window for
+        the in-step logit processors: each resident slot's last W
+        (prompt + generated) tokens, -1-padded — rebuilt host-side per
+        step so the compiled shapes never depend on generation
+        progress."""
+        W = int(self.sampling.penalty_window)
+        hist = np.full((self.kv.max_slots, W), -1, np.int32)
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            toks = req.runtime_prompt[-W:]
+            if toks:
+                hist[slot, :len(toks)] = toks
+        return hist
+
     # -------------------------------------------------------------- run
     def step(self):
         """One engine iteration. Returns True when any work (tokens or
@@ -275,12 +348,22 @@ class ServingEngine:
                        plan.decode, plan.prefills,
                        verify_width=self.draft_k + 1)
         self._rng, sub = jax.random.split(self._rng)
-        out, self.kv.k_pool, self.kv.v_pool = self._step_fn(
-            self._arrays, self.kv.k_pool, self.kv.v_pool,
-            jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
-            jnp.asarray(sp.positions),
-            jnp.asarray(self.kv.block_tables),
-            jnp.asarray(sp.sample_index), sub)
+        args = [self._arrays, self.kv.k_pool, self.kv.v_pool]
+        if self.kv.quantized:
+            args += [self.kv.k_scale, self.kv.v_scale]
+        args += [jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
+                 jnp.asarray(sp.positions),
+                 jnp.asarray(self.kv.block_tables),
+                 jnp.asarray(sp.sample_index)]
+        if batcher.needs_history(self.sampling):
+            args.append(jnp.asarray(self._penalty_history()))
+        args.append(sub)
+        res = self._step_fn(*args)
+        if self.kv.quantized:
+            (out, self.kv.k_pool, self.kv.v_pool, self.kv.k_scale,
+             self.kv.v_scale) = res
+        else:
+            out, self.kv.k_pool, self.kv.v_pool = res
         sch.note_fed(plan)
         self.steps_run += 1
         if self.draft_k:
@@ -358,6 +441,8 @@ class ServingEngine:
             smetrics.SERVING_KV_BLOCKS_IN_USE.set(self.kv.blocks_in_use)
             smetrics.SERVING_KV_BLOCK_UTILIZATION.set(
                 self.kv.utilization)
+            smetrics.SERVING_KV_BYTES_PER_TOKEN.set(
+                self.kv.kv_bytes_per_token)
             new_p = sch.preemption_count - self._preempt_seen
             if new_p:
                 smetrics.SERVING_PREEMPTIONS.inc(new_p)

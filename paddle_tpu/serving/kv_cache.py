@@ -101,11 +101,26 @@ class BlockAllocator:
 
 
 class PagedKVCache:
-    """Paged pools + per-slot block tables + the slot length ledger."""
+    """Paged pools + per-slot block tables + the slot length ledger.
+
+    `kv_dtype="int8"` stores the pools quantized: int8 payloads plus
+    fp32 scale pools `k_scale`/`v_scale` of shape `[L, NB, BS, H]` —
+    one scale per pool ENTRY per head, riding exactly the same
+    `(block, offset)` coordinates as the K/V bytes, so every consumer
+    of a block id (slot tables, CoW, truncate, prefix-cache adoption)
+    carries the scales for free. The granularity is deliberately
+    per-entry rather than per-whole-block: blocks fill incrementally
+    across steps and are SHARED between requests (radix prefix cache),
+    so a whole-block scale would make already-written int8 values
+    depend on later appends — per-entry scales keep quantization a
+    pure function of the token's own fp K/V, which is what preserves
+    the prefix-cache contract ("cached K/V is exactly what
+    re-prefilling would write") and makes the int8 engine
+    deterministic under chunking, preemption and sharing."""
 
     def __init__(self, num_layers, num_heads, head_dim, *, num_blocks,
                  block_size, max_slots, max_blocks_per_slot,
-                 dtype="float32"):
+                 dtype="float32", kv_dtype=None):
         import jax.numpy as jnp
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -115,10 +130,21 @@ class PagedKVCache:
         self.max_slots = int(max_slots)
         self.max_blocks_per_slot = int(max_blocks_per_slot)
         self.dtype = str(dtype)
+        self.kv_dtype = str(kv_dtype) if kv_dtype else self.dtype
+        if self.kv_dtype not in ("float32", "bfloat16", "float16",
+                                 "int8"):
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} not supported; use a float "
+                "dtype or 'int8' (per-entry-per-head scaled)")
         shape = (num_layers, self.num_blocks, self.block_size,
                  num_heads, head_dim)
-        self.k_pool = jnp.zeros(shape, jnp.dtype(self.dtype))
-        self.v_pool = jnp.zeros(shape, jnp.dtype(self.dtype))
+        self.k_pool = jnp.zeros(shape, jnp.dtype(self.kv_dtype))
+        self.v_pool = jnp.zeros(shape, jnp.dtype(self.kv_dtype))
+        self.k_scale = self.v_scale = None
+        if self.quantized:
+            sshape = shape[:-1]                      # [L, NB, BS, H]
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
         self.allocator = BlockAllocator(self.num_blocks)
         self.block_tables = np.zeros(
             (self.max_slots, self.max_blocks_per_slot), np.int32)
@@ -131,6 +157,32 @@ class PagedKVCache:
         self._copy_fn = None
 
     # ------------------------------------------------------------ sizing
+    @property
+    def quantized(self):
+        return self.kv_dtype == "int8"
+
+    @property
+    def kv_bytes_per_token(self):
+        """HBM bytes one cached token costs across K+V and all layers,
+        including the quantization scales — the number the
+        `paddle_tpu_serving_kv_bytes_per_token` gauge publishes and
+        `tools/kv_smoke.py` budgets with. Read per engine step for the
+        gauge, so it is pure host arithmetic on fixed geometry (the
+        explicit itemsize map mirrors the kv_dtype whitelist in
+        __init__ — np.dtype only knows "bfloat16" after jax registers
+        ml_dtypes, an import-order dependency not worth having)."""
+        itemsize = {"float32": 4, "bfloat16": 2,
+                    "float16": 2, "int8": 1}[self.kv_dtype]
+        per = self.num_heads * self.head_dim * itemsize
+        if self.quantized:
+            per += self.num_heads * 4            # fp32 scale per head
+        return 2 * self.num_layers * per         # K and V
+
+    @property
+    def block_bytes(self):
+        """HBM bytes one K+V block (all layers) occupies, incl scales."""
+        return self.kv_bytes_per_token * self.block_size
+
     @property
     def max_slot_tokens(self):
         return self.max_blocks_per_slot * self.block_size
@@ -224,20 +276,40 @@ class PagedKVCache:
     def _copy_block_data(self, src, dst):
         """pool[:, dst] = pool[:, src] for K and V, as ONE jitted
         fixed-shape copy (block ids ride as traced scalars, so every
-        CoW reuses the same executable; pools are donated in place)."""
+        CoW reuses the same executable; pools are donated in place).
+        Quantized pools copy the per-entry scale columns in the SAME
+        executable — a CoW'd block dequantizes identically to its
+        source."""
         import jax.numpy as jnp
 
         if self._copy_fn is None:
             from ..jit.functional import instrumented_jit
 
-            def copy(kp, vp, src, dst):
-                return (kp.at[:, dst].set(kp[:, src]),
-                        vp.at[:, dst].set(vp[:, src]))
+            if self.quantized:
+                def copy(kp, vp, ks, vs, src, dst):
+                    return (kp.at[:, dst].set(kp[:, src]),
+                            vp.at[:, dst].set(vp[:, src]),
+                            ks.at[:, dst].set(ks[:, src]),
+                            vs.at[:, dst].set(vs[:, src]))
 
-            self._copy_fn = instrumented_jit(
-                copy, "serving_prefix_cow", donate_argnums=(0, 1))
-        self.k_pool, self.v_pool = self._copy_fn(
-            self.k_pool, self.v_pool, jnp.int32(src), jnp.int32(dst))
+                self._copy_fn = instrumented_jit(
+                    copy, "serving_prefix_cow",
+                    donate_argnums=(0, 1, 2, 3))
+            else:
+                def copy(kp, vp, src, dst):
+                    return (kp.at[:, dst].set(kp[:, src]),
+                            vp.at[:, dst].set(vp[:, src]))
+
+                self._copy_fn = instrumented_jit(
+                    copy, "serving_prefix_cow", donate_argnums=(0, 1))
+        if self.quantized:
+            (self.k_pool, self.v_pool, self.k_scale,
+             self.v_scale) = self._copy_fn(
+                self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                jnp.int32(src), jnp.int32(dst))
+        else:
+            self.k_pool, self.v_pool = self._copy_fn(
+                self.k_pool, self.v_pool, jnp.int32(src), jnp.int32(dst))
 
     def truncate_slot(self, slot, new_len):
         """Roll back `slot` to cover only `new_len` tokens: blocks past

@@ -35,6 +35,10 @@ SERVING_KV_BLOCKS_IN_USE = REGISTRY.gauge(
 SERVING_KV_BLOCK_UTILIZATION = REGISTRY.gauge(
     "paddle_tpu_serving_kv_block_utilization",
     "Allocated fraction of the allocatable KV block pool")
+SERVING_KV_BYTES_PER_TOKEN = REGISTRY.gauge(
+    "paddle_tpu_serving_kv_bytes_per_token",
+    "HBM bytes one cached token costs across K+V and all layers "
+    "(int8 pools include their per-entry-per-head fp32 scales)")
 SERVING_PREEMPTIONS = REGISTRY.counter(
     "paddle_tpu_serving_preemptions_total",
     "Decode requests evicted (blocks reclaimed, request requeued)")
@@ -106,6 +110,7 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_active_slots",
     "paddle_tpu_serving_kv_blocks_in_use",
     "paddle_tpu_serving_kv_block_utilization",
+    "paddle_tpu_serving_kv_bytes_per_token",
     "paddle_tpu_serving_preemptions_total",
     "paddle_tpu_serving_requests_total",
     "paddle_tpu_serving_tokens_total",

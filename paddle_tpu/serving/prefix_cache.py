@@ -43,6 +43,15 @@ Correctness never depends on the tree: a cold cache (or one evicted to
 nothing) degrades to PR 2 behaviour, and outputs are token-identical
 either way because cached K/V is exactly what re-prefilling the same
 tokens through the same compiled step would write.
+
+Quantized pools (`PagedKVCache(kv_dtype="int8")`) need NO code here:
+the per-entry-per-head scale arrays are indexed by the same
+`(block, offset)` coordinates as the K/V bytes, so adoption shares
+scale rows by sharing block ids, `cow_block` copies the scale columns
+inside its one jitted executable, and the token-identity argument
+above still holds because quantization is a pure per-token function
+(see kv_cache.PagedKVCache) — asserted by the int8 prefix/CoW parity
+cells in tests/test_paged_kernels.py.
 """
 from __future__ import annotations
 

@@ -59,13 +59,34 @@ def test_splash_mha_grads_flow():
 
 
 def test_splash_gate():
-    # the kernel only claims lane-aligned seq and 64-aligned head_dim;
-    # everything else must take the XLA path (and still be correct)
+    # the kernel only claims lane-aligned seq and a head_dim the
+    # INSTALLED kernel tiles; everything else must take the XLA path
+    # (and still be correct)
     assert not splash_supported(100, 64)   # S % 128 != 0
     assert not splash_supported(256, 80)   # D % 64 != 0
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 100, 32))
     out = splash_mha(q, q, q, causal=True)
     assert out.shape == (1, 2, 100, 32)
+
+
+def test_splash_head_dim_quantum_gates_at_callsite(_interpret_splash):
+    """The installed-kernel head_dim limitation (jax 0.4.x refuses
+    head_dim % 128 at trace time) must be detected by the static gate,
+    not by the trace-and-refuse net: a 64-but-not-128 head_dim is
+    either supported by the probe (newer kernels) or gated OFF, and
+    calling splash_mha on it must neither raise nor grow the refusal
+    set."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    quantum = fa.splash_head_dim_quantum()
+    assert quantum in (64, 128)
+    assert splash_supported(256, 64) == (quantum == 64)
+    assert splash_supported(256, 128)
+    fa._SPLASH_REFUSED.clear()
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 128, 64))
+    out = splash_mha(q, q, q, causal=True)
+    assert out.shape == (1, 2, 128, 64)
+    # the callsite gate (not a trace refusal) routed the fallback
+    assert (128, 64) not in fa._SPLASH_REFUSED or quantum == 64
 
 
 def test_functional_flash_attention_uses_dispatch():
@@ -110,7 +131,9 @@ def _interpret_splash():
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_splash_mha_key_padding_matches_oracle(_interpret_splash, causal):
-    B, H, S, D = 2, 2, 128, 64
+    # head_dim 128: a shape the INSTALLED kernel accepts, so the real
+    # segment-id plumbing (not the XLA fallback) runs in interpret mode
+    B, H, S, D = 2, 2, 128, 128
     ks = jax.random.split(jax.random.PRNGKey(2), 3)
     q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
     k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
@@ -131,7 +154,7 @@ def test_splash_mha_key_padding_matches_oracle(_interpret_splash, causal):
 
 
 def test_splash_mha_key_padding_grads(_interpret_splash):
-    B, H, S, D = 1, 2, 128, 64
+    B, H, S, D = 1, 2, 128, 128
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
     q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
     k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
@@ -169,7 +192,7 @@ def test_sdpa_routes_key_padding_mask_to_splash(_interpret_splash,
         return orig(*a, **kw)
     monkeypatch.setattr(fa, "splash_mha", spy)
 
-    B, S, H, D = 2, 128, 2, 64
+    B, S, H, D = 2, 128, 2, 128
     x = paddle.randn([B, S, H, D])
     keep = np.arange(S)[None, :] < np.array([100, 128])[:, None]
     mask = paddle.to_tensor(keep[:, None, None, :])  # [B,1,1,S] bool

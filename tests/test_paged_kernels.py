@@ -1,0 +1,441 @@
+"""Block-table-native Pallas paged-attention kernels + int8 KV pools
+(ISSUE 9).
+
+The parity matrix: every serving attention shape (ragged prefill /
+K-wide verify / K=1 decode) x pool dtype (fp32 / int8) runs the Pallas
+kernel (interpret mode on the CPU mesh — the real scalar-prefetch +
+block-table plumbing, not a shim) against the pure-XLA gather oracle;
+the engine-level matrix covers (fp / int8) x (TP=1 / TP=2 CPU mesh)
+including preemption, copy-on-write, prefix-cache adoption with
+quantized scales, speculation, and the one-compile contract. The int8
+path's bounded-divergence contract is enforced end-to-end by
+tools/kv_smoke.py, wired in here.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import paged_attention as pa
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving.distributed import TPServingEngine
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+from paddle_tpu.serving.kv_cache import PagedKVCache
+
+
+@pytest.fixture
+def _interpret_paged(monkeypatch):
+    """Run the block-table-native kernels in interpret mode so the
+    dispatch gate admits them on the CPU mesh."""
+    monkeypatch.setattr(pa, "_INTERPRET", True)
+    yield
+
+
+@pytest.fixture
+def _force_oracle(monkeypatch):
+    """Pin the XLA gather path regardless of backend/interpret."""
+    monkeypatch.setenv("PADDLE_TPU_PAGED_PALLAS", "0")
+    yield
+
+
+def _rand_pools(rng, NB, BS, H, Dh, quantized):
+    if quantized:
+        kp = rng.randint(-127, 128, (NB, BS, H, Dh)).astype(np.int8)
+        vp = rng.randint(-127, 128, (NB, BS, H, Dh)).astype(np.int8)
+        ks = (np.abs(rng.randn(NB, BS, H)) * 0.02 + 0.005).astype(
+            np.float32)
+        vs = (np.abs(rng.randn(NB, BS, H)) * 0.02 + 0.005).astype(
+            np.float32)
+        return kp, vp, ks, vs
+    kp = rng.randn(NB, BS, H, Dh).astype(np.float32)
+    vp = rng.randn(NB, BS, H, Dh).astype(np.float32)
+    return kp, vp, None, None
+
+
+# ------------------------------------------------- kernel-vs-oracle cells
+
+
+class TestKernelOracleParity:
+    NB, BS, H, Dh, S, MB = 11, 4, 3, 16, 4, 6
+
+    def _setup(self, quantized, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        kp, vp, ks, vs = _rand_pools(rng, self.NB, self.BS, self.H,
+                                     self.Dh, quantized)
+        bt = rng.randint(0, self.NB, (self.S, self.MB)).astype(np.int32)
+        args = [jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt)]
+        scales = [None if ks is None else jnp.asarray(ks),
+                  None if vs is None else jnp.asarray(vs)]
+        return rng, args, scales
+
+    @pytest.mark.parametrize("quantized", [False, True],
+                             ids=["fp32", "int8"])
+    def test_ragged_matches_oracle(self, quantized, monkeypatch,
+                                   _interpret_paged):
+        import jax.numpy as jnp
+        rng, (kp, vp, bt), (ks, vs) = self._setup(quantized)
+        T = 9
+        q = jnp.asarray(rng.randn(T, self.H, self.Dh).astype(np.float32))
+        slots = jnp.asarray(rng.randint(-1, self.S, T).astype(np.int32))
+        pos = jnp.asarray(rng.randint(
+            0, self.MB * self.BS, T).astype(np.int32))
+        got = fa.ragged_paged_attention(q, kp, vp, bt, slots, pos,
+                                        ks, vs)
+        monkeypatch.setenv("PADDLE_TPU_PAGED_PALLAS", "0")
+        ref = fa.ragged_paged_attention(q, kp, vp, bt, slots, pos,
+                                        ks, vs)
+        valid = np.asarray(slots) >= 0        # padding rows are garbage
+        np.testing.assert_allclose(np.asarray(got)[valid],
+                                   np.asarray(ref)[valid],
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("quantized", [False, True],
+                             ids=["fp32", "int8"])
+    def test_verify_matches_oracle(self, quantized, monkeypatch,
+                                   _interpret_paged):
+        import jax.numpy as jnp
+        rng, (kp, vp, bt), (ks, vs) = self._setup(quantized, seed=1)
+        K = 3
+        q = jnp.asarray(rng.randn(self.S, K, self.H,
+                                  self.Dh).astype(np.float32))
+        pos = jnp.asarray(np.sort(rng.randint(
+            0, self.MB * self.BS, (self.S, K)), axis=1).astype(np.int32))
+        slots = jnp.arange(self.S, dtype=jnp.int32)
+        got = fa.verify_paged_attention(q, kp, vp, bt, slots, pos,
+                                        ks, vs)
+        monkeypatch.setenv("PADDLE_TPU_PAGED_PALLAS", "0")
+        ref = fa.verify_paged_attention(q, kp, vp, bt, slots, pos,
+                                        ks, vs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("quantized", [False, True],
+                             ids=["fp32", "int8"])
+    def test_decode_matches_oracle(self, quantized, monkeypatch,
+                                   _interpret_paged):
+        import jax.numpy as jnp
+        rng, (kp, vp, bt), (ks, vs) = self._setup(quantized, seed=2)
+        q = jnp.asarray(rng.randn(self.S, self.H,
+                                  self.Dh).astype(np.float32))
+        lens = jnp.asarray(rng.randint(
+            1, self.MB * self.BS, self.S).astype(np.int32))
+        got = fa.paged_attention(q, kp, vp, bt, lens, ks, vs)
+        monkeypatch.setenv("PADDLE_TPU_PAGED_PALLAS", "0")
+        ref = fa.paged_attention(q, kp, vp, bt, lens, ks, vs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_kill_switch_restores_oracle(self, _interpret_paged,
+                                         _force_oracle):
+        # with the env kill-switch the gate must refuse even under
+        # interpret mode
+        assert not pa.paged_pallas_enabled(128, 16)
+
+    def test_gate_off_cpu_without_interpret(self):
+        # plain CPU backend, no interpret: XLA oracle path
+        assert not pa.paged_pallas_enabled(128, 16)
+
+
+# --------------------------------------------------------- engine matrix
+
+
+def _model(vocab=211):
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=vocab, hidden_size=32, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=128,
+                         compute_dtype="float32")
+    m.eval()
+    return m
+
+
+def _prompts(lens, vocab=211, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, n).tolist() for n in lens]
+
+
+def _engine(cls, m, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("cache_dtype", "float32")
+    kw.setdefault("seed", 0)
+    return cls(m, **kw)
+
+
+class TestEnginePallasPath:
+    """End-to-end: the compiled mixed step running through the
+    interpret-mode Pallas kernels must be TOKEN-IDENTICAL to the XLA
+    oracle path — fp32 exactly, int8 vs its own oracle-path twin."""
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"],
+                             ids=["fp32", "int8"])
+    def test_engine_token_identical(self, kv_dtype, _interpret_paged):
+        m = _model(vocab=97)
+        prompts = _prompts((4, 7, 11), vocab=97)
+        got = _engine(ServingEngine, m, max_slots=2, block_size=4,
+                      max_seq_len=32, kv_dtype=kv_dtype).generate_batch(
+            prompts, max_new_tokens=4)
+        pa._INTERPRET = False
+        try:
+            ref = _engine(ServingEngine, m, max_slots=2, block_size=4,
+                          max_seq_len=32,
+                          kv_dtype=kv_dtype).generate_batch(
+                prompts, max_new_tokens=4)
+        finally:
+            pa._INTERPRET = True
+        assert got == ref
+
+    def test_engine_speculative_pallas_identical(self, _interpret_paged):
+        """The verify-shaped kernel carries the speculative region:
+        draft_k>0 through Pallas must equal the non-speculative Pallas
+        engine (greedy identity) — exercising the G=K grouped cell."""
+        m = _model(vocab=97)
+        prompts = _prompts((4, 9), vocab=97)
+        base = _engine(ServingEngine, m, max_slots=2, block_size=4,
+                       max_seq_len=32).generate_batch(
+            prompts, max_new_tokens=5)
+        spec = _engine(ServingEngine, m, max_slots=2, block_size=4,
+                       max_seq_len=32, draft_k=2).generate_batch(
+            prompts, max_new_tokens=5)
+        assert spec == base
+
+
+class TestEngineInt8:
+    """int8 pools on the XLA oracle path: deterministic quantization
+    invariants the per-entry scales buy (see kv_cache.PagedKVCache)."""
+
+    def test_single_compile_and_agreement(self):
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            # the kv_smoke workload (model seed 0): the >=99% greedy
+            # agreement bound is a property of the real divergence
+            # scale, but WHICH argmaxes sit close enough to flip is
+            # seed-dependent on a random-init model — pin the seed the
+            # documented contract was measured on
+            paddle.seed(0)
+            m = GPTForGeneration(vocab_size=211, hidden_size=32,
+                                 num_layers=2, num_attention_heads=4,
+                                 max_position_embeddings=128,
+                                 compute_dtype="float32")
+            m.eval()
+            prompts = _prompts((3, 9, 17, 5, 12, 7, 21, 4))
+            fp = _engine(ServingEngine, m).generate_batch(
+                prompts, max_new_tokens=6)
+            c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+            q8 = _engine(ServingEngine, m, kv_dtype="int8")
+            out = q8.generate_batch(prompts, max_new_tokens=6)
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0 == 1
+            total = sum(len(o) for o in fp)
+            agree = sum(a == b for x, y in zip(fp, out)
+                        for a, b in zip(x, y))
+            assert agree / total >= 0.99
+            assert q8.kv.blocks_in_use == 0
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_preemption_is_int8_deterministic(self):
+        """Per-token quantization is append-order independent: a
+        preempted + re-prefilled int8 request must emit exactly the
+        tokens of an unpressured int8 run."""
+        m = _model()
+        prompts = _prompts((3, 9, 17, 5, 12, 7, 21, 4))
+        calm = _engine(ServingEngine, m, kv_dtype="int8").generate_batch(
+            prompts, max_new_tokens=6)
+        tight = _engine(ServingEngine, m, kv_dtype="int8",
+                        num_blocks=10)
+        out = tight.generate_batch(prompts, max_new_tokens=6)
+        assert tight.scheduler.preemption_count > 0
+        assert out == calm
+
+    def test_prefix_adoption_cow_carries_scales(self):
+        """Prefix-cache adoption + CoW on int8 pools: shared-head
+        requests must match the uncached int8 engine token for token
+        (the CoW copy includes the scale columns), and the pool must
+        drain clean."""
+        m = _model()
+        rng = np.random.RandomState(3)
+        common = rng.randint(1, 211, 24).tolist()
+        shared = [common + rng.randint(1, 211, 4).tolist()
+                  for _ in range(4)]
+        # fully-cached prompts (== common): the hit ends mid-block, so
+        # admission must CoW the last shared block before re-feeding
+        # its final token — the cell that exercises scale-carrying CoW
+        shared.insert(2, list(common))
+        shared.append(list(common))
+        plain = _engine(ServingEngine, m, max_slots=2,
+                        kv_dtype="int8").generate_batch(
+            shared, max_new_tokens=6)
+        cached = _engine(ServingEngine, m, max_slots=2,
+                         kv_dtype="int8", prefix_caching=True)
+        out = cached.generate_batch(shared, max_new_tokens=6)
+        assert out == plain
+        assert cached.prefix_cache.hit_tokens > 0
+        assert cached.prefix_cache.cow_copies > 0
+        cached.prefix_cache.evict_all()
+        assert cached.kv.blocks_in_use == 0
+        assert cached.kv.allocator.invariant_ok
+
+    def test_speculative_int8_identity(self):
+        m = _model()
+        prompts = _prompts((3, 9, 17, 5))
+        base = _engine(ServingEngine, m, kv_dtype="int8").generate_batch(
+            prompts, max_new_tokens=8)
+        spec = _engine(ServingEngine, m, kv_dtype="int8",
+                       draft_k=3)
+        out = spec.generate_batch(prompts, max_new_tokens=8)
+        assert out == base
+        assert spec.kv.blocks_in_use == 0
+
+    def test_kv_dtype_validation(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            PagedKVCache(2, 4, 8, num_blocks=4, block_size=4,
+                         max_slots=2, max_blocks_per_slot=2,
+                         kv_dtype="int4")
+
+    def test_kv_bytes_per_token(self):
+        fp = PagedKVCache(2, 4, 8, num_blocks=4, block_size=4,
+                          max_slots=2, max_blocks_per_slot=2)
+        q8 = PagedKVCache(2, 4, 8, num_blocks=4, block_size=4,
+                          max_slots=2, max_blocks_per_slot=2,
+                          kv_dtype="int8")
+        # 2 (K,V) * L=2 * H=4 * (Dh=8 * itemsize [+ 4B scale/head])
+        assert fp.kv_bytes_per_token == 2 * 2 * 4 * 8 * 4
+        assert q8.kv_bytes_per_token == 2 * 2 * 4 * (8 + 4)
+        assert q8.block_bytes == q8.kv_bytes_per_token * 4
+        assert not fp.quantized and q8.quantized
+
+    def test_cow_copies_scale_columns(self):
+        import jax.numpy as jnp
+        kv = PagedKVCache(1, 2, 4, num_blocks=6, block_size=2,
+                          max_slots=2, max_blocks_per_slot=2,
+                          kv_dtype="int8")
+        kv.ensure_capacity(0, 2)
+        src = kv.slot_blocks(0)[0]
+        kv.k_pool = kv.k_pool.at[:, src].set(7)
+        kv.k_scale = kv.k_scale.at[:, src].set(0.25)
+        kv.v_scale = kv.v_scale.at[:, src].set(0.5)
+        assert kv.cow_block(0, 0)
+        dst = kv.slot_blocks(0)[0]
+        assert dst != src
+        np.testing.assert_array_equal(np.asarray(kv.k_pool[:, dst]), 7)
+        np.testing.assert_array_equal(
+            np.asarray(kv.k_scale[:, dst]), 0.25)
+        np.testing.assert_array_equal(
+            np.asarray(kv.v_scale[:, dst]), 0.5)
+        assert kv.allocator.invariant_ok
+
+
+class TestTPMatrix:
+    """(fp / int8) x TP=2 vs TP=1 on the CPU virtual-device mesh:
+    token identity, one compile, sharded scale pools."""
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"],
+                             ids=["fp32", "int8"])
+    def test_tp2_matches_tp1(self, kv_dtype):
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = _model()
+            prompts = _prompts((3, 9, 17, 5))
+            ref = _engine(ServingEngine, m,
+                          kv_dtype=kv_dtype).generate_batch(
+                prompts, max_new_tokens=8)
+            c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+            tp = _engine(TPServingEngine, m, tensor_parallel=2,
+                         kv_dtype=kv_dtype)
+            out = tp.generate_batch(prompts, max_new_tokens=8)
+            assert out == ref
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0 == 1
+            assert tp.kv.blocks_in_use == 0
+            if kv_dtype == "int8":
+                assert "mp" in str(tp.kv.k_scale.sharding.spec)
+                assert "mp" in str(tp.kv.v_scale.sharding.spec)
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_tp2_int8_prefix_and_preemption(self):
+        """The pressure cells: int8 TP=2 under preemption and under
+        prefix adoption + CoW must match int8 TP=1."""
+        m = _model()
+        prompts = _prompts((3, 9, 17, 5, 12, 7, 21, 4))
+        ref = _engine(ServingEngine, m, kv_dtype="int8",
+                      num_blocks=10).generate_batch(
+            prompts, max_new_tokens=6)
+        tp = _engine(TPServingEngine, m, tensor_parallel=2,
+                     kv_dtype="int8", num_blocks=10)
+        assert tp.generate_batch(prompts, max_new_tokens=6) == ref
+        assert tp.scheduler.preemption_count > 0
+
+        rng = np.random.RandomState(3)
+        common = rng.randint(1, 211, 24).tolist()
+        shared = [common + rng.randint(1, 211, 4).tolist()
+                  for _ in range(6)]
+        plain = _engine(ServingEngine, m, max_slots=2,
+                        kv_dtype="int8").generate_batch(
+            shared, max_new_tokens=6)
+        tpc = _engine(TPServingEngine, m, tensor_parallel=2,
+                      max_slots=2, kv_dtype="int8",
+                      prefix_caching=True)
+        assert tpc.generate_batch(shared, max_new_tokens=6) == plain
+        assert tpc.prefix_cache.hit_tokens > 0
+        tpc.prefix_cache.evict_all()
+        assert tpc.kv.blocks_in_use == 0
+        assert tpc.kv.allocator.invariant_ok
+
+    def test_tp2_penalties_match_tp1(self):
+        """Logit processors under the TP mesh: the penalty history is
+        a replicated extra step input (n_data grows by one), so the
+        shard_map spec ordering is load-bearing — pin it with a
+        TP=2-vs-TP=1 token-identity cell, penalties on, both dtypes."""
+        from paddle_tpu.serving.batcher import SamplingConfig
+        m = _model()
+        prompts = _prompts((3, 9, 17, 5))
+        sc = dict(repetition_penalty=1.5, presence_penalty=0.3,
+                  penalty_window=32)
+        for kv_dtype in (None, "int8"):
+            ref = _engine(ServingEngine, m, kv_dtype=kv_dtype,
+                          sampling=SamplingConfig(**sc)).generate_batch(
+                prompts, max_new_tokens=8)
+            tp = _engine(TPServingEngine, m, tensor_parallel=2,
+                         kv_dtype=kv_dtype,
+                         sampling=SamplingConfig(**sc))
+            assert tp.generate_batch(prompts, max_new_tokens=8) == ref
+            # penalties must actually bite vs the plain greedy run
+            assert ref != _engine(ServingEngine, m,
+                                  kv_dtype=kv_dtype).generate_batch(
+                prompts, max_new_tokens=8)
+
+
+# --------------------------------------------------------- smoke wiring
+
+
+def test_kv_smoke_tool(capsys):
+    """tools/kv_smoke.py is the tier-1 CI contract for the int8 pools:
+    >= 1.9x capacity at equal HBM budget, >= 99% greedy agreement,
+    zero leaked blocks/scales after evict_all, and the metric names
+    (incl. paddle_tpu_serving_kv_bytes_per_token) in the dump."""
+    import importlib.util
+    import os
+
+    pm.REGISTRY.reset()
+    was = pm._enabled
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "kv_smoke.py")
+    spec = importlib.util.spec_from_file_location("kv_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        rc = mod.main()
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "paddle_tpu_serving_kv_bytes_per_token" in out
+    finally:
+        pm.REGISTRY.reset()
+        if not was:
+            pm.disable()

@@ -1,0 +1,194 @@
+"""Int8 KV-cache smoke run + CI contract.
+
+Three contracts for `PagedKVCache(kv_dtype="int8")` (ISSUE 9, wired
+into tier-1 via tests/test_paged_kernels.py):
+
+1. **Capacity**: at an EQUAL HBM byte budget, int8 pools (including
+   their per-entry-per-head fp32 scales) must fit >= 1.9x the resident
+   requests of fp32 pools — verified both analytically
+   (`PagedKVCache.block_bytes`) and behaviourally: under the same
+   over-subscribed workload the int8 engine must preempt strictly less
+   than fp32 and hold >= 1.9x the peak resident tokens.
+2. **Agreement**: greedy outputs of the int8 engine must agree with
+   the fp path on >= 99% of generated tokens on the smoke workload
+   (the bounded-divergence contract, docs/SERVING.md).
+3. **No leaks**: after the prefix-cached int8 engine drains and
+   `evict_all()` runs, zero blocks remain allocated, the allocator
+   ledger invariant holds, and the radix tree holds no block (scale
+   rows ride block ids, so a clean block ledger IS a clean scale
+   ledger — asserted via the tree/allocator, not a parallel count).
+
+Both engines run with metrics on, and every serving contract metric —
+including the new `paddle_tpu_serving_kv_bytes_per_token` gauge — must
+appear in the Prometheus dump with the int8/fp32 byte ratio the
+capacity math predicts. Exit status is non-zero on any violation.
+
+Usage: JAX_PLATFORMS=cpu python tools/kv_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_smoke():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.engine import ServingEngine, STEP_FN_NAME
+
+    pm.enable()
+    paddle.seed(0)
+    model = GPTForGeneration(vocab_size=211, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 211, n).tolist()
+               for n in (3, 9, 17, 5, 12, 7, 21, 4)]
+    failures = []
+
+    def engine(kv_dtype=None, num_blocks=None, prefix_caching=False,
+               max_slots=4):
+        return ServingEngine(model, max_slots=max_slots, block_size=4,
+                             num_blocks=num_blocks, max_seq_len=48,
+                             cache_dtype="float32", kv_dtype=kv_dtype,
+                             seed=0, prefix_caching=prefix_caching)
+
+    # ---- contract 2 first: agreement on an unconstrained pool ----
+    fp = engine()
+    out_fp = fp.generate_batch(prompts, max_new_tokens=6)
+    c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+    q8 = engine(kv_dtype="int8")
+    out_q8 = q8.generate_batch(prompts, max_new_tokens=6)
+    compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0
+    if compiles != 1:
+        failures.append(f"int8 mixed step compiled {compiles} times, "
+                        "want 1")
+    total = sum(len(o) for o in out_fp)
+    agree = sum(a == b for x, y in zip(out_fp, out_q8)
+                for a, b in zip(x, y))
+    agreement = agree / max(1, total)
+    if agreement < 0.99:
+        failures.append(f"greedy agreement {agreement:.3f} "
+                        f"({agree}/{total}) below the 0.99 contract")
+    if q8.kv.blocks_in_use != 0:
+        failures.append(f"{q8.kv.blocks_in_use} blocks leaked by the "
+                        "int8 engine")
+
+    # ---- contract 1: equal-HBM-budget capacity ----
+    bb_fp = fp.kv.block_bytes
+    bb_q8 = q8.kv.block_bytes
+    budget = 10 * bb_fp                # 10 fp32 blocks' worth of HBM
+    blocks_fp = budget // bb_fp
+    blocks_q8 = budget // bb_q8
+    ratio = blocks_q8 / blocks_fp
+    if ratio < 1.9:
+        failures.append(
+            f"int8 fits only {ratio:.2f}x the fp32 blocks at equal "
+            f"HBM budget (block bytes {bb_q8} vs {bb_fp}; need >=1.9x)")
+    # behavioural check: same workload, same HBM budget, slots NOT the
+    # binding constraint (max_slots=8) and demand deep enough to fill
+    # either pool. The fp32 engine must preempt, the int8 engine must
+    # not, and the int8 engine's peak resident working set (cached
+    # tokens across slots) must be >= 1.9x fp32's
+    pressure = prompts + [rng.randint(1, 211, n).tolist()
+                          for n in (14, 10, 18, 8)]
+    residents = {}
+    for name, dt, nb in (("fp32", None, blocks_fp),
+                         ("int8", "int8", blocks_q8)):
+        eng = engine(kv_dtype=dt, num_blocks=int(nb) + 1, max_slots=8)
+        reqs = [eng.submit(p, 8) for p in pressure]
+        peak = 0
+        while eng.scheduler.has_work:
+            if not eng.step():
+                break
+            peak = max(peak, int(eng.kv.slot_lens.sum()))
+        residents[name] = (peak, eng.scheduler.preemption_count)
+    peak_fp, preempt_fp = residents["fp32"]
+    peak_q8, preempt_q8 = residents["int8"]
+    if preempt_fp == 0:
+        failures.append("budgeted fp32 run never preempted — the "
+                        "capacity phase is not exercising pressure")
+    if preempt_q8 >= preempt_fp:
+        failures.append(f"budgeted int8 run preempted {preempt_q8} "
+                        f"times vs fp32's {preempt_fp} at the same "
+                        "HBM budget (must be strictly fewer)")
+    if peak_q8 < 1.9 * peak_fp:
+        failures.append(f"int8 peak resident tokens {peak_q8} below "
+                        f"1.9x fp32's {peak_fp} at equal HBM budget")
+
+    # ---- contract 3: prefix-cached int8 engine drains clean ----
+    common = rng.randint(1, 211, 24).tolist()
+    shared = [common + rng.randint(1, 211, 4).tolist()
+              for _ in range(6)]
+    plain = engine(kv_dtype="int8")
+    out_plain = plain.generate_batch(shared, max_new_tokens=6)
+    cached = engine(kv_dtype="int8", prefix_caching=True)
+    out_cached = cached.generate_batch(shared, max_new_tokens=6)
+    if out_cached != out_plain:
+        failures.append(
+            "int8 prefix-cached outputs diverge from the uncached int8 "
+            "engine (per-entry scales must make sharing lossless)")
+    if cached.prefix_cache.hit_tokens <= 0:
+        failures.append("int8 prefix cache recorded no hit tokens")
+    cached.prefix_cache.evict_all()
+    if cached.kv.blocks_in_use != 0:
+        failures.append(f"{cached.kv.blocks_in_use} blocks leaked by "
+                        "the int8 prefix-cached engine after evict_all")
+    if not cached.kv.allocator.invariant_ok:
+        failures.append("allocator ledger invariant violated after "
+                        "int8 evict_all")
+    if cached.prefix_cache.cached_blocks != 0:
+        failures.append(f"{cached.prefix_cache.cached_blocks} scale-"
+                        "bearing blocks still referenced by the radix "
+                        "tree after evict_all")
+
+    stats = {
+        "agreement": round(agreement, 4),
+        "block_bytes_fp32": int(bb_fp), "block_bytes_int8": int(bb_q8),
+        "capacity_ratio": round(ratio, 3),
+        "peak_resident_tokens_fp32": int(peak_fp),
+        "peak_resident_tokens_int8": int(peak_q8),
+        "preemptions_fp32": int(preempt_fp),
+        "preemptions_int8": int(preempt_q8),
+        "kv_bytes_per_token_fp32": int(fp.kv.kv_bytes_per_token),
+        "kv_bytes_per_token_int8": int(q8.kv.kv_bytes_per_token),
+    }
+    return stats, failures
+
+
+def main():
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.metrics import CONTRACT_METRICS
+
+    stats, failures = run_smoke()
+    text = pm.REGISTRY.to_prometheus()
+    print(text)
+    for name in CONTRACT_METRICS:
+        if name not in text:
+            failures.append(f"MISSING serving metric: {name}")
+    if failures:
+        for f in failures:
+            print(f"KV SMOKE FAILURE: {f}", file=sys.stderr)
+        return 1
+    print("kv smoke OK: "
+          f"agreement {stats['agreement']:.1%}, "
+          f"capacity {stats['capacity_ratio']:.2f}x "
+          f"({stats['block_bytes_int8']} vs "
+          f"{stats['block_bytes_fp32']} B/block), peak resident "
+          f"tokens {stats['peak_resident_tokens_int8']} vs "
+          f"{stats['peak_resident_tokens_fp32']} "
+          f"(preemptions {stats['preemptions_int8']} vs "
+          f"{stats['preemptions_fp32']}), "
+          f"{stats['kv_bytes_per_token_int8']} vs "
+          f"{stats['kv_bytes_per_token_fp32']} B/token",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
