@@ -1,0 +1,98 @@
+"""Expert-parallel MoE GPT (ISSUE 10, docs/MOE.md): train the hybrid
+MoE trainer over the ("dp","pp","mp","ep") mesh, then serve the same
+model class through the one-compile mixed step with TP x EP sharding.
+
+Runs on the CPU virtual mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu python examples/8_gpt_moe.py
+"""
+import argparse
+
+import numpy as np
+import jax
+
+from paddle_tpu.parallel.hybrid_gpt import GPTConfig, HybridGPT
+from paddle_tpu.profiler import metrics as pm
+
+
+def main_train(ep=2, dp=1, steps=6, experts=4, top_k=2):
+    """MoE pretraining: experts sharded over the ep axis, fixed
+    [E, C, d] dispatch tensors riding all_to_all inside the ONE
+    compiled step; per-step routing stats printed."""
+    cfg = GPTConfig(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                    n_layers=4, dp=dp, ep=ep, moe_num_experts=experts,
+                    moe_top_k=top_k, moe_capacity_factor=2.0,
+                    remat=False, compute_dtype=jax.numpy.float32)
+    n = cfg.dp * cfg.pp * cfg.mp * cfg.ep
+    if jax.device_count() < n:
+        raise SystemExit(f"need {n} devices; jax sees "
+                         f"{jax.device_count()}")
+    trainer = HybridGPT(cfg)
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = 4 * cfg.dp * cfg.ep
+    for step in range(steps):
+        tok = rng.randint(0, cfg.vocab_size,
+                          (batch, cfg.seq_len)).astype(np.int32)
+        tok_d, lab_d = trainer.shard_data(tok, tok)
+        params, opt, loss = trainer.train_step(params, opt, tok_d,
+                                               lab_d, step_num=step + 1)
+        st = jax.device_get(trainer.last_moe_stats)
+        counts = np.asarray(st["counts"], np.int64)
+        print(f"step {step}: loss {float(jax.device_get(loss)):.4f} "
+              f"balance {float(st['balance']):.3f} "
+              f"z {float(st['z']):.3f} dropped {int(st['dropped'])} "
+              f"expert_tokens {counts.tolist()} "
+              f"entropy {pm.moe_utilization_entropy(counts):.3f} "
+              f"(E={experts} k={top_k} ep={cfg.ep} dp={cfg.dp})")
+    return params
+
+
+def main_serve(tensor_parallel=2, expert_parallel=2, n_req=6,
+               max_new=16):
+    """MoE serving: per-token routing inside the ONE jitted mixed step
+    (fixed expert-capacity slots), experts sharded over ep and heads
+    over mp on a 2-D (ep, mp) mesh — token-identical to the EP=1
+    single-chip engine."""
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.models.gpt import GPTForGeneration
+
+    paddle.seed(0)
+    model = GPTForGeneration(vocab_size=512, hidden_size=64,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=256,
+                             compute_dtype="float32",
+                             moe=dict(num_expert=4, top_k=2,
+                                      capacity_factor=2.0))
+    model.eval()
+    cfg = inference.Config()
+    cfg.enable_continuous_batching(
+        max_slots=4, block_size=8, max_seq_len=128,
+        tensor_parallel=tensor_parallel,
+        expert_parallel=expert_parallel)
+    engine = inference.create_serving_engine(cfg, model)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 512, int(n)).tolist()
+               for n in rng.randint(4, 24, n_req)]
+    outs = engine.generate_batch(prompts, max_new_tokens=max_new)
+    for i, o in enumerate(outs):
+        print(f"req {i}: {len(o)} tokens -> {o[:8]}...")
+    print(f"expert tokens {engine.moe_expert_counts.astype(int).tolist()} "
+          f"utilization entropy {engine.moe_utilization_entropy():.3f} "
+          f"dropped {int(engine.moe_dropped_total)} "
+          f"(tp={tensor_parallel} ep={expert_parallel})")
+    return outs
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("train", "serve", "both"),
+                    default="both")
+    ap.add_argument("--ep", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=2)
+    args = ap.parse_args()
+    if args.mode in ("train", "both"):
+        main_train(ep=args.ep)
+    if args.mode in ("serve", "both"):
+        main_serve(tensor_parallel=args.tp, expert_parallel=args.ep)

@@ -4,11 +4,13 @@ Parity: `python/paddle/incubate/distributed/models/moe/` (`MoELayer`
 (moe_layer.py), gates: NaiveGate/GShardGate/SwitchGate, comm via
 global_scatter/global_gather ops `collective/global_scatter_op.cu.cc`).
 
-TPU-native: the dispatch/combine is the dense one-hot + `lax.all_to_all`
-implementation in parallel/hybrid_gpt._moe_ffn; this module provides the
-layer/gate class surface over it. Inside a compiled sharded step with an
-"ep" (=dp) mesh axis the all_to_all rides ICI; on one chip it degrades to
-a dense grouped-FFN.
+TPU-native: routing/dispatch/combine come from the shared fixed-shape
+capacity router in `parallel.moe_utils` (one-hot einsums; also behind
+`parallel/hybrid_gpt._moe_ffn` and the serving mixed step — see
+docs/MOE.md); this module provides the layer/gate class surface over
+it. Inside a compiled sharded step with the dedicated "ep" mesh axis
+the `[E, C, d]` dispatch tensors ride `lax.all_to_all` on ICI; on one
+chip each expert runs its capacity buffer locally.
 """
 from .gate import NaiveGate, GShardGate, SwitchGate, BaseGate  # noqa
 from .moe_layer import MoELayer  # noqa

@@ -1,9 +1,17 @@
 """MoELayer — parity: moe_layer.py `MoELayer(gate, experts, ...)`.
 
-Top-k dispatch/combine implemented densely (one-hot einsum, TPU-friendly);
-the expert-parallel all_to_all happens when the surrounding step is
-compiled over a mesh with the experts sharded (hybrid_gpt's _moe_ffn path);
-eager single-controller execution evaluates experts locally.
+Fixed-shape capacity dispatch (ISSUE 10): with a `capacity_factor`
+the gate picks top-k experts per token,
+`parallel.moe_utils.capacity_dispatch` builds the one-hot `[T, k, C]`
+dispatch/combine masks, and each expert runs ONLY its `[C, d]`
+capacity buffer; overflowed (token, choice) pairs are dropped (the
+surrounding residual carries them). The uncapped default keeps the
+reference's dense every-expert evaluation (no drops, no O(T^2 k)
+masks — see the class docstring). The expert-parallel all_to_all
+happens when the surrounding step is compiled over a mesh with the
+experts sharded (hybrid_gpt's `_moe_ffn` path over the "ep" axis);
+eager single-controller execution evaluates the local experts
+directly.
 """
 from __future__ import annotations
 
@@ -19,10 +27,23 @@ from .gate import NaiveGate, SwitchGate, GShardGate
 
 
 class MoELayer(Layer):
-    """moe_layer.py:MoELayer parity: inp [B, S, d] -> [B, S, d]."""
+    """moe_layer.py:MoELayer parity: inp [B, S, d] -> [B, S, d].
+
+    `capacity_factor` bounds each expert's per-batch token buffer at
+    `ceil(factor * T * k / E)` slots (the fixed-shape dispatch the
+    compiled paths use). The default (None) is UNCAPPED and runs the
+    reference's dense every-expert evaluation instead: same compute
+    as capacity dispatch at C = T but without materializing the
+    O(T^2 k) slot masks, and no token can ever drop — this layer
+    returns the combine directly with no residual of its own, so the
+    every-token semantics are preserved unless a caller that wraps
+    the layer in a residual block explicitly opts into capping.
+    `last_stats` carries the latest routing statistics
+    ({counts [E], dropped, capacity})."""
 
     def __init__(self, d_model, experts=None, gate=None, moe_group=None,
-                 mp_group=None, recompute_interval=0, **kwargs):
+                 mp_group=None, recompute_interval=0,
+                 capacity_factor=None, **kwargs):
         super().__init__()
         self.d_model = d_model
         if isinstance(gate, dict):
@@ -36,8 +57,12 @@ class MoELayer(Layer):
         self.experts = experts if isinstance(experts, LayerList) \
             else LayerList(experts)
         self.num_expert = len(self.experts)
+        self.capacity_factor = None if capacity_factor is None \
+            else float(capacity_factor)
+        self.last_stats = None
 
     def forward(self, inp):
+        from .....parallel import moe_utils
         inp = as_tensor(inp)
         shape = inp.shape
         d = shape[-1]
@@ -45,18 +70,56 @@ class MoELayer(Layer):
         x = ops.reshape(inp, [-1, d])  # [T, d]
         gate_val, gate_idx = self.gate(x)  # [T, k], [T, k]
         E = self.num_expert
+        T = x.shape[0]
+        k = gate_val.shape[-1]
+        gv, gi, xa = as_tensor(gate_val), as_tensor(gate_idx), \
+            as_tensor(x)
 
-        # run every expert on all tokens, combine by gates (dense combine;
-        # the sparse dispatch version lives in the compiled hybrid path)
-        expert_outs = [ops.unsqueeze(exp(x), 1) for exp in self.experts]
-        stacked = ops.concat(expert_outs, axis=1)  # [T, E, d]
+        if self.capacity_factor is None:
+            # uncapped: every expert evaluates every token and the
+            # gate mixes — identical math to C = T capacity dispatch
+            # without the [T, k, T] slot masks
+            expert_outs = [ops.unsqueeze(exp(xa), 1)
+                           for exp in self.experts]
+            stacked = ops.concat(expert_outs, axis=1)       # [T, E, d]
 
-        gv, gi, st = as_tensor(gate_val), as_tensor(gate_idx), \
-            as_tensor(stacked)
+            def _mix(val, idx, outs):
+                oh = jax.nn.one_hot(idx, E, dtype=outs.dtype)  # [T,k,E]
+                w = jnp.einsum("tk,tke->te", val.astype(outs.dtype),
+                               oh)
+                counts = jnp.sum(oh.astype(jnp.float32), axis=(0, 1))
+                return (jnp.einsum("te,ted->td", w, outs), counts,
+                        jnp.zeros((), jnp.float32))
 
-        def _fn(val, idx, outs):
-            mask = jax.nn.one_hot(idx, E, dtype=outs.dtype)  # [T,k,E]
-            w = jnp.einsum("tk,tke->te", val.astype(outs.dtype), mask)
-            return jnp.einsum("te,ted->td", w, outs)
-        out = dispatch.apply("moe_combine", _fn, (gv, gi, st))
+            out, counts, dropped = dispatch.apply(
+                "moe_combine", _mix, (gv, gi, as_tensor(stacked)))
+            self.last_stats = {"counts": counts, "dropped": dropped,
+                               "capacity": T}
+            return ops.reshape(out, shape)
+
+        C = moe_utils.expert_capacity(T, E, k, self.capacity_factor)
+
+        def _dispatch(xd, val, idx):
+            plan = moe_utils.capacity_dispatch(val, idx, E, C,
+                                               dtype=xd.dtype)
+            buf = moe_utils.dispatch_tokens(xd, plan)       # [E, C, d]
+            return (buf, plan.comb, plan.e_oh, plan.counts,
+                    plan.dropped)
+
+        buf, comb, e_oh, counts, dropped = dispatch.apply(
+            "moe_dispatch", _dispatch, (xa, gv, gi))
+        # each expert consumes ONLY its capacity buffer (C tokens)
+        expert_outs = [ops.unsqueeze(exp(buf[e]), 0)
+                       for e, exp in enumerate(self.experts)]
+        eout = ops.concat(expert_outs, axis=0)              # [E, C, d]
+
+        def _combine(eo, cb, eh):
+            return jnp.einsum("tkc,tke,ecd->td", cb, eh,
+                              eo.astype(cb.dtype))
+
+        out = dispatch.apply("moe_combine", _combine,
+                             (as_tensor(eout), as_tensor(comb),
+                              as_tensor(e_oh)))
+        self.last_stats = {"counts": counts, "dropped": dropped,
+                           "capacity": C}
         return ops.reshape(out, shape)

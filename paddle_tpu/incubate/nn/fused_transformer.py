@@ -131,64 +131,115 @@ def _ffn_dense(cfg, pl, h):
     return f + pl["ffn2_b"].astype(f.dtype)
 
 
-def _ffn_moe(cfg, pl, h):
-    """Top-k dense-dispatch MoE FFN with capacity.
-
-    `h` [B, S, D]. Experts stacked [E, D, F] / [E, F, D] (locally
-    `[E_loc]` when ep_axis is set). Returns (out, aux_loss)."""
-    B, S, D = h.shape
-    T = B * S
-    E = cfg.num_experts
-    k = cfg.moe_topk
-    cd = h.dtype
-    xt = h.reshape(T, D)
-    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
-                        pl["gate_w"].astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)                     # [T, E]
-    topv, topi = jax.lax.top_k(probs, k)                        # [T, k]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-    # load-balance aux (gshard): mean prob vs mean top-1 assignment
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32), axis=0)
-    aux = E * jnp.sum(me * ce)
-    C = max(1, int(cfg.capacity_factor * T * k / E))
-    # slot of each (token, choice) within its expert
-    oh = jax.nn.one_hot(topi, E, dtype=jnp.int32)               # [T, k, E]
-    flat_oh = oh.reshape(T * k, E)
-    pos = jnp.cumsum(flat_oh, axis=0) * flat_oh - 1             # [T*k, E]
-    slot = jnp.sum(pos * flat_oh, axis=-1).reshape(T, k)        # [T, k]
-    in_cap = (slot < C) & (slot >= 0)
-    disp = (jax.nn.one_hot(slot, C, dtype=cd)
-            * in_cap[..., None].astype(cd))                     # [T, k, C]
-    e_oh = oh.astype(cd)                                        # [T, k, E]
-    dispatched = jnp.einsum("tkc,tke,td->ecd", disp, e_oh,
-                            xt.astype(cd))                      # [E, C, D]
-    if cfg.ep_axis is not None and cfg.ep_size > 1:
-        E_loc = E // cfg.ep_size
-        dispatched = dispatched.reshape(cfg.ep_size, E_loc, C, D)
-        dispatched = jax.lax.all_to_all(dispatched, cfg.ep_axis,
-                                        split_axis=0, concat_axis=0,
-                                        tiled=False)
-        expert_in = jnp.swapaxes(dispatched, 0, 1).reshape(
-            E_loc, cfg.ep_size * C, D)
-    else:
-        expert_in = dispatched
+def _expert_ffn(cfg, pl, expert_in):
+    """Stacked expert FFN on [E_loc, C', D] capacity buffers (weight-
+    only dequant fused into the einsums when scales are present)."""
+    cd = expert_in.dtype
     f = jnp.einsum("ecd,edf->ecf", expert_in,
                    _deq(cfg, pl["ffn1_w"], pl.get("ffn1_s"), cd))
     f = _act(cfg, f + pl["ffn1_b"][:, None, :].astype(cd))
     eout = jnp.einsum("ecf,efd->ecd", f,
                       _deq(cfg, pl["ffn2_w"], pl.get("ffn2_s"), cd))
+    return eout + pl["ffn2_b"][:, None, :].astype(cd)
+
+
+def _ffn_moe(cfg, pl, h):
+    """Top-k capacity-factor MoE FFN (parallel.moe_utils routing core).
+
+    `h` [B, S, D]. Experts stacked [E, D, F] / [E, F, D] (locally
+    `[E_loc]` when ep_axis is set: tokens sharded over ep_axis, the
+    [E, C, D] dispatch tensors ride all_to_all to the expert owners —
+    the training-style exchange). Returns (out, balance_aux_loss);
+    capacity-dropped (token, choice) pairs contribute 0 and the
+    caller's residual carries them."""
+    from ...parallel import moe_utils
+    B, S, D = h.shape
+    T = B * S
+    E = cfg.num_experts
+    cd = h.dtype
+    xt = h.reshape(T, D)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        pl["gate_w"].astype(jnp.float32))
+    C = moe_utils.expert_capacity(T, E, cfg.moe_topk,
+                                  cfg.capacity_factor)
+    axes = (cfg.ep_axis,) if (cfg.ep_axis is not None
+                              and cfg.ep_size > 1) else None
+    r = moe_utils.top_k_routing(logits, cfg.moe_topk, C, axes=axes,
+                                dtype=cd)
+    dispatched = moe_utils.dispatch_tokens(xt.astype(cd), r.plan)
+    if axes:
+        expert_in = moe_utils.all_to_all_dispatch(dispatched,
+                                                  cfg.ep_axis,
+                                                  cfg.ep_size)
+    else:
+        expert_in = dispatched
+    eout = _expert_ffn(cfg, pl, expert_in)
+    if axes:
+        eout = moe_utils.all_to_all_combine(eout, cfg.ep_axis,
+                                            cfg.ep_size)
+    out = moe_utils.combine_tokens(eout, r.plan)
+    return out.reshape(B, S, D), r.balance_loss
+
+
+def _ffn_moe_tokens(cfg, pl, h, valid):
+    """Serving-side MoE FFN on the flat `[T, D]` mixed-step token axis.
+
+    Per-token top-k routing with FIXED expert-capacity slots: T is the
+    engine's static token budget, so the `[E, C, D]` dispatch tensors
+    are compile-time constants — routing churn, capacity overflow and
+    padding slots never change a compiled shape (the one-compile
+    rule). `valid` [T] masks padding tokens out of routing, capacity
+    claims and statistics. Overflowed (token, choice) pairs contribute
+    0 and the layer's residual connection carries the token through —
+    degradation, never a recompile.
+
+    Expert parallelism (`cfg.ep_axis` + `cfg.ep_size > 1`, the
+    TPServingEngine TP x EP mesh): the token set is REPLICATED across
+    shards, so dispatch degenerates from all_to_all to slicing this
+    rank's resident experts out of the (identical) dispatch tensor;
+    each shard runs E/ep experts at capacity C and the combine psums
+    partial mixtures over the ep axis. Expert FFN matmuls are
+    row-parallel over `cfg.mp_axis` exactly like `_ffn_dense`.
+
+    Returns (out [T, D], stats {counts [E], dropped, aux}) — stats are
+    identical on every shard (replicated tokens), so no psum."""
+    from ...parallel import moe_utils
+    T, D = h.shape
+    E = cfg.num_experts
+    cd = h.dtype
+    logits = jnp.einsum("td,de->te", h.astype(jnp.float32),
+                        pl["gate_w"].astype(jnp.float32))
+    C = moe_utils.expert_capacity(T, E, cfg.moe_topk,
+                                  cfg.capacity_factor)
+    r = moe_utils.top_k_routing(logits, cfg.moe_topk, C, valid=valid,
+                                dtype=cd)
+    ep = cfg.ep_size if cfg.ep_axis is not None else 1
+    if ep > 1:
+        # slice this shard's resident experts out of the one-hot FIRST
+        # and dispatch only their [E/ep, C, D] buffers — dispatching
+        # all E and slicing after would spend ep-times the einsum work
+        E_loc = E // ep
+        rank = jax.lax.axis_index(cfg.ep_axis)
+        e_oh_loc = jax.lax.dynamic_slice_in_dim(
+            r.plan.e_oh, rank * E_loc, E_loc, axis=2)
+    else:
+        e_oh_loc = r.plan.e_oh
+    local_in = moe_utils.dispatch_tokens(h, r.plan, e_oh=e_oh_loc)
+    f = jnp.einsum("ecd,edf->ecf", local_in,
+                   _deq(cfg, pl["ffn1_w"], pl.get("ffn1_s"), cd))
+    f = _act(cfg, f + pl["ffn1_b"][:, None, :].astype(cd))
+    eout = jnp.einsum("ecf,efd->ecd", f,
+                      _deq(cfg, pl["ffn2_w"], pl.get("ffn2_s"), cd))
+    # row-parallel over mp (each shard holds an F/tp slice), bias once
+    # after the reduction
+    eout = _maybe_psum(cfg, eout)
     eout = eout + pl["ffn2_b"][:, None, :].astype(cd)
-    if cfg.ep_axis is not None and cfg.ep_size > 1:
-        E_loc = E // cfg.ep_size
-        eout = eout.reshape(E_loc, cfg.ep_size, C, D)
-        eout = jnp.swapaxes(eout, 0, 1)
-        eout = jax.lax.all_to_all(eout, cfg.ep_axis, split_axis=0,
-                                  concat_axis=0, tiled=False)
-        eout = eout.reshape(E, C, D)
-    out = jnp.einsum("tkc,tke,tk,ecd->td", disp, e_oh,
-                     topv.astype(cd), eout)
-    return out.reshape(B, S, D), aux
+    out = jnp.einsum("tkc,tke,ecd->td", r.plan.comb, e_oh_loc, eout)
+    if ep > 1:
+        out = jax.lax.psum(out, cfg.ep_axis)
+    stats = {"counts": r.plan.counts, "dropped": r.plan.dropped,
+             "aux": r.balance_loss}
+    return out, stats
 
 
 def _deq(cfg, w, scale, dtype):

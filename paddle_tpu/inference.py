@@ -28,6 +28,7 @@ class Config:
         self._serving = None
         self._max_pending = None
         self._tensor_parallel = None
+        self._expert_parallel = None
         self._num_replicas = None
         self._router_policy = None
         self._sampling = None
@@ -41,6 +42,7 @@ class Config:
                                    draft_ngram=None, prefix_caching=None,
                                    max_pending=None, sampling=None,
                                    tensor_parallel=None,
+                                   expert_parallel=None,
                                    num_replicas=None,
                                    router_policy=None):
         """Opt the predictor surface into the paged-KV continuous
@@ -63,10 +65,12 @@ class Config:
         fields — strategy/temperature/top_k/top_p; speculation
         auto-disables for non-greedy strategies). `tensor_parallel > 1`
         shards the mixed step + KV pools over an `mp` mesh
-        (`serving.distributed.TPServingEngine`); `num_replicas > 1`
-        plus `create_serving_router` puts a prefix-affinity
-        `ReplicaRouter` in front of that many frontends
-        (`router_policy`: "affinity" | "round_robin")."""
+        (`serving.distributed.TPServingEngine`); for MoE decoder
+        stacks `expert_parallel > 1` additionally shards the experts
+        over the `ep` rows of a 2-D (ep, mp) mesh (docs/MOE.md);
+        `num_replicas > 1` plus `create_serving_router` puts a
+        prefix-affinity `ReplicaRouter` in front of that many
+        frontends (`router_policy`: "affinity" | "round_robin")."""
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
@@ -75,6 +79,7 @@ class Config:
             draft_ngram=draft_ngram, prefix_caching=prefix_caching)
         self._max_pending = max_pending
         self._tensor_parallel = tensor_parallel
+        self._expert_parallel = expert_parallel
         self._num_replicas = num_replicas
         self._router_policy = router_policy
         self._sampling = sampling
@@ -196,12 +201,13 @@ def create_serving_engine(config: Config, model, sampling=None, seed=0,
     kw = {k: v for k, v in config.serving_config().items()
           if v is not None}
     sampling = _resolve_sampling(config, sampling)
-    tp = config._tensor_parallel
-    if tp is not None and int(tp) > 1:
+    tp = int(config._tensor_parallel or 1)
+    ep = int(config._expert_parallel or 1)
+    if tp > 1 or ep > 1:
         from .serving.distributed.tp_engine import TPServingEngine
-        return TPServingEngine(model, tensor_parallel=int(tp),
-                               mesh=mesh, sampling=sampling, seed=seed,
-                               **kw)
+        return TPServingEngine(model, tensor_parallel=tp,
+                               expert_parallel=ep, mesh=mesh,
+                               sampling=sampling, seed=seed, **kw)
     from .serving.engine import ServingEngine
     return ServingEngine(model, sampling=sampling, seed=seed, **kw)
 
@@ -224,15 +230,24 @@ def create_serving_router(config: Config, model, sampling=None, seed=0):
     from .serving.distributed.router import ReplicaRouter
     from .serving.frontend import ServingFrontend
     tp = int(config._tensor_parallel or 1)
+    ep = int(config._expert_parallel or 1)
     meshes = [None] * n
-    if tp > 1:
+    if tp > 1 or ep > 1:
         import jax
 
-        from .parallel.mp_layers import tp_mesh
+        from .parallel.mp_layers import tp_ep_mesh, tp_mesh
         devices = jax.devices()
-        meshes = [tp_mesh(tp, devices=[
-            devices[(r * tp + i) % len(devices)] for i in range(tp)])
-            for r in range(n)]
+        world = tp * ep
+        picks = [[devices[(r * world + i) % len(devices)]
+                  for i in range(world)] for r in range(n)]
+        # MoE stacks always serve over the 2-D (ep, mp) mesh, even at
+        # expert_parallel=1 (the expert param specs name the ep axis)
+        moe = bool(getattr(getattr(model, "decoder", None),
+                           "_num_experts", 0))
+        if ep > 1 or moe:
+            meshes = [tp_ep_mesh(tp, ep, devices=d) for d in picks]
+        else:
+            meshes = [tp_mesh(tp, devices=d) for d in picks]
     fkw = {}
     if config._max_pending is not None:
         fkw["max_pending"] = int(config._max_pending)

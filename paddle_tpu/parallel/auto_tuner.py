@@ -71,15 +71,46 @@ class ModelSpec:
     master_bytes: int = 4            # fp32 master copy
     act_bytes: int = 2
     remat: bool = True
+    # MoE (ISSUE 10): E experts replace the dense FFN; each token
+    # computes top_k of them, the fixed [E, C, d] dispatch buffers pad
+    # compute up to capacity_factor, and the ep mesh axis shards the
+    # expert params + rides the dispatch/combine all_to_all
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.d_ff == 0:
             self.d_ff = 4 * self.d_model
 
     @property
+    def expert_param_elems(self) -> int:
+        """Parameter elements sharded over the ep axis (the stacked
+        expert FFNs); 0 for dense models."""
+        if not self.moe_experts:
+            return 0
+        return 2 * self.d_model * self.d_ff * self.moe_experts \
+            * self.n_layers
+
+    @property
     def n_params(self) -> int:
         d, L = self.d_model, self.n_layers
-        return (4 * d * d + 2 * d * self.d_ff) * L \
+        shared = 4 * d * d * L + self.vocab_size * d + self.seq_len * d
+        if self.moe_experts:
+            return shared + d * self.moe_experts * L \
+                + self.expert_param_elems
+        return shared + 2 * d * self.d_ff * L
+
+    @property
+    def active_params(self) -> int:
+        """Parameters each token actually multiplies (the MFU
+        numerator base): top_k experts for MoE, everything for
+        dense."""
+        if not self.moe_experts:
+            return self.n_params
+        d, L = self.d_model, self.n_layers
+        return (4 * d * d + d * self.moe_experts
+                + self.moe_top_k * 2 * d * self.d_ff) * L \
             + self.vocab_size * d + self.seq_len * d
 
     @property
@@ -87,22 +118,31 @@ class ModelSpec:
         """Parameter-tensor count estimate (12 per block + embeddings/
         final LN/head): the collective count of an UNbucketed
         per-parameter grad reduction."""
-        return 12 * self.n_layers + 4
+        return (13 if self.moe_experts else 12) * self.n_layers + 4
 
     def step_flops(self) -> float:
-        """fwd+bwd (+recompute) matmul FLOPs for one global batch."""
+        """fwd+bwd (+recompute) matmul FLOPs for one global batch —
+        the COMPUTED flops: MoE pays for every capacity slot (E * C =
+        ~capacity_factor * top_k * T), not just the routed tokens."""
         toks = self.global_batch * self.seq_len
         base = self.useful_flops()
+        if self.moe_experts:
+            # E * C slots are computed vs top_k routed per token:
+            # (cap_factor - 1) * top_k extra slot-equivalents each
+            pad = max((self.moe_capacity_factor - 1.0)
+                      * self.moe_top_k, 0.0)
+            base += 6.0 * 2 * self.d_model * self.d_ff \
+                * self.n_layers * pad * toks
         if self.remat:
             base *= 4.0 / 3.0  # one extra forward
         return base
 
     def useful_flops(self) -> float:
-        """Model FLOPs for one global batch WITHOUT recompute overhead —
-        the MFU numerator (same 6N + 6*L*S*d per-token convention as
-        bench.py)."""
+        """Model FLOPs for one global batch WITHOUT recompute or
+        capacity-padding overhead — the MFU numerator (same
+        6N_active + 6*L*S*d per-token convention as bench.py)."""
         toks = self.global_batch * self.seq_len
-        return (6.0 * self.n_params
+        return (6.0 * self.active_params
                 + 6.0 * self.n_layers * self.seq_len * self.d_model) \
             * toks
 
@@ -112,6 +152,7 @@ class Strategy:
     dp: int = 1
     mp: int = 1
     pp: int = 1
+    ep: int = 1                      # expert parallel (MoE only)
     micro_batches: int = 1
     zero_stage: int = 0
     schedule: str = "1f1b"           # gpipe | 1f1b | zero_bubble
@@ -119,11 +160,12 @@ class Strategy:
     bucket_size: int = 0             # 0 = per-parameter grad reduction
 
     def degree(self):
-        return self.dp * self.mp * self.pp
+        return self.dp * self.mp * self.pp * self.ep
 
     def as_hybrid_configs(self):
         return {"dp_degree": self.dp, "mp_degree": self.mp,
-                "pp_degree": self.pp, "sharding_degree": 1,
+                "pp_degree": self.pp, "ep_degree": self.ep,
+                "sharding_degree": 1,
                 "micro_batches": self.micro_batches,
                 "zero_stage": self.zero_stage,
                 "schedule": self.schedule,
@@ -158,9 +200,12 @@ class CostModel:
 
     # -------------------------------------------------------------- mem
     def memory_per_device(self, m: ModelSpec, s: Strategy) -> float:
-        P = float(m.n_params)
-        # params + grads live sharded over mp and pp always
+        # params + grads live sharded over mp and pp always; the
+        # expert-stacked FFN params additionally shard over ep
         shard = s.mp * s.pp
+        P_eff = float(m.n_params - m.expert_param_elems) \
+            + float(m.expert_param_elems) / max(s.ep, 1)
+        P = P_eff
         p_bytes = P * m.param_bytes / shard
         g_bytes = P * m.grad_bytes / shard
         # optimizer state (+master weights): zero>=1 additionally shards
@@ -171,9 +216,10 @@ class CostModel:
             g_bytes /= s.dp
         if s.zero_stage >= 3:
             p_bytes /= s.dp  # params stored sharded between steps
-        # activations: batch split over dp, per-microbatch live set over
-        # pp stages; remat keeps ~1 residual per layer boundary
-        b_local = max(m.global_batch // (s.dp * s.micro_batches), 1)
+        # activations: batch split over dp x ep, per-microbatch live set
+        # over pp stages; remat keeps ~1 residual per layer boundary
+        b_local = max(m.global_batch // (s.dp * s.ep
+                                         * s.micro_batches), 1)
         act_per_layer = b_local * m.seq_len * m.d_model * m.act_bytes
         layers_local = max(m.n_layers // s.pp, 1)
         live_factor = 2.0 if m.remat else 14.0   # resid vs full act set
@@ -214,10 +260,12 @@ class CostModel:
 
     def comm_time(self, m: ModelSpec, s: Strategy) -> float:
         c = self.cluster
-        P = float(m.n_params)
-        comm = 0.0
         # dp grad sync: allreduce (zero=0) or RS+AG (zero>=1) of the
-        # mp/pp-local shard
+        # mp/pp-local shard (the ep-sharded expert grads sync over dp
+        # at 1/ep size each — same aggregate as dividing by ep here)
+        P = float(m.n_params - m.expert_param_elems) \
+            + float(m.expert_param_elems) / max(s.ep, 1)
+        comm = 0.0
         g_local = P * m.grad_bytes / (s.mp * s.pp)
         if s.dp > 1:
             if s.zero_stage >= 1:
@@ -241,14 +289,26 @@ class CostModel:
             comm += 2.0 * _shard_xfer_time(p_local, s.dp, c.ici_bw)
         # mp: 2 allreduce fwd + 2 bwd per layer of [B_local, S, d] acts
         if s.mp > 1:
-            b_local = max(m.global_batch // s.dp, 1)
+            b_local = max(m.global_batch // (s.dp * s.ep), 1)
             act = b_local * m.seq_len * m.d_model * m.act_bytes
             layers_local = max(m.n_layers // s.pp, 1)
             comm += 4.0 * layers_local * (_ring_allreduce_time(
                 act, s.mp, c.ici_bw) + c.collective_latency)
+        # ep: dispatch + combine all_to_all of the [E, C, d] capacity
+        # buffers per layer, fwd + bwd (4 exchanges); an all_to_all
+        # moves (ep-1)/ep of the payload off-chip
+        if s.ep > 1 and m.moe_experts:
+            toks_local = max(m.global_batch // (s.dp * s.ep), 1) \
+                * m.seq_len
+            slots = m.moe_capacity_factor * m.moe_top_k * toks_local
+            a2a = slots * m.d_model * m.act_bytes * (s.ep - 1) / s.ep
+            layers_local = max(m.n_layers // s.pp, 1)
+            comm += 4.0 * layers_local * (a2a / c.ici_bw
+                                          + c.collective_latency)
         # pp: p2p activation sends per microbatch tick (fwd+bwd)
         if s.pp > 1:
-            b_micro = max(m.global_batch // (s.dp * s.micro_batches), 1)
+            b_micro = max(m.global_batch // (s.dp * s.ep
+                                             * s.micro_batches), 1)
             act = b_micro * m.seq_len * m.d_model * m.act_bytes
             comm += 2.0 * s.micro_batches * act / c.ici_bw
         return comm
@@ -310,7 +370,7 @@ class StrategyTuner:
         self.cluster = cluster or ClusterSpec()
         self.cost_model = CostModel(self.cluster)
 
-    def _factorizations(self, n):
+    def _factorizations(self, n, with_ep=False):
         for dp in range(1, n + 1):
             if n % dp:
                 continue
@@ -318,38 +378,52 @@ class StrategyTuner:
             for mp in range(1, rest + 1):
                 if rest % mp:
                     continue
-                yield dp, mp, rest // mp
+                rest2 = rest // mp
+                if not with_ep:
+                    yield dp, mp, rest2, 1
+                    continue
+                for pp in range(1, rest2 + 1):
+                    if rest2 % pp:
+                        continue
+                    yield dp, mp, pp, rest2 // pp
 
     def search(self, model: ModelSpec, n_devices: Optional[int] = None,
                top_k: int = 1, schedules=("1f1b",), bucket_sizes=(0,),
                zero_stages=(0, 1, 2, 3)):
         n = n_devices or self.cluster.n_devices
+        moe = model.moe_experts > 0
         scored = []
-        for dp, mp, pp in self._factorizations(n):
-            if model.n_layers % pp or model.global_batch % dp:
+        for dp, mp, pp, ep in self._factorizations(n, with_ep=moe):
+            if model.n_layers % pp or model.global_batch % (dp * ep):
                 continue
             if model.n_heads and (mp > model.n_heads
                                   or model.n_heads % mp):
                 continue
             if model.vocab_size % mp:
                 continue
+            # ep must divide the expert count — an ep that strands a
+            # fractional expert per rank is INFEASIBLE, not just slow
+            if ep > 1 and (not moe or model.moe_experts % ep):
+                continue
             micro_opts = {1} if pp == 1 else {
                 mb for mb in (pp, 2 * pp, 4 * pp)
-                if model.global_batch % (dp * mb) == 0}
+                if model.global_batch % (dp * ep * mb) == 0}
             sched_opts = schedules if pp > 1 else ("1f1b",)
-            # bucketed grad reduction exists only on the pure dense-DP
-            # executor path (hybrid_gpt's grad_bucket_bytes contract):
-            # scoring buckets on an mp/pp mesh would rank a config no
-            # executor can run and let a near-tie flip the mesh choice
+            # bucketed grad reduction exists only on the pure DENSE-DP
+            # executor path (hybrid_gpt's grad_bucket_bytes contract —
+            # MoE expert leaves are ep-sharded, never plain-dp-psummed):
+            # scoring buckets elsewhere would rank a config no executor
+            # can run and let a near-tie flip the mesh choice
             buck_opts = bucket_sizes if (dp > 1 and mp == 1
-                                         and pp == 1) else (0,)
+                                         and pp == 1 and ep == 1
+                                         and not moe) else (0,)
             for micro in sorted(micro_opts):
                 for zero in zero_stages:
                     for sched in sched_opts:
                         for bucket in buck_opts:
                             if bucket and zero >= 1:
                                 continue  # RS/AG path, nothing to bucket
-                            s = Strategy(dp=dp, mp=mp, pp=pp,
+                            s = Strategy(dp=dp, mp=mp, pp=pp, ep=ep,
                                          micro_batches=micro,
                                          zero_stage=zero,
                                          schedule=sched,
@@ -360,9 +434,9 @@ class StrategyTuner:
                                 continue
                             t = self.cost_model.step_time(model, s)
                             # prefer simpler configs on near-ties (zero
-                            # adds collectives; mp/pp/zb add failure
+                            # adds collectives; mp/pp/ep/zb add failure
                             # surface)
-                            tie_break = (zero, mp, pp,
+                            tie_break = (zero, mp, pp, ep,
                                          sched != "1f1b", bucket)
                             scored.append((t, tie_break, s, mem))
         if not scored:

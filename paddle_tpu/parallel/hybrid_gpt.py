@@ -52,12 +52,25 @@ class GPTConfig:
     dp: int = 1
     pp: int = 1
     mp: int = 1
+    ep: int = 1              # expert parallel: experts sharded over a
+                             # dedicated "ep" mesh axis; tokens are
+                             # data-sharded over (dp, ep) jointly and
+                             # shared-param grads psum across ep like dp
     micro_batches: int = 1   # per train_batch, split over pp schedule
     sequence_parallel: bool = False
-    # MoE / expert parallel (experts sharded over the dp axis)
-    moe_experts: int = 0     # 0 = dense
+    # MoE (ISSUE 10): top-k capacity-factor router, fixed [E, C, d]
+    # dispatch tensors, all_to_all over "ep" (parallel/moe_utils.py).
+    # moe_num_experts is a CONSTRUCTOR-ONLY alias (an InitVar, not a
+    # field, and deliberately no read property): dataclasses.replace
+    # must see only the one real field, so replace(cfg, moe_experts=0)
+    # really produces a dense config instead of the alias
+    # resurrecting the expert count
+    moe_experts: int = 0     # 0 = dense (alias: moe_num_experts)
+    moe_num_experts: dataclasses.InitVar[int] = 0
+    moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
-    moe_aux_weight: float = 0.01
+    moe_aux_weight: float = 0.01   # load-balance loss weight
+    moe_z_weight: float = 1e-3     # router z-loss weight
     # fused residual-add+LN Pallas kernel between attention and FFN
     # (docs/gpt_perf_analysis.md: the XLA add/LN fusions pay carry-layout
     # conversions); jnp fallback off-TPU
@@ -112,15 +125,29 @@ class GPTConfig:
     zero_stage: int = 1      # 0: replicated adam; 1: states+update sharded
                              # over dp (stage-2: grads reduce-scattered too)
 
-    def __post_init__(self):
+    def __post_init__(self, moe_num_experts):
         if self.d_ff == 0:
             self.d_ff = 4 * self.d_model
         assert self.n_layers % self.pp == 0
         assert self.n_heads % self.mp == 0
         assert self.d_model % self.n_heads == 0
         assert self.vocab_size % self.mp == 0
+        # resolve the constructor alias; refuse two CONFLICTING
+        # non-zero values (silently picking one would train the wrong
+        # architecture)
+        assert not (self.moe_experts and moe_num_experts
+                    and self.moe_experts != moe_num_experts), \
+            f"moe_experts={self.moe_experts} conflicts with " \
+            f"moe_num_experts={moe_num_experts}"
+        if moe_num_experts and not self.moe_experts:
+            self.moe_experts = moe_num_experts
         if self.moe_experts:
-            assert self.moe_experts % self.dp == 0
+            assert self.moe_experts % self.ep == 0, \
+                "moe_experts must divide evenly over the ep axis"
+            assert 1 <= self.moe_top_k <= self.moe_experts
+        else:
+            assert self.ep == 1, \
+                "ep > 1 needs a MoE config (dense models scale over dp)"
         if self.sequence_parallel:
             assert self.seq_len % self.mp == 0
         if self.grad_bucket_bytes:
@@ -189,12 +216,15 @@ def param_specs(cfg: GPTConfig) -> Dict[str, Any]:
         "ln2_w": P("pp", None), "ln2_b": P("pp", None),
     }
     if moe:
+        # experts sharded over the dedicated ep axis (gate is a SHARED
+        # param: replicated over dp AND ep, so the shard_map transpose
+        # psums its grad across both — the "like dp" contract)
         blocks.update({
             "gate": P("pp", None, None),
-            "w_fc1": P("pp", "dp", None, "mp"),
-            "b_fc1": P("pp", "dp", "mp"),
-            "w_fc2": P("pp", "dp", "mp", None),
-            "b_fc2": P("pp", "dp", None),
+            "w_fc1": P("pp", "ep", None, "mp"),
+            "b_fc1": P("pp", "ep", "mp"),
+            "w_fc2": P("pp", "ep", "mp", None),
+            "b_fc2": P("pp", "ep", None),
         })
     else:
         blocks.update({
@@ -270,68 +300,77 @@ def _dense_ffn(x, w1, b1, w2, b2, cfg: GPTConfig):
     return out, b2
 
 
-def _moe_ffn(x, gate_w, w1, b1, w2, b2, cfg: GPTConfig):
-    """Switch-style top-1 MoE with expert parallelism over the dp axis.
+def _moe_data_axes(cfg: GPTConfig):
+    """Mesh axes the token batch is sharded over (None outside a
+    multi-rank mesh): the axes MoE routing statistics must psum across
+    for EP/DP-invariant aux losses and global expert counts."""
+    axes = tuple(a for a, n in (("dp", cfg.dp), ("ep", cfg.ep)) if n > 1)
+    return axes or None
 
-    x [B, S, d] local tokens. Experts: E total, E/dp resident per dp rank
-    (w1 local [E_loc, d, ff_loc]). Dispatch via dense one-hot (TPU-friendly)
-    + all_to_all over "dp" (the reference's global_scatter/global_gather).
-    Returns (out_partial_over_mp, aux_loss).
-    """
+
+def _zero_moe_stats(cfg: GPTConfig):
+    """The per-block MoE stats pytree (dense blocks contribute zeros so
+    the scan carry keeps one static structure)."""
+    E = max(cfg.moe_experts, 1)
+    return {"balance": jnp.zeros((), jnp.float32),
+            "z": jnp.zeros((), jnp.float32),
+            "counts": jnp.zeros((E,), jnp.float32),
+            "dropped": jnp.zeros((), jnp.float32)}
+
+
+def _moe_ffn(x, gate_w, w1, b1, w2, b2, cfg: GPTConfig):
+    """Top-k capacity-factor MoE with expert parallelism over "ep".
+
+    x [B, S, d] local tokens. Experts: E total, E/ep resident per ep
+    rank (w1 local [E_loc, d, ff_loc]). Routing/dispatch/combine come
+    from `parallel.moe_utils` (fixed one-hot einsums); the [E, C, d]
+    dispatch tensor rides `lax.all_to_all` over "ep" to the expert
+    owners and back (the compiled global_scatter/global_gather).
+    Capacity-overflowed (token, choice) pairs contribute 0 — the
+    block's residual connection is the drop path. Returns
+    (out_partial_over_mp, stats) with stats per `_zero_moe_stats`
+    (balance/z losses are psum'd over the data axes so they are
+    invariant to the dp x ep token sharding)."""
+    from . import moe_utils
     cd = cfg.compute_dtype
     B, S, d = x.shape
     T = B * S
     E = cfg.moe_experts
-    E_loc = w1.shape[0]
-    dp = cfg.dp
+    ep = cfg.ep
+    axes = _moe_data_axes(cfg)
     xt = x.reshape(T, d)
     logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
                         gate_w.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)             # [T]
-    gate_val = jnp.max(probs, axis=-1)                  # [T]
-    # load-balance aux loss (switch transformer)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=0)
-    aux = E * jnp.sum(me * ce)
-    # capacity + position of each token within its expert
-    C = max(1, int(cfg.moe_capacity_factor * T / E))
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)     # [T,E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1               # [T,E]
-    # within-expert slot of each token: pos has -1 in unselected expert
-    # columns, so mask with onehot before reducing (pos.sum(-1) would be
-    # off by E-1 and silently drop the first tokens of every expert)
-    slot = jnp.sum(pos * onehot, axis=-1)                       # [T]
-    in_cap = jnp.any((pos < C) & (onehot > 0), axis=-1)
-    disp = (jax.nn.one_hot(slot, C, dtype=cd)
-            * in_cap[:, None].astype(cd))                        # [T,C]
-    comb = disp * gate_val[:, None].astype(cd)                   # [T,C]
-    e_oh = jax.nn.one_hot(expert_idx, E, dtype=cd)               # [T,E]
-    # dispatched [E, C, d]
-    dispatched = jnp.einsum("tc,te,td->ecd", disp, e_oh, xt.astype(cd))
-    if dp > 1:
-        # [E, C, d] -> [dp, E_loc, C, d]; all_to_all over dp sends each
-        # expert bucket to its owner rank (global_scatter); the received
-        # leading dim indexes the source rank.
-        dispatched = dispatched.reshape(dp, E_loc, C, d)
-        dispatched = jax.lax.all_to_all(dispatched, "dp", split_axis=0,
-                                        concat_axis=0, tiled=False)
-        expert_in = jnp.swapaxes(dispatched, 0, 1).reshape(E_loc, dp * C, d)
+    C = moe_utils.expert_capacity(T, E, cfg.moe_top_k,
+                                  cfg.moe_capacity_factor)
+    r = moe_utils.top_k_routing(logits, cfg.moe_top_k, C, axes=axes,
+                                dtype=cd)
+    dispatched = moe_utils.dispatch_tokens(xt.astype(cd), r.plan)
+    if ep > 1:
+        expert_in = moe_utils.all_to_all_dispatch(dispatched, "ep", ep)
     else:
-        expert_in = dispatched  # [E(=E_loc), C, d]
+        expert_in = dispatched                   # [E(=E_loc), C, d]
     h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(cd)) \
         + b1[:, None, :].astype(cd)
     h = jax.nn.gelu(h)
+    # b2 is replicated over mp while the matmul is a row-parallel
+    # PARTIAL (w2 holds an ff/mp shard) that the caller psums: scale
+    # the bias by 1/mp so the psum restores it exactly once — adding
+    # it unscaled would count it mp times (it must ride inside the
+    # expert buffer, not after the combine, because each token's bias
+    # share is gate-weighted per selected expert)
     eout = jnp.einsum("ecf,efd->ecd", h, w2.astype(cd)) \
-        + b2[:, None, :].astype(cd)
-    if dp > 1:
-        eout = eout.reshape(E_loc, dp, C, d)
-        eout = jnp.swapaxes(eout, 0, 1)          # [dp, E_loc, C, d]
-        eout = jax.lax.all_to_all(eout, "dp", split_axis=0, concat_axis=0,
-                                  tiled=False)   # global_gather
-        eout = eout.reshape(E, C, d)
-    out = jnp.einsum("tc,te,ecd->td", comb, e_oh, eout)
-    return out.reshape(B, S, d), aux
+        + (b2[:, None, :] / cfg.mp).astype(cd)
+    if ep > 1:
+        eout = moe_utils.all_to_all_combine(eout, "ep", ep)
+    out = moe_utils.combine_tokens(eout, r.plan)
+    counts, dropped = r.plan.counts, r.plan.dropped
+    if axes:
+        counts = jax.lax.psum(counts, axes)
+        dropped = jax.lax.psum(dropped, axes)
+    stats = {"balance": r.balance_loss, "z": r.z_loss,
+             "counts": counts, "dropped": dropped}
+    return out.reshape(B, S, d), stats
 
 
 def _block(x, lp, cfg: GPTConfig):
@@ -368,7 +407,7 @@ def _block(x, lp, cfg: GPTConfig):
     else:
         x = x + attn.astype(x.dtype)
         h2 = _layer_norm(x, lp["ln2_w"], lp["ln2_b"])
-    aux = jnp.zeros((), jnp.float32)
+    aux = _zero_moe_stats(cfg)
     if cfg.moe_experts:
         h2 = gather_sp(h2)
         ff, aux = _moe_ffn(h2, lp["gate"], lp["w_fc1"], lp["b_fc1"],
@@ -417,18 +456,18 @@ def _stage_forward(x, blocks_local, cfg: GPTConfig):
 
     if cfg.unroll_layers:
         n = jax.tree_util.tree_leaves(blocks_local)[0].shape[0]
-        aux_tot = jnp.zeros((), jnp.float32)
+        aux_tot = _zero_moe_stats(cfg)
         for i in range(n):
             lp = jax.tree_util.tree_map(lambda a: a[i], blocks_local)
             x, aux = block_fn(x, lp)
-            aux_tot = aux_tot + aux
+            aux_tot = jax.tree.map(jnp.add, aux_tot, aux)
         return x, aux_tot
 
     def body(carry, lp):
         y, aux = block_fn(carry, lp)
         return y, aux
     x, auxs = jax.lax.scan(body, x, blocks_local)
-    return x, jnp.sum(auxs)
+    return x, jax.tree.map(lambda a: jnp.sum(a, axis=0), auxs)
 
 
 def _vocab_parallel_embed(tokens, tok_emb_local, cfg: GPTConfig):
@@ -622,10 +661,11 @@ def _loss_fn(params, tokens, labels, cfg: GPTConfig, dp_mean=True):
         y, aux = _stage_forward(x_in, params["blocks"], cfg)
         # this stage holds a REAL microbatch only for ticks in
         # [stage, stage+M); bubble ticks process padding and must not
-        # contribute to the MoE balance loss
+        # contribute to the MoE losses or expert counts
         stage_valid = jnp.logical_and(t - stage >= 0, t - stage < M) \
             if pp > 1 else jnp.asarray(True)
-        aux = jnp.where(stage_valid, aux, 0.0)
+        aux = jax.tree.map(
+            lambda a: jnp.where(stage_valid, a, jnp.zeros_like(a)), aux)
         # pass activations down the pipe (circular; stage0's recv is unused)
         if pp > 1:
             x_next = jax.lax.ppermute(
@@ -642,13 +682,13 @@ def _loss_fn(params, tokens, labels, cfg: GPTConfig, dp_mean=True):
             valid = t >= 0
             loss_t = head_loss(y, lab_t)
         loss_sum = loss_sum + jnp.where(valid, loss_t, 0.0)
-        aux_sum = aux_sum + aux
+        aux_sum = jax.tree.map(jnp.add, aux_sum, aux)
         n_done = n_done + jnp.where(valid, 1.0, 0.0)
         return (x_next, loss_sum, aux_sum, n_done), None
 
     x0 = jnp.zeros((Bm, S_loc, d), cd)
     (xf, loss_sum, aux_sum, n_done), _ = jax.lax.scan(
-        tick, (x0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+        tick, (x0, jnp.zeros((), jnp.float32), _zero_moe_stats(cfg),
                jnp.zeros((), jnp.float32)),
         (tok_sched, lab_sched, jnp.arange(T)))
 
@@ -657,17 +697,28 @@ def _loss_fn(params, tokens, labels, cfg: GPTConfig, dp_mean=True):
     if pp > 1:
         loss = jax.lax.psum(
             jnp.where(is_last, loss, 0.0), "pp")
-    # aux loss: each stage accumulated its local layers' aux over its M
-    # valid ticks; psum over pp totals all layers -> per-layer-per-micro
+    # MoE aux losses: each stage accumulated its local layers' stats
+    # over its M valid ticks; psum over pp totals all layers. Balance/z
+    # normalize to per-layer-per-micro; counts/dropped stay raw totals
+    # for this step (already psum'd over the dp x ep token axes inside
+    # `_moe_ffn`, so they are the GLOBAL step totals, replicated).
+    stats = None
     if cfg.moe_experts:
-        aux = aux_sum
+        stats = aux_sum
         if pp > 1:
-            aux = jax.lax.psum(aux, "pp")
-        aux = aux / (cfg.n_layers * max(M, 1))
-        loss = loss + cfg.moe_aux_weight * aux
-    # mean over dp (each dp rank computed its shard's loss)
-    if cfg.dp > 1 and dp_mean:
-        loss = jax.lax.pmean(loss, "dp")
+            stats = jax.lax.psum(stats, "pp")
+        per = cfg.n_layers * max(M, 1)
+        stats = dict(stats, balance=stats["balance"] / per,
+                     z=stats["z"] / per)
+        loss = loss + cfg.moe_aux_weight * stats["balance"] \
+            + cfg.moe_z_weight * stats["z"]
+    # mean over the data axes (each dp x ep rank computed its shard's
+    # loss; the MoE stats are already axis-invariant)
+    daxes = _moe_data_axes(cfg)
+    if daxes and dp_mean:
+        loss = jax.lax.pmean(loss, daxes)
+    if cfg.moe_experts:
+        return loss, stats
     return loss
 
 
@@ -758,12 +809,14 @@ def _world_axes(cfg: GPTConfig):
         axes.append("pp")
     if cfg.mp > 1:
         axes.append("mp")
+    if cfg.ep > 1:
+        axes.append("ep")
     return tuple(axes)
 
 
 def _zero_pad(cfg, n):
     from .zero import pad_len
-    return pad_len(n, max(cfg.dp * cfg.pp * cfg.mp, 1))
+    return pad_len(n, max(cfg.dp * cfg.pp * cfg.mp * cfg.ep, 1))
 
 
 def init_opt_state(cfg: GPTConfig, params):
@@ -863,7 +916,18 @@ def collective_bytes_per_step(cfg: GPTConfig, batch: int):
         Bm = max(batch // max(cfg.micro_batches, 1), 1)
         out["pp_ppermute_est"] = (2 * cfg.micro_batches * cfg.pp
                                   * Bm * S * d * act_bytes)
-    if cfg.zero_stage >= 1 and cfg.dp * cfg.pp * cfg.mp > 1:
+    if cfg.moe_experts and cfg.ep > 1:
+        # per layer: dispatch + combine all_to_all of the [E, C, d]
+        # capacity tensors, fwd + bwd (x2 each)
+        from . import moe_utils
+        T_loc = max(batch // max(cfg.dp * cfg.ep, 1), 1) * S \
+            // max(cfg.micro_batches, 1)
+        C = moe_utils.expert_capacity(T_loc, cfg.moe_experts,
+                                      cfg.moe_top_k,
+                                      cfg.moe_capacity_factor)
+        out["ep_alltoall_est"] = (4 * cfg.n_layers * cfg.micro_batches
+                                  * cfg.moe_experts * C * d * act_bytes)
+    if cfg.zero_stage >= 1 and cfg.dp * cfg.pp * cfg.mp * cfg.ep > 1:
         # optimizer update: grads reduce-scatter in, params all-gather
         # out, fp32 flat buffers; a world of 1 shards nothing
         out["zero_shard_est"] = 2 * n_params * 4
@@ -887,7 +951,9 @@ def auto_parallel_config(cfg: GPTConfig, n_devices, global_batch=32,
         vocab_size=cfg.vocab_size, d_ff=cfg.d_ff,
         global_batch=int(global_batch), n_heads=cfg.n_heads,
         param_bytes=4, grad_bytes=cd_bytes if cfg.bf16_grads else 4,
-        act_bytes=cd_bytes, remat=cfg.remat)
+        act_bytes=cd_bytes, remat=cfg.remat,
+        moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
+        moe_capacity_factor=cfg.moe_capacity_factor)
     # zero_stages limited to what GPTConfig executes (0/1): clamping a
     # zero>=2 winner after the fact would run a config the search's
     # HBM-feasibility gate never admitted
@@ -895,13 +961,12 @@ def auto_parallel_config(cfg: GPTConfig, n_devices, global_batch=32,
                            measurements=measurements,
                            schedules=("gpipe",), zero_stages=(0, 1))
     s = plan.strategy
-    # the search only admits bucket_size>0 on pure dense-DP meshes, so
-    # the scored config IS the executed one; MoE (not modeled by the
-    # tuner) still opts out — its expert leaves are dp-sharded
-    bucket = 0 if cfg.moe_experts else s.bucket_size
+    # the search only admits bucket_size>0 on pure dense-DP (ep=1)
+    # meshes, so the scored config IS the executed one
     cfg = dataclasses.replace(
-        cfg, dp=s.dp, mp=s.mp, pp=s.pp, micro_batches=s.micro_batches,
-        zero_stage=s.zero_stage, grad_bucket_bytes=bucket)
+        cfg, dp=s.dp, mp=s.mp, pp=s.pp, ep=s.ep,
+        micro_batches=s.micro_batches, zero_stage=s.zero_stage,
+        grad_bucket_bytes=s.bucket_size)
     return cfg, plan
 
 
@@ -932,22 +997,36 @@ class HybridGPT:
             raise ValueError(f"unknown strategy {strategy!r} "
                              "(None or 'auto')")
         self.cfg = cfg
-        n = cfg.dp * cfg.pp * cfg.mp
+        self.last_moe_stats = None
+        self._moe_stats_pending = None
+        n = cfg.dp * cfg.pp * cfg.mp * cfg.ep
         assert len(devices) >= n, \
             f"need {n} devices, have {len(devices)}"
-        self.mesh = Mesh(np.array(devices[:n]).reshape(cfg.dp, cfg.pp,
-                                                       cfg.mp),
-                         ("dp", "pp", "mp"))
+        moe = cfg.moe_experts > 0
+        # MoE configs ride a 4th "ep" mesh axis (present even at ep=1
+        # so expert param specs always resolve and EP=1/EP=2 compile
+        # identical program structure); dense configs keep the exact
+        # 3-axis mesh — no new axis, no new compile cost. Tokens are
+        # data-sharded over (dp, ep) jointly under MoE.
+        if moe:
+            shape, axes = (cfg.dp, cfg.pp, cfg.mp, cfg.ep), \
+                ("dp", "pp", "mp", "ep")
+        else:
+            shape, axes = (cfg.dp, cfg.pp, cfg.mp), ("dp", "pp", "mp")
+        self.mesh = Mesh(np.array(devices[:n]).reshape(shape), axes)
         self.pspecs = param_specs(cfg)
         self.ospecs = opt_specs(cfg, self.pspecs)
         cfg_ref = cfg
         mesh = self.mesh
-        data_spec = P("dp", None)
+        data_spec = P(("dp", "ep"), None) if moe else P("dp", None)
+        self._data_spec = data_spec
 
+        stats_spec = jax.tree.map(lambda _: P(), _zero_moe_stats(cfg))
+        loss_out = (P(), stats_spec) if moe else P()
         loss_sm = _shard_map(
             lambda p, tok, lab: _loss_fn(p, tok, lab, cfg_ref),
             mesh=mesh, in_specs=(self.pspecs, data_spec, data_spec),
-            out_specs=P(), check_vma=False)
+            out_specs=loss_out, check_vma=False)
 
         use_buckets = cfg.grad_bucket_bytes > 0 and cfg.dp > 1
         self._use_buckets = use_buckets
@@ -973,6 +1052,7 @@ class HybridGPT:
                 out_specs=(P(), self.pspecs), check_vma=False)
 
         def step(params, opt_state, tokens, labels, lr, t):
+            mstats = None
             if cfg_ref.bf16_grads:
                 cd = cfg_ref.compute_dtype
                 target = jax.tree.map(
@@ -982,6 +1062,9 @@ class HybridGPT:
                 target = params
             if use_buckets:
                 loss, grads = grads_sm(target, tokens, labels)
+            elif moe:
+                (loss, mstats), grads = jax.value_and_grad(
+                    loss_sm, has_aux=True)(target, tokens, labels)
             else:
                 loss, grads = jax.value_and_grad(loss_sm)(target, tokens,
                                                           labels)
@@ -995,6 +1078,8 @@ class HybridGPT:
                         g.dtype), grads)
             params, opt_state = _apply_updates(cfg_ref, mesh, params,
                                                grads, opt_state, lr, t)
+            if moe:
+                return params, opt_state, loss, mstats
             return params, opt_state, loss
 
         # pin the step outputs to the canonical param/opt shardings:
@@ -1007,29 +1092,39 @@ class HybridGPT:
         out_shard = (jax.tree.map(cn, self.pspecs, is_leaf=is_spec),
                      jax.tree.map(cn, self.ospecs, is_leaf=is_spec),
                      cn(P()))
+        step_shard = out_shard if not moe else out_shard + (
+            jax.tree.map(lambda _: cn(P()), _zero_moe_stats(cfg)),)
         self._step = instrumented_jit(step, "HybridGPT.train_step",
                                       donate_argnums=(0, 1),
-                                      out_shardings=out_shard)
+                                      out_shardings=step_shard)
         self._loss_sm = loss_sm
         self._loss_jit = instrumented_jit(loss_sm, "HybridGPT.loss")
 
         def steps_k(params, opt_state, tokens, labels, lr, t0, k):
             """K training steps as ONE executable (lax.scan over the
             step body) — the hapi run_many grouping applied to the
-            hybrid trainer: amortizes per-dispatch relay latency."""
+            hybrid trainer: amortizes per-dispatch relay latency.
+            MoE configs additionally stack the per-step routing stats
+            as scan ys so train_many does not silently drop them."""
             def body(carry, i):
                 p, o = carry
-                p, o, loss = step(p, o, tokens, labels, lr, t0 + i)
-                return (p, o), loss
-            (params, opt_state), losses = jax.lax.scan(
+                res = step(p, o, tokens, labels, lr, t0 + i)
+                ys = res[2] if not moe else (res[2], res[3])
+                return (res[0], res[1]), ys
+            (params, opt_state), ys = jax.lax.scan(
                 body, (params, opt_state),
                 jnp.arange(k, dtype=jnp.float32))
-            return params, opt_state, losses
+            if moe:
+                losses, stats_k = ys
+                return params, opt_state, losses, stats_k
+            return params, opt_state, ys
 
+        many_shard = out_shard if not moe else out_shard + (
+            jax.tree.map(lambda _: cn(P()), _zero_moe_stats(cfg)),)
         self._steps_k = instrumented_jit(steps_k, "HybridGPT.train_many",
                                          static_argnums=(6,),
                                          donate_argnums=(0, 1),
-                                         out_shardings=out_shard)
+                                         out_shardings=many_shard)
 
     def init(self, key):
         # Generate the full logical params UNSHARDED, then device_put
@@ -1056,10 +1151,18 @@ class HybridGPT:
         return p_init, o_init
 
     def shard_data(self, tokens, labels):
-        ds = NamedSharding(self.mesh, P("dp", None))
+        ds = NamedSharding(self.mesh, self._data_spec)
         return (jax.device_put(tokens, ds), jax.device_put(labels, ds))
 
     def loss(self, params, tokens, labels):
+        out = self._loss_jit(params, tokens, labels)
+        return out[0] if self.cfg.moe_experts else out
+
+    def loss_and_moe_stats(self, params, tokens, labels):
+        """(loss, stats) for MoE configs — stats per `_zero_moe_stats`
+        (balance/z per-layer-per-micro means, global expert counts and
+        dropped-token total for the batch)."""
+        assert self.cfg.moe_experts, "dense config has no MoE stats"
         return self._loss_jit(params, tokens, labels)
 
     def collective_bytes_per_step(self, batch):
@@ -1083,16 +1186,58 @@ class HybridGPT:
         t = jnp.asarray(step_num, jnp.float32)
         if _metrics._enabled:
             self._record_collectives(tokens, params=params)
-        return self._step(params, opt_state, tokens, labels, lr, t)
+        res = self._step(params, opt_state, tokens, labels, lr, t)
+        if self.cfg.moe_experts:
+            params, opt_state, loss, mstats = res
+            # device arrays; host fetch deferred to the accessor. With
+            # metrics on, record the PREVIOUS step's stats — step N is
+            # already enqueued, so the device_get of step N-1's
+            # (finished) stats never stalls async dispatch; the gauges
+            # lag one step
+            self.last_moe_stats = mstats
+            if _metrics._enabled:
+                prev = self._moe_stats_pending
+                self._moe_stats_pending = mstats
+                if prev is not None:
+                    self._record_moe_stats(prev)
+            return params, opt_state, loss
+        return res
+
+    def _record_moe_stats(self, mstats):
+        st = jax.device_get(mstats)
+        _metrics.record_moe_stats("train", st["counts"], st["dropped"],
+                                  st["balance"])
+
+    def flush_moe_metrics(self):
+        """Drain the one-step-lagged MoE metrics (train_step records
+        step N when step N+1 dispatches): call after the LAST step of
+        a metrics-enabled run so the final step's routing stats land
+        in the registry too."""
+        if self._moe_stats_pending is not None and _metrics._enabled:
+            self._record_moe_stats(self._moe_stats_pending)
+        self._moe_stats_pending = None
 
     def train_many(self, params, opt_state, tokens, labels, k, lr=None,
                    start_step=1):
         """Run k steps in one device dispatch; returns
-        (params, opt_state, losses[k])."""
+        (params, opt_state, losses[k]). MoE configs keep their
+        routing stats: `last_moe_stats` holds the FINAL step's and the
+        metrics record the k-step aggregate."""
         lr = jnp.asarray(lr if lr is not None else self.cfg.learning_rate,
                          jnp.float32)
         t0 = jnp.asarray(start_step, jnp.float32)
         if _metrics._enabled:
             self._record_collectives(tokens, steps=int(k), params=params)
-        return self._steps_k(params, opt_state, tokens, labels, lr, t0,
-                             int(k))
+        res = self._steps_k(params, opt_state, tokens, labels, lr, t0,
+                            int(k))
+        if self.cfg.moe_experts:
+            params, opt_state, losses, stats_k = res
+            self.last_moe_stats = jax.tree.map(lambda a: a[-1], stats_k)
+            if _metrics._enabled:
+                st = jax.device_get(stats_k)
+                _metrics.record_moe_stats(
+                    "train", np.sum(st["counts"], axis=0),
+                    float(np.sum(st["dropped"])),
+                    float(st["balance"][-1]))
+            return params, opt_state, losses
+        return res

@@ -1,21 +1,255 @@
-"""`paddle.distributed.utils.global_scatter/global_gather` parity
-(`python/paddle/distributed/utils/moe_utils.py:21,144` over the
-`global_scatter/global_gather` CUDA ops).
+"""MoE routing + dispatch utilities.
 
-Count-based MoE token exchange: rows of x are grouped per
-(destination card, expert); each card keeps the rows routed to its own
-experts. Single-process world (world_size=1) runs the permutation
-directly; the multi-card compiled path is `incubate.distributed.models
-.moe` (capacity all_to_all inside the jitted step), which is how the
-TPU build actually trains MoE — these eager wrappers exist for the
-reference's dygraph API surface.
+Two layers live here:
+
+1. **The fixed-shape top-k capacity router** (ISSUE 10): softmax gate,
+   per-expert capacity slots, overflow dropped (the caller's residual
+   path covers dropped tokens), GShard-style load-balance loss and
+   router z-loss. Dispatch and combine are expressed as one-hot
+   einsums over `[T, k, C]` / `[T, k, E]` masks, so the whole MoE
+   block is static-shape and XLA fuses it — the TPU replacement for
+   the reference's `number_count`/`assign_pos`/
+   `prune_gate_by_capacity` CUDA op chain. Every MoE consumer shares
+   this one core: `parallel.hybrid_gpt._moe_ffn` (training),
+   `incubate.nn.fused_transformer._ffn_moe` (fused stack + eager),
+   `incubate.distributed.models.moe.MoELayer`, and the serving mixed
+   step (`serving.engine`).
+
+2. **Expert-parallel exchange.** `all_to_all_dispatch` /
+   `all_to_all_combine` move the `[E, C, d]` dispatch tensors over an
+   expert-parallel mesh axis inside a compiled step (the
+   `global_scatter/global_gather` capability riding `lax.all_to_all`
+   on ICI); the eager `global_scatter/global_gather` wrappers keep
+   parity with `python/paddle/distributed/utils/moe_utils.py:21,144`
+   for the reference's dygraph API surface.
 """
 from __future__ import annotations
+
+import dataclasses
+import math
 
 import numpy as np
 
 from ..core.tensor import Tensor
 from . import env as dist_env
+
+
+# ---------------------------------------------------------------------
+# fixed-shape top-k capacity routing (pure jax; shapes never depend on
+# routing decisions, so the consumers stay one-compile)
+# ---------------------------------------------------------------------
+
+
+def expert_capacity(num_tokens, num_experts, top_k, capacity_factor):
+    """Per-expert capacity slots C = ceil(factor * T * k / E), floored
+    at 1. At `capacity_factor >= E / top_k` (e.g. >= top_k when
+    E == top_k**2) C reaches T, so no token can overflow — the
+    zero-drop regime the smoke contracts pin."""
+    c = capacity_factor * float(num_tokens) * float(top_k) \
+        / float(num_experts)
+    return max(1, int(math.ceil(c)))
+
+
+@dataclasses.dataclass
+class DispatchPlan:
+    """Fixed-shape masks for one routed token set.
+
+    disp  [T, k, C]  0/1 dispatch mask (capacity slot per choice)
+    comb  [T, k, C]  gate-weighted combine mask (disp * gate value)
+    e_oh  [T, k, E]  expert one-hot per choice (invalid/padded rows 0)
+    counts  [E] f32  tokens each expert actually received (post-drop)
+    dropped    f32   (token, choice) pairs lost to capacity overflow
+    """
+    disp: object
+    comb: object
+    e_oh: object
+    counts: object
+    dropped: object
+
+
+def capacity_dispatch(gate_val, gate_idx, num_experts, capacity,
+                      valid=None, dtype=None):
+    """Build the dispatch/combine masks for already-chosen experts.
+
+    gate_val/gate_idx [T, k]; `valid` [T] bool masks padding tokens
+    (they claim no capacity and never reach an expert — the serving
+    engine's empty slots). Slot assignment is a cumulative count in
+    token-major, choice-minor order, so earlier tokens win capacity
+    (GShard's position-in-expert semantics); an overflowing choice is
+    dropped: its disp/comb rows are zero and the caller's residual
+    connection carries the token through unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    T, k = gate_val.shape
+    E, C = int(num_experts), int(capacity)
+    dtype = dtype or gate_val.dtype
+    oh = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)          # [T,k,E]
+    if valid is not None:
+        oh = oh * valid.astype(jnp.int32)[:, None, None]
+    flat_oh = oh.reshape(T * k, E)
+    # position of each (token, choice) within its expert's arrival order
+    pos = jnp.cumsum(flat_oh, axis=0) * flat_oh - 1            # [T*k,E]
+    slot = jnp.sum(pos * flat_oh, axis=-1).reshape(T, k)       # [T,k]
+    routed = jnp.sum(oh, axis=-1) > 0                          # [T,k]
+    in_cap = routed & (slot < C)
+    disp = (jax.nn.one_hot(slot, C, dtype=dtype)
+            * in_cap[..., None].astype(dtype))                 # [T,k,C]
+    comb = disp * gate_val.astype(dtype)[..., None]
+    e_oh = oh.astype(dtype)
+    # counts summed in f32 from the int masks: a bf16 compute dtype
+    # would round the running sum past ~256 tokens per expert and
+    # break the exact-count contracts (sum == T*k) the smokes pin
+    kept = jnp.sum(oh.astype(jnp.float32)
+                   * in_cap[..., None].astype(jnp.float32),
+                   axis=(0, 1))                                # [E]
+    dropped = (jnp.sum(routed.astype(jnp.float32))
+               - jnp.sum(in_cap.astype(jnp.float32)))
+    return DispatchPlan(disp=disp, comb=comb, e_oh=e_oh, counts=kept,
+                        dropped=dropped)
+
+
+def _masked_axis_sums(vals, valid, axes):
+    """Sum `vals` ([T, ...]) over tokens (masked by `valid`) and over
+    the given mesh axes; returns (sums, n_tokens) — the ingredients of
+    an EP/DP-invariant mean."""
+    import jax
+    import jax.numpy as jnp
+
+    if valid is not None:
+        v = valid.astype(vals.dtype)
+        vals = vals * v.reshape((-1,) + (1,) * (vals.ndim - 1))
+        n = jnp.sum(v.astype(jnp.float32))
+    else:
+        n = jnp.asarray(float(vals.shape[0]), jnp.float32)
+    s = jnp.sum(vals, axis=0)
+    if axes:
+        s = jax.lax.psum(s, axes)
+        n = jax.lax.psum(n, axes)
+    return s, n
+
+
+def router_balance_loss(probs, e_oh, valid=None, axes=None):
+    """GShard/Switch load-balance loss, top-k generalized:
+
+        aux = E * sum_e  mean_t(probs[t, e]) * f_e
+        f_e = (1 / (T * k)) * sum_{t,j} 1[choice (t, j) routed to e]
+
+    Uniform routing gives aux == 1 (the minimum for a fixed me). When
+    `axes` names mesh axes (("dp", "ep") in the hybrid step), the two
+    means are computed over the GLOBAL token set via psums, so the
+    loss — and its gradient — is invariant to how tokens are sharded
+    (the EP=2 vs EP=1 parity contract)."""
+    import jax.numpy as jnp
+
+    E = probs.shape[-1]
+    k = e_oh.shape[1]
+    me_s, n = _masked_axis_sums(probs.astype(jnp.float32), valid, axes)
+    ce_s, _ = _masked_axis_sums(
+        jnp.sum(e_oh.astype(jnp.float32), axis=1), valid, axes)
+    n = jnp.maximum(n, 1.0)
+    me = me_s / n
+    ce = ce_s / (n * float(k))
+    return float(E) * jnp.sum(me * ce)
+
+
+def router_z_loss(logits, valid=None, axes=None):
+    """Router z-loss (ST-MoE): mean_t logsumexp(logits[t])^2 — keeps
+    the gate logits small so the softmax stays in its stable range."""
+    import jax
+    import jax.numpy as jnp
+
+    z = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1) ** 2
+    s, n = _masked_axis_sums(z, valid, axes)
+    return s / jnp.maximum(n, 1.0)
+
+
+@dataclasses.dataclass
+class RouterOutput:
+    plan: DispatchPlan
+    gates: object        # [T, k] renormalized top-k gate values
+    balance_loss: object  # scalar f32
+    z_loss: object        # scalar f32
+
+
+def top_k_routing(logits, top_k, capacity, valid=None, axes=None,
+                  dtype=None):
+    """Softmax gate -> top-k -> renormalize -> capacity dispatch.
+
+    logits [T, E] f32-castable; returns a `RouterOutput` whose plan
+    carries the fixed-shape dispatch/combine masks plus the aux
+    losses. `axes` (mesh axis names) makes the aux statistics global —
+    pass the data-sharding axes when tracing inside shard_map."""
+    import jax
+    import jax.numpy as jnp
+
+    lf = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(lf, axis=-1)
+    topv, topi = jax.lax.top_k(probs, int(top_k))
+    gates = topv / jnp.maximum(
+        jnp.sum(topv, axis=-1, keepdims=True), 1e-12)
+    plan = capacity_dispatch(gates, topi, logits.shape[-1], capacity,
+                             valid=valid, dtype=dtype or logits.dtype)
+    aux = router_balance_loss(probs, plan.e_oh, valid=valid, axes=axes)
+    z = router_z_loss(lf, valid=valid, axes=axes)
+    return RouterOutput(plan=plan, gates=gates, balance_loss=aux,
+                        z_loss=z)
+
+
+def dispatch_tokens(x, plan, e_oh=None):
+    """x [T, d] -> dispatched [E, C, d] (each expert's capacity
+    buffer, zero-padded on unclaimed slots). Pass a sliced `e_oh`
+    ([T, k, E_loc]) to build only one shard's resident-expert buffers
+    — the serving EP path, where computing all E and slicing after
+    would waste (ep-1)/ep of the dispatch einsum."""
+    import jax.numpy as jnp
+    e_oh = plan.e_oh if e_oh is None else e_oh
+    return jnp.einsum("tkc,tke,td->ecd", plan.disp, e_oh,
+                      x.astype(plan.disp.dtype))
+
+
+def combine_tokens(eout, plan):
+    """eout [E, C, d] expert outputs -> [T, d] gate-weighted mixture;
+    dropped (token, choice) pairs contribute 0."""
+    import jax.numpy as jnp
+    return jnp.einsum("tkc,tke,ecd->td", plan.comb, plan.e_oh,
+                      eout.astype(plan.comb.dtype))
+
+
+# ---------------------------------------------------------------------
+# expert-parallel exchange over a mesh axis (inside shard_map)
+# ---------------------------------------------------------------------
+
+
+def all_to_all_dispatch(dispatched, axis, ep):
+    """[E, C, d] per-rank dispatch buffers -> [E_loc, ep * C, d] per-
+    expert inputs on the expert's owner rank. The compiled
+    `global_scatter`: each rank keeps the buckets of its resident
+    experts from every source rank (the received leading dim indexes
+    the source, concatenated into the capacity axis)."""
+    import jax
+    import jax.numpy as jnp
+    E, C, d = dispatched.shape
+    E_loc = E // int(ep)
+    t = dispatched.reshape(int(ep), E_loc, C, d)
+    t = jax.lax.all_to_all(t, axis, split_axis=0, concat_axis=0,
+                           tiled=False)
+    return jnp.swapaxes(t, 0, 1).reshape(E_loc, int(ep) * C, d)
+
+
+def all_to_all_combine(eout, axis, ep):
+    """Inverse of `all_to_all_dispatch` (the compiled `global_gather`):
+    [E_loc, ep * C, d] expert outputs -> [E, C, d] back on the token
+    owners."""
+    import jax
+    import jax.numpy as jnp
+    E_loc, epC, d = eout.shape
+    C = epC // int(ep)
+    t = jnp.swapaxes(eout.reshape(E_loc, int(ep), C, d), 0, 1)
+    t = jax.lax.all_to_all(t, axis, split_axis=0, concat_axis=0,
+                           tiled=False)
+    return t.reshape(E_loc * int(ep), C, d)
 
 
 def _counts(t):

@@ -209,17 +209,55 @@ SERVING_TP_SPECS = {
     "ffn2_b": (P(), False), "ffn2_s": (P(), False),
 }
 
+#: MoE decoder stacks (FusedMultiTransformerMoe): the gate replicates
+#: (every shard routes the full token set identically); the expert-
+#: stacked FFN params shard their EXPERT axis over "ep" and keep the
+#: dense column/row-parallel mp split WITHIN each expert. ffn2_b is
+#: per-expert, so unlike the dense stack it shards over ep (added once
+#: after the mp psum, exactly like the dense bias-after-psum rule).
+SERVING_MOE_TP_SPECS = {
+    "gate_w": (P(), False),
+    "ffn1_w": (P(None, "ep", None, "mp"), False),
+    "ffn1_b": (P(None, "ep", "mp"), False),
+    "ffn1_s": (P(None, "ep", "mp"), False),
+    "ffn2_w": (P(None, "ep", "mp", None), False),
+    "ffn2_b": (P(None, "ep", None), False),
+    "ffn2_s": (P(None, "ep", None), False),
+}
 
-def serving_tp_spec(name):
+
+def serving_tp_spec(name, moe=False):
     """PartitionSpec + permute flag for one decoder param under the TP
-    serving engine. Unknown names (e.g. MoE gates) raise so new stack
+    (x EP when `moe`) serving engine. Unknown names raise so new stack
     variants fail loudly instead of silently replicating."""
     try:
+        if moe and name in SERVING_MOE_TP_SPECS:
+            return SERVING_MOE_TP_SPECS[name]
         return SERVING_TP_SPECS[name]
     except KeyError:
         raise ValueError(
             f"no tensor-parallel sharding rule for decoder param "
             f"{name!r} — add it to parallel.mp_layers.SERVING_TP_SPECS")
+
+
+def tp_ep_mesh(tensor_parallel, expert_parallel, devices=None):
+    """2-D `("ep", "mp")` mesh for MoE serving: `expert_parallel` rows
+    of `tensor_parallel` devices. Experts shard over rows, heads and
+    expert-FFN columns over columns; the token set replicates."""
+    import numpy as np
+    from jax.sharding import Mesh
+    tp, ep = int(tensor_parallel), int(expert_parallel)
+    if tp < 1 or ep < 1:
+        raise ValueError(
+            f"tensor_parallel/expert_parallel must be >= 1, got "
+            f"{tp}/{ep}")
+    n = tp * ep
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise ValueError(
+            f"tensor_parallel={tp} x expert_parallel={ep} needs {n} "
+            f"devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]).reshape(ep, tp), ("ep", "mp"))
 
 
 def place_model_on_mesh(model, mesh):

@@ -521,3 +521,58 @@ HAPI_EPOCHS = REGISTRY.counter(
 HOST_EVENTS_DROPPED = REGISTRY.counter(
     "paddle_tpu_profiler_host_events_dropped_total",
     "RecordEvent spans dropped by the bounded host ring buffer")
+
+# ---- MoE routing (ISSUE 10): shared by the hybrid trainer
+# ("train" path) and the serving mixed step ("serving" path) -----------
+MOE_EXPERT_TOKENS = REGISTRY.counter(
+    "paddle_tpu_moe_expert_tokens_total",
+    "Tokens dispatched to each expert (post-capacity)",
+    ("path", "expert"))
+MOE_DROPPED_TOKENS = REGISTRY.counter(
+    "paddle_tpu_moe_dropped_tokens_total",
+    "(token, choice) routing assignments lost to capacity overflow "
+    "(the token rides the residual path instead)", ("path",))
+MOE_EXPERT_UTILIZATION = REGISTRY.gauge(
+    "paddle_tpu_moe_expert_utilization",
+    "Normalized entropy of the cumulative per-expert token "
+    "distribution (1.0 = perfectly balanced, 0.0 = one expert takes "
+    "everything)", ("path",))
+MOE_AUX_LOSS = REGISTRY.gauge(
+    "paddle_tpu_moe_aux_loss",
+    "Latest GShard load-balance loss of the routed batch (1.0 = "
+    "uniform routing)", ("path",))
+
+
+def moe_utilization_entropy(counts):
+    """Normalized entropy of a per-expert token-count vector in
+    [0, 1] — the `paddle_tpu_moe_expert_utilization` gauge value (one
+    definition shared by the trainer, the serving engine, bench.py and
+    the moe_smoke contract)."""
+    import numpy as _np
+    c = _np.asarray(counts, _np.float64)
+    total = c.sum()
+    if total <= 0 or c.size <= 1:
+        return 0.0
+    p = c / total
+    p = p[p > 0]
+    return float(-(p * _np.log(p)).sum() / _np.log(c.size))
+
+
+def record_moe_stats(path, counts, dropped, aux, utilization=None):
+    """One emission path for a routed batch's MoE stats — shared by
+    the hybrid trainer ("train") and the serving engine ("serving") so
+    the counter/gauge semantics cannot drift. `utilization` overrides
+    the entropy source (the engine passes its CUMULATIVE distribution;
+    the trainer lets the per-step counts speak)."""
+    import numpy as _np
+    counts = _np.asarray(counts, _np.float64)
+    for e, c in enumerate(counts):
+        if c:
+            MOE_EXPERT_TOKENS.labels(path, str(e)).inc(float(c))
+    dropped = float(dropped)
+    if dropped:
+        MOE_DROPPED_TOKENS.labels(path).inc(dropped)
+    MOE_AUX_LOSS.labels(path).set(float(aux))
+    MOE_EXPERT_UTILIZATION.labels(path).set(
+        moe_utilization_entropy(counts) if utilization is None
+        else float(utilization))

@@ -42,6 +42,7 @@ class SamplingConfig:
     top_p: float = 1.0             # 1.0 = off
     repetition_penalty: float = 1.0   # 1.0 = off (HF semantics)
     presence_penalty: float = 0.0     # 0.0 = off (additive, one-shot)
+    frequency_penalty: float = 0.0    # 0.0 = off (count-scaled)
     penalty_window: int = 128      # tokens of context the penalties see
 
 
@@ -49,7 +50,8 @@ def needs_history(sc: SamplingConfig) -> bool:
     """True when `select_token` wants the per-slot token-history input
     (any logit processor active) — the engine then packs a fixed
     `[max_slots, penalty_window]` history tensor into the mixed step."""
-    return sc.repetition_penalty != 1.0 or sc.presence_penalty != 0.0
+    return (sc.repetition_penalty != 1.0 or sc.presence_penalty != 0.0
+            or sc.frequency_penalty != 0.0)
 
 
 def apply_logit_penalties(logits, history, sc: SamplingConfig):
@@ -64,8 +66,11 @@ def apply_logit_penalties(logits, history, sc: SamplingConfig):
 
     * repetition (HF semantics): seen tokens' logits are divided by
       the penalty when positive, multiplied when negative.
-    * presence: a flat subtraction per seen token (one-shot, not
-      count-scaled — the frequency variant would use `counts`)."""
+    * presence: a flat subtraction per seen token (one-shot).
+    * frequency: a COUNT-SCALED subtraction — each occurrence in the
+      window adds another `frequency_penalty`, so chronic repeaters
+      are pushed down harder than one-off mentions (the OpenAI-style
+      companion of the one-shot presence penalty)."""
     import jax.numpy as jnp
     valid = history >= 0
     idx = jnp.where(valid, history, 0)
@@ -81,6 +86,8 @@ def apply_logit_penalties(logits, history, sc: SamplingConfig):
     if sc.presence_penalty != 0.0:
         logits = logits - float(sc.presence_penalty) * seen.astype(
             logits.dtype)
+    if sc.frequency_penalty != 0.0:
+        logits = logits - float(sc.frequency_penalty) * counts
     return logits
 
 

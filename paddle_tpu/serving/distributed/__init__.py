@@ -5,8 +5,11 @@ Two layers over the single-process serving stack (docs/SERVING.md,
 
 * `tp_engine.TPServingEngine` — the ONE compiled mixed step and the
   paged KV block pools sharded over a 1-D `("mp",)` tensor-parallel
-  mesh: heads partitioned, block tables replicated, token-identical to
-  the TP=1 engine and still exactly one compile per engine.
+  mesh (or a 2-D `("ep", "mp")` mesh for MoE stacks:
+  `expert_parallel=` shards the experts, TP x EP compose —
+  docs/MOE.md): heads partitioned, block tables replicated,
+  token-identical to the TP=1/EP=1 engine and still exactly one
+  compile per engine.
 * `router.ReplicaRouter` — asyncio ingress over N `ServingFrontend`
   replicas with prefix-affinity dispatch (a router-side shadow radix
   index estimates each replica's cached prefixes), queue-depth load

@@ -1,9 +1,11 @@
-"""Tensor-parallel serving engine: the ONE mixed step, sharded.
+"""Tensor-parallel (x expert-parallel) serving engine: the ONE mixed
+step, sharded.
 
 `TPServingEngine` runs the exact host loop of `serving.engine`
 (scheduler, paged KV bookkeeping, speculation, prefix cache — all
 inherited unchanged) while the compiled mixed step executes SPMD over
-a 1-D `("mp",)` mesh (`parallel.mp_layers.tp_mesh`):
+a 1-D `("mp",)` mesh (`parallel.mp_layers.tp_mesh`) — or, for MoE
+decoder stacks, a 2-D `("ep", "mp")` mesh (`mp_layers.tp_ep_mesh`):
 
 * **Heads partitioned on `mp`** — the fused QKV out axis is permuted
   host-side into shard-major order (`mp_layers.shard_major_qkv`) so a
@@ -12,7 +14,7 @@ a 1-D `("mp",)` mesh (`parallel.mp_layers.tp_mesh`):
   `ops.pallas.flash_attention` ragged/verify/paged entries see
   per-shard head slices of q and of the pools.
 * **KV block pools sharded on the head axis** — `[L, NB, BS, H, Dh]`
-  pools carry `P(None, None, None, "mp", None)`, so each chip holds
+  pools carry `P(None, None, None, "mp")`, so each chip holds
   `1/tp` of the KV bytes; block TABLES stay replicated host-side
   numpy exactly as in the single-chip engine (identical block ids on
   every shard — the allocator remains one logical free list).
@@ -21,34 +23,57 @@ a 1-D `("mp",)` mesh (`parallel.mp_layers.tp_mesh`):
   shared `_step_body` (engine.py) emits `lax.psum(..., "mp")` for both
   via `cfg.mp_axis`, after which hidden states are replicated and the
   sampling head runs identically on every shard.
+* **Experts partitioned on `ep`** (`expert_parallel > 1`, MoE stacks
+  only) — the expert-stacked FFN weights shard their expert axis over
+  `ep` (`mp_layers.SERVING_MOE_TP_SPECS`) while each expert's FFN
+  keeps the dense column/row mp split, so TP and EP COMPOSE. The token
+  set is replicated across shards, so the training-style all_to_all
+  degenerates: each shard slices its resident experts out of the
+  (identical) `[E, C, D]` dispatch tensor, runs `E/ep` experts at
+  capacity `C`, and the combine psums partial mixtures over `ep`
+  (`incubate.nn.fused_transformer._ffn_moe_tokens`). Routing, gate
+  logits and the MoE statistics are identical on every shard, so
+  EP=2 serving is token-identical to EP=1 — same one-compile rule,
+  capacity overflow still degrades to the residual path. KV pools
+  replicate over `ep` (they shard over `mp` only).
 
-Contracts (tests/test_tp_serving.py): token parity with the TP=1
-engine on the CPU virtual-device mesh (speculation on and off), still
-exactly ONE compile per engine, allocator/CoW/truncate/prefix-cache
-invariants unchanged per shard.
+Contracts (tests/test_tp_serving.py + tests/test_moe.py): token parity
+with the TP=1/EP=1 engine on the CPU virtual-device mesh (speculation
+on and off), still exactly ONE compile per engine, allocator/CoW/
+truncate/prefix-cache invariants unchanged per shard.
 """
 from __future__ import annotations
 
 from ...parallel import shard_map as _shard_map
 from ...parallel.mp_layers import (serving_tp_spec, shard_major_qkv,
-                                   tp_mesh)
+                                   tp_ep_mesh, tp_mesh)
 from ..engine import ServingEngine
 
 
 class TPServingEngine(ServingEngine):
-    """`ServingEngine` with the mixed step sharded over an `mp` mesh.
+    """`ServingEngine` with the mixed step sharded over an `mp` (or
+    `ep x mp` for MoE) mesh.
 
     `tensor_parallel=1` degrades to a 1-device mesh (useful for
-    exercising the shard_map plumbing without parallelism); the host
-    API is identical to the base engine.
+    exercising the shard_map plumbing without parallelism);
+    `expert_parallel > 1` shards a MoE stack's experts over the extra
+    `ep` mesh rows. The host API is identical to the base engine.
     """
 
-    def __init__(self, model, *, tensor_parallel=2, mesh=None, **kw):
+    def __init__(self, model, *, tensor_parallel=2, expert_parallel=1,
+                 mesh=None, **kw):
         dec = model.decoder
-        if getattr(dec, "_num_experts", 0):
-            raise NotImplementedError(
-                "MoE decoder stacks are not tensor-parallel-served yet")
         tp = int(tensor_parallel)
+        ep = int(expert_parallel)
+        n_exp = int(getattr(dec, "_num_experts", 0))
+        if ep > 1 and not n_exp:
+            raise ValueError(
+                "expert_parallel > 1 needs a MoE decoder stack "
+                "(FusedMultiTransformerMoe)")
+        if n_exp and n_exp % ep:
+            raise ValueError(
+                f"num_experts={n_exp} not divisible by "
+                f"expert_parallel={ep}")
         if dec.num_heads % tp:
             raise ValueError(
                 f"num_heads={dec.num_heads} not divisible by "
@@ -58,10 +83,20 @@ class TPServingEngine(ServingEngine):
                 f"dim_feedforward={dec.dim_feedforward} not divisible "
                 f"by tensor_parallel={tp}")
         self.tensor_parallel = tp
-        self.mesh = mesh if mesh is not None else tp_mesh(tp)
-        if tuple(self.mesh.axis_names) != ("mp",):
+        self.expert_parallel = ep
+        # MoE stacks always ride the 2-D mesh (the expert param specs
+        # name "ep" even at ep=1); dense stacks keep the 1-D mesh the
+        # PR 8 contracts pinned
+        if mesh is not None:
+            self.mesh = mesh
+        elif n_exp:
+            self.mesh = tp_ep_mesh(tp, ep)
+        else:
+            self.mesh = tp_mesh(tp)
+        want = ("ep", "mp") if n_exp else ("mp",)
+        if tuple(self.mesh.axis_names) != want:
             raise ValueError(
-                f"TP serving mesh must be 1-D ('mp',), got "
+                f"serving mesh for this stack must be {want}, got "
                 f"{self.mesh.axis_names}")
         super().__init__(model, **kw)
         self._shard_state()
@@ -73,19 +108,27 @@ class TPServingEngine(ServingEngine):
         # by trimming trailing Nones, and a spec-different-but-
         # placement-identical initial device_put would make the SECOND
         # step miss the jit cache and recompile (the PR 7 hybrid-step
-        # lesson, re-learned here by contract test)
+        # lesson, re-learned here by contract test). Under the 2-D MoE
+        # mesh the same spec replicates the pools over ep. At tp=1 the
+        # normalization ALSO drops the size-1 "mp" entry entirely, so
+        # pre-normalize to P() — otherwise an EP-only mesh pays the
+        # same second-step recompile (caught by tools/moe_smoke.py).
         from jax.sharding import PartitionSpec as P
+        if self.tensor_parallel == 1:
+            return P()
         return P(None, None, None, "mp")
 
     def _array_specs(self):
         """One PartitionSpec per entry of `self._arrays` (the order
         `_gen_tensors` fixes: we, pe, decoder params, ln_f w/b, head —
         embeddings and the lm head replicate; decoder params follow
-        `mp_layers.SERVING_TP_SPECS`)."""
+        `mp_layers.SERVING_TP_SPECS`, MoE experts
+        `SERVING_MOE_TP_SPECS`)."""
         from jax.sharding import PartitionSpec as P
         names = self.model._dec_names
+        moe = self.num_experts > 0
         return ([P(), P()]
-                + [serving_tp_spec(n)[0] for n in names]
+                + [serving_tp_spec(n, moe=moe)[0] for n in names]
                 + [P(), P(), P()])
 
     def _shard_state(self):
@@ -99,9 +142,10 @@ class TPServingEngine(ServingEngine):
         dec = self.model.decoder
         names = self.model._dec_names
         H, Dh = dec.num_heads, dec.head_dim
+        moe = self.num_experts > 0
         specs = self._array_specs()
         permute = ([False, False]
-                   + [serving_tp_spec(n)[1] for n in names]
+                   + [serving_tp_spec(n, moe=moe)[1] for n in names]
                    + [False, False, False])
         out = []
         for arr, spec, perm in zip(self._arrays, specs, permute):
@@ -123,12 +167,16 @@ class TPServingEngine(ServingEngine):
     # ------------------------------------------------------ mixed step
     def _step_cfg(self):
         """Per-shard decoder config: local head count + the psum axis
-        (engine._step_body emits the row-parallel reductions off it)."""
+        (engine._step_body emits the row-parallel reductions off it);
+        MoE stacks additionally carry the ep axis/size for the
+        slice-dispatch + psum-combine in `_ffn_moe_tokens`."""
         import dataclasses
         cfg = self.model.decoder._cfg()
-        return dataclasses.replace(
-            cfg, num_heads=cfg.num_heads // self.tensor_parallel,
-            mp_axis="mp")
+        rep = dict(num_heads=cfg.num_heads // self.tensor_parallel,
+                   mp_axis="mp")
+        if self.num_experts:
+            rep.update(ep_axis="ep", ep_size=self.expert_parallel)
+        return dataclasses.replace(cfg, **rep)
 
     def _build_step(self):
         from jax.sharding import PartitionSpec as P
@@ -149,7 +197,11 @@ class TPServingEngine(ServingEngine):
         n_data = 6 + (1 if batcher.needs_history(self.sampling) else 0)
         data_in = (rep,) * n_data
         tok_out = (rep, rep) if self.draft_k else rep
+        # MoE stats (counts/dropped/aux) come off replicated routing
+        # inputs, identical on every shard
+        stats_out = ({"counts": rep, "dropped": rep, "aux": rep},) \
+            if self.num_experts else ()
         return _shard_map(
             body, mesh=self.mesh,
             in_specs=(self._array_specs(),) + pools + data_in,
-            out_specs=(tok_out,) + pools, check_vma=False)
+            out_specs=(tok_out,) + pools + stats_out, check_vma=False)

@@ -19,6 +19,15 @@ Host loop per `step()`:
   bookkeeping (TTFT / inter-token metrics, EOS + length termination,
   block release).
 
+MoE decoder stacks (`GPTForGeneration(moe=...)`) serve through the
+same step: per-token top-k routing into FIXED expert-capacity slots
+(`_ffn_moe_tokens` — T is the static token budget, so the [E, C, D]
+dispatch buffers are compile-time shapes and capacity overflow
+degrades to the residual path, never a recompile); per-expert token
+counts / dropped totals / the balance-loss gauge ride the step
+outputs (docs/MOE.md). `serving.distributed.TPServingEngine` adds
+TP x EP sharding over a 2-D (ep, mp) mesh.
+
 With `draft_k > 0` (greedy only) each decode feeds a verify group —
 the last accepted token plus up to draft_k n-gram prompt-lookup
 proposals (`serving.draft`) — through a fixed `[max_slots, draft_k+1]`
@@ -58,10 +67,11 @@ class ServingEngine:
         model.eval()
         self.model = model
         dec = model.decoder
-        if getattr(dec, "_num_experts", 0):
-            raise NotImplementedError(
-                "MoE decoder stacks are not paged yet; serve the dense "
-                "or weight-only FusedMultiTransformer stacks")
+        self.num_experts = int(getattr(dec, "_num_experts", 0))
+        if self.num_experts and getattr(dec, "_ep_size", 1) > 1:
+            raise ValueError(
+                "serve a FULL MoE stack (ep_size=1): the engine shards "
+                "experts itself (TPServingEngine expert_parallel=)")
         L, H, Dh = dec.num_layers, dec.num_heads, dec.head_dim
         maxpos = model.max_position_embeddings
         max_seq_len = min(max_seq_len or maxpos, maxpos)
@@ -125,6 +135,12 @@ class ServingEngine:
         self._preempt_seen = 0
         self._prefix_seen = (0, 0, 0)    # hit / miss / evicted deltas
         self.steps_run = 0
+        # cumulative MoE routing state (host mirrors of the per-step
+        # device stats; the smoke contracts read these directly)
+        self.moe_expert_counts = np.zeros(max(self.num_experts, 1),
+                                          np.float64)
+        self.moe_dropped_total = 0.0
+        self.moe_last_aux = 0.0
 
     # ------------------------------------------------------- mixed step
     def _step_cfg(self):
@@ -142,7 +158,7 @@ class ServingEngine:
         import jax.numpy as jnp
 
         from ..incubate.nn.fused_transformer import (
-            _ffn_dense, _ln, _maybe_psum, _mm, _qkv)
+            _ffn_dense, _ffn_moe_tokens, _ln, _maybe_psum, _mm, _qkv)
         from ..ops.pallas.flash_attention import (
             ragged_paged_attention, verify_paged_attention)
 
@@ -160,6 +176,7 @@ class ServingEngine:
         sc = self.sampling
         quant = self.kv.quantized
         use_hist = batcher.needs_history(sc)
+        moe = cfg.num_experts > 0
 
         def quantize(x):
             """[T, H, Dh] fp -> (int8 values, [T, H] fp32 scales):
@@ -200,10 +217,11 @@ class ServingEngine:
 
             def layer(carry, xs):
                 if quant:
-                    h, kp, vp, ksc, vsc = carry
+                    h, kp, vp, ksc, vsc = carry[:5]
                 else:
-                    h, kp, vp = carry
+                    h, kp, vp = carry[:3]
                     ksc = vsc = None
+                ms = carry[-1] if moe else None
                 pl, li = xs
                 hn = _ln(h, pl["ln_s"], pl["ln_b"], cfg.epsilon)
                 q, k, v = _qkv(cfg, pl, hn[None])
@@ -253,21 +271,46 @@ class ServingEngine:
                 out = out + pl["out_b"].astype(out.dtype)
                 h = h + out
                 hn = _ln(h, pl["ffn_ln_s"], pl["ffn_ln_b"], cfg.epsilon)
-                h = h + _ffn_dense(cfg, pl, hn)
+                if moe:
+                    # per-token top-k routing into fixed capacity slots
+                    # (padding tokens masked out by `valid`); overflow
+                    # rides the residual — shapes never change, so the
+                    # one-compile rule holds with MoE exactly as dense
+                    f, st = _ffn_moe_tokens(cfg, pl, hn, valid)
+                    h = h + f
+                    ms = jax.tree.map(jnp.add, ms, st)
+                else:
+                    h = h + _ffn_dense(cfg, pl, hn)
+                new_carry = (h, kp, vp)
                 if quant:
-                    return (h, kp, vp, ksc, vsc), None
-                return (h, kp, vp), None
+                    new_carry += (ksc, vsc)
+                if moe:
+                    new_carry += (ms,)
+                return new_carry, None
 
+            carry0 = (x, k_pool, v_pool)
             if quant:
-                (x, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
-                    layer, (x, k_pool, v_pool, k_scale, v_scale),
-                    (params, jnp.arange(L)))
+                carry0 += (k_scale, v_scale)
+            if moe:
+                carry0 += ({"counts": jnp.zeros((cfg.num_experts,),
+                                                jnp.float32),
+                            "dropped": jnp.zeros((), jnp.float32),
+                            "aux": jnp.zeros((), jnp.float32)},)
+            carry, _ = jax.lax.scan(layer, carry0,
+                                    (params, jnp.arange(L)))
+            moe_stats = carry[-1] if moe else None
+            if moe:
+                # aux reported as the per-layer mean balance loss
+                moe_stats = dict(moe_stats,
+                                 aux=moe_stats["aux"] / float(L))
+            if quant:
+                x, k_pool, v_pool, k_scale, v_scale = carry[:5]
                 pools = (k_pool, v_pool, k_scale, v_scale)
             else:
-                (x, k_pool, v_pool), _ = jax.lax.scan(
-                    layer, (x, k_pool, v_pool),
-                    (params, jnp.arange(L)))
+                x, k_pool, v_pool = carry[:3]
                 pools = (k_pool, v_pool)
+            if moe:
+                pools += (moe_stats,)
             xf = _ln(x, lnw, lnb, cfg.epsilon)
             sidx = jnp.clip(sample_index, 0, T - 1)
             h_last = xf[sidx]                          # [max_slots, D]
@@ -331,6 +374,26 @@ class ServingEngine:
                 hist[slot, :len(toks)] = toks
         return hist
 
+    def moe_utilization_entropy(self):
+        """Normalized entropy of the cumulative per-expert token
+        distribution (1.0 = balanced; 0.0 = degenerate/no MoE)."""
+        return _pmetrics.moe_utilization_entropy(self.moe_expert_counts)
+
+    def _note_moe_stats(self, moe_stats):
+        """Fold one step's device-side routing stats into the host
+        mirrors + metrics (per-expert token counters, dropped-token
+        counter, aux-loss gauge, utilization-entropy gauge)."""
+        st = {k: np.asarray(v) for k, v in moe_stats.items()}
+        counts = st["counts"].astype(np.float64)
+        dropped = float(st["dropped"])
+        self.moe_expert_counts += counts
+        self.moe_dropped_total += dropped
+        self.moe_last_aux = float(st["aux"])
+        if _pmetrics._enabled:
+            _pmetrics.record_moe_stats(
+                "serving", counts, dropped, self.moe_last_aux,
+                utilization=self.moe_utilization_entropy())
+
     # -------------------------------------------------------------- run
     def step(self):
         """One engine iteration. Returns True when any work (tokens or
@@ -359,6 +422,9 @@ class ServingEngine:
             args.append(jnp.asarray(self._penalty_history()))
         args.append(sub)
         res = self._step_fn(*args)
+        moe_stats = None
+        if self.num_experts:
+            res, moe_stats = res[:-1], res[-1]
         if self.kv.quantized:
             (out, self.kv.k_pool, self.kv.v_pool, self.kv.k_scale,
              self.kv.v_scale) = res
@@ -430,6 +496,8 @@ class ServingEngine:
                 req = sch.slots[slot]
                 if req is not None:
                     emit(req, [int(tok_np[slot])])
+        if moe_stats is not None:
+            self._note_moe_stats(moe_stats)
         if _pmetrics._enabled:
             smetrics.SERVING_STEPS.inc()
             smetrics.SERVING_TOKENS.labels("prefill").inc(

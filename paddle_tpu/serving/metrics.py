@@ -9,7 +9,13 @@ observability off pays one branch per step.
 """
 from __future__ import annotations
 
-from ..profiler.metrics import REGISTRY, exponential_buckets
+from ..profiler.metrics import (REGISTRY, exponential_buckets,
+                                MOE_AUX_LOSS, MOE_DROPPED_TOKENS,
+                                MOE_EXPERT_TOKENS,
+                                MOE_EXPERT_UTILIZATION)  # noqa: F401
+# (the MoE routing metrics live in profiler.metrics because the hybrid
+# trainer records them too — re-exported here so the serving contract
+# below registers them by import, like every other serving metric)
 
 # 100us .. ~100s in x4 steps: TTFT on a loaded queue can sit behind
 # whole prefill rounds, far above the dispatch-scale default buckets
@@ -127,6 +133,12 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_router_failovers_total",
     "paddle_tpu_serving_router_replica_queue_depth",
     "paddle_tpu_serving_router_replicas_up",
+    # MoE serving (ISSUE 10): per-expert routing volume, capacity
+    # drops, cumulative utilization entropy, latest balance loss
+    "paddle_tpu_moe_expert_tokens_total",
+    "paddle_tpu_moe_dropped_tokens_total",
+    "paddle_tpu_moe_expert_utilization",
+    "paddle_tpu_moe_aux_loss",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

@@ -188,3 +188,103 @@ def test_calibration_from_mfu_key_and_bandwidth():
                            "collective_seconds": 0.02})
     assert 0.5 < cal.mxu_efficiency < 0.7
     assert cal.ici_bw == pytest.approx(5e10)
+
+
+# ------------------------------------------------------- MoE / ep axis
+
+
+def _moe_350m(batch=32, experts=8):
+    return ModelSpec(n_layers=24, d_model=1024, seq_len=1024,
+                     vocab_size=50304, global_batch=batch, n_heads=16,
+                     moe_experts=experts, moe_top_k=2,
+                     moe_capacity_factor=1.25)
+
+
+def test_moe_param_accounting():
+    """n_params counts every expert; active_params only top_k of them
+    (the MFU numerator); expert_param_elems is the ep-shardable part."""
+    dense = _gpt_350m()
+    moe = _moe_350m(experts=8)
+    assert moe.expert_param_elems == \
+        2 * 1024 * 4096 * 8 * 24
+    assert moe.n_params > dense.n_params
+    assert moe.active_params < moe.n_params
+    # top_k=2 activates exactly 2 experts' worth of FFN per token
+    d, ff, L = 1024, 4096, 24
+    assert moe.active_params - (dense.n_params - 2 * d * ff * L) == \
+        2 * 2 * d * ff * L + d * 8 * L
+    assert dense.expert_param_elems == 0
+
+
+def test_moe_ep_shards_memory_and_prices_alltoall():
+    """ep=2 halves the expert-parameter footprint and adds a nonzero
+    all_to_all term that grows with capacity_factor."""
+    m = _moe_350m(experts=8)
+    cm = CostModel(ClusterSpec(n_devices=8))
+    mem1 = cm.memory_per_device(m, Strategy(dp=2, ep=1))
+    mem2 = cm.memory_per_device(m, Strategy(dp=1, ep=2))
+    assert mem2 < mem1
+    c1 = cm.comm_time(m, Strategy(dp=1, ep=2))
+    assert c1 > 0.0
+    hungry = _moe_350m(experts=8)
+    hungry.moe_capacity_factor = 4.0
+    assert cm.comm_time(hungry, Strategy(dp=1, ep=2)) > c1
+
+
+def test_moe_infeasible_ep_never_chosen():
+    """num_experts % ep != 0 strands fractional experts: with E=3 on
+    an 8-device pool no power-of-two ep divides E, so the search must
+    keep ep=1 everywhere."""
+    m = _moe_350m(experts=3)
+    ranked = StrategyTuner(ClusterSpec(n_devices=8)).search(
+        m, top_k=8, zero_stages=(0, 1))
+    assert ranked, "no feasible MoE strategy found"
+    assert all(s.ep == 1 for s in ranked)
+
+
+def test_moe_tune_places_expert_parallel_when_memory_bound():
+    """A big-expert MoE that cannot replicate its experts on one chip
+    must come back with ep > 1 when ep is the only axis that can
+    shard them (n_heads=1 blocks mp, n_layers=1 blocks pp, zero off
+    keeps dp from sharding state)."""
+    m = ModelSpec(n_layers=1, d_model=1024, seq_len=1024,
+                  vocab_size=50304, global_batch=32, n_heads=1,
+                  moe_experts=128, moe_top_k=2)
+    cm = CostModel(ClusterSpec(n_devices=8))
+    # replicated experts (~1.07B elems x 18 B) blow the 16GB budget
+    assert cm.memory_per_device(m, Strategy(dp=8)) > 16e9
+    res = tune(m, cluster=ClusterSpec(n_devices=8), zero_stages=(0,))
+    assert res.strategy.ep > 1, res.strategy
+    assert m.moe_experts % res.strategy.ep == 0
+    assert res.strategy.mp == 1 and res.strategy.pp == 1
+    assert res.strategy.degree() <= 8
+    assert res.strategy.as_hybrid_configs()["ep_degree"] == \
+        res.strategy.ep
+
+
+def test_moe_auto_strategy_trains():
+    """HybridGPT(strategy="auto") on a MoE config executes the tuner's
+    pick end to end (ep mapped onto the mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.parallel.hybrid_gpt import GPTConfig, HybridGPT
+    cfg = GPTConfig(vocab_size=64, seq_len=16, d_model=32, n_heads=4,
+                    n_layers=4, d_ff=64, remat=False,
+                    moe_num_experts=4, moe_top_k=2,
+                    compute_dtype=jnp.float32)
+    # a 2-device pool keeps the executed mesh (and its compile) small
+    tr = HybridGPT(cfg, strategy="auto", global_batch=8,
+                   devices=jax.devices()[:2],
+                   cluster=ClusterSpec(n_devices=2))
+    assert tr.cfg.moe_experts == 4
+    assert tr.cfg.dp * tr.cfg.mp * tr.cfg.pp * tr.cfg.ep <= \
+        len(jax.devices())
+    p, o = tr.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tok, lab = tr.shard_data(
+        rng.randint(0, 64, (8, 16)).astype(np.int32),
+        rng.randint(0, 64, (8, 16)).astype(np.int32))
+    p, o, loss = tr.train_step(p, o, tok, lab)
+    assert np.isfinite(float(loss))

@@ -139,12 +139,15 @@ def test_moe_dispatch_no_dropped_tokens():
                      n_layers=4, d_ff=ff, moe_experts=E,
                      moe_capacity_factor=4.0,
                      compute_dtype=jnp.float32)
-    out, aux = _moe_ffn(x, gate_w, w1, b1, w2, b2, cfg2)
+    out, stats = _moe_ffn(x, gate_w, w1, b1, w2, b2, cfg2)
     # every token must have received an expert output (bias=1 guarantees
     # nonzero if dispatched)
     norms = np.asarray(jnp.linalg.norm(out.reshape(B * S, d), axis=-1))
     assert (norms > 1e-6).all(), f"dropped tokens: {np.where(norms < 1e-6)}"
-    assert np.isfinite(float(aux))
+    assert np.isfinite(float(stats["balance"]))
+    assert float(stats["dropped"]) == 0.0
+    assert float(np.asarray(stats["counts"]).sum()) \
+        == B * S * cfg2.moe_top_k
 
 
 def test_ce_seq_chunks_parity():

@@ -527,6 +527,58 @@ class TestLogitProcessors:
         assert pen != base
         assert repeats(pen) < repeats(base)
 
+    def test_frequency_penalty_count_scaled_unit(self):
+        """Frequency (ISSUE 10 satellite) is COUNT-scaled: a token
+        seen n times in the window loses n * penalty — unlike the
+        one-shot presence subtraction it sits next to."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving.batcher import (apply_logit_penalties,
+                                                needs_history)
+        rng = np.random.RandomState(1)
+        B, V, W = 2, 11, 6
+        logits = rng.randn(B, V).astype(np.float32)
+        hist = np.full((B, W), -1, np.int32)
+        hist[0, :4] = [2, 5, 2, 2]       # token 2 three times
+        hist[1, :1] = [0]
+        sc = SamplingConfig(frequency_penalty=0.7)
+        assert needs_history(sc)
+        assert not needs_history(SamplingConfig())
+        got = np.asarray(apply_logit_penalties(
+            jnp.asarray(logits), jnp.asarray(hist), sc))
+        ref = logits.copy()
+        ref[0, 2] -= 3 * 0.7
+        ref[0, 5] -= 1 * 0.7
+        ref[1, 0] -= 1 * 0.7
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_frequency_penalty_engine_discourages_repeats(self):
+        """Engine-level frequency penalty: fewer repeated tokens than
+        the unpenalized run, and (same engines) still exactly ONE
+        mixed-step compile each — the history tensor keeps the
+        compiled shapes fixed."""
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            m = self._model()
+            prompts = self._prompts()
+            c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+            base = self._engine(m, seed=0).generate_batch(
+                prompts, max_new_tokens=10)
+            pen = self._engine(m, seed=0, sampling=SamplingConfig(
+                frequency_penalty=8.0)).generate_batch(
+                prompts, max_new_tokens=10)
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0 == 2
+
+            def repeats(outs):
+                return sum(len(o) - len(set(o)) for o in outs)
+
+            assert pen != base
+            assert repeats(pen) < repeats(base)
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
     def test_presence_penalty_changes_outputs(self):
         m = self._model()
         prompts = self._prompts()
