@@ -910,6 +910,153 @@ def bench_gpt_moe(on_tpu):
     }
 
 
+def bench_kernel_autotune(on_tpu):
+    """ISSUE 11 extra: the measurement-driven Pallas kernel autotuner.
+
+    Three records, every platform:
+      * paged decode tuned-vs-default tok/s — the engine-level KV
+        block-size search (`tune_block_size`, parity-gated candidates
+        sharing the dispatch gate's alignment predicate) and the same
+        decode-heavy stream served at the default 16 vs the winner;
+      * MoE dispatch einsum-vs-indexed tok/s — the one-hot [T,k,C]
+        dispatch/combine einsums against the index-table gather pair
+        the grouped-expert kernel rides (backend-independent; on TPU
+        the grouped Pallas matmul adds MXU tiling on top —
+        docs/KERNELS.md carries the expected-effect analysis);
+      * cache contract — search seconds, cache-hit ratio for this
+        process, and the hit-is-zero-cost assertion (1k lookups, no
+        searcher invocation).
+    Searches persist into a throwaway cache so a bench run never
+    mutates the operator's tuned cache."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.ops.pallas import autotune
+    from paddle_tpu.ops.pallas import paged_attention as pa_mod
+    from paddle_tpu.parallel import moe_utils
+    from paddle_tpu.serving.engine import ServingEngine
+
+    tmp = tempfile.NamedTemporaryFile(suffix=".json", delete=False)
+    tmp.close()
+    old_cache = os.environ.get("PADDLE_TPU_KERNEL_CACHE")
+    os.environ["PADDLE_TPU_KERNEL_CACHE"] = tmp.name
+    autotune.reset_for_tests()
+    try:
+        import paddle_tpu as paddle
+        paddle.seed(1234)
+        m = GPTForGeneration(vocab_size=512, hidden_size=64,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=256,
+                             compute_dtype="float32")
+        m.eval()
+        H, Dh = 4, 16
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 512, int(n)).astype(np.int32)
+                   for n in rng.randint(16, 48, 16)]
+
+        def serve_tok_s(block_size):
+            eng = ServingEngine(m, max_slots=8, block_size=block_size,
+                                max_seq_len=128,
+                                cache_dtype="float32", seed=0)
+            eng.generate_batch([prompts[0]], max_new_tokens=2)
+            t0 = time.perf_counter()
+            outs = eng.generate_batch(prompts, max_new_tokens=24)
+            dt = time.perf_counter() - t0
+            return sum(len(o) for o in outs) / dt, outs
+
+        default_tok_s, ref_outs = serve_tok_s(16)
+        res = pa_mod.tune_block_size(8, H, Dh, context_len=64,
+                                     budget_s=20.0)
+        tuned_bs = int(res.config["block_size"])
+        tuned_tok_s, tuned_outs = serve_tok_s(tuned_bs)
+        assert tuned_outs == ref_outs    # block size never changes tokens
+
+        # MoE dispatch representation: one-hot einsums vs index tables
+        T, E, k, d = 256, 8, 2, 128
+        C = moe_utils.expert_capacity(T, E, k, 1.25)
+        logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+        x = jnp.asarray(rng.randn(T, d).astype(np.float32))
+        r = moe_utils.top_k_routing(logits, k, C)
+
+        @jax.jit
+        def einsum_pair(x):
+            disp = moe_utils.dispatch_tokens(x, r.plan)
+            return moe_utils.combine_tokens(disp, r.plan)
+
+        @jax.jit
+        def indexed_pair(x):
+            disp = moe_utils.dispatch_tokens_indexed(x, r.plan, E, C)
+            return moe_utils.combine_tokens_indexed(disp, r.plan)
+
+        def rate(fn):
+            fn(x).block_until_ready()
+            iters = 30
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x)
+            out.block_until_ready()
+            return T * iters / (time.perf_counter() - t0)
+
+        einsum_tok_s = rate(einsum_pair)
+        indexed_tok_s = rate(indexed_pair)
+
+        # cache contract: the tuned winner is a hit, hits cost nothing
+        bucket = autotune.shape_bucket(8, H, Dh)
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            cfg = autotune.ensure(
+                "paged_block_size", bucket, np.float32, default=None,
+                searcher=lambda: (_ for _ in ()).throw(
+                    AssertionError("searched on a cache hit")))
+        lookup_ms = (time.perf_counter() - t0) * 1e3
+        assert cfg == res.config
+        req = autotune.requested()
+        hit_ratio = (sum(req.values()) / len(req)) if req else 0.0
+
+        return {
+            "metric": "kernel_autotune",
+            "value": round(tuned_tok_s, 1), "unit": "tokens/sec",
+            "paged_decode": {
+                "default_block_size": 16,
+                "tuned_block_size": tuned_bs,
+                "default_tokens_per_sec": round(default_tok_s, 1),
+                "tuned_tokens_per_sec": round(tuned_tok_s, 1),
+                "outputs_identical": True,
+                "search_seconds": round(res.elapsed, 2),
+                "candidates_tried": res.tried,
+                "candidates_rejected_parity": res.rejected,
+            },
+            "moe_dispatch": {
+                "einsum_tokens_per_sec": round(einsum_tok_s, 1),
+                "indexed_tokens_per_sec": round(indexed_tok_s, 1),
+                "speedup": round(indexed_tok_s / einsum_tok_s, 2),
+                "shape": {"T": T, "E": E, "top_k": k, "d": d, "C": C},
+            },
+            "cache_hit_ratio": round(hit_ratio, 3),
+            "cache_lookup_ms_per_1k": round(lookup_ms, 2),
+            "cache_hit_zero_cost": lookup_ms < 200.0,
+            "backend": autotune.backend_key(),
+            "note": (None if on_tpu else
+                     "CPU: search machinery + cache contract; the "
+                     "Pallas tile wins are TPU-only by design "
+                     "(docs/KERNELS.md expected-effect analysis)"),
+        }
+    finally:
+        autotune.reset_for_tests()
+        if old_cache is None:
+            os.environ.pop("PADDLE_TPU_KERNEL_CACHE", None)
+        else:
+            os.environ["PADDLE_TPU_KERNEL_CACHE"] = old_cache
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
+
+
 def _metrics_extra():
     """Condensed observability snapshot for the benchmark JSON `extras`
     (only when PADDLE_TPU_METRICS is set — instrumentation off keeps the
@@ -1029,6 +1176,20 @@ def main():
     else:
         result["extras"].append(
             {"metric": "gpt_moe", "skipped": "time budget"})
+
+    # kernel-autotune extra (ISSUE 11): every-platform — block-size
+    # search + tuned-vs-default decode tok/s, MoE dispatch
+    # representation A/B, cache-hit-zero-cost contract
+    if _budget_left() > 60:
+        try:
+            result["extras"].append(bench_kernel_autotune(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            result["extras"].append(
+                {"metric": "kernel_autotune",
+                 "error": f"{type(e).__name__}: {e}"})
+    else:
+        result["extras"].append(
+            {"metric": "kernel_autotune", "skipped": "time budget"})
 
     # embedding-engine extra: every-platform (localhost PS servers +
     # CPU dense step) with the >= 1.3x-vs-direct driver contract

@@ -131,15 +131,45 @@ def _ffn_dense(cfg, pl, h):
     return f + pl["ffn2_b"].astype(f.dtype)
 
 
-def _expert_ffn(cfg, pl, expert_in):
-    """Stacked expert FFN on [E_loc, C', D] capacity buffers (weight-
-    only dequant fused into the einsums when scales are present)."""
+def _grouped_path_enabled(cfg, pl):
+    """True when the expert FFN matmuls run the Pallas grouped-expert
+    kernel (ops.pallas.grouped_matmul) instead of the one-hot einsum
+    oracle — TPU backend (or kernel-test interpret mode) with
+    MXU-alignable feature axes; `PADDLE_TPU_GROUPED_MATMUL=0` or a
+    CPU backend keeps the reference path. Static at trace time."""
+    from ...ops.pallas import grouped_matmul as _gmm
+    d_in = pl["ffn1_w"].shape[-2]
+    d_ff = pl["ffn1_w"].shape[-1]
+    return _gmm.grouped_matmul_enabled(d_in, d_ff)
+
+
+def _expert_matmuls(cfg, pl, expert_in):
+    """The two stacked expert contractions ([E_loc, C', D] capacity
+    buffers -> expert outputs) with weight-only dequant fused in —
+    grouped Pallas kernel when enabled, einsum oracle otherwise."""
     cd = expert_in.dtype
+    if _grouped_path_enabled(cfg, pl):
+        from ...ops.pallas.grouped_matmul import grouped_expert_matmul
+        qmax = float(2 ** (cfg.quant_bits - 1) - 1)
+        f = grouped_expert_matmul(expert_in, pl["ffn1_w"],
+                                  pl.get("ffn1_s"), qmax=qmax,
+                                  out_dtype=cd)
+        f = _act(cfg, f + pl["ffn1_b"][:, None, :].astype(cd))
+        return grouped_expert_matmul(f, pl["ffn2_w"],
+                                     pl.get("ffn2_s"), qmax=qmax,
+                                     out_dtype=cd)
     f = jnp.einsum("ecd,edf->ecf", expert_in,
                    _deq(cfg, pl["ffn1_w"], pl.get("ffn1_s"), cd))
     f = _act(cfg, f + pl["ffn1_b"][:, None, :].astype(cd))
-    eout = jnp.einsum("ecf,efd->ecd", f,
+    return jnp.einsum("ecf,efd->ecd", f,
                       _deq(cfg, pl["ffn2_w"], pl.get("ffn2_s"), cd))
+
+
+def _expert_ffn(cfg, pl, expert_in):
+    """Stacked expert FFN on [E_loc, C', D] capacity buffers (weight-
+    only dequant fused into the matmuls when scales are present)."""
+    cd = expert_in.dtype
+    eout = _expert_matmuls(cfg, pl, expert_in)
     return eout + pl["ffn2_b"][:, None, :].astype(cd)
 
 
@@ -164,9 +194,14 @@ def _ffn_moe(cfg, pl, h):
                                   cfg.capacity_factor)
     axes = (cfg.ep_axis,) if (cfg.ep_axis is not None
                               and cfg.ep_size > 1) else None
+    grouped = _grouped_path_enabled(cfg, pl)
     r = moe_utils.top_k_routing(logits, cfg.moe_topk, C, axes=axes,
-                                dtype=cd)
-    dispatched = moe_utils.dispatch_tokens(xt.astype(cd), r.plan)
+                                dtype=cd, build_masks=not grouped)
+    if grouped:
+        dispatched = moe_utils.dispatch_tokens_indexed(
+            xt.astype(cd), r.plan, E, C)
+    else:
+        dispatched = moe_utils.dispatch_tokens(xt.astype(cd), r.plan)
     if axes:
         expert_in = moe_utils.all_to_all_dispatch(dispatched,
                                                   cfg.ep_axis,
@@ -177,7 +212,10 @@ def _ffn_moe(cfg, pl, h):
     if axes:
         eout = moe_utils.all_to_all_combine(eout, cfg.ep_axis,
                                             cfg.ep_size)
-    out = moe_utils.combine_tokens(eout, r.plan)
+    if grouped:
+        out = moe_utils.combine_tokens_indexed(eout, r.plan)
+    else:
+        out = moe_utils.combine_tokens(eout, r.plan)
     return out.reshape(B, S, D), r.balance_loss
 
 
@@ -211,30 +249,52 @@ def _ffn_moe_tokens(cfg, pl, h, valid):
                         pl["gate_w"].astype(jnp.float32))
     C = moe_utils.expert_capacity(T, E, cfg.moe_topk,
                                   cfg.capacity_factor)
+    grouped = _grouped_path_enabled(cfg, pl)
     r = moe_utils.top_k_routing(logits, cfg.moe_topk, C, valid=valid,
-                                dtype=cd)
+                                dtype=cd, build_masks=not grouped)
     ep = cfg.ep_size if cfg.ep_axis is not None else 1
-    if ep > 1:
-        # slice this shard's resident experts out of the one-hot FIRST
-        # and dispatch only their [E/ep, C, D] buffers — dispatching
-        # all E and slicing after would spend ep-times the einsum work
-        E_loc = E // ep
-        rank = jax.lax.axis_index(cfg.ep_axis)
-        e_oh_loc = jax.lax.dynamic_slice_in_dim(
-            r.plan.e_oh, rank * E_loc, E_loc, axis=2)
+    E_loc = E // ep
+    rank = jax.lax.axis_index(cfg.ep_axis) if ep > 1 else 0
+    if grouped:
+        # index-based dispatch (ISSUE 11): the capacity assignment is
+        # ONE [E, C] token-index table + a gather — no [T, k, C] /
+        # [T, k, E] one-hot is ever materialized — and the expert
+        # matmuls run the grouped Pallas kernel on the dense [E_loc,
+        # C, D] buffers. Under ep the shard slices its resident
+        # experts' index rows before gathering, exactly like the
+        # e_oh slice on the einsum path.
+        tos = moe_utils.dispatch_indices(r.plan, E, C)
+        if ep > 1:
+            tos = jax.lax.dynamic_slice_in_dim(tos, rank * E_loc,
+                                               E_loc, axis=0)
+        local_in = moe_utils.dispatch_tokens_indexed(
+            h, r.plan, E_loc, C, indices=tos)
+        eout = _expert_matmuls(cfg, pl, local_in)
+        eout = _maybe_psum(cfg, eout)
+        eout = eout + pl["ffn2_b"][:, None, :].astype(cd)
+        out = moe_utils.combine_tokens_indexed(
+            eout, r.plan, e_offset=rank * E_loc, num_local=E_loc)
     else:
-        e_oh_loc = r.plan.e_oh
-    local_in = moe_utils.dispatch_tokens(h, r.plan, e_oh=e_oh_loc)
-    f = jnp.einsum("ecd,edf->ecf", local_in,
-                   _deq(cfg, pl["ffn1_w"], pl.get("ffn1_s"), cd))
-    f = _act(cfg, f + pl["ffn1_b"][:, None, :].astype(cd))
-    eout = jnp.einsum("ecf,efd->ecd", f,
-                      _deq(cfg, pl["ffn2_w"], pl.get("ffn2_s"), cd))
-    # row-parallel over mp (each shard holds an F/tp slice), bias once
-    # after the reduction
-    eout = _maybe_psum(cfg, eout)
-    eout = eout + pl["ffn2_b"][:, None, :].astype(cd)
-    out = jnp.einsum("tkc,tke,ecd->td", r.plan.comb, e_oh_loc, eout)
+        if ep > 1:
+            # slice this shard's resident experts out of the one-hot
+            # FIRST and dispatch only their [E/ep, C, D] buffers —
+            # dispatching all E and slicing after would spend ep-times
+            # the einsum work
+            e_oh_loc = jax.lax.dynamic_slice_in_dim(
+                r.plan.e_oh, rank * E_loc, E_loc, axis=2)
+        else:
+            e_oh_loc = r.plan.e_oh
+        local_in = moe_utils.dispatch_tokens(h, r.plan, e_oh=e_oh_loc)
+        f = jnp.einsum("ecd,edf->ecf", local_in,
+                       _deq(cfg, pl["ffn1_w"], pl.get("ffn1_s"), cd))
+        f = _act(cfg, f + pl["ffn1_b"][:, None, :].astype(cd))
+        eout = jnp.einsum("ecf,efd->ecd", f,
+                          _deq(cfg, pl["ffn2_w"], pl.get("ffn2_s"), cd))
+        # row-parallel over mp (each shard holds an F/tp slice), bias
+        # once after the reduction
+        eout = _maybe_psum(cfg, eout)
+        eout = eout + pl["ffn2_b"][:, None, :].astype(cd)
+        out = jnp.einsum("tkc,tke,ecd->td", r.plan.comb, e_oh_loc, eout)
     if ep > 1:
         out = jax.lax.psum(out, cfg.ep_axis)
     stats = {"counts": r.plan.counts, "dropped": r.plan.dropped,

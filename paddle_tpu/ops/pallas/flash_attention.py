@@ -98,7 +98,8 @@ def splash_supported(seq_len: int, head_dim: int) -> bool:
 
 def _splash_kernel(n_heads: int, seq_len: int, causal: bool,
                    segmented: bool = False,
-                   residual_ckpt: str | None = None):
+                   residual_ckpt: str | None = None,
+                   dtype: str = "float32", head_dim: int = 128):
     """Build (and cache) a vmapped splash kernel for [B, H, S, D] inputs.
 
     Block sizes: the largest power-of-two tile <= 1024 dividing S, with
@@ -114,17 +115,28 @@ def _splash_kernel(n_heads: int, seq_len: int, causal: bool,
     block = next(b for b in (1024, 512, 256, 128) if seq_len % b == 0)
     # experiment override: "bq,bkv,bkvc,bqd,bkvd,bkvdc"
     env = os.environ.get("PADDLE_TPU_SPLASH_BLOCKS", "")
+    # r5 in-model sweep at [32,16,1024,64] (tools/gpt_microbench.py):
+    # fwd q-block 512 with full kv tiles but kv_compute 512, bwd
+    # dq-block 512 / full kv — 836.5 vs 853.6 ms/step for the old
+    # uniform-1024 fwd config; uniform 512 and q=256 were worse.
+    # The autotuner ("splash" kernel space) supersedes the hand sweep
+    # when a cached winner exists for the bucket; the env override
+    # stays the top-priority experiment knob.
+    bq = min(512, block)
+    sizes = [bq, block, bq, bq, block, block]
+    from . import autotune as _autotune
+    _tuned = _autotune.kernel_config(
+        "splash", _autotune.shape_bucket(seq_len, block, head_dim),
+        dtype, default=None)
+    if _tuned:
+        sizes = [min(int(_tuned.get(k, s)), block) for k, s in zip(
+            ("block_q", "block_kv", "block_kv_compute", "block_q_dkv",
+             "block_kv_dkv", "block_kv_dkv_compute"), sizes)]
     key = (n_heads, seq_len, causal, block, segmented, residual_ckpt,
-           env, _INTERPRET)
+           env, tuple(sizes), _INTERPRET)
     if key not in _SPLASH_CACHE:
         from jax.experimental.pallas.ops.tpu.splash_attention import (
             splash_attention_kernel as sk, splash_attention_mask as smask)
-        # r5 in-model sweep at [32,16,1024,64] (tools/gpt_microbench.py):
-        # fwd q-block 512 with full kv tiles but kv_compute 512, bwd
-        # dq-block 512 / full kv — 836.5 vs 853.6 ms/step for the old
-        # uniform-1024 fwd config; uniform 512 and q=256 were worse
-        bq = min(512, block)
-        sizes = [bq, block, bq, bq, block, block]
         if env:
             parts = env.split(",")
             if len(parts) != 6:
@@ -200,9 +212,11 @@ def splash_mha(q, k, v, *, causal=True, scale=None, kv_keep=None,
                     import splash_attention_kernel as sk
                 seg = kv_keep.astype(jnp.int32)
                 kern = _splash_kernel(h, s, causal, segmented=True,
-                                      residual_ckpt=rc)
+                                      residual_ckpt=rc,
+                                      dtype=str(q.dtype), head_dim=d)
                 return kern(qs, k, v, sk.SegmentIds(q=seg, kv=seg))
-            kern = _splash_kernel(h, s, causal, residual_ckpt=rc)
+            kern = _splash_kernel(h, s, causal, residual_ckpt=rc,
+                                  dtype=str(q.dtype), head_dim=d)
             return kern(qs, k, v)
         except NotImplementedError:
             # the installed kernel refused the shape at trace time
@@ -280,6 +294,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=_INTERPRET,
     )(q, k, v)
 
 
@@ -319,13 +334,34 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=None, block_k=None):
     """q/k/v: [B, S, H, D] (paddle layout). bias unsupported -> caller
-    falls back to the XLA path."""
+    falls back to the XLA path.
+
+    `block_q`/`block_k` default to the autotuner's cached winner for
+    this (S, D) shape bucket (`ops.pallas.autotune`, kernel
+    ``flash_fwd``) and to the hand-picked 256/256 on a cache miss or
+    with the kill-switch set; explicit arguments always win."""
     if bias is not None:
         raise NotImplementedError("flash_attention kernel: bias "
                                   "unsupported; use the XLA path")
     b, s, h, d = q.shape
+    if block_q is None or block_k is None:
+        from . import autotune
+        tuned = autotune.kernel_config(
+            "flash_fwd", autotune.shape_bucket(s, d), q.dtype,
+            default=None) or {}
+
+        def usable(v):
+            # the pow2 bucket may cover sequences its winner doesn't
+            # divide (S=768 in the 1024 bucket, winner 512): such a
+            # tile would demote the shape to the XLA fallback, so the
+            # hand default — which the pre-tuner path served — wins
+            return v is not None and s % min(int(v), s) == 0
+
+        tq, tk = tuned.get("block_q"), tuned.get("block_k")
+        block_q = block_q or (tq if usable(tq) else DEFAULT_BLOCK_Q)
+        block_k = block_k or (tk if usable(tk) else DEFAULT_BLOCK_K)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q != 0 or s % block_k != 0 or d % 128 != 0:
@@ -344,6 +380,120 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
                       bool(causal), block_q, block_k)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+
+
+def tune_flash(seq_len, head_dim, *, batch_heads=4, causal=True,
+               dtype="float32", seed=0, budget_s=None, timer=None,
+               persist=True):
+    """Search the (block_q, block_k) space of the hand flash-forward
+    kernel against the XLA softmax reference; the winner lands in the
+    persistent cache so `flash_attention`'s next call resolves it for
+    free (interpret mode off-TPU)."""
+    import numpy as np
+
+    from . import autotune
+
+    global _INTERPRET
+    dtype = np.dtype(dtype)
+    rng = np.random.RandomState(seed)
+    shape = (batch_heads, seq_len, head_dim)
+    q = jnp.asarray(rng.randn(*shape).astype(dtype))
+    k = jnp.asarray(rng.randn(*shape).astype(dtype))
+    v = jnp.asarray(rng.randn(*shape).astype(dtype))
+    scale = 1.0 / math.sqrt(head_dim)
+
+    def oracle(q, k, v):
+        return _xla_reference(q, k, v, scale, causal)
+
+    def build(cfg):
+        bq, bk = int(cfg["block_q"]), int(cfg["block_k"])
+        if seq_len % bq or seq_len % bk:
+            return None
+
+        def run(q, k, v):
+            return _flash_fwd(q, k, v, scale, causal, bq, bk)
+        return run
+
+    was = _INTERPRET
+    if not _on_tpu_backend() or _INTERPRET:
+        _INTERPRET = True
+    try:
+        return autotune.search(
+            "flash_fwd", autotune.shape_bucket(seq_len, head_dim),
+            dtype, autotune.flash_candidates(seq_len, head_dim), build,
+            (q, k, v), oracle, rtol=2e-2, atol=2e-2,
+            budget_s=budget_s, timer=timer, persist=persist,
+            meta={"causal": bool(causal), "seed": seed})
+    finally:
+        _INTERPRET = was
+
+
+def tune_splash(seq_len, *, n_heads=2, batch=1, head_dim=128,
+                causal=True, dtype="float32", seed=0, budget_s=None,
+                timer=None, persist=True):
+    """Search the six splash block sizes (fwd q/kv/kv_compute +
+    fused-bwd dq/kv/kv_compute) against the XLA attention oracle.
+    Candidates run the REAL library kernel — value AND input grads,
+    so the backward block sizes are exercised too — in interpret mode
+    off-TPU; the winner lands in the cache `_splash_kernel` resolves
+    at build time."""
+    import numpy as np
+
+    from . import autotune
+
+    dtype = np.dtype(dtype)
+    block = next(b for b in (1024, 512, 256, 128)
+                 if seq_len % b == 0)
+    rng = np.random.RandomState(seed)
+    shape = (batch, n_heads, seq_len, head_dim)
+    q = jnp.asarray(rng.randn(*shape).astype(dtype))
+    k = jnp.asarray(rng.randn(*shape).astype(dtype))
+    v = jnp.asarray(rng.randn(*shape).astype(dtype))
+    scale = 1.0 / math.sqrt(head_dim)
+
+    def oracle(q, k, v):
+        def f(q, k, v):
+            out = jax.nn.dot_product_attention(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), scale=scale, is_causal=causal)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        loss, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return (loss,) + grads
+
+    interp = not _on_tpu_backend() or _INTERPRET
+
+    def build(cfg):
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+            splash_attention_mask as smask)
+        bs = sk.BlockSizes(
+            block_q=cfg["block_q"], block_kv=cfg["block_kv"],
+            block_kv_compute=cfg["block_kv_compute"],
+            block_q_dkv=cfg["block_q_dkv"],
+            block_kv_dkv=cfg["block_kv_dkv"],
+            block_kv_dkv_compute=cfg["block_kv_dkv_compute"],
+            use_fused_bwd_kernel=True)
+        m = (smask.CausalMask((seq_len, seq_len)) if causal
+             else smask.FullMask((seq_len, seq_len)))
+        mask = smask.MultiHeadMask([m] * n_heads)
+        kern = jax.vmap(sk.make_splash_mha(
+            mask, head_shards=1, q_seq_shards=1, block_sizes=bs,
+            interpret=interp))
+
+        def run(q, k, v):
+            def f(q, k, v):
+                out = kern((q * scale).astype(q.dtype), k, v)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+            loss, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(
+                q, k, v)
+            return (loss,) + grads
+        return run
+
+    return autotune.search(
+        "splash", autotune.shape_bucket(seq_len, block, head_dim),
+        dtype, autotune.splash_candidates(seq_len), build, (q, k, v),
+        oracle, rtol=5e-2, atol=5e-2, budget_s=budget_s, timer=timer,
+        persist=persist, meta={"causal": bool(causal), "seed": seed})
 
 
 # ---------------------------------------------------------------------------
@@ -426,6 +576,21 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
         from .paged_attention import ragged_attend
         return ragged_attend(q, k_pool, v_pool, block_tables, slot_ids,
                              positions, k_scale, v_scale, scale=scale)
+    return ragged_gather_reference(q, k_pool, v_pool, block_tables,
+                                   slot_ids, positions, k_scale,
+                                   v_scale, scale=scale)
+
+
+def ragged_gather_reference(q, k_pool, v_pool, block_tables, slot_ids,
+                            positions, k_scale=None, v_scale=None, *,
+                            scale=None):
+    """The pure-XLA gather implementation of `ragged_paged_attention`
+    — the CPU path, the kernel-parity oracle, and the admission gate
+    the autotuner holds every paged candidate against."""
+    T, H, Dh = q.shape
+    BS = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
     safe_slot = jnp.clip(slot_ids, 0, block_tables.shape[0] - 1)
     bt = block_tables[safe_slot]                      # [T, MB]
     S = bt.shape[1] * BS
@@ -480,6 +645,20 @@ def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
         from .paged_attention import verify_attend
         return verify_attend(q, k_pool, v_pool, block_tables, slot_ids,
                              positions, k_scale, v_scale, scale=scale)
+    return verify_gather_reference(q, k_pool, v_pool, block_tables,
+                                   slot_ids, positions, k_scale,
+                                   v_scale, scale=scale)
+
+
+def verify_gather_reference(q, k_pool, v_pool, block_tables, slot_ids,
+                            positions, k_scale=None, v_scale=None, *,
+                            scale=None):
+    """The pure-XLA gather implementation of `verify_paged_attention`
+    (CPU path / parity oracle / tuner admission gate)."""
+    B, K, H, Dh = q.shape
+    BS = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
     safe_slot = jnp.clip(slot_ids, 0, block_tables.shape[0] - 1)
     bt = block_tables[safe_slot]                      # [B, MB]
     S = bt.shape[1] * BS

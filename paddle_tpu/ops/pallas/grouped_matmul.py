@@ -1,0 +1,286 @@
+"""Grouped-expert matmul Pallas kernel for the MoE capacity buffers.
+
+The MoE serving hot path (ISSUE 10) runs every expert's FFN on its
+fixed `[C, d]` capacity buffer. The XLA path expresses the whole block
+as one-hot einsums (`moe_utils.dispatch_tokens` / `combine_tokens` +
+`einsum("ecd,edf->ecf")`), which materializes `[T, k, C]`/`[T, k, E]`
+masks and leaves the per-expert matmuls to the compiler's batching.
+This kernel grids DIRECTLY over (expert, C-tile, F-tile) with a
+sequential d-reduction axis, so each expert's capacity buffer hits the
+MXU as dense tiles:
+
+* grid `(E, C/bc, F/bf, D/bd)` — the leading three axes are
+  embarrassingly parallel (`dimension_semantics`), the trailing
+  reduction axis carries a VMEM fp32 accumulator;
+* int8 weight-only experts dequantize INSIDE the kernel: the
+  per-(expert, out-channel) scale tile rides the same (e, f) index
+  map as the weight tile and multiplies it right after the load —
+  the weight is read from HBM as int8, exactly like `_mm`'s fused
+  dequant on the dense path;
+* tile sizes `(block_c, block_f, block_d)` are TUNABLE
+  (`ops.pallas.autotune`, kernel name ``grouped_matmul``) — the
+  einsum path stays the CPU oracle and the fallback for shapes the
+  gate refuses.
+
+The companion index-based dispatch/combine (no one-hot
+materialization) lives in `parallel.moe_utils`
+(`dispatch_tokens_indexed` / `combine_tokens_indexed`); together they
+form the grouped MoE path `incubate.nn.fused_transformer` dispatches
+to on TPU (or under kernel-test interpret mode).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import autotune
+
+# Set by tests to run the kernel in interpret mode on the CPU mesh.
+_INTERPRET = False
+
+
+def _on_tpu_backend() -> bool:
+    from ...core.place import on_tpu_backend
+    return on_tpu_backend()
+
+
+def grouped_matmul_killed() -> bool:
+    """`PADDLE_TPU_GROUPED_MATMUL=0`: the operator asked for the
+    one-hot einsum reference on every MoE expert matmul."""
+    return os.environ.get("PADDLE_TPU_GROUPED_MATMUL", "1") == "0"
+
+
+def grouped_matmul_enabled(d_in, d_out) -> bool:
+    """Dispatch gate: env kill-switch first, then backend/shape — on a
+    TPU backend the contraction and output feature axes must be
+    lane-aligned so weight tiles fill (sublane x 128) registers; under
+    `_INTERPRET` (tests) any shape runs. Alignment comes from the same
+    source of truth as the paged gate (`autotune.LANE_ALIGN`)."""
+    if grouped_matmul_killed():
+        return False
+    if _INTERPRET:
+        return True
+    return (_on_tpu_backend() and d_in % autotune.LANE_ALIGN == 0
+            and d_out % autotune.LANE_ALIGN == 0)
+
+
+def _gmm_kernel(x_ref, w_ref, o_ref, acc_ref, *, nd, qmax):
+    """One (expert, c-tile, f-tile, d-tile) grid cell.
+
+    x tile [1, bc, bd]; w tile [1, bd, bf] (int8 when quantized);
+    optional scale tile [1, bf] fp32; out tile [1, bc, bf]; fp32
+    accumulator scratch [bc, bf] carried across the d axis."""
+    d = pl.program_id(3)
+
+    @pl.when(d == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    w = w_ref[0].astype(jnp.float32)
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[0].astype(jnp.float32), w,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(d == nd - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _gmm_kernel_quant(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nd, qmax):
+    d = pl.program_id(3)
+
+    @pl.when(d == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # weight-only dequant fused at the tile load: int8 tile * per-
+    # out-channel scale/qmax (same formula as fused_transformer._deq)
+    w = w_ref[0].astype(jnp.float32) \
+        * (s_ref[0].astype(jnp.float32) / qmax)[None, :]
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[0].astype(jnp.float32), w,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(d == nd - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _pick_block(n, target):
+    """Largest divisor of n that is <= target (tiles must be exact —
+    a remainder tile would read past the buffer)."""
+    b = min(int(target), int(n))
+    while n % b:
+        b -= 1
+    return b
+
+
+def _gmm_call(x, w, scale, qmax, bc, bf, bd, out_dtype):
+    """The raw pallas_call with resolved tile sizes."""
+    E, C, D = x.shape
+    F = w.shape[2]
+    nd = D // bd
+    grid = (E, C // bc, F // bf, nd)
+    in_specs = [
+        pl.BlockSpec((1, bc, bd), lambda e, c, f, d: (e, c, d)),
+        pl.BlockSpec((1, bd, bf), lambda e, c, f, d: (e, d, f)),
+    ]
+    args = [x, w]
+    if scale is not None:
+        in_specs.append(pl.BlockSpec((1, bf), lambda e, c, f, d: (e, f)))
+        args.append(scale)
+        kernel = functools.partial(_gmm_kernel_quant, nd=nd,
+                                   qmax=float(qmax))
+    else:
+        kernel = functools.partial(_gmm_kernel, nd=nd, qmax=float(qmax))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bc, bf), lambda e, c, f, d: (e, c, f)),
+        scratch_shapes=[pltpu.VMEM((bc, bf), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((E, C, F), out_dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * E * C * D * F,
+            bytes_accessed=(E * C * D * x.dtype.itemsize
+                            + E * D * F * w.dtype.itemsize
+                            + E * C * F * jnp.dtype(out_dtype).itemsize),
+            transcendentals=0),
+        interpret=_INTERPRET,
+    )(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _gmm_core(x, w, bc, bf, bd, out_dtype):
+    """Differentiable (unquantized) grouped matmul: Pallas forward,
+    XLA einsum backward — the `_flash_core` discipline (the compiler
+    fuses the two grouped backward contractions well, and training
+    never runs int8 experts)."""
+    return _gmm_call(x, w, None, 127.0, bc, bf, bd, out_dtype)
+
+
+def _gmm_core_fwd(x, w, bc, bf, bd, out_dtype):
+    return _gmm_call(x, w, None, 127.0, bc, bf, bd, out_dtype), (x, w)
+
+
+def _gmm_core_bwd(bc, bf, bd, out_dtype, res, g):
+    x, w = res
+    gf = g.astype(jnp.float32)
+    dx = jnp.einsum("ecf,edf->ecd", gf, w.astype(jnp.float32))
+    dw = jnp.einsum("ecd,ecf->edf", x.astype(jnp.float32), gf)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_gmm_core.defvjp(_gmm_core_fwd, _gmm_core_bwd)
+
+
+def grouped_expert_matmul(x, w, scale=None, *, qmax=127.0,
+                          block_c=None, block_f=None, block_d=None,
+                          out_dtype=None):
+    """x [E, C, D] @ w [E, D, F] -> [E, C, F], one expert per leading
+    grid axis. `scale` [E, F] fp32 dequantizes int8 weight-only
+    experts inside the kernel (`w * scale / qmax` per out-channel);
+    the quantized variant is inference-only (no VJP — int8 experts
+    are never trained), the fp variant differentiates via a custom
+    VJP whose backward runs the XLA grouped contractions.
+
+    Tile sizes default to the tuned winner for this shape bucket
+    (`autotune.kernel_config("grouped_matmul", ...)`) and fall back to
+    MXU-shaped 128/512 targets; explicit arguments pin them (the
+    tuner's candidate builder does exactly that)."""
+    E, C, D = x.shape
+    F = w.shape[2]
+    if block_c is None or block_f is None or block_d is None:
+        # int8 weight-only experts key by the WEIGHT dtype: tiles
+        # measured on int8 loads are a different cache entry than the
+        # fp variant's (int8 halves the weight fetch per tile)
+        key_dt = w.dtype if scale is not None else x.dtype
+        cfg = autotune.kernel_config(
+            "grouped_matmul", autotune.shape_bucket(E, C, D, F),
+            key_dt, default=None) or {}
+        block_c = block_c or cfg.get("block_c", 128)
+        block_f = block_f or cfg.get("block_f", 128)
+        block_d = block_d or cfg.get("block_d", 512)
+    bc = _pick_block(C, block_c)
+    bf = _pick_block(F, block_f)
+    bd = _pick_block(D, block_d)
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    if scale is None:
+        return _gmm_core(x, w, bc, bf, bd, out_dtype)
+    return _gmm_call(x, w, scale, qmax, bc, bf, bd, out_dtype)
+
+
+def grouped_matmul_oracle(x, w, scale=None, *, qmax=127.0,
+                          out_dtype=None):
+    """The einsum reference (CPU oracle + fallback): dequant in the
+    compute dtype, then `ecd,edf->ecf` — numerically the
+    `fused_transformer._expert_ffn` formulation."""
+    cd = out_dtype or x.dtype
+    wf = w.astype(cd)
+    if scale is not None:
+        wf = wf * (scale[:, None, :].astype(cd) / float(qmax))
+    return jnp.einsum("ecd,edf->ecf", x.astype(cd), wf).astype(cd)
+
+
+def tune_grouped_matmul(E, C, D, F, *, dtype="float32",
+                        quantized=False, seed=0, budget_s=None,
+                        timer=None, persist=True):
+    """Search the (block_c, block_f, block_d) tile space of one
+    grouped-matmul shape bucket against the einsum oracle. Runs the
+    real kernel (interpret mode off-TPU); the winner lands in the
+    persistent cache so `grouped_expert_matmul`'s next trace resolves
+    it for free."""
+    import numpy as np
+
+    global _INTERPRET
+    dtype = np.dtype(dtype)
+    if dtype == np.int8:
+        # an int8 KEY dtype means the weight-quantized variant:
+        # activations stay fp32 (the serving compute dtype), weights
+        # int8 + scales
+        quantized, dtype = True, np.dtype(np.float32)
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(E, C, D).astype(dtype))
+    if quantized:
+        w = jnp.asarray(rng.randint(-127, 128, (E, D, F)).astype(
+            np.int8))
+        s = jnp.asarray((np.abs(rng.randn(E, F)) * 0.05 + 0.01).astype(
+            np.float32))
+        args = (x, w, s)
+    else:
+        w = jnp.asarray((rng.randn(E, D, F) * 0.1).astype(dtype))
+        args = (x, w, None)
+
+    def oracle(x, w, s):
+        return grouped_matmul_oracle(x, w, s, out_dtype=dtype)
+
+    def build(cfg):
+        def run(x, w, s):
+            return grouped_expert_matmul(
+                x, w, s, block_c=cfg["block_c"], block_f=cfg["block_f"],
+                block_d=cfg["block_d"], out_dtype=dtype)
+        return run
+
+    was = _INTERPRET
+    if not _on_tpu_backend():
+        _INTERPRET = True
+    try:
+        # quantized winners cache under int8 (the weight dtype the
+        # runtime lookup keys by), never clobbering the fp entry
+        key_dt = np.dtype(np.int8) if quantized else dtype
+        return autotune.search(
+            "grouped_matmul", autotune.shape_bucket(E, C, D, F),
+            key_dt, autotune.grouped_matmul_candidates(E, C, D, F),
+            build, args, oracle, rtol=2e-2, atol=2e-2,
+            budget_s=budget_s, timer=timer, persist=persist,
+            meta={"quantized": bool(quantized), "seed": seed})
+    finally:
+        _INTERPRET = was

@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import autotune
+
 # finite mask value: -inf would NaN the running-max rescale on fully
 # masked tiles (exp(-inf - -inf)); matches jax's paged kernel choice
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max) / 1e6  # ~-3.4e32/1e6
@@ -78,13 +80,16 @@ def paged_pallas_enabled(head_dim, block_size) -> bool:
     XLA gather paths everywhere), then backend/shape: on a TPU backend
     the kernels want a lane-aligned head_dim and a sublane-aligned
     block size so KV tiles hit full (8/32 x 128) registers; under
-    `_INTERPRET` (tests) any shape runs."""
+    `_INTERPRET` (tests) any shape runs. The alignment predicate is
+    `autotune.paged_alignment_ok` — the SAME source of truth the
+    kernel tuner's candidate filters use, so a tuned candidate the
+    serve-time gate would refuse cannot exist (ISSUE 11)."""
     if pallas_killed():
         return False
     if _INTERPRET:
         return True
-    return (_on_tpu_backend() and head_dim % 128 == 0
-            and block_size % 8 == 0)
+    return (_on_tpu_backend()
+            and autotune.paged_alignment_ok(head_dim, block_size))
 
 
 def _group_positions(pos_ref, g, G):
@@ -165,19 +170,34 @@ def _paged_attend_kernel(slot_ref, bt_ref, pos_ref, q_ref, k_ref, v_ref,
 
 def _paged_attend_grouped(q, k_pool, v_pool, block_tables, slot_ids,
                           positions, k_scale=None, v_scale=None, *,
-                          scale=None):
+                          scale=None, kernel_name="paged_ragged",
+                          tuning=None):
     """Grouped block-table-native attention.
 
     q [N, G, H, Dh]; k_pool/v_pool [NB, BS, H, Dh]; block_tables
     [S, MB] int32; slot_ids [N] int32 (-1 = padding group); positions
     [N, G] int32. Optional k_scale/v_scale [NB, BS, H] fp32 dequantize
-    int8 pools inside the kernel. Returns [N, G, H, Dh] in q.dtype."""
+    int8 pools inside the kernel. Returns [N, G, H, Dh] in q.dtype.
+
+    `kernel_name` keys the autotuner lookup: the tuned grid-layout
+    config (`dimension_semantics` — whether Mosaic may treat the
+    group axis as parallel) is resolved HERE, at trace time, so a
+    cached winner costs one dict probe inside the one compile and
+    nothing per step."""
     N, G, H, Dh = q.shape
     NB, BS = k_pool.shape[0], k_pool.shape[1]
     S, MB = block_tables.shape
     quantized = k_scale is not None
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
+    tuned = tuning if tuning is not None else autotune.kernel_config(
+        kernel_name, autotune.shape_bucket(N, G, H, Dh, BS),
+        k_pool.dtype, default=None) or {}
+    dim_sem = tuned.get("dimension_semantics")
+    compiler_params = None
+    if dim_sem is not None:
+        compiler_params = pltpu.TPUCompilerParams(
+            dimension_semantics=tuple(dim_sem))
     qs = (q.astype(jnp.float32) * scale).astype(
         q.dtype if q.dtype != jnp.float64 else jnp.float32)
 
@@ -211,10 +231,13 @@ def _paged_attend_grouped(q, k_pool, v_pool, block_tables, slot_ids,
     )
     kernel = functools.partial(
         _paged_attend_kernel, block_size=BS, G=G, quantized=quantized)
+    extra = {}
+    if compiler_params is not None:
+        extra["compiler_params"] = compiler_params
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((N, G, H, Dh), q.dtype),
-        interpret=_INTERPRET,
+        interpret=_INTERPRET, **extra,
         cost_estimate=pl.CostEstimate(
             flops=4 * N * G * H * Dh * MB * BS,
             bytes_accessed=(2 * N * MB * BS * H * Dh
@@ -236,7 +259,8 @@ def ragged_attend(q, k_pool, v_pool, block_tables, slot_ids, positions,
     T = q.shape[0]
     out = _paged_attend_grouped(
         q[:, None], k_pool, v_pool, block_tables, slot_ids,
-        positions.reshape(T, 1), k_scale, v_scale, scale=scale)
+        positions.reshape(T, 1), k_scale, v_scale, scale=scale,
+        kernel_name="paged_ragged")
     return out[:, 0]
 
 
@@ -246,7 +270,7 @@ def verify_attend(q, k_pool, v_pool, block_tables, slot_ids, positions,
     one G=K group per slot, ONE block-table walk per group."""
     return _paged_attend_grouped(
         q, k_pool, v_pool, block_tables, slot_ids, positions,
-        k_scale, v_scale, scale=scale)
+        k_scale, v_scale, scale=scale, kernel_name="paged_verify")
 
 
 def decode_attend(q, k_pool, v_pool, block_tables, context_lens,
@@ -258,5 +282,144 @@ def decode_attend(q, k_pool, v_pool, block_tables, context_lens,
     out = _paged_attend_grouped(
         q[:, None], k_pool, v_pool, block_tables,
         jnp.arange(B, dtype=jnp.int32), positions,
-        k_scale, v_scale, scale=scale)
+        k_scale, v_scale, scale=scale, kernel_name="paged_decode")
     return out[:, 0]
+
+
+# ----------------------------------------------------------- autotuning
+
+
+def _synth_paged_inputs(N, G, H, Dh, BS, context_len, dtype, seed):
+    """Deterministic synthetic pools/tables/queries for one paged
+    shape bucket (the tuner's measurement workload). `dtype` is the
+    POOL dtype: int8 builds quantized pools with per-entry-per-head
+    fp32 scales (the `kv_dtype="int8"` serving layout) under fp32
+    queries; otherwise scales are None."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    mb = -(-int(context_len) // BS)
+    NB = N * mb + 1
+    dtype = np.dtype(dtype)
+    quant = dtype == np.int8
+    qdt = np.float32 if quant else dtype
+    q = jnp.asarray(rng.randn(N, G, H, Dh).astype(qdt))
+    if quant:
+        kp = jnp.asarray(rng.randint(-127, 128, (NB, BS, H, Dh))
+                         .astype(np.int8))
+        vp = jnp.asarray(rng.randint(-127, 128, (NB, BS, H, Dh))
+                         .astype(np.int8))
+        ks = jnp.asarray((np.abs(rng.randn(NB, BS, H)) * 0.02
+                          + 0.005).astype(np.float32))
+        vs = jnp.asarray((np.abs(rng.randn(NB, BS, H)) * 0.02
+                          + 0.005).astype(np.float32))
+    else:
+        kp = jnp.asarray(rng.randn(NB, BS, H, Dh).astype(dtype))
+        vp = jnp.asarray(rng.randn(NB, BS, H, Dh).astype(dtype))
+        ks = vs = None
+    bt = jnp.asarray(
+        1 + np.arange(N * mb, dtype=np.int32).reshape(N, mb))
+    slots = jnp.arange(N, dtype=jnp.int32)
+    pos = jnp.asarray(
+        np.clip(context_len - 1 - np.arange(G)[::-1], 0,
+                context_len - 1).astype(np.int32)[None].repeat(N, 0))
+    return q, kp, vp, bt, slots, pos, ks, vs
+
+
+def tune_paged_kernel(kernel_name, N, G, H, Dh, BS, *,
+                      context_len=None, dtype="float32", seed=0,
+                      budget_s=None, timer=None, persist=True):
+    """Search the grid-layout space of one paged-attention bucket.
+
+    Candidates run the REAL block-table kernel (interpret mode off-TPU
+    — the same plumbing tier-1 parity uses) against the XLA gather
+    oracle; the winner lands in the persistent cache under
+    `(kernel_name, shape_bucket(N, G, H, Dh, BS), dtype, backend)` so
+    the serving engine's next trace picks it up for free."""
+    import numpy as np
+    from . import flash_attention as fa
+
+    global _INTERPRET
+    dtype = np.dtype(dtype)
+    context_len = int(context_len or 4 * BS)
+    args = _synth_paged_inputs(N, G, H, Dh, BS, context_len,
+                               dtype, seed)
+
+    def oracle(q, kp, vp, bt, slots, pos, ks, vs):
+        if G == 1:
+            return fa.ragged_gather_reference(q[:, 0], kp, vp, bt,
+                                              slots, pos[:, 0], ks, vs)
+        return fa.verify_gather_reference(q, kp, vp, bt, slots, pos,
+                                          ks, vs)
+
+    def build(cfg):
+        def run(q, kp, vp, bt, slots, pos, ks, vs):
+            out = _paged_attend_grouped(q, kp, vp, bt, slots, pos,
+                                        ks, vs,
+                                        kernel_name=kernel_name,
+                                        tuning=cfg)
+            return out[:, 0] if G == 1 else out
+        return run
+
+    was = _INTERPRET
+    if not _on_tpu_backend():
+        _INTERPRET = True
+    try:
+        return autotune.search(
+            kernel_name, autotune.shape_bucket(N, G, H, Dh, BS), dtype,
+            autotune.paged_candidates(), build, args, oracle,
+            rtol=2e-2, atol=2e-2, budget_s=budget_s, timer=timer,
+            persist=persist,
+            meta={"context_len": context_len, "seed": seed})
+    finally:
+        _INTERPRET = was
+
+
+def tune_block_size(max_slots, H, Dh, *, context_len=64,
+                    dtype="float32", seed=0, budget_s=None,
+                    timer=None, persist=True):
+    """Search the ENGINE-level KV block-size axis: each candidate
+    re-shapes the pools (`NB = slots * ceil(ctx / BS) + 1`) and times
+    decode-shaped ragged attention over them; parity holds per
+    candidate against the gather oracle on the candidate's own pools.
+    Candidates come from `autotune.paged_block_size_candidates` — the
+    SAME alignment predicate as the serve-time dispatch gate, so the
+    cached winner is admissible wherever the kernels are
+    (`ServingEngine(block_size="auto")` reads the result)."""
+    import numpy as np
+    from . import flash_attention as fa
+
+    global _INTERPRET
+    dtype = np.dtype(dtype)
+
+    def oracle(q, kp, vp, bt, slots, pos, ks, vs):
+        return fa.ragged_gather_reference(q[:, 0], kp, vp, bt, slots,
+                                          pos[:, 0], ks, vs)
+
+    def build(cfg):
+        bs = int(cfg["block_size"])
+        cand_args = _synth_paged_inputs(max_slots, 1, H, Dh, bs,
+                                        context_len, dtype, seed)
+
+        def run(q, kp, vp, bt, slots, pos, ks, vs):
+            if paged_pallas_enabled(Dh, bs):
+                out = _paged_attend_grouped(q, kp, vp, bt, slots, pos,
+                                            ks, vs,
+                                            kernel_name="paged_decode")
+                return out[:, 0]
+            return fa.ragged_gather_reference(q[:, 0], kp, vp, bt,
+                                              slots, pos[:, 0], ks, vs)
+        return run, cand_args
+
+    was = _INTERPRET
+    if not _on_tpu_backend():
+        _INTERPRET = True
+    try:
+        return autotune.search(
+            "paged_block_size", autotune.shape_bucket(max_slots, H, Dh),
+            dtype,
+            autotune.paged_block_size_candidates(Dh, context_len),
+            build, None, oracle, rtol=2e-2, atol=2e-2,
+            budget_s=budget_s, timer=timer, persist=persist,
+            meta={"context_len": int(context_len), "seed": seed})
+    finally:
+        _INTERPRET = was

@@ -54,21 +54,32 @@ def expert_capacity(num_tokens, num_experts, top_k, capacity_factor):
 class DispatchPlan:
     """Fixed-shape masks for one routed token set.
 
-    disp  [T, k, C]  0/1 dispatch mask (capacity slot per choice)
-    comb  [T, k, C]  gate-weighted combine mask (disp * gate value)
+    disp  [T, k, C]  0/1 dispatch mask (capacity slot per choice);
+                     None when built with `build_masks=False` (the
+                     index-based grouped-matmul path never reads it)
+    comb  [T, k, C]  gate-weighted combine mask (disp * gate value);
+                     None like `disp` under `build_masks=False`
     e_oh  [T, k, E]  expert one-hot per choice (invalid/padded rows 0)
     counts  [E] f32  tokens each expert actually received (post-drop)
     dropped    f32   (token, choice) pairs lost to capacity overflow
+    gate_idx [T, k]  chosen expert per (token, choice)
+    slot  [T, k]     capacity slot within the chosen expert
+    in_cap [T, k]    bool: the choice landed inside capacity
+    gates [T, k]     renormalized gate values (the combine weights)
     """
     disp: object
     comb: object
     e_oh: object
     counts: object
     dropped: object
+    gate_idx: object = None
+    slot: object = None
+    in_cap: object = None
+    gates: object = None
 
 
 def capacity_dispatch(gate_val, gate_idx, num_experts, capacity,
-                      valid=None, dtype=None):
+                      valid=None, dtype=None, build_masks=True):
     """Build the dispatch/combine masks for already-chosen experts.
 
     gate_val/gate_idx [T, k]; `valid` [T] bool masks padding tokens
@@ -77,7 +88,12 @@ def capacity_dispatch(gate_val, gate_idx, num_experts, capacity,
     token-major, choice-minor order, so earlier tokens win capacity
     (GShard's position-in-expert semantics); an overflowing choice is
     dropped: its disp/comb rows are zero and the caller's residual
-    connection carries the token through unchanged."""
+    connection carries the token through unchanged.
+
+    `build_masks=False` skips materializing the [T, k, C] one-hot
+    disp/comb masks — the index-based dispatch/combine below only
+    needs the (gate_idx, slot, in_cap, gates) integer plan, and for
+    serving-scale C the masks are the dominant memory term."""
     import jax
     import jax.numpy as jnp
 
@@ -93,9 +109,11 @@ def capacity_dispatch(gate_val, gate_idx, num_experts, capacity,
     slot = jnp.sum(pos * flat_oh, axis=-1).reshape(T, k)       # [T,k]
     routed = jnp.sum(oh, axis=-1) > 0                          # [T,k]
     in_cap = routed & (slot < C)
-    disp = (jax.nn.one_hot(slot, C, dtype=dtype)
-            * in_cap[..., None].astype(dtype))                 # [T,k,C]
-    comb = disp * gate_val.astype(dtype)[..., None]
+    disp = comb = None
+    if build_masks:
+        disp = (jax.nn.one_hot(slot, C, dtype=dtype)
+                * in_cap[..., None].astype(dtype))             # [T,k,C]
+        comb = disp * gate_val.astype(dtype)[..., None]
     e_oh = oh.astype(dtype)
     # counts summed in f32 from the int masks: a bf16 compute dtype
     # would round the running sum past ~256 tokens per expert and
@@ -106,7 +124,8 @@ def capacity_dispatch(gate_val, gate_idx, num_experts, capacity,
     dropped = (jnp.sum(routed.astype(jnp.float32))
                - jnp.sum(in_cap.astype(jnp.float32)))
     return DispatchPlan(disp=disp, comb=comb, e_oh=e_oh, counts=kept,
-                        dropped=dropped)
+                        dropped=dropped, gate_idx=gate_idx, slot=slot,
+                        in_cap=in_cap, gates=gate_val)
 
 
 def _masked_axis_sums(vals, valid, axes):
@@ -174,13 +193,15 @@ class RouterOutput:
 
 
 def top_k_routing(logits, top_k, capacity, valid=None, axes=None,
-                  dtype=None):
+                  dtype=None, build_masks=True):
     """Softmax gate -> top-k -> renormalize -> capacity dispatch.
 
     logits [T, E] f32-castable; returns a `RouterOutput` whose plan
     carries the fixed-shape dispatch/combine masks plus the aux
     losses. `axes` (mesh axis names) makes the aux statistics global —
-    pass the data-sharding axes when tracing inside shard_map."""
+    pass the data-sharding axes when tracing inside shard_map.
+    `build_masks=False` keeps the plan index-only (the grouped-matmul
+    dispatch path — see `capacity_dispatch`)."""
     import jax
     import jax.numpy as jnp
 
@@ -190,7 +211,8 @@ def top_k_routing(logits, top_k, capacity, valid=None, axes=None,
     gates = topv / jnp.maximum(
         jnp.sum(topv, axis=-1, keepdims=True), 1e-12)
     plan = capacity_dispatch(gates, topi, logits.shape[-1], capacity,
-                             valid=valid, dtype=dtype or logits.dtype)
+                             valid=valid, dtype=dtype or logits.dtype,
+                             build_masks=build_masks)
     aux = router_balance_loss(probs, plan.e_oh, valid=valid, axes=axes)
     z = router_z_loss(lf, valid=valid, axes=axes)
     return RouterOutput(plan=plan, gates=gates, balance_loss=aux,
@@ -215,6 +237,65 @@ def combine_tokens(eout, plan):
     import jax.numpy as jnp
     return jnp.einsum("tkc,tke,ecd->td", plan.comb, plan.e_oh,
                       eout.astype(plan.comb.dtype))
+
+
+# ---------------------------------------------------------------------
+# index-based dispatch/combine (ISSUE 11): the grouped-expert-matmul
+# companions. Instead of contracting [T, k, C] x [T, k, E] one-hot
+# masks, the capacity assignment becomes ONE [E, C] token-index table
+# (a scatter) and dispatch/combine become gathers — no mask tensor is
+# ever materialized, and the expert FFN runs on the dense [E, C, d]
+# buffers via `ops.pallas.grouped_matmul.grouped_expert_matmul`.
+# The einsum pair above stays the parity oracle and the fallback.
+# ---------------------------------------------------------------------
+
+
+def dispatch_indices(plan, num_experts, capacity):
+    """[E, C] int32 token index per capacity slot (-1 = unclaimed).
+
+    Each in-capacity (token, choice) owns a unique (expert, slot) by
+    construction (`slot` is the arrival position within the expert),
+    so the scatter has no collisions; dropped/padded choices are
+    routed out of bounds and dropped by the scatter mode."""
+    import jax.numpy as jnp
+    T, k = plan.slot.shape
+    E, C = int(num_experts), int(capacity)
+    ok = plan.in_cap.reshape(-1)
+    e = jnp.where(ok, plan.gate_idx.reshape(-1), E)
+    c = jnp.where(ok, plan.slot.reshape(-1), 0)
+    tok = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    tos = jnp.full((E, C), -1, jnp.int32)
+    return tos.at[e, c].set(tok, mode="drop")
+
+
+def dispatch_tokens_indexed(x, plan, num_experts, capacity,
+                            indices=None):
+    """x [T, d] -> [E, C, d] capacity buffers via gather (unclaimed
+    slots zero) — semantically identical to `dispatch_tokens`."""
+    import jax.numpy as jnp
+    tos = dispatch_indices(plan, num_experts, capacity) \
+        if indices is None else indices
+    g = x[jnp.maximum(tos, 0)]                       # [E, C, d]
+    return g * (tos >= 0).astype(x.dtype)[..., None]
+
+
+def combine_tokens_indexed(eout, plan, e_offset=0, num_local=None):
+    """eout [E_loc, C, d] -> [T, d] gate-weighted mixture via gather —
+    semantically identical to `combine_tokens`. `e_offset`/`num_local`
+    select a resident expert range (the serving EP path: each shard
+    combines only its local experts' outputs and psums the partial
+    mixtures over the ep axis)."""
+    import jax.numpy as jnp
+    E_loc, C = eout.shape[0], eout.shape[1]
+    if num_local is None:
+        num_local = E_loc
+    e = plan.gate_idx
+    local = plan.in_cap & (e >= e_offset) & (e < e_offset + num_local)
+    el = jnp.clip(e - e_offset, 0, E_loc - 1)
+    cl = jnp.clip(plan.slot, 0, C - 1)
+    vals = eout[el, cl]                              # [T, k, d]
+    w = plan.gates.astype(eout.dtype) * local.astype(eout.dtype)
+    return jnp.sum(vals * w[..., None], axis=1)
 
 
 # ---------------------------------------------------------------------

@@ -522,6 +522,24 @@ HOST_EVENTS_DROPPED = REGISTRY.counter(
     "paddle_tpu_profiler_host_events_dropped_total",
     "RecordEvent spans dropped by the bounded host ring buffer")
 
+# ---- Pallas kernel autotuner (ISSUE 11): ops.pallas.autotune --------
+KERNEL_AUTOTUNE_CACHE_HITS = REGISTRY.counter(
+    "paddle_tpu_kernel_autotune_cache_hits_total",
+    "Tuned-kernel config lookups served from the persistent cache "
+    "(zero search cost)", ("kernel",))
+KERNEL_AUTOTUNE_CACHE_MISSES = REGISTRY.counter(
+    "paddle_tpu_kernel_autotune_cache_misses_total",
+    "Tuned-kernel config lookups that fell back to the hand-picked "
+    "default (no cached winner for the shape bucket)", ("kernel",))
+KERNEL_AUTOTUNE_SEARCH_SECONDS = REGISTRY.counter(
+    "paddle_tpu_kernel_autotune_search_seconds_total",
+    "Wall seconds spent measuring kernel-variant candidates",
+    ("kernel",))
+KERNEL_AUTOTUNE_REJECTED_PARITY = REGISTRY.counter(
+    "paddle_tpu_kernel_autotune_candidates_rejected_parity_total",
+    "Kernel-variant candidates refused admission by the XLA-oracle "
+    "parity gate (or by failing to run at all)", ("kernel",))
+
 # ---- MoE routing (ISSUE 10): shared by the hybrid trainer
 # ("train" path) and the serving mixed step ("serving" path) -----------
 MOE_EXPERT_TOKENS = REGISTRY.counter(

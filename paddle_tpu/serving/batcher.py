@@ -91,6 +91,32 @@ def apply_logit_penalties(logits, history, sc: SamplingConfig):
     return logits
 
 
+def filter_logits(logits, sc: SamplingConfig):
+    """The temperature / top-k / top-p logit transform of the sampling
+    strategy, factored out so the speculative verify path can reuse
+    it: the distribution non-speculative sampling draws from is
+    EXACTLY `softmax(filter_logits(logits, sc))`, and the rejection
+    rule must target that same distribution (serving/engine.py)."""
+    import jax
+    import jax.numpy as jnp
+    if sc.temperature != 1.0:
+        logits = logits / max(sc.temperature, 1e-6)
+    if sc.top_k and sc.top_k > 0:
+        kth = jax.lax.top_k(logits, sc.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if sc.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p; the
+        # cutoff is the SMALLEST kept logit
+        keep = cum - probs < sc.top_p
+        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                      keepdims=True)
+        logits = jnp.where(logits < kth, -1e9, logits)
+    return logits
+
+
 def select_token(logits, key, sc: SamplingConfig, history=None):
     """logits [B, V] -> token [B] int32 (device-side sampling).
 
@@ -104,21 +130,7 @@ def select_token(logits, key, sc: SamplingConfig, history=None):
         logits = apply_logit_penalties(logits, history, sc)
     if sc.strategy == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if sc.temperature != 1.0:
-        logits = logits / max(sc.temperature, 1e-6)
-    if sc.top_k and sc.top_k > 0:
-        kth = jax.lax.top_k(logits, sc.top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -1e9, logits)
-    if sc.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest prefix with cumulative prob >= top_p; the
-        # cutoff is the SMALLEST kept logit
-        keep = cum - probs < sc.top_p
-        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
-                      keepdims=True)
-        logits = jnp.where(logits < kth, -1e9, logits)
+    logits = filter_logits(logits, sc)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

@@ -196,7 +196,13 @@ class TPServingEngine(ServingEngine):
         # through the scanned psum)
         n_data = 6 + (1 if batcher.needs_history(self.sampling) else 0)
         data_in = (rep,) * n_data
-        tok_out = (rep, rep) if self.draft_k else rep
+        # spec-sampling adds the residual-resample + accept matrices
+        # to the verify outputs (engine._step_body) — all replicated,
+        # like the token outputs
+        if self.draft_k:
+            tok_out = (rep,) * (4 if self.spec_sampling else 2)
+        else:
+            tok_out = rep
         # MoE stats (counts/dropped/aux) come off replicated routing
         # inputs, identical on every shard
         stats_out = ({"counts": rep, "dropped": rep, "aux": rep},) \

@@ -33,6 +33,22 @@ def accept_length(fed_tokens, scored_tokens):
     return m
 
 
+def accept_length_sampled(fed_tokens, accept_flags):
+    """Longest accepted draft prefix under REJECTION sampling.
+
+    `accept_flags[j]` is the device's verdict on draft `d_{j+1}`
+    (uniform u_j < p_j(d_{j+1}) against the target distribution at
+    verify position j — serving/engine.py). Returns m: drafts
+    d_1..d_m were accepted; the emitter then takes the device's
+    residual resample at position m (rejection there) or its bonus
+    sample (all drafts accepted, m == len(fed_tokens) - 1). Same
+    off-by-one contract as `accept_length`, same single home."""
+    m = 0
+    while m < len(fed_tokens) - 1 and bool(accept_flags[m]):
+        m += 1
+    return m
+
+
 def ngram_propose(tokens, k, max_ngram=3, min_ngram=1):
     """Propose `k` draft tokens for the sequence `tokens`.
 

@@ -75,6 +75,31 @@ class ServingEngine:
         L, H, Dh = dec.num_layers, dec.num_heads, dec.head_dim
         maxpos = model.max_position_embeddings
         max_seq_len = min(max_seq_len or maxpos, maxpos)
+        if block_size == "auto":
+            # tuned KV block size (ISSUE 11): the kernel autotuner's
+            # cached winner for this engine's shape bucket, falling
+            # back to the hand-picked 16. Candidates are admitted
+            # through the SAME alignment predicate as the serve-time
+            # Pallas dispatch gate, so "auto" can never pick a block
+            # size the kernels would refuse (bench.py's
+            # kernel_autotune extra is what populates the cache).
+            from ..ops.pallas import autotune as _kt
+            block_size = _kt.ensure(
+                "paged_block_size",
+                _kt.shape_bucket(max_slots, H, Dh),
+                np.dtype(np.int8) if kv_dtype == "int8"
+                else np.dtype(np.float32),
+                {"block_size": 16})["block_size"]
+            # geometry clamp: a winner tuned under a longer context
+            # must never exceed THIS engine's sequence bound (one
+            # block spanning the whole sequence would degrade paging/
+            # CoW/prefix sharing to whole-sequence granularity); the
+            # candidate list shares the gate's alignment predicate
+            allowed = [c["block_size"]
+                       for c in _kt.paged_block_size_candidates(
+                           Dh, max_seq_len)]
+            if block_size not in allowed:
+                block_size = 16 if 16 in allowed else allowed[-1]
         self.block_size = int(block_size)
         mbps = -(-max_seq_len // self.block_size)
         if num_blocks is None:
@@ -83,16 +108,21 @@ class ServingEngine:
         self.draft_k = int(draft_k)
         self.sampling = sampling or SamplingConfig()
         self.speculation_disabled = False
-        if self.draft_k > 0 and (self.sampling.strategy != "greedy"
-                                 or batcher.needs_history(self.sampling)):
-            # speculation verifies against the GREEDY UNPENALIZED
-            # continuation; sampled requests would need rejection
-            # sampling and penalized ones a per-draft-position history,
-            # so the engine auto-disables the draft path rather than
-            # refuse the sampling config (ROADMAP: non-greedy sampling
-            # in the serving engine; docs/SERVING.md)
+        if self.draft_k > 0 and batcher.needs_history(self.sampling):
+            # penalized sampling would need a per-draft-position
+            # history tensor (each verify position sees a different
+            # context window), so the engine auto-disables the draft
+            # path rather than refuse the config (docs/SERVING.md)
             self.draft_k = 0
             self.speculation_disabled = True
+        # plain sampling (temperature/top-k/top-p, no penalties) keeps
+        # speculation: drafts are accepted by the standard REJECTION
+        # rule against the filtered target distribution, so the output
+        # DISTRIBUTION matches non-speculative sampling exactly
+        # (ISSUE 11 satellite; the greedy path keeps its exact
+        # token-identity verify)
+        self.spec_sampling = (self.draft_k > 0
+                              and self.sampling.strategy != "greedy")
         self.token_budget = batcher.choose_token_budget(
             max_slots, self.block_size, token_budget,
             verify_width=self.draft_k + 1)
@@ -132,6 +162,13 @@ class ServingEngine:
         donate = (1, 2, 3, 4) if self.kv.quantized else (1, 2)
         self._step_fn = instrumented_jit(
             self._build_step(), STEP_FN_NAME, donate_argnums=donate)
+        # register this engine's paged-kernel shape buckets with the
+        # autotuner (ISSUE 11): keys derive from the token budget /
+        # slot count / per-shard head slice, so the tuner-cache audit
+        # (tools/kernel_coverage.py --tuner-audit) can flag buckets
+        # serving traffic hits that hold no tuned entry. Pure host
+        # dict probes — the step itself is untouched.
+        self._kernel_buckets = self._note_kernel_buckets()
         self._preempt_seen = 0
         self._prefix_seen = (0, 0, 0)    # hit / miss / evicted deltas
         self.steps_run = 0
@@ -141,6 +178,44 @@ class ServingEngine:
                                           np.float64)
         self.moe_dropped_total = 0.0
         self.moe_last_aux = 0.0
+
+    def _note_kernel_buckets(self):
+        """The (kernel, shape-bucket, dtype) keys this engine's mixed
+        step resolves tuned configs under — one `kernel_config` probe
+        each (recording cache hits/misses + the audit trail). The
+        bucket derives from the token budget: with speculation the
+        verify region [S, K] rides `paged_verify` and the remaining
+        flat tokens `paged_ragged`; without, the whole [T] axis is one
+        ragged bucket. Head counts are the PER-SHARD slice under TP
+        (`_step_cfg`), so a TP=2 engine tunes different keys than
+        TP=1 — topology is part of the key by construction, alongside
+        the backend/device-count component `autotune.backend_key`
+        already carries."""
+        from ..ops.pallas import autotune as _kt
+        cfg = self._step_cfg()
+        H, Dh, BS = cfg.num_heads, cfg.head_dim, self.block_size
+        dt = np.int8 if self.kv.quantized else self.kv.k_pool.dtype
+        T, S, K = self.token_budget, self.kv.max_slots, self.draft_k + 1
+        dtn = np.dtype(dt).name
+        keys = []
+        if K > 1:
+            keys.append(("paged_verify",
+                         _kt.shape_bucket(S, K, H, Dh, BS), dtn))
+            keys.append(("paged_ragged",
+                         _kt.shape_bucket(max(T - S * K, 1), 1, H, Dh,
+                                          BS), dtn))
+        else:
+            keys.append(("paged_ragged",
+                         _kt.shape_bucket(T, 1, H, Dh, BS), dtn))
+        for kernel, bucket, dtype in keys:
+            # ensure(): a hit is one dict probe; a miss falls back to
+            # the hand defaults — except under
+            # PADDLE_TPU_KERNEL_AUTOTUNE=tune, where the registered
+            # search runs HERE, at build time, before the step is ever
+            # traced (the tuning-outside-the-jitted-step contract),
+            # and persists the winner for every later engine
+            _kt.ensure(kernel, bucket, dtype, default=None)
+        return keys
 
     # ------------------------------------------------------- mixed step
     def _step_cfg(self):
@@ -177,6 +252,7 @@ class ServingEngine:
         quant = self.kv.quantized
         use_hist = batcher.needs_history(sc)
         moe = cfg.num_experts > 0
+        spec_sampling = self.spec_sampling
 
         def quantize(x):
             """[T, H, Dh] fp -> (int8 values, [T, H] fp32 scales):
@@ -315,17 +391,55 @@ class ServingEngine:
             sidx = jnp.clip(sample_index, 0, T - 1)
             h_last = xf[sidx]                          # [max_slots, D]
             logits = jnp.matmul(h_last, head.astype(h_last.dtype))
+            if spec_sampling:
+                rng, rng_u, rng_res, rng_bonus = jax.random.split(
+                    rng, 4)
             tok = select_token(logits, rng, sc, history)
             if K == 1:
                 return (tok,) + pools
-            # greedy scores for EVERY verify-region position: tok_v[s, j]
-            # is the model's next token after slot s's j-th fed token —
-            # the host accepts the longest draft prefix matching it
             hv = xf[:R].reshape(S, K, -1)
             logits_v = jnp.matmul(hv, head.astype(hv.dtype))
-            tok_v = jnp.argmax(logits_v.astype(jnp.float32),
-                               axis=-1).astype(jnp.int32)
-            return ((tok, tok_v),) + pools
+            if not spec_sampling:
+                # greedy scores for EVERY verify-region position:
+                # tok_v[s, j] is the model's next token after slot s's
+                # j-th fed token — the host accepts the longest draft
+                # prefix matching it
+                tok_v = jnp.argmax(logits_v.astype(jnp.float32),
+                                   axis=-1).astype(jnp.int32)
+                return ((tok, tok_v),) + pools
+            # REJECTION-SAMPLING verify (ISSUE 11 satellite): the
+            # n-gram proposer is deterministic (a point-mass draft
+            # distribution q), so the standard rule reduces to:
+            # accept draft d at position j w.p. min(1, p_j(d)) where
+            # p_j = softmax(filter_logits(...)) is EXACTLY the
+            # distribution non-speculative sampling draws from; on
+            # rejection, emit a sample of the residual
+            # norm(max(p_j - q, 0)) = p_j with d removed; when every
+            # draft is accepted the bonus token samples the full p at
+            # the last fed position. Emitted tokens are therefore
+            # p-distributed at every position — the output
+            # DISTRIBUTION matches draft_k=0 sampling.
+            fl = batcher.filter_logits(
+                logits_v.astype(jnp.float32), sc)       # [S, K, V]
+            fed = token_ids[:R].reshape(S, K)
+            # fed token at position j+1, scored by position j (last
+            # column pads with 0 — the host never reads its verdict)
+            nxt = jnp.concatenate(
+                [fed[:, 1:], jnp.zeros((S, 1), jnp.int32)], axis=1)
+            probs = jax.nn.softmax(fl, axis=-1)
+            p_draft = jnp.take_along_axis(
+                probs, nxt[..., None], axis=-1)[..., 0]  # [S, K]
+            u = jax.random.uniform(rng_u, (S, K))
+            acc = u < p_draft
+            # residual resample: p with the rejected draft removed
+            res_mask = jax.nn.one_hot(nxt, fl.shape[-1],
+                                      dtype=jnp.bool_)
+            tok_res = jax.random.categorical(
+                rng_res, jnp.where(res_mask, -1e9, fl),
+                axis=-1).astype(jnp.int32)
+            tok_v = jax.random.categorical(
+                rng_bonus, fl, axis=-1).astype(jnp.int32)
+            return ((tok, tok_v, tok_res, acc),) + pools
 
         return step
 
@@ -432,7 +546,11 @@ class ServingEngine:
             out, self.kv.k_pool, self.kv.v_pool = res
         sch.note_fed(plan)
         self.steps_run += 1
-        if self.draft_k:
+        tokres_np = acc_np = None
+        if self.draft_k and self.spec_sampling:
+            tok_np, tokv_np, tokres_np, acc_np = (np.asarray(t)
+                                                  for t in out)
+        elif self.draft_k:
             tok_np, tokv_np = (np.asarray(t) for t in out)
         else:
             tok_np, tokv_np = np.asarray(out), None
@@ -469,13 +587,24 @@ class ServingEngine:
             if req is not None:
                 emit(req, [int(tok_np[slot])])
         if self.draft_k:
-            from .draft import accept_length
+            from .draft import accept_length, accept_length_sampled
             for slot, toks, pos in sp.decode_entries:
                 req = sch.slots[slot]
                 if req is None:
                     continue
                 g = tokv_np[slot]
-                m = accept_length(toks, g)
+                if self.spec_sampling:
+                    # rejection-sampling acceptance: accepted drafts
+                    # re-emit the fed tokens, then the device's
+                    # residual resample (rejection at m) or its bonus
+                    # sample (every draft accepted)
+                    m = accept_length_sampled(toks, acc_np[slot])
+                    emitted = [int(t) for t in toks[1:m + 1]]
+                    emitted.append(int(g[m]) if m == len(toks) - 1
+                                   else int(tokres_np[slot][m]))
+                else:
+                    m = accept_length(toks, g)
+                    emitted = [int(t) for t in g[:m + 1]]
                 if _pmetrics._enabled:
                     smetrics.SERVING_ACCEPT_LENGTH.observe(m + 1)
                     if len(toks) > 1:
@@ -483,7 +612,7 @@ class ServingEngine:
                             "proposed").inc(len(toks) - 1)
                         smetrics.SERVING_DRAFT_TOKENS.labels(
                             "accepted").inc(m)
-                done = emit(req, [int(t) for t in g[:m + 1]])
+                done = emit(req, emitted)
                 if not done:
                     # roll back blocks whose only contents were
                     # rejected-draft K/V columns

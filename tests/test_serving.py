@@ -424,21 +424,40 @@ class TestServingSampling:
             .generate_batch(prompts, max_new_tokens=8)
         assert hot != greedy             # hot sampling leaves the argmax
 
-    def test_speculation_auto_disables_for_sampling(self):
-        """draft_k > 0 with a non-greedy strategy silently falls back
-        to plain decode (greedy-only verify) instead of refusing."""
+    def test_speculation_survives_sampling(self):
+        """draft_k > 0 with a non-greedy strategy keeps speculation on
+        via the rejection-sampling accept rule (ISSUE 11 satellite —
+        used to auto-disable) and stays seed-deterministic."""
         m = self._model()
         sc = SamplingConfig(strategy="sampling", temperature=1.5)
         eng = self._engine(m, sampling=sc, seed=3, draft_k=3)
-        assert eng.draft_k == 0
-        assert eng.speculation_disabled
-        ref = self._engine(m, sampling=sc, seed=3).generate_batch(
-            self._prompts(), max_new_tokens=6)
+        assert eng.draft_k == 3
+        assert eng.spec_sampling and not eng.speculation_disabled
         out = eng.generate_batch(self._prompts(), max_new_tokens=6)
-        assert out == ref                # identical to a draft_k=0 engine
-        # greedy engines keep speculation on
+        again = self._engine(m, sampling=sc, seed=3,
+                             draft_k=3).generate_batch(
+            self._prompts(), max_new_tokens=6)
+        assert out == again              # same seed, same tokens
+        for o in out:
+            assert len(o) == 6
+        # greedy engines keep the exact token-identity verify
         spec = self._engine(m, seed=0, draft_k=3)
         assert spec.draft_k == 3 and not spec.speculation_disabled
+        assert not spec.spec_sampling
+
+    def test_spec_sampling_top_k_one_matches_greedy(self):
+        """top_k=1 collapses the filtered distribution to the argmax:
+        p(draft) is exactly 1 or 0, so the rejection rule degenerates
+        to the greedy verify and the speculative sampling engine must
+        emit the greedy engine's exact tokens."""
+        m = self._model()
+        prompts = self._prompts()
+        greedy = self._engine(m, seed=0).generate_batch(
+            prompts, max_new_tokens=8)
+        k1 = self._engine(m, sampling=SamplingConfig(
+            strategy="sampling", top_k=1), seed=0,
+            draft_k=3).generate_batch(prompts, max_new_tokens=8)
+        assert k1 == greedy
 
     def test_config_sampling_knob(self):
         from paddle_tpu import inference

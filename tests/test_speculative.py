@@ -257,19 +257,27 @@ class TestServingSpeculative:
             pm.REGISTRY.reset()
             pm.disable()
 
-    def test_sampling_engine_auto_disables_speculation(self):
-        """Speculation verifies the GREEDY continuation only; a
-        non-greedy sampling config used to be refused outright — since
-        ISSUE 8 it auto-disables the draft path instead (the sampled
-        engine still serves, just without speculation)."""
+    def test_sampling_engine_keeps_speculation(self):
+        """Speculation used to verify the GREEDY continuation only
+        (non-greedy configs auto-disabled the draft path since
+        ISSUE 8); ISSUE 11 accepts drafts by the rejection-sampling
+        rule instead, so a plain sampling config keeps draft_k —
+        only PENALIZED sampling still auto-disables (each verify
+        position would need its own history window)."""
         from paddle_tpu.serving.batcher import SamplingConfig
         m = _model()
         eng = ServingEngine(m, max_slots=2, block_size=8,
                             max_seq_len=64, cache_dtype="float32",
                             draft_k=2,
                             sampling=SamplingConfig("sampling"))
-        assert eng.draft_k == 0
-        assert eng.speculation_disabled
+        assert eng.draft_k == 2
+        assert eng.spec_sampling and not eng.speculation_disabled
+        pen = ServingEngine(m, max_slots=2, block_size=8,
+                            max_seq_len=64, cache_dtype="float32",
+                            draft_k=2,
+                            sampling=SamplingConfig(
+                                "sampling", presence_penalty=0.5))
+        assert pen.draft_k == 0 and pen.speculation_disabled
 
     def test_inference_config_passthrough(self):
         import paddle_tpu.inference as infer

@@ -1,10 +1,11 @@
-"""Kernel-family coverage manifest generator (VERDICT r4 #5).
+"""Kernel-family coverage manifest generator (VERDICT r4 #5) + the
+kernel-autotune cache audit (ISSUE 11).
 
-Enumerates the reference's PHI kernel families (decl headers under
-`/root/reference/paddle/phi/kernels/` root + selected_rows/ sparse/
-strings/ fusion/, with `_grad` folded into its base family — jax.vjp
-plays the yaml-backward role) and resolves each against the paddle_tpu
-public surface. Writes PARITY_KERNELS.md.
+Mode 1 (default): enumerates the reference's PHI kernel families
+(decl headers under `/root/reference/paddle/phi/kernels/` root +
+selected_rows/ sparse/ strings/ fusion/, with `_grad` folded into its
+base family — jax.vjp plays the yaml-backward role) and resolves each
+against the paddle_tpu public surface. Writes PARITY_KERNELS.md.
 
 Resolution order: explicit RESOLVED map (family -> "dotted.path" or
 ("dotted.path", note)), then automatic name lookup across NAMESPACES.
@@ -13,6 +14,16 @@ MISSING.
 
 Run: python tools/kernel_coverage.py  (from the repo root; needs the
 reference checkout at /root/reference)
+
+Mode 2 (`--tuner-audit`): dump the Pallas kernel-autotune cache
+(`paddle_tpu.ops.pallas.autotune`) and flag STALE shape-buckets —
+keys the canonical CI serving workload (and any traffic this process
+already exercised) resolves configs under that hold no tuned entry.
+A fresh-hardware cache, a renamed kernel, or an engine shape change
+all surface here before they surface as silent hand-default
+performance. Exit status is non-zero when the canonical workload has
+uncovered buckets, so tests/test_kernel_autotune.py wires this
+contract into tier-1. Needs no reference checkout.
 """
 from __future__ import annotations
 
@@ -380,6 +391,81 @@ def families():
     return sorted(fams)
 
 
+# ---------------------------------------------------------------------
+# kernel-autotune cache audit (ISSUE 11 satellite)
+# ---------------------------------------------------------------------
+
+
+def tuner_smoke_workload():
+    """The canonical CI serving traffic whose paged shape-buckets the
+    seeded cache must cover: the serving_smoke engine shape (tiny GPT,
+    4 slots, block 4) with and without speculation, in fp32 AND the
+    DEFAULT bfloat16 cache dtype (lookups key by pool dtype — a
+    bf16-only gap would be exactly the silent hand-default regression
+    the audit exists to catch). Returns the `(kernel, bucket, dtype)`
+    keys the engines registered."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.serving.engine import ServingEngine
+
+    paddle.seed(1234)
+    model = GPTForGeneration(vocab_size=211, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=96,
+                             compute_dtype="float32")
+    model.eval()
+    keys = []
+    for draft_k, cache_dtype in ((0, "float32"), (2, "float32"),
+                                 (0, "bfloat16"), (2, "bfloat16")):
+        eng = ServingEngine(model, max_slots=4, block_size=4,
+                            max_seq_len=64, cache_dtype=cache_dtype,
+                            draft_k=draft_k)
+        for key in eng._kernel_buckets:
+            if key not in keys:
+                keys.append(key)
+    return keys
+
+
+def tuner_cache_audit(exercise=True):
+    """Stale-cache detection report: every requested autotune key with
+    no cached entry. `exercise=True` first drives the canonical smoke
+    workload so the audit is meaningful in a fresh process."""
+    from paddle_tpu.ops.pallas import autotune
+
+    smoke_missing = []
+    if exercise:
+        for kernel, bucket, dtype in tuner_smoke_workload():
+            key = autotune.cache_key(kernel, bucket, dtype)
+            if key not in autotune.load_cache():
+                smoke_missing.append(key)
+    req_missing, req_hit = autotune.audit()
+    return {
+        "backend": autotune.backend_key(),
+        "cache_entries": sorted(autotune.load_cache()),
+        "smoke_missing": smoke_missing,
+        "requested_missing": req_missing,
+        "requested_hit": req_hit,
+    }
+
+
+def tuner_audit_main():
+    import json
+    rep = tuner_cache_audit()
+    print(json.dumps(rep, indent=1))
+    if rep["smoke_missing"]:
+        print(f"STALE TUNER CACHE: {len(rep['smoke_missing'])} "
+              f"canonical serving bucket(s) have no tuned entry: "
+              f"{rep['smoke_missing']}", file=sys.stderr)
+        return 1
+    if rep["requested_missing"]:
+        # live-traffic misses are a warning, not a failure: the
+        # contract pins the canonical workload only (ad-hoc engine
+        # shapes legitimately miss until someone re-tunes)
+        print(f"note: {len(rep['requested_missing'])} non-canonical "
+              "bucket(s) missing", file=sys.stderr)
+    return 0
+
+
 def main():
     fams = families()
     covered, missing, excluded = [], [], []
@@ -446,4 +532,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--tuner-audit" in sys.argv[1:]:
+        sys.exit(tuner_audit_main())
     main()
