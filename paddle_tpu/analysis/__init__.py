@@ -1,0 +1,22 @@
+"""paddle_tpu.analysis — trace-discipline tooling (ISSUE 12).
+
+Three layers, one invariant: code that runs under a jax trace obeys
+the backend's idiom discipline, and the jit-cache identity of "the
+ONE jitted step" never silently breaks.
+
+* `analysis.tracelint` / `analysis.callgraph` / `analysis.rules` —
+  the AST static pass (`tools/tracelint.py` CLI, tier-1-gated).
+* `analysis.guards` — runtime sanitizers: transfer guard +
+  compile-count watchdog (+ NaN debug), suite-wide via
+  tests/conftest.py.
+* `analysis.specs` — the canonical-PartitionSpec normal form shared
+  by the runtime call sites (tp_engine, hybrid_gpt) and the
+  recompile-hazard lint rules.
+
+docs/ANALYSIS.md is the rule catalog + env contract.
+"""
+from .specs import (canonical_sharding,  # noqa: F401
+                    canonicalize_spec)
+from .tracelint import (load_allowlist, reconcile,  # noqa: F401
+                        run_tracelint)
+from . import guards  # noqa: F401

@@ -1,0 +1,236 @@
+"""Runtime trace-discipline sanitizers: transfer guard + compile-count
+watchdog (+ optional NaN debug), as one `sanitize()` context.
+
+The static pass (tracelint) proves what it can from the AST; these
+guards catch the rest AT RUNTIME, cheaply enough to stay on for the
+whole tier-1 suite (wired in tests/conftest.py) and for every
+tools/*_smoke.py run:
+
+* **Transfer guard** — jax's own implicit-transfer tripwire. Suite
+  default guards DEVICE-TO-HOST only: an implicit d2h (``float(x)``,
+  ``.item()`` on a device array mid-hot-loop) is the classic hidden
+  sync that serializes a serving step, and explicit ``device_get`` /
+  ``np.asarray`` stay allowed, so the host loops keep working.
+  Host-to-device can NOT be globally disallowed — eager ops
+  materialize scalar constants via h2d on every call (verified on
+  this jax: even ``x * 2.0`` trips) — so h2d guarding is opt-in
+  (`guard_scope=("all",)`) for targeted tests. On the CPU test
+  backend d2h transfers are free and never trip: the suite-wide
+  guard is a no-op there by construction and a real tripwire on
+  device backends. A guard error crossing the context boundary
+  increments `paddle_tpu_compile_watchdog_transfer_guard_trips_total`.
+
+* **Compile-count watchdog** — budgets per `instrumented_jit` name,
+  counted PER JIT INSTANCE (each `instrumented_jit(...)` wrapper gets
+  its own monotonically-issued id), fed by the PR 1 compile
+  accounting in `jit/functional.py`. "The ONE jitted mixed step
+  compiles exactly once per engine" becomes enforceable: budget
+  ``serving_mixed_step=1`` means each engine's OWN step wrapper may
+  compile once — N engines in one test are each allowed their one
+  compile, while a spec-mismatch second compile of any single engine
+  is a recorded violation (and fails the test via the conftest
+  fixture). Violations increment
+  `paddle_tpu_compile_watchdog_budget_exceeded_total{fn=...}`.
+
+Env contract (docs/ANALYSIS.md): ``PADDLE_TPU_GUARDS=0`` disables the
+suite-wide wiring; ``=1``/unset enables transfer guard + watchdog;
+``=nan`` additionally flips ``jax_debug_nans`` for the guarded scope.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..profiler.metrics import (COMPILE_WATCHDOG_BUDGET_EXCEEDED,
+                                TRANSFER_GUARD_TRIPS)
+
+#: per-instrumented_jit-name compile budgets PER JIT INSTANCE. Only
+#: entries with a hard one-compile contract belong here: names whose
+#: instances legitimately compile per shape signature (gen_prefill,
+#: HybridGPT.train_many's static k, ...) stay unbudgeted.
+DEFAULT_BUDGETS: Dict[str, int] = {
+    # one mixed step per engine — tests/test_serving.py's contract
+    "serving_mixed_step": 1,
+    # one fixed-shape pool copy per PagedKVCache (prefix-cache CoW)
+    "serving_prefix_cow": 1,
+}
+
+_id_counter = itertools.count(1)
+
+
+def next_instance_id():
+    """Monotonic id for one jitted wrapper (id() reuse after GC would
+    merge two instances' counts)."""
+    return next(_id_counter)
+
+
+@dataclasses.dataclass
+class BudgetViolation:
+    name: str
+    instance: int
+    count: int
+    budget: int
+
+    def __str__(self):
+        return (f"jit entry '{self.name}' (instance {self.instance}) "
+                f"compiled {self.count}x, budget {self.budget} — a "
+                "spec/signature mismatch is forcing a silent "
+                "recompile (docs/ANALYSIS.md)")
+
+
+class CompileWatchdog:
+    """Per-(name, instance) compile counting against budgets."""
+
+    def __init__(self, budgets=None):
+        self.budgets = dict(DEFAULT_BUDGETS)
+        if budgets:
+            self.budgets.update(budgets)
+        self.violations: List[BudgetViolation] = []
+        self._counts: Dict[tuple, int] = {}
+        self._violated: Dict[tuple, BudgetViolation] = {}
+        self._lock = threading.Lock()
+
+    def note_compile(self, name, instance, n=1):
+        budget = self.budgets.get(name)
+        with self._lock:
+            key = (name, instance)
+            c = self._counts[key] = self._counts.get(key, 0) + n
+            if budget is not None and c > budget:
+                # ONE violation (and one metric tick) per (name,
+                # instance) — a persistently-recompiling entry updates
+                # its count instead of repeating the same root cause
+                v = self._violated.get(key)
+                if v is None:
+                    v = BudgetViolation(name, instance, c, budget)
+                    self._violated[key] = v
+                    self.violations.append(v)
+                    COMPILE_WATCHDOG_BUDGET_EXCEEDED.labels(name).inc()
+                else:
+                    v.count = c
+
+    def check(self):
+        """Raise on any recorded violation (explicit-check style; the
+        conftest fixture prefers reading `.violations` to fail the
+        test with every violation listed)."""
+        if self.violations:
+            raise RuntimeError("; ".join(str(v)
+                                         for v in self.violations))
+
+    def consume_violations(self):
+        """Return and clear — for tests that DELIBERATELY trigger a
+        violation and must not fail their own teardown."""
+        with self._lock:
+            out, self.violations = self.violations, []
+            self._violated.clear()
+        return out
+
+
+# active watchdog stack (sanitize() nests: conftest wraps every test,
+# the smoke tools wrap their own runs inside that)
+_STACK: List[CompileWatchdog] = []
+_STACK_LOCK = threading.Lock()
+
+
+def active() -> bool:
+    return bool(_STACK)
+
+
+def current() -> Optional[CompileWatchdog]:
+    return _STACK[-1] if _STACK else None
+
+
+def notify_compile(name, instance, n=1):
+    """Called by instrumented_jit when a wrapper observes fresh
+    compiles; fans out to every active watchdog (nested scopes each
+    keep their own books)."""
+    with _STACK_LOCK:
+        watchers = list(_STACK)
+    for wd in watchers:
+        wd.note_compile(name, instance, n)
+
+
+def is_transfer_guard_error(exc) -> bool:
+    s = str(exc)
+    return "transfer" in s and ("Disallowed" in s or "disallow" in s)
+
+
+def note_exception(exc) -> bool:
+    """Count `exc` against the transfer-guard trip metric when it is
+    a guard error; returns whether it was one. `sanitize` calls this
+    for exceptions crossing its own boundary, but a pytest test
+    body's exception never unwinds through a yield fixture — the
+    conftest wiring reports it from a `pytest_runtest_makereport`
+    hook instead, so the metric moves on device backends where the
+    suite-wide d2h guard actually trips. Counting is idempotent per
+    exception OBJECT (marked on first count): one trip seen by both
+    an inner sanitize scope and the makereport hook increments
+    once."""
+    if exc is None or not is_transfer_guard_error(exc):
+        return False
+    if not getattr(exc, "_paddle_tpu_trip_counted", False):
+        try:
+            exc._paddle_tpu_trip_counted = True
+        except Exception:
+            pass
+        TRANSFER_GUARD_TRIPS.inc()
+    return True
+
+
+@contextlib.contextmanager
+def sanitize(transfer_guard="disallow", guard_scope=("device_to_host",),
+             budgets=None, nan_debug=False, watchdog=True):
+    """The combined sanitizer context. Yields the CompileWatchdog (or
+    None with watchdog=False).
+
+    `transfer_guard`: jax guard level ("disallow" | "log" | None=off).
+    `guard_scope`: transfer directions to guard — any of
+    "device_to_host", "host_to_device", "device_to_device", or "all".
+    `budgets`: overrides merged over DEFAULT_BUDGETS.
+    `nan_debug`: flip jax_debug_nans inside the scope.
+    """
+    import jax
+
+    wd = CompileWatchdog(budgets) if watchdog else None
+    scopes = {
+        "device_to_host": jax.transfer_guard_device_to_host,
+        "host_to_device": jax.transfer_guard_host_to_device,
+        "device_to_device": jax.transfer_guard_device_to_device,
+        "all": jax.transfer_guard,
+    }
+    old_nan = jax.config.jax_debug_nans
+    with contextlib.ExitStack() as stack:
+        if transfer_guard:
+            for s in guard_scope:
+                stack.enter_context(scopes[s](transfer_guard))
+        if nan_debug:
+            jax.config.update("jax_debug_nans", True)
+        if wd is not None:
+            with _STACK_LOCK:
+                _STACK.append(wd)
+        try:
+            yield wd
+        except Exception as e:
+            note_exception(e)
+            raise
+        finally:
+            if wd is not None:
+                with _STACK_LOCK:
+                    _STACK.remove(wd)
+            if nan_debug:
+                jax.config.update("jax_debug_nans", old_nan)
+
+
+def from_env(default="1"):
+    """kwargs for `sanitize()` from the PADDLE_TPU_GUARDS env knob
+    (docs/ANALYSIS.md), or None when guards are disabled."""
+    v = os.environ.get("PADDLE_TPU_GUARDS", default).strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return None
+    kw = {}
+    if v == "nan":
+        kw["nan_debug"] = True
+    return kw

@@ -1,0 +1,574 @@
+"""Tracelint rule catalog.
+
+Every rule descends from a bug this repo actually shipped and fixed
+by hand (the CHANGES.md lore notes cited per rule in
+docs/ANALYSIS.md); tracelint turns each one into a machine-checked
+invariant. Two families:
+
+* **TL1xx — trace-safety**: patterns inside functions the call-graph
+  pass proved run under a jax trace. Context-free rules (host calls,
+  state mutation, ``.item()``) apply to every traced function; the
+  dataflow-lite rules (branching on / casting a traced value) apply
+  only to TRACE ENTRIES, whose parameters are known-traced (minus
+  ``static_argnums``) — transitive callees may legitimately receive
+  static config, so flagging them would drown the signal.
+* **RH2xx — recompile hazards**: module-level checks for the
+  spec-normalization and weak-type pitfalls that made a second,
+  silent compile of "the ONE jitted step". These share their
+  normal-form logic with the runtime through
+  ``analysis.specs.literal_is_canonical``.
+
+The analysis is deliberately an UNDER-approximation (it only fires on
+patterns it can prove are inside a trace) — precision over recall, so
+`tools/tracelint.py --check` stays a hard CI gate with a near-empty
+allowlist.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+from . import specs as _specs
+from .callgraph import FunctionInfo, ModuleIndex, _dotted
+
+#: rule id -> one-line summary (the meta-test asserts each id is
+#: documented in docs/ANALYSIS.md)
+RULES = {
+    "TL101": "host call inside a traced function (time.*, np.random, "
+             "os.environ/getenv, open, input)",
+    "TL102": "host materialization of a traced value (.item(), "
+             "float()/int()/bool() on a traced argument)",
+    "TL103": "python branch (if/while) on a traced value",
+    "TL104": "mutation of closure/global state inside a traced "
+             "function",
+    "TL105": "unhashable (list/dict/set) static argument to a jitted "
+             "callable",
+    "TL106": "donated buffer read after the donating call",
+    "RH201": "non-canonical PartitionSpec (trailing None / singleton "
+             "tuple) in a jit-boundary sharding",
+    "RH202": "all-None PartitionSpec where jax's cache key wants P()",
+    "RH203": "bare python number passed to a jitted callable "
+             "(weak-type literal: a dtype-flipping caller recompiles)",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    relpath: str
+    qualname: str
+    lineno: int
+    message: str
+
+    @property
+    def key(self):
+        """Allowlist identity: stable across line-number churn."""
+        return f"{self.rule}:{self.relpath}:{self.qualname}"
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------- trace rules
+
+#: module roots whose calls are host-only inside a trace
+_HOST_MODULES = {
+    "time": ("time", "perf_counter", "monotonic", "sleep",
+             "process_time", "time_ns", "perf_counter_ns"),
+    "random": None,          # all of python stdlib random
+    "np.random": None,
+    "numpy.random": None,
+}
+_HOST_BUILTINS = {"open", "input"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "setdefault", "remove", "discard", "clear",
+             "appendleft", "write"}
+
+
+def _resolved(module: ModuleIndex, node):
+    return module.resolve_alias(_dotted(node))
+
+
+def _is_host_call(module, call):
+    name = _resolved(module, call.func)
+    if name is None:
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in _HOST_BUILTINS:
+            return call.func.id
+        return None
+    if name in _HOST_BUILTINS:
+        return name
+    if name in ("os.getenv", "os.environb.get"):
+        return name
+    if name.startswith("os.environ."):
+        return name
+    for root, members in _HOST_MODULES.items():
+        rootdot = root + "."
+        if name == root or name.startswith(rootdot):
+            if members is None:
+                return name
+            tail = name[len(rootdot):]
+            if tail in members:
+                return name
+    return None
+
+
+def _fn_body(fn: FunctionInfo):
+    if isinstance(fn.node, ast.Lambda):
+        return [fn.node.body]
+    return fn.node.body
+
+
+def _walk_own(fn: FunctionInfo):
+    """Walk a function's body WITHOUT descending into nested function
+    definitions (they are separate FunctionInfos and get their own
+    pass if traced) — including nested defs that sit directly in the
+    body statement list."""
+    stack = list(_fn_body(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_names(fn: FunctionInfo):
+    """Names that are local to the function (params + anything bound
+    in its body, python scoping rules minus global/nonlocal)."""
+    local = set(fn.params)
+    node = fn.node
+    if isinstance(node, ast.Lambda):
+        a = node.args
+        return local | {p.arg for p in a.posonlyargs + a.args
+                        + a.kwonlyargs} \
+            | ({a.vararg.arg} if a.vararg else set()) \
+            | ({a.kwarg.arg} if a.kwarg else set())
+    a = node.args
+    local |= {p.arg for p in a.kwonlyargs}
+    if a.vararg:
+        local.add(a.vararg.arg)
+    if a.kwarg:
+        local.add(a.kwarg.arg)
+    declared = set()
+    for n in _walk_own(fn):
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            declared.update(n.names)
+        elif isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            local.add(n.id)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    local.add(t.id)
+        elif isinstance(n, (ast.comprehension,)):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    local.add(t.id)
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    for t in ast.walk(item.optional_vars):
+                        if isinstance(t, ast.Name):
+                            local.add(t.id)
+    local |= set(fn.nested)
+    return local - declared
+
+
+def _traced_params(fn: FunctionInfo):
+    """Parameter names known to carry traced values: trace entries
+    only, minus static_argnums, minus leading params bound by
+    `functools.partial` at the trace root (partial-bound args are
+    closed over host-side — the `jit(partial(init_params, cfg))`
+    idiom), and minus `self`/`cls`."""
+    if not fn.trace_entry:
+        return set()
+    params = [p for p in fn.params if p not in ("self", "cls")]
+    return {p for i, p in enumerate(params)
+            if i not in fn.static_argnums and i >= fn.partial_bound}
+
+
+#: attribute reads that are trace-time STATIC on a traced array —
+#: exactly the exemption set docs/ANALYSIS.md documents for TL102/103
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _mentions_bare(expr, names):
+    """Does `expr` reference any of `names` as a traced VALUE — a bare
+    load, or an attribute/method that reads the value (`x.any()`,
+    `x.sum()`)? Only the static metadata attrs (`x.shape` / `x.ndim` /
+    `x.dtype` / `x.size`) are exempt: those are compile-time facts and
+    must not trip the traced-value rules."""
+    hits = []
+
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, node):
+            if isinstance(node.value, ast.Name):
+                if node.value.id in names \
+                        and node.attr not in _STATIC_ATTRS:
+                    hits.append(node.value.id)
+                return
+            self.generic_visit(node)
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load) and node.id in names:
+                hits.append(node.id)
+
+    V().visit(expr)
+    return hits
+
+
+def _is_contextmanager(fn: FunctionInfo):
+    """@contextlib.contextmanager functions get a TL104 pass: their
+    enter/exit push/pop pairs are SYMMETRIC trace-time scoping (the
+    no_grad / functional_rng idiom), not state leaking into the
+    compiled graph."""
+    node = fn.node
+    for dec in getattr(node, "decorator_list", ()):
+        name = _dotted(dec if not isinstance(dec, ast.Call)
+                       else dec.func)
+        if name and name.rsplit(".", 1)[-1] in (
+                "contextmanager", "asynccontextmanager"):
+            return True
+    return False
+
+
+def _memo_read_names(fn: FunctionInfo, mutation_counts):
+    """Names whose mutations follow the MEMO-CACHE idiom: the function
+    also READS the name (`cache.get(k)` / `k in cache` /
+    `return cache[...]`) beyond the mutation sites themselves, so the
+    write is an idempotent-per-key trace-time memoization (the
+    _SPLASH_CACHE / kernel_config pattern), not per-call state."""
+    loads = {}
+    for n in _walk_own(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in mutation_counts:
+            loads[n.id] = loads.get(n.id, 0) + 1
+    # each mutation site itself contributes exactly one Load of the
+    # base name (`X.append(...)` / `X[k] = v` both load X)
+    return {name for name, c in loads.items()
+            if c > mutation_counts[name]}
+
+
+def check_traced_function(fn: FunctionInfo) -> Iterator[Finding]:
+    """All TL1xx checks for one traced function."""
+    module = fn.module
+    rel = module.relpath
+
+    def finding(rule, node, msg):
+        return Finding(rule, rel, fn.qualname,
+                       getattr(node, "lineno", fn.lineno), msg)
+
+    local = _local_names(fn)
+    traced = _traced_params(fn)
+    cm_exempt = _is_contextmanager(fn)
+
+    # pre-pass: TL104 candidate mutation counts per free name, for the
+    # memo-idiom exemption
+    mutation_counts = {}
+    for node in _walk_own(fn):
+        name = _tl104_target(node, local)
+        if name:
+            mutation_counts[name] = mutation_counts.get(name, 0) + 1
+    memo_names = _memo_read_names(fn, mutation_counts) \
+        if mutation_counts else set()
+
+    for node in _walk_own(fn):
+        # ---- TL101: host calls
+        if isinstance(node, ast.Call):
+            host = _is_host_call(module, node)
+            if host:
+                yield finding(
+                    "TL101", node,
+                    f"host call `{host}(...)` runs at TRACE time "
+                    "(frozen into the compiled graph, or a sync): "
+                    "hoist it out of the traced function")
+            # ---- TL102: .item()
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                yield finding(
+                    "TL102", node,
+                    ".item() on a traced value is a host sync and a "
+                    "tracer error under jit — return the array and "
+                    "read it host-side")
+            # ---- TL102: float()/int()/bool() on traced params
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and len(node.args) == 1 and traced:
+                hits = _mentions_bare(node.args[0], traced)
+                if hits:
+                    yield finding(
+                        "TL102", node,
+                        f"{node.func.id}() materializes traced "
+                        f"argument `{hits[0]}` on the host — use jnp "
+                        "casts and keep the value on device")
+        # ---- TL103: python branching on traced values
+        if isinstance(node, (ast.If, ast.While)) and traced:
+            hits = _mentions_bare(node.test, traced)
+            if hits:
+                yield finding(
+                    "TL103", node,
+                    f"python `{type(node).__name__.lower()}` on "
+                    f"traced argument `{hits[0]}` — the branch "
+                    "freezes at trace time (or raises); use "
+                    "jnp.where / lax.cond / lax.select")
+        if isinstance(node, ast.IfExp) and traced:
+            hits = _mentions_bare(node.test, traced)
+            if hits:
+                yield finding(
+                    "TL103", node,
+                    f"conditional expression on traced argument "
+                    f"`{hits[0]}` — use jnp.where / lax.select")
+        # ---- TL104: mutating non-local state
+        if not cm_exempt:
+            name = _tl104_target(node, local)
+            if name and name not in memo_names:
+                if isinstance(node, ast.Call):
+                    what = f"`{name}.{node.func.attr}(...)` mutates"
+                else:
+                    what = (f"subscript/augmented assign into "
+                            f"`{name}` mutates")
+                yield finding(
+                    "TL104", node,
+                    f"{what} closure/global state inside the trace "
+                    "— it runs ONCE at trace time, not per call; "
+                    "return the value instead")
+
+
+def _tl104_target(node, local):
+    """The free (non-local) name a node mutates, or None: mutator
+    method calls (`X.append(...)`) and subscript/augmented assigns
+    (`X[k] = v`)."""
+    if isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATORS \
+            and isinstance(node.func.value, ast.Name) \
+            and node.func.value.id not in local \
+            and node.func.value.id not in ("self", "cls"):
+        return node.func.value.id
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id not in local \
+                    and t.value.id not in ("self", "cls"):
+                return t.value.id
+    return None
+
+
+# ----------------------------------------------------- call-site rules
+
+
+def check_jit_call_sites(module: ModuleIndex) -> Iterator[Finding]:
+    """TL105/TL106/RH203 — rules at CALLS OF jitted handles recorded
+    by the call-graph pass (`h = jax.jit(f, static_argnums=...,
+    donate_argnums=...)` then `h(...)`)."""
+    if not module.jit_handles:
+        return
+    for qual, fn in list(module.functions.items()):
+        if not isinstance(fn.node,
+                          (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from _check_sites_in(module, fn)
+
+
+def _handle_for_call(module, call):
+    if isinstance(call.func, ast.Name):
+        return module.jit_handles.get(call.func.id)
+    if isinstance(call.func, ast.Attribute) \
+            and isinstance(call.func.value, ast.Name) \
+            and call.func.value.id in ("self", "cls"):
+        return module.jit_handles.get(f"self.{call.func.attr}")
+    return None
+
+
+def _check_sites_in(module, fn) -> Iterator[Finding]:
+    rel = module.relpath
+    body = list(fn.node.body)
+    for node in _walk_own(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        h = _handle_for_call(module, node)
+        if h is None:
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            continue                       # positions unknowable
+        # ---- TL105: unhashable static args
+        for i in h.static_argnums:
+            if i < len(node.args) and isinstance(
+                    node.args[i], (ast.List, ast.Dict, ast.Set)):
+                yield Finding(
+                    "TL105", rel, fn.qualname, node.lineno,
+                    f"static arg {i} of `{h.target}` is a "
+                    f"{type(node.args[i]).__name__.lower()} literal "
+                    "— unhashable static args defeat the jit cache "
+                    "(the PR 4 conv-padding-list bug): pass a tuple")
+        # ---- RH203: weak-type scalar literals as traced args
+        for i, a in enumerate(node.args):
+            if i in h.static_argnums:
+                continue
+            if isinstance(a, ast.Constant) \
+                    and isinstance(a.value, (int, float)) \
+                    and not isinstance(a.value, bool):
+                yield Finding(
+                    "RH203", rel, fn.qualname, node.lineno,
+                    f"bare python number `{a.value}` passed to "
+                    f"jitted `{h.target}` traces as a WEAK-typed "
+                    "scalar: any caller passing a concrete-dtype "
+                    "value compiles a second executable — wrap in "
+                    "jnp.asarray(..., dtype) or make it static")
+        # ---- TL106: donated-buffer reuse
+        donated = [(i, _dotted(node.args[i]))
+                   for i in h.donate_argnums if i < len(node.args)]
+        donated = [(i, d) for i, d in donated if d is not None]
+        if donated:
+            yield from _donation_reuse(rel, fn, body, node, h, donated)
+
+
+def _donation_reuse(rel, fn, body, call, handle, donated):
+    """Scan statements after the donating call for loads of the
+    donated names (stopping per-name at rebinding)."""
+    stmt = getattr(call, "_tracelint_parent", None)
+    # rebinding via the call's own assignment targets clears the name
+    rebound = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            for sub in ast.walk(t):
+                d = _dotted(sub) if isinstance(
+                    sub, (ast.Name, ast.Attribute)) else None
+                if d:
+                    rebound.add(d)
+    live = {d for _, d in donated if d not in rebound}
+    if not live:
+        return
+    # statements strictly after the donating one, same block only
+    # (best effort — nested blocks after it are included via walk)
+    try:
+        idx = body.index(stmt)
+    except ValueError:
+        return
+    for later in body[idx + 1:]:
+        for sub in ast.walk(later):
+            if isinstance(sub, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(sub, "ctx", None),
+                                   ast.Store):
+                d = _dotted(sub)
+                if d in live:
+                    live.discard(d)
+        for sub in ast.walk(later):
+            if isinstance(sub, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(sub, "ctx", None),
+                                   ast.Load):
+                d = _dotted(sub)
+                if d in live:
+                    yield Finding(
+                        "TL106", rel, fn.qualname, sub.lineno,
+                        f"`{d}` was DONATED to `{handle.target}` "
+                        f"(line {call.lineno}) and read again here — "
+                        "donated buffers alias the outputs; rebind "
+                        "the result or drop donate_argnums")
+                    live.discard(d)
+        if not live:
+            return
+
+
+# ------------------------------------------------- recompile-hazard pass
+
+_SHARDING_KWARGS = ("out_shardings", "in_shardings")
+
+
+def _p_literal_entries(call):
+    """A `P(...)`/`PartitionSpec(...)` call -> entry list for
+    `specs.literal_is_canonical`, or None if not a P-literal."""
+    name = _dotted(call.func)
+    if name is None or name.rsplit(".", 1)[-1] not in (
+            "P", "PartitionSpec"):
+        return None
+    entries = []
+    for a in call.args:
+        if isinstance(a, ast.Constant):
+            entries.append(a.value)
+        elif isinstance(a, ast.Tuple) and all(
+                isinstance(e, ast.Constant) for e in a.elts):
+            entries.append(tuple(e.value for e in a.elts))
+        else:
+            entries.append(_specs.OPAQUE)
+    return entries
+
+
+def _canonical_wrapped(parents):
+    """True when one of the enclosing calls is canonicalize_spec /
+    canonical_sharding — the literal is normalized at runtime."""
+    for p in parents:
+        if isinstance(p, ast.Call):
+            name = _dotted(p.func)
+            if name and name.rsplit(".", 1)[-1] in (
+                    "canonicalize_spec", "canonical_sharding"):
+                return True
+    return False
+
+
+def check_recompile_hazards(module: ModuleIndex) -> Iterator[Finding]:
+    """RH201/RH202: non-canonical P literals at JIT-BOUNDARY sharding
+    positions — `out_shardings=`/`in_shardings=` kwargs and
+    `NamedSharding(...)` constructor args — unless wrapped in
+    canonicalize_spec/canonical_sharding. (in_specs/out_specs of
+    shard_maps USED INSIDE a trace carry no cache identity, so they
+    are deliberately out of scope.)"""
+    rel = module.relpath
+    contexts = []          # (P-call, enclosing qual, wrapping parents)
+
+    def qual_at(node):
+        best = None
+        for f in module.functions.values():
+            n = f.node
+            if getattr(n, "lineno", 1) <= node.lineno <= getattr(
+                    n, "end_lineno", getattr(n, "lineno", 1)):
+                if best is None or n.lineno > best.node.lineno:
+                    best = f
+        return best.qualname if best else "<module>"
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            tail = name.rsplit(".", 1)[-1] if name else ""
+            roots = []
+            if tail == "NamedSharding" and len(node.args) >= 2:
+                roots.append(node.args[1])
+            for kw in node.keywords:
+                if kw.arg in _SHARDING_KWARGS:
+                    roots.append(kw.value)
+            for root in roots:
+                for sub, parents in _walk_with_parents(root):
+                    if isinstance(sub, ast.Call):
+                        entries = _p_literal_entries(sub)
+                        if entries is not None and \
+                                not _canonical_wrapped(parents):
+                            contexts.append((sub, entries))
+    for sub, entries in contexts:
+        ok, why = _specs.literal_is_canonical(entries)
+        if ok:
+            continue
+        rule = "RH202" if entries and all(
+            e is None for e in entries) else "RH201"
+        yield Finding(rule, rel, qual_at(sub), sub.lineno,
+                      f"jit-boundary spec P({_fmt_entries(entries)}) "
+                      f"is not canonical: {why}")
+
+
+def _fmt_entries(entries):
+    return ", ".join(
+        "?" if e is _specs.OPAQUE else repr(e) for e in entries)
+
+
+def _walk_with_parents(root):
+    stack = [(root, ())]
+    while stack:
+        node, parents = stack.pop()
+        yield node, parents
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, parents + (node,)))

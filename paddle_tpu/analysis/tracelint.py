@@ -1,0 +1,167 @@
+"""Tracelint driver: run the call-graph + rule passes over a package
+tree, reconcile against the allowlist, render human/JSON reports.
+
+CI semantics (`tools/tracelint.py --check`, wired into tier-1 via
+tests/test_static_analysis.py):
+
+* a finding whose key is NOT in the allowlist -> **exit 1** (new
+  violation: fix it, don't allowlist it);
+* a key with MORE findings than its allowlisted count -> **exit 1**
+  (regression against the burn-down);
+* fewer findings than allowlisted -> exit 0 with a burn-down nudge
+  (shrink the count — the allowlist only ever ratchets DOWN);
+* every allowlist entry carries a one-line justification, rendered in
+  the report so the debt stays visible.
+
+The allowlist lives next to the CLI (tools/tracelint_allowlist.json)
+and starts as small as possible — see docs/ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from . import callgraph, rules
+
+
+def run_tracelint(root, package_name=None) -> List[rules.Finding]:
+    """All findings for the package at `root` (e.g. .../paddle_tpu),
+    sorted by (path, line)."""
+    index, resolver = callgraph.build_traced_set(root, package_name)
+    findings: List[rules.Finding] = []
+    for fn in resolver.traced_functions():
+        findings.extend(rules.check_traced_function(fn))
+    for module in index.modules.values():
+        findings.extend(rules.check_jit_call_sites(module))
+        findings.extend(rules.check_recompile_hazards(module))
+    # one finding per (key, line): the same violation reached through
+    # two trace roots must not double-count against the allowlist
+    seen = set()
+    out = []
+    for f in sorted(findings,
+                    key=lambda f: (f.relpath, f.lineno, f.rule)):
+        k = (f.key, f.lineno)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+# ------------------------------------------------------------ allowlist
+
+
+def load_allowlist(path):
+    """{key: {"count": int, "reason": str}} from the JSON allowlist
+    file ({} when absent)."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for e in data.get("entries", []):
+        out[e["key"]] = {"count": int(e.get("count", 1)),
+                         "reason": e.get("reason", "")}
+    return out
+
+
+def reconcile(findings, allowlist):
+    """Split findings into (new, allowed) and compute burn-down /
+    regression state per allowlist key.
+
+    Returns a report dict: `new` (finding dicts), `allowed`, `over`
+    ({key: (count, budget)}), `burndown` ({key: (count, budget)}),
+    `ok` (bool: no new findings, no over-budget keys)."""
+    by_key: Dict[str, List[rules.Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    new, allowed, over, burndown = [], [], {}, {}
+    for key, fs in by_key.items():
+        entry = allowlist.get(key)
+        if entry is None:
+            new.extend(fs)
+            continue
+        allowed.extend(fs)
+        if len(fs) > entry["count"]:
+            over[key] = (len(fs), entry["count"])
+        elif len(fs) < entry["count"]:
+            burndown[key] = (len(fs), entry["count"])
+    for key, entry in allowlist.items():
+        if key not in by_key:
+            burndown[key] = (0, entry["count"])
+    return {
+        "new": [f.to_dict() for f in new],
+        "allowed": [f.to_dict() for f in allowed],
+        "over": over,
+        "burndown": burndown,
+        "ok": not new and not over,
+    }
+
+
+# -------------------------------------------------------------- reports
+
+
+def render_human(report, allowlist):
+    lines = []
+    for f in report["new"]:
+        lines.append(f"{f['relpath']}:{f['lineno']}: {f['rule']} "
+                     f"[{f['qualname']}] {f['message']}")
+    if report["allowed"]:
+        lines.append("")
+        lines.append(f"allowlisted ({len(report['allowed'])}):")
+        for f in report["allowed"]:
+            reason = allowlist.get(
+                f"{f['rule']}:{f['relpath']}:{f['qualname']}",
+                {}).get("reason", "")
+            lines.append(
+                f"  {f['relpath']}:{f['lineno']}: {f['rule']} "
+                f"[{f['qualname']}]" + (f" — {reason}" if reason
+                                        else ""))
+    for key, (n, budget) in sorted(report["over"].items()):
+        lines.append(f"REGRESSION {key}: {n} findings > allowlisted "
+                     f"{budget}")
+    for key, (n, budget) in sorted(report["burndown"].items()):
+        lines.append(f"burn-down {key}: {n} findings < allowlisted "
+                     f"{budget} — shrink the allowlist count")
+    n_new = len(report["new"])
+    lines.append("")
+    lines.append(
+        f"tracelint: {n_new} new finding(s), "
+        f"{len(report['allowed'])} allowlisted, "
+        f"{len(report['over'])} over budget"
+        + (" — OK" if report["ok"] else " — FAIL"))
+    return "\n".join(lines)
+
+
+def main(argv=None, root=None, allowlist_path=None):
+    """CLI body shared with tools/tracelint.py. Exit 0 iff --check
+    passes (no new findings, no over-budget keys)."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="tracelint",
+        description="AST trace-discipline lint for paddle_tpu "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: exit 1 on new/over-budget findings")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--root", default=root,
+                    help="package directory to lint")
+    ap.add_argument("--allowlist", default=allowlist_path,
+                    help="allowlist JSON path")
+    args = ap.parse_args(argv)
+
+    pkg_root = args.root
+    if pkg_root is None:
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    findings = run_tracelint(pkg_root)
+    allowlist = load_allowlist(args.allowlist)
+    report = reconcile(findings, allowlist)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_human(report, allowlist))
+    if args.check:
+        return 0 if report["ok"] else 1
+    return 0

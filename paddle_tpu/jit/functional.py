@@ -27,34 +27,46 @@ def instrumented_jit(fn, name, **jit_kwargs):
     enabled, calls that trigger a fresh trace+compile (detected via the
     jitted callable's compilation-cache size) increment
     paddle_tpu_jit_compiles_total{fn=name} and add their wall time to
-    paddle_tpu_jit_compile_seconds_total{fn=name}. Disabled, the wrapper
-    is one branch over the plain jitted call."""
+    paddle_tpu_jit_compile_seconds_total{fn=name}. When an
+    `analysis.guards` sanitize scope is active, fresh compiles are also
+    reported to its compile-count watchdog keyed by (name, THIS
+    wrapper) — so per-instance one-compile budgets hold even with
+    metrics off. Neither active, the wrapper is one branch over the
+    plain jitted call."""
+    from ..analysis import guards as _guards
     jitted = jax.jit(fn, **jit_kwargs)
     cache_size = getattr(jitted, "_cache_size", None)
+    instance = _guards.next_instance_id()
 
     @functools.wraps(fn)
     def call(*args, **kwargs):
-        if not _metrics._enabled or cache_size is None:
+        timed = _metrics._enabled
+        if (not timed and not _guards.active()) or cache_size is None:
             return jitted(*args, **kwargs)
         try:
             before = cache_size()
         except Exception:
             return jitted(*args, **kwargs)
-        t0 = time.perf_counter()
+        # watchdog-only tracking (metrics off) skips the clock reads:
+        # two cache-size probes per call is its whole per-step cost
+        t0 = time.perf_counter() if timed else 0.0
         out = jitted(*args, **kwargs)
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0 if timed else 0.0
         try:
             compiled = cache_size() - before
         except Exception:
             compiled = 0
         if compiled > 0:
-            _metrics.JIT_COMPILES.labels(name).inc(compiled)
-            # dt spans trace+compile+first execution — the honest cost
-            # of hitting an uncompiled signature
-            _metrics.JIT_COMPILE_SECONDS.labels(name).inc(dt)
+            if timed:
+                _metrics.JIT_COMPILES.labels(name).inc(compiled)
+                # dt spans trace+compile+first execution — the honest
+                # cost of hitting an uncompiled signature
+                _metrics.JIT_COMPILE_SECONDS.labels(name).inc(dt)
+            _guards.notify_compile(name, instance, compiled)
         return out
 
     call._jitted = jitted
+    call._watchdog_instance = instance
     return call
 
 

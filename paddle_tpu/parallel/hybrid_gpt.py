@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis.specs import canonical_sharding
 from ..jit.functional import instrumented_jit
 from ..profiler import metrics as _metrics
 from . import shard_map as _shard_map
@@ -837,7 +838,10 @@ def opt_specs(cfg: GPTConfig, pspecs):
     def per_leaf(spec):
         if cfg.zero_stage >= 1:
             axes = _world_axes(cfg)
-            s = P(axes if axes else None)
+            # canonical form: P(), not P(None) — these leaves are
+            # pinned as step out_shardings, where the two are
+            # DIFFERENT jit-cache keys (analysis.specs, rule RH202)
+            s = P(axes) if axes else P()
             return {"m": s, "v": s}
         return {"m": spec, "v": spec}
     return jax.tree.map(per_leaf, pspecs,
@@ -1086,8 +1090,13 @@ class HybridGPT:
         # GSPMD otherwise infers spec-different-but-placement-identical
         # shardings for some leaves (P('pp', None) vs P('pp', 'mp') at
         # mp=1), so the SECOND step — fed the first step's outputs —
-        # missed the jit cache and every trainer paid a double compile
-        cn = lambda s: NamedSharding(mesh, s)      # noqa: E731
+        # missed the jit cache and every trainer paid a double compile.
+        # Specs go through analysis.specs.canonicalize_spec — the one
+        # normal form init()/shard_data() ALSO place with, so the
+        # out-pin and the initial device_put can never disagree on
+        # cache identity (the repeated PR 7/8/10 hand-normalizations,
+        # single-sourced).
+        cn = lambda s: canonical_sharding(mesh, s)  # noqa: E731
         is_spec = lambda x: isinstance(x, P)       # noqa: E731
         out_shard = (jax.tree.map(cn, self.pspecs, is_leaf=is_spec),
                      jax.tree.map(cn, self.ospecs, is_leaf=is_spec),
@@ -1138,7 +1147,7 @@ class HybridGPT:
         # costs a transient full-params footprint, acceptable until a
         # partitionable-threefry jax is the floor.
         p_specs = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), self.pspecs,
+            lambda s: canonical_sharding(self.mesh, s), self.pspecs,
             is_leaf=lambda x: isinstance(x, P))
         p_full = jax.jit(functools.partial(init_params, self.cfg))(key)
         p_init = jax.device_put(p_full, p_specs)
@@ -1146,12 +1155,13 @@ class HybridGPT:
             o_init = jax.jit(
                 functools.partial(init_opt_state, self.cfg),
                 out_shardings=jax.tree.map(
-                    lambda s: NamedSharding(self.mesh, s), self.ospecs,
+                    lambda s: canonical_sharding(self.mesh, s),
+                    self.ospecs,
                     is_leaf=lambda x: isinstance(x, P)))(p_init)
         return p_init, o_init
 
     def shard_data(self, tokens, labels):
-        ds = NamedSharding(self.mesh, self._data_spec)
+        ds = canonical_sharding(self.mesh, self._data_spec)
         return (jax.device_put(tokens, ds), jax.device_put(labels, ds))
 
     def loss(self, params, tokens, labels):

@@ -540,6 +540,17 @@ KERNEL_AUTOTUNE_REJECTED_PARITY = REGISTRY.counter(
     "Kernel-variant candidates refused admission by the XLA-oracle "
     "parity gate (or by failing to run at all)", ("kernel",))
 
+# ---- trace-discipline guards (ISSUE 12): analysis.guards ------------
+COMPILE_WATCHDOG_BUDGET_EXCEEDED = REGISTRY.counter(
+    "paddle_tpu_compile_watchdog_budget_exceeded_total",
+    "Jit instances that compiled past their per-instance budget under "
+    "analysis.guards.sanitize (a spec/signature mismatch forcing a "
+    "silent recompile of a one-compile entry)", ("fn",))
+TRANSFER_GUARD_TRIPS = REGISTRY.counter(
+    "paddle_tpu_compile_watchdog_transfer_guard_trips_total",
+    "jax transfer-guard errors (implicit device transfers) observed "
+    "crossing an analysis.guards.sanitize boundary")
+
 # ---- MoE routing (ISSUE 10): shared by the hybrid trainer
 # ("train" path) and the serving mixed step ("serving" path) -----------
 MOE_EXPERT_TOKENS = REGISTRY.counter(

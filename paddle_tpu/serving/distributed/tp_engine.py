@@ -103,20 +103,21 @@ class TPServingEngine(ServingEngine):
 
     # ------------------------------------------------------- sharding
     def _pool_spec(self):
-        # head axis (index 3) of the [L, NB, BS, H, Dh] pools; trailing
-        # None deliberately OMITTED — jax normalizes step-output specs
-        # by trimming trailing Nones, and a spec-different-but-
-        # placement-identical initial device_put would make the SECOND
-        # step miss the jit cache and recompile (the PR 7 hybrid-step
-        # lesson, re-learned here by contract test). Under the 2-D MoE
-        # mesh the same spec replicates the pools over ep. At tp=1 the
-        # normalization ALSO drops the size-1 "mp" entry entirely, so
-        # pre-normalize to P() — otherwise an EP-only mesh pays the
-        # same second-step recompile (caught by tools/moe_smoke.py).
+        # head axis (index 3) of the [L, NB, BS, H, Dh] pools, in the
+        # CANONICAL normal form (analysis.specs): the jit cache keys on
+        # input shardings, so the spec the initial device_put places
+        # the pools with must be byte-identical to the spec the step's
+        # outputs carry — trailing Nones trimmed (the PR 8 lesson) and
+        # the size-1 "mp" entry dropped to P() at tp=1 (the PR 10
+        # EP-only-mesh lesson, caught by tools/moe_smoke.py) — or the
+        # SECOND step pays a silent full recompile. canonicalize_spec
+        # is the one shared definition of that form (the recompile-
+        # hazard lint rule RH201/RH202 checks against the same logic).
+        # Under the 2-D MoE mesh the same spec replicates over ep.
         from jax.sharding import PartitionSpec as P
-        if self.tensor_parallel == 1:
-            return P()
-        return P(None, None, None, "mp")
+
+        from ...analysis.specs import canonicalize_spec
+        return canonicalize_spec(P(None, None, None, "mp"), self.mesh)
 
     def _array_specs(self):
         """One PartitionSpec per entry of `self._arrays` (the order

@@ -10,12 +10,16 @@ observability off pays one branch per step.
 from __future__ import annotations
 
 from ..profiler.metrics import (REGISTRY, exponential_buckets,
+                                COMPILE_WATCHDOG_BUDGET_EXCEEDED,
                                 MOE_AUX_LOSS, MOE_DROPPED_TOKENS,
                                 MOE_EXPERT_TOKENS,
-                                MOE_EXPERT_UTILIZATION)  # noqa: F401
+                                MOE_EXPERT_UTILIZATION,
+                                TRANSFER_GUARD_TRIPS)  # noqa: F401
 # (the MoE routing metrics live in profiler.metrics because the hybrid
-# trainer records them too — re-exported here so the serving contract
-# below registers them by import, like every other serving metric)
+# trainer records them too, and the ISSUE 12 guard counters because
+# analysis.guards watches TRAINING jits as much as serving ones —
+# re-exported here so the serving contract below registers them by
+# import, like every other serving metric)
 
 # 100us .. ~100s in x4 steps: TTFT on a loaded queue can sit behind
 # whole prefill rounds, far above the dispatch-scale default buckets
@@ -139,6 +143,11 @@ CONTRACT_METRICS = (
     "paddle_tpu_moe_dropped_tokens_total",
     "paddle_tpu_moe_expert_utilization",
     "paddle_tpu_moe_aux_loss",
+    # trace-discipline guards (ISSUE 12): compile-budget violations +
+    # transfer-guard trips observed by analysis.guards.sanitize — the
+    # serving one-compile contract's runtime tripwire
+    "paddle_tpu_compile_watchdog_budget_exceeded_total",
+    "paddle_tpu_compile_watchdog_transfer_guard_trips_total",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

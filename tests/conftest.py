@@ -41,3 +41,38 @@ def _seed():
     import paddle_tpu as paddle
     paddle.seed(1234)
     yield
+
+
+# Trace-discipline guards (ISSUE 12, docs/ANALYSIS.md): every test
+# runs under analysis.guards.sanitize — jax's device-to-host transfer
+# guard (a no-op on this CPU backend by construction, a real implicit-
+# sync tripwire on device backends) plus the compile-count watchdog:
+# any one-compile-contract jit instance (serving_mixed_step, ...)
+# that compiles a second time FAILS the test right here, instead of
+# surfacing as a review finding two PRs later. PADDLE_TPU_GUARDS=0
+# opts out; =nan additionally flips jax_debug_nans.
+@pytest.fixture(autouse=True)
+def _guards():
+    from paddle_tpu.analysis import guards
+    kw = guards.from_env()
+    if kw is None:
+        yield None
+        return
+    with guards.sanitize(**kw) as wd:
+        yield wd
+    if wd is not None and wd.violations:
+        pytest.fail("compile watchdog: "
+                    + "; ".join(str(v) for v in wd.violations))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    # a test-body exception never unwinds through the _guards yield
+    # fixture (pytest catches it in the call phase), so transfer-guard
+    # trips are counted HERE, off the test report's excinfo
+    outcome = yield
+    if call.when == "call" and call.excinfo is not None:
+        from paddle_tpu.analysis import guards
+        if guards.from_env() is not None:     # PADDLE_TPU_GUARDS=0
+            guards.note_exception(call.excinfo.value)
+    return outcome

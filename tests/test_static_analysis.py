@@ -1,0 +1,60 @@
+"""Tier-1 wiring for the tracelint CLI (ISSUE 12) — the same pattern
+as tools/kernel_coverage.py --tuner-audit: the shipped tree must lint
+clean (no new findings over the allowlist), fast, and the gate must
+actually FAIL when a forbidden pattern is injected.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "tracelint.py")
+
+
+@pytest.mark.skipif(os.environ.get("PADDLE_TPU_TRACELINT") == "0",
+                    reason="PADDLE_TPU_TRACELINT=0")
+def test_shipped_tree_lints_clean_under_30s():
+    """`tools/tracelint.py --check` exits 0 on the shipped tree, well
+    inside the 30s budget (the pass itself is pure-AST; the package
+    import dominates the wall time)."""
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, CLI, "--check"],
+                          capture_output=True, text=True, timeout=120)
+    dt = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert dt < 30, f"tracelint took {dt:.1f}s (budget 30s)"
+    assert "OK" in proc.stdout
+
+
+def test_json_report_shape():
+    proc = subprocess.run([sys.executable, CLI, "--json"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert set(rep) >= {"new", "allowed", "over", "burndown", "ok"}
+    assert rep["ok"] is True and rep["new"] == []
+    # the deliberate trace-time env gates stay visible as debt
+    assert len(rep["allowed"]) >= 1
+
+
+def test_injected_violation_fails_check(tmp_path):
+    """End-to-end exit-1 proof: the CLI pointed at a tree holding one
+    forbidden pattern (a host call in a jitted fn) must fail."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text(
+        "import time\nimport jax\n\n"
+        "def f(x):\n    return x * time.time()\n\n"
+        "g = jax.jit(f)\n")
+    proc = subprocess.run(
+        [sys.executable, CLI, "--check", "--root", str(pkg),
+         "--allowlist", os.path.join(REPO, "tools",
+                                     "tracelint_allowlist.json")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout
+    assert "TL101" in proc.stdout

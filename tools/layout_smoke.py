@@ -121,13 +121,20 @@ def run(mode: str):
 
 
 def main():
-    n_on, e_on = run("1")
-    print(f"layout_smoke: autotune=1 optimized-HLO image transposes = "
-          f"{n_on} (contract: <= {MAX_TAGGED_TRANSPOSES}), "
-          f"framework-emitted = {e_on}")
-    n_off, e_off = run("0")
-    print(f"layout_smoke: autotune=0 optimized-HLO image transposes = "
-          f"{n_off}, framework-emitted = {e_off}")
+    # runtime sanitizers (ISSUE 12): transfer guard + compile watchdog
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        n_on, e_on = run("1")
+        print(f"layout_smoke: autotune=1 optimized-HLO image "
+              f"transposes = {n_on} (contract: <= "
+              f"{MAX_TAGGED_TRANSPOSES}), framework-emitted = {e_on}")
+        n_off, e_off = run("0")
+        print(f"layout_smoke: autotune=0 optimized-HLO image "
+              f"transposes = {n_off}, framework-emitted = {e_off}")
+    if wd.violations:
+        for v in wd.violations:
+            print(f"layout_smoke: compile watchdog: {v}")
+        return 1
     if n_on > MAX_TAGGED_TRANSPOSES:
         print("layout_smoke: FAIL — propagated mode leaks interior "
               "transposes")

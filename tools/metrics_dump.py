@@ -32,6 +32,11 @@ EXPECTED_METRICS = (
     "paddle_tpu_kernel_autotune_cache_misses_total",
     "paddle_tpu_kernel_autotune_search_seconds_total",
     "paddle_tpu_kernel_autotune_candidates_rejected_parity_total",
+    # Trace-discipline guards (ISSUE 12): registered by importing
+    # profiler.metrics; activity is exercised by tests/test_tracelint
+    # and the smoke tools' sanitize() wrappers
+    "paddle_tpu_compile_watchdog_budget_exceeded_total",
+    "paddle_tpu_compile_watchdog_transfer_guard_trips_total",
 )
 
 

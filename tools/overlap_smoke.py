@@ -212,9 +212,15 @@ def check_one_compile():
 
 
 def main():
-    ok = check_bucketing()
-    ok = check_zero_bubble() and ok
-    ok = check_one_compile() and ok
+    # runtime sanitizers (ISSUE 12): transfer guard + compile watchdog
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        ok = check_bucketing()
+        ok = check_zero_bubble() and ok
+        ok = check_one_compile() and ok
+    for v in wd.violations:
+        print(f"overlap_smoke: compile watchdog: {v}")
+        ok = False
     print("overlap_smoke: " + ("OK" if ok else "FAIL"))
     return 0 if ok else 1
 

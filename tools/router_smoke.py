@@ -207,7 +207,11 @@ def main():
     from paddle_tpu.profiler import metrics as pm
     from paddle_tpu.serving.metrics import CONTRACT_METRICS
 
-    stats, failures = run_smoke()
+    # runtime sanitizers (ISSUE 12): transfer guard + compile watchdog
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        stats, failures = run_smoke()
+    failures += [f"compile watchdog: {v}" for v in wd.violations]
     text = pm.REGISTRY.to_prometheus()
     print(text)
     for name in CONTRACT_METRICS:

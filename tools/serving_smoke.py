@@ -137,7 +137,13 @@ def main():
     from paddle_tpu.serving import metrics as sm
     from paddle_tpu.serving.metrics import CONTRACT_METRICS
 
-    engine, spec, prefix_stats, failures = run_smoke()
+    # runtime sanitizers ON for the whole smoke (ISSUE 12): transfer
+    # guard + compile-count watchdog — a second compile of any
+    # one-compile entry is a smoke failure, not a review finding
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        engine, spec, prefix_stats, failures = run_smoke()
+    failures += [f"compile watchdog: {v}" for v in wd.violations]
     text = pm.REGISTRY.to_prometheus()
     print(text)
     for name in CONTRACT_METRICS:

@@ -1,0 +1,37 @@
+"""Trace-discipline lint CLI (ISSUE 12) — the static-analysis gate.
+
+Runs the `paddle_tpu.analysis` tracelint + recompile-hazard passes
+over the shipped package and reconciles against the allowlist
+(tools/tracelint_allowlist.json). CI contract
+(tests/test_static_analysis.py, tier-1): `--check` exits 0 on the
+shipped tree; any NEW finding — a host call in a traced function, a
+list-typed static arg, a trailing-None jit-boundary spec, ... — exits
+1. Rule catalog + allowlist semantics: docs/ANALYSIS.md.
+
+Usage:
+  python tools/tracelint.py --check            # CI gate
+  python tools/tracelint.py                    # full report
+  python tools/tracelint.py --json             # machine-readable
+  python tools/tracelint.py --root DIR         # lint another tree
+  PADDLE_TPU_TRACELINT=0                       # skip the tier-1 gate
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_ROOT = os.path.join(_REPO, "paddle_tpu")
+DEFAULT_ALLOWLIST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tracelint_allowlist.json")
+
+
+def main(argv=None):
+    from paddle_tpu.analysis import tracelint
+    return tracelint.main(argv, root=DEFAULT_ROOT,
+                          allowlist_path=DEFAULT_ALLOWLIST)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
