@@ -145,6 +145,22 @@ class Tensor:
         if self._layout is not None:       # hand back the logical layout
             from . import layout as layout_mod
             a = a.transpose(*layout_mod.TO_NCHW_PERM)
+        if a.base is not None or not a.flags.owndata:
+            # Paddle's Tensor.numpy() returns a SNAPSHOT (a writable
+            # copy), but np.asarray of a CPU jax buffer is a read-only
+            # zero-copy VIEW of the live device buffer. Handing that
+            # view out is a correctness trap with buffer donation: a
+            # donated executable may reuse the buffer in place and
+            # silently rewrite the caller's "snapshot". Fresh-compiled
+            # executables dodge it (PJRT sees the external reference
+            # and copies instead of donating), but executables
+            # DESERIALIZED from the persistent compilation cache skip
+            # that protection on this jax — observed as hapi-trained
+            # weights "never changing" because the pre-training
+            # snapshot aliased the donated param buffer. Copy-on-view
+            # only: backends whose device_get already materializes an
+            # owning host array pay nothing.
+            a = a.copy()
         return a
 
     def __array__(self, dtype=None):

@@ -32,6 +32,9 @@ class Config:
         self._num_replicas = None
         self._router_policy = None
         self._sampling = None
+        self._prefill_replicas = None
+        self._decode_replicas = None
+        self._migration = None
 
     # -- continuous batching (paddle_tpu.serving) -------------------------
     def enable_continuous_batching(self, max_slots=None, block_size=None,
@@ -44,7 +47,10 @@ class Config:
                                    tensor_parallel=None,
                                    expert_parallel=None,
                                    num_replicas=None,
-                                   router_policy=None):
+                                   router_policy=None,
+                                   prefill_replicas=None,
+                                   decode_replicas=None,
+                                   migration=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
@@ -70,7 +76,32 @@ class Config:
         over the `ep` rows of a 2-D (ep, mp) mesh (docs/MOE.md);
         `num_replicas > 1` plus `create_serving_router` puts a
         prefix-affinity `ReplicaRouter` in front of that many
-        frontends (`router_policy`: "affinity" | "round_robin")."""
+        frontends (`router_policy`: "affinity" | "round_robin").
+
+        Disaggregated prefill/decode serving (docs/SERVING.md,
+        "Disaggregated serving"): `prefill_replicas`/`decode_replicas`
+        (both >= 1, replacing `num_replicas`) split the fleet into
+        prefill-role replicas — chunked prefill only, requests hand
+        off at the first token with their paged KV blocks streamed
+        over the block transport — and decode-role replicas that admit
+        the migrated requests mid-stream (greedy outputs stay
+        token-identical to a monolithic fleet; decode replicas get a
+        decode-sized token budget and keep `draft_k` speculation).
+        `migration=True` (or a dict of `ReplicaRouter.
+        MIGRATION_DEFAULTS` overrides: imbalance/interval/max_per_tick)
+        additionally lets loaded decode replicas SHED live requests to
+        lighter siblings instead of preempting them."""
+        # validate BEFORE any assignment: a raising call must leave the
+        # config exactly as it was (callers catch and retry)
+        if (prefill_replicas is not None) != (decode_replicas is not None):
+            raise ValueError(
+                "prefill_replicas and decode_replicas come as a pair "
+                "(a disaggregated fleet needs both roles)")
+        if prefill_replicas is not None and num_replicas is not None:
+            raise ValueError(
+                "pass either num_replicas (monolithic fleet) or "
+                "prefill_replicas/decode_replicas (disaggregated), "
+                "not both")
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
@@ -83,6 +114,9 @@ class Config:
         self._num_replicas = num_replicas
         self._router_policy = router_policy
         self._sampling = sampling
+        self._prefill_replicas = prefill_replicas
+        self._decode_replicas = decode_replicas
+        self._migration = migration
         return self
 
     def continuous_batching_enabled(self):
@@ -183,7 +217,7 @@ def _resolve_sampling(config: Config, sampling):
 
 
 def create_serving_engine(config: Config, model, sampling=None, seed=0,
-                          mesh=None):
+                          mesh=None, **overrides):
     """Build a continuous-batching `serving.ServingEngine` from an
     `enable_continuous_batching()` config and a causal-LM serving model
     (`models.gpt.GPTForGeneration` or anything exposing the same
@@ -194,12 +228,16 @@ def create_serving_engine(config: Config, model, sampling=None, seed=0,
     With `tensor_parallel > 1` on the config the engine is a
     `serving.distributed.TPServingEngine`: same host loop, mixed step
     and KV pools sharded over an `mp` mesh (`mesh` overrides the
-    default `parallel.mp_layers.tp_mesh` device pick)."""
+    default `parallel.mp_layers.tp_mesh` device pick). `overrides`
+    replace individual engine kwargs after the config — the
+    disaggregated `create_serving_router` uses this to give each
+    replica its role (and prefill replicas `draft_k=0`)."""
     if not config.continuous_batching_enabled():
         raise ValueError(
             "call config.enable_continuous_batching(...) first")
     kw = {k: v for k, v in config.serving_config().items()
           if v is not None}
+    kw.update(overrides)
     sampling = _resolve_sampling(config, sampling)
     tp = int(config._tensor_parallel or 1)
     ep = int(config._expert_parallel or 1)
@@ -220,13 +258,31 @@ def create_serving_router(config: Config, model, sampling=None, seed=0):
     `serving.distributed.ReplicaRouter`. `async with router:` starts
     every replica's step loop plus the health prober;
     `submit()`/`stream()` dispatch with affinity, load balancing and
-    failover (docs/SERVING.md "Distributed serving")."""
+    failover (docs/SERVING.md "Distributed serving").
+
+    With `prefill_replicas`/`decode_replicas` on the config the fleet
+    is DISAGGREGATED instead: prefill-role engines (chunked prefill
+    only, `draft_k` forced to 0) hand requests off at the first token
+    over the KV block transport to decode-role engines (decode-sized
+    token budgets, speculation kept), and `migration=` enables
+    router-driven load shedding between decode replicas
+    (docs/SERVING.md "Disaggregated serving")."""
     if not config.continuous_batching_enabled():
         raise ValueError(
             "call config.enable_continuous_batching(...) first")
-    n = int(config._num_replicas or 1)
-    if n < 1:
-        raise ValueError(f"num_replicas must be >= 1, got {n}")
+    roles = None
+    if config._prefill_replicas is not None:
+        p, d = int(config._prefill_replicas), int(config._decode_replicas)
+        if p < 1 or d < 1:
+            raise ValueError(
+                f"a disaggregated fleet needs prefill_replicas >= 1 "
+                f"and decode_replicas >= 1, got {p}/{d}")
+        roles = ["prefill"] * p + ["decode"] * d
+        n = p + d
+    else:
+        n = int(config._num_replicas or 1)
+        if n < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {n}")
     from .serving.distributed.router import ReplicaRouter
     from .serving.frontend import ServingFrontend
     tp = int(config._tensor_parallel or 1)
@@ -251,13 +307,28 @@ def create_serving_router(config: Config, model, sampling=None, seed=0):
     fkw = {}
     if config._max_pending is not None:
         fkw["max_pending"] = int(config._max_pending)
+
+    def _overrides(r):
+        if roles is None:
+            return {}
+        if roles[r] == "prefill":
+            # prefill replicas never decode past the first token, so
+            # speculation would only waste the reserved verify region
+            return {"role": "prefill", "draft_k": 0}
+        return {"role": "decode"}
+
     frontends = [ServingFrontend(
         create_serving_engine(config, model, sampling=sampling,
-                              seed=seed, mesh=meshes[r]), **fkw)
+                              seed=seed, mesh=meshes[r],
+                              **_overrides(r)), **fkw)
         for r in range(n)]
     rkw = {}
     if config._router_policy is not None:
         rkw["policy"] = config._router_policy
+    if roles is not None:
+        rkw["roles"] = roles
+    if config._migration is not None:
+        rkw["migration"] = config._migration
     return ReplicaRouter(frontends, **rkw)
 
 

@@ -146,7 +146,7 @@ def round_up(n, m):
 
 
 def choose_token_budget(max_slots, block_size, requested=None,
-                        verify_width=1):
+                        verify_width=1, role="mixed"):
     """Per-step token budget: a power of two >= max(max_slots,
     2*block_size) so a full decode round always fits and prefill chunks
     cover at least two KV blocks per step (generation.py's bucket
@@ -159,12 +159,24 @@ def choose_token_budget(max_slots, block_size, requested=None,
     `max_slots * verify_width` flat tokens are the RESERVED verify
     region (see `pack_step`), so the floor rises to that region plus
     prefill room — a budget that left prefill zero tokens would starve
-    admission forever."""
+    admission forever.
+
+    `role="decode"` (disaggregated serving, docs/SERVING.md) shrinks
+    the DEFAULT: a decode-role replica admits migrated requests whose
+    KV arrives by block transport, so its steps are decode-dominated
+    and the budget only needs the decode/verify tokens plus a little
+    prefill headroom (preempted migrants re-prefill locally; +1 keeps
+    at least one prefill token even with every slot decoding). Every
+    step pays the full fixed `[T]` compute whether or not prefill rides
+    along — the small budget is where disaggregation's inter-token
+    latency win comes from. Explicit `requested` always wins."""
     vw = int(verify_width)
     region = max_slots * vw
     if requested is not None:
         floor = max_slots if vw == 1 else region + 1
         return next_pow2(max(int(requested), floor), lo=1)
+    if role == "decode":
+        return next_pow2(region + 1, lo=1)
     if vw == 1:
         return next_pow2(max(max_slots, 2 * block_size))
     return next_pow2(region + 2 * block_size)

@@ -16,11 +16,20 @@ Two layers over the single-process serving stack (docs/SERVING.md,
   balancing, health probes (`health.ReplicaHealth`) and lossless
   failover: a dead replica's in-flight requests re-submit elsewhere
   (prompts are re-prefillable; greedy outputs are identical).
+* `transport.KVTransport` — block-granular KV movement for the
+  DISAGGREGATED fleet (docs/SERVING.md "Disaggregated serving"):
+  prefill-role replicas stream paged KV blocks (with their int8 scale
+  rows) to decode-role replicas and hand live requests off at the
+  first token; loaded decode replicas shed requests the same way.
+  `ReplicaRouter(roles=..., migration=...)` orchestrates both.
 """
 from .health import ReplicaHealth  # noqa: F401
 from .router import (NoReplicaAvailable, ReplicaRouter,  # noqa: F401
                      ShadowRadixIndex)
 from .tp_engine import TPServingEngine  # noqa: F401
+from .transport import (BlockChunk, InProcessTransport,  # noqa: F401
+                        KVTransport, MigrationTicket)
 
 __all__ = ["TPServingEngine", "ReplicaRouter", "ReplicaHealth",
-           "ShadowRadixIndex", "NoReplicaAvailable"]
+           "ShadowRadixIndex", "NoReplicaAvailable", "KVTransport",
+           "InProcessTransport", "MigrationTicket", "BlockChunk"]

@@ -40,7 +40,7 @@ import itertools
 from ...profiler import metrics as _pmetrics
 from .. import metrics as smetrics
 from ..frontend import (DeadlineExceeded, FrontendClosed,
-                        RequestCancelled)
+                        RequestCancelled, RequestMigrated)
 from .health import ReplicaHealth
 
 
@@ -138,6 +138,54 @@ class ShadowRadixIndex:
             self._push(replica, node)
         self._evict(replica)
 
+    def remove(self, replica, tokens):
+        """Forget `tokens`' path on `replica`: the deepest matched
+        nodes are deleted bottom-up while they are CHILDLESS, so a
+        prefix other inserted prompts still extend survives — only the
+        suffix unique to this token sequence goes. This is the
+        migration update (`on_migrate`): a request's chat-turn KV left
+        the replica, so its unique tail must stop attracting affinity
+        there, while the shared family head (still in the replica's
+        real prefix cache) keeps steering. Returns nodes removed."""
+        root = self._roots.get(replica)
+        if root is None:
+            return 0
+        node, path = root, []
+        for chunk in self._chunks(tokens):
+            nxt = node.children.get(chunk)
+            if nxt is None:
+                break
+            path.append(nxt)
+            node = nxt
+        removed = 0
+        keep = root
+        for node in reversed(path):
+            parent = node.parent
+            if (node.children or parent is None
+                    or parent.children.get(node.key) is not node):
+                keep = node
+                break
+            del parent.children[node.key]
+            node.parent = None
+            self._counts[replica] -= 1
+            removed += 1
+        else:
+            keep = root
+        if keep is not root and not keep.children:
+            # the surviving tail node just became a leaf: give the
+            # eviction heap an entry at its current stamp
+            self._push(replica, keep)
+        return removed
+
+    def on_migrate(self, src, dst, tokens):
+        """A live request (prompt + generated output = `tokens`) moved
+        from `src` to `dst`, blocks and all: move its affinity with it
+        so later same-head requests steer at the KV's NEW home instead
+        of the stale copy (the dispatch-time-only learning bug this
+        method closes — docs/SERVING.md, "Disaggregated serving")."""
+        self.remove(src, tokens)
+        self.insert(dst, tokens)
+
     def drop(self, replica):
         """Forget a replica's whole tree (it died; its cache is gone)."""
         self._roots.pop(replica, None)
@@ -189,8 +237,15 @@ class ReplicaRouter:
     contract in tools/router_smoke.py is measured against).
     """
 
+    #: auto-shed policy defaults (`migration=True`): a decode replica
+    #: sheds one live request per tick while its load exceeds the
+    #: lightest decode replica's by >= `imbalance`
+    MIGRATION_DEFAULTS = {"imbalance": 4, "interval": 0.05,
+                          "max_per_tick": 1}
+
     def __init__(self, frontends, *, policy="affinity",
-                 shadow_capacity=4096, probe_interval=0.05):
+                 shadow_capacity=4096, probe_interval=0.05,
+                 roles=None, transport=None, migration=None):
         if not frontends:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "round_robin"):
@@ -202,36 +257,97 @@ class ReplicaRouter:
         if len(bs) != 1:
             raise ValueError(
                 f"replicas disagree on block_size: {sorted(bs)}")
+        # ---- disaggregated roles (docs/SERVING.md) ------------------
+        n = len(self.frontends)
+        if roles is None:
+            roles = ["mixed"] * n
+        roles = [str(r) for r in roles]
+        if len(roles) != n or any(
+                r not in ("mixed", "prefill", "decode") for r in roles):
+            raise ValueError(f"roles must be one of mixed/prefill/"
+                             f"decode per replica, got {roles}")
+        for i, r in enumerate(roles):
+            er = getattr(self.frontends[i].engine, "role", "mixed")
+            if (r == "prefill") != (er == "prefill"):
+                raise ValueError(
+                    f"replica {i}: router role {r!r} but engine role "
+                    f"{er!r} — a prefill replica needs an engine built "
+                    "with role='prefill' (and only those hand off)")
+        self.roles = roles
+        self.disagg = any(r != "mixed" for r in roles)
+        self._dispatch_targets = [i for i, r in enumerate(roles)
+                                  if r in ("prefill", "mixed")]
+        self._decode_targets = [i for i, r in enumerate(roles)
+                                if r in ("decode", "mixed")]
+        if self.disagg and (not self._dispatch_targets
+                            or not self._decode_targets):
+            raise ValueError(
+                "a disaggregated fleet needs at least one prefill-"
+                f"capable AND one decode-capable replica, got {roles}")
+        if self.disagg or migration:
+            metas = {tuple(sorted(fe.engine.kv.kv_meta().items()))
+                     for fe in self.frontends}
+            if len(metas) != 1:
+                raise ValueError(
+                    "migration needs identical KV geometry on every "
+                    f"replica, got {sorted(metas)}")
+            from .transport import InProcessTransport
+            self.transport = (transport if transport is not None
+                              else InProcessTransport())
+        else:
+            self.transport = transport
+        self.migration = None
+        if migration:
+            if not self.disagg:
+                # the monolithic stream path has no RequestMigrated
+                # handler — auto-shedding there would end healthy
+                # streams with an unhandled migration ticket
+                raise ValueError(
+                    "migration= needs a disaggregated fleet (roles "
+                    "with decode replicas); a monolithic fleet "
+                    "rebalances by dispatch, not by moving live KV")
+            self.migration = dict(self.MIGRATION_DEFAULTS)
+            if isinstance(migration, dict):
+                self.migration.update(migration)
         self.shadow = ShadowRadixIndex(bs.pop(),
                                        capacity_blocks=shadow_capacity)
         self.clock = self.frontends[0].engine.clock
         self.probe_interval = float(probe_interval)
         self._inflight = [0] * len(self.frontends)
         self._rr = itertools.count()
+        self._rr_decode = itertools.count()
+        self._mseq = itertools.count()
         self._prober = None
+        self._balancer = None
         # raw counters (always on; mirrored into the metrics registry
         # only when observability is enabled)
         self.dispatches = 0
         self.affinity_hits = 0
         self.failovers = 0
+        self.migrations = {"handoff": 0, "shed": 0}
+        self.role_dispatches = {"mixed": 0, "prefill": 0, "decode": 0}
 
     # ---------------------------------------------------------- lifecycle
     async def start(self):
         for fe in self.frontends:
             await fe.start()
+        loop = asyncio.get_running_loop()
         if self._prober is None:
-            self._prober = asyncio.get_running_loop().create_task(
+            self._prober = loop.create_task(
                 self.health.run(self.probe_interval))
+        if self.migration and self._balancer is None:
+            self._balancer = loop.create_task(self._balance_loop())
         return self
 
     async def stop(self):
-        if self._prober is not None:
-            self._prober.cancel()
-            try:
-                await self._prober
-            except asyncio.CancelledError:
-                pass
-            self._prober = None
+        for task in (self._prober, self._balancer):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self._prober = self._balancer = None
         for i, fe in enumerate(self.frontends):
             if self.health.probe(i):
                 await fe.stop()
@@ -259,13 +375,15 @@ class ReplicaRouter:
                     self.queue_depth(i))
 
     def _pick(self, prompt):
-        """(replica index, affinity_hit) for one dispatch. Raises
-        NoReplicaAvailable when every replica is down."""
-        live = [i for i in range(len(self.frontends))
+        """(replica index, affinity_hit) for one PROMPT dispatch —
+        restricted to prefill-capable replicas in a disaggregated
+        fleet. Raises NoReplicaAvailable when every candidate is down."""
+        live = [i for i in self._dispatch_targets
                 if self.health.alive(i)]
         if not live:
             raise NoReplicaAvailable(
-                f"all {len(self.frontends)} replicas are down")
+                f"all {len(self._dispatch_targets)} prompt-dispatch "
+                "replicas are down")
         self.dispatches += 1
         if self.policy == "round_robin":
             idx = live[next(self._rr) % len(live)]
@@ -289,6 +407,89 @@ class ReplicaRouter:
         self._export_depths()
         return idx, affinity
 
+    def _pick_decode(self, tokens, exclude=()):
+        """Destination decode replica for a handoff or a shed
+        migration: router-directed PLACEMENT — the shadow index knows
+        where every prefix (and migrated chat turn) lives, so the
+        request lands where its KV history already is when possible,
+        least-loaded otherwise. Raises NoReplicaAvailable when no
+        decode-capable replica (outside `exclude`) is up."""
+        live = [i for i in self._decode_targets
+                if i not in exclude and self.health.alive(i)]
+        if not live:
+            raise NoReplicaAvailable(
+                "no decode-capable replica available "
+                f"(roles={self.roles}, excluded={sorted(exclude)})")
+        if self.policy == "round_robin":
+            return live[next(self._rr_decode) % len(live)]
+        hits = {i: self.shadow.match(i, tokens) for i in live}
+        best = max(hits.values())
+        cands = ([i for i in live if hits[i] == best]
+                 if best >= self.shadow.bs else live)
+        return min(cands, key=lambda i: (self.queue_depth(i), i))
+
+    # ---------------------------------------------------- load shedding
+    def shed(self, idx, n=1):
+        """Manually ask replica `idx` to shed up to `n` live decodes;
+        their streams re-place transparently via `RequestMigrated`.
+        Returns how many were flagged."""
+        return self.frontends[idx].shed(n)
+
+    def rebalance(self):
+        """One auto-shed decision (the `migration=` policy, also run
+        periodically by the balance loop): when the most-loaded decode
+        replica exceeds the least-loaded by >= `imbalance`, it sheds
+        `max_per_tick` requests — the in-flight streams carry the KV
+        to the lighter replica and the caller never notices. Returns
+        requests flagged."""
+        if not self.migration:
+            return 0
+        live = [i for i in self._decode_targets if self.health.alive(i)]
+        if len(live) < 2:
+            return 0
+        depths = {i: self.queue_depth(i) for i in live}
+        hi = max(live, key=lambda i: (depths[i], -i))
+        lo = min(live, key=lambda i: (depths[i], i))
+        if depths[hi] - depths[lo] < self.migration["imbalance"]:
+            return 0
+        return self.frontends[hi].shed(self.migration["max_per_tick"])
+
+    async def _balance_loop(self):
+        while True:
+            await asyncio.sleep(self.migration["interval"])
+            self.rebalance()
+
+    # ------------------------------------------------- metric helpers
+    def _count_role(self, role):
+        self.role_dispatches[role] = self.role_dispatches.get(role, 0) + 1
+        if _pmetrics._enabled:
+            smetrics.ROUTER_DISPATCH_ROLE.labels(role).inc()
+
+    def _note_migration(self, reason):
+        self.migrations[reason] = self.migrations.get(reason, 0) + 1
+        if _pmetrics._enabled:
+            smetrics.ROUTER_MIGRATIONS.labels(reason).inc()
+
+    def _fail_over(self, idx):
+        """Common replica-death bookkeeping on a failover path."""
+        self.health.mark_down(idx)
+        self.shadow.drop(idx)
+        self.failovers += 1
+        self._count(idx, "failover")
+        if _pmetrics._enabled:
+            smetrics.ROUTER_FAILOVERS.inc()
+
+    def _is_replica_death(self, idx, e):
+        """Classify a _FAILOVER_ERRORS exception: True = replica `idx`
+        is actually gone (fail over elsewhere); False = the replica is
+        still serving and this was a per-REQUEST failure (e.g. the
+        engine-stall RuntimeError for a working set its pool can't
+        hold) — surface it, since re-submitting the same request to
+        identical replicas would just stall them one by one. ONE
+        definition for every dispatch path, so the probe-before-
+        failover subtlety can't drift between them."""
+        return isinstance(e, _ReplicaDied) or not self.health.probe(idx)
+
     # ------------------------------------------------------------ serving
     async def submit(self, prompt, max_new_tokens=32, *,
                      tenant="default", timeout=None):
@@ -300,43 +501,68 @@ class ReplicaRouter:
             out.append(tok)
         return out
 
+    def _hold(self, idx):
+        """Count a dispatch in replica `idx`'s load estimate only until
+        its frontend admits it into the fair queue — from then on
+        queue_depth sees it there (then in the engine FIFO / resident
+        slots), and keeping it held for the whole request would
+        double-count every admitted request. Returns (on_admitted
+        callback, release-for-finally callback)."""
+        self._inflight[idx] += 1
+        pending = [True]
+
+        def _admitted():
+            if pending[0]:
+                pending[0] = False
+                self._inflight[idx] -= 1
+                self._export_depths()
+
+        def _release():
+            if pending[0]:
+                pending[0] = False
+                self._inflight[idx] -= 1
+            self._export_depths()
+
+        return _admitted, _release
+
+    def _remaining(self, idx, deadline):
+        """Seconds left before `deadline` (None = no deadline); counts
+        and raises when already past."""
+        if deadline is None:
+            return None
+        remaining = deadline - self.clock()
+        if remaining <= 0:
+            self._count(idx, "expired")
+            raise DeadlineExceeded()
+        return remaining
+
     async def stream(self, prompt, max_new_tokens=32, *,
                      tenant="default", timeout=None):
         """Async generator of generated tokens. On a replica death the
         request transparently re-submits to a live replica; tokens the
-        caller already received are suppressed from the re-run."""
+        caller already received are suppressed from the re-run. In a
+        disaggregated fleet the stream spans the prefill replica, the
+        block handoff and the decode replica (plus any shed hops) —
+        see `_stream_disagg`."""
+        if self.disagg:
+            async for tok in self._stream_disagg(
+                    prompt, max_new_tokens, tenant, timeout):
+                yield tok
+            return
         deadline = (self.clock() + float(timeout)
                     if timeout is not None else None)
         delivered = 0
         while True:
             idx, _ = self._pick(prompt)
-            remaining = None
-            if deadline is not None:
-                remaining = deadline - self.clock()
-                if remaining <= 0:
-                    self._count(idx, "expired")
-                    raise DeadlineExceeded()
-            # count the dispatch in the load estimate only until the
-            # replica's frontend admits it into its fair queue — from
-            # then on queue_depth sees it there (then in the engine
-            # FIFO / resident slots), and keeping _inflight held for
-            # the whole request would double-count every admitted
-            # request against that replica
-            self._inflight[idx] += 1
-            pending = [True]
-
-            def _admitted(idx=idx, pending=pending):
-                if pending[0]:
-                    pending[0] = False
-                    self._inflight[idx] -= 1
-                    self._export_depths()
-
+            self._count_role("mixed")
+            remaining = self._remaining(idx, deadline)
+            on_admitted, release = self._hold(idx)
             attempt_out = []
             try:
-                async for tok in self._attempt(idx, prompt,
-                                               max_new_tokens, tenant,
-                                               remaining, attempt_out,
-                                               _admitted):
+                agen = self.frontends[idx].stream(
+                    prompt, max_new_tokens, tenant=tenant,
+                    timeout=remaining, on_admitted=on_admitted)
+                async for tok in self._attempt(idx, agen, attempt_out):
                     if len(attempt_out) > delivered:
                         delivered += 1
                         yield tok
@@ -347,22 +573,10 @@ class ReplicaRouter:
                 self._count(idx, "finished")
                 return
             except _FAILOVER_ERRORS as e:
-                if not isinstance(e, _ReplicaDied) \
-                        and self.health.probe(idx):
-                    # the replica is still serving: this was a
-                    # per-REQUEST failure (e.g. the engine-stall
-                    # RuntimeError for a working set its pool can't
-                    # hold) — surface it; re-submitting the same
-                    # request to identical replicas would just stall
-                    # them one by one
+                if not self._is_replica_death(idx, e):
                     self._count(idx, "error")
                     raise
-                self.health.mark_down(idx)
-                self.shadow.drop(idx)
-                self.failovers += 1
-                self._count(idx, "failover")
-                if _pmetrics._enabled:
-                    smetrics.ROUTER_FAILOVERS.inc()
+                self._fail_over(idx)
                 continue                      # re-dispatch elsewhere
             except DeadlineExceeded:
                 self._count(idx, "expired")
@@ -374,20 +588,198 @@ class ReplicaRouter:
                 self._count(idx, "error")
                 raise
             finally:
-                if pending[0]:
-                    pending[0] = False
-                    self._inflight[idx] -= 1
-                self._export_depths()
+                release()
 
-    async def _attempt(self, idx, prompt, max_new_tokens, tenant,
-                       timeout, attempt_out, on_admitted):
-        """One dispatch to replica `idx`: forward its stream, racing
-        the replica's down event (rescues requests stranded on a
-        step-loop that died without failing its handles)."""
-        fe = self.frontends[idx]
+    async def _stream_disagg(self, prompt, max_new_tokens, tenant,
+                             timeout):
+        """The disaggregated request pipeline, one async token stream:
+
+        1. **Prefill dispatch** — affinity-steered over prefill-capable
+           replicas; the handoff DESTINATION is chosen up front (shadow
+           placement over decode replicas) so completed KV blocks
+           stream ahead over the transport while prefill still runs.
+        2. **Handoff** — the prefill frontend ends the attempt with
+           `RequestMigrated(ticket)` after the first sampled token; the
+           ticket (host state + tail blocks) ships to the destination,
+           which imports the blocks and continues the stream
+           mid-request, token-identically under greedy decoding.
+        3. **Shed hops** — a loaded decode replica may end the attempt
+           with another `RequestMigrated`; the request re-places onto a
+           lighter decode replica (shadow entries move with it) and the
+           stream continues seamlessly.
+        4. **Failover** — a replica death restarts the whole pipeline
+           (the KV payload died with the replica; prompts are
+           re-prefillable) with already-delivered tokens suppressed.
+        """
+        deadline = (self.clock() + float(timeout)
+                    if timeout is not None else None)
+        prompt = list(prompt)
+        delivered = 0
+        transport = self.transport
+        inbox = [None, None]                # (dst, key) awaiting collect
+
+        def _drop_inbox():
+            if inbox[0] is not None:
+                transport.drop(inbox[0], inbox[1])
+                inbox[0] = inbox[1] = None
+
+        try:
+            while True:                     # failover restart loop
+                pidx, _ = self._pick(prompt)
+                self._count_role("prefill")
+                on_blocks = None
+                didx = key = None
+                if self.roles[pidx] == "prefill":
+                    # handoff is certain: choose the destination now so
+                    # completed blocks stream ahead of the ticket. A
+                    # MIXED dispatch replica decodes locally instead —
+                    # streaming its prompt KV ahead would pay a full
+                    # export + codec round-trip dropped unconsumed for
+                    # every request that never sheds.
+                    didx = self._pick_decode(prompt)
+                    key = f"req{next(self._mseq)}"
+                    inbox[0], inbox[1] = didx, key
+                    meta = self.frontends[pidx].engine.kv.kv_meta()
+
+                    def _ship(chunk, p=pidx, d=didx, k=key, m=meta):
+                        transport.send_chunk(p, d, k, m, chunk)
+
+                    on_blocks = _ship
+                remaining = self._remaining(pidx, deadline)
+                on_admitted, release = self._hold(pidx)
+                attempt_out = []
+                ticket = None
+                try:
+                    agen = self.frontends[pidx].stream(
+                        prompt, max_new_tokens, tenant=tenant,
+                        timeout=remaining, on_admitted=on_admitted,
+                        on_blocks=on_blocks)
+                    async for tok in self._attempt(pidx, agen,
+                                                   attempt_out):
+                        if len(attempt_out) > delivered:
+                            delivered += 1
+                            yield tok
+                    # finished on the dispatch replica (a mixed replica
+                    # serving end-to-end, or EOS/horizon at the prefill
+                    # replica's first token): no migration happened
+                    _drop_inbox()
+                    self.shadow.insert(pidx, prompt + attempt_out)
+                    self._count(pidx, "finished")
+                    return
+                except RequestMigrated as e:
+                    ticket = e.ticket
+                except _FAILOVER_ERRORS as e:
+                    _drop_inbox()
+                    if not self._is_replica_death(pidx, e):
+                        self._count(pidx, "error")
+                        raise
+                    self._fail_over(pidx)
+                    continue
+                except DeadlineExceeded:
+                    self._count(pidx, "expired")
+                    raise
+                except RequestCancelled:
+                    self._count(pidx, "cancelled")
+                    raise
+                except Exception:
+                    self._count(pidx, "error")
+                    raise
+                finally:
+                    release()
+
+                # ---- migration out of the dispatch replica: a prefill
+                # handoff (destination already receiving the stream-
+                # ahead), or a mixed replica SHEDDING its live decode
+                # (destination chosen now; every block rides the ticket)
+                if didx is None:
+                    path = list(ticket.prompt) + list(ticket.output)
+                    didx = self._pick_decode(path, exclude=(pidx,))
+                    key = f"req{next(self._mseq)}"
+                    inbox[0], inbox[1] = didx, key
+                    self.shadow.on_migrate(pidx, didx, path)
+                    self._note_migration("shed")
+                else:
+                    self._note_migration("handoff")
+                self._count(pidx, "migrated")
+                hand_t0 = self.clock()
+                transport.send_ticket(pidx, didx, key, ticket)
+                restart = False
+                while True:                 # decode phase + shed hops
+                    assembled = transport.collect(didx, key)
+                    inbox[0] = inbox[1] = None
+                    self._count_role("decode")
+                    # placement bookkeeping: the KV now lives on didx
+                    history = (list(assembled.prompt)
+                               + list(assembled.output))
+                    self.shadow.insert(didx, history)
+                    remaining = self._remaining(didx, deadline)
+                    on_admitted, release = self._hold(didx)
+                    attempt_out = []
+                    base = len(assembled.output)
+                    gap_open = True
+                    try:
+                        agen = self.frontends[didx].stream_ticket(
+                            assembled, on_admitted=on_admitted)
+                        async for tok in self._attempt(didx, agen,
+                                                       attempt_out):
+                            if gap_open:
+                                gap_open = False
+                                if _pmetrics._enabled:
+                                    smetrics.SERVING_HANDOFF_LATENCY \
+                                        .observe(self.clock() - hand_t0)
+                            if base + len(attempt_out) > delivered:
+                                delivered += 1
+                                yield tok
+                        self.shadow.insert(
+                            didx, history + attempt_out)
+                        self._count(didx, "finished")
+                        return
+                    except RequestMigrated as e:
+                        # shed: re-place on a lighter decode replica;
+                        # the shadow entries move with the KV
+                        t2 = e.ticket
+                        old = didx
+                        path = list(t2.prompt) + list(t2.output)
+                        didx = self._pick_decode(path, exclude=(old,))
+                        self.shadow.on_migrate(old, didx, path)
+                        self._note_migration("shed")
+                        self._count(old, "migrated")
+                        key = f"req{next(self._mseq)}"
+                        inbox[0], inbox[1] = didx, key
+                        hand_t0 = self.clock()
+                        transport.send_ticket(old, didx, key, t2)
+                        continue
+                    except _FAILOVER_ERRORS as e:
+                        if not self._is_replica_death(didx, e):
+                            self._count(didx, "error")
+                            raise
+                        # the KV payload died with the replica: restart
+                        # from prefill, suppressing delivered tokens
+                        self._fail_over(didx)
+                        restart = True
+                        break
+                    except DeadlineExceeded:
+                        self._count(didx, "expired")
+                        raise
+                    except RequestCancelled:
+                        self._count(didx, "cancelled")
+                        raise
+                    except Exception:
+                        self._count(didx, "error")
+                        raise
+                    finally:
+                        release()
+                if not restart:
+                    return
+        finally:
+            _drop_inbox()
+
+    async def _attempt(self, idx, agen, attempt_out):
+        """One dispatch attempt against replica `idx`: forward the
+        given frontend stream, racing the replica's down event
+        (rescues requests stranded on a step-loop that died without
+        failing its handles)."""
         q = asyncio.Queue()
-        agen = fe.stream(prompt, max_new_tokens, tenant=tenant,
-                         timeout=timeout, on_admitted=on_admitted)
 
         async def pump():
             try:
@@ -432,9 +824,19 @@ class ReplicaRouter:
 
     def stats(self):
         """Router-side counters (always on, registry-independent)."""
-        return {"dispatches": self.dispatches,
-                "affinity_hits": self.affinity_hits,
-                "failovers": self.failovers,
-                "health": self.health.snapshot(),
-                "queue_depths": [self.queue_depth(i) for i in
-                                 range(len(self.frontends))]}
+        out = {"dispatches": self.dispatches,
+               "affinity_hits": self.affinity_hits,
+               "failovers": self.failovers,
+               "roles": list(self.roles),
+               "migrations": dict(self.migrations),
+               "role_dispatches": dict(self.role_dispatches),
+               "health": self.health.snapshot(),
+               "queue_depths": [self.queue_depth(i) for i in
+                                range(len(self.frontends))]}
+        if self.transport is not None:
+            out["transport"] = {
+                "bytes_sent": self.transport.bytes_sent,
+                "bytes_received": self.transport.bytes_received,
+                "blocks_sent": self.transport.blocks_sent,
+                "tickets_sent": self.transport.tickets_sent}
+        return out

@@ -156,14 +156,24 @@ class TPServingEngine(ServingEngine):
                 arr, NamedSharding(self.mesh, spec)))
         self._arrays = out
         psh = NamedSharding(self.mesh, self._pool_spec())
-        self.kv.k_pool = jax.device_put(self.kv.k_pool, psh)
-        self.kv.v_pool = jax.device_put(self.kv.v_pool, psh)
-        if self.kv.quantized:
-            # the [L, NB, BS, H] scale pools shard on the same (head)
-            # axis — trailing-None-trimmed, P(None, None, None, "mp")
-            # happens to be the pool spec verbatim
-            self.kv.k_scale = jax.device_put(self.kv.k_scale, psh)
-            self.kv.v_scale = jax.device_put(self.kv.v_scale, psh)
+
+        def _place(kv, _psh=psh, _put=jax.device_put):
+            kv.k_pool = _put(kv.k_pool, _psh)
+            kv.v_pool = _put(kv.v_pool, _psh)
+            if kv.quantized:
+                # the [L, NB, BS, H] scale pools shard on the same
+                # (head) axis — trailing-None-trimmed, P(None, None,
+                # None, "mp") happens to be the pool spec verbatim
+                kv.k_scale = _put(kv.k_scale, _psh)
+                kv.v_scale = _put(kv.v_scale, _psh)
+
+        _place(self.kv)
+        # KV block transport (disaggregated serving): imported pools
+        # come out of the scatter executable with whatever sharding
+        # GSPMD inferred — re-pin the canonical spec so the next mixed
+        # step's input shardings stay byte-identical (a drift here is
+        # a silent full recompile, the PR 8/PR 10 lesson)
+        self.kv.place_pools = _place
 
     # ------------------------------------------------------ mixed step
     def _step_cfg(self):

@@ -1,0 +1,293 @@
+"""Block-granular KV transport between serving replicas.
+
+Disaggregated prefill/decode serving (docs/SERVING.md, "Disaggregated
+serving") moves a request's paged KV blocks between replicas: a
+prefill-role replica streams the blocks it writes to a decode-role
+replica as prefill chunks complete, hands the request off at the first
+sampled token, and a loaded decode replica can later shed the live
+request — blocks and all — to a sibling. The unit of transfer is the
+PR 9 KV block: `[L, BS, H, Dh]` K/V payloads plus, for int8 pools, the
+`[L, BS, H]` fp32 scale rows that share the block's coordinates — a
+block is self-contained by construction, so shipping it preserves the
+dequantization of every entry bit-exactly.
+
+Three layers here:
+
+* **Codec** — `encode_chunk`/`decode_chunk` and `encode_state`/
+  `decode_state`: a versioned bytes-on-the-wire format (magic +
+  length-prefixed JSON header describing geometry and array layout +
+  raw C-order array payloads). Round-trips are bit-exact for
+  fp32/bf16/int8 pools including scale rows (tests/test_transport.py
+  property-tests this), and the header's `kv_meta` geometry lets the
+  importer refuse a mismatched fleet instead of corrupting a pool.
+* **`MigrationTicket`** — the request's host state (prompt, generated
+  output, horizon, deadlines, timing for the metrics continuity) plus
+  the block chunks not yet streamed. Everything the destination's
+  `ServingEngine.submit_migrated` needs to resume the request
+  token-identically under greedy decoding.
+* **`KVTransport`** — the pluggable wire. `InProcessTransport` is the
+  reference implementation: chunks and tickets pass through the real
+  codec (`wire=True`, the default) so byte counts and bit-exactness
+  are exercised on every transfer, landing in a per-(destination, key)
+  inbox the router collects from. A multi-host transport implements
+  the same five methods over a real fabric; everything above this
+  module is already written against the interface.
+
+Metrics: raw counters on the transport object (always on) are mirrored
+into `paddle_tpu_serving_kv_transport_bytes_total{direction}` when the
+profiler registry is enabled; block import counts ride
+`kv_cache.PagedKVCache.blocks_imported` and surface as
+`paddle_tpu_serving_kv_blocks_migrated_total` via the engine's step
+mirror.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+
+import numpy as np
+
+from ...profiler import metrics as _pmetrics
+
+MAGIC = b"PTKV"
+VERSION = 1
+
+
+def _np_dtype(name):
+    """np.dtype by name, with ml_dtypes (bfloat16 & friends) available
+    — jax has registered them long before any transport runs, but the
+    import keeps the codec usable standalone."""
+    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+    return np.dtype(name)
+
+
+@dataclasses.dataclass
+class BlockChunk:
+    """A contiguous run of a slot's KV blocks in transit.
+
+    `start` indexes the slot's block TABLE (not the pool): chunk i of a
+    request covers table entries [start, start+count). `arrays` is
+    `(k, v)` for float pools or `(k, v, k_scale, v_scale)` for int8 —
+    each `[count, L, BS, ...]`, exactly what
+    `PagedKVCache.export_blocks` produced and `import_blocks` expects.
+    """
+    start: int
+    count: int
+    arrays: tuple
+
+    @property
+    def nbytes(self):
+        return int(sum(a.nbytes for a in self.arrays))
+
+
+#: host-state fields of a ticket, in wire order (everything except the
+#: chunks, which travel as separate codec frames)
+_STATE_FIELDS = ("prompt", "output", "max_new_tokens", "eos_token_id",
+                 "deadline", "tenant", "slot_len", "total_blocks",
+                 "kv_meta", "submit_time", "first_token_time",
+                 "cache_hit_tokens", "preemptions", "created_at")
+
+
+@dataclasses.dataclass
+class MigrationTicket:
+    """Everything a destination engine needs to resume a live request.
+
+    Built by `ServingEngine.extract_request`; consumed by
+    `ServingEngine.submit_migrated`. `chunks` holds the blocks NOT yet
+    streamed ahead (for a prefill handoff the tail past
+    `Request.shipped_blocks`; for a decode shed, everything); the
+    router's transport merges pre-streamed chunks back in, and
+    `total_blocks` lets the importer validate full coverage before it
+    touches a pool. Timing fields carry over so TTFT is observed once
+    and inter-token gaps stay continuous across the migration.
+    """
+    prompt: list
+    output: list
+    max_new_tokens: int
+    eos_token_id: object
+    deadline: object
+    tenant: str
+    slot_len: int
+    total_blocks: int
+    kv_meta: dict
+    chunks: list
+    submit_time: float = 0.0
+    first_token_time: object = None
+    cache_hit_tokens: int = 0
+    preemptions: int = 0
+    created_at: float = 0.0
+
+    def state_dict(self):
+        d = {f: getattr(self, f) for f in _STATE_FIELDS}
+        d["prompt"] = [int(t) for t in self.prompt]
+        d["output"] = [int(t) for t in self.output]
+        return d
+
+
+# --------------------------------------------------------------- codec
+def _frame(header: dict, payloads) -> bytes:
+    hj = json.dumps(header).encode("utf-8")
+    parts = [MAGIC, struct.pack("<I", len(hj)), hj]
+    parts.extend(payloads)
+    return b"".join(parts)
+
+
+def _unframe(data: bytes):
+    if data[:4] != MAGIC:
+        raise ValueError("not a PTKV frame (bad magic)")
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    header = json.loads(data[8:8 + hlen].decode("utf-8"))
+    if header.get("v") != VERSION:
+        raise ValueError(f"unsupported PTKV version {header.get('v')}")
+    return header, 8 + hlen
+
+
+def encode_chunk(meta: dict, chunk: BlockChunk) -> bytes:
+    """One block chunk -> wire bytes: header (geometry + per-array
+    dtype/shape) + raw C-order payloads. Bit-exact by construction —
+    `tobytes()`/`frombuffer` never reinterpret values."""
+    header = {
+        "v": VERSION, "kind": "chunk", "meta": dict(meta),
+        "start": int(chunk.start), "count": int(chunk.count),
+        "arrays": [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                   for a in chunk.arrays],
+    }
+    payloads = [np.ascontiguousarray(a).tobytes() for a in chunk.arrays]
+    return _frame(header, payloads)
+
+
+def decode_chunk(data: bytes):
+    """Wire bytes -> (meta, BlockChunk). Arrays are fresh host copies
+    (writable), so the importer can pad/concatenate freely."""
+    header, off = _unframe(data)
+    if header.get("kind") != "chunk":
+        raise ValueError(f"expected a chunk frame, got {header.get('kind')!r}")
+    arrays = []
+    for desc in header["arrays"]:
+        dt = _np_dtype(desc["dtype"])
+        shape = tuple(desc["shape"])
+        n = int(np.prod(shape)) if shape else 1
+        a = np.frombuffer(data, dtype=dt, count=n, offset=off)
+        arrays.append(a.reshape(shape).copy())
+        off += n * dt.itemsize
+    return header["meta"], BlockChunk(start=int(header["start"]),
+                                      count=int(header["count"]),
+                                      arrays=tuple(arrays))
+
+
+def encode_state(ticket: MigrationTicket) -> bytes:
+    header = {"v": VERSION, "kind": "state", "state": ticket.state_dict()}
+    return _frame(header, [])
+
+
+def decode_state(data: bytes) -> dict:
+    header, _ = _unframe(data)
+    if header.get("kind") != "state":
+        raise ValueError(f"expected a state frame, got {header.get('kind')!r}")
+    return header["state"]
+
+
+# ----------------------------------------------------------- transport
+class KVTransport:
+    """Pluggable block-granular transport between replicas.
+
+    `send_chunk` ships one `BlockChunk` toward `(dst, key)` — the
+    prefill-streaming path; `send_ticket` ships a ticket's host state
+    plus its remaining chunks — the handoff/shed path; `collect` pops
+    the assembled ticket at the destination; `pending`/`drop` manage
+    abandoned transfers. Raw byte counters are always on; the registry
+    mirror records only when profiler metrics are enabled.
+    """
+
+    def __init__(self):
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.blocks_sent = 0
+        self.tickets_sent = 0
+
+    def _note(self, sent, received):
+        self.bytes_sent += int(sent)
+        self.bytes_received += int(received)
+        if _pmetrics._enabled:
+            from .. import metrics as smetrics
+            smetrics.SERVING_KV_TRANSPORT_BYTES.labels("sent").inc(sent)
+            smetrics.SERVING_KV_TRANSPORT_BYTES.labels("received").inc(
+                received)
+
+    # one chunk toward (dst, key); meta is the source pool's kv_meta()
+    def send_chunk(self, src, dst, key, meta, chunk):
+        raise NotImplementedError
+
+    # ticket state + its unstreamed chunks toward (dst, key)
+    def send_ticket(self, src, dst, key, ticket):
+        raise NotImplementedError
+
+    # assembled MigrationTicket at dst (state + every chunk, in table
+    # order); raises KeyError when the state frame has not arrived
+    def collect(self, dst, key):
+        raise NotImplementedError
+
+    def pending(self, dst, key):
+        raise NotImplementedError
+
+    # forget a transfer (request finished/cancelled before handoff)
+    def drop(self, dst, key):
+        raise NotImplementedError
+
+
+class InProcessTransport(KVTransport):
+    """Reference transport: same-process inbox, REAL codec on the wire.
+
+    With `wire=True` (default) every chunk and ticket is encoded to
+    bytes and decoded back, so byte accounting, geometry validation and
+    bit-exactness are exercised on every transfer exactly as a network
+    transport would; `wire=False` passes arrays through zero-copy
+    (bytes counted analytically) for tests that isolate the transport
+    interface from the codec."""
+
+    def __init__(self, wire=True):
+        super().__init__()
+        self.wire = bool(wire)
+        self._inbox = {}            # (dst, key) -> {"state", "chunks"}
+
+    def _box(self, dst, key):
+        return self._inbox.setdefault((dst, key),
+                                      {"state": None, "chunks": []})
+
+    def send_chunk(self, src, dst, key, meta, chunk):
+        if self.wire:
+            data = encode_chunk(meta, chunk)
+            self._note(len(data), len(data))
+            meta, chunk = decode_chunk(data)
+        else:
+            nb = chunk.nbytes
+            self._note(nb, nb)
+        self.blocks_sent += chunk.count
+        self._box(dst, key)["chunks"].append(chunk)
+
+    def send_ticket(self, src, dst, key, ticket):
+        for chunk in ticket.chunks:
+            self.send_chunk(src, dst, key, ticket.kv_meta, chunk)
+        if self.wire:
+            data = encode_state(ticket)
+            self._note(len(data), len(data))
+            state = decode_state(data)
+        else:
+            state = ticket.state_dict()
+            self._note(64, 64)       # nominal host-state frame
+        self.tickets_sent += 1
+        self._box(dst, key)["state"] = state
+
+    def collect(self, dst, key):
+        box = self._inbox.pop((dst, key), None)
+        if box is None or box["state"] is None:
+            raise KeyError(f"no complete ticket for ({dst!r}, {key!r})")
+        chunks = sorted(box["chunks"], key=lambda c: c.start)
+        return MigrationTicket(chunks=chunks, **box["state"])
+
+    def pending(self, dst, key):
+        return (dst, key) in self._inbox
+
+    def drop(self, dst, key):
+        self._inbox.pop((dst, key), None)

@@ -28,6 +28,16 @@ counts / dropped totals / the balance-loss gauge ride the step
 outputs (docs/MOE.md). `serving.distributed.TPServingEngine` adds
 TP x EP sharding over a 2-D (ep, mp) mesh.
 
+Disaggregated roles (docs/SERVING.md "Disaggregated serving"):
+`role="prefill"` parks each request in the "handoff" state right after
+its first sampled token — `extract_request` then exports its KV blocks
+(int8 scale rows included) into a `MigrationTicket` a decode-role
+engine admits mid-stream via `submit_migrated`, with greedy outputs
+token-identical to a monolithic engine; `role="decode"` defaults to a
+decode-sized token budget and admits migrated requests by IMPORTING
+their blocks at scheduler admission (never a new compiled shape — the
+one-compile contract holds across migration admits).
+
 With `draft_k > 0` (greedy only) each decode feeds a verify group —
 the last accepted token plus up to draft_k n-gram prompt-lookup
 proposals (`serving.draft`) — through a fixed `[max_slots, draft_k+1]`
@@ -59,7 +69,8 @@ class ServingEngine:
                  num_blocks=None, max_seq_len=None, token_budget=None,
                  sampling=None, eos_token_id=None, cache_dtype=None,
                  kv_dtype=None, seed=0, clock=time.monotonic,
-                 draft_k=0, draft_ngram=3, prefix_caching=False):
+                 draft_k=0, draft_ngram=3, prefix_caching=False,
+                 role="mixed"):
         import functools
 
         import jax
@@ -105,6 +116,17 @@ class ServingEngine:
         if num_blocks is None:
             # full residency for every slot, + the reserved null block
             num_blocks = max_slots * mbps + 1
+        # disaggregated serving role (docs/SERVING.md): "prefill" runs
+        # chunked prefill only — the request parks in the "handoff"
+        # state after its first sampled token and the frontend extracts
+        # it toward a decode replica; "decode" behaves like "mixed" at
+        # the engine level (it can still re-prefill a preempted
+        # migrant) but defaults to a decode-sized token budget. The
+        # router's dispatch policy is what keeps fresh prompts off
+        # decode replicas.
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        self.role = role
         self.draft_k = int(draft_k)
         self.sampling = sampling or SamplingConfig()
         self.speculation_disabled = False
@@ -125,7 +147,7 @@ class ServingEngine:
                               and self.sampling.strategy != "greedy")
         self.token_budget = batcher.choose_token_budget(
             max_slots, self.block_size, token_budget,
-            verify_width=self.draft_k + 1)
+            verify_width=self.draft_k + 1, role=self.role)
         dtype = cache_dtype or getattr(model, "_gen_cache_dtype",
                                        "bfloat16")
         self.kv = PagedKVCache(
@@ -171,6 +193,7 @@ class ServingEngine:
         self._kernel_buckets = self._note_kernel_buckets()
         self._preempt_seen = 0
         self._prefix_seen = (0, 0, 0)    # hit / miss / evicted deltas
+        self._imported_seen = 0          # kv.blocks_imported delta
         self.steps_run = 0
         # cumulative MoE routing state (host mirrors of the per-step
         # device stats; the smoke contracts read these directly)
@@ -472,6 +495,90 @@ class ServingEngine:
             smetrics.SERVING_REQUESTS.labels("cancelled").inc()
         return ok
 
+    # -------------------------------------------- migration (disagg)
+    def _slot_chunk(self, req, first_block, last_block):
+        """Export `req`'s table blocks [first_block, last_block) as one
+        transport chunk (None when the range is empty)."""
+        row = self.kv.slot_blocks(req.slot)
+        ids = row[first_block:last_block]
+        if not ids:
+            return None
+        from .distributed.transport import BlockChunk
+        return BlockChunk(start=int(first_block), count=len(ids),
+                          arrays=self.kv.export_blocks(ids))
+
+    def export_unshipped(self, req):
+        """Stream-ahead export for a prefill in flight: the FULL blocks
+        written since the last call (a full block's contents are final
+        — later chunks write later blocks, and decode writes land past
+        the prompt), so the decode side holds most of the KV before
+        the handoff ticket even exists. Returns a BlockChunk or None."""
+        if req.slot < 0:
+            return None
+        full = int(self.kv.slot_lens[req.slot]) // self.block_size
+        chunk = self._slot_chunk(req, req.shipped_blocks, full)
+        if chunk is not None:
+            req.shipped_blocks = full
+        return chunk
+
+    def extract_request(self, req):
+        """Pull a resident request out of this engine for migration:
+        export the blocks not yet streamed ahead (all of them for a
+        decode shed), capture the host state, then free the slot.
+        Returns the `MigrationTicket` the destination's
+        `submit_migrated` consumes. Greedy parity contract: the ticket
+        carries bit-exact KV (scales included) and the full token
+        history, so the destination continues the stream exactly as
+        this engine would have (docs/SERVING.md)."""
+        if req.slot < 0 or req.state not in ("decode", "handoff"):
+            raise ValueError(
+                f"request {req.req_id} not extractable "
+                f"(state={req.state!r}, slot={req.slot})")
+        from .distributed.transport import MigrationTicket
+        slot_len = int(self.kv.slot_lens[req.slot])
+        total = self.kv.blocks_for(slot_len)
+        chunks = []
+        tail = self._slot_chunk(req, req.shipped_blocks, total)
+        if tail is not None:
+            chunks.append(tail)
+        ticket = MigrationTicket(
+            prompt=list(req.prompt), output=list(req.output),
+            max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id, deadline=req.deadline,
+            tenant=req.tenant, slot_len=slot_len, total_blocks=total,
+            kv_meta=self.kv.kv_meta(), chunks=chunks,
+            submit_time=req.submit_time,
+            first_token_time=req.first_token_time,
+            cache_hit_tokens=req.cache_hit_tokens,
+            preemptions=req.preemptions, created_at=self.clock())
+        self.scheduler.extract(req)
+        if _pmetrics._enabled:
+            smetrics.SERVING_REQUESTS.labels("migrated").inc()
+        return ticket
+
+    def submit_migrated(self, ticket):
+        """Admit a migrated request: validates the transported pool
+        geometry against this engine's, then queues the ticket — the
+        scheduler imports its blocks into a slot at the next plan (so
+        the mixed step's shapes, and its one-compile contract, are
+        untouched by the admission). Returns the Request handle."""
+        mine = self.kv.kv_meta()
+        theirs = dict(ticket.kv_meta or {})
+        if theirs != mine:
+            raise ValueError(
+                f"migrated KV geometry {theirs} does not match this "
+                f"engine's {mine} — disaggregated replicas must share "
+                "block_size/kv_dtype/layer geometry")
+        covered = sum(c.count for c in ticket.chunks)
+        if covered != ticket.total_blocks:
+            raise ValueError(
+                f"ticket carries {covered} blocks but declares "
+                f"{ticket.total_blocks} — transport lost a chunk")
+        req = self.scheduler.submit_migrated(ticket)
+        if _pmetrics._enabled:
+            smetrics.SERVING_QUEUE_DEPTH.set(len(self.scheduler.queue))
+        return req
+
     def _penalty_history(self):
         """Fixed `[max_slots, penalty_window]` int32 context window for
         the in-step logit processors: each resident slot's last W
@@ -585,7 +692,14 @@ class ServingEngine:
         for slot in sp.prefill_done:
             req = sch.slots[slot]
             if req is not None:
-                emit(req, [int(tok_np[slot])])
+                done = emit(req, [int(tok_np[slot])])
+                if not done and self.role == "prefill":
+                    # prefill-role handoff point: the first token is
+                    # sampled, every prompt token's K/V is written —
+                    # the request parks until the frontend extracts it
+                    # toward a decode replica (a request that finished
+                    # AT its first token never migrates)
+                    req.state = "handoff"
         if self.draft_k:
             from .draft import accept_length, accept_length_sampled
             for slot, toks, pos in sp.decode_entries:
@@ -644,6 +758,10 @@ class ServingEngine:
             if new_p:
                 smetrics.SERVING_PREEMPTIONS.inc(new_p)
                 self._preempt_seen = sch.preemption_count
+            new_imp = self.kv.blocks_imported - self._imported_seen
+            if new_imp:
+                smetrics.SERVING_KV_BLOCKS_MIGRATED.inc(new_imp)
+                self._imported_seen = self.kv.blocks_imported
             if self.prefix_cache is not None:
                 pc = self.prefix_cache
                 h0, m0, e0 = self._prefix_seen

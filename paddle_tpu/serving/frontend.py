@@ -51,6 +51,18 @@ class FrontendClosed(Exception):
     """The frontend was stopped while the request was in flight."""
 
 
+class RequestMigrated(Exception):
+    """The request left this replica mid-stream (prefill handoff or a
+    load-shedding migration). Carries the `MigrationTicket` — KV block
+    payload plus host state — the router re-submits elsewhere; tokens
+    already streamed stay delivered (the ticket's `output` includes
+    them, so the destination publishes only what comes after)."""
+
+    def __init__(self, ticket):
+        super().__init__("request migrated away")
+        self.ticket = ticket
+
+
 class FrontendHandle:
     """One in-flight request as seen by a caller."""
 
@@ -64,6 +76,13 @@ class FrontendHandle:
         self.published = 0
         self.cancel_requested = False
         self.terminal = False
+        # disaggregated serving (docs/SERVING.md): inbound migrations
+        # carry their ticket until engine admission; prefill handoffs
+        # stream completed blocks through `on_blocks`; `shed()` flags
+        # live decodes for extraction at the next step boundary
+        self.ticket = None
+        self.on_blocks = None
+        self.extract_requested = False
 
     @property
     def tokens(self):
@@ -148,12 +167,16 @@ class ServingFrontend:
 
     # ------------------------------------------------------------ intake
     async def _enqueue(self, prompt, max_new_tokens, tenant, timeout):
-        if self._closed or self._task is None:
-            raise FrontendClosed("frontend is not running")
         deadline = (self.engine.clock() + float(timeout)
                     if timeout is not None else None)
         handle = FrontendHandle(list(prompt), int(max_new_tokens),
                                 str(tenant), deadline)
+        return await self._enqueue_handle(handle)
+
+    async def _enqueue_handle(self, handle):
+        if self._closed or self._task is None:
+            raise FrontendClosed("frontend is not running")
+        deadline = handle.deadline
         while not self._fair.push(handle.tenant, handle):
             # bounded queue full: wait until the step loop drains
             # space — but never past the request's own deadline (a
@@ -186,18 +209,48 @@ class ServingFrontend:
         return out
 
     async def stream(self, prompt, max_new_tokens=32, *,
-                     tenant="default", timeout=None, on_admitted=None):
+                     tenant="default", timeout=None, on_admitted=None,
+                     on_blocks=None):
         """Async generator of generated tokens, one per decode step
         (speculative acceptance can deliver several per step). Closing
         the generator — or cancelling its consumer — cancels the
         request and reclaims its resources. `on_admitted` (if given)
         is called once the request is in the fair queue — i.e. visible
         to this frontend's own accounting; the router uses it to stop
-        double-counting the dispatch in its load estimate."""
+        double-counting the dispatch in its load estimate.
+
+        `on_blocks` (disaggregated serving) is called after each step
+        with a `BlockChunk` of KV blocks the prefill completed since
+        the last call — the router ships them ahead to the handoff
+        destination. On a prefill-role engine the stream ends with
+        `RequestMigrated(ticket)` once the first token is sampled."""
         handle = await self._enqueue(prompt, max_new_tokens, tenant,
                                      timeout)
+        handle.on_blocks = on_blocks
         if on_admitted is not None:
             on_admitted()
+        async for tok in self._consume(handle):
+            yield tok
+
+    async def stream_ticket(self, ticket, *, on_admitted=None):
+        """Admit a migrated-in request (disaggregated serving): the
+        ticket's KV blocks are imported at engine admission and tokens
+        stream from where the source replica left off — `published`
+        starts past the ticket's already-delivered output, so nothing
+        is re-sent. Deadline/tenant/backpressure semantics match
+        `stream` (the ticket carries the original absolute deadline)."""
+        handle = FrontendHandle(list(ticket.prompt),
+                                int(ticket.max_new_tokens),
+                                str(ticket.tenant), ticket.deadline)
+        handle.ticket = ticket
+        handle.published = len(ticket.output)
+        await self._enqueue_handle(handle)
+        if on_admitted is not None:
+            on_admitted()
+        async for tok in self._consume(handle):
+            yield tok
+
+    async def _consume(self, handle):
         try:
             while True:
                 item = await handle.queue.get()
@@ -254,17 +307,82 @@ class ServingFrontend:
                 self._finish_handle(handle, DeadlineExceeded())
                 continue
             try:
-                handle.req = self.engine.submit(
-                    handle.prompt, handle.max_new_tokens,
-                    deadline=handle.deadline, tenant=handle.tenant)
-            except ValueError as e:      # oversized / empty prompt
-                self._finish_handle(handle, e)
+                if handle.ticket is not None:
+                    # migrated-in request: block import happens at the
+                    # scheduler's next plan, not here — engine state
+                    # only mutates between steps either way
+                    handle.req = self.engine.submit_migrated(
+                        handle.ticket)
+                    handle.ticket = None
+                else:
+                    handle.req = self.engine.submit(
+                        handle.prompt, handle.max_new_tokens,
+                        deadline=handle.deadline, tenant=handle.tenant)
+            except ValueError as e:      # oversized / empty prompt /
+                self._finish_handle(handle, e)  # mismatched KV geometry
                 continue
             self._live.append(handle)
         self._space.set()
 
+    def shed(self, n=1):
+        """Flag up to `n` live decodes for extraction at the next step
+        boundary (load shedding, disaggregated serving): each victim's
+        stream ends with `RequestMigrated(ticket)` and the router
+        re-places it on a lighter replica. Victims are the decodes with
+        the MOST remaining work (max_new_tokens - generated), so one
+        migration sheds the most future load; requests that have not
+        produced a token yet are skipped (nothing to hand off
+        mid-stream — they are cheaper to let finish prefill first).
+        Returns how many were flagged."""
+        cands = [h for h in self._live
+                 if not h.terminal and not h.cancel_requested
+                 and not h.extract_requested and h.req is not None
+                 and h.req.state == "decode" and h.req.output]
+        cands.sort(key=lambda h: (
+            -(h.req.max_new_tokens - len(h.req.output)),
+            h.req.arrival))
+        picked = cands[:int(n)]
+        for h in picked:
+            h.extract_requested = True
+        if picked:
+            self._wake.set()
+        return len(picked)
+
+    def _apply_extractions(self):
+        """Extract shed-flagged decodes (between steps, loop thread —
+        the same engine-mutation discipline as cancellation). Tokens
+        generated before the flag were published by the previous
+        `_publish`, so the migration sentinel is strictly ordered
+        after every delivered token."""
+        for handle in list(self._live):
+            if not handle.extract_requested or handle.terminal:
+                continue
+            req = handle.req
+            if req is None or req.state != "decode" or not req.output:
+                continue                 # not extractable (yet)
+            self._live.remove(handle)
+            ticket = self.engine.extract_request(req)
+            self._finish_handle(handle, RequestMigrated(ticket))
+
+    def _stream_blocks(self):
+        """Ship newly completed prefill blocks for handoff-destined
+        requests (runs right after each step, before `_publish`, so
+        the extraction tail stays minimal)."""
+        for handle in self._live:
+            if handle.on_blocks is None or handle.terminal:
+                continue
+            req = handle.req
+            if req is None or req.slot < 0 or req.state != "prefill":
+                continue
+            chunk = self.engine.export_unshipped(req)
+            if chunk is not None:
+                handle.on_blocks(chunk)
+
     def _publish(self):
-        """Push newly generated tokens + terminal states to waiters."""
+        """Push newly generated tokens + terminal states to waiters.
+        On a prefill-role engine, requests that reached the "handoff"
+        state (first token sampled) are extracted HERE — their stream
+        delivers the token(s) first, then `RequestMigrated(ticket)`."""
         for handle in list(self._live):
             req = handle.req
             n = len(req.output)
@@ -280,6 +398,10 @@ class ServingFrontend:
                     self._finish_handle(handle, DeadlineExceeded())
                 else:
                     self._finish_handle(handle, RequestCancelled())
+            elif req.state == "handoff":
+                self._live.remove(handle)
+                ticket = self.engine.extract_request(req)
+                self._finish_handle(handle, RequestMigrated(ticket))
 
     def _next_pending_deadline(self):
         # handles waiting in the frontend queue never reach the
@@ -314,10 +436,12 @@ class ServingFrontend:
         backoff = 0.0
         while not self._closed:
             self._apply_cancellations()
+            self._apply_extractions()
             self._admit_pending()
             if self.engine.scheduler.has_work:
                 self.step_calls += 1
                 did = await loop.run_in_executor(None, self.engine.step)
+                self._stream_blocks()
                 self._publish()
                 if did:
                     backoff = 0.0

@@ -155,6 +155,16 @@ class PagedKVCache:
         # before an allocation is refused
         self.prefix_cache = None
         self._copy_fn = None
+        # block transport (serving.distributed.transport): jitted
+        # gather/scatter executables per pow2 id-width, raw transfer
+        # counters (the engine mirrors them into the metrics registry),
+        # and an optional re-placement hook a sharded engine installs
+        # so imported pools return to their canonical mesh sharding
+        # (a spec drift here would silently recompile the mixed step)
+        self._transfer_fns = {}
+        self.place_pools = None
+        self.blocks_exported = 0
+        self.blocks_imported = 0
 
     # ------------------------------------------------------------ sizing
     @property
@@ -310,6 +320,188 @@ class PagedKVCache:
         else:
             self.k_pool, self.v_pool = self._copy_fn(
                 self.k_pool, self.v_pool, jnp.int32(src), jnp.int32(dst))
+
+    # ------------------------------------------------- block transport
+    def kv_meta(self):
+        """The pool geometry a KV transfer must agree on end to end —
+        shipped in every codec frame so a mismatched fleet is refused
+        at import instead of corrupting a pool."""
+        return {"num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "head_dim": self.head_dim,
+                "block_size": self.block_size,
+                "dtype": self.dtype,
+                "kv_dtype": self.kv_dtype}
+
+    def _transfer_fn(self, kind, width):
+        """Jitted gather ("export") / donated scatter ("import") over
+        the pools for a `[width]` block-id vector. One instrumented
+        instance per (kind, pow2 width): ids ride as traced values, so
+        every transfer of up to `width` blocks reuses the same
+        executable — no per-block (or per-count) compile."""
+        fn = self._transfer_fns.get((kind, width))
+        if fn is not None:
+            return fn
+        import jax.numpy as jnp
+
+        from ..jit.functional import instrumented_jit
+
+        if kind == "export":
+            if self.quantized:
+                def gather(kp, vp, ks, vs, ids):
+                    return (jnp.moveaxis(kp[:, ids], 1, 0),
+                            jnp.moveaxis(vp[:, ids], 1, 0),
+                            jnp.moveaxis(ks[:, ids], 1, 0),
+                            jnp.moveaxis(vs[:, ids], 1, 0))
+            else:
+                def gather(kp, vp, ids):
+                    return (jnp.moveaxis(kp[:, ids], 1, 0),
+                            jnp.moveaxis(vp[:, ids], 1, 0))
+            fn = instrumented_jit(gather, "serving_kv_export")
+        elif kind == "import":
+            if self.quantized:
+                def scatter(kp, vp, ks, vs, ids, pk, pv, pks, pvs):
+                    return (kp.at[:, ids].set(jnp.moveaxis(pk, 0, 1)),
+                            vp.at[:, ids].set(jnp.moveaxis(pv, 0, 1)),
+                            ks.at[:, ids].set(jnp.moveaxis(pks, 0, 1)),
+                            vs.at[:, ids].set(jnp.moveaxis(pvs, 0, 1)))
+
+                fn = instrumented_jit(scatter, "serving_kv_import",
+                                      donate_argnums=(0, 1, 2, 3))
+            else:
+                def scatter(kp, vp, ids, pk, pv):
+                    return (kp.at[:, ids].set(jnp.moveaxis(pk, 0, 1)),
+                            vp.at[:, ids].set(jnp.moveaxis(pv, 0, 1)))
+
+                fn = instrumented_jit(scatter, "serving_kv_import",
+                                      donate_argnums=(0, 1))
+        else:
+            raise ValueError(f"unknown transfer kind {kind!r}")
+        self._transfer_fns[(kind, width)] = fn
+        return fn
+
+    def _pools(self):
+        if self.quantized:
+            return [self.k_pool, self.v_pool, self.k_scale, self.v_scale]
+        return [self.k_pool, self.v_pool]
+
+    def export_blocks(self, block_ids):
+        """Read `block_ids`' pool columns out to host arrays: a tuple
+        `(k, v)` — plus `(k_scale, v_scale)` for int8 pools — each
+        `[n, L, BS, ...]` (block-major, so one block's bytes are
+        contiguous for the wire codec). One jitted fixed-shape gather
+        per pow2 id-width; ids need not be contiguous or ordered. The
+        int8 scale rows ride the same block coordinates by
+        construction, so an exported block dequantizes identically
+        wherever it lands."""
+        import jax.numpy as jnp
+
+        from .batcher import next_pow2
+        ids = [int(b) for b in block_ids]
+        if not ids:
+            raise ValueError("export_blocks needs at least one block")
+        n = len(ids)
+        width = next_pow2(n, lo=1)
+        padded = np.zeros(width, np.int32)     # pad with the NULL block
+        padded[:n] = ids
+        out = self._transfer_fn("export", width)(
+            *self._pools(), jnp.asarray(padded))
+        self.blocks_exported += n
+        return tuple(np.asarray(a)[:n] for a in out)
+
+    def import_blocks(self, block_ids, arrays):
+        """Scatter transported block payloads into `block_ids` (already
+        allocated by the caller): the donated-pool inverse of
+        `export_blocks`, one jitted fixed-shape scatter per pow2
+        id-width. Payload dtypes/shapes are validated against the pool
+        geometry first — a mismatched fleet is refused, never written.
+        Padding entries land in the reserved NULL block, which is never
+        read through."""
+        import jax.numpy as jnp
+
+        from .batcher import next_pow2
+        ids = [int(b) for b in block_ids]
+        if not ids:
+            raise ValueError("import_blocks needs at least one block")
+        n = len(ids)
+        pools = self._pools()
+        if len(arrays) != len(pools):
+            raise ValueError(
+                f"expected {len(pools)} payload arrays for "
+                f"kv_dtype={self.kv_dtype!r}, got {len(arrays)}")
+        for a, p in zip(arrays, pools):
+            expect = (n, p.shape[0]) + tuple(p.shape[2:])
+            if tuple(a.shape) != expect or str(a.dtype) != str(p.dtype):
+                raise ValueError(
+                    f"payload {tuple(a.shape)}/{a.dtype} does not match "
+                    f"pool geometry {expect}/{p.dtype}")
+        width = next_pow2(n, lo=1)
+        padded_ids = np.zeros(width, np.int32)
+        padded_ids[:n] = ids
+        payload = []
+        for a in arrays:
+            a = np.asarray(a)
+            if width > n:
+                a = np.concatenate(
+                    [a, np.zeros((width - n,) + a.shape[1:], a.dtype)],
+                    axis=0)
+            payload.append(jnp.asarray(a))
+        out = self._transfer_fn("import", width)(
+            *pools, jnp.asarray(padded_ids), *payload)
+        if self.quantized:
+            (self.k_pool, self.v_pool,
+             self.k_scale, self.v_scale) = out
+        else:
+            self.k_pool, self.v_pool = out
+        self.blocks_imported += n
+        if self.place_pools is not None:
+            # sharded engines re-pin the canonical pool sharding so the
+            # next mixed step's input specs are byte-identical (the
+            # PR 8/PR 10 silent-recompile lesson)
+            self.place_pools(self)
+
+    def import_into_slot(self, slot, slot_len, chunks):
+        """Admit a migrated request's KV: allocate destination blocks
+        covering `slot_len` tokens, scatter the transported chunks into
+        them, and wire up `slot`'s table. Chunk coverage is validated
+        to be exactly blocks [0, blocks_for(slot_len)) with no gaps
+        BEFORE any allocation. Returns False (state unchanged) when the
+        free list — after the prefix-cache eviction backstop — cannot
+        supply the blocks; the scheduler leaves the request queued and
+        retries next plan."""
+        if slot_len <= 0:
+            raise ValueError(f"import_into_slot needs slot_len >= 1, "
+                             f"got {slot_len}")
+        need = self.blocks_for(slot_len)
+        ordered = sorted(chunks, key=lambda c: c.start)
+        at = 0
+        for c in ordered:
+            if c.start != at:
+                raise ValueError(
+                    f"migration chunks leave a gap at block {at} "
+                    f"(next chunk starts at {c.start})")
+            at += c.count
+        if at != need:
+            raise ValueError(
+                f"migration chunks cover {at} blocks but slot_len="
+                f"{slot_len} needs {need}")
+        if self._slot_blocks[slot]:
+            raise ValueError(f"slot {slot} is not empty")
+        got = self._alloc(need)
+        if got is None:
+            return False
+        try:
+            for c in ordered:
+                self.import_blocks(got[c.start:c.start + c.count],
+                                   c.arrays)
+        except Exception:
+            self.allocator.free(got)
+            raise
+        self._slot_blocks[slot] = list(got)
+        self.block_tables[slot, :need] = got
+        self.block_tables[slot, need:] = NULL_BLOCK
+        self.slot_lens[slot] = slot_len
+        return True
 
     def truncate_slot(self, slot, new_len):
         """Roll back `slot` to cover only `new_len` tokens: blocks past

@@ -75,11 +75,37 @@ SERVING_PREFIX_EVICTIONS = REGISTRY.counter(
     "paddle_tpu_serving_prefix_cache_evictions_total",
     "Cached KV blocks reclaimed by LRU eviction under pool pressure")
 
+# ---- disaggregated serving (serving.distributed.transport) -------------
+SERVING_KV_BLOCKS_MIGRATED = REGISTRY.counter(
+    "paddle_tpu_serving_kv_blocks_migrated_total",
+    "KV blocks imported into a replica's pool from a prefill handoff "
+    "or a load-shedding migration (int8 scale rows ride along)")
+SERVING_KV_TRANSPORT_BYTES = REGISTRY.counter(
+    "paddle_tpu_serving_kv_transport_bytes_total",
+    "Bytes moved by the KV block transport (codec frames: headers + "
+    "K/V payloads + scale rows + ticket state)",
+    ("direction",))   # sent|received
+SERVING_HANDOFF_LATENCY = REGISTRY.histogram(
+    "paddle_tpu_serving_handoff_latency_seconds",
+    "Stream gap a migration causes: ticket extraction on the source "
+    "to the first token emitted by the destination replica",
+    buckets=exponential_buckets(1e-4, 4.0, 10))
+
 # ---- multi-replica router (serving.distributed.router) -----------------
 ROUTER_REQUESTS = REGISTRY.counter(
     "paddle_tpu_serving_router_requests_total",
     "Router dispatches by replica and outcome",
-    ("replica", "outcome"))   # finished|failover|expired|cancelled|error
+    ("replica", "outcome"))
+# outcomes: finished|failover|expired|cancelled|error|migrated
+ROUTER_MIGRATIONS = REGISTRY.counter(
+    "paddle_tpu_serving_router_migrations_total",
+    "Live-request migrations the router orchestrated",
+    ("reason",))   # handoff (prefill->decode) | shed (load balancing)
+ROUTER_DISPATCH_ROLE = REGISTRY.counter(
+    "paddle_tpu_serving_router_prefill_decode_dispatch_total",
+    "Dispatches by target replica role (disaggregated fleets count "
+    "one prefill and one decode dispatch per handed-off request)",
+    ("role",))   # prefill|decode|mixed
 ROUTER_AFFINITY_HITS = REGISTRY.counter(
     "paddle_tpu_serving_router_affinity_hits_total",
     "Dispatches routed to a replica whose shadow radix index already "
@@ -137,6 +163,14 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_router_failovers_total",
     "paddle_tpu_serving_router_replica_queue_depth",
     "paddle_tpu_serving_router_replicas_up",
+    # disaggregated prefill/decode serving (ISSUE 13): block transport
+    # volume, migration counts by reason, per-role dispatch, and the
+    # stream gap a handoff/shed costs the caller
+    "paddle_tpu_serving_kv_blocks_migrated_total",
+    "paddle_tpu_serving_kv_transport_bytes_total",
+    "paddle_tpu_serving_handoff_latency_seconds",
+    "paddle_tpu_serving_router_migrations_total",
+    "paddle_tpu_serving_router_prefill_decode_dispatch_total",
     # MoE serving (ISSUE 10): per-expert routing volume, capacity
     # drops, cumulative utilization entropy, latest balance loss
     "paddle_tpu_moe_expert_tokens_total",

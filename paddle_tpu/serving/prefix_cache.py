@@ -44,6 +44,17 @@ nothing) degrades to PR 2 behaviour, and outputs are token-identical
 either way because cached K/V is exactly what re-prefilling the same
 tokens through the same compiled step would write.
 
+Disaggregated serving (docs/SERVING.md) needs NO code here either, by
+the same argument: a migrated-away request's slot releases through the
+ordinary `release_slot`/`unlock_slot` path — shared prefix blocks it
+adopted stay cached on the SOURCE replica (the tree holds its own
+refcount), so the prefill replica that published a prompt head keeps
+serving it to future same-head requests after every handoff; and
+blocks imported on the destination are bit-exact copies of what
+re-prefilling would have written there, so the destination's
+finish-time `insert` publishes a valid chat-turn prefix built from
+transported blocks.
+
 Quantized pools (`PagedKVCache(kv_dtype="int8")`) need NO code here:
 the per-entry-per-head scale arrays are indexed by the same
 `(block, offset)` coordinates as the K/V bytes, so adoption shares

@@ -19,9 +19,18 @@ KV cache:
   keeps admission from thrashing running decodes.
 * **Deadlines** — an optional absolute deadline per request; queued or
   resident requests past it are expired and their blocks reclaimed.
+* **Migration** (disaggregated serving, docs/SERVING.md) — a request
+  arriving from another replica (`submit_migrated`) joins the FRONT of
+  the queue carrying its KV payload; admission IMPORTS the blocks into
+  a slot (`kv.import_into_slot`) instead of prefilling, and `extract`
+  releases a resident request migrating away (its blocks were exported
+  by the engine first). Prefill-role engines park completed prompts in
+  the `"handoff"` state, which plans neither prefill nor decode.
 
-The scheduler is pure host-side bookkeeping — it never touches device
-arrays; the engine turns its plans into the fixed-shape step inputs.
+The scheduler is pure host-side bookkeeping — it orchestrates through
+the kv-cache API (which owns any device work, like the import scatter)
+and never touches device arrays itself; the engine turns its plans
+into the fixed-shape step inputs.
 """
 from __future__ import annotations
 
@@ -43,13 +52,19 @@ class Request:                     # in sets/queues across state moves
     deadline: Optional[float] = None  # absolute time.monotonic()
     arrival: float = 0.0
     state: str = "queued"
-    # queued|prefill|decode|finished|expired|cancelled
+    # queued|prefill|handoff|decode|finished|expired|cancelled|migrated
     slot: int = -1
     output: list = dataclasses.field(default_factory=list)
     fed: int = 0                      # runtime-prompt tokens fed so far
     preemptions: int = 0
     cache_hit_tokens: int = 0         # prefix-cache tokens skipped
     tenant: str = "default"           # frontend fairness bucket
+    # disaggregated serving (serving.distributed.transport): inbound
+    # migrations carry their KV payload until admission imports it;
+    # prefill-role engines track which full blocks were already
+    # streamed ahead so extraction ships only the tail
+    ticket: Optional[object] = None
+    shipped_blocks: int = 0
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -116,6 +131,35 @@ class Scheduler:
         self.queue.append(req)
         return req
 
+    def submit_migrated(self, ticket):
+        """Queue a request migrated in from another replica: its KV
+        payload rides `req.ticket` until a slot frees and the blocks
+        fit, then admission IMPORTS the blocks instead of prefilling.
+        Joins the FRONT of the queue — like a preemption victim, the
+        request is already mid-stream and its caller is watching the
+        token gap. Timing fields carry over so TTFT is observed once
+        (on the source) and inter-token histograms stay continuous."""
+        total = len(ticket.prompt) + int(ticket.max_new_tokens) - 1
+        if total > self.kv.max_slot_tokens:
+            raise ValueError(
+                f"migrated request needs {total} cached tokens; a slot "
+                f"holds at most {self.kv.max_slot_tokens}")
+        now = self.clock()
+        req = Request(req_id=next(self._ids),
+                      prompt=list(ticket.prompt),
+                      max_new_tokens=int(ticket.max_new_tokens),
+                      eos_token_id=ticket.eos_token_id,
+                      deadline=ticket.deadline,
+                      arrival=now, submit_time=ticket.submit_time,
+                      tenant=str(ticket.tenant),
+                      output=list(ticket.output),
+                      cache_hit_tokens=int(ticket.cache_hit_tokens),
+                      preemptions=int(ticket.preemptions),
+                      ticket=ticket)
+        req.first_token_time = ticket.first_token_time
+        self.queue.appendleft(req)
+        return req
+
     @property
     def num_active(self):
         return sum(s is not None for s in self.slots)
@@ -154,6 +198,29 @@ class Scheduler:
             if not self.queue:
                 break
             if self.slots[slot] is None:
+                if self.queue[0].ticket is not None:
+                    # migrated request at the head: admission imports
+                    # its transported KV blocks instead of prefilling.
+                    # If the free list (after prefix-cache eviction)
+                    # can't cover them yet, it WAITS at the head —
+                    # head-of-line priority is deliberate: the request
+                    # is mid-stream and resuming it beats admitting
+                    # fresh prompts behind it.
+                    req = self.queue[0]
+                    if not self.kv.import_into_slot(
+                            slot, req.ticket.slot_len,
+                            req.ticket.chunks):
+                        break
+                    self.queue.popleft()
+                    req.slot = slot
+                    req.state = "decode"
+                    # the whole runtime prompt's K/V is resident — the
+                    # next step feeds output[-1] at position slot_len,
+                    # exactly like a post-prefill decode
+                    req.fed = len(req.runtime_prompt)
+                    req.ticket = None          # payload consumed
+                    self.slots[slot] = req
+                    continue
                 req = self.queue.popleft()
                 req.slot = slot
                 req.state = "prefill"
@@ -323,6 +390,20 @@ class Scheduler:
             self.prefix_cache.insert(req.slot,
                                      (req.prompt + req.output)[:n])
         self._free_slot(req)
+
+    def extract(self, req, now=None):
+        """Release a resident request that is migrating away: its slot,
+        blocks and prefix locks are reclaimed here (the engine exported
+        the block payload FIRST), and the request reaches the terminal-
+        for-this-replica state "migrated" — it keeps producing tokens,
+        just on another engine. Shared prefix blocks the slot adopted
+        stay cached (refcounted), so the source replica keeps serving
+        the prefix to future same-head requests."""
+        if req.slot < 0:
+            raise ValueError(f"request {req.req_id} is not resident")
+        self._free_slot(req)
+        req.state = "migrated"
+        req.finish_time = self.clock() if now is None else now
 
     def cancel(self, req, now=None):
         """Abort a queued or resident request: its blocks (and prefix

@@ -489,3 +489,40 @@ def test_fused_allreduce_gradients_scales_by_dp_world(monkeypatch):
         list(lin.parameters()), hcg=FakeHcg())
     np.testing.assert_allclose(lin.weight.grad.numpy(), g1 * 2.0 / 4.0,
                                rtol=1e-6)
+
+
+def test_tensor_numpy_is_an_owning_snapshot():
+    """Paddle parity: Tensor.numpy() returns a writable COPY that
+    never aliases the live device buffer. The sharp edge this pins:
+    a zero-copy view of a param taken before a DONATED compiled step
+    can be silently rewritten in place when the step's executable
+    comes out of the persistent compilation cache (the deserialized
+    path skips PJRT's external-reference copy protection), which made
+    a pre-training snapshot equal the post-training weights."""
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    a = t.numpy()
+    assert a.flags.owndata and a.base is None
+    assert a.flags.writeable
+    a[:] = -1.0                      # mutating the snapshot ...
+    np.testing.assert_array_equal(   # ... never touches the tensor
+        t.numpy(), np.arange(12, dtype=np.float32).reshape(3, 4))
+
+    # the end-to-end shape of the original bug: snapshot, run a
+    # donated compiled fit, snapshot again — they must differ
+    paddle.seed(7)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    before = net[0].weight.numpy()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-2,
+                                        parameters=model.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    from paddle_tpu.io import TensorDataset
+    xs = rng.rand(32, 4).astype(np.float32)
+    ys = rng.randint(0, 2, (32, 1))
+    model.fit(TensorDataset([xs, ys]), epochs=2, batch_size=8,
+              verbose=0)
+    assert model._jit_ok
+    assert not np.allclose(net[0].weight.numpy(), before), \
+        "numpy() snapshot aliased the donated param buffer"

@@ -1,0 +1,247 @@
+"""KV block transport tests (ISSUE 13 tentpole a + satellite 1).
+
+Codec round-trip property tests (fp32/bf16/int8 pools, scale rows,
+non-contiguous block ids), the export/import jitted gather/scatter
+pair, `import_into_slot` coverage validation, and the in-process
+transport's byte accounting — with the allocator invariant
+(allocated + free + NULL == pool) asserted on BOTH pools after every
+transfer.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.distributed.transport import (
+    BlockChunk, InProcessTransport, MigrationTicket, decode_chunk,
+    decode_state, encode_chunk, encode_state)
+from paddle_tpu.serving.kv_cache import PagedKVCache
+
+
+def _kv(kv_dtype=None, num_blocks=17, block_size=4, layers=2, heads=3,
+        head_dim=5):
+    return PagedKVCache(layers, heads, head_dim, num_blocks=num_blocks,
+                        block_size=block_size, max_slots=4,
+                        max_blocks_per_slot=6, dtype="float32",
+                        kv_dtype=kv_dtype)
+
+
+def _fill_random(kv, rng):
+    """Deterministic random pool contents (host-built, device-put)."""
+    import jax.numpy as jnp
+    if kv.quantized:
+        kv.k_pool = jnp.asarray(rng.randint(
+            -127, 128, kv.k_pool.shape).astype(np.int8))
+        kv.v_pool = jnp.asarray(rng.randint(
+            -127, 128, kv.v_pool.shape).astype(np.int8))
+        kv.k_scale = jnp.asarray(
+            rng.rand(*kv.k_scale.shape).astype(np.float32))
+        kv.v_scale = jnp.asarray(
+            rng.rand(*kv.v_scale.shape).astype(np.float32))
+    else:
+        dt = kv.k_pool.dtype
+        kv.k_pool = jnp.asarray(
+            rng.randn(*kv.k_pool.shape)).astype(dt)
+        kv.v_pool = jnp.asarray(
+            rng.randn(*kv.v_pool.shape)).astype(dt)
+
+
+def _pool_cols(kv, ids):
+    """Host copies of the pools' columns at `ids`, in export layout."""
+    out = []
+    for p in kv._pools():
+        out.append(np.moveaxis(np.asarray(p)[:, ids], 1, 0))
+    return out
+
+
+class TestCodecRoundTrip:
+    @pytest.mark.parametrize("kv_dtype", [None, "bfloat16", "int8"])
+    def test_export_bytes_import_bit_exact(self, kv_dtype):
+        """export -> wire bytes -> import is bit-exact for every pool
+        dtype, INCLUDING the int8 scale rows, over random block sets
+        with non-contiguous, unordered ids."""
+        rng = np.random.RandomState(3)
+        src = _kv(kv_dtype)
+        _fill_random(src, rng)
+        for trial in range(4):
+            n = int(rng.randint(1, 9))
+            ids = rng.choice(np.arange(1, src.num_blocks), size=n,
+                             replace=False).tolist()
+            arrays = src.export_blocks(ids)
+            data = encode_chunk(src.kv_meta(), BlockChunk(0, n, arrays))
+            meta, chunk = decode_chunk(data)
+            assert meta == src.kv_meta()
+            for a, b in zip(arrays, chunk.arrays):
+                assert str(a.dtype) == str(b.dtype)
+                assert np.array_equal(np.asarray(a), b)
+            dst = _kv(kv_dtype)
+            got = dst.allocator.alloc(n)
+            dst.import_blocks(got, chunk.arrays)
+            assert src.allocator.invariant_ok
+            assert dst.allocator.invariant_ok
+            for s, d in zip(ids, got):
+                for ps, pd in zip(src._pools(), dst._pools()):
+                    assert np.array_equal(np.asarray(ps[:, s]),
+                                          np.asarray(pd[:, d])), \
+                        (kv_dtype, trial)
+
+    def test_import_touches_only_target_blocks(self):
+        """The pow2-padded scatter writes the target ids (and the NULL
+        block, which is never read through) — every other block's
+        contents survive bit-exactly."""
+        rng = np.random.RandomState(5)
+        src, dst = _kv(), _kv()
+        _fill_random(src, rng)
+        _fill_random(dst, rng)
+        before = np.asarray(dst.k_pool).copy()
+        got = dst.allocator.alloc(3)          # pow2 pads to width 4
+        dst.import_blocks(got, src.export_blocks([2, 9, 4]))
+        after = np.asarray(dst.k_pool)
+        untouched = [b for b in range(1, dst.num_blocks)
+                     if b not in got]
+        for b in untouched:
+            assert np.array_equal(before[:, b], after[:, b])
+
+    def test_geometry_mismatch_refused(self):
+        src = _kv()
+        dst = _kv(block_size=8, num_blocks=9)
+        arrays = src.export_blocks([1, 2])
+        got = dst.allocator.alloc(2)
+        with pytest.raises(ValueError, match="does not match"):
+            dst.import_blocks(got, arrays)
+        dst.allocator.free(got)
+        assert dst.allocator.invariant_ok
+
+    def test_quantized_payload_arity_enforced(self):
+        src = _kv()                            # fp pools: 2 arrays
+        dst = _kv("int8")                      # int8 wants 4
+        got = dst.allocator.alloc(1)
+        with pytest.raises(ValueError, match="payload arrays"):
+            dst.import_blocks(got, src.export_blocks([1]))
+        dst.allocator.free(got)
+
+    def test_state_frame_roundtrip(self):
+        t = MigrationTicket(
+            prompt=[1, 2, 3], output=[9, 8], max_new_tokens=16,
+            eos_token_id=None, deadline=12.5, tenant="t0", slot_len=4,
+            total_blocks=1, kv_meta=_kv().kv_meta(), chunks=[],
+            submit_time=1.0, first_token_time=2.0, cache_hit_tokens=4,
+            preemptions=1, created_at=3.0)
+        state = decode_state(encode_state(t))
+        rebuilt = MigrationTicket(chunks=[], **state)
+        assert rebuilt.prompt == t.prompt
+        assert rebuilt.output == t.output
+        assert rebuilt.kv_meta == t.kv_meta
+        assert rebuilt.deadline == t.deadline
+        assert rebuilt.first_token_time == t.first_token_time
+
+    def test_bad_frames_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            decode_chunk(b"NOPE" + b"\x00" * 16)
+        chunk_bytes = encode_chunk(_kv().kv_meta(),
+                                   BlockChunk(0, 1,
+                                              _kv().export_blocks([1])))
+        with pytest.raises(ValueError, match="state"):
+            decode_state(chunk_bytes)
+
+
+class TestImportIntoSlot:
+    def _chunks_for(self, src, ids, split_at=None):
+        if split_at is None:
+            return [BlockChunk(0, len(ids), src.export_blocks(ids))]
+        a, b = ids[:split_at], ids[split_at:]
+        return [BlockChunk(0, len(a), src.export_blocks(a)),
+                BlockChunk(split_at, len(b), src.export_blocks(b))]
+
+    def test_multi_chunk_coverage_assembles_in_order(self):
+        rng = np.random.RandomState(11)
+        src, dst = _kv(), _kv()
+        _fill_random(src, rng)
+        ids = [5, 2, 8]                        # a slot's table, in order
+        chunks = self._chunks_for(src, ids, split_at=2)
+        assert dst.import_into_slot(0, 3 * src.block_size, chunks[::-1])
+        assert dst.allocator.invariant_ok
+        row = dst.slot_blocks(0)
+        assert len(row) == 3
+        assert int(dst.slot_lens[0]) == 3 * src.block_size
+        for s, d in zip(ids, row):
+            assert np.array_equal(np.asarray(src.k_pool[:, s]),
+                                  np.asarray(dst.k_pool[:, d]))
+
+    def test_coverage_gap_and_short_cover_rejected(self):
+        src, dst = _kv(), _kv()
+        good = src.export_blocks([1, 2])
+        with pytest.raises(ValueError, match="gap"):
+            dst.import_into_slot(0, 3 * src.block_size,
+                                 [BlockChunk(1, 2, good)])
+        with pytest.raises(ValueError, match="cover"):
+            dst.import_into_slot(0, 3 * src.block_size,
+                                 [BlockChunk(0, 2, good)])
+        assert dst.allocator.num_used == 0
+        assert dst.allocator.invariant_ok
+
+    def test_dry_pool_returns_false_state_unchanged(self):
+        src = _kv()
+        dst = _kv(num_blocks=3)                # 2 allocatable blocks
+        hog = dst.allocator.alloc(2)
+        chunks = [BlockChunk(0, 2, src.export_blocks([1, 2]))]
+        assert dst.import_into_slot(0, 2 * src.block_size, chunks) \
+            is False
+        assert dst.slot_blocks(0) == []
+        assert int(dst.slot_lens[0]) == 0
+        assert dst.allocator.invariant_ok
+        dst.allocator.free(hog)
+        assert dst.import_into_slot(0, 2 * src.block_size, chunks)
+        assert dst.allocator.invariant_ok
+
+
+class TestInProcessTransport:
+    def _chunk(self, src, ids, start=0):
+        return BlockChunk(start, len(ids), src.export_blocks(ids))
+
+    def _ticket(self, src, chunks, total):
+        return MigrationTicket(
+            prompt=[1, 2], output=[3], max_new_tokens=8,
+            eos_token_id=None, deadline=None, tenant="a",
+            slot_len=total * src.block_size, total_blocks=total,
+            kv_meta=src.kv_meta(), chunks=chunks)
+
+    def test_wire_roundtrip_counts_bytes_and_blocks(self):
+        rng = np.random.RandomState(2)
+        src = _kv("int8")
+        _fill_random(src, rng)
+        t = InProcessTransport()
+        t.send_chunk("p0", "d0", "k", src.kv_meta(),
+                     self._chunk(src, [3, 7]))
+        t.send_ticket("p0", "d0", "k",
+                      self._ticket(src, [self._chunk(src, [9], start=2)],
+                                   total=3))
+        assert t.bytes_sent == t.bytes_received > 0
+        assert t.blocks_sent == 3
+        assert t.tickets_sent == 1
+        ticket = t.collect("d0", "k")
+        assert [(c.start, c.count) for c in ticket.chunks] \
+            == [(0, 2), (2, 1)]
+        assert ticket.kv_meta == src.kv_meta()
+        # wire mode decoded fresh arrays — bit-equal to the source
+        ref = src.export_blocks([3, 7])
+        for a, b in zip(ref, ticket.chunks[0].arrays):
+            assert np.array_equal(np.asarray(a), b)
+        assert not t.pending("d0", "k")       # collect pops
+
+    def test_collect_incomplete_or_dropped_raises(self):
+        src = _kv()
+        t = InProcessTransport()
+        t.send_chunk("p", "d", "k", src.kv_meta(),
+                     self._chunk(src, [1]))
+        with pytest.raises(KeyError):
+            t.collect("d", "k")               # no state frame yet
+        t.drop("d", "k")
+        assert not t.pending("d", "k")
+
+    def test_wire_off_passes_through_with_analytic_bytes(self):
+        src = _kv()
+        t = InProcessTransport(wire=False)
+        chunk = self._chunk(src, [1, 2])
+        t.send_ticket("p", "d", "k", self._ticket(src, [chunk], 2))
+        assert t.bytes_sent >= chunk.nbytes
+        got = t.collect("d", "k")
+        assert got.chunks[0].arrays[0] is chunk.arrays[0]  # zero-copy

@@ -1,0 +1,221 @@
+"""Disaggregated prefill/decode serving smoke run + contract check.
+
+CI contract (tests/test_disagg.py runs this in-process, the same way
+tests/test_router.py runs tools/router_smoke.py):
+
+* **Parity phase** — a Poisson stream of mixed-length prompts through
+  a 1-prefill + 2-decode `ReplicaRouter` fleet (`kv_dtype="int8"`, so
+  the block transport carries real scale rows; prefix caching on the
+  prefill replica; speculation on the decode replicas). Every request
+  hands off prefill->decode over the KV block transport, and outputs
+  must be token-identical to a solo monolithic engine — zero
+  duplicate, zero lost tokens across every migration.
+* **Live migration** — mid-stream, the busiest decode replica is asked
+  to shed; at least one shed migration must COMPLETE (the request
+  finishes on its new replica) with outputs still identical.
+* **Drain hygiene** — after the stream drains, every replica must hold
+  zero resident slots, zero allocated KV blocks once its prefix cache
+  is released (int8 scale rows share block coordinates, so the block
+  ledger covers them), and every allocator ledger must satisfy
+  allocated + free + NULL == pool.
+* **Metric contract** — every serving metric name in
+  `serving.metrics.CONTRACT_METRICS` must appear in the Prometheus
+  dump, with real activity on the migration/transport counters.
+
+Exit status is non-zero on any violation.
+
+Usage: JAX_PLATFORMS=cpu python tools/disagg_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_REQUESTS = 8
+MAX_NEW = 16
+
+
+def _workload(vocab=193):
+    """Deterministic Poisson stream: a shared 12-token head on half
+    the prompts (exercises prefix caching + placement), mixed tails."""
+    import random
+
+    import numpy as np
+    rng = np.random.RandomState(7)
+    head = rng.randint(1, vocab, 12).tolist()
+    gaps = random.Random(3)
+    t, events = 0.0, []
+    for i in range(N_REQUESTS):
+        t += 0.01 + min(gaps.expovariate(40.0), 0.15)
+        tail = rng.randint(1, vocab, int(rng.randint(4, 14))).tolist()
+        prompt = (head + tail) if i % 2 == 0 else tail
+        events.append((t, f"tenant{i % 3}", prompt))
+    return events
+
+
+def _fleet(model):
+    """1 prefill-role + 2 decode-role replicas, mixed steps warmed so
+    the Poisson schedule is not dominated by first-step compiles."""
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.frontend import ServingFrontend
+    pre = ServingEngine(model, max_slots=3, block_size=4,
+                        max_seq_len=64, cache_dtype="float32", seed=0,
+                        kv_dtype="int8", role="prefill",
+                        prefix_caching=True)
+    decs = [ServingEngine(model, max_slots=3, block_size=4,
+                          max_seq_len=64, cache_dtype="float32",
+                          seed=0, kv_dtype="int8", role="decode",
+                          draft_k=2)
+            for _ in range(2)]
+    for eng in [pre] + decs:
+        eng.generate_batch([[7, 7]], max_new_tokens=1)   # warm compile
+    return [ServingFrontend(e, max_pending=16) for e in [pre] + decs]
+
+
+def run_smoke():
+    import asyncio
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.distributed import ReplicaRouter
+    from paddle_tpu.serving.engine import ServingEngine
+
+    pm.enable()
+    paddle.seed(1234)
+    model = GPTForGeneration(vocab_size=193, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+    model.eval()
+    events = _workload()
+    prompts = [e[2] for e in events]
+    failures = []
+
+    # solo monolithic oracle: same int8 pools, same greedy math
+    solo = ServingEngine(model, max_slots=4, block_size=4,
+                         max_seq_len=64, cache_dtype="float32", seed=0,
+                         kv_dtype="int8")
+    oracle = solo.generate_batch(prompts, max_new_tokens=MAX_NEW)
+
+    fes = _fleet(model)
+    router = ReplicaRouter(fes, roles=["prefill", "decode", "decode"],
+                           probe_interval=0.02)
+
+    async def run():
+        async def fire(ev, t0):
+            t, tenant, prompt = ev
+            delay = t - (asyncio.get_event_loop().time() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return await router.submit(prompt, max_new_tokens=MAX_NEW,
+                                       tenant=tenant)
+
+        async def shed_once(t0):
+            # wait until decodes are live, then shed from the busiest
+            # decode replica; retry until a victim was flagged
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                busiest = max((1, 2), key=router.queue_depth)
+                if router.shed(busiest, 1):
+                    return
+
+        async with router:
+            t0 = asyncio.get_event_loop().time()
+            outs, _ = await asyncio.gather(
+                asyncio.gather(*[fire(ev, t0) for ev in events]),
+                shed_once(t0))
+        return outs
+
+    outs = asyncio.run(run())
+
+    if outs != oracle:
+        failures.append("disaggregated outputs diverge from the solo "
+                        "monolithic engine (duplicate or lost tokens)")
+    stats = router.stats()
+    if stats["migrations"]["handoff"] < N_REQUESTS:
+        failures.append(
+            f"expected every request to hand off, saw "
+            f"{stats['migrations']['handoff']}/{N_REQUESTS}")
+    if stats["migrations"]["shed"] < 1:
+        failures.append("no completed live (shed) migration")
+    if stats["transport"]["bytes_sent"] <= 0 \
+            or stats["transport"]["blocks_sent"] <= 0:
+        failures.append("KV transport recorded no traffic")
+
+    # drain hygiene on every replica
+    for i, fe in enumerate(fes):
+        eng = fe.engine
+        if eng.scheduler.num_active or eng.scheduler.queue:
+            failures.append(f"replica {i} not drained")
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.evict_all()
+        if eng.kv.blocks_in_use != 0:
+            failures.append(f"replica {i} leaked {eng.kv.blocks_in_use} "
+                            "KV blocks (scale rows ride the same ids)")
+        if not eng.kv.allocator.invariant_ok:
+            failures.append(f"replica {i} allocator ledger corrupt")
+
+    stats_out = {
+        "handoffs": stats["migrations"]["handoff"],
+        "sheds": stats["migrations"]["shed"],
+        "role_dispatches": stats["role_dispatches"],
+        "transport_bytes": stats["transport"]["bytes_sent"],
+        "blocks_sent": stats["transport"]["blocks_sent"],
+    }
+    return stats_out, failures
+
+
+def main():
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.metrics import CONTRACT_METRICS
+
+    # runtime sanitizers (ISSUE 12): transfer guard + compile watchdog
+    # — each engine's mixed step must compile exactly once, INCLUDING
+    # across every migration admit
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        stats, failures = run_smoke()
+    failures += [f"compile watchdog: {v}" for v in wd.violations]
+    text = pm.REGISTRY.to_prometheus()
+    print(text)
+    for name in CONTRACT_METRICS:
+        if name not in text:
+            failures.append(f"MISSING serving metric: {name}")
+    from paddle_tpu.serving import metrics as sm
+    reasons = {lv[0] for lv, _c in sm.ROUTER_MIGRATIONS.samples()}
+    for reason in ("handoff", "shed"):
+        if reason not in reasons:
+            failures.append(
+                f"router_migrations_total recorded no {reason!r} "
+                f"migrations (saw {sorted(reasons)})")
+    for direction in ("sent", "received"):
+        ch = dict(sm.SERVING_KV_TRANSPORT_BYTES.samples())
+        c = ch.get((direction,))
+        if not c or c.value <= 0:
+            failures.append(
+                f"kv_transport_bytes_total{{{direction}}} recorded "
+                "nothing")
+    if sm.SERVING_KV_BLOCKS_MIGRATED.value <= 0:
+        failures.append("kv_blocks_migrated_total recorded nothing")
+    roles = {lv[0] for lv, _c in sm.ROUTER_DISPATCH_ROLE.samples()}
+    for role in ("prefill", "decode"):
+        if role not in roles:
+            failures.append(
+                f"prefill_decode_dispatch_total recorded no {role!r} "
+                f"dispatches (saw {sorted(roles)})")
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAILURE: {f}", file=sys.stderr)
+        return 1
+    print(f"disagg smoke OK: {stats['handoffs']} handoffs, "
+          f"{stats['sheds']} shed migration(s), "
+          f"{stats['blocks_sent']} blocks / "
+          f"{stats['transport_bytes']} bytes on the wire, "
+          f"role dispatches {stats['role_dispatches']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
