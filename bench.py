@@ -846,6 +846,105 @@ def bench_serving_prefix_cache():
     return extra
 
 
+def bench_serving_multi_lora():
+    """ISSUE 14 extra: K tenants' finetunes through ONE multi-LoRA
+    engine vs K per-tenant engines at EQUAL total HBM (the KV block
+    budget is split K ways for the solo fleet; adapter slots are the
+    multi engine's only extra bytes). Same Poisson-ish interleaved
+    stream both sides, outputs asserted token-identical per tenant.
+    Reports aggregate tokens/sec both ways, the adapter cache hit
+    ratio, and the measured marginal HBM per tenant against the
+    analytic 2*r*d*layers-per-projection bound."""
+    import time as _time
+
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.serving.adapters import make_random_adapter
+    from paddle_tpu.serving.engine import ServingEngine
+
+    rng = np.random.RandomState(0)
+    V, K = 1024, 4
+    tenants = [f"tenant{i}" for i in range(K)]
+    m = GPTForGeneration(vocab_size=V, hidden_size=128, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=512,
+                         compute_dtype="float32")
+    m.eval()
+    adapters = {t: make_random_adapter(m.decoder, 8, seed=i + 1,
+                                       scale=0.1)
+                for i, t in enumerate(tenants)}
+    n_req = 24
+    req_tenants = [tenants[i % K] for i in range(n_req)]
+    prompts = [rng.randint(1, V, int(n)).tolist()
+               for n in rng.randint(8, 48, n_req)]
+    total_blocks = 96                    # the shared HBM budget
+    warm = rng.randint(1, V, 8).tolist()
+
+    def multi():
+        eng = ServingEngine(m, max_slots=8, block_size=16,
+                            max_seq_len=128, cache_dtype="float32",
+                            num_blocks=total_blocks + 1, seed=0,
+                            max_adapters=K + 1, lora_rank=8)
+        for t in tenants:
+            eng.register_adapter(t, adapters[t])
+        eng.generate_batch([warm], max_new_tokens=2)     # compile
+        t0 = _time.perf_counter()
+        reqs = [eng.submit(p, 16, adapter_id=t)
+                for p, t in zip(prompts, req_tenants)]
+        eng.run()
+        dt = _time.perf_counter() - t0
+        outs = [list(r.output) for r in reqs]
+        return eng, outs, sum(len(o) for o in outs) / dt
+
+    def solo_fleet():
+        engs = {}
+        for t in tenants:
+            e = ServingEngine(m, max_slots=2, block_size=16,
+                              max_seq_len=128, cache_dtype="float32",
+                              num_blocks=total_blocks // K + 1, seed=0,
+                              max_adapters=2, lora_rank=8)
+            e.register_adapter(t, adapters[t])
+            e.generate_batch([warm], max_new_tokens=2)   # compile
+            engs[t] = e
+        t0 = _time.perf_counter()
+        reqs = [engs[t].submit(p, 16, adapter_id=t)
+                for p, t in zip(prompts, req_tenants)]
+        # round-robin the engines the way one process would
+        while any(e.scheduler.has_work for e in engs.values()):
+            for e in engs.values():
+                if e.scheduler.has_work:
+                    e.step()
+        dt = _time.perf_counter() - t0
+        outs = [list(r.output) for r in reqs]
+        return sum(len(o) for o in outs) / dt, outs
+
+    eng, outs_multi, tput_multi = multi()
+    tput_solo, outs_solo = solo_fleet()
+    bound = sum(2 * eng.adapters.rank
+                * max(di, do) * eng.adapters.num_layers * 4
+                for _, di, do in eng.adapters.hooks)
+    extra = {
+        "metric": "serving_multi_lora",
+        "value": round(tput_multi, 1), "unit": "tokens/sec",
+        "solo_fleet_tokens_per_sec": round(tput_solo, 1),
+        "speedup_vs_solo_fleet": round(tput_multi / tput_solo, 3),
+        "tenants": K, "requests": n_req,
+        "adapter_hit_ratio": round(eng.adapters.hit_ratio(), 3),
+        "adapter_evictions": int(eng.adapters.evictions),
+        "marginal_bytes_per_tenant": int(eng.adapters.bytes_per_slot),
+        "marginal_bytes_bound": int(bound),
+        "within_analytic_bound":
+            eng.adapters.bytes_per_slot <= bound,
+        "outputs_identical": outs_multi == outs_solo,
+    }
+    if not extra["outputs_identical"]:
+        extra["error"] = "multi-LoRA outputs diverged from the " \
+            "per-tenant solo fleet"
+    if not extra["within_analytic_bound"]:
+        extra["error"] = "marginal HBM per tenant exceeds the " \
+            "analytic bound"
+    return extra
+
+
 def bench_serving_kv_int8():
     """ISSUE 9 extra: fp32 vs int8 KV block pools on the SAME Poisson
     request stream at an EQUAL HBM budget (tiny GPT, every platform).
@@ -1237,7 +1336,21 @@ def _metrics_extra():
             "paddle_tpu_moe_dropped_tokens_total"),
         "moe_expert_utilization": round(
             metrics.MOE_EXPERT_UTILIZATION.labels("serving").value, 4),
+        # expert-weight HBM per dtype for the gpt_moe bench shape
+        # (ISSUE 14): what the weight-only knob buys at serving time —
+        # analytic, scales included (grouped_matmul.expert_weight_bytes)
+        "moe_expert_weight_bytes": _expert_weight_bytes_by_dtype(),
     }
+
+
+def _expert_weight_bytes_by_dtype():
+    """bf16 / int8 / int4 expert-stack bytes (both FFN mats + scales)
+    for the gpt_moe bench shape — 8 experts on the 350M-class config."""
+    from paddle_tpu.ops.pallas.grouped_matmul import expert_weight_bytes
+    L, E, D, F = 24, 8, 1024, 4096
+    return {dt: int(expert_weight_bytes(E, D, F, dt, L)
+                    + expert_weight_bytes(E, F, D, dt, L))
+            for dt in ("bfloat16", "int8", "int4")}
 
 
 def main():
@@ -1321,6 +1434,15 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["extras"].append(
             {"metric": "serving_kv_int8",
+             "error": f"{type(e).__name__}: {e}"})
+
+    # multi-LoRA lane (ISSUE 14): K tenants through one engine vs K
+    # per-tenant engines at equal HBM — every platform
+    try:
+        result["extras"].append(bench_serving_multi_lora())
+    except Exception as e:  # noqa: BLE001
+        result["extras"].append(
+            {"metric": "serving_multi_lora",
              "error": f"{type(e).__name__}: {e}"})
 
     # MoE lane (ISSUE 10): every-platform — hybrid MoE train tok/s

@@ -57,6 +57,9 @@ DEFAULT_BUDGETS: Dict[str, int] = {
     "serving_mixed_step": 1,
     # one fixed-shape pool copy per PagedKVCache (prefix-cache CoW)
     "serving_prefix_cow": 1,
+    # one fixed-shape slot write per AdapterCache — every LoRA load/
+    # evict-reload reuses it (tools/lora_smoke.py's contract)
+    "serving_adapter_load": 1,
 }
 
 _id_counter = itertools.count(1)

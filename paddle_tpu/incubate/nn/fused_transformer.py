@@ -68,6 +68,10 @@ class _MTConfig:
     activation: str = "gelu"
     dropout_rate: float = 0.0
     quant_bits: int = 0            # 0 = float weights, 8 = weight-only int8
+    moe_quant_bits: int = 0        # expert-stack override: 0 = follow
+    #                                quant_bits, 8 = int8, 4 = packed
+    #                                int4 (two nibbles/byte, fp16
+    #                                scales — ops.pallas.grouped_matmul)
     num_experts: int = 0           # 0 = dense FFN
     moe_topk: int = 2
     capacity_factor: float = 1.25
@@ -122,13 +126,60 @@ def _dropout(cfg, x, key, training):
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
-def _ffn_dense(cfg, pl, h):
+def _lora_delta(x, a, b, oh):
+    """Multi-LoRA delta for one hooked projection (serving mixed step,
+    `serving/adapters.py`): `x [..., d_in]` with T total rows, slot
+    tensors `a [K, d_in, r]` / `b [K, r, d_out]` (adapter slot 0 is
+    the all-zero NULL adapter), `oh [T, K]` the per-token adapter
+    one-hot. Returns `x @ a[aid] @ b[aid]` per token, fixed-shape:
+
+    * the A contraction computes ALL K rank-r projections
+      (`td,kdr->tkr` — K*T*d*r flops, tiny next to the dense d x d
+      matmul for K*r << d) and the one-hot selects — no `[T, d, r]`
+      gather is ever materialized;
+    * the B side masks the selected `[T, r]` back through the one-hot
+      (`[T, K, r]`, small) so the contraction collapses k and r at
+      once.
+
+    fp32 accumulation, cast back at the end. One-hot rows are exact
+    {0,1}, so a token's delta is bit-independent of how many adapter
+    slots the engine was built with — and the null slot's delta is
+    exactly 0.0, which keeps slot-0 tokens token-identical to an
+    adapter-free engine (alpha/r scaling is folded into B at load
+    time)."""
+    lead = x.shape[:-1]
+    xf = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    ohf = oh.astype(jnp.float32)
+    ya = jnp.einsum("td,kdr->tkr", xf, a.astype(jnp.float32))
+    y = jnp.einsum("tkr,tk->tr", ya, ohf)
+    yk = y[:, None, :] * ohf[:, :, None]                  # [T, K, r]
+    z = jnp.einsum("tkr,krd->td", yk, b.astype(jnp.float32))
+    return z.reshape(*lead, b.shape[-1]).astype(x.dtype)
+
+
+def _ffn_dense(cfg, pl, h, lora_oh=None):
     f = _mm(cfg, h, pl["ffn1_w"], pl.get("ffn1_s"))
+    if lora_oh is not None and "lora_ffn1_a" in pl:
+        f = f + _lora_delta(h, pl["lora_ffn1_a"], pl["lora_ffn1_b"],
+                            lora_oh)
     f = f + pl["ffn1_b"].astype(f.dtype)
     f = _act(cfg, f)
-    f = _mm(cfg, f, pl["ffn2_w"], pl.get("ffn2_s"))
-    f = _maybe_psum(cfg, f)
-    return f + pl["ffn2_b"].astype(f.dtype)
+    g = _mm(cfg, f, pl["ffn2_w"], pl.get("ffn2_s"))
+    if lora_oh is not None and "lora_ffn2_a" in pl:
+        # row-parallel under TP: A holds this shard's F/tp slice, so
+        # the delta is a partial sum that joins the SAME psum the base
+        # matmul already pays (the _maybe_psum right below)
+        g = g + _lora_delta(f, pl["lora_ffn2_a"], pl["lora_ffn2_b"],
+                            lora_oh)
+    g = _maybe_psum(cfg, g)
+    return g + pl["ffn2_b"].astype(g.dtype)
+
+
+def _moe_bits(cfg):
+    """Effective expert-stack weight-only bits: the `moe_quant_bits`
+    override when set (int4/int8 experts under an int8 — or float —
+    attention stack), else the stack-wide `quant_bits`."""
+    return cfg.moe_quant_bits or cfg.quant_bits
 
 
 def _grouped_path_enabled(cfg, pl):
@@ -136,9 +187,12 @@ def _grouped_path_enabled(cfg, pl):
     kernel (ops.pallas.grouped_matmul) instead of the one-hot einsum
     oracle — TPU backend (or kernel-test interpret mode) with
     MXU-alignable feature axes; `PADDLE_TPU_GROUPED_MATMUL=0` or a
-    CPU backend keeps the reference path. Static at trace time."""
+    CPU backend keeps the reference path. Static at trace time.
+    int4-packed expert weights hold HALF their logical contraction
+    rows, so the alignment check doubles them back first."""
     from ...ops.pallas import grouped_matmul as _gmm
-    d_in = pl["ffn1_w"].shape[-2]
+    packed = _moe_bits(cfg) == 4 and pl.get("ffn1_s") is not None
+    d_in = pl["ffn1_w"].shape[-2] * (2 if packed else 1)
     d_ff = pl["ffn1_w"].shape[-1]
     return _gmm.grouped_matmul_enabled(d_in, d_ff)
 
@@ -146,11 +200,14 @@ def _grouped_path_enabled(cfg, pl):
 def _expert_matmuls(cfg, pl, expert_in):
     """The two stacked expert contractions ([E_loc, C', D] capacity
     buffers -> expert outputs) with weight-only dequant fused in —
-    grouped Pallas kernel when enabled, einsum oracle otherwise."""
+    grouped Pallas kernel when enabled, einsum oracle otherwise.
+    Expert quantization bits come from `_moe_bits` (int4 experts use
+    qmax=7 and the nibble-packed kernel/dequant)."""
     cd = expert_in.dtype
     if _grouped_path_enabled(cfg, pl):
         from ...ops.pallas.grouped_matmul import grouped_expert_matmul
-        qmax = float(2 ** (cfg.quant_bits - 1) - 1)
+        qmax = float(2 ** (_moe_bits(cfg) - 1) - 1) if _moe_bits(cfg) \
+            else 127.0
         f = grouped_expert_matmul(expert_in, pl["ffn1_w"],
                                   pl.get("ffn1_s"), qmax=qmax,
                                   out_dtype=cd)
@@ -303,17 +360,30 @@ def _ffn_moe_tokens(cfg, pl, h, valid):
 
 
 def _deq(cfg, w, scale, dtype):
+    """Expert-stack weight dequant for the einsum path (`_deq` is only
+    ever applied to ffn1_w/ffn2_w expert weights, so its bits come
+    from `_moe_bits`); int4-packed weights unpack first."""
     if scale is None:
         return w.astype(dtype)
-    qmax = float(2 ** (cfg.quant_bits - 1) - 1)
+    bits = _moe_bits(cfg)
+    if bits == 4:
+        from ...ops.pallas.grouped_matmul import unpack_int4
+        w = unpack_int4(w, axis=-2)
+    qmax = float(2 ** (bits - 1) - 1)
     return w.astype(dtype) * (scale[:, None, :].astype(dtype) / qmax)
 
 
-def _qkv(cfg, pl, h):
+def _qkv(cfg, pl, h, lora_oh=None):
     """h [B, S, D] -> q, k, v each [B, S, H, Dh] (H is the local head
-    count under TP)."""
+    count under TP). `lora_oh` [B*S, K] adds the multi-LoRA delta to
+    the fused projection pre-bias (serving mixed step; B is replicated
+    there, so the sharded lora_qkv_b — shard-major-permuted like
+    qkv_w — lands each shard's head slice)."""
     B, S, _ = h.shape
     qkv = _mm(cfg, h, pl["qkv_w"], pl.get("qkv_s"))
+    if lora_oh is not None and "lora_qkv_a" in pl:
+        qkv = qkv + _lora_delta(h, pl["lora_qkv_a"], pl["lora_qkv_b"],
+                                lora_oh)
     qkv = qkv + pl["qkv_b"].astype(qkv.dtype)
     H = cfg.num_heads
     qkv = qkv.reshape(B, S, 3, H, cfg.head_dim)
@@ -883,6 +953,7 @@ class FusedMultiTransformer(Layer):
             activation=self.activation,
             dropout_rate=self.dropout_rate if training else 0.0,
             quant_bits=getattr(self, "_quant_bits", 0),
+            moe_quant_bits=getattr(self, "_moe_quant_bits", 0),
             num_experts=getattr(self, "_num_experts", 0),
             moe_topk=getattr(self, "_moe_topk", 2),
             capacity_factor=getattr(self, "_capacity_factor", 1.25),
@@ -1190,11 +1261,23 @@ class FusedMultiTransformerMoe(FusedMultiTransformer):
 
 class FusedMultiTransformerMoeWeightOnly(FusedMultiTransformerMoe):
     """ref `fused_transformer.py:2645` — MoE stack with weight-only
-    int8 attention + expert weights."""
+    int8 attention + expert weights.
 
-    def __init__(self, *args, quant_bits=8, **kw):
+    `moe_quant_bits=4` (ISSUE 14) stores the EXPERT stacks int4:
+    nibble-packed along the contraction axis (two weights per byte,
+    `ops.pallas.grouped_matmul.pack_int4`) with per-(expert,
+    out-channel) fp16 scales, while the attention weights keep
+    `quant_bits` (int8) — the expert stacks are where a big MoE's
+    bytes live, so this is the knob that makes it fit fewer chips."""
+
+    def __init__(self, *args, quant_bits=8, moe_quant_bits=None, **kw):
         super().__init__(*args, **kw)
         self._quant_bits = quant_bits
+        self._moe_quant_bits = int(moe_quant_bits or 0)
+        ebits = self._moe_quant_bits or quant_bits
+        if ebits not in (4, 8):
+            raise ValueError(
+                f"expert weight-only bits must be 4 or 8, got {ebits}")
         for attr, key in (("qkv_weights", "qkv"),
                           ("linear_weights", "out")):
             w = getattr(self, attr)
@@ -1205,7 +1288,7 @@ class FusedMultiTransformerMoeWeightOnly(FusedMultiTransformerMoe):
         for attr, key in (("ffn1_weights", "ffn1"),
                           ("ffn2_weights", "ffn2")):
             w = getattr(self, attr)
-            q, s = _quantize_expert_stack(w._data, quant_bits)
+            q, s = _quantize_expert_stack(w._data, ebits)
             del self._parameters[attr]
             self.register_buffer(attr, Tensor(q))
             self.register_buffer(key + "_scales", Tensor(s))
@@ -1231,7 +1314,13 @@ FusedMultiTransformerMoeINT8 = FusedMultiTransformerMoeWeightOnly
 
 
 def _quantize_expert_stack(w, bits):
-    """[L, E, In, Out] -> int8 + scales [L, E, Out]."""
+    """[L, E, In, Out] -> int8 + fp32 scales [L, E, Out]; `bits=4`
+    returns the nibble-PACKED [L, E, In/2, Out] layout with fp16
+    scales instead (`ops.pallas.grouped_matmul.quantize_int4_experts`
+    — the kernel and `_deq` both speak that format)."""
+    if bits == 4:
+        from ...ops.pallas.grouped_matmul import quantize_int4_experts
+        return quantize_int4_experts(w)
     qmax = float(2 ** (bits - 1) - 1)
     scale = jnp.maximum(jnp.max(jnp.abs(w), axis=-2), 1e-9)
     q = jnp.clip(jnp.round(w / scale[:, :, None, :] * qmax), -qmax, qmax

@@ -50,7 +50,10 @@ class Config:
                                    router_policy=None,
                                    prefill_replicas=None,
                                    decode_replicas=None,
-                                   migration=None):
+                                   migration=None,
+                                   max_adapters=None, lora_rank=None,
+                                   lora_alpha=None,
+                                   moe_weight_dtype=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
@@ -90,7 +93,19 @@ class Config:
         `migration=True` (or a dict of `ReplicaRouter.
         MIGRATION_DEFAULTS` overrides: imbalance/interval/max_per_tick)
         additionally lets loaded decode replicas SHED live requests to
-        lighter siblings instead of preempting them."""
+        lighter siblings instead of preempting them.
+
+        Multi-tenant serving (docs/SERVING.md "Multi-tenant serving",
+        ISSUE 14): `max_adapters > 0` gives the engine fixed LoRA
+        adapter slot tensors (slot 0 reserved for the base model) —
+        `engine.register_adapter(...)` + `Request.adapter_id` serve K
+        finetunes through the ONE compiled mixed step, with pin/LRU
+        slot eviction and near-zero marginal HBM per tenant;
+        `lora_rank`/`lora_alpha` size the slots. `moe_weight_dtype`
+        ("int8" | "int4") quantizes a float MoE stack's EXPERT weights
+        at engine build — int4 packs two nibbles per byte with
+        per-(expert, out-channel) fp16 scales, dequantized at the
+        matmul tile load (ops/pallas/grouped_matmul.py)."""
         # validate BEFORE any assignment: a raising call must leave the
         # config exactly as it was (callers catch and retry)
         if (prefill_replicas is not None) != (decode_replicas is not None):
@@ -107,7 +122,9 @@ class Config:
             num_blocks=num_blocks, max_seq_len=max_seq_len,
             token_budget=token_budget, eos_token_id=eos_token_id,
             cache_dtype=cache_dtype, kv_dtype=kv_dtype, draft_k=draft_k,
-            draft_ngram=draft_ngram, prefix_caching=prefix_caching)
+            draft_ngram=draft_ngram, prefix_caching=prefix_caching,
+            max_adapters=max_adapters, lora_rank=lora_rank,
+            lora_alpha=lora_alpha, moe_weight_dtype=moe_weight_dtype)
         self._max_pending = max_pending
         self._tensor_parallel = tensor_parallel
         self._expert_parallel = expert_parallel

@@ -17,6 +17,14 @@ MXU as dense tiles:
   map as the weight tile and multiplies it right after the load —
   the weight is read from HBM as int8, exactly like `_mm`'s fused
   dequant on the dense path;
+* int4 weight-only experts (ISSUE 14) store TWO nibbles per byte
+  along the contraction axis (`pack_int4`/`unpack_int4`: low nibble =
+  even row, high nibble = odd row, sign-extended by arithmetic
+  shifts) with per-(expert, out-channel) fp16 scales; the kernel
+  loads the packed `[bd/2, bf]` tile and unpacks + dequantizes it in
+  registers right before the dot — the weight is read from HBM at
+  0.5 bytes/element, and the autotune cache keys these winners by
+  the `int4` weight dtype (the PR 11 int8 keying rule);
 * tile sizes `(block_c, block_f, block_d)` are TUNABLE
   (`ops.pallas.autotune`, kernel name ``grouped_matmul``) — the
   einsum path stays the CPU oracle and the fallback for shapes the
@@ -69,6 +77,85 @@ def grouped_matmul_enabled(d_in, d_out) -> bool:
             and d_out % autotune.LANE_ALIGN == 0)
 
 
+# ---------------------------------------------------------------------
+# int4 packing (two nibbles per byte along the contraction axis)
+# ---------------------------------------------------------------------
+
+INT4_QMAX = 7.0
+
+
+def pack_int4(q, axis=-2):
+    """Pack int4-valued int8 (`[-8, 7]`) pairs along `axis` into one
+    int8 byte each: low nibble = even index, high nibble = odd index.
+    The axis length must be even (expert contraction axes always are —
+    they are MXU-lane-aligned in practice)."""
+    q = jnp.asarray(q)
+    axis = axis % q.ndim
+    if q.shape[axis] % 2:
+        raise ValueError(
+            f"pack_int4 needs an even axis length, got {q.shape[axis]}")
+    even = jnp.take(q, jnp.arange(0, q.shape[axis], 2), axis=axis)
+    odd = jnp.take(q, jnp.arange(1, q.shape[axis], 2), axis=axis)
+    return ((odd.astype(jnp.int8) << 4)
+            | (even.astype(jnp.int8) & 0x0F)).astype(jnp.int8)
+
+
+def unpack_int4(packed, axis=-2):
+    """Inverse of `pack_int4`: int8 bytes -> int4 values, interleaved
+    back to the original order (arithmetic shifts sign-extend, so the
+    round trip is exact over [-8, 7]). Pure vector ops, so the grouped
+    kernel unpacks its weight tile with the same function."""
+    axis = axis % packed.ndim
+    low = (packed << 4) >> 4
+    high = packed >> 4
+    out = jnp.stack([low, high], axis=axis + 1)
+    shape = list(packed.shape)
+    shape[axis] *= 2
+    return out.reshape(shape)
+
+
+def is_packed_int4(w, d_in):
+    """True when `w` is an int4-packed weight for a logical `[...,
+    d_in, d_out]` matmul: int8 storage with HALF the contraction rows.
+    The shape test is unambiguous — an int8 weight always matches its
+    activation's contraction axis exactly."""
+    return (w.dtype == jnp.int8 or str(w.dtype) == "int8") \
+        and w.shape[-2] * 2 == int(d_in)
+
+
+def quantize_int4_experts(w):
+    """[..., In, Out] float -> (packed int8 [..., In/2, Out], fp16
+    scales [..., Out]): symmetric per-out-channel amax scaling at
+    qmax=7, then nibble-packed along the contraction axis. The fp16
+    scales halve the (already small) scale overhead vs the int8
+    path's fp32 — int4's point is bytes. Same scale convention as
+    `fused_transformer._quantize_expert_stack`: dequant is
+    `q * scale / qmax`."""
+    wf = jnp.asarray(w).astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2), 1e-9)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :] * INT4_QMAX),
+                 -INT4_QMAX, INT4_QMAX).astype(jnp.int8)
+    return pack_int4(q, axis=-2), scale.astype(jnp.float16)
+
+
+def expert_weight_bytes(E, d_in, d_out, weight_dtype, num_layers=1):
+    """HBM bytes one expert-weight stack `[L, E, d_in, d_out]` costs,
+    scales included — the analytic side of the int4 capacity contract
+    (bf16 2 B/elem; int8 0.5 B... no: 1 B + fp32 scale/out-chan; int4
+    0.5 B + fp16 scale/out-chan). Pure host arithmetic."""
+    n = num_layers * E * d_in * d_out
+    per_scale = num_layers * E * d_out
+    if weight_dtype in ("float32",):
+        return 4 * n
+    if weight_dtype in ("bfloat16", "float16"):
+        return 2 * n
+    if weight_dtype == "int8":
+        return n + 4 * per_scale
+    if weight_dtype == "int4":
+        return n // 2 + 2 * per_scale
+    raise ValueError(f"unknown expert weight dtype {weight_dtype!r}")
+
+
 def _gmm_kernel(x_ref, w_ref, o_ref, acc_ref, *, nd, qmax):
     """One (expert, c-tile, f-tile, d-tile) grid cell.
 
@@ -111,31 +198,68 @@ def _gmm_kernel_quant(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nd, qmax):
         o_ref[0] = acc_ref[...].astype(o_ref.dtype)
 
 
-def _pick_block(n, target):
+def _gmm_kernel_quant4(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nd,
+                       qmax):
+    """int4 variant: the weight tile arrives PACKED `[bd/2, bf]` int8
+    and is unpacked + dequantized in registers right before the dot —
+    the HBM fetch is half the int8 path's. Same grid/accumulator
+    discipline as the other kernels; the d-reduction axis indexes
+    packed rows (bd/2 per tile), the x tile the matching bd rows."""
+    d = pl.program_id(3)
+
+    @pl.when(d == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    w4 = unpack_int4(w_ref[0], axis=0)               # [bd, bf] int4
+    w = w4.astype(jnp.float32) \
+        * (s_ref[0].astype(jnp.float32) / qmax)[None, :]
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[0].astype(jnp.float32), w,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(d == nd - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _pick_block(n, target, multiple=1):
     """Largest divisor of n that is <= target (tiles must be exact —
-    a remainder tile would read past the buffer)."""
+    a remainder tile would read past the buffer). `multiple` further
+    constrains the divisor (the int4 d-tile must cover whole packed
+    bytes, so it must be even)."""
     b = min(int(target), int(n))
-    while n % b:
+    b -= b % multiple
+    while b > multiple and (n % b or b % multiple):
         b -= 1
+    if b <= 0 or n % b:
+        b = multiple
     return b
 
 
 def _gmm_call(x, w, scale, qmax, bc, bf, bd, out_dtype):
-    """The raw pallas_call with resolved tile sizes."""
+    """The raw pallas_call with resolved tile sizes. An int4-packed
+    weight (`is_packed_int4`) rides the quant4 kernel: its BlockSpec
+    tiles packed rows (`bd // 2` per d-step) while x tiles the
+    matching `bd` activation rows — the index maps line up because
+    both advance one block per d grid step."""
     E, C, D = x.shape
     F = w.shape[2]
+    int4 = is_packed_int4(w, D)
     nd = D // bd
     grid = (E, C // bc, F // bf, nd)
     in_specs = [
         pl.BlockSpec((1, bc, bd), lambda e, c, f, d: (e, c, d)),
-        pl.BlockSpec((1, bd, bf), lambda e, c, f, d: (e, d, f)),
+        pl.BlockSpec((1, bd // 2 if int4 else bd, bf),
+                     lambda e, c, f, d: (e, d, f)),
     ]
     args = [x, w]
     if scale is not None:
         in_specs.append(pl.BlockSpec((1, bf), lambda e, c, f, d: (e, f)))
         args.append(scale)
-        kernel = functools.partial(_gmm_kernel_quant, nd=nd,
-                                   qmax=float(qmax))
+        kernel = functools.partial(
+            _gmm_kernel_quant4 if int4 else _gmm_kernel_quant, nd=nd,
+            qmax=float(qmax))
     else:
         kernel = functools.partial(_gmm_kernel, nd=nd, qmax=float(qmax))
     return pl.pallas_call(
@@ -151,7 +275,7 @@ def _gmm_call(x, w, scale, qmax, bc, bf, bd, out_dtype):
         cost_estimate=pl.CostEstimate(
             flops=2 * E * C * D * F,
             bytes_accessed=(E * C * D * x.dtype.itemsize
-                            + E * D * F * w.dtype.itemsize
+                            + w.size * w.dtype.itemsize
                             + E * C * F * jnp.dtype(out_dtype).itemsize),
             transcendentals=0),
         interpret=_INTERPRET,
@@ -182,7 +306,7 @@ def _gmm_core_bwd(bc, bf, bd, out_dtype, res, g):
 _gmm_core.defvjp(_gmm_core_fwd, _gmm_core_bwd)
 
 
-def grouped_expert_matmul(x, w, scale=None, *, qmax=127.0,
+def grouped_expert_matmul(x, w, scale=None, *, qmax=None,
                           block_c=None, block_f=None, block_d=None,
                           out_dtype=None):
     """x [E, C, D] @ w [E, D, F] -> [E, C, F], one expert per leading
@@ -192,17 +316,32 @@ def grouped_expert_matmul(x, w, scale=None, *, qmax=127.0,
     are never trained), the fp variant differentiates via a custom
     VJP whose backward runs the XLA grouped contractions.
 
+    int4-packed weights (`is_packed_int4`: int8 storage at half the
+    contraction rows, the `pack_int4` layout) dispatch the quant4
+    kernel with the `[E, F]` fp16 scales; tile lookups then key by
+    the `int4` dtype. `qmax` defaults by detected weight format
+    (INT4_QMAX packed, 127 int8) so a call site that forgets to
+    thread it can never silently mis-scale the dequant.
+
     Tile sizes default to the tuned winner for this shape bucket
     (`autotune.kernel_config("grouped_matmul", ...)`) and fall back to
     MXU-shaped 128/512 targets; explicit arguments pin them (the
     tuner's candidate builder does exactly that)."""
     E, C, D = x.shape
+    int4 = scale is not None and is_packed_int4(w, D)
+    if qmax is None:
+        qmax = INT4_QMAX if int4 else 127.0
     F = w.shape[2]
     if block_c is None or block_f is None or block_d is None:
-        # int8 weight-only experts key by the WEIGHT dtype: tiles
-        # measured on int8 loads are a different cache entry than the
-        # fp variant's (int8 halves the weight fetch per tile)
-        key_dt = w.dtype if scale is not None else x.dtype
+        # quantized experts key by the WEIGHT dtype (int8 / int4):
+        # tiles measured on 1-byte or packed-nibble loads are a
+        # different cache entry than the fp variant's
+        if int4:
+            key_dt = jnp.dtype(jnp.int4)
+        elif scale is not None:
+            key_dt = w.dtype
+        else:
+            key_dt = x.dtype
         cfg = autotune.kernel_config(
             "grouped_matmul", autotune.shape_bucket(E, C, D, F),
             key_dt, default=None) or {}
@@ -211,19 +350,27 @@ def grouped_expert_matmul(x, w, scale=None, *, qmax=127.0,
         block_d = block_d or cfg.get("block_d", 512)
     bc = _pick_block(C, block_c)
     bf = _pick_block(F, block_f)
-    bd = _pick_block(D, block_d)
+    bd = _pick_block(D, block_d, multiple=2 if int4 else 1)
     out_dtype = jnp.dtype(out_dtype or x.dtype)
     if scale is None:
         return _gmm_core(x, w, bc, bf, bd, out_dtype)
     return _gmm_call(x, w, scale, qmax, bc, bf, bd, out_dtype)
 
 
-def grouped_matmul_oracle(x, w, scale=None, *, qmax=127.0,
+def grouped_matmul_oracle(x, w, scale=None, *, qmax=None,
                           out_dtype=None):
     """The einsum reference (CPU oracle + fallback): dequant in the
     compute dtype, then `ecd,edf->ecf` — numerically the
-    `fused_transformer._expert_ffn` formulation."""
+    `fused_transformer._expert_ffn` formulation. int4-packed weights
+    unpack first (same nibble layout as the kernel); `qmax` defaults
+    by detected format like `grouped_expert_matmul`."""
     cd = out_dtype or x.dtype
+    if scale is not None and is_packed_int4(w, x.shape[2]):
+        if qmax is None:
+            qmax = INT4_QMAX
+        w = unpack_int4(w, axis=-2)
+    if qmax is None:
+        qmax = 127.0
     wf = w.astype(cd)
     if scale is not None:
         wf = wf * (scale[:, None, :].astype(cd) / float(qmax))
@@ -242,14 +389,22 @@ def tune_grouped_matmul(E, C, D, F, *, dtype="float32",
 
     global _INTERPRET
     dtype = np.dtype(dtype)
-    if dtype == np.int8:
-        # an int8 KEY dtype means the weight-quantized variant:
+    int4 = dtype == np.dtype(jnp.int4)
+    if int4 or dtype == np.int8:
+        # an int8/int4 KEY dtype means the weight-quantized variant:
         # activations stay fp32 (the serving compute dtype), weights
-        # int8 + scales
+        # quantized + scales (int4: nibble-packed, fp16 scales)
         quantized, dtype = True, np.dtype(np.float32)
     rng = np.random.RandomState(seed)
     x = jnp.asarray(rng.randn(E, C, D).astype(dtype))
-    if quantized:
+    qmax = INT4_QMAX if int4 else 127.0
+    if int4:
+        q = rng.randint(-7, 8, (E, D, F)).astype(np.int8)
+        w = pack_int4(jnp.asarray(q), axis=-2)
+        s = jnp.asarray((np.abs(rng.randn(E, F)) * 0.05 + 0.01).astype(
+            np.float16))
+        args = (x, w, s)
+    elif quantized:
         w = jnp.asarray(rng.randint(-127, 128, (E, D, F)).astype(
             np.int8))
         s = jnp.asarray((np.abs(rng.randn(E, F)) * 0.05 + 0.01).astype(
@@ -260,27 +415,34 @@ def tune_grouped_matmul(E, C, D, F, *, dtype="float32",
         args = (x, w, None)
 
     def oracle(x, w, s):
-        return grouped_matmul_oracle(x, w, s, out_dtype=dtype)
+        return grouped_matmul_oracle(x, w, s, qmax=qmax, out_dtype=dtype)
 
     def build(cfg):
         def run(x, w, s):
             return grouped_expert_matmul(
-                x, w, s, block_c=cfg["block_c"], block_f=cfg["block_f"],
-                block_d=cfg["block_d"], out_dtype=dtype)
+                x, w, s, qmax=qmax, block_c=cfg["block_c"],
+                block_f=cfg["block_f"], block_d=cfg["block_d"],
+                out_dtype=dtype)
         return run
 
     was = _INTERPRET
     if not _on_tpu_backend():
         _INTERPRET = True
     try:
-        # quantized winners cache under int8 (the weight dtype the
-        # runtime lookup keys by), never clobbering the fp entry
-        key_dt = np.dtype(np.int8) if quantized else dtype
+        # quantized winners cache under the weight dtype the runtime
+        # lookup keys by (int8 / int4), never clobbering the fp entry
+        if int4:
+            key_dt = np.dtype(jnp.int4)
+        elif quantized:
+            key_dt = np.dtype(np.int8)
+        else:
+            key_dt = dtype
         return autotune.search(
             "grouped_matmul", autotune.shape_bucket(E, C, D, F),
             key_dt, autotune.grouped_matmul_candidates(E, C, D, F),
             build, args, oracle, rtol=2e-2, atol=2e-2,
             budget_s=budget_s, timer=timer, persist=persist,
-            meta={"quantized": bool(quantized), "seed": seed})
+            meta={"quantized": bool(quantized), "int4": bool(int4),
+                  "seed": seed})
     finally:
         _INTERPRET = was

@@ -226,11 +226,34 @@ SERVING_MOE_TP_SPECS = {
 }
 
 
+#: multi-LoRA adapter slot tensors (serving.adapters): each hooked
+#: projection's `A [L, K, d_in, r]` / `B [L, K, r, d_out]`. A of the
+#: column-parallel projections (qkv, ffn1) replicates (rank axes are
+#: tiny) and B shards its out axis over "mp" — qkv's B shard-major-
+#: permuted exactly like qkv_w, so the delta lands each shard's own
+#: head slice; A of the row-parallel projections (out, ffn2) shards
+#: its IN axis so the per-shard delta is a partial sum that joins the
+#: psum the step already does for the base matmul, with B replicated.
+SERVING_LORA_TP_SPECS = {
+    "lora_qkv_a": (P(), False),
+    "lora_qkv_b": (P(None, None, None, "mp"), True),
+    "lora_out_a": (P(None, None, "mp"), False),
+    "lora_out_b": (P(), False),
+    "lora_ffn1_a": (P(), False),
+    "lora_ffn1_b": (P(None, None, None, "mp"), False),
+    "lora_ffn2_a": (P(None, None, "mp"), False),
+    "lora_ffn2_b": (P(), False),
+}
+
+
 def serving_tp_spec(name, moe=False):
-    """PartitionSpec + permute flag for one decoder param under the TP
-    (x EP when `moe`) serving engine. Unknown names raise so new stack
-    variants fail loudly instead of silently replicating."""
+    """PartitionSpec + permute flag for one decoder param (or adapter
+    slot tensor) under the TP (x EP when `moe`) serving engine.
+    Unknown names raise so new stack variants fail loudly instead of
+    silently replicating."""
     try:
+        if name in SERVING_LORA_TP_SPECS:
+            return SERVING_LORA_TP_SPECS[name]
         if moe and name in SERVING_MOE_TP_SPECS:
             return SERVING_MOE_TP_SPECS[name]
         return SERVING_TP_SPECS[name]

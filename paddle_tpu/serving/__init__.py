@@ -15,11 +15,13 @@ for the slot protocol, prefix-cache and distributed semantics.
 modules here can be imported from `incubate/nn/generation.py` without
 cycles.
 """
+from . import adapters  # noqa: F401
 from . import batcher  # noqa: F401
 from . import kv_cache  # noqa: F401
 from . import metrics  # noqa: F401
 from . import prefix_cache  # noqa: F401
 from . import scheduler  # noqa: F401
+from .adapters import AdapterCache  # noqa: F401
 from .batcher import FairQueue, SamplingConfig  # noqa: F401
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
 from .prefix_cache import RadixPrefixCache  # noqa: F401
@@ -28,7 +30,8 @@ from .scheduler import Request, Scheduler  # noqa: F401
 __all__ = [
     "SamplingConfig", "BlockAllocator", "PagedKVCache", "Request",
     "Scheduler", "ServingEngine", "ServingFrontend", "FairQueue",
-    "RadixPrefixCache", "batcher", "kv_cache", "metrics", "scheduler",
+    "RadixPrefixCache", "AdapterCache", "adapters", "batcher",
+    "kv_cache", "metrics", "scheduler",
     "prefix_cache", "engine", "frontend", "distributed",
     "TPServingEngine", "ReplicaRouter",
 ]

@@ -1,0 +1,341 @@
+"""Multi-LoRA adapter slots for the one-compile serving mixed step.
+
+Multi-tenant serving means thousands of *variants* of one base model —
+per-customer finetunes — and the only way that scales is near-zero
+marginal HBM per tenant (ROADMAP item 3; the reference fork's
+`weight_only_linear_kernel.h` + `fused_multi_transformer_moe_*` pair
+exists for exactly this serving shape). The design mirrors the paged
+KV cache's shape discipline:
+
+* **Fixed adapter slot tensors.** Each hooked projection (fused qkv,
+  attention out, and — dense stacks — ffn1/ffn2) owns two device
+  tensors `A [L, max_adapters, d_in, r]` and `B [L, max_adapters, r,
+  d_out]` that ride the compiled mixed step as ordinary inputs: which
+  adapters are resident NEVER changes a compiled shape, so adapter
+  loads, evictions and churn keep the one-compile contract
+  (watchdog-enforced). The leading `L` axis rides the step's
+  `lax.scan` over layers exactly like the stacked base weights.
+* **Per-token adapter ids** ride the flat token axis the way sampling
+  params do: the engine rebuilds a `[T]` int32 vector from the
+  scheduler's slot table each step and the step body turns it into
+  one `[T, K]` one-hot that every layer's `_lora_delta` reuses
+  (`incubate.nn.fused_transformer._lora_delta` — the one-hot select
+  keeps the delta K*T*d*r flops with no `[T, d, r]` gather).
+* **Slot 0 is the NULL adapter** — all-zero A/B, never assigned,
+  never evicted. Base-model requests (and padding tokens) carry
+  adapter id 0, their delta is exactly 0.0, and their tokens are
+  identical to an engine built with no adapter support at all
+  (tools/lora_smoke.py asserts this).
+* **The host cache reuses the prefix-cache machinery's shape**:
+  refcounted pins (every resident request pins its adapter — a pinned
+  slot is never evicted, so admission BLOCKS instead of corrupting a
+  neighbour mid-flight), LRU eviction over unpinned slots, and a cold
+  load is ONE donated jitted slot-write (`serving_adapter_load`, the
+  `cow_block` pattern: the slot id rides as a traced scalar, so every
+  load of every adapter reuses one executable — never a recompile).
+
+TP composition (`serving.distributed.tp_engine`): A of column-parallel
+projections (qkv, ffn1) replicates and B shards its out axis over
+`mp` (the qkv B shard-major-permuted exactly like `qkv_w`); A of
+row-parallel projections (out, ffn2) shards its IN axis so the delta
+is a partial sum that joins the psum the mixed step already does; B
+there replicates (`parallel.mp_layers.SERVING_LORA_TP_SPECS`).
+
+KV interaction: LoRA changes the K/V a request writes, so the radix
+prefix cache — which shares blocks by TOKEN ids alone — must never
+share blocks across adapters. Requests with a non-null adapter simply
+bypass the prefix cache (lookup and insert); base-model requests keep
+full sharing. Preemption needs no special handling: the victim's
+blocks are dropped and re-prefilled under the same adapter.
+
+MoE stacks hook qkv + attention-out only (expert FFNs are routed,
+capacity-sliced and possibly int4-packed — a per-token dense delta
+there would double the dispatch machinery for little finetune signal;
+attention LoRA is the standard high-signal target).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..profiler import metrics as _pmetrics
+
+#: hooked projection family names, in the fixed order the step
+#: consumes their slot tensors (a/b interleaved per family)
+DENSE_HOOKS = ("qkv", "out", "ffn1", "ffn2")
+MOE_HOOKS = ("qkv", "out")
+
+
+def hook_dims(decoder):
+    """[(name, d_in, d_out)] for the decoder's hooked projections
+    (full, unsharded dims — the TP engine shards the built arrays)."""
+    D = decoder.embed_dim
+    inner = decoder.num_heads * decoder.head_dim
+    hooks = [("qkv", D, 3 * inner), ("out", inner, D)]
+    if not int(getattr(decoder, "_num_experts", 0)):
+        F = decoder.dim_feedforward
+        hooks += [("ffn1", D, F), ("ffn2", F, D)]
+    return hooks
+
+
+class AdapterCache:
+    """Fixed device slot tensors + host pin/LRU bookkeeping for K LoRA
+    adapters served through one compiled mixed step.
+
+    `max_adapters` counts slot 0 (the reserved null adapter), so
+    `max_adapters - 1` finetunes can be RESIDENT at once; any number
+    can be registered — cold ones load into an evicted slot on demand.
+    """
+
+    def __init__(self, decoder, *, max_adapters, rank, alpha=None,
+                 dtype="float32", clock=time.monotonic):
+        import jax.numpy as jnp
+        K = int(max_adapters)
+        if K < 2:
+            raise ValueError(
+                f"max_adapters={K} leaves no usable slot past the "
+                "reserved null adapter (slot 0); need >= 2")
+        r = int(rank)
+        if r < 1:
+            raise ValueError(f"lora_rank must be >= 1, got {r}")
+        self.max_adapters = K
+        self.rank = r
+        self.alpha = float(alpha) if alpha is not None else float(r)
+        self.scaling = self.alpha / r      # folded into B at load time
+        self.clock = clock
+        self.hooks = hook_dims(decoder)
+        self.num_layers = decoder.num_layers
+        self._dtype = jnp.dtype(dtype)
+        L = self.num_layers
+        self._arrays = {}
+        for name, di, do in self.hooks:
+            self._arrays[f"lora_{name}_a"] = jnp.zeros(
+                (L, K, di, r), self._dtype)
+            self._arrays[f"lora_{name}_b"] = jnp.zeros(
+                (L, K, r, do), self._dtype)
+        self.array_names = tuple(self._arrays)
+        # host ledger: slot 0 is permanently the null adapter
+        self._registry = {}                # adapter_id -> host weights
+        self._resident = {}                # adapter_id -> slot
+        self._slot_ids = [None] * K        # slot -> adapter_id
+        self._pins = np.zeros(K, np.int64)
+        self._stamp = np.zeros(K, np.float64)
+        self._tick = 0
+        # hooks a sharded engine installs (serving.distributed):
+        # prepare(name, payload) re-lays a payload out for the mesh
+        # (shard-major qkv B); place(cache) re-pins the canonical
+        # shardings after the donated load write (the PR 8/PR 10
+        # silent-recompile lesson, same as kv_cache.place_pools)
+        self.prepare = None
+        self.place = None
+        self._load_fn = None
+        # raw counters (always on; mirrored into the metrics registry
+        # under the one-branch discipline when observability is on)
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.evictions = 0
+        self.load_seconds = 0.0
+
+    # -------------------------------------------------------- inspection
+    def device_arrays(self):
+        """The slot tensors in `array_names` order — the engine feeds
+        them to the mixed step every iteration."""
+        return [self._arrays[n] for n in self.array_names]
+
+    def known(self, adapter_id):
+        return adapter_id in self._registry
+
+    def resident(self, adapter_id):
+        """True when the adapter sits in a device slot right now (the
+        router's adapter-affinity signal). The null adapter is always
+        resident."""
+        return adapter_id is None or adapter_id in self._resident
+
+    def slot_of(self, adapter_id):
+        if adapter_id is None:
+            return 0
+        return self._resident.get(adapter_id)
+
+    @property
+    def resident_count(self):
+        """Assigned (non-null) slots — the resident-adapters gauge."""
+        return len(self._resident)
+
+    def pin_count(self, adapter_id):
+        slot = self._resident.get(adapter_id)
+        return 0 if slot is None else int(self._pins[slot])
+
+    @property
+    def total_pins(self):
+        return int(self._pins[1:].sum())
+
+    @property
+    def bytes_per_slot(self):
+        """Marginal HBM one resident tenant costs: the per-slot slice
+        of every A/B slot tensor. For rank r over the hooked
+        projections this is Sigma r*(d_in + d_out)*L*itemsize — the
+        `2*r*d*layers`-per-square-projection bound the bench asserts
+        against."""
+        item = self._dtype.itemsize
+        return sum(self.rank * (di + do) * self.num_layers * item
+                   for _, di, do in self.hooks)
+
+    # ------------------------------------------------------ registration
+    def register(self, adapter_id, weights):
+        """Register a finetune's host weights. `weights` maps each
+        hooked projection name to an `(a, b)` pair of arrays shaped
+        `[L, d_in, r]` / `[L, r, d_out]` (numpy or jax). Registration
+        is host-only — device slots are claimed lazily at admission."""
+        if adapter_id is None:
+            raise ValueError("adapter_id None is the reserved null "
+                             "adapter; it needs no registration")
+        got = set(weights)
+        want = {n for n, _, _ in self.hooks}
+        if got != want:
+            raise ValueError(
+                f"adapter {adapter_id!r} must provide exactly "
+                f"{sorted(want)}, got {sorted(got)}")
+        L, r = self.num_layers, self.rank
+        host = {}
+        for name, di, do in self.hooks:
+            a, b = (np.asarray(x) for x in weights[name])
+            if a.shape != (L, di, r) or b.shape != (L, r, do):
+                raise ValueError(
+                    f"adapter {adapter_id!r} {name}: want a "
+                    f"{(L, di, r)} / b {(L, r, do)}, got "
+                    f"{a.shape} / {b.shape}")
+            host[name] = (a, b)
+        self._registry[adapter_id] = host
+        return adapter_id
+
+    # --------------------------------------------------------- residency
+    def _touch(self, slot):
+        self._tick += 1
+        self._stamp[slot] = self._tick
+
+    def acquire(self, adapter_id):
+        """Pin `adapter_id` into a device slot for one resident
+        request. Returns the slot index, or None when every non-null
+        slot is pinned by in-flight requests (the scheduler then
+        leaves the request queued — admission blocks on residency,
+        it never corrupts a neighbour's slot mid-flight)."""
+        if adapter_id is None:
+            return 0
+        host = self._registry.get(adapter_id)
+        if host is None:
+            raise ValueError(f"adapter {adapter_id!r} is not "
+                             "registered on this engine")
+        slot = self._resident.get(adapter_id)
+        if slot is not None:
+            self._pins[slot] += 1
+            self._touch(slot)
+            self.cache_hits += 1
+            if _pmetrics._enabled:
+                from . import metrics as smetrics
+                smetrics.SERVING_ADAPTER_CACHE_HITS.inc()
+            return slot
+        # cold: a free slot first, else the LRU unpinned slot
+        evicted = False
+        free = [s for s in range(1, self.max_adapters)
+                if self._slot_ids[s] is None]
+        if free:
+            slot = free[0]
+        else:
+            cands = [s for s in range(1, self.max_adapters)
+                     if self._pins[s] == 0]
+            if not cands:
+                return None
+            slot = min(cands, key=lambda s: self._stamp[s])
+            del self._resident[self._slot_ids[slot]]
+            self._slot_ids[slot] = None
+            self.evictions += 1
+            evicted = True
+        self.cache_misses += 1
+        t0 = self.clock()
+        self._load(slot, host)
+        dt = self.clock() - t0
+        self.load_seconds += dt
+        self._slot_ids[slot] = adapter_id
+        self._resident[adapter_id] = slot
+        self._pins[slot] += 1
+        self._touch(slot)
+        if _pmetrics._enabled:
+            from . import metrics as smetrics
+            smetrics.SERVING_ADAPTER_CACHE_MISSES.inc()
+            smetrics.SERVING_ADAPTER_LOAD_SECONDS.inc(max(dt, 0.0))
+            if evicted:
+                smetrics.SERVING_ADAPTER_EVICTIONS.inc()
+            smetrics.SERVING_ADAPTERS_RESIDENT.set(self.resident_count)
+        return slot
+
+    def release(self, adapter_id):
+        """Drop one resident request's pin (finish / preempt / expire
+        / cancel / migrate-away). The adapter STAYS resident until LRU
+        eviction needs its slot — the warm-cache property the router's
+        adapter affinity banks on."""
+        if adapter_id is None:
+            return
+        slot = self._resident.get(adapter_id)
+        if slot is None or self._pins[slot] <= 0:
+            raise ValueError(
+                f"release of adapter {adapter_id!r} without a pin")
+        self._pins[slot] -= 1
+
+    # -------------------------------------------------------- device load
+    def _load(self, slot, host):
+        """Write one adapter's weights into `slot` across every hooked
+        projection: ONE donated jitted executable
+        (`serving_adapter_load`), slot id as a traced scalar — cold
+        loads and evict-reloads all reuse it, so adapter churn can
+        never recompile anything (budget-1 in
+        `analysis.guards.DEFAULT_BUDGETS`)."""
+        import jax.numpy as jnp
+
+        if self._load_fn is None:
+            from ..jit.functional import instrumented_jit
+            n = len(self.array_names)
+
+            def load(*args):
+                arrs, slot_i, pays = args[:n], args[n], args[n + 1:]
+                return tuple(a.at[:, slot_i].set(p)
+                             for a, p in zip(arrs, pays))
+
+            self._load_fn = instrumented_jit(
+                load, "serving_adapter_load",
+                donate_argnums=tuple(range(n)))
+        payloads = []
+        for name, _, _ in self.hooks:
+            a, b = host[name]
+            b = np.asarray(b, np.float64) * self.scaling  # fold alpha/r
+            for kind, arr in (("a", a), ("b", b)):
+                if self.prepare is not None:
+                    arr = self.prepare(f"lora_{name}_{kind}", arr)
+                payloads.append(jnp.asarray(
+                    np.asarray(arr).astype(self._dtype)))
+        out = self._load_fn(
+            *[self._arrays[n] for n in self.array_names],
+            jnp.int32(slot), *payloads)
+        for name, arr in zip(self.array_names, out):
+            self._arrays[name] = arr
+        if self.place is not None:
+            self.place(self)
+
+    # ----------------------------------------------------------- metrics
+    def hit_ratio(self):
+        t = self.cache_hits + self.cache_misses
+        return self.cache_hits / t if t else 0.0
+
+
+def make_random_adapter(decoder, rank, seed=0, scale=0.02):
+    """A deterministic random adapter for smokes/benches/examples:
+    nonzero A and B for every hooked projection (so a wrong slot or a
+    missed delta visibly changes tokens)."""
+    rng = np.random.RandomState(seed)
+    L = decoder.num_layers
+    out = {}
+    for name, di, do in hook_dims(decoder):
+        a = rng.randn(L, di, rank).astype(np.float32) * scale
+        b = rng.randn(L, rank, do).astype(np.float32) * scale
+        out[name] = (a, b)
+    return out

@@ -323,6 +323,7 @@ class ReplicaRouter:
         # only when observability is enabled)
         self.dispatches = 0
         self.affinity_hits = 0
+        self.adapter_affinity_hits = 0
         self.failovers = 0
         self.migrations = {"handoff": 0, "shed": 0}
         self.role_dispatches = {"mixed": 0, "prefill": 0, "decode": 0}
@@ -374,10 +375,45 @@ class ReplicaRouter:
                 smetrics.ROUTER_REPLICA_QUEUE_DEPTH.labels(str(i)).set(
                     self.queue_depth(i))
 
-    def _pick(self, prompt):
+    def _shadow_note(self, idx, tokens, adapter_id):
+        """Record `tokens` on replica `idx`'s shadow tree UNLESS the
+        request runs under an adapter: adapter K/V never enters the
+        replica's real prefix cache, so the shadow must not learn it
+        either (a poisoned shadow would affinity-steer base prompts
+        at KV that was never cached). The ONE place this rule lives —
+        every dispatch path calls through here."""
+        if adapter_id is None:
+            self.shadow.insert(idx, tokens)
+
+    def _shadow_migrate(self, src, dst, tokens, adapter_id):
+        """`_shadow_note`'s companion for live migrations (same
+        adapter-bypass rule)."""
+        if adapter_id is None:
+            self.shadow.on_migrate(src, dst, tokens)
+
+    def _adapter_holders(self, live, adapter_id):
+        """Replicas in `live` whose AdapterCache holds `adapter_id`
+        resident right now (the adapter-affinity signal — like the
+        shadow radix, a best-effort estimate: a stale pick only costs
+        one slot-write load, never correctness)."""
+        out = []
+        for i in live:
+            cache = getattr(self.frontends[i].engine, "adapters", None)
+            if cache is not None and cache.resident(adapter_id):
+                out.append(i)
+        return out
+
+    def _pick(self, prompt, adapter_id=None):
         """(replica index, affinity_hit) for one PROMPT dispatch —
         restricted to prefill-capable replicas in a disaggregated
-        fleet. Raises NoReplicaAvailable when every candidate is down."""
+        fleet. Raises NoReplicaAvailable when every candidate is down.
+
+        Adapter affinity (ISSUE 14) filters FIRST: replicas whose
+        AdapterCache already holds the request's adapter keep their
+        warm slot (and skip a load), and the existing shadow-radix /
+        least-loaded ladder breaks ties among them. No holder -> the
+        full ladder decides and the landing replica loads the adapter
+        cold at admission."""
         live = [i for i in self._dispatch_targets
                 if self.health.alive(i)]
         if not live:
@@ -387,9 +423,23 @@ class ReplicaRouter:
         self.dispatches += 1
         if self.policy == "round_robin":
             idx = live[next(self._rr) % len(live)]
-            self.shadow.insert(idx, prompt)
+            self._shadow_note(idx, prompt, adapter_id)
             self._export_depths()
             return idx, False
+        if adapter_id is not None:
+            # adapter requests bypass the replica-side prefix cache
+            # (their K/V is adapter-specific), so the shadow radix
+            # neither matches nor learns them — residency + load
+            # decide instead
+            holders = self._adapter_holders(live, adapter_id)
+            if holders:
+                live = holders
+                self.adapter_affinity_hits += 1
+                if _pmetrics._enabled:
+                    smetrics.ROUTER_ADAPTER_AFFINITY_HITS.inc()
+            idx = min(live, key=lambda i: (self.queue_depth(i), i))
+            self._export_depths()
+            return idx, bool(holders)
         hits = {i: self.shadow.match(i, prompt) for i in live}
         best = max(hits.values())
         affinity = best >= self.shadow.bs        # >= one full KV block
@@ -491,13 +541,23 @@ class ReplicaRouter:
         return isinstance(e, _ReplicaDied) or not self.health.probe(idx)
 
     # ------------------------------------------------------------ serving
+    def register_adapter(self, adapter_id, weights):
+        """Register a LoRA adapter on EVERY replica (migrating fleets
+        need the registration wherever a request can land — failover
+        re-prefills under the same adapter, and disagg tickets
+        re-acquire a slot pin at the destination)."""
+        for fe in self.frontends:
+            fe.engine.register_adapter(adapter_id, weights)
+        return adapter_id
+
     async def submit(self, prompt, max_new_tokens=32, *,
-                     tenant="default", timeout=None):
+                     tenant="default", timeout=None, adapter_id=None):
         """Run one request to completion (with transparent failover);
         returns its generated token ids."""
         out = []
         async for tok in self.stream(prompt, max_new_tokens,
-                                     tenant=tenant, timeout=timeout):
+                                     tenant=tenant, timeout=timeout,
+                                     adapter_id=adapter_id):
             out.append(tok)
         return out
 
@@ -537,7 +597,7 @@ class ReplicaRouter:
         return remaining
 
     async def stream(self, prompt, max_new_tokens=32, *,
-                     tenant="default", timeout=None):
+                     tenant="default", timeout=None, adapter_id=None):
         """Async generator of generated tokens. On a replica death the
         request transparently re-submits to a live replica; tokens the
         caller already received are suppressed from the re-run. In a
@@ -546,14 +606,15 @@ class ReplicaRouter:
         see `_stream_disagg`."""
         if self.disagg:
             async for tok in self._stream_disagg(
-                    prompt, max_new_tokens, tenant, timeout):
+                    prompt, max_new_tokens, tenant, timeout,
+                    adapter_id=adapter_id):
                 yield tok
             return
         deadline = (self.clock() + float(timeout)
                     if timeout is not None else None)
         delivered = 0
         while True:
-            idx, _ = self._pick(prompt)
+            idx, _ = self._pick(prompt, adapter_id=adapter_id)
             self._count_role("mixed")
             remaining = self._remaining(idx, deadline)
             on_admitted, release = self._hold(idx)
@@ -561,15 +622,19 @@ class ReplicaRouter:
             try:
                 agen = self.frontends[idx].stream(
                     prompt, max_new_tokens, tenant=tenant,
-                    timeout=remaining, on_admitted=on_admitted)
+                    timeout=remaining, on_admitted=on_admitted,
+                    adapter_id=adapter_id)
                 async for tok in self._attempt(idx, agen, attempt_out):
                     if len(attempt_out) > delivered:
                         delivered += 1
                         yield tok
                 # replica finished the request: publish the chat turn
                 # to its shadow tree (the engine's finish-insert did
-                # the same with the real blocks)
-                self.shadow.insert(idx, list(prompt) + attempt_out)
+                # the same with the real blocks; adapter requests
+                # never entered the real cache, so their shadow stays
+                # out too)
+                self._shadow_note(idx, list(prompt) + attempt_out,
+                                  adapter_id)
                 self._count(idx, "finished")
                 return
             except _FAILOVER_ERRORS as e:
@@ -591,7 +656,7 @@ class ReplicaRouter:
                 release()
 
     async def _stream_disagg(self, prompt, max_new_tokens, tenant,
-                             timeout):
+                             timeout, adapter_id=None):
         """The disaggregated request pipeline, one async token stream:
 
         1. **Prefill dispatch** — affinity-steered over prefill-capable
@@ -625,7 +690,7 @@ class ReplicaRouter:
 
         try:
             while True:                     # failover restart loop
-                pidx, _ = self._pick(prompt)
+                pidx, _ = self._pick(prompt, adapter_id=adapter_id)
                 self._count_role("prefill")
                 on_blocks = None
                 didx = key = None
@@ -653,7 +718,7 @@ class ReplicaRouter:
                     agen = self.frontends[pidx].stream(
                         prompt, max_new_tokens, tenant=tenant,
                         timeout=remaining, on_admitted=on_admitted,
-                        on_blocks=on_blocks)
+                        on_blocks=on_blocks, adapter_id=adapter_id)
                     async for tok in self._attempt(pidx, agen,
                                                    attempt_out):
                         if len(attempt_out) > delivered:
@@ -663,7 +728,8 @@ class ReplicaRouter:
                     # serving end-to-end, or EOS/horizon at the prefill
                     # replica's first token): no migration happened
                     _drop_inbox()
-                    self.shadow.insert(pidx, prompt + attempt_out)
+                    self._shadow_note(pidx, prompt + attempt_out,
+                                      adapter_id)
                     self._count(pidx, "finished")
                     return
                 except RequestMigrated as e:
@@ -696,7 +762,8 @@ class ReplicaRouter:
                     didx = self._pick_decode(path, exclude=(pidx,))
                     key = f"req{next(self._mseq)}"
                     inbox[0], inbox[1] = didx, key
-                    self.shadow.on_migrate(pidx, didx, path)
+                    self._shadow_migrate(pidx, didx, path,
+                                         adapter_id)
                     self._note_migration("shed")
                 else:
                     self._note_migration("handoff")
@@ -711,7 +778,7 @@ class ReplicaRouter:
                     # placement bookkeeping: the KV now lives on didx
                     history = (list(assembled.prompt)
                                + list(assembled.output))
-                    self.shadow.insert(didx, history)
+                    self._shadow_note(didx, history, adapter_id)
                     remaining = self._remaining(didx, deadline)
                     on_admitted, release = self._hold(didx)
                     attempt_out = []
@@ -730,8 +797,9 @@ class ReplicaRouter:
                             if base + len(attempt_out) > delivered:
                                 delivered += 1
                                 yield tok
-                        self.shadow.insert(
-                            didx, history + attempt_out)
+                        self._shadow_note(didx,
+                                          history + attempt_out,
+                                          adapter_id)
                         self._count(didx, "finished")
                         return
                     except RequestMigrated as e:
@@ -741,7 +809,8 @@ class ReplicaRouter:
                         old = didx
                         path = list(t2.prompt) + list(t2.output)
                         didx = self._pick_decode(path, exclude=(old,))
-                        self.shadow.on_migrate(old, didx, path)
+                        self._shadow_migrate(old, didx, path,
+                                             adapter_id)
                         self._note_migration("shed")
                         self._count(old, "migrated")
                         key = f"req{next(self._mseq)}"
@@ -826,6 +895,7 @@ class ReplicaRouter:
         """Router-side counters (always on, registry-independent)."""
         out = {"dispatches": self.dispatches,
                "affinity_hits": self.affinity_hits,
+               "adapter_affinity_hits": self.adapter_affinity_hits,
                "failovers": self.failovers,
                "roles": list(self.roles),
                "migrations": dict(self.migrations),

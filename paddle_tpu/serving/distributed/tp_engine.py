@@ -124,24 +124,34 @@ class TPServingEngine(ServingEngine):
         `_gen_tensors` fixes: we, pe, decoder params, ln_f w/b, head —
         embeddings and the lm head replicate; decoder params follow
         `mp_layers.SERVING_TP_SPECS`, MoE experts
-        `SERVING_MOE_TP_SPECS`)."""
+        `SERVING_MOE_TP_SPECS`). The ENGINE's name list is the source
+        of truth: engine-side expert quantization may have added
+        ffn1_s/ffn2_s entries the float model never had."""
         from jax.sharding import PartitionSpec as P
-        names = self.model._dec_names
+        names = self._names
         moe = self.num_experts > 0
         return ([P(), P()]
                 + [serving_tp_spec(n, moe=moe)[0] for n in names]
                 + [P(), P(), P()])
 
+    def _adapter_specs(self):
+        """PartitionSpec per adapter slot tensor, in
+        `AdapterCache.array_names` order (SERVING_LORA_TP_SPECS)."""
+        return [serving_tp_spec(n)[0]
+                for n in self.adapters.array_names]
+
     def _shard_state(self):
         """Re-lay out the cast param arrays (shard-major QKV) and
-        device_put params + KV pools to their mesh shardings, so the
-        first step call compiles against the final layouts and never
-        pays a resharding copy."""
+        device_put params + KV pools + adapter slot tensors to their
+        mesh shardings, so the first step call compiles against the
+        final layouts and never pays a resharding copy."""
         import jax
         from jax.sharding import NamedSharding
 
+        from ...analysis.specs import canonicalize_spec
+
         dec = self.model.decoder
-        names = self.model._dec_names
+        names = self._names
         H, Dh = dec.num_heads, dec.head_dim
         moe = self.num_experts > 0
         specs = self._array_specs()
@@ -174,15 +184,51 @@ class TPServingEngine(ServingEngine):
         # step's input shardings stay byte-identical (a drift here is
         # a silent full recompile, the PR 8/PR 10 lesson)
         self.kv.place_pools = _place
+        if self.adapters is not None:
+            # adapter slot tensors: column-parallel B shards its out
+            # axis (qkv's shard-major-permuted), row-parallel A its in
+            # axis — the engine's step body then adds each delta on
+            # the same side of the psum as its base matmul
+            ad_sharding = {
+                n: NamedSharding(self.mesh, canonicalize_spec(
+                    spec, self.mesh))
+                for n, spec in zip(self.adapters.array_names,
+                                   self._adapter_specs())}
+            for n in self.adapters.array_names:
+                self.adapters._arrays[n] = jax.device_put(
+                    self.adapters._arrays[n], ad_sharding[n])
+            tp = self.tensor_parallel
+
+            def _prepare(name, arr, _tp=tp, _H=H, _Dh=Dh):
+                # host payload re-layout before the slot write: qkv's
+                # B out axis must be shard-major like qkv_w so a plain
+                # "mp" split IS a head split
+                if serving_tp_spec(name)[1]:
+                    import numpy as _np
+                    return _np.asarray(shard_major_qkv(
+                        jax.numpy.asarray(arr), _tp, _H, _Dh))
+                return arr
+
+            def _place_adapters(cache, _sh=ad_sharding,
+                                _put=jax.device_put):
+                # the donated load write's outputs re-pin the
+                # canonical shardings (same lesson as place_pools)
+                for n in cache.array_names:
+                    cache._arrays[n] = _put(cache._arrays[n], _sh[n])
+
+            self.adapters.prepare = _prepare
+            self.adapters.place = _place_adapters
 
     # ------------------------------------------------------ mixed step
     def _step_cfg(self):
         """Per-shard decoder config: local head count + the psum axis
         (engine._step_body emits the row-parallel reductions off it);
         MoE stacks additionally carry the ep axis/size for the
-        slice-dispatch + psum-combine in `_ffn_moe_tokens`."""
+        slice-dispatch + psum-combine in `_ffn_moe_tokens`. Starts
+        from the base engine's cfg so engine-side expert quantization
+        (moe_quant_bits) composes with sharding."""
         import dataclasses
-        cfg = self.model.decoder._cfg()
+        cfg = ServingEngine._step_cfg(self)
         rep = dict(num_heads=cfg.num_heads // self.tensor_parallel,
                    mp_axis="mp")
         if self.num_experts:
@@ -194,18 +240,29 @@ class TPServingEngine(ServingEngine):
 
         from .. import batcher
 
+        from ...analysis.specs import canonicalize_spec
+
         body = self._step_body(self._step_cfg())
         pool = self._pool_spec()
         rep = P()
         # int8 pools ride (k_scale, v_scale) right after the pools,
         # sharded on the same head axis; the step returns them too
         pools = (pool,) * (4 if self.kv.quantized else 2)
+        # adapter slot tensors follow the pools (engine._step_body's
+        # rest-parse order), each under its SERVING_LORA_TP_SPECS
+        # sharding; the per-token adapter-id vector replicates with
+        # the other flat-token inputs
+        lora_in = tuple(
+            canonicalize_spec(s, self.mesh)
+            for s in self._adapter_specs()) \
+            if self.adapters is not None else ()
         # flat-token inputs, block tables, the optional logit-processor
         # history and the rng key replicate; sampled tokens come off
         # the replicated post-psum hidden state so the token outputs
         # replicate too (check_vma=False: 0.4.x's checker can't see
         # through the scanned psum)
-        n_data = 6 + (1 if batcher.needs_history(self.sampling) else 0)
+        n_data = 6 + (1 if self.adapters is not None else 0) \
+            + (1 if batcher.needs_history(self.sampling) else 0)
         data_in = (rep,) * n_data
         # spec-sampling adds the residual-resample + accept matrices
         # to the verify outputs (engine._step_body) — all replicated,
@@ -220,5 +277,5 @@ class TPServingEngine(ServingEngine):
             if self.num_experts else ()
         return _shard_map(
             body, mesh=self.mesh,
-            in_specs=(self._array_specs(),) + pools + data_in,
+            in_specs=(self._array_specs(),) + pools + lora_in + data_in,
             out_specs=(tok_out,) + pools + stats_out, check_vma=False)

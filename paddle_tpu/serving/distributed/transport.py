@@ -86,7 +86,8 @@ class BlockChunk:
 _STATE_FIELDS = ("prompt", "output", "max_new_tokens", "eos_token_id",
                  "deadline", "tenant", "slot_len", "total_blocks",
                  "kv_meta", "submit_time", "first_token_time",
-                 "cache_hit_tokens", "preemptions", "created_at")
+                 "cache_hit_tokens", "preemptions", "created_at",
+                 "adapter_id")
 
 
 @dataclasses.dataclass
@@ -117,6 +118,11 @@ class MigrationTicket:
     cache_hit_tokens: int = 0
     preemptions: int = 0
     created_at: float = 0.0
+    # multi-LoRA (serving.adapters): the adapter the request decodes
+    # under travels with it — the destination re-acquires a slot pin
+    # at admission (it must hold the registration; JSON-serializable
+    # ids only, like tenant)
+    adapter_id: object = None
 
     def state_dict(self):
         d = {f: getattr(self, f) for f in _STATE_FIELDS}

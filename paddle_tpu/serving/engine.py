@@ -70,7 +70,8 @@ class ServingEngine:
                  sampling=None, eos_token_id=None, cache_dtype=None,
                  kv_dtype=None, seed=0, clock=time.monotonic,
                  draft_k=0, draft_ngram=3, prefix_caching=False,
-                 role="mixed"):
+                 role="mixed", max_adapters=0, lora_rank=8,
+                 lora_alpha=None, moe_weight_dtype=None):
         import functools
 
         import jax
@@ -161,6 +162,20 @@ class ServingEngine:
         if prefix_caching:
             from .prefix_cache import RadixPrefixCache
             self.prefix_cache = RadixPrefixCache(self.kv)
+        # multi-LoRA adapter slots (ISSUE 14, docs/SERVING.md
+        # "Multi-tenant serving"): fixed [L, K, ...] slot tensors per
+        # hooked projection ride the mixed step as inputs; the host
+        # cache pins/evicts/loads without ever changing a compiled
+        # shape. The compute dtype matches the step's so deltas cast
+        # once.
+        cdt_name = getattr(model, "_compute_dtype", "float32")
+        self.adapters = None
+        if int(max_adapters):
+            from .adapters import AdapterCache
+            self.adapters = AdapterCache(
+                dec, max_adapters=int(max_adapters),
+                rank=int(lora_rank), alpha=lora_alpha,
+                dtype=cdt_name, clock=clock)
         from .draft import ngram_propose
         self.scheduler = Scheduler(
             self.kv, max_slots=max_slots,
@@ -168,17 +183,31 @@ class ServingEngine:
             draft_k=self.draft_k,
             draft_fn=functools.partial(ngram_propose, k=self.draft_k,
                                        max_ngram=int(draft_ngram)),
-            prefix_cache=self.prefix_cache)
+            prefix_cache=self.prefix_cache,
+            adapter_cache=self.adapters)
         self.eos_token_id = eos_token_id
         self.clock = clock
         self._rng = jax.random.PRNGKey(int(seed))
         # cast float params to the compute dtype ONCE (same discipline
         # as generation.generate: a per-step astype re-reads the full
         # parameter set every token)
-        cdt = jnp.dtype(getattr(model, "_compute_dtype", "float32"))
+        cdt = jnp.dtype(cdt_name)
         self._arrays = [a.astype(cdt)
                         if a.dtype in (jnp.float32, jnp.float64) else a
                         for a in (t._data for t in model._gen_tensors())]
+        # the engine owns its decoder-param NAME list (a copy of the
+        # model's): engine-side expert quantization below may extend
+        # it with scale entries the float model never had
+        self._names = list(model._dec_names)
+        # engine-side weight-only expert quantization (ISSUE 14):
+        # serve a float/bf16 MoE stack with int8 or packed-int4
+        # experts without rebuilding the model — the expert arrays in
+        # self._arrays are quantized in place and the step cfg carries
+        # the matching moe_quant_bits
+        self.moe_weight_dtype = moe_weight_dtype
+        self._moe_weight_bits = 0
+        if moe_weight_dtype is not None:
+            self._quantize_moe_experts(str(moe_weight_dtype))
         # int8 pools: the scale arrays are donated alongside the pools
         # so the quantize-on-append writes alias in place too
         donate = (1, 2, 3, 4) if self.kv.quantized else (1, 2)
@@ -201,6 +230,44 @@ class ServingEngine:
                                           np.float64)
         self.moe_dropped_total = 0.0
         self.moe_last_aux = 0.0
+
+    def _quantize_moe_experts(self, dtype_str):
+        """Quantize the expert FFN stacks of `self._arrays` in place
+        (weight-only int8, or nibble-packed int4 with fp16 scales) and
+        extend `self._names` with the scale entries. Host-side, once,
+        at build — the mixed step then reads int8/int4 expert bytes
+        from HBM and dequantizes at the matmul (grouped kernel or
+        einsum path alike). Refused on non-MoE stacks and on models
+        that are already weight-only (requantizing int8 -> int4 would
+        compound quantization error silently)."""
+        import jax.numpy as jnp
+
+        from ..incubate.nn.fused_transformer import \
+            _quantize_expert_stack
+        if dtype_str not in ("int8", "int4"):
+            raise ValueError(
+                f"moe_weight_dtype={dtype_str!r} not supported; use "
+                "'int8' or 'int4'")
+        if not self.num_experts:
+            raise ValueError(
+                "moe_weight_dtype needs a MoE decoder stack")
+        if "ffn1_s" in self._names or "ffn2_s" in self._names:
+            raise ValueError(
+                "model experts are already weight-only quantized; "
+                "build the float model and let the engine quantize, "
+                "or pick the dtype at model build "
+                "(FusedMultiTransformerMoeWeightOnly(moe_quant_bits=))")
+        bits = 4 if dtype_str == "int4" else 8
+        for wname in ("ffn1_w", "ffn2_w"):
+            i = self._names.index(wname)
+            w = self._arrays[2 + i]            # [L, E, In, Out]
+            q, s = _quantize_expert_stack(
+                jnp.asarray(w).astype(jnp.float32), bits)
+            self._arrays[2 + i] = q
+            sname = wname[:-2] + "_s"
+            self._names.insert(i + 1, sname)
+            self._arrays.insert(2 + i + 1, s)
+        self._moe_weight_bits = bits
 
     def _note_kernel_buckets(self):
         """The (kernel, shape-bucket, dtype) keys this engine's mixed
@@ -245,8 +312,15 @@ class ServingEngine:
         """The decoder config the step body runs under. The TP engine
         (`serving.distributed.tp_engine`) overrides this with the
         per-shard head count and an `mp_axis`, and `_step_body` then
-        emits the matching psums — same math, sharded."""
-        return self.model.decoder._cfg()
+        emits the matching psums — same math, sharded. Engine-side
+        expert quantization overrides the cfg's moe bits so `_deq`/
+        the grouped kernel dequantize what the engine actually packed."""
+        import dataclasses
+        cfg = self.model.decoder._cfg()
+        if self._moe_weight_bits:
+            cfg = dataclasses.replace(
+                cfg, moe_quant_bits=self._moe_weight_bits)
+        return cfg
 
     def _build_step(self):
         return self._step_body(self._step_cfg())
@@ -256,15 +330,13 @@ class ServingEngine:
         import jax.numpy as jnp
 
         from ..incubate.nn.fused_transformer import (
-            _ffn_dense, _ffn_moe_tokens, _ln, _maybe_psum, _mm, _qkv)
+            _ffn_dense, _ffn_moe_tokens, _ln, _lora_delta, _maybe_psum,
+            _mm, _qkv)
         from ..ops.pallas.flash_attention import (
             ragged_paged_attention, verify_paged_attention)
 
         model = self.model
-        names = list(model._dec_names) if hasattr(model, "_dec_names") \
-            else None
-        if names is None:
-            names, _ = model.decoder._param_tensors()
+        names = list(self._names)
         L = cfg.num_layers
         BS = self.block_size
         T = self.token_budget
@@ -276,6 +348,9 @@ class ServingEngine:
         use_hist = batcher.needs_history(sc)
         moe = cfg.num_experts > 0
         spec_sampling = self.spec_sampling
+        lora = self.adapters is not None
+        ad_names = tuple(self.adapters.array_names) if lora else ()
+        K_ad = self.adapters.max_adapters if lora else 0
 
         def quantize(x):
             """[T, H, Dh] fp -> (int8 values, [T, H] fp32 scales):
@@ -290,22 +365,40 @@ class ServingEngine:
 
         def step(arrays, k_pool, v_pool, *rest):
             # static signature variants (one compile each way): int8
-            # pools add (k_scale, v_scale) after the pools; active
-            # logit processors add the [S, W] history before the rng
+            # pools add (k_scale, v_scale) after the pools; adapter
+            # slot tensors follow them, with the per-token adapter ids
+            # after sample_index; active logit processors add the
+            # [S, W] history before the rng
             rest = list(rest)
             k_scale = v_scale = history = None
             if quant:
                 k_scale, v_scale = rest[:2]
                 rest = rest[2:]
+            ad_arrays = ()
+            if lora:
+                ad_arrays = rest[:len(ad_names)]
+                rest = rest[len(ad_names):]
             (token_ids, slot_ids, positions, block_tables,
              sample_index) = rest[:5]
             rest = rest[5:]
+            adapter_ids = rest.pop(0) if lora else None
             if use_hist:
                 history = rest.pop(0)
             (rng,) = rest
-            we, pe, dec_arrays, lnw, lnb, head = \
-                model._split_arrays(arrays)
+            n_dec = len(names)
+            we, pe = arrays[0], arrays[1]
+            dec_arrays = arrays[2:2 + n_dec]
+            lnw, lnb, head = arrays[-3], arrays[-2], arrays[-1]
             params = dict(zip(names, dec_arrays))
+            if lora:
+                # the [L, K, ...] slot tensors join the scanned params
+                # so each layer's xs slice carries its own adapter
+                # rows; ONE [T, K] one-hot feeds every layer's deltas
+                params.update(dict(zip(ad_names, ad_arrays)))
+                lora_oh = jax.nn.one_hot(adapter_ids, K_ad,
+                                         dtype=jnp.float32)
+            else:
+                lora_oh = None
             valid = slot_ids >= 0
             pos = jnp.where(valid, positions, 0)
             x = model._embed(we, pe, token_ids, pos)          # [T, D]
@@ -323,7 +416,7 @@ class ServingEngine:
                 ms = carry[-1] if moe else None
                 pl, li = xs
                 hn = _ln(h, pl["ln_s"], pl["ln_b"], cfg.epsilon)
-                q, k, v = _qkv(cfg, pl, hn[None])
+                q, k, v = _qkv(cfg, pl, hn[None], lora_oh=lora_oh)
                 q, k, v = q[0], k[0], v[0]                  # [T, H, Dh]
                 if quant:
                     # quantize-on-append: int8 payload + per-entry
@@ -362,6 +455,12 @@ class ServingEngine:
                          ap], axis=0)
                 attn = attn.reshape(T, cfg.num_heads * cfg.head_dim)
                 out = _mm(cfg, attn, pl["out_w"], pl.get("out_s"))
+                if lora_oh is not None:
+                    # row-parallel LoRA: A holds this shard's head
+                    # slice of the in axis, so the delta is a partial
+                    # product that joins the psum right below
+                    out = out + _lora_delta(attn, pl["lora_out_a"],
+                                            pl["lora_out_b"], lora_oh)
                 # row-parallel reduction under TP (no-op when
                 # cfg.mp_axis is None): each shard holds the partial
                 # product of its own head slice; _ffn_dense below does
@@ -379,7 +478,7 @@ class ServingEngine:
                     h = h + f
                     ms = jax.tree.map(jnp.add, ms, st)
                 else:
-                    h = h + _ffn_dense(cfg, pl, hn)
+                    h = h + _ffn_dense(cfg, pl, hn, lora_oh=lora_oh)
                 new_carry = (h, kp, vp)
                 if quant:
                     new_carry += (ksc, vsc)
@@ -467,10 +566,22 @@ class ServingEngine:
         return step
 
     # ------------------------------------------------------------ intake
+    def register_adapter(self, adapter_id, weights):
+        """Register a LoRA finetune's host weights (see
+        `serving.adapters.AdapterCache.register`); device slots are
+        claimed lazily at admission."""
+        if self.adapters is None:
+            raise ValueError(
+                "this engine was built without adapter support "
+                "(ServingEngine(max_adapters=...))")
+        return self.adapters.register(adapter_id, weights)
+
     def submit(self, prompt_ids, max_new_tokens=32, deadline=None,
-               tenant="default"):
+               tenant="default", adapter_id=None):
         """Queue one request. Returns the scheduler's Request handle
-        (read `.output` / `.state` as the engine advances)."""
+        (read `.output` / `.state` as the engine advances).
+        `adapter_id` selects a registered LoRA adapter (None = base
+        model, token-identical to an adapter-free engine)."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -480,9 +591,19 @@ class ServingEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_position_embeddings "
                 f"({maxpos})")
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "request names an adapter but this engine was "
+                    "built without adapter support (max_adapters=0)")
+            if not self.adapters.known(adapter_id):
+                raise ValueError(
+                    f"adapter {adapter_id!r} is not registered on "
+                    "this engine (register_adapter first)")
         req = self.scheduler.submit(prompt, max_new_tokens,
                                     eos_token_id=self.eos_token_id,
-                                    deadline=deadline, tenant=tenant)
+                                    deadline=deadline, tenant=tenant,
+                                    adapter_id=adapter_id)
         if _pmetrics._enabled:
             smetrics.SERVING_QUEUE_DEPTH.set(len(self.scheduler.queue))
         return req
@@ -550,7 +671,8 @@ class ServingEngine:
             submit_time=req.submit_time,
             first_token_time=req.first_token_time,
             cache_hit_tokens=req.cache_hit_tokens,
-            preemptions=req.preemptions, created_at=self.clock())
+            preemptions=req.preemptions, created_at=self.clock(),
+            adapter_id=req.adapter_id)
         self.scheduler.extract(req)
         if _pmetrics._enabled:
             smetrics.SERVING_REQUESTS.labels("migrated").inc()
@@ -574,10 +696,33 @@ class ServingEngine:
             raise ValueError(
                 f"ticket carries {covered} blocks but declares "
                 f"{ticket.total_blocks} — transport lost a chunk")
+        aid = getattr(ticket, "adapter_id", None)
+        if aid is not None and (self.adapters is None
+                                or not self.adapters.known(aid)):
+            raise ValueError(
+                f"migrated request needs adapter {aid!r}, which is "
+                "not registered on this engine — register every "
+                "adapter on every replica of a migrating fleet "
+                "(ReplicaRouter.register_adapter does)")
         req = self.scheduler.submit_migrated(ticket)
         if _pmetrics._enabled:
             smetrics.SERVING_QUEUE_DEPTH.set(len(self.scheduler.queue))
         return req
+
+    def _adapter_token_ids(self, sp):
+        """Per-token adapter SLOT ids for one packed step, riding the
+        flat token axis exactly like the sampling params do: each
+        token inherits its owning slot's pinned adapter slot; padding
+        (and base-model) tokens carry the null slot 0. Rebuilt
+        host-side per step, so compiled shapes never depend on which
+        adapters are resident."""
+        slot_ad = np.zeros(self.kv.max_slots, np.int32)
+        for s, req in enumerate(self.scheduler.slots):
+            if req is not None:
+                slot_ad[s] = req.adapter_slot
+        return np.where(sp.slot_ids >= 0,
+                        slot_ad[np.clip(sp.slot_ids, 0, None)],
+                        0).astype(np.int32)
 
     def _penalty_history(self):
         """Fixed `[max_slots, penalty_window]` int32 context window for
@@ -635,10 +780,14 @@ class ServingEngine:
         args = [self._arrays, self.kv.k_pool, self.kv.v_pool]
         if self.kv.quantized:
             args += [self.kv.k_scale, self.kv.v_scale]
+        if self.adapters is not None:
+            args += self.adapters.device_arrays()
         args += [jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
                  jnp.asarray(sp.positions),
                  jnp.asarray(self.kv.block_tables),
                  jnp.asarray(sp.sample_index)]
+        if self.adapters is not None:
+            args.append(jnp.asarray(self._adapter_token_ids(sp)))
         if batcher.needs_history(self.sampling):
             args.append(jnp.asarray(self._penalty_history()))
         args.append(sub)

@@ -66,11 +66,13 @@ class RequestMigrated(Exception):
 class FrontendHandle:
     """One in-flight request as seen by a caller."""
 
-    def __init__(self, prompt, max_new_tokens, tenant, deadline):
+    def __init__(self, prompt, max_new_tokens, tenant, deadline,
+                 adapter_id=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.tenant = tenant
         self.deadline = deadline
+        self.adapter_id = adapter_id      # LoRA adapter (None = base)
         self.req = None               # scheduler Request once admitted
         self.queue = asyncio.Queue()  # tokens, then _DONE / exception
         self.published = 0
@@ -166,11 +168,13 @@ class ServingFrontend:
         await self.stop()
 
     # ------------------------------------------------------------ intake
-    async def _enqueue(self, prompt, max_new_tokens, tenant, timeout):
+    async def _enqueue(self, prompt, max_new_tokens, tenant, timeout,
+                       adapter_id=None):
         deadline = (self.engine.clock() + float(timeout)
                     if timeout is not None else None)
         handle = FrontendHandle(list(prompt), int(max_new_tokens),
-                                str(tenant), deadline)
+                                str(tenant), deadline,
+                                adapter_id=adapter_id)
         return await self._enqueue_handle(handle)
 
     async def _enqueue_handle(self, handle):
@@ -199,18 +203,20 @@ class ServingFrontend:
         return handle
 
     async def submit(self, prompt, max_new_tokens=32, *,
-                     tenant="default", timeout=None):
+                     tenant="default", timeout=None, adapter_id=None):
         """Run one request to completion; returns its generated token
-        ids. Cancelling the awaiting task cancels the request."""
+        ids. Cancelling the awaiting task cancels the request.
+        `adapter_id` selects a registered LoRA adapter (None = base)."""
         out = []
         async for tok in self.stream(prompt, max_new_tokens,
-                                     tenant=tenant, timeout=timeout):
+                                     tenant=tenant, timeout=timeout,
+                                     adapter_id=adapter_id):
             out.append(tok)
         return out
 
     async def stream(self, prompt, max_new_tokens=32, *,
-                     tenant="default", timeout=None, on_admitted=None,
-                     on_blocks=None):
+                     tenant="default", timeout=None, adapter_id=None,
+                     on_admitted=None, on_blocks=None):
         """Async generator of generated tokens, one per decode step
         (speculative acceptance can deliver several per step). Closing
         the generator — or cancelling its consumer — cancels the
@@ -225,7 +231,7 @@ class ServingFrontend:
         destination. On a prefill-role engine the stream ends with
         `RequestMigrated(ticket)` once the first token is sampled."""
         handle = await self._enqueue(prompt, max_new_tokens, tenant,
-                                     timeout)
+                                     timeout, adapter_id=adapter_id)
         handle.on_blocks = on_blocks
         if on_admitted is not None:
             on_admitted()
@@ -241,7 +247,9 @@ class ServingFrontend:
         `stream` (the ticket carries the original absolute deadline)."""
         handle = FrontendHandle(list(ticket.prompt),
                                 int(ticket.max_new_tokens),
-                                str(ticket.tenant), ticket.deadline)
+                                str(ticket.tenant), ticket.deadline,
+                                adapter_id=getattr(ticket,
+                                                   "adapter_id", None))
         handle.ticket = ticket
         handle.published = len(ticket.output)
         await self._enqueue_handle(handle)
@@ -317,7 +325,8 @@ class ServingFrontend:
                 else:
                     handle.req = self.engine.submit(
                         handle.prompt, handle.max_new_tokens,
-                        deadline=handle.deadline, tenant=handle.tenant)
+                        deadline=handle.deadline, tenant=handle.tenant,
+                        adapter_id=handle.adapter_id)
             except ValueError as e:      # oversized / empty prompt /
                 self._finish_handle(handle, e)  # mismatched KV geometry
                 continue

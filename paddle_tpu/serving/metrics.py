@@ -91,6 +91,24 @@ SERVING_HANDOFF_LATENCY = REGISTRY.histogram(
     "to the first token emitted by the destination replica",
     buckets=exponential_buckets(1e-4, 4.0, 10))
 
+# ---- multi-LoRA adapter cache (serving.adapters, ISSUE 14) -------------
+SERVING_ADAPTER_CACHE_HITS = REGISTRY.counter(
+    "paddle_tpu_serving_adapter_cache_hits_total",
+    "Admissions whose adapter was already resident in a device slot")
+SERVING_ADAPTER_CACHE_MISSES = REGISTRY.counter(
+    "paddle_tpu_serving_adapter_cache_misses_total",
+    "Admissions that loaded a cold adapter into a device slot (one "
+    "donated jitted slot-write each — never a recompile)")
+SERVING_ADAPTER_EVICTIONS = REGISTRY.counter(
+    "paddle_tpu_serving_adapter_evictions_total",
+    "Resident adapters LRU-evicted from their slot to admit a cold one")
+SERVING_ADAPTER_LOAD_SECONDS = REGISTRY.counter(
+    "paddle_tpu_serving_adapter_load_seconds_total",
+    "Wall seconds spent in adapter slot-write loads")
+SERVING_ADAPTERS_RESIDENT = REGISTRY.gauge(
+    "paddle_tpu_serving_adapters_resident",
+    "Non-null adapters currently holding a device slot")
+
 # ---- multi-replica router (serving.distributed.router) -----------------
 ROUTER_REQUESTS = REGISTRY.counter(
     "paddle_tpu_serving_router_requests_total",
@@ -110,6 +128,10 @@ ROUTER_AFFINITY_HITS = REGISTRY.counter(
     "paddle_tpu_serving_router_affinity_hits_total",
     "Dispatches routed to a replica whose shadow radix index already "
     "held at least one full block of the prompt")
+ROUTER_ADAPTER_AFFINITY_HITS = REGISTRY.counter(
+    "paddle_tpu_serving_router_adapter_affinity_hits_total",
+    "Dispatches steered to a replica whose AdapterCache already held "
+    "the request's LoRA adapter resident")
 ROUTER_FAILOVERS = REGISTRY.counter(
     "paddle_tpu_serving_router_failovers_total",
     "In-flight requests re-submitted to another replica after their "
@@ -171,6 +193,15 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_handoff_latency_seconds",
     "paddle_tpu_serving_router_migrations_total",
     "paddle_tpu_serving_router_prefill_decode_dispatch_total",
+    # multi-LoRA adapters (ISSUE 14): slot-cache traffic, eviction
+    # churn, load cost, residency, and the router's adapter-affinity
+    # steering
+    "paddle_tpu_serving_adapter_cache_hits_total",
+    "paddle_tpu_serving_adapter_cache_misses_total",
+    "paddle_tpu_serving_adapter_evictions_total",
+    "paddle_tpu_serving_adapter_load_seconds_total",
+    "paddle_tpu_serving_adapters_resident",
+    "paddle_tpu_serving_router_adapter_affinity_hits_total",
     # MoE serving (ISSUE 10): per-expert routing volume, capacity
     # drops, cumulative utilization entropy, latest balance loss
     "paddle_tpu_moe_expert_tokens_total",

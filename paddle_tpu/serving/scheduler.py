@@ -59,6 +59,11 @@ class Request:                     # in sets/queues across state moves
     preemptions: int = 0
     cache_hit_tokens: int = 0         # prefix-cache tokens skipped
     tenant: str = "default"           # frontend fairness bucket
+    # multi-LoRA (serving.adapters): the registered adapter this
+    # request decodes under (None = base model) and, while resident,
+    # the device slot its pin holds (0 = the reserved null slot)
+    adapter_id: object = None
+    adapter_slot: int = 0
     # disaggregated serving (serving.distributed.transport): inbound
     # migrations carry their KV payload until admission imports it;
     # prefill-role engines track which full blocks were already
@@ -95,7 +100,7 @@ class Plan:
 class Scheduler:
     def __init__(self, kv_cache, *, max_slots, token_budget,
                  clock=time.monotonic, draft_k=0, draft_fn=None,
-                 prefix_cache=None):
+                 prefix_cache=None, adapter_cache=None):
         self.kv = kv_cache
         self.max_slots = max_slots
         self.token_budget = token_budget
@@ -114,20 +119,29 @@ class Scheduler:
         # cached prompt heads, prefill completion / finish publish the
         # written blocks for later requests
         self.prefix_cache = prefix_cache
+        # multi-LoRA adapter cache (serving.adapters): admission pins
+        # the request's adapter into a device slot — and BLOCKS at the
+        # queue head when every slot is pinned by in-flight requests;
+        # `_free_slot` drops the pin on every release path
+        self.adapters = adapter_cache
 
     # ---------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
-               deadline=None, tenant="default"):
+               deadline=None, tenant="default", adapter_id=None):
         total = len(prompt) + max_new_tokens - 1  # last token never fed
         if total > self.kv.max_slot_tokens:
             raise ValueError(
                 f"request needs {total} cached tokens; a slot holds at "
                 f"most {self.kv.max_slot_tokens}")
+        if adapter_id is not None and self.adapters is None:
+            raise ValueError("request names an adapter but the "
+                             "scheduler has no adapter cache")
         now = self.clock()
         req = Request(req_id=next(self._ids), prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=eos_token_id, deadline=deadline,
-                      arrival=now, submit_time=now, tenant=str(tenant))
+                      arrival=now, submit_time=now, tenant=str(tenant),
+                      adapter_id=adapter_id)
         self.queue.append(req)
         return req
 
@@ -155,7 +169,8 @@ class Scheduler:
                       output=list(ticket.output),
                       cache_hit_tokens=int(ticket.cache_hit_tokens),
                       preemptions=int(ticket.preemptions),
-                      ticket=ticket)
+                      ticket=ticket,
+                      adapter_id=getattr(ticket, "adapter_id", None))
         req.first_token_time = ticket.first_token_time
         self.queue.appendleft(req)
         return req
@@ -172,6 +187,13 @@ class Scheduler:
     def _free_slot(self, req):
         if self.prefix_cache is not None:
             self.prefix_cache.unlock_slot(req.slot)
+        if self.adapters is not None and req.adapter_id is not None:
+            # every release path (finish/preempt/expire/cancel/extract)
+            # funnels through here, so each admission's pin is dropped
+            # exactly once; the adapter stays resident until LRU
+            # eviction needs its slot
+            self.adapters.release(req.adapter_id)
+            req.adapter_slot = 0
         self.kv.release_slot(req.slot)
         self.slots[req.slot] = None
         req.slot = -1
@@ -193,6 +215,20 @@ class Scheduler:
                 expired.append(req)
         return expired
 
+    def _acquire_adapter(self, req):
+        """Pin the queue head's adapter into a device slot. True on
+        success (or no adapter); False = every slot is pinned by
+        in-flight requests — admission BLOCKS at the head until one
+        finishes (residency gating, never slot corruption)."""
+        if self.adapters is None or req.adapter_id is None:
+            req.adapter_slot = 0
+            return True
+        slot_a = self.adapters.acquire(req.adapter_id)
+        if slot_a is None:
+            return False
+        req.adapter_slot = int(slot_a)
+        return True
+
     def _admit(self):
         for slot in range(self.max_slots):
             if not self.queue:
@@ -207,9 +243,17 @@ class Scheduler:
                     # is mid-stream and resuming it beats admitting
                     # fresh prompts behind it.
                     req = self.queue[0]
+                    if not self._acquire_adapter(req):
+                        break
                     if not self.kv.import_into_slot(
                             slot, req.ticket.slot_len,
                             req.ticket.chunks):
+                        # release the fresh pin so the retry next plan
+                        # can't stack a second one
+                        if self.adapters is not None \
+                                and req.adapter_id is not None:
+                            self.adapters.release(req.adapter_id)
+                            req.adapter_slot = 0
                         break
                     self.queue.popleft()
                     req.slot = slot
@@ -221,18 +265,25 @@ class Scheduler:
                     req.ticket = None          # payload consumed
                     self.slots[slot] = req
                     continue
+                if not self._acquire_adapter(self.queue[0]):
+                    break
                 req = self.queue.popleft()
                 req.slot = slot
                 req.state = "prefill"
                 req.fed = 0
                 self.slots[slot] = req
-                if self.prefix_cache is not None:
+                if self.prefix_cache is not None \
+                        and req.adapter_id is None:
                     # cached prompt head: adopt the shared blocks, mark
                     # their K/V as already resident, and start chunked
                     # prefill at the first uncached token. Re-admission
                     # after a preemption rides the same path — the
                     # victim's own published blocks usually cover most
-                    # of its re-prefill.
+                    # of its re-prefill. Requests under a non-null
+                    # adapter BYPASS the prefix cache entirely: their
+                    # K/V depends on the adapter, and the radix tree
+                    # keys by token ids alone — sharing across
+                    # adapters would serve another finetune's cache.
                     hit = self.prefix_cache.lookup_and_adopt(
                         slot, req.runtime_prompt)
                     req.fed = hit
@@ -368,8 +419,10 @@ class Scheduler:
             if completes and self.prefix_cache is not None:
                 # the whole prompt's K/V is resident now — publish its
                 # full blocks so concurrent same-prefix requests hit
+                # (base-model requests only: adapter K/V must never
+                # enter the token-keyed tree)
                 req = self.slots[slot]
-                if req is not None:
+                if req is not None and req.adapter_id is None:
                     self.prefix_cache.insert(slot, req.runtime_prompt)
 
     def note_accept(self, slot, new_len):
@@ -382,7 +435,8 @@ class Scheduler:
     def finish(self, req, now=None):
         req.state = "finished"
         req.finish_time = self.clock() if now is None else now
-        if self.prefix_cache is not None and req.slot >= 0:
+        if self.prefix_cache is not None and req.slot >= 0 \
+                and req.adapter_id is None:
             # publish prompt + generated history (chat-turn reuse);
             # only tokens whose K/V was actually written count — the
             # last emitted token never fed the step

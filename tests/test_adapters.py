@@ -1,0 +1,467 @@
+"""Multi-tenant LoRA serving (ISSUE 14): AdapterCache slot ledger,
+engine parity contracts (null adapter / tenant-vs-solo / TP=2),
+admission blocking on residency, prefix-cache bypass, eviction churn
+under one compile, int4 expert quantization lanes, and the
+tools/lora_smoke.py tier-1 wiring."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving.adapters import (AdapterCache, hook_dims,
+                                         make_random_adapter)
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+
+VOCAB = 211
+
+
+def small_model(moe=False, seed=0):
+    paddle.seed(seed)
+    kw = {}
+    if moe:
+        kw["moe"] = dict(num_expert=4, top_k=2, capacity_factor=2.0)
+    m = GPTForGeneration(vocab_size=VOCAB, hidden_size=32,
+                         num_layers=2, num_attention_heads=4,
+                         max_position_embeddings=128,
+                         compute_dtype="float32", **kw)
+    m.eval()
+    return m
+
+
+def engine(model, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", "float32")
+    kw.setdefault("seed", 0)
+    return ServingEngine(model, **kw)
+
+
+def prompts_for(rng, lens):
+    return [rng.randint(1, VOCAB, int(n)).tolist() for n in lens]
+
+
+# ------------------------------------------------------- cache ledger
+class TestAdapterCache:
+    def test_slot0_reserved_and_min_slots(self):
+        m = small_model()
+        with pytest.raises(ValueError):
+            AdapterCache(m.decoder, max_adapters=1, rank=4)
+        c = AdapterCache(m.decoder, max_adapters=3, rank=4)
+        assert c.acquire(None) == 0          # null adapter: slot 0
+        assert c.resident(None)
+        assert c.resident_count == 0
+
+    def test_register_validates_shapes(self):
+        m = small_model()
+        c = AdapterCache(m.decoder, max_adapters=3, rank=4)
+        ad = make_random_adapter(m.decoder, 4, seed=1)
+        c.register("a", ad)
+        with pytest.raises(ValueError):
+            c.register("b", {"qkv": ad["qkv"]})          # missing hooks
+        bad = dict(ad)
+        a, b = bad["qkv"]
+        bad["qkv"] = (a[:, :, :2], b)                    # wrong rank
+        with pytest.raises(ValueError):
+            c.register("b", bad)
+        with pytest.raises(ValueError):
+            c.acquire("never-registered")
+
+    def test_pin_lru_evict_and_blocking(self):
+        m = small_model()
+        c = AdapterCache(m.decoder, max_adapters=3, rank=4)  # 2 usable
+        for name in ("a", "b", "d"):
+            c.register(name, make_random_adapter(m.decoder, 4, seed=1))
+        sa = c.acquire("a")
+        sb = c.acquire("b")
+        assert {sa, sb} == {1, 2}
+        # both pinned: a third adapter cannot be admitted
+        assert c.acquire("d") is None
+        c.release("a")
+        # "a" unpinned -> LRU evicts it for "d"
+        sd = c.acquire("d")
+        assert sd == sa
+        assert not c.resident("a") and c.resident("d")
+        assert c.evictions == 1
+        # re-acquiring "a" must wait for a free slot again
+        assert c.acquire("a") is None
+        c.release("b")
+        assert c.acquire("a") == sb
+        # hits: second acquire of a resident adapter pins again
+        assert c.acquire("a") == sb
+        assert c.pin_count("a") == 2
+        c.release("a")
+        c.release("a")
+        c.release("d")
+        assert c.total_pins == 0
+        with pytest.raises(ValueError):
+            c.release("a")                   # release without a pin
+
+    def test_bytes_per_slot_matches_hooks(self):
+        m = small_model()
+        c = AdapterCache(m.decoder, max_adapters=3, rank=4)
+        want = sum(4 * (di + do) * m.decoder.num_layers * 4
+                   for _, di, do in hook_dims(m.decoder))
+        assert c.bytes_per_slot == want
+
+    def test_moe_hooks_attention_only(self):
+        m = small_model(moe=True)
+        names = [n for n, _, _ in hook_dims(m.decoder)]
+        assert names == ["qkv", "out"]
+
+
+# --------------------------------------------------- engine contracts
+class TestAdapterEngine:
+    def test_null_adapter_token_identical_and_one_compile(self):
+        m = small_model()
+        rng = np.random.RandomState(7)
+        ps = prompts_for(rng, (3, 9, 17, 5))
+        base = engine(m)
+        out_base = base.generate_batch(ps, max_new_tokens=6)
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            e = engine(m, max_adapters=3, lora_rank=4)
+            e.register_adapter("t1", make_random_adapter(
+                m.decoder, 4, seed=1, scale=0.3))
+            reqs = [e.submit(p, 6) for p in ps]
+            e.run()
+            assert [list(r.output) for r in reqs] == out_base
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value == 1
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+    def test_tenant_solo_parity_across_eviction_churn(self):
+        m = small_model()
+        rng = np.random.RandomState(3)
+        ps = prompts_for(rng, (4, 11, 6, 9, 14, 5, 8, 7))
+        ads = {t: make_random_adapter(m.decoder, 4, seed=i + 1,
+                                      scale=0.3)
+               for i, t in enumerate(("t1", "t2", "t3"))}
+        # 2 usable slots, 3 tenants -> at least one evict-reload
+        multi = engine(m, max_adapters=3, lora_rank=4)
+        for t, w in ads.items():
+            multi.register_adapter(t, w)
+        tenants = ["t1", "t2", "t1", "t3", "t2", "t1", "t3", "t2"]
+        reqs = [multi.submit(p, 6, adapter_id=t)
+                for p, t in zip(ps, tenants)]
+        multi.run()
+        outs = [list(r.output) for r in reqs]
+        assert multi.adapters.evictions >= 1
+        assert multi.adapters.total_pins == 0
+        assert multi.kv.blocks_in_use == 0
+        for t in ads:
+            solo = engine(m, max_adapters=2, lora_rank=4)
+            solo.register_adapter(t, ads[t])
+            idxs = [i for i, x in enumerate(tenants) if x == t]
+            sr = [solo.submit(ps[i], 6, adapter_id=t) for i in idxs]
+            solo.run()
+            assert [list(r.output) for r in sr] == \
+                [outs[i] for i in idxs]
+
+    def test_adapter_changes_tokens(self):
+        m = small_model()
+        rng = np.random.RandomState(5)
+        ps = prompts_for(rng, (6, 12))
+        base = engine(m)
+        out_base = base.generate_batch(ps, max_new_tokens=8)
+        e = engine(m, max_adapters=2, lora_rank=4)
+        e.register_adapter("t", make_random_adapter(
+            m.decoder, 4, seed=2, scale=0.5))
+        reqs = [e.submit(p, 8, adapter_id="t") for p in ps]
+        e.run()
+        assert [list(r.output) for r in reqs] != out_base
+
+    def test_admission_blocks_until_pin_frees(self):
+        """All non-null slots pinned by running requests: a request
+        for a THIRD adapter waits in queue (no corruption, no crash)
+        and is served once a tenant finishes."""
+        m = small_model()
+        rng = np.random.RandomState(9)
+        e = engine(m, max_slots=2, max_adapters=3, lora_rank=4)
+        for i, t in enumerate(("a", "b", "d")):
+            e.register_adapter(t, make_random_adapter(
+                m.decoder, 4, seed=i + 1, scale=0.3))
+        ra = e.submit(rng.randint(1, VOCAB, 4).tolist(), 10,
+                      adapter_id="a")
+        rb = e.submit(rng.randint(1, VOCAB, 4).tolist(), 10,
+                      adapter_id="b")
+        rd = e.submit(rng.randint(1, VOCAB, 4).tolist(), 4,
+                      adapter_id="d")
+        e.step()
+        # a and b admitted and pinned; d must still be queued
+        assert ra.slot >= 0 and rb.slot >= 0
+        assert rd.state == "queued"
+        e.run()
+        assert all(r.state == "finished" for r in (ra, rb, rd))
+        assert len(rd.output) == 4
+        assert e.adapters.total_pins == 0
+
+    def test_unknown_adapter_rejected_at_submit(self):
+        m = small_model()
+        e = engine(m, max_adapters=2, lora_rank=4)
+        with pytest.raises(ValueError):
+            e.submit([1, 2, 3], 4, adapter_id="nope")
+        base = engine(m)
+        with pytest.raises(ValueError):
+            base.submit([1, 2, 3], 4, adapter_id="nope")
+
+    def test_preemption_reacquires_adapter(self):
+        """A preempted tenant request re-prefills under the SAME
+        adapter after re-admission — outputs match the unpressured
+        engine."""
+        m = small_model()
+        rng = np.random.RandomState(13)
+        ps = prompts_for(rng, (9, 11, 10))
+        ad = make_random_adapter(m.decoder, 4, seed=4, scale=0.3)
+        roomy = engine(m, max_adapters=2, lora_rank=4)
+        roomy.register_adapter("t", ad)
+        r0 = [roomy.submit(p, 8, adapter_id="t") for p in ps]
+        roomy.run()
+        want = [list(r.output) for r in r0]
+        tight = engine(m, max_adapters=2, lora_rank=4, num_blocks=13)
+        tight.register_adapter("t", ad)
+        reqs = [tight.submit(p, 8, adapter_id="t") for p in ps]
+        tight.run()
+        assert tight.scheduler.preemption_count > 0
+        assert [list(r.output) for r in reqs] == want
+        assert tight.adapters.total_pins == 0
+
+    def test_prefix_cache_bypassed_for_adapter_requests(self):
+        """Same prompt under two adapters + base: outputs differ per
+        adapter, adapter requests record no prefix hits, and base
+        requests still share."""
+        m = small_model()
+        rng = np.random.RandomState(17)
+        head = rng.randint(1, VOCAB, 16).tolist()
+        e = engine(m, max_adapters=3, lora_rank=4,
+                   prefix_caching=True)
+        for i, t in enumerate(("a", "b")):
+            e.register_adapter(t, make_random_adapter(
+                m.decoder, 4, seed=i + 5, scale=0.4))
+        r1 = e.submit(head, 6, adapter_id="a")
+        e.run()
+        r2 = e.submit(head, 6, adapter_id="b")
+        e.run()
+        r3 = e.submit(head, 6)
+        e.run()
+        r4 = e.submit(head, 6)
+        e.run()
+        assert list(r1.output) != list(r2.output)
+        # adapter requests never hit (or seeded) the radix tree
+        assert r1.cache_hit_tokens == 0 and r2.cache_hit_tokens == 0
+        # the base request seeded it; the second base request hits
+        assert r4.cache_hit_tokens > 0
+        # and the base pair is self-consistent
+        assert list(r3.output) == list(r4.output)
+
+    def test_tp2_token_identical_with_adapters(self):
+        from paddle_tpu.serving.distributed.tp_engine import \
+            TPServingEngine
+        m = small_model()
+        rng = np.random.RandomState(21)
+        ps = prompts_for(rng, (3, 9, 17))
+        ad = make_random_adapter(m.decoder, 4, seed=1, scale=0.3)
+
+        def run(e):
+            e.register_adapter("t1", ad)
+            reqs = [e.submit(p, 6,
+                             adapter_id=("t1" if i % 2 else None))
+                    for i, p in enumerate(ps)]
+            e.run()
+            return [list(r.output) for r in reqs]
+
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            o1 = run(engine(m, max_adapters=3, lora_rank=4))
+            e2 = TPServingEngine(m, tensor_parallel=2, max_slots=4,
+                                 block_size=4, max_seq_len=64,
+                                 cache_dtype="float32", seed=0,
+                                 max_adapters=3, lora_rank=4)
+            o2 = run(e2)
+            assert o1 == o2
+            assert pm.JIT_COMPILES.labels(STEP_FN_NAME).value == 2
+            assert pm.JIT_COMPILES.labels(
+                "serving_adapter_load").value == 2
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+
+
+# --------------------------------------------- int4 expert lanes
+class TestInt4Experts:
+    def test_engine_side_quantization_int8_int4(self):
+        m = small_model(moe=True, seed=7)
+        rng = np.random.RandomState(3)
+        ps = prompts_for(rng, (3, 9, 17, 5))
+        fp = engine(m)
+        out_fp = fp.generate_batch(ps, max_new_tokens=4)
+        for dt, packed_rows in (("int8", 32), ("int4", 16)):
+            q = engine(m, moe_weight_dtype=dt)
+            out_q = q.generate_batch(ps, max_new_tokens=4)
+            assert len(out_q) == len(out_fp)
+            w = q._arrays[2 + q._names.index("ffn1_w")]
+            s = q._arrays[2 + q._names.index("ffn1_s")]
+            assert w.shape[-2] == packed_rows and str(w.dtype) == "int8"
+            assert str(s.dtype) == ("float16" if dt == "int4"
+                                    else "float32")
+
+    def test_engine_refuses_bad_targets(self):
+        dense = small_model()
+        with pytest.raises(ValueError):
+            engine(dense, moe_weight_dtype="int4")
+        moe = small_model(moe=True)
+        with pytest.raises(ValueError):
+            engine(moe, moe_weight_dtype="int2")
+        paddle.seed(0)
+        already = GPTForGeneration(
+            vocab_size=VOCAB, hidden_size=32, num_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            compute_dtype="float32", weight_only=True,
+            moe=dict(num_expert=4, top_k=2))
+        already.eval()
+        with pytest.raises(ValueError):
+            engine(already, moe_weight_dtype="int4")
+
+    def test_model_level_int4_class(self):
+        paddle.seed(0)
+        m = GPTForGeneration(
+            vocab_size=VOCAB, hidden_size=32, num_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            compute_dtype="float32", weight_only=True,
+            moe=dict(num_expert=4, top_k=2, moe_quant_bits=4))
+        m.eval()
+        d = m.decoder
+        assert d._moe_quant_bits == 4
+        # experts packed (half the contraction rows), fp16 scales;
+        # attention stays int8 with fp32 scales
+        assert d.ffn1_weights.shape[-2] == d.embed_dim // 2
+        assert str(d.ffn1_scales._data.dtype) == "float16"
+        assert str(d.qkv_scales._data.dtype) == "float32"
+        e = engine(m)
+        out = e.generate_batch([[5, 9, 23]], max_new_tokens=4)
+        assert len(out[0]) == 4
+
+    def test_grid_snapped_int4_token_identical(self):
+        """Expert weights on the exact int4 grid: engine-side packing
+        must round-trip losslessly — token-identical serving (the
+        lora_smoke int4 phase's contract, unit-sized here)."""
+        import jax.numpy as jnp
+        m = small_model(moe=True, seed=7)
+        for attr in ("ffn1_weights", "ffn2_weights"):
+            w = getattr(m.decoder, attr)._data.astype(jnp.float32)
+            sc = jnp.maximum(jnp.max(jnp.abs(w), axis=-2), 1e-9)
+            q = jnp.clip(jnp.round(w / sc[:, :, None, :] * 7.0), -7, 7)
+            getattr(m.decoder, attr)._data = q * (sc[:, :, None, :]
+                                                  / 7.0)
+        rng = np.random.RandomState(3)
+        ps = prompts_for(rng, (3, 9, 17, 5, 12))
+        out_fp = engine(m).generate_batch(ps, max_new_tokens=6)
+        out_q4 = engine(m, moe_weight_dtype="int4").generate_batch(
+            ps, max_new_tokens=6)
+        assert out_fp == out_q4
+
+
+# ------------------------------------------------ router affinity
+class TestRouterAdapterAffinity:
+    def _replicas(self, m, n=2):
+        from paddle_tpu.serving.frontend import ServingFrontend
+        return [ServingFrontend(
+            engine(m, max_slots=3, max_adapters=3, lora_rank=4),
+            max_pending=16) for _ in range(n)]
+
+    def test_adapter_affinity_steers_to_resident_replica(self):
+        import asyncio
+
+        from paddle_tpu.serving.distributed.router import ReplicaRouter
+        m = small_model()
+        rng = np.random.RandomState(31)
+        ads = {t: make_random_adapter(m.decoder, 4, seed=i + 1,
+                                      scale=0.3)
+               for i, t in enumerate(("a", "b"))}
+        ps = prompts_for(rng, (5, 7, 6, 9, 4, 8))
+
+        async def run():
+            router = ReplicaRouter(self._replicas(m))
+            for t, w in ads.items():
+                router.register_adapter(t, w)
+            async with router:
+                outs = []
+                for i, p in enumerate(ps):
+                    t = ("a", "b")[i % 2]
+                    outs.append(await router.submit(
+                        p, max_new_tokens=5, adapter_id=t))
+            return outs, router
+
+        outs, router = asyncio.run(run())
+        # after the first dispatch per tenant, every same-tenant
+        # request lands where its adapter is already resident
+        assert router.adapter_affinity_hits >= len(ps) - 2
+        # solo parity: the routed outputs match a solo engine per
+        # tenant (the router adds steering, never math)
+        for t in ("a", "b"):
+            solo = engine(m, max_adapters=2, lora_rank=4)
+            solo.register_adapter(t, ads[t])
+            idxs = [i for i in range(len(ps))
+                    if ("a", "b")[i % 2] == t]
+            sr = [solo.submit(ps[i], 5, adapter_id=t) for i in idxs]
+            solo.run()
+            assert [list(r.output) for r in sr] == \
+                [outs[i] for i in idxs]
+
+    def test_adapter_requests_skip_shadow_radix(self):
+        import asyncio
+
+        from paddle_tpu.serving.distributed.router import ReplicaRouter
+        m = small_model()
+        rng = np.random.RandomState(37)
+        head = rng.randint(1, VOCAB, 12).tolist()
+        ad = make_random_adapter(m.decoder, 4, seed=3, scale=0.3)
+
+        async def run():
+            router = ReplicaRouter(self._replicas(m))
+            router.register_adapter("a", ad)
+            async with router:
+                for _ in range(3):
+                    await router.submit(head, max_new_tokens=4,
+                                        adapter_id="a")
+            return router
+
+        router = asyncio.run(run())
+        # adapter traffic never teaches the shadow radix (its blocks
+        # never enter the real prefix cache either)
+        assert router.affinity_hits == 0
+        assert all(router.shadow.size(i) == 0
+                   for i in range(len(router.frontends)))
+
+
+# ----------------------------------------------------- smoke wiring
+def test_lora_smoke_tool(capsys):
+    """tools/lora_smoke.py is the tier-1 CI contract: K=4 adapters
+    over a Poisson multi-tenant stream with forced slot churn —
+    null/tenant parity, exactly 1 mixed-step compile + 1 load
+    compile, zero leaked pins/blocks, the int4 expert capacity +
+    agreement phase, and the adapter metric names in the dump."""
+    import importlib.util
+    import os
+
+    pm.REGISTRY.reset()
+    was = pm._enabled
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "lora_smoke.py")
+    spec = importlib.util.spec_from_file_location("lora_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        rc = mod.main()
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "paddle_tpu_serving_adapter_cache_hits_total" in out
+        assert "paddle_tpu_serving_adapters_resident" in out
+    finally:
+        pm.REGISTRY.reset()
+        if not was:
+            pm.disable()

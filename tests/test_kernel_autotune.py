@@ -524,6 +524,76 @@ class TestGroupedMatmulParity:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_int4_pack_unpack_roundtrip_property(self):
+        """pack_int4/unpack_int4 round-trip exactly over the full
+        int4 range on random shapes/axes (the nibble layout both the
+        kernel and `_deq` decode)."""
+        rng = np.random.RandomState(3)
+        for _ in range(20):
+            nd = rng.randint(2, 5)
+            shape = [int(rng.randint(1, 7)) for _ in range(nd)]
+            axis = int(rng.randint(-nd, nd))
+            shape[axis] = 2 * int(rng.randint(1, 9))   # even pack axis
+            q = rng.randint(-8, 8, shape).astype(np.int8)
+            p = gmm.pack_int4(q, axis=axis)
+            assert p.shape[axis % nd] == shape[axis % nd] // 2
+            assert np.array_equal(np.asarray(
+                gmm.unpack_int4(p, axis=axis)), q)
+        with pytest.raises(ValueError):
+            gmm.pack_int4(np.zeros((3, 5), np.int8), axis=-1)
+
+    @pytest.mark.parametrize("E,C,D,F", [(2, 8, 16, 32), (4, 16, 32, 16),
+                                         (3, 5, 8, 24)])
+    def test_int4_weight_dequant_cell(self, E, C, D, F, tmp_cache):
+        """int4 twin of EVERY fp test-matrix entry: packed weights +
+        fp16 scales through the quant4 kernel vs the einsum oracle."""
+        import jax.numpy as jnp
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(E, C, D).astype(np.float32))
+        q = rng.randint(-7, 8, (E, D, F)).astype(np.int8)
+        w = gmm.pack_int4(jnp.asarray(q), axis=-2)
+        s = jnp.asarray((np.abs(rng.randn(E, F)) * 0.05 + 0.01).astype(
+            np.float16))
+        out = gmm.grouped_expert_matmul(x, w, s, qmax=gmm.INT4_QMAX)
+        ref = gmm.grouped_matmul_oracle(x, w, s, qmax=gmm.INT4_QMAX,
+                                        out_dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        # the oracle itself decodes the packed layout exactly
+        wd = np.asarray(gmm.unpack_int4(w, axis=-2))
+        assert np.array_equal(wd, q)
+
+    def test_int4_quantize_dequant_error_bound(self):
+        """quantize_int4_experts: dequant error bounded by half a
+        quantization step per weight (round-to-nearest on a symmetric
+        7-level-per-side grid)."""
+        rng = np.random.RandomState(5)
+        w = rng.randn(2, 3, 8, 12).astype(np.float32)
+        p, s = gmm.quantize_int4_experts(w)
+        assert str(p.dtype) == "int8" and str(s.dtype) == "float16"
+        q = np.asarray(gmm.unpack_int4(p, axis=-2), np.float32)
+        deq = q * (np.asarray(s, np.float32)[..., None, :]
+                   / gmm.INT4_QMAX)
+        step = np.asarray(s, np.float32) / gmm.INT4_QMAX
+        err = np.abs(deq - w)
+        # fp16 scale rounding adds a hair on top of the half-step
+        assert (err <= 0.51 * step[..., None, :] + 1e-6).all()
+
+    def test_int4_tune_seeds_int4_key(self, tmp_cache):
+        """tune_grouped_matmul(dtype='int4') searches the packed
+        variant and persists under the int4 weight dtype — the
+        seeder's int4 twin lane (never clobbering fp/int8 entries)."""
+        res = gmm.tune_grouped_matmul(2, 8, 16, 32, dtype="int4",
+                                      timer=lambda f, a, r: 0.0)
+        assert res.rejected == 0 and res.tried >= 1
+        key = at.cache_key("grouped_matmul", at.shape_bucket(2, 8, 16,
+                                                             32),
+                           np.dtype("int4"))
+        assert at.kernel_config(
+            "grouped_matmul", at.shape_bucket(2, 8, 16, 32),
+            np.dtype("int4"), default=None) is not None
+        assert "int4" in key
+
     def test_tile_candidates_all_pass_parity(self, tmp_cache):
         """Every tile candidate the space emits survives the oracle
         gate (the search can only be choosing among correct

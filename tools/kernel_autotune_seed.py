@@ -100,14 +100,18 @@ def seed(out_path, budget_s=15.0, verbose=True):
         note(f"splash|{s}",
              flash_attention.tune_splash(s, budget_s=budget_s))
 
-    # 4. grouped-expert matmul tiles at the MoE serving smoke shape
-    #    (fp and int8-dequant share the bucket; fp numbers seed it)
+    # 4. grouped-expert matmul tiles at the canonical MoE serving
+    #    buckets — fp32 plus the int8 AND int4 weight-only twins
+    #    (quantized lookups key by the WEIGHT dtype, so without the
+    #    twins every quantized engine's tile lookup would miss; the
+    #    ISSUE 14 satellite closing the PR 11 int8 precedent)
     if verbose:
         print("grouped_matmul:")
     for e, c, dd, f in ((4, 32, 128, 512), (4, 16, 32, 128)):
-        note(f"grouped_matmul|{e}x{c}x{dd}x{f}",
-             grouped_matmul.tune_grouped_matmul(
-                 e, c, dd, f, budget_s=budget_s))
+        for dt in ("float32", "int8", "int4"):
+            note(f"grouped_matmul|{e}x{c}x{dd}x{f}|{dt}",
+                 grouped_matmul.tune_grouped_matmul(
+                     e, c, dd, f, dtype=dt, budget_s=budget_s))
 
     return results
 
