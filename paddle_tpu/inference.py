@@ -53,7 +53,9 @@ class Config:
                                    migration=None,
                                    max_adapters=None, lora_rank=None,
                                    lora_alpha=None,
-                                   moe_weight_dtype=None):
+                                   moe_weight_dtype=None,
+                                   sparse_blocks=None,
+                                   sparse_recent=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
@@ -105,9 +107,27 @@ class Config:
         ("int8" | "int4") quantizes a float MoE stack's EXPERT weights
         at engine build — int4 packs two nibbles per byte with
         per-(expert, out-channel) fp16 scales, dequantized at the
-        matmul tile load (ops/pallas/grouped_matmul.py)."""
+        matmul tile load (ops/pallas/grouped_matmul.py).
+
+        Long-context serving (docs/SERVING.md "Long-context serving",
+        ISSUE 15): `sparse_blocks=B` turns on block-sparse paged
+        decode attention — every decode/verify query scores the
+        candidate KV blocks against per-block min/max key summaries
+        and attends only B top-scoring blocks plus the first block
+        (attention sink) and a `sparse_recent`-block recency window;
+        `B >= allocated blocks` is token-identical to dense and
+        sparsity never recompiles. `kv_dtype="fp8_e4m3"` stores the
+        pools as e4m3 bytes under the int8 scale plumbing — half of
+        int8's fp32-baseline bytes again, composable with sparsity,
+        TP sharding, transport and the prefix cache."""
         # validate BEFORE any assignment: a raising call must leave the
         # config exactly as it was (callers catch and retry)
+        if kv_dtype is not None:
+            from .serving.kv_cache import KV_DTYPES
+            if str(kv_dtype) not in KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype={kv_dtype!r} not supported; pick one "
+                    f"of {sorted(KV_DTYPES)}")
         if (prefill_replicas is not None) != (decode_replicas is not None):
             raise ValueError(
                 "prefill_replicas and decode_replicas come as a pair "
@@ -124,7 +144,8 @@ class Config:
             cache_dtype=cache_dtype, kv_dtype=kv_dtype, draft_k=draft_k,
             draft_ngram=draft_ngram, prefix_caching=prefix_caching,
             max_adapters=max_adapters, lora_rank=lora_rank,
-            lora_alpha=lora_alpha, moe_weight_dtype=moe_weight_dtype)
+            lora_alpha=lora_alpha, moe_weight_dtype=moe_weight_dtype,
+            sparse_blocks=sparse_blocks, sparse_recent=sparse_recent)
         self._max_pending = max_pending
         self._tensor_parallel = tensor_parallel
         self._expert_parallel = expert_parallel
@@ -331,7 +352,14 @@ def create_serving_router(config: Config, model, sampling=None, seed=0):
         if roles[r] == "prefill":
             # prefill replicas never decode past the first token, so
             # speculation would only waste the reserved verify region
-            return {"role": "prefill", "draft_k": 0}
+            # — and in a block-sparse fleet they likewise skip the
+            # sparse decode region while still MAINTAINING the block
+            # summaries (track_summaries), so their exported blocks
+            # match a sparse decode replica's kv_meta geometry
+            ov = {"role": "prefill", "draft_k": 0}
+            if (config.serving_config() or {}).get("sparse_blocks"):
+                ov.update(sparse_blocks=None, track_summaries=True)
+            return ov
         return {"role": "decode"}
 
     frontends = [ServingFrontend(

@@ -424,6 +424,10 @@ def _default_searcher(kernel, bucket, dtype, budget_s):
                 "paged_verify", *b, dtype=d, budget_s=t),
             "paged_decode": lambda b, d, t: _pa.tune_paged_kernel(
                 "paged_decode", *b, dtype=d, budget_s=t),
+            # block-sparse decode (ISSUE 15): 6-dim bucket — the last
+            # axis is the shortened-table width (sparsity budget B)
+            "paged_sparse": lambda b, d, t: _pa.tune_paged_sparse(
+                *b, dtype=d, budget_s=t),
             "paged_block_size": lambda b, d, t: _pa.tune_block_size(
                 *b, dtype=d, budget_s=t),
             "flash_fwd": lambda b, d, t: _fa.tune_flash(
@@ -579,6 +583,7 @@ SEARCH_SPACES = {
     "paged_ragged": paged_candidates,
     "paged_verify": paged_candidates,
     "paged_decode": paged_candidates,
+    "paged_sparse": paged_candidates,
     "paged_block_size": paged_block_size_candidates,
     "grouped_matmul": grouped_matmul_candidates,
 }

@@ -533,7 +533,7 @@ def _gather_dequant(pool, scale_pool, bt, q_dtype):
 
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
                            positions, k_scale=None, v_scale=None, *,
-                           scale=None):
+                           scale=None, kernel_name="paged_ragged"):
     """Flat-token attention over a block-paged KV cache — the kernel of
     the continuous-batching mixed step (`paddle_tpu.serving.engine`),
     following the Ragged-Paged-Attention shape discipline: ONE fixed
@@ -551,9 +551,14 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     (padding blocks beyond the sequence are masked by construction, so
     the NULL-block garbage is never read through).
 
-    With `k_scale`/`v_scale` (`[NB, BS, H]` fp32) the pools are int8
-    and dequantized per entry per head — on the gather path right
-    after the gather, in the Pallas kernels inside the KV tile load.
+    With `k_scale`/`v_scale` (`[NB, BS, H]` fp32) the pools are
+    quantized (int8 or fp8_e4m3) and dequantized per entry per head —
+    on the gather path right after the gather, in the Pallas kernels
+    inside the KV tile load.
+
+    `kernel_name` keys the autotuner lookup (the sparse decode region
+    passes "paged_sparse" with its shortened block tables, ISSUE 15);
+    the math is identical for any name.
 
     On a TPU backend (or under kernel-test interpret mode) this
     dispatches to the block-table-native Pallas kernel
@@ -575,7 +580,8 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     if _paged_kernel_enabled(Dh, BS):
         from .paged_attention import ragged_attend
         return ragged_attend(q, k_pool, v_pool, block_tables, slot_ids,
-                             positions, k_scale, v_scale, scale=scale)
+                             positions, k_scale, v_scale, scale=scale,
+                             kernel_name=kernel_name)
     return ragged_gather_reference(q, k_pool, v_pool, block_tables,
                                    slot_ids, positions, k_scale,
                                    v_scale, scale=scale)
@@ -608,7 +614,7 @@ def ragged_gather_reference(q, k_pool, v_pool, block_tables, slot_ids,
 
 def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
                            positions, k_scale=None, v_scale=None, *,
-                           scale=None):
+                           scale=None, kernel_name="paged_verify"):
     """Verify-shaped paged attention: q `[B, K, H, Dh]` — K queries per
     slot (the speculative draft window: the last accepted token plus
     the proposed draft tokens), each attending its own slot's paged
@@ -644,7 +650,8 @@ def verify_paged_attention(q, k_pool, v_pool, block_tables, slot_ids,
     if _paged_kernel_enabled(Dh, BS):
         from .paged_attention import verify_attend
         return verify_attend(q, k_pool, v_pool, block_tables, slot_ids,
-                             positions, k_scale, v_scale, scale=scale)
+                             positions, k_scale, v_scale, scale=scale,
+                             kernel_name=kernel_name)
     return verify_gather_reference(q, k_pool, v_pool, block_tables,
                                    slot_ids, positions, k_scale,
                                    v_scale, scale=scale)

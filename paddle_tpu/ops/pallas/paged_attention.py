@@ -183,16 +183,22 @@ def _paged_attend_grouped(q, k_pool, v_pool, block_tables, slot_ids,
     config (`dimension_semantics` — whether Mosaic may treat the
     group axis as parallel) is resolved HERE, at trace time, so a
     cached winner costs one dict probe inside the one compile and
-    nothing per step."""
+    nothing per step. The block-sparse decode entry ("paged_sparse",
+    ISSUE 15) is this same kernel fed a SHORTENED per-slot block table
+    — the table width IS the sparsity budget, so its cache bucket
+    carries MB where the dense entries' buckets do not."""
     N, G, H, Dh = q.shape
     NB, BS = k_pool.shape[0], k_pool.shape[1]
     S, MB = block_tables.shape
     quantized = k_scale is not None
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
+    if kernel_name == "paged_sparse":
+        bucket = autotune.shape_bucket(N, G, H, Dh, BS, MB)
+    else:
+        bucket = autotune.shape_bucket(N, G, H, Dh, BS)
     tuned = tuning if tuning is not None else autotune.kernel_config(
-        kernel_name, autotune.shape_bucket(N, G, H, Dh, BS),
-        k_pool.dtype, default=None) or {}
+        kernel_name, bucket, k_pool.dtype, default=None) or {}
     dim_sem = tuned.get("dimension_semantics")
     compiler_params = None
     if dim_sem is not None:
@@ -252,25 +258,29 @@ def _paged_attend_grouped(q, k_pool, v_pool, block_tables, slot_ids,
 
 
 def ragged_attend(q, k_pool, v_pool, block_tables, slot_ids, positions,
-                  k_scale=None, v_scale=None, *, scale=None):
+                  k_scale=None, v_scale=None, *, scale=None,
+                  kernel_name="paged_ragged"):
     """Flat-token ragged paged attention (chunked prefill + plain
     decode): q [T, H, Dh], one G=1 group per flat token. Signature
-    mirrors `flash_attention.ragged_paged_attention`."""
+    mirrors `flash_attention.ragged_paged_attention`. The sparse
+    decode region passes `kernel_name="paged_sparse"` with its
+    shortened tables so tuned configs resolve under the sparse key."""
     T = q.shape[0]
     out = _paged_attend_grouped(
         q[:, None], k_pool, v_pool, block_tables, slot_ids,
         positions.reshape(T, 1), k_scale, v_scale, scale=scale,
-        kernel_name="paged_ragged")
+        kernel_name=kernel_name)
     return out[:, 0]
 
 
 def verify_attend(q, k_pool, v_pool, block_tables, slot_ids, positions,
-                  k_scale=None, v_scale=None, *, scale=None):
+                  k_scale=None, v_scale=None, *, scale=None,
+                  kernel_name="paged_verify"):
     """K-wide speculative verify: q [B, K, H, Dh], positions [B, K] —
     one G=K group per slot, ONE block-table walk per group."""
     return _paged_attend_grouped(
         q, k_pool, v_pool, block_tables, slot_ids, positions,
-        k_scale, v_scale, scale=scale, kernel_name="paged_verify")
+        k_scale, v_scale, scale=scale, kernel_name=kernel_name)
 
 
 def decode_attend(q, k_pool, v_pool, block_tables, context_lens,
@@ -292,22 +302,32 @@ def decode_attend(q, k_pool, v_pool, block_tables, context_lens,
 def _synth_paged_inputs(N, G, H, Dh, BS, context_len, dtype, seed):
     """Deterministic synthetic pools/tables/queries for one paged
     shape bucket (the tuner's measurement workload). `dtype` is the
-    POOL dtype: int8 builds quantized pools with per-entry-per-head
-    fp32 scales (the `kv_dtype="int8"` serving layout) under fp32
-    queries; otherwise scales are None."""
+    POOL dtype: int8/float8_e4m3fn build quantized pools with
+    per-entry-per-head fp32 scales (the `kv_dtype="int8"`/"fp8_e4m3"
+    serving layouts) under fp32 queries; otherwise scales are None."""
     import numpy as np
     rng = np.random.RandomState(seed)
     mb = -(-int(context_len) // BS)
     NB = N * mb + 1
     dtype = np.dtype(dtype)
-    quant = dtype == np.int8
+    quant = dtype.itemsize == 1       # int8 or a scaled fp8 format
     qdt = np.float32 if quant else dtype
     q = jnp.asarray(rng.randn(N, G, H, Dh).astype(qdt))
     if quant:
-        kp = jnp.asarray(rng.randint(-127, 128, (NB, BS, H, Dh))
-                         .astype(np.int8))
-        vp = jnp.asarray(rng.randint(-127, 128, (NB, BS, H, Dh))
-                         .astype(np.int8))
+        if dtype == np.int8:
+            kp = jnp.asarray(rng.randint(-127, 128, (NB, BS, H, Dh))
+                             .astype(np.int8))
+            vp = jnp.asarray(rng.randint(-127, 128, (NB, BS, H, Dh))
+                             .astype(np.int8))
+        else:
+            # fp8: stay inside the e4m3 finite range (casts past 448
+            # produce NaN, which would poison the parity oracle)
+            kp = jnp.asarray(np.clip(rng.randn(NB, BS, H, Dh) * 100,
+                                     -440, 440).astype(np.float32)
+                             ).astype(dtype)
+            vp = jnp.asarray(np.clip(rng.randn(NB, BS, H, Dh) * 100,
+                                     -440, 440).astype(np.float32)
+                             ).astype(dtype)
         ks = jnp.asarray((np.abs(rng.randn(NB, BS, H)) * 0.02
                           + 0.005).astype(np.float32))
         vs = jnp.asarray((np.abs(rng.randn(NB, BS, H)) * 0.02
@@ -370,6 +390,54 @@ def tune_paged_kernel(kernel_name, N, G, H, Dh, BS, *,
             rtol=2e-2, atol=2e-2, budget_s=budget_s, timer=timer,
             persist=persist,
             meta={"context_len": context_len, "seed": seed})
+    finally:
+        _INTERPRET = was
+
+
+def tune_paged_sparse(N, G, H, Dh, BS, B, *, dtype="float32", seed=0,
+                      budget_s=None, timer=None, persist=True):
+    """Search the grid-layout space of the BLOCK-SPARSE decode bucket
+    (ISSUE 15): the same grouped kernel fed a shortened `[N, B]` block
+    table — the table width IS the sparsity budget, so the bucket key
+    carries B (`shape_bucket(N, G, H, Dh, BS, B)`) and a tuned dense
+    entry can never alias a sparse one. The measurement workload holds
+    exactly B resident blocks per slot (context_len = B * BS), which
+    is what the serving engine's compacted-position masking reduces
+    the sparse region to."""
+    import numpy as np
+    from . import flash_attention as fa
+
+    global _INTERPRET
+    dtype = np.dtype(dtype)
+    args = _synth_paged_inputs(N, G, H, Dh, BS, int(B) * BS,
+                               dtype, seed)
+
+    def oracle(q, kp, vp, bt, slots, pos, ks, vs):
+        if G == 1:
+            return fa.ragged_gather_reference(q[:, 0], kp, vp, bt,
+                                              slots, pos[:, 0], ks, vs)
+        return fa.verify_gather_reference(q, kp, vp, bt, slots, pos,
+                                          ks, vs)
+
+    def build(cfg):
+        def run(q, kp, vp, bt, slots, pos, ks, vs):
+            out = _paged_attend_grouped(q, kp, vp, bt, slots, pos,
+                                        ks, vs,
+                                        kernel_name="paged_sparse",
+                                        tuning=cfg)
+            return out[:, 0] if G == 1 else out
+        return run
+
+    was = _INTERPRET
+    if not _on_tpu_backend():
+        _INTERPRET = True
+    try:
+        return autotune.search(
+            "paged_sparse", autotune.shape_bucket(N, G, H, Dh, BS, B),
+            dtype, autotune.paged_candidates(), build, args, oracle,
+            rtol=2e-2, atol=2e-2, budget_s=budget_s, timer=timer,
+            persist=persist, meta={"sparse_blocks": int(B),
+                                   "seed": seed})
     finally:
         _INTERPRET = was
 

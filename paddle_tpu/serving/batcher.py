@@ -146,7 +146,8 @@ def round_up(n, m):
 
 
 def choose_token_budget(max_slots, block_size, requested=None,
-                        verify_width=1, role="mixed"):
+                        verify_width=1, role="mixed",
+                        reserve_region=False):
     """Per-step token budget: a power of two >= max(max_slots,
     2*block_size) so a full decode round always fits and prefill chunks
     cover at least two KV blocks per step (generation.py's bucket
@@ -169,15 +170,22 @@ def choose_token_budget(max_slots, block_size, requested=None,
     at least one prefill token even with every slot decoding). Every
     step pays the full fixed `[T]` compute whether or not prefill rides
     along — the small budget is where disaggregation's inter-token
-    latency win comes from. Explicit `requested` always wins."""
+    latency win comes from. Explicit `requested` always wins.
+
+    `reserve_region=True` reserves the per-slot decode region even at
+    `verify_width == 1` (block-sparse decode, ISSUE 15: the sparse
+    engine routes the region through shortened block tables, so its
+    tokens must sit at fixed per-slot indices) — the floors follow the
+    speculative treatment."""
     vw = int(verify_width)
     region = max_slots * vw
+    region_on = vw > 1 or reserve_region
     if requested is not None:
-        floor = max_slots if vw == 1 else region + 1
+        floor = max_slots if not region_on else region + 1
         return next_pow2(max(int(requested), floor), lo=1)
     if role == "decode":
         return next_pow2(region + 1, lo=1)
-    if vw == 1:
+    if not region_on:
         return next_pow2(max(max_slots, 2 * block_size))
     return next_pow2(region + 2 * block_size)
 
@@ -290,7 +298,7 @@ class StepPlan:
 
 
 def pack_step(token_budget, max_slots, decode, prefills,
-              verify_width=1) -> StepPlan:
+              verify_width=1, reserve_region=False) -> StepPlan:
     """Pack decode entries + prefill chunks into the flat-token layout.
 
     decode: [(slot, token_or_tokens, position)] — one entry per running
@@ -309,9 +317,13 @@ def pack_step(token_budget, max_slots, decode, prefills,
     reshape it to `[max_slots, vw]` and run the verify-shaped paged
     attention + per-position logits without any gather indices that
     change shape as the decode mix churns; prefill packs after the
-    region."""
+    region. `reserve_region=True` applies the same fixed per-slot
+    layout at `verify_width == 1` (block-sparse decode, ISSUE 15:
+    decode token of slot s sits at flat index s, and its hidden state
+    still samples through `sample_index` like the dense layout)."""
     vw = int(verify_width)
-    region = max_slots * vw if vw > 1 else 0
+    region_on = vw > 1 or reserve_region
+    region = max_slots * vw if region_on else 0
     token_ids = np.zeros(token_budget, np.int32)
     slot_ids = np.full(token_budget, -1, np.int32)
     positions = np.zeros(token_budget, np.int32)
@@ -327,21 +339,22 @@ def pack_step(token_budget, max_slots, decode, prefills,
             raise ValueError(
                 f"decode group of {len(toks)} tokens exceeds the "
                 f"verify width {max(vw, 1)}")
-        base = slot * vw if vw > 1 else i
+        base = slot * vw if region_on else i
         token_ids[base:base + len(toks)] = toks
         slot_ids[base:base + len(toks)] = slot
         positions[base:base + len(toks)] = np.arange(
             pos, pos + len(toks), dtype=np.int32)
         if vw == 1:
-            sample_index[slot] = i
-            i += 1
+            sample_index[slot] = base
+            if not region_on:
+                i += 1
         decode_slots.append(slot)
         decode_entries.append((slot, toks, int(pos)))
         n_decode += len(toks)
-    if vw > 1:
+    if region_on:
         i = region
     n = n_decode + sum(len(c[1]) for c in prefills) \
-        + (region - n_decode if vw > 1 else 0)
+        + (region - n_decode if region_on else 0)
     if n > token_budget:
         raise ValueError(f"plan of {n} tokens exceeds token budget "
                          f"{token_budget}")

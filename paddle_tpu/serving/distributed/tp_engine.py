@@ -119,6 +119,16 @@ class TPServingEngine(ServingEngine):
         from ...analysis.specs import canonicalize_spec
         return canonicalize_spec(P(None, None, None, "mp"), self.mesh)
 
+    def _summary_spec(self):
+        # the block-summary pools (ISSUE 15) are [L, NB, H, Dh]: the
+        # head axis sits at index 2, one spot earlier than in the
+        # [L, NB, BS, H, Dh] payload pools — same canonical-form
+        # discipline as _pool_spec
+        from jax.sharding import PartitionSpec as P
+
+        from ...analysis.specs import canonicalize_spec
+        return canonicalize_spec(P(None, None, "mp"), self.mesh)
+
     def _array_specs(self):
         """One PartitionSpec per entry of `self._arrays` (the order
         `_gen_tensors` fixes: we, pe, decoder params, ln_f w/b, head —
@@ -166,8 +176,9 @@ class TPServingEngine(ServingEngine):
                 arr, NamedSharding(self.mesh, spec)))
         self._arrays = out
         psh = NamedSharding(self.mesh, self._pool_spec())
+        ssh = NamedSharding(self.mesh, self._summary_spec())
 
-        def _place(kv, _psh=psh, _put=jax.device_put):
+        def _place(kv, _psh=psh, _ssh=ssh, _put=jax.device_put):
             kv.k_pool = _put(kv.k_pool, _psh)
             kv.v_pool = _put(kv.v_pool, _psh)
             if kv.quantized:
@@ -176,6 +187,10 @@ class TPServingEngine(ServingEngine):
                 # None, "mp") happens to be the pool spec verbatim
                 kv.k_scale = _put(kv.k_scale, _psh)
                 kv.v_scale = _put(kv.v_scale, _psh)
+            if kv.summaries:
+                # [L, NB, H, Dh] summary pools: head axis at index 2
+                kv.k_sum_min = _put(kv.k_sum_min, _ssh)
+                kv.k_sum_max = _put(kv.k_sum_max, _ssh)
 
         _place(self.kv)
         # KV block transport (disaggregated serving): imported pools
@@ -245,9 +260,14 @@ class TPServingEngine(ServingEngine):
         body = self._step_body(self._step_cfg())
         pool = self._pool_spec()
         rep = P()
-        # int8 pools ride (k_scale, v_scale) right after the pools,
-        # sharded on the same head axis; the step returns them too
+        # quantized pools ride (k_scale, v_scale) right after the
+        # pools, sharded on the same head axis; summary-tracking pools
+        # add (k_sum_min, k_sum_max) after those with the head axis
+        # one spot earlier — the kv_cache._pools() order; the step
+        # returns them all
         pools = (pool,) * (4 if self.kv.quantized else 2)
+        if self.kv.summaries:
+            pools += (self._summary_spec(),) * 2
         # adapter slot tensors follow the pools (engine._step_body's
         # rest-parse order), each under its SERVING_LORA_TP_SPECS
         # sharding; the per-token adapter-id vector replicates with

@@ -71,7 +71,9 @@ class ServingEngine:
                  kv_dtype=None, seed=0, clock=time.monotonic,
                  draft_k=0, draft_ngram=3, prefix_caching=False,
                  role="mixed", max_adapters=0, lora_rank=8,
-                 lora_alpha=None, moe_weight_dtype=None):
+                 lora_alpha=None, moe_weight_dtype=None,
+                 sparse_blocks=None, sparse_recent=2,
+                 track_summaries=None):
         import functools
 
         import jax
@@ -96,10 +98,18 @@ class ServingEngine:
             # size the kernels would refuse (bench.py's
             # kernel_autotune extra is what populates the cache).
             from ..ops.pallas import autotune as _kt
+
+            from .kv_cache import KV_DTYPES, kv_jnp_dtype
+            # quantized pools key the lookup by their storage dtype
+            # (KV_DTYPES' quantized flag is the single source of
+            # truth, not a re-hardcoded name list); float pools share
+            # the fp32 key
+            quant_bs = kv_dtype is not None and \
+                KV_DTYPES.get(str(kv_dtype), (0, False))[1]
             block_size = _kt.ensure(
                 "paged_block_size",
                 _kt.shape_bucket(max_slots, H, Dh),
-                np.dtype(np.int8) if kv_dtype == "int8"
+                np.dtype(kv_jnp_dtype(kv_dtype)) if quant_bs
                 else np.dtype(np.float32),
                 {"block_size": 16})["block_size"]
             # geometry clamp: a winner tuned under a longer context
@@ -146,15 +156,59 @@ class ServingEngine:
         # token-identity verify)
         self.spec_sampling = (self.draft_k > 0
                               and self.sampling.strategy != "greedy")
+        # block-sparse paged decode attention (ISSUE 15, docs/
+        # SERVING.md "Long-context serving"): with `sparse_blocks=B`,
+        # every decode/verify query scores the slot's candidate blocks
+        # against per-block channel-wise min/max key summaries
+        # (Quest-style upper bound) and attends only a FIXED budget of
+        # blocks — B top-scoring plus the first block (attention sink)
+        # and a recency window of `sparse_recent` blocks (always
+        # including the in-flight tail, widened so a K-wide verify
+        # group's own writes are always resident). Fixed width means
+        # fixed shapes: sparsity never recompiles, and `sparse_blocks
+        # >= allocated blocks` is token-identical to the dense engine.
+        self.sparse_blocks = (None if sparse_blocks is None
+                              else int(sparse_blocks))
+        self._sparse = self.sparse_blocks is not None
+        self.sparse_table_width = 0
+        self._sparse_recent = 0
+        if self._sparse:
+            if self.sparse_blocks < 1:
+                raise ValueError(
+                    f"sparse_blocks={sparse_blocks} must be >= 1 "
+                    "(or None for dense decode attention)")
+            K_w = self.draft_k + 1
+            # the recency window must cover every block a verify
+            # group's K fed tokens can span, so the group's own
+            # just-written keys are always attended
+            self._sparse_recent = max(
+                int(sparse_recent),
+                1 + -(-(K_w - 1) // self.block_size))
+            self.sparse_table_width = min(
+                mbps, 1 + self._sparse_recent + self.sparse_blocks)
+        # `track_summaries=True` maintains the block summaries WITHOUT
+        # the sparse decode region: the prefill-role half of a sparse
+        # disaggregated fleet (docs/SERVING.md) — prefill runs at
+        # dense speed paying only the append-side scatter, while its
+        # exported blocks carry the summary rows a sparse decode
+        # replica's kv_meta requires
+        self._track_summaries = (self._sparse if track_summaries
+                                 is None else bool(track_summaries))
+        if self._sparse and not self._track_summaries:
+            raise ValueError(
+                "sparse_blocks needs the block summaries; don't pass "
+                "track_summaries=False on a sparse engine")
         self.token_budget = batcher.choose_token_budget(
             max_slots, self.block_size, token_budget,
-            verify_width=self.draft_k + 1, role=self.role)
+            verify_width=self.draft_k + 1, role=self.role,
+            reserve_region=self._sparse)
         dtype = cache_dtype or getattr(model, "_gen_cache_dtype",
                                        "bfloat16")
         self.kv = PagedKVCache(
             L, H, Dh, num_blocks=num_blocks,
             block_size=self.block_size, max_slots=max_slots,
-            max_blocks_per_slot=mbps, dtype=dtype, kv_dtype=kv_dtype)
+            max_blocks_per_slot=mbps, dtype=dtype, kv_dtype=kv_dtype,
+            summaries=self._track_summaries)
         # radix prefix cache: cross-request KV reuse for shared prompt
         # heads (system prompts, few-shot templates, chat history) —
         # registers itself as the kv cache's eviction backstop
@@ -184,7 +238,8 @@ class ServingEngine:
             draft_fn=functools.partial(ngram_propose, k=self.draft_k,
                                        max_ngram=int(draft_ngram)),
             prefix_cache=self.prefix_cache,
-            adapter_cache=self.adapters)
+            adapter_cache=self.adapters,
+            reserve_region=self._sparse)
         self.eos_token_id = eos_token_id
         self.clock = clock
         self._rng = jax.random.PRNGKey(int(seed))
@@ -208,9 +263,10 @@ class ServingEngine:
         self._moe_weight_bits = 0
         if moe_weight_dtype is not None:
             self._quantize_moe_experts(str(moe_weight_dtype))
-        # int8 pools: the scale arrays are donated alongside the pools
-        # so the quantize-on-append writes alias in place too
-        donate = (1, 2, 3, 4) if self.kv.quantized else (1, 2)
+        # quantized pools donate their scale arrays and summary-
+        # tracking pools their min/max rows alongside the K/V pools,
+        # so every in-step pool write aliases in place
+        donate = tuple(range(1, 1 + len(self.kv._pools())))
         self._step_fn = instrumented_jit(
             self._build_step(), STEP_FN_NAME, donate_argnums=donate)
         # register this engine's paged-kernel shape buckets with the
@@ -224,6 +280,13 @@ class ServingEngine:
         self._prefix_seen = (0, 0, 0)    # hit / miss / evicted deltas
         self._imported_seen = 0          # kv.blocks_imported delta
         self.steps_run = 0
+        # block-sparse decode accounting (host mirrors of the fixed
+        # selection arithmetic — the per-step selected count is
+        # min(allocated, sparse_table_width) by construction, so the
+        # metrics need no extra device readback)
+        self.sparse_candidate_blocks = 0
+        self.sparse_selected_blocks = 0
+        self._sparse_skip_seen = 0       # metrics-counter delta base
         # cumulative MoE routing state (host mirrors of the per-step
         # device stats; the smoke contracts read these directly)
         self.moe_expert_counts = np.zeros(max(self.num_experts, 1),
@@ -284,11 +347,25 @@ class ServingEngine:
         from ..ops.pallas import autotune as _kt
         cfg = self._step_cfg()
         H, Dh, BS = cfg.num_heads, cfg.head_dim, self.block_size
-        dt = np.int8 if self.kv.quantized else self.kv.k_pool.dtype
+        # key by the POOL dtype (int8 pools are int8, fp8 pools
+        # float8_e4m3fn, fp pools their own dtype) — exactly what the
+        # kernels' trace-time lookups resolve under
+        dt = self.kv.k_pool.dtype
         T, S, K = self.token_budget, self.kv.max_slots, self.draft_k + 1
         dtn = np.dtype(dt).name
         keys = []
-        if K > 1:
+        if self._sparse:
+            # the decode/verify region reads the SHORTENED tables: its
+            # bucket carries the table width (sparse_table_width) so a
+            # sparse winner can never alias a dense one
+            keys.append(("paged_sparse",
+                         _kt.shape_bucket(S, K, H, Dh, BS,
+                                          self.sparse_table_width),
+                         dtn))
+            keys.append(("paged_ragged",
+                         _kt.shape_bucket(max(T - S * K, 1), 1, H, Dh,
+                                          BS), dtn))
+        elif K > 1:
             keys.append(("paged_verify",
                          _kt.shape_bucket(S, K, H, Dh, BS), dtn))
             keys.append(("paged_ragged",
@@ -335,6 +412,8 @@ class ServingEngine:
         from ..ops.pallas.flash_attention import (
             ragged_paged_attention, verify_paged_attention)
 
+        from .kv_cache import FP8_MAX, SUMMARY_INIT, kv_jnp_dtype
+
         model = self.model
         names = list(self._names)
         L = cfg.num_layers
@@ -342,9 +421,19 @@ class ServingEngine:
         T = self.token_budget
         S = self.kv.max_slots
         K = self.draft_k + 1          # verify width (1 = no speculation)
-        R = S * K                     # reserved verify region (K > 1)
+        sparse = self._sparse
+        track = self._track_summaries  # summaries maintained on append
+        Bt = self.sparse_table_width  # shortened table width (sparse)
+        W_rec = self._sparse_recent   # forced recency window (blocks)
+        MB = self.kv.max_blocks_per_slot
+        # the reserved per-slot region: speculation reshapes it to
+        # [S, K] for the verify entry; block-sparse decode reserves it
+        # even at K == 1 so the selection is one fixed [S, ...] batch
+        region_on = K > 1 or sparse
+        R = S * K                     # region width when region_on
         sc = self.sampling
         quant = self.kv.quantized
+        fp8 = self.kv.kv_dtype == "fp8_e4m3"
         use_hist = batcher.needs_history(sc)
         moe = cfg.num_experts > 0
         spec_sampling = self.spec_sampling
@@ -353,26 +442,116 @@ class ServingEngine:
         K_ad = self.adapters.max_adapters if lora else 0
 
         def quantize(x):
-            """[T, H, Dh] fp -> (int8 values, [T, H] fp32 scales):
-            symmetric per-token-per-head amax scaling. A pure function
-            of the token's own K/V, so quantization is independent of
-            append order, chunking and block sharing (the property the
-            prefix-cache/preemption parity tests rely on)."""
+            """[T, H, Dh] fp -> (quantized values, [T, H] fp32
+            scales): symmetric per-token-per-head amax scaling — to
+            the int8 grid, or to the fp8 e4m3 finite range (scaling
+            amax onto 448 spends the format's whole mantissa budget
+            per entry; the clip keeps boundary values off the NaN
+            cast). A pure function of the token's own K/V, so
+            quantization is independent of append order, chunking and
+            block sharing (the property the prefix-cache/preemption
+            parity tests rely on)."""
             xf = x.astype(jnp.float32)
+            if fp8:
+                s = jnp.max(jnp.abs(xf), axis=-1) / FP8_MAX
+                qv = xf / jnp.maximum(s, 1e-20)[..., None]
+                qv = jnp.clip(qv, -FP8_MAX, FP8_MAX)
+                return qv.astype(kv_jnp_dtype("fp8_e4m3")), s
             s = jnp.max(jnp.abs(xf), axis=-1) / 127.0
             q8 = jnp.round(xf / jnp.maximum(s, 1e-20)[..., None])
             return jnp.clip(q8, -127, 127).astype(jnp.int8), s
 
+        def select_blocks(q_r, pos_r, block_tables, smin_l, smax_l):
+            """Top-B block selection for the decode/verify region
+            (ISSUE 15, Quest-style): score every candidate block of
+            each slot by the channel-wise upper bound of q . k over
+            the block's [min, max] summary box, force-keep the first
+            block (attention sink) and the last `W_rec` blocks (the
+            recency window — which always covers the group's own
+            just-written keys), take the fixed top `Bt`, and emit
+
+              * a SHORTENED `[S, Bt]` block table (selected blocks in
+                their original order; NULL-padded when a slot holds
+                fewer than Bt blocks), and
+              * COMPACTED query positions `[S, K]` — each query's
+                position translated into the shortened table's
+                coordinates, so the kernels' `key_pos <= query_pos`
+                mask stays exactly right: full selected blocks before
+                the query's own block are wholly visible, the query's
+                block is visible up to its true offset, and the NULL
+                padding columns (compacted positions past the query)
+                are never read through.
+
+            With Bt >= the slot's allocated blocks the selection is
+            the identity (same table prefix, same positions), which is
+            what makes `sparse_blocks >= allocated` bit-identical to
+            the dense engine.
+
+            q_r [S, K, H, Dh] raw queries; pos_r [S, K] true
+            positions; smin_l/smax_l [NB, H, Dh] this layer's
+            summaries."""
+            from ..incubate.nn.fused_transformer import _maybe_psum
+            qf = q_r.astype(jnp.float32)
+            qpos = jnp.maximum(qf, 0.0)
+            qneg = jnp.minimum(qf, 0.0)
+            bt_r = block_tables[:S]                     # [S, MB]
+            sming = smin_l[bt_r]                        # [S, MB, H, Dh]
+            smaxg = smax_l[bt_r]
+            # ub(q, block) = sum_d max(q_d*min_d, q_d*max_d)
+            #             = sum_d (max(q_d,0)*max_d + min(q_d,0)*min_d)
+            # summed over heads: under TP each shard holds its head
+            # slice, so the psum makes every shard select from the
+            # GLOBAL head total — TP=2 selections match TP=1 exactly.
+            # The psum must come BEFORE the max over the group's K
+            # queries: max_k(a_k + b_k) != max_k(a_k) + max_k(b_k)
+            # when different queries achieve each shard's maximum, so
+            # a post-max psum would make TP=2 rank blocks differently
+            # than TP=1 whenever speculation meets real sparsity
+            score = (jnp.einsum("skhd,smhd->skm", qpos, smaxg)
+                     + jnp.einsum("skhd,smhd->skm", qneg, sming))
+            score = _maybe_psum(cfg, score)             # [S, K, MB]
+            score = jnp.max(score, axis=1)              # [S, MB]
+            n_blk = jnp.max(pos_r, axis=1) // BS + 1    # [S] allocated
+            m_idx = jnp.arange(MB, dtype=jnp.int32)[None, :]
+            forced = (m_idx == 0) | (m_idx >= (n_blk - W_rec)[:, None])
+            score = jnp.where(forced, jnp.float32(jnp.inf), score)
+            # candidates past the allocated prefix can never be
+            # selected, whatever their (stale) summaries say
+            score = jnp.where(m_idx < n_blk[:, None], score,
+                              -jnp.float32(jnp.inf))
+            _, sel = jax.lax.top_k(score, Bt)           # [S, Bt]
+            selv = jnp.take_along_axis(score, sel, axis=1)
+            # re-sort the selection into original table order (the
+            # compaction below depends on it); slots with fewer than
+            # Bt valid blocks sort their -inf picks to the end as MB
+            ord_ = jnp.sort(jnp.where(selv > -jnp.float32(jnp.inf),
+                                      sel, MB), axis=1)
+            short_bt = jnp.where(
+                ord_ < MB,
+                jnp.take_along_axis(bt_r, jnp.minimum(ord_, MB - 1),
+                                    axis=1),
+                0).astype(jnp.int32)
+            bq = pos_r // BS                            # [S, K]
+            cnt = jnp.sum(ord_[:, None, :] < bq[:, :, None], axis=-1)
+            pos_c = (cnt * BS + pos_r % BS).astype(jnp.int32)
+            return short_bt, pos_c
+
         def step(arrays, k_pool, v_pool, *rest):
-            # static signature variants (one compile each way): int8
-            # pools add (k_scale, v_scale) after the pools; adapter
-            # slot tensors follow them, with the per-token adapter ids
+            # static signature variants (one compile each way):
+            # quantized pools add (k_scale, v_scale) after the pools
+            # and summary-tracking pools (k_sum_min, k_sum_max) after
+            # those — the kv_cache._pools() order; adapter slot
+            # tensors follow them, with the per-token adapter ids
             # after sample_index; active logit processors add the
             # [S, W] history before the rng
             rest = list(rest)
             k_scale = v_scale = history = None
+            k_sum_min = k_sum_max = None
             if quant:
                 k_scale, v_scale = rest[:2]
+                rest = rest[2:]
+            if track:
+                k_sum_min, k_sum_max = rest[:2]
                 rest = rest[2:]
             ad_arrays = ()
             if lora:
@@ -408,18 +587,22 @@ class ServingEngine:
             wo = pos % BS
 
             def layer(carry, xs):
+                at = 3
+                h, kp, vp = carry[:3]
+                ksc = vsc = smin = smax = None
                 if quant:
-                    h, kp, vp, ksc, vsc = carry[:5]
-                else:
-                    h, kp, vp = carry[:3]
-                    ksc = vsc = None
+                    ksc, vsc = carry[at:at + 2]
+                    at += 2
+                if track:
+                    smin, smax = carry[at:at + 2]
+                    at += 2
                 ms = carry[-1] if moe else None
                 pl, li = xs
                 hn = _ln(h, pl["ln_s"], pl["ln_b"], cfg.epsilon)
                 q, k, v = _qkv(cfg, pl, hn[None], lora_oh=lora_oh)
                 q, k, v = q[0], k[0], v[0]                  # [T, H, Dh]
                 if quant:
-                    # quantize-on-append: int8 payload + per-entry
+                    # quantize-on-append: int8/fp8 payload + per-entry
                     # scales land at the same (block, offset) coords
                     kq, ks_new = quantize(k)
                     vq, vs_new = quantize(v)
@@ -432,7 +615,52 @@ class ServingEngine:
                     kp = kp.at[li, wb, wo].set(k.astype(kp.dtype))
                     vp = vp.at[li, wb, wo].set(v.astype(vp.dtype))
                     ks_l = vs_l = None
-                if K == 1:
+                if track:
+                    # summary update on append: the offset-0 write of
+                    # a block RESETS its row first (non-first tokens
+                    # aim the reset at the NULL row), then one
+                    # scatter-min/max folds every appended key in —
+                    # well-defined even when one prefill chunk writes
+                    # many entries of the same block, and a freed-
+                    # then-reused block can never leak its previous
+                    # owner's statistics
+                    ksf = k.astype(jnp.float32)
+                    rb = jnp.where(valid & (wo == 0), wb, 0)
+                    smin = smin.at[li, rb].set(SUMMARY_INIT)
+                    smax = smax.at[li, rb].set(-SUMMARY_INIT)
+                    wbs = jnp.where(valid, wb, 0)
+                    smin = smin.at[li, wbs].min(ksf)
+                    smax = smax.at[li, wbs].max(ksf)
+                if sparse:
+                    # region queries attend the SHORTENED tables: the
+                    # kernels read Bt blocks per slot instead of the
+                    # whole context, and the compacted positions keep
+                    # the causal mask exact; prefill chunks (whose
+                    # queries sit mid-prompt) keep the dense path
+                    q_r = q[:R].reshape(S, K, cfg.num_heads,
+                                        cfg.head_dim)
+                    pos_r = pos[:R].reshape(S, K)
+                    short_bt, pos_c = select_blocks(
+                        q_r, pos_r, block_tables, smin[li], smax[li])
+                    if K == 1:
+                        ar = ragged_paged_attention(
+                            q[:R], kp[li], vp[li], short_bt,
+                            slot_ids[:R], pos_c[:, 0], ks_l, vs_l,
+                            kernel_name="paged_sparse")
+                    else:
+                        ar = verify_paged_attention(
+                            q_r, kp[li], vp[li], short_bt,
+                            jnp.arange(S, dtype=jnp.int32), pos_c,
+                            ks_l, vs_l,
+                            kernel_name="paged_sparse").reshape(
+                            R, cfg.num_heads, cfg.head_dim)
+                    ap = ragged_paged_attention(
+                        q[R:], kp[li], vp[li], block_tables,
+                        slot_ids[R:], pos[R:], ks_l, vs_l)
+                    attn = jnp.concatenate(
+                        [ar.reshape(R, cfg.num_heads, cfg.head_dim),
+                         ap], axis=0)
+                elif K == 1:
                     attn = ragged_paged_attention(
                         q, kp[li], vp[li], block_tables, slot_ids, pos,
                         ks_l, vs_l)
@@ -482,6 +710,8 @@ class ServingEngine:
                 new_carry = (h, kp, vp)
                 if quant:
                     new_carry += (ksc, vsc)
+                if track:
+                    new_carry += (smin, smax)
                 if moe:
                     new_carry += (ms,)
                 return new_carry, None
@@ -489,6 +719,8 @@ class ServingEngine:
             carry0 = (x, k_pool, v_pool)
             if quant:
                 carry0 += (k_scale, v_scale)
+            if track:
+                carry0 += (k_sum_min, k_sum_max)
             if moe:
                 carry0 += ({"counts": jnp.zeros((cfg.num_experts,),
                                                 jnp.float32),
@@ -501,12 +733,9 @@ class ServingEngine:
                 # aux reported as the per-layer mean balance loss
                 moe_stats = dict(moe_stats,
                                  aux=moe_stats["aux"] / float(L))
-            if quant:
-                x, k_pool, v_pool, k_scale, v_scale = carry[:5]
-                pools = (k_pool, v_pool, k_scale, v_scale)
-            else:
-                x, k_pool, v_pool = carry[:3]
-                pools = (k_pool, v_pool)
+            n_pool = 2 + (2 if quant else 0) + (2 if track else 0)
+            x = carry[0]
+            pools = tuple(carry[1:1 + n_pool])
             if moe:
                 pools += (moe_stats,)
             xf = _ln(x, lnw, lnb, cfg.epsilon)
@@ -740,6 +969,15 @@ class ServingEngine:
                 hist[slot, :len(toks)] = toks
         return hist
 
+    def sparse_skip_ratio(self):
+        """Fraction of candidate KV blocks the sparse decode path
+        SKIPPED (0.0 = dense, or sparsity off) — the long-context
+        smoke's measured-sparsity contract."""
+        if not self.sparse_candidate_blocks:
+            return 0.0
+        return 1.0 - (self.sparse_selected_blocks
+                      / self.sparse_candidate_blocks)
+
     def moe_utilization_entropy(self):
         """Normalized entropy of the cumulative per-expert token
         distribution (1.0 = balanced; 0.0 = degenerate/no MoE)."""
@@ -775,11 +1013,10 @@ class ServingEngine:
             return bool(plan.expired)
         sp = pack_step(self.token_budget, self.kv.max_slots,
                        plan.decode, plan.prefills,
-                       verify_width=self.draft_k + 1)
+                       verify_width=self.draft_k + 1,
+                       reserve_region=self._sparse)
         self._rng, sub = jax.random.split(self._rng)
-        args = [self._arrays, self.kv.k_pool, self.kv.v_pool]
-        if self.kv.quantized:
-            args += [self.kv.k_scale, self.kv.v_scale]
+        args = [self._arrays] + self.kv._pools()
         if self.adapters is not None:
             args += self.adapters.device_arrays()
         args += [jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
@@ -795,13 +1032,22 @@ class ServingEngine:
         moe_stats = None
         if self.num_experts:
             res, moe_stats = res[:-1], res[-1]
-        if self.kv.quantized:
-            (out, self.kv.k_pool, self.kv.v_pool, self.kv.k_scale,
-             self.kv.v_scale) = res
-        else:
-            out, self.kv.k_pool, self.kv.v_pool = res
+        out = res[0]
+        self.kv._set_pools(res[1:])
         sch.note_fed(plan)
         self.steps_run += 1
+        if self._sparse and plan.decode:
+            # selection arithmetic is deterministic on fixed geometry
+            # (min(allocated, table width) blocks attended per decode
+            # group per layer), so the skip accounting is pure host
+            # math — no device readback
+            for slot, tok, pos in plan.decode:
+                width = 1 if np.isscalar(tok) or getattr(
+                    tok, "ndim", None) == 0 else len(tok)
+                n_blk = (pos + width - 1) // self.block_size + 1
+                self.sparse_candidate_blocks += n_blk
+                self.sparse_selected_blocks += min(
+                    n_blk, self.sparse_table_width)
         tokres_np = acc_np = None
         if self.draft_k and self.spec_sampling:
             tok_np, tokv_np, tokres_np, acc_np = (np.asarray(t)
@@ -903,6 +1149,16 @@ class ServingEngine:
                 self.kv.utilization)
             smetrics.SERVING_KV_BYTES_PER_TOKEN.set(
                 self.kv.kv_bytes_per_token)
+            if self._sparse and self.sparse_candidate_blocks:
+                skipped = (self.sparse_candidate_blocks
+                           - self.sparse_selected_blocks)
+                if skipped > self._sparse_skip_seen:
+                    smetrics.SERVING_KV_BLOCKS_SKIPPED.inc(
+                        skipped - self._sparse_skip_seen)
+                    self._sparse_skip_seen = skipped
+                smetrics.SERVING_SPARSE_ATTENTION_RATIO.set(
+                    self.sparse_selected_blocks
+                    / self.sparse_candidate_blocks)
             new_p = sch.preemption_count - self._preempt_seen
             if new_p:
                 smetrics.SERVING_PREEMPTIONS.inc(new_p)

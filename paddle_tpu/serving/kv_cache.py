@@ -20,6 +20,44 @@ import numpy as np
 
 NULL_BLOCK = 0
 
+#: supported pool dtypes -> (HBM bytes per element, whether the pool
+#: stores quantized payloads needing per-entry-per-head fp32 scales).
+#: THE one list `Config(kv_dtype=)` and the constructor validate
+#: against — an unknown dtype fails here with the supported set in
+#: the message, never as a deep KeyError in the sizing math.
+KV_DTYPES = {
+    "float32": (4, False),
+    "bfloat16": (2, False),
+    "float16": (2, False),
+    "int8": (1, True),
+    # fp8 KV pools (ISSUE 15): e4m3 payloads under the SAME per-entry
+    # per-head fp32 scale plumbing as int8 — quantize-on-append scales
+    # amax to the e4m3 max (448) so the full mantissa range is used
+    # per entry; CPU-testable via ml_dtypes
+    "fp8_e4m3": (1, True),
+}
+
+#: fp8 format constants (ml_dtypes float8_e4m3fn): finite max 448;
+#: values past it cast to NaN, so quantize clips first
+FP8_MAX = 448.0
+
+#: "empty" sentinel for the min summary rows (max rows use the
+#: negation): large but finite — far above any real key magnitude, far
+#: enough below float32 max that score products stay finite — so a
+#: never-written row scores a huge NEGATIVE upper bound (never
+#: selected) without NaN-ing the scorer's arithmetic the way +/-inf
+#: would
+SUMMARY_INIT = 1e30
+
+
+def kv_jnp_dtype(kv_dtype):
+    """The jnp storage dtype for a `KV_DTYPES` name ("fp8_e4m3" is a
+    serving-facing alias of ml_dtypes' float8_e4m3fn)."""
+    import jax.numpy as jnp
+    if kv_dtype == "fp8_e4m3":
+        return jnp.float8_e4m3fn
+    return jnp.dtype(kv_dtype)
+
 
 class BlockAllocator:
     """LIFO free-list over block ids [reserved, num_blocks), with
@@ -116,11 +154,26 @@ class PagedKVCache:
     pure function of the token's own fp K/V, which is what preserves
     the prefix-cache contract ("cached K/V is exactly what
     re-prefilling would write") and makes the int8 engine
-    deterministic under chunking, preemption and sharing."""
+    deterministic under chunking, preemption and sharing.
+
+    `kv_dtype="fp8_e4m3"` rides the exact same plumbing with e4m3
+    payloads (ml_dtypes), halving KV bytes again vs the int8 story's
+    fp32 baseline and composing with sparsity, TP sharding, transport
+    and the prefix cache for free.
+
+    `summaries=True` (the block-sparse attention substrate, ISSUE 15)
+    additionally keeps per-(pool-block, head) CHANNEL-WISE min/max
+    key summaries `k_sum_min`/`k_sum_max` `[L, NB, H, Dh]` fp32,
+    updated on append inside the jitted mixed step (the offset-0
+    write of a block RESETS its row, so freed-then-reused blocks can
+    never leak a previous owner's statistics). Summary rows ride the
+    same block coordinates as the scale rows, so CoW, truncation,
+    prefix adoption and migration transport carry them by
+    construction."""
 
     def __init__(self, num_layers, num_heads, head_dim, *, num_blocks,
                  block_size, max_slots, max_blocks_per_slot,
-                 dtype="float32", kv_dtype=None):
+                 dtype="float32", kv_dtype=None, summaries=False):
         import jax.numpy as jnp
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -131,20 +184,31 @@ class PagedKVCache:
         self.max_blocks_per_slot = int(max_blocks_per_slot)
         self.dtype = str(dtype)
         self.kv_dtype = str(kv_dtype) if kv_dtype else self.dtype
-        if self.kv_dtype not in ("float32", "bfloat16", "float16",
-                                 "int8"):
+        if self.kv_dtype not in KV_DTYPES:
             raise ValueError(
-                f"kv_dtype={self.kv_dtype!r} not supported; use a float "
-                "dtype or 'int8' (per-entry-per-head scaled)")
+                f"kv_dtype={self.kv_dtype!r} not supported; pick one "
+                f"of {sorted(KV_DTYPES)} ('int8'/'fp8_e4m3' store "
+                "per-entry-per-head scaled quantized pools)")
+        self.summaries = bool(summaries)
         shape = (num_layers, self.num_blocks, self.block_size,
                  num_heads, head_dim)
-        self.k_pool = jnp.zeros(shape, jnp.dtype(self.kv_dtype))
-        self.v_pool = jnp.zeros(shape, jnp.dtype(self.kv_dtype))
+        self.k_pool = jnp.zeros(shape, kv_jnp_dtype(self.kv_dtype))
+        self.v_pool = jnp.zeros(shape, kv_jnp_dtype(self.kv_dtype))
         self.k_scale = self.v_scale = None
         if self.quantized:
             sshape = shape[:-1]                      # [L, NB, BS, H]
             self.k_scale = jnp.zeros(sshape, jnp.float32)
             self.v_scale = jnp.zeros(sshape, jnp.float32)
+        self.k_sum_min = self.k_sum_max = None
+        if self.summaries:
+            # min starts high / max starts low so the first append of
+            # a block's offset-0 entry (which resets the row anyway)
+            # and an unwritten row alike can never look attractive to
+            # the block scorer
+            mshape = (num_layers, self.num_blocks, num_heads, head_dim)
+            self.k_sum_min = jnp.full(mshape, SUMMARY_INIT, jnp.float32)
+            self.k_sum_max = jnp.full(mshape, -SUMMARY_INIT,
+                                      jnp.float32)
         self.allocator = BlockAllocator(self.num_blocks)
         self.block_tables = np.zeros(
             (self.max_slots, self.max_blocks_per_slot), np.int32)
@@ -169,24 +233,30 @@ class PagedKVCache:
     # ------------------------------------------------------------ sizing
     @property
     def quantized(self):
-        return self.kv_dtype == "int8"
+        return KV_DTYPES[self.kv_dtype][1]
 
     @property
     def kv_bytes_per_token(self):
         """HBM bytes one cached token costs across K+V and all layers,
-        including the quantization scales — the number the
+        including the quantization scales and (amortized per token)
+        the block-summary rows — the number the
         `paddle_tpu_serving_kv_bytes_per_token` gauge publishes and
-        `tools/kv_smoke.py` budgets with. Read per engine step for the
-        gauge, so it is pure host arithmetic on fixed geometry (the
-        explicit itemsize map mirrors the kv_dtype whitelist in
-        __init__ — np.dtype only knows "bfloat16" after jax registers
+        `tools/kv_smoke.py`/`tools/longctx_smoke.py` budget with. Read
+        per engine step for the gauge, so it is pure host arithmetic
+        on fixed geometry (the explicit `KV_DTYPES` itemsize map —
+        np.dtype only knows "bfloat16"/fp8 after jax registers
         ml_dtypes, an import-order dependency not worth having)."""
-        itemsize = {"float32": 4, "bfloat16": 2,
-                    "float16": 2, "int8": 1}[self.kv_dtype]
+        itemsize = KV_DTYPES[self.kv_dtype][0]
         per = self.num_heads * self.head_dim * itemsize
         if self.quantized:
             per += self.num_heads * 4            # fp32 scale per head
-        return 2 * self.num_layers * per         # K and V
+        per *= 2                                 # K and V
+        if self.summaries:
+            # one fp32 min + max K-summary row per BLOCK, spread over
+            # its block_size tokens (K only — the scorer never needs V)
+            per += (2 * self.num_heads * self.head_dim * 4
+                    ) // self.block_size
+        return self.num_layers * per
 
     @property
     def block_bytes(self):
@@ -284,42 +354,30 @@ class PagedKVCache:
         return True
 
     def _copy_block_data(self, src, dst):
-        """pool[:, dst] = pool[:, src] for K and V, as ONE jitted
-        fixed-shape copy (block ids ride as traced scalars, so every
-        CoW reuses the same executable; pools are donated in place).
-        Quantized pools copy the per-entry scale columns in the SAME
-        executable — a CoW'd block dequantizes identically to its
+        """pool[:, dst] = pool[:, src] for every pool array, as ONE
+        jitted fixed-shape copy (block ids ride as traced scalars, so
+        every CoW reuses the same executable; pools are donated in
+        place). Quantized pools copy the per-entry scale columns and
+        summary-tracking pools the block-summary rows in the SAME
+        executable — every array indexes its block at axis 1, so a
+        CoW'd block dequantizes AND scores identically to its
         source."""
         import jax.numpy as jnp
 
         if self._copy_fn is None:
             from ..jit.functional import instrumented_jit
+            n = len(self._pools())
 
-            if self.quantized:
-                def copy(kp, vp, ks, vs, src, dst):
-                    return (kp.at[:, dst].set(kp[:, src]),
-                            vp.at[:, dst].set(vp[:, src]),
-                            ks.at[:, dst].set(ks[:, src]),
-                            vs.at[:, dst].set(vs[:, src]))
+            def copy(*args):
+                pools, src, dst = args[:n], args[n], args[n + 1]
+                return tuple(p.at[:, dst].set(p[:, src]) for p in pools)
 
-                self._copy_fn = instrumented_jit(
-                    copy, "serving_prefix_cow",
-                    donate_argnums=(0, 1, 2, 3))
-            else:
-                def copy(kp, vp, src, dst):
-                    return (kp.at[:, dst].set(kp[:, src]),
-                            vp.at[:, dst].set(vp[:, src]))
-
-                self._copy_fn = instrumented_jit(
-                    copy, "serving_prefix_cow", donate_argnums=(0, 1))
-        if self.quantized:
-            (self.k_pool, self.v_pool, self.k_scale,
-             self.v_scale) = self._copy_fn(
-                self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-                jnp.int32(src), jnp.int32(dst))
-        else:
-            self.k_pool, self.v_pool = self._copy_fn(
-                self.k_pool, self.v_pool, jnp.int32(src), jnp.int32(dst))
+            self._copy_fn = instrumented_jit(
+                copy, "serving_prefix_cow",
+                donate_argnums=tuple(range(n)))
+        out = self._copy_fn(*self._pools(), jnp.int32(src),
+                            jnp.int32(dst))
+        self._set_pools(out)
 
     # ------------------------------------------------- block transport
     def kv_meta(self):
@@ -331,69 +389,76 @@ class PagedKVCache:
                 "head_dim": self.head_dim,
                 "block_size": self.block_size,
                 "dtype": self.dtype,
-                "kv_dtype": self.kv_dtype}
+                "kv_dtype": self.kv_dtype,
+                "summaries": self.summaries}
 
     def _transfer_fn(self, kind, width):
         """Jitted gather ("export") / donated scatter ("import") over
         the pools for a `[width]` block-id vector. One instrumented
         instance per (kind, pow2 width): ids ride as traced values, so
         every transfer of up to `width` blocks reuses the same
-        executable — no per-block (or per-count) compile."""
+        executable — no per-block (or per-count) compile. Every pool
+        array (payloads, scales, summaries) indexes its block at axis
+        1, so one generic gather/scatter covers them all."""
         fn = self._transfer_fns.get((kind, width))
         if fn is not None:
             return fn
         import jax.numpy as jnp
 
         from ..jit.functional import instrumented_jit
+        n = len(self._pools())
 
         if kind == "export":
-            if self.quantized:
-                def gather(kp, vp, ks, vs, ids):
-                    return (jnp.moveaxis(kp[:, ids], 1, 0),
-                            jnp.moveaxis(vp[:, ids], 1, 0),
-                            jnp.moveaxis(ks[:, ids], 1, 0),
-                            jnp.moveaxis(vs[:, ids], 1, 0))
-            else:
-                def gather(kp, vp, ids):
-                    return (jnp.moveaxis(kp[:, ids], 1, 0),
-                            jnp.moveaxis(vp[:, ids], 1, 0))
+            def gather(*args):
+                pools, ids = args[:n], args[n]
+                return tuple(jnp.moveaxis(p[:, ids], 1, 0)
+                             for p in pools)
+
             fn = instrumented_jit(gather, "serving_kv_export")
         elif kind == "import":
-            if self.quantized:
-                def scatter(kp, vp, ks, vs, ids, pk, pv, pks, pvs):
-                    return (kp.at[:, ids].set(jnp.moveaxis(pk, 0, 1)),
-                            vp.at[:, ids].set(jnp.moveaxis(pv, 0, 1)),
-                            ks.at[:, ids].set(jnp.moveaxis(pks, 0, 1)),
-                            vs.at[:, ids].set(jnp.moveaxis(pvs, 0, 1)))
+            def scatter(*args):
+                pools, ids, payload = args[:n], args[n], args[n + 1:]
+                return tuple(
+                    p.at[:, ids].set(jnp.moveaxis(a, 0, 1))
+                    for p, a in zip(pools, payload))
 
-                fn = instrumented_jit(scatter, "serving_kv_import",
-                                      donate_argnums=(0, 1, 2, 3))
-            else:
-                def scatter(kp, vp, ids, pk, pv):
-                    return (kp.at[:, ids].set(jnp.moveaxis(pk, 0, 1)),
-                            vp.at[:, ids].set(jnp.moveaxis(pv, 0, 1)))
-
-                fn = instrumented_jit(scatter, "serving_kv_import",
-                                      donate_argnums=(0, 1))
+            fn = instrumented_jit(scatter, "serving_kv_import",
+                                  donate_argnums=tuple(range(n)))
         else:
             raise ValueError(f"unknown transfer kind {kind!r}")
         self._transfer_fns[(kind, width)] = fn
         return fn
 
     def _pools(self):
+        out = [self.k_pool, self.v_pool]
         if self.quantized:
-            return [self.k_pool, self.v_pool, self.k_scale, self.v_scale]
-        return [self.k_pool, self.v_pool]
+            out += [self.k_scale, self.v_scale]
+        if self.summaries:
+            out += [self.k_sum_min, self.k_sum_max]
+        return out
+
+    def _set_pools(self, arrays):
+        """Inverse of `_pools()`: rebind the pool attributes from a
+        jitted executable's output tuple (same fixed order)."""
+        arrays = list(arrays)
+        self.k_pool, self.v_pool = arrays[:2]
+        arrays = arrays[2:]
+        if self.quantized:
+            self.k_scale, self.v_scale = arrays[:2]
+            arrays = arrays[2:]
+        if self.summaries:
+            self.k_sum_min, self.k_sum_max = arrays[:2]
 
     def export_blocks(self, block_ids):
         """Read `block_ids`' pool columns out to host arrays: a tuple
-        `(k, v)` — plus `(k_scale, v_scale)` for int8 pools — each
-        `[n, L, BS, ...]` (block-major, so one block's bytes are
+        `(k, v)` — plus `(k_scale, v_scale)` for quantized pools and
+        `(k_sum_min, k_sum_max)` for summary-tracking ones — each
+        `[n, L, ...]` (block-major, so one block's bytes are
         contiguous for the wire codec). One jitted fixed-shape gather
         per pow2 id-width; ids need not be contiguous or ordered. The
-        int8 scale rows ride the same block coordinates by
-        construction, so an exported block dequantizes identically
-        wherever it lands."""
+        scale and summary rows ride the same block coordinates by
+        construction, so an exported block dequantizes AND scores
+        identically wherever it lands."""
         import jax.numpy as jnp
 
         from .batcher import next_pow2
@@ -448,11 +513,7 @@ class PagedKVCache:
             payload.append(jnp.asarray(a))
         out = self._transfer_fn("import", width)(
             *pools, jnp.asarray(padded_ids), *payload)
-        if self.quantized:
-            (self.k_pool, self.v_pool,
-             self.k_scale, self.v_scale) = out
-        else:
-            self.k_pool, self.v_pool = out
+        self._set_pools(out)
         self.blocks_imported += n
         if self.place_pools is not None:
             # sharded engines re-pin the canonical pool sharding so the

@@ -75,6 +75,16 @@ SERVING_PREFIX_EVICTIONS = REGISTRY.counter(
     "paddle_tpu_serving_prefix_cache_evictions_total",
     "Cached KV blocks reclaimed by LRU eviction under pool pressure")
 
+# ---- block-sparse paged decode attention (ISSUE 15) --------------------
+SERVING_KV_BLOCKS_SKIPPED = REGISTRY.counter(
+    "paddle_tpu_serving_kv_blocks_skipped_total",
+    "Candidate KV blocks the sparse decode path did NOT read (summary "
+    "scoring kept a fixed top-B + sink + recency budget instead)")
+SERVING_SPARSE_ATTENTION_RATIO = REGISTRY.gauge(
+    "paddle_tpu_serving_sparse_attention_ratio",
+    "Cumulative fraction of candidate KV blocks the sparse decode "
+    "path actually attended (1.0 = dense; lower = sparser)")
+
 # ---- disaggregated serving (serving.distributed.transport) -------------
 SERVING_KV_BLOCKS_MIGRATED = REGISTRY.counter(
     "paddle_tpu_serving_kv_blocks_migrated_total",
@@ -180,6 +190,10 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_prefix_cache_hit_tokens_total",
     "paddle_tpu_serving_prefix_cache_miss_tokens_total",
     "paddle_tpu_serving_prefix_cache_evictions_total",
+    # block-sparse paged decode attention (ISSUE 15): blocks the
+    # summary scorer skipped + the cumulative attended fraction
+    "paddle_tpu_serving_kv_blocks_skipped_total",
+    "paddle_tpu_serving_sparse_attention_ratio",
     "paddle_tpu_serving_router_requests_total",
     "paddle_tpu_serving_router_affinity_hits_total",
     "paddle_tpu_serving_router_failovers_total",

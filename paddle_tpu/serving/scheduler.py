@@ -100,7 +100,8 @@ class Plan:
 class Scheduler:
     def __init__(self, kv_cache, *, max_slots, token_budget,
                  clock=time.monotonic, draft_k=0, draft_fn=None,
-                 prefix_cache=None, adapter_cache=None):
+                 prefix_cache=None, adapter_cache=None,
+                 reserve_region=False):
         self.kv = kv_cache
         self.max_slots = max_slots
         self.token_budget = token_budget
@@ -124,6 +125,11 @@ class Scheduler:
         # queue head when every slot is pinned by in-flight requests;
         # `_free_slot` drops the pin on every release path
         self.adapters = adapter_cache
+        # block-sparse decode (ISSUE 15): the engine reserves the
+        # per-slot decode region even at draft_k == 0, so prefill
+        # budgets must treat it as spoken for exactly like the
+        # speculative verify region
+        self.reserve_region = bool(reserve_region)
 
     # ---------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
@@ -371,9 +377,11 @@ class Scheduler:
             else:
                 decode.append((req.slot, req.output[-1], pos))
 
-        # with speculation the verify region is RESERVED up front (see
-        # batcher.pack_step) — prefill budget never depends on the mix
-        reserved = len(decode) if self.draft_k == 0 \
+        # with speculation (or the sparse decode region) the region is
+        # RESERVED up front (see batcher.pack_step) — prefill budget
+        # never depends on the mix
+        reserved = len(decode) \
+            if self.draft_k == 0 and not self.reserve_region \
             else self.max_slots * (self.draft_k + 1)
         budget_left = self.token_budget - reserved
         prefills = []

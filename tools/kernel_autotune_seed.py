@@ -59,28 +59,36 @@ def seed(out_path, budget_s=15.0, verbose=True):
 
     # 1. the canonical CI serving workload's paged buckets (the
     #    tuner-cache audit contract: these must always be covered) —
-    #    fp32 AND the int8 quantized-pool twin of each bucket (the
-    #    kv_dtype="int8" engines key their lookups by pool dtype)
+    #    each bucket's own dtype (fp32 / bf16 / the ISSUE 15
+    #    float8_e4m3fn pools) AND the int8 quantized-pool twin (the
+    #    kv_dtype="int8" engines key their lookups by pool dtype).
+    #    "paged_sparse" buckets (ISSUE 15) carry the sparsity budget
+    #    as a sixth axis and tune the shortened-table workload.
     if verbose:
         print("paged-attention family (canonical serving buckets):")
     done = set()
     for kernel, bucket, dtype in tuner_smoke_workload():
-        n, g, h, dh, bs = bucket
         for dt in (dtype, "int8"):
             if (kernel, bucket, dt) in done:
                 continue
             done.add((kernel, bucket, dt))
-            note(f"{kernel}|{bucket}|{dt}",
-                 paged_attention.tune_paged_kernel(
-                     kernel, n, g, h, dh, bs, dtype=dt,
-                     budget_s=budget_s))
+            if kernel == "paged_sparse":
+                n, g, h, dh, bs, b = bucket
+                res = paged_attention.tune_paged_sparse(
+                    n, g, h, dh, bs, b, dtype=dt, budget_s=budget_s)
+            else:
+                n, g, h, dh, bs = bucket
+                res = paged_attention.tune_paged_kernel(
+                    kernel, n, g, h, dh, bs, dtype=dt,
+                    budget_s=budget_s)
+            note(f"{kernel}|{bucket}|{dt}", res)
 
     # 2. engine-level KV block size for the smoke engine shape
     #    (ServingEngine(block_size="auto") resolves this key; int8
     #    twin for quantized engines)
     if verbose:
         print("paged block size:")
-    for dt in ("float32", "int8"):
+    for dt in ("float32", "int8", "float8_e4m3fn"):
         note(f"paged_block_size|{dt}",
              paged_attention.tune_block_size(4, 4, 8, context_len=32,
                                              dtype=dt,

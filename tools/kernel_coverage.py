@@ -402,8 +402,11 @@ def tuner_smoke_workload():
     4 slots, block 4) with and without speculation, in fp32 AND the
     DEFAULT bfloat16 cache dtype (lookups key by pool dtype — a
     bf16-only gap would be exactly the silent hand-default regression
-    the audit exists to catch). Returns the `(kernel, bucket, dtype)`
-    keys the engines registered."""
+    the audit exists to catch), plus the ISSUE 15 lanes: a
+    block-sparse engine (its decode region resolves "paged_sparse"
+    keys whose buckets carry the sparsity budget B) and an fp8 pool
+    engine (lookups key by the float8_e4m3fn pool dtype). Returns the
+    `(kernel, bucket, dtype)` keys the engines registered."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTForGeneration
     from paddle_tpu.serving.engine import ServingEngine
@@ -415,11 +418,22 @@ def tuner_smoke_workload():
                              compute_dtype="float32")
     model.eval()
     keys = []
-    for draft_k, cache_dtype in ((0, "float32"), (2, "float32"),
-                                 (0, "bfloat16"), (2, "bfloat16")):
+    variants = [dict(draft_k=0, cache_dtype="float32"),
+                dict(draft_k=2, cache_dtype="float32"),
+                dict(draft_k=0, cache_dtype="bfloat16"),
+                dict(draft_k=2, cache_dtype="bfloat16"),
+                # block-sparse decode (dense prefill rides along) +
+                # its speculative twin
+                dict(draft_k=0, cache_dtype="float32", sparse_blocks=4),
+                dict(draft_k=2, cache_dtype="float32", sparse_blocks=4),
+                # fp8 KV pools — and the fp8 sparse composition
+                dict(draft_k=0, cache_dtype="float32",
+                     kv_dtype="fp8_e4m3"),
+                dict(draft_k=0, cache_dtype="float32",
+                     kv_dtype="fp8_e4m3", sparse_blocks=4)]
+    for kw in variants:
         eng = ServingEngine(model, max_slots=4, block_size=4,
-                            max_seq_len=64, cache_dtype=cache_dtype,
-                            draft_k=draft_k)
+                            max_seq_len=64, **kw)
         for key in eng._kernel_buckets:
             if key not in keys:
                 keys.append(key)
