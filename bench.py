@@ -1455,7 +1455,24 @@ def _metrics_extra():
         # (ISSUE 14): what the weight-only knob buys at serving time —
         # analytic, scales included (grouped_matmul.expert_weight_bytes)
         "moe_expert_weight_bytes": _expert_weight_bytes_by_dtype(),
+        # request tracing + SLO plane (ISSUE 16): nonzero when the run
+        # also sets PADDLE_TPU_TRACE=1 (tracing, like the rest of the
+        # instrumentation, stays off unless asked for)
+        "trace_requests": total("paddle_tpu_serving_trace_requests_total"),
+        "trace_events": total("paddle_tpu_serving_trace_events_total"),
+        "trace_events_dropped": total(
+            "paddle_tpu_serving_trace_events_dropped_total"),
+        "trace_open": total("paddle_tpu_serving_trace_active"),
+        "slo_breaches": total("paddle_tpu_serving_slo_breaches_total"),
+        "flight_steps": _flight_steps(),
     }
+
+
+def _flight_steps():
+    """Per-step flight-recorder coverage across every engine this bench
+    process created (serving.tracing.StepFlightRecorder)."""
+    from paddle_tpu.serving import tracing
+    return int(sum(rec.steps for rec in tracing.flight_recorders()))
 
 
 def _expert_weight_bytes_by_dtype():

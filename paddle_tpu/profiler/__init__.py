@@ -70,6 +70,48 @@ class _HostEventRecorder:
 _recorder = _HostEventRecorder()
 _recording = [False]
 
+# Pluggable observability providers (ISSUE 16): subsystems that own
+# their own event stores (serving.tracing's request traces + per-engine
+# step flight recorders) register callables here instead of the
+# profiler importing them — profiler must stay importable without the
+# serving stack. Chrome sources return lists of trace-event dicts
+# merged into export_chrome_tracing's file; summary sections return a
+# text block (or "" to stay silent) appended to summary().
+_chrome_sources = []
+_summary_sections = []
+
+
+def register_chrome_source(fn):
+    if fn not in _chrome_sources:
+        _chrome_sources.append(fn)
+
+
+def register_summary_section(fn):
+    if fn not in _summary_sections:
+        _summary_sections.append(fn)
+
+
+def _extra_chrome_events():
+    events = []
+    for fn in list(_chrome_sources):
+        try:
+            events.extend(fn() or [])
+        except Exception:
+            pass
+    return events
+
+
+def _extra_summary_sections():
+    parts = []
+    for fn in list(_summary_sections):
+        try:
+            text = fn()
+        except Exception:
+            continue
+        if text:
+            parts.append(text)
+    return parts
+
 
 class RecordEvent:
     """platform/profiler/event_tracing.h:49 parity — user span."""
@@ -129,6 +171,7 @@ def export_chrome_tracing(dir_name, worker_name=None):
         events = list(_recorder.events)
         if metrics._enabled:
             events += metrics.REGISTRY.chrome_counter_events()
+        events += _extra_chrome_events()
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
     return handler
@@ -217,6 +260,9 @@ def _full_summary(self, sorted_by=None, op_detail=True, thread_sep=False,
         sorted_by=sorted_by or SortedKeys.CPUTotal)
     if metrics._enabled:
         out = out + "\n\n" + metrics.REGISTRY.render_table()
+    extra = _extra_summary_sections()
+    if extra:
+        out = "\n\n".join([out] + extra)
     print(out)
     return out
 
@@ -239,4 +285,5 @@ def summary(sorted_by=None, trace_dir=None, top_k=30):
         parts.append(f"(host ring buffer dropped {_recorder.dropped} "
                      f"spans; raise PADDLE_TPU_PROFILER_EVENTS_MAX)")
     parts.append(metrics.REGISTRY.render_table())
+    parts.extend(_extra_summary_sections())
     return "\n\n".join(parts)

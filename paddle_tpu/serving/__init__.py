@@ -21,11 +21,15 @@ from . import kv_cache  # noqa: F401
 from . import metrics  # noqa: F401
 from . import prefix_cache  # noqa: F401
 from . import scheduler  # noqa: F401
+from . import slo  # noqa: F401
+from . import tracing  # noqa: F401
 from .adapters import AdapterCache  # noqa: F401
 from .batcher import FairQueue, SamplingConfig  # noqa: F401
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
 from .prefix_cache import RadixPrefixCache  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
+from .slo import SLOConfig, SLOMonitor  # noqa: F401
+from .tracing import RequestTracer, StepFlightRecorder  # noqa: F401
 
 __all__ = [
     "SamplingConfig", "BlockAllocator", "PagedKVCache", "Request",
@@ -34,6 +38,8 @@ __all__ = [
     "kv_cache", "metrics", "scheduler",
     "prefix_cache", "engine", "frontend", "distributed",
     "TPServingEngine", "ReplicaRouter",
+    "tracing", "slo", "RequestTracer", "StepFlightRecorder",
+    "SLOConfig", "SLOMonitor",
 ]
 
 _LAZY = {

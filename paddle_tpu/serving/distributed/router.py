@@ -39,6 +39,7 @@ import itertools
 
 from ...profiler import metrics as _pmetrics
 from .. import metrics as smetrics
+from .. import tracing as _tracing
 from ..frontend import (DeadlineExceeded, FrontendClosed,
                         RequestCancelled, RequestMigrated)
 from .health import ReplicaHealth
@@ -596,6 +597,20 @@ class ReplicaRouter:
             raise DeadlineExceeded()
         return remaining
 
+    def _rname(self, idx):
+        """Replica name for trace events — the engine's name when it
+        has one (ISSUE 16 gives every engine one), else the index."""
+        return getattr(self.frontends[idx].engine, "name",
+                       f"replica{idx}")
+
+    def _tclose(self, trace_id, outcome):
+        """Close a trace from the router's side of the stream (caller
+        abandoned the generator, deadline, error). Idempotent with the
+        engine-side terminal hook — the first writer wins, so a normal
+        finish/cancel recorded by the scheduler is never overwritten."""
+        if trace_id is not None and _tracing._enabled:
+            _tracing.TRACER.finish(trace_id, outcome, replica="router")
+
     async def stream(self, prompt, max_new_tokens=32, *,
                      tenant="default", timeout=None, adapter_id=None):
         """Async generator of generated tokens. On a replica death the
@@ -613,47 +628,74 @@ class ReplicaRouter:
         deadline = (self.clock() + float(timeout)
                     if timeout is not None else None)
         delivered = 0
-        while True:
-            idx, _ = self._pick(prompt, adapter_id=adapter_id)
-            self._count_role("mixed")
-            remaining = self._remaining(idx, deadline)
-            on_admitted, release = self._hold(idx)
-            attempt_out = []
-            try:
-                agen = self.frontends[idx].stream(
-                    prompt, max_new_tokens, tenant=tenant,
-                    timeout=remaining, on_admitted=on_admitted,
-                    adapter_id=adapter_id)
-                async for tok in self._attempt(idx, agen, attempt_out):
-                    if len(attempt_out) > delivered:
-                        delivered += 1
-                        yield tok
-                # replica finished the request: publish the chat turn
-                # to its shadow tree (the engine's finish-insert did
-                # the same with the real blocks; adapter requests
-                # never entered the real cache, so their shadow stays
-                # out too)
-                self._shadow_note(idx, list(prompt) + attempt_out,
-                                  adapter_id)
-                self._count(idx, "finished")
-                return
-            except _FAILOVER_ERRORS as e:
-                if not self._is_replica_death(idx, e):
+        # the trace id is minted ONCE per request, before the dispatch
+        # loop: failover re-dispatches record onto the SAME trace (the
+        # "dispatched" event reopens a trace the dying replica's cancel
+        # path closed), so one stitched timeline survives the restart
+        trace_id = (_tracing.TRACER.mint(tenant=str(tenant))
+                    if _tracing._enabled else None)
+        try:
+            while True:
+                idx, _ = self._pick(prompt, adapter_id=adapter_id)
+                self._count_role("mixed")
+                if _tracing._enabled:
+                    _tracing.TRACER.event(trace_id, "dispatched",
+                                          replica=self._rname(idx),
+                                          role="mixed", tenant=tenant)
+                remaining = self._remaining(idx, deadline)
+                on_admitted, release = self._hold(idx)
+                attempt_out = []
+                try:
+                    agen = self.frontends[idx].stream(
+                        prompt, max_new_tokens, tenant=tenant,
+                        timeout=remaining, on_admitted=on_admitted,
+                        adapter_id=adapter_id, trace_id=trace_id)
+                    async for tok in self._attempt(idx, agen,
+                                                   attempt_out):
+                        if len(attempt_out) > delivered:
+                            delivered += 1
+                            yield tok
+                    # replica finished the request: publish the chat
+                    # turn to its shadow tree (the engine's
+                    # finish-insert did the same with the real blocks;
+                    # adapter requests never entered the real cache, so
+                    # their shadow stays out too)
+                    self._shadow_note(idx, list(prompt) + attempt_out,
+                                      adapter_id)
+                    self._count(idx, "finished")
+                    return
+                except _FAILOVER_ERRORS as e:
+                    if not self._is_replica_death(idx, e):
+                        self._count(idx, "error")
+                        raise
+                    self._fail_over(idx)
+                    if _tracing._enabled:
+                        _tracing.TRACER.event(
+                            trace_id, "failover",
+                            replica=self._rname(idx),
+                            delivered=delivered)
+                    continue                  # re-dispatch elsewhere
+                except DeadlineExceeded:
+                    self._count(idx, "expired")
+                    raise
+                except RequestCancelled:
+                    self._count(idx, "cancelled")
+                    raise
+                except Exception:
                     self._count(idx, "error")
                     raise
-                self._fail_over(idx)
-                continue                      # re-dispatch elsewhere
-            except DeadlineExceeded:
-                self._count(idx, "expired")
-                raise
-            except RequestCancelled:
-                self._count(idx, "cancelled")
-                raise
-            except Exception:
-                self._count(idx, "error")
-                raise
-            finally:
-                release()
+                finally:
+                    release()
+        except DeadlineExceeded:
+            self._tclose(trace_id, "expired")
+            raise
+        except (RequestCancelled, GeneratorExit,
+                asyncio.CancelledError):
+            self._tclose(trace_id, "cancelled")
+            raise
+        except BaseException:
+            self._tclose(trace_id, "error")
+            raise
 
     async def _stream_disagg(self, prompt, max_new_tokens, tenant,
                              timeout, adapter_id=None):
@@ -682,6 +724,12 @@ class ReplicaRouter:
         delivered = 0
         transport = self.transport
         inbox = [None, None]                # (dst, key) awaiting collect
+        # one trace id for the WHOLE pipeline: prefill dispatch,
+        # stream-ahead, ticket transport, decode admission, shed hops
+        # and failover restarts all stitch onto it (the ticket carries
+        # it across the replica boundary)
+        trace_id = (_tracing.TRACER.mint(tenant=str(tenant))
+                    if _tracing._enabled else None)
 
         def _drop_inbox():
             if inbox[0] is not None:
@@ -692,6 +740,11 @@ class ReplicaRouter:
             while True:                     # failover restart loop
                 pidx, _ = self._pick(prompt, adapter_id=adapter_id)
                 self._count_role("prefill")
+                if _tracing._enabled:
+                    _tracing.TRACER.event(trace_id, "dispatched",
+                                          replica=self._rname(pidx),
+                                          role=self.roles[pidx],
+                                          tenant=tenant)
                 on_blocks = None
                 didx = key = None
                 if self.roles[pidx] == "prefill":
@@ -718,7 +771,8 @@ class ReplicaRouter:
                     agen = self.frontends[pidx].stream(
                         prompt, max_new_tokens, tenant=tenant,
                         timeout=remaining, on_admitted=on_admitted,
-                        on_blocks=on_blocks, adapter_id=adapter_id)
+                        on_blocks=on_blocks, adapter_id=adapter_id,
+                        trace_id=trace_id)
                     async for tok in self._attempt(pidx, agen,
                                                    attempt_out):
                         if len(attempt_out) > delivered:
@@ -740,6 +794,11 @@ class ReplicaRouter:
                         self._count(pidx, "error")
                         raise
                     self._fail_over(pidx)
+                    if _tracing._enabled:
+                        _tracing.TRACER.event(
+                            trace_id, "failover",
+                            replica=self._rname(pidx),
+                            delivered=delivered)
                     continue
                 except DeadlineExceeded:
                     self._count(pidx, "expired")
@@ -775,6 +834,11 @@ class ReplicaRouter:
                     assembled = transport.collect(didx, key)
                     inbox[0] = inbox[1] = None
                     self._count_role("decode")
+                    if _tracing._enabled:
+                        _tracing.TRACER.event(trace_id, "dispatched",
+                                              replica=self._rname(didx),
+                                              role="decode",
+                                              tenant=tenant)
                     # placement bookkeeping: the KV now lives on didx
                     history = (list(assembled.prompt)
                                + list(assembled.output))
@@ -825,6 +889,11 @@ class ReplicaRouter:
                         # the KV payload died with the replica: restart
                         # from prefill, suppressing delivered tokens
                         self._fail_over(didx)
+                        if _tracing._enabled:
+                            _tracing.TRACER.event(
+                                trace_id, "failover",
+                                replica=self._rname(didx),
+                                delivered=delivered)
                         restart = True
                         break
                     except DeadlineExceeded:
@@ -840,6 +909,19 @@ class ReplicaRouter:
                         release()
                 if not restart:
                     return
+        except DeadlineExceeded:
+            self._tclose(trace_id, "expired")
+            raise
+        except (RequestCancelled, GeneratorExit,
+                asyncio.CancelledError):
+            # caller abandoned the stream (or cancelled it) — possibly
+            # mid-handoff, with the ticket still in the inbox; the
+            # finally drops the inbox, this closes the trace
+            self._tclose(trace_id, "cancelled")
+            raise
+        except BaseException:
+            self._tclose(trace_id, "error")
+            raise
         finally:
             _drop_inbox()
 

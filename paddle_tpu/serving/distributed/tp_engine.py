@@ -101,6 +101,12 @@ class TPServingEngine(ServingEngine):
         super().__init__(model, **kw)
         self._shard_state()
 
+    def _flight_extra(self):
+        # the mesh split rides every flight-recorder step record, so a
+        # merged fleet chrome trace tells a TP=2/EP=2 replica's step
+        # slices from a single-chip sibling's at a glance
+        return {"tp": self.tensor_parallel, "ep": self.expert_parallel}
+
     # ------------------------------------------------------- sharding
     def _pool_spec(self):
         # head axis (index 3) of the [L, NB, BS, H, Dh] pools, in the

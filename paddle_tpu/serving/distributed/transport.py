@@ -87,7 +87,7 @@ _STATE_FIELDS = ("prompt", "output", "max_new_tokens", "eos_token_id",
                  "deadline", "tenant", "slot_len", "total_blocks",
                  "kv_meta", "submit_time", "first_token_time",
                  "cache_hit_tokens", "preemptions", "created_at",
-                 "adapter_id")
+                 "adapter_id", "trace_id")
 
 
 @dataclasses.dataclass
@@ -123,6 +123,12 @@ class MigrationTicket:
     # at admission (it must hold the registration; JSON-serializable
     # ids only, like tenant)
     adapter_id: object = None
+    # fleet-wide request tracing (serving.tracing, ISSUE 16): the trace
+    # id travels on the wire with the host state, so the destination's
+    # scheduler stitches its spans onto the SAME trace the source and
+    # router were writing (a JSON-safe string, None = source not
+    # tracing)
+    trace_id: object = None
 
     def state_dict(self):
         d = {f: getattr(self, f) for f in _STATE_FIELDS}
@@ -273,6 +279,7 @@ class InProcessTransport(KVTransport):
         self._box(dst, key)["chunks"].append(chunk)
 
     def send_ticket(self, src, dst, key, ticket):
+        nb0 = self.bytes_sent
         for chunk in ticket.chunks:
             self.send_chunk(src, dst, key, ticket.kv_meta, chunk)
         if self.wire:
@@ -284,6 +291,12 @@ class InProcessTransport(KVTransport):
             self._note(64, 64)       # nominal host-state frame
         self.tickets_sent += 1
         self._box(dst, key)["state"] = state
+        from .. import tracing as _tracing
+        if _tracing._enabled:
+            _tracing.on_transport(
+                getattr(ticket, "trace_id", None), src, dst,
+                nbytes=self.bytes_sent - nb0,
+                blocks=sum(c.count for c in ticket.chunks))
 
     def collect(self, dst, key):
         box = self._inbox.pop((dst, key), None)

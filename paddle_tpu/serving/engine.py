@@ -57,11 +57,17 @@ from ..jit.functional import instrumented_jit
 from ..profiler import metrics as _pmetrics
 from . import batcher
 from . import metrics as smetrics
+from . import tracing as _tracing
 from .batcher import SamplingConfig, pack_step, select_token
 from .kv_cache import PagedKVCache
 from .scheduler import Scheduler
 
 STEP_FN_NAME = "serving_mixed_step"
+
+# default replica names (`role` + sequence): stable labels for trace
+# span events and flight-recorder tracks when the caller names nothing
+import itertools as _itertools  # noqa: E402
+_ENGINE_SEQ = _itertools.count()
 
 
 class ServingEngine:
@@ -73,7 +79,7 @@ class ServingEngine:
                  role="mixed", max_adapters=0, lora_rank=8,
                  lora_alpha=None, moe_weight_dtype=None,
                  sparse_blocks=None, sparse_recent=2,
-                 track_summaries=None):
+                 track_summaries=None, name=None):
         import functools
 
         import jax
@@ -138,6 +144,10 @@ class ServingEngine:
         if role not in ("mixed", "prefill", "decode"):
             raise ValueError(f"unknown engine role {role!r}")
         self.role = role
+        # replica label stamped on trace span events + the flight
+        # recorder track (serving.tracing, ISSUE 16)
+        self.name = (str(name) if name is not None
+                     else f"{role}{next(_ENGINE_SEQ)}")
         self.draft_k = int(draft_k)
         self.sampling = sampling or SamplingConfig()
         self.speculation_disabled = False
@@ -240,6 +250,7 @@ class ServingEngine:
             prefix_cache=self.prefix_cache,
             adapter_cache=self.adapters,
             reserve_region=self._sparse)
+        self.scheduler.replica = self.name
         self.eos_token_id = eos_token_id
         self.clock = clock
         self._rng = jax.random.PRNGKey(int(seed))
@@ -293,6 +304,16 @@ class ServingEngine:
                                           np.float64)
         self.moe_dropped_total = 0.0
         self.moe_last_aux = 0.0
+        # per-engine step flight recorder (serving.tracing): one host
+        # record per step, noted only while tracing is enabled;
+        # registered so profiler chrome export / summary() merge it
+        self.flight = _tracing.StepFlightRecorder(self.name, self.role)
+        _tracing.register_flight_recorder(self.flight)
+
+    def _flight_extra(self):
+        """Extra per-step flight-recorder fields; TPServingEngine
+        overrides to stamp its mesh split."""
+        return {}
 
     def _quantize_moe_experts(self, dtype_str):
         """Quantize the expert FFN stacks of `self._arrays` in place
@@ -806,7 +827,7 @@ class ServingEngine:
         return self.adapters.register(adapter_id, weights)
 
     def submit(self, prompt_ids, max_new_tokens=32, deadline=None,
-               tenant="default", adapter_id=None):
+               tenant="default", adapter_id=None, trace_id=None):
         """Queue one request. Returns the scheduler's Request handle
         (read `.output` / `.state` as the engine advances).
         `adapter_id` selects a registered LoRA adapter (None = base
@@ -832,7 +853,8 @@ class ServingEngine:
         req = self.scheduler.submit(prompt, max_new_tokens,
                                     eos_token_id=self.eos_token_id,
                                     deadline=deadline, tenant=tenant,
-                                    adapter_id=adapter_id)
+                                    adapter_id=adapter_id,
+                                    trace_id=trace_id)
         if _pmetrics._enabled:
             smetrics.SERVING_QUEUE_DEPTH.set(len(self.scheduler.queue))
         return req
@@ -901,7 +923,9 @@ class ServingEngine:
             first_token_time=req.first_token_time,
             cache_hit_tokens=req.cache_hit_tokens,
             preemptions=req.preemptions, created_at=self.clock(),
-            adapter_id=req.adapter_id)
+            adapter_id=req.adapter_id, trace_id=req.trace_id)
+        if _tracing._enabled:
+            _tracing.on_extracted(req, ticket, self.name)
         self.scheduler.extract(req)
         if _pmetrics._enabled:
             smetrics.SERVING_REQUESTS.labels("migrated").inc()
@@ -1005,6 +1029,10 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
         sch = self.scheduler
+        # tracing state is sampled ONCE per step: recording stays
+        # consistent across the step even if a monitor attaches midway
+        trace_on = _tracing._enabled
+        t0 = self.clock() if trace_on else None
         plan = sch.plan()
         if _pmetrics._enabled and plan.expired:
             for _ in plan.expired:
@@ -1057,21 +1085,48 @@ class ServingEngine:
         else:
             tok_np, tokv_np = np.asarray(out), None
         now = self.clock()
+        if trace_on:
+            # one prefill_chunk span per planned chunk: slot residents
+            # are stable between plan() and here (admissions happen
+            # only inside plan), so sch.slots[slot] is the chunk's
+            # request
+            for slot, chunk, start, completes in plan.prefills:
+                req = sch.slots[slot]
+                if req is not None:
+                    _tracing.TRACER.event(
+                        req.trace_id, "prefill_chunk",
+                        replica=self.name, ts=now, start=int(start),
+                        tokens=len(chunk), completes=bool(completes))
 
-        def emit(req, tokens):
+        def emit(req, tokens, verify=False):
             """Append generated tokens; returns True when the request
             reached a terminal state (EOS / horizon)."""
             if req.state == "prefill":
                 req.state = "decode"
-            if req.first_token_time is None:
+            first = req.first_token_time is None
+            gap = None
+            if first:
                 req.first_token_time = now
                 if _pmetrics._enabled:
                     smetrics.SERVING_TTFT_SECONDS.observe(
                         now - req.submit_time)
-            elif _pmetrics._enabled and req._last_token_time is not None:
-                smetrics.SERVING_INTER_TOKEN_SECONDS.observe(
-                    now - req._last_token_time)
+            elif req._last_token_time is not None:
+                gap = now - req._last_token_time
+                if _pmetrics._enabled:
+                    smetrics.SERVING_INTER_TOKEN_SECONDS.observe(gap)
             req._last_token_time = now
+            if trace_on:
+                # the span twins of the two histograms above: the
+                # first_token event's ts minus the enqueued event's ts
+                # IS `now - req.submit_time`, and decode/verify events
+                # carry the same `gap` — tools/trace_smoke.py asserts
+                # the sums match
+                if first:
+                    _tracing.on_first_token(req, self.name, ts=now)
+                else:
+                    _tracing.on_tokens(req, self.name, ts=now,
+                                       n=len(tokens), gap=gap,
+                                       verify=verify)
             for t in tokens:
                 req.output.append(t)
                 if len(req.output) >= req.max_new_tokens or \
@@ -1095,6 +1150,11 @@ class ServingEngine:
                     # toward a decode replica (a request that finished
                     # AT its first token never migrates)
                     req.state = "handoff"
+                    if trace_on:
+                        _tracing.TRACER.event(
+                            req.trace_id, "handoff",
+                            replica=self.name, ts=now)
+        spec_accept = spec_groups = 0
         if self.draft_k:
             from .draft import accept_length, accept_length_sampled
             for slot, toks, pos in sp.decode_entries:
@@ -1121,7 +1181,10 @@ class ServingEngine:
                             "proposed").inc(len(toks) - 1)
                         smetrics.SERVING_DRAFT_TOKENS.labels(
                             "accepted").inc(m)
-                done = emit(req, emitted)
+                if trace_on:
+                    spec_accept += m + 1
+                    spec_groups += 1
+                done = emit(req, emitted, verify=True)
                 if not done:
                     # roll back blocks whose only contents were
                     # rejected-draft K/V columns
@@ -1181,6 +1244,32 @@ class ServingEngine:
                         pc.evictions - e0)
                 self._prefix_seen = (pc.hit_tokens, pc.miss_tokens,
                                      pc.evictions)
+        if trace_on:
+            # flight-recorder note: every field is a host int/float the
+            # loop already holds — no device readback, no jit input.
+            # The jit cache size probes a host dict; a growing value
+            # across records is a compile event (the watchdog fails the
+            # run outright, this just timestamps it).
+            try:
+                compiled = int(self._step_fn._jitted._cache_size())
+            except Exception:
+                compiled = -1
+            self.flight.note(
+                ts=t0, dur=self.clock() - t0,
+                prefill_tokens=int(sp.prefill_tokens),
+                decode_tokens=int(sp.decode_tokens),
+                active_slots=int(sch.num_active),
+                queue_depth=len(sch.queue),
+                spec_accept_tokens=spec_accept,
+                spec_groups=spec_groups,
+                sparse_skip_ratio=(
+                    1.0 - self.sparse_selected_blocks
+                    / self.sparse_candidate_blocks
+                    if self._sparse and self.sparse_candidate_blocks
+                    else 0.0),
+                blocks_imported=int(self.kv.blocks_imported),
+                compile_cache_size=compiled,
+                **self._flight_extra())
         return True
 
     def run(self, max_steps=None):

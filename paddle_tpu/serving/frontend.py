@@ -67,12 +67,16 @@ class FrontendHandle:
     """One in-flight request as seen by a caller."""
 
     def __init__(self, prompt, max_new_tokens, tenant, deadline,
-                 adapter_id=None):
+                 adapter_id=None, trace_id=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.tenant = tenant
         self.deadline = deadline
         self.adapter_id = adapter_id      # LoRA adapter (None = base)
+        # fleet-wide tracing (serving.tracing): the router mints the
+        # trace id at dispatch and it rides the handle to the engine
+        # submit, so the request's spans stitch onto the fleet trace
+        self.trace_id = trace_id
         self.req = None               # scheduler Request once admitted
         self.queue = asyncio.Queue()  # tokens, then _DONE / exception
         self.published = 0
@@ -169,12 +173,13 @@ class ServingFrontend:
 
     # ------------------------------------------------------------ intake
     async def _enqueue(self, prompt, max_new_tokens, tenant, timeout,
-                       adapter_id=None):
+                       adapter_id=None, trace_id=None):
         deadline = (self.engine.clock() + float(timeout)
                     if timeout is not None else None)
         handle = FrontendHandle(list(prompt), int(max_new_tokens),
                                 str(tenant), deadline,
-                                adapter_id=adapter_id)
+                                adapter_id=adapter_id,
+                                trace_id=trace_id)
         return await self._enqueue_handle(handle)
 
     async def _enqueue_handle(self, handle):
@@ -216,7 +221,7 @@ class ServingFrontend:
 
     async def stream(self, prompt, max_new_tokens=32, *,
                      tenant="default", timeout=None, adapter_id=None,
-                     on_admitted=None, on_blocks=None):
+                     on_admitted=None, on_blocks=None, trace_id=None):
         """Async generator of generated tokens, one per decode step
         (speculative acceptance can deliver several per step). Closing
         the generator — or cancelling its consumer — cancels the
@@ -231,7 +236,8 @@ class ServingFrontend:
         destination. On a prefill-role engine the stream ends with
         `RequestMigrated(ticket)` once the first token is sampled."""
         handle = await self._enqueue(prompt, max_new_tokens, tenant,
-                                     timeout, adapter_id=adapter_id)
+                                     timeout, adapter_id=adapter_id,
+                                     trace_id=trace_id)
         handle.on_blocks = on_blocks
         if on_admitted is not None:
             on_admitted()
@@ -249,7 +255,9 @@ class ServingFrontend:
                                 int(ticket.max_new_tokens),
                                 str(ticket.tenant), ticket.deadline,
                                 adapter_id=getattr(ticket,
-                                                   "adapter_id", None))
+                                                   "adapter_id", None),
+                                trace_id=getattr(ticket,
+                                                 "trace_id", None))
         handle.ticket = ticket
         handle.published = len(ticket.output)
         await self._enqueue_handle(handle)
@@ -326,7 +334,8 @@ class ServingFrontend:
                     handle.req = self.engine.submit(
                         handle.prompt, handle.max_new_tokens,
                         deadline=handle.deadline, tenant=handle.tenant,
-                        adapter_id=handle.adapter_id)
+                        adapter_id=handle.adapter_id,
+                        trace_id=handle.trace_id)
             except ValueError as e:      # oversized / empty prompt /
                 self._finish_handle(handle, e)  # mismatched KV geometry
                 continue

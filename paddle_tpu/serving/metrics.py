@@ -170,6 +170,51 @@ SERVING_SPEC_ROLLBACK_BLOCKS = REGISTRY.counter(
     "paddle_tpu_serving_spec_rollback_blocks_total",
     "KV blocks returned to the free list by draft rollbacks")
 
+# ---- fleet-wide request tracing (serving.tracing, ISSUE 16) ------------
+SERVING_TRACES = REGISTRY.counter(
+    "paddle_tpu_serving_trace_requests_total",
+    "Stitched request traces closed, by terminal outcome",
+    ("outcome",))   # finished|expired|cancelled|error
+SERVING_TRACE_EVENTS = REGISTRY.counter(
+    "paddle_tpu_serving_trace_events_total",
+    "Span events recorded into request traces, by event name",
+    ("event",))
+SERVING_TRACE_EVENTS_DROPPED = REGISTRY.counter(
+    "paddle_tpu_serving_trace_events_dropped_total",
+    "Span events dropped by the per-trace bound "
+    "(PADDLE_TPU_TRACE_EVENTS_MAX) or by trace-table eviction")
+SERVING_TRACE_ACTIVE = REGISTRY.gauge(
+    "paddle_tpu_serving_trace_active",
+    "Open (not yet terminal) request traces — nonzero after a drain "
+    "means orphaned spans")
+SERVING_TRACE_QUEUE_WAIT = REGISTRY.histogram(
+    "paddle_tpu_serving_trace_queue_wait_seconds",
+    "Submit-to-first-admission wait derived at the admission span "
+    "(fresh prefill admissions only: imports and re-prefills after "
+    "preemption do not re-observe)",
+    buckets=_LATENCY_BUCKETS)
+
+# ---- SLO plane (serving.slo, ISSUE 16) ---------------------------------
+SERVING_SLO_TTFT_P95 = REGISTRY.gauge(
+    "paddle_tpu_serving_slo_ttft_p95_seconds",
+    "Sliding-window p95 of submit-to-first-token latency", ("tenant",))
+SERVING_SLO_INTER_TOKEN_P99 = REGISTRY.gauge(
+    "paddle_tpu_serving_slo_inter_token_p99_seconds",
+    "Sliding-window p99 of the inter-token gap", ("tenant",))
+SERVING_SLO_DEADLINE_MISS_RATIO = REGISTRY.gauge(
+    "paddle_tpu_serving_slo_deadline_miss_ratio",
+    "Fraction of requests in the window that expired or finished past "
+    "their deadline", ("tenant",))
+SERVING_SLO_BURN_RATE = REGISTRY.gauge(
+    "paddle_tpu_serving_slo_burn_rate",
+    "measured / target per objective (>1 = the objective is burning)",
+    ("tenant", "objective"))
+SERVING_SLO_BREACHES = REGISTRY.counter(
+    "paddle_tpu_serving_slo_breaches_total",
+    "Edge-triggered objective breaches (ok -> burning transitions "
+    "observed by SLOMonitor.evaluate)",
+    ("tenant", "objective"))
+
 #: every name above, for the smoke-tool contract check
 CONTRACT_METRICS = (
     "paddle_tpu_serving_ttft_seconds",
@@ -222,6 +267,20 @@ CONTRACT_METRICS = (
     "paddle_tpu_moe_dropped_tokens_total",
     "paddle_tpu_moe_expert_utilization",
     "paddle_tpu_moe_aux_loss",
+    # fleet-wide request tracing + SLO plane (ISSUE 16): stitched-trace
+    # outcomes/volume, orphan gauge, span-derived queue wait, and the
+    # per-tenant sliding-window objective gauges the future autoscaler
+    # consumes
+    "paddle_tpu_serving_trace_requests_total",
+    "paddle_tpu_serving_trace_events_total",
+    "paddle_tpu_serving_trace_events_dropped_total",
+    "paddle_tpu_serving_trace_active",
+    "paddle_tpu_serving_trace_queue_wait_seconds",
+    "paddle_tpu_serving_slo_ttft_p95_seconds",
+    "paddle_tpu_serving_slo_inter_token_p99_seconds",
+    "paddle_tpu_serving_slo_deadline_miss_ratio",
+    "paddle_tpu_serving_slo_burn_rate",
+    "paddle_tpu_serving_slo_breaches_total",
     # trace-discipline guards (ISSUE 12): compile-budget violations +
     # transfer-guard trips observed by analysis.guards.sanitize — the
     # serving one-compile contract's runtime tripwire

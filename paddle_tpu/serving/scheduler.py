@@ -41,6 +41,7 @@ import time
 from typing import Optional
 
 from . import batcher
+from . import tracing as _tracing
 
 
 @dataclasses.dataclass(eq=False)   # identity semantics: requests live
@@ -70,6 +71,10 @@ class Request:                     # in sets/queues across state moves
     # streamed ahead so extraction ships only the tail
     ticket: Optional[object] = None
     shipped_blocks: int = 0
+    # fleet-wide request tracing (serving.tracing, ISSUE 16): minted at
+    # router dispatch and carried across migrations via the ticket so
+    # one stitched trace covers every replica the request touched
+    trace_id: Optional[str] = None
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -130,10 +135,14 @@ class Scheduler:
         # budgets must treat it as spoken for exactly like the
         # speculative verify region
         self.reserve_region = bool(reserve_region)
+        # replica label the tracing hooks stamp on span events; the
+        # owning engine overwrites it with its own name
+        self.replica = None
 
     # ---------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
-               deadline=None, tenant="default", adapter_id=None):
+               deadline=None, tenant="default", adapter_id=None,
+               trace_id=None):
         total = len(prompt) + max_new_tokens - 1  # last token never fed
         if total > self.kv.max_slot_tokens:
             raise ValueError(
@@ -147,8 +156,10 @@ class Scheduler:
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=eos_token_id, deadline=deadline,
                       arrival=now, submit_time=now, tenant=str(tenant),
-                      adapter_id=adapter_id)
+                      adapter_id=adapter_id, trace_id=trace_id)
         self.queue.append(req)
+        if _tracing._enabled:
+            _tracing.on_submit(req, self.replica)
         return req
 
     def submit_migrated(self, ticket):
@@ -176,9 +187,12 @@ class Scheduler:
                       cache_hit_tokens=int(ticket.cache_hit_tokens),
                       preemptions=int(ticket.preemptions),
                       ticket=ticket,
-                      adapter_id=getattr(ticket, "adapter_id", None))
+                      adapter_id=getattr(ticket, "adapter_id", None),
+                      trace_id=getattr(ticket, "trace_id", None))
         req.first_token_time = ticket.first_token_time
         self.queue.appendleft(req)
+        if _tracing._enabled:
+            _tracing.on_submit_migrated(req, self.replica, ts=now)
         return req
 
     @property
@@ -219,6 +233,10 @@ class Scheduler:
                 req.state = "expired"
                 req.finish_time = now
                 expired.append(req)
+        if _tracing._enabled:
+            for req in expired:
+                _tracing.on_terminal(req, "expired", self.replica,
+                                     ts=now)
         return expired
 
     def _acquire_adapter(self, req):
@@ -270,6 +288,10 @@ class Scheduler:
                     req.fed = len(req.runtime_prompt)
                     req.ticket = None          # payload consumed
                     self.slots[slot] = req
+                    if _tracing._enabled:
+                        _tracing.on_admitted(req, self.replica,
+                                             kind="import",
+                                             ts=self.clock())
                     continue
                 if not self._acquire_adapter(self.queue[0]):
                     break
@@ -278,6 +300,16 @@ class Scheduler:
                 req.state = "prefill"
                 req.fed = 0
                 self.slots[slot] = req
+                if _tracing._enabled:
+                    # a re-prefill resumes a preempted sequence (its
+                    # generated prefix folds into the prompt) — a
+                    # distinct span kind so queue-wait is observed
+                    # only on the FIRST admission
+                    kind = ("re_prefill"
+                            if (req.output or req.preemptions)
+                            else "prefill")
+                    _tracing.on_admitted(req, self.replica, kind=kind,
+                                         ts=self.clock())
                 if self.prefix_cache is not None \
                         and req.adapter_id is None:
                     # cached prompt head: adopt the shared blocks, mark
@@ -313,6 +345,9 @@ class Scheduler:
         victim.preemptions += 1
         self.preemption_count += 1
         self.queue.appendleft(victim)
+        if _tracing._enabled:
+            _tracing.on_preempted(victim, self.replica,
+                                  ts=self.clock())
         return victim
 
     # ------------------------------------------------- speculative draft
@@ -452,6 +487,9 @@ class Scheduler:
             self.prefix_cache.insert(req.slot,
                                      (req.prompt + req.output)[:n])
         self._free_slot(req)
+        if _tracing._enabled:
+            _tracing.on_terminal(req, "finished", self.replica,
+                                 ts=req.finish_time)
 
     def extract(self, req, now=None):
         """Release a resident request that is migrating away: its slot,
@@ -483,4 +521,7 @@ class Scheduler:
             self._free_slot(req)
         req.state = "cancelled"
         req.finish_time = self.clock() if now is None else now
+        if _tracing._enabled:
+            _tracing.on_terminal(req, "cancelled", self.replica,
+                                 ts=req.finish_time)
         return True

@@ -37,6 +37,16 @@ EXPECTED_METRICS = (
     # and the smoke tools' sanitize() wrappers
     "paddle_tpu_compile_watchdog_budget_exceeded_total",
     "paddle_tpu_compile_watchdog_transfer_guard_trips_total",
+    # Request tracing + SLO plane (ISSUE 16): registered by importing
+    # serving.metrics (tracing/slo mirror into these); activity is
+    # exercised by tools/trace_smoke.py and tests/test_tracing.py.
+    # CONTRACT_METRICS below greps the full set; these are the
+    # representative names pinned here so a contract-table edit cannot
+    # silently drop the observability plane from this dump.
+    "paddle_tpu_serving_trace_requests_total",
+    "paddle_tpu_serving_trace_events_total",
+    "paddle_tpu_serving_slo_ttft_p95_seconds",
+    "paddle_tpu_serving_slo_breaches_total",
 )
 
 

@@ -1,0 +1,308 @@
+"""Fleet-wide request-trace smoke run + contract check (ISSUE 16).
+
+CI contract (tests/test_tracing.py runs this in-process, the same way
+tests/test_disagg.py runs tools/disagg_smoke.py):
+
+* **One stitched trace per request** — a Poisson stream through a
+  1-prefill + 2-decode `ReplicaRouter` fleet where EVERY request is
+  force-migrated (prefill handoff) and at least one shed migration
+  completes. Each request must yield exactly ONE trace whose events
+  span the prefill replica, the transport hop and a decode replica,
+  with monotone timestamps and a terminal "finished" outcome.
+* **Span/histogram agreement** — the span-derived TTFT and queue-wait
+  of every trace must aggregate to the SAME count/sum the registry
+  histograms recorded (tracing reuses the emit-time numbers, so the
+  match is exact, not approximate).
+* **Zero orphans after drain** — once the stream drains, no trace may
+  remain open and every replica must hold zero slots/blocks.
+* **SLO plane** — a monitor with a deliberately impossible TTFT target
+  on one tenant must fire exactly one edge-triggered breach (and its
+  callback), while the sane tenants stay ok.
+* **Metric contract** — every serving metric name in
+  `serving.metrics.CONTRACT_METRICS` must appear in the Prometheus
+  dump, with real activity on the trace/SLO counters; the whole run
+  sits under `guards.sanitize()` so a tracing-induced recompile or
+  device transfer fails the smoke.
+
+Exit status is non-zero on any violation.
+
+Usage: JAX_PLATFORMS=cpu python tools/trace_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_REQUESTS = 8
+MAX_NEW = 16
+BREACH_TENANT = "tenant1"
+
+
+def _workload(vocab=193):
+    """Deterministic Poisson stream, the disagg_smoke shape: shared
+    12-token head on half the prompts, three tenants round-robin."""
+    import random
+
+    import numpy as np
+    rng = np.random.RandomState(7)
+    head = rng.randint(1, vocab, 12).tolist()
+    gaps = random.Random(3)
+    t, events = 0.0, []
+    for i in range(N_REQUESTS):
+        t += 0.01 + min(gaps.expovariate(40.0), 0.15)
+        tail = rng.randint(1, vocab, int(rng.randint(4, 14))).tolist()
+        prompt = (head + tail) if i % 2 == 0 else tail
+        events.append((t, f"tenant{i % 3}", prompt))
+    return events
+
+
+def _fleet(model):
+    """1 prefill + 2 decode replicas, NAMED so trace events carry
+    readable replica ids; mixed steps warmed BEFORE tracing/metrics
+    turn on so histogram counts equal trace counts exactly."""
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.frontend import ServingFrontend
+    pre = ServingEngine(model, max_slots=3, block_size=4,
+                        max_seq_len=64, cache_dtype="float32", seed=0,
+                        kv_dtype="int8", role="prefill",
+                        prefix_caching=True, name="pre0")
+    decs = [ServingEngine(model, max_slots=3, block_size=4,
+                          max_seq_len=64, cache_dtype="float32",
+                          seed=0, kv_dtype="int8", role="decode",
+                          draft_k=2, name=f"dec{i}")
+            for i in range(2)]
+    for eng in [pre] + decs:
+        eng.generate_batch([[7, 7]], max_new_tokens=1)   # warm compile
+    return [ServingFrontend(e, max_pending=16) for e in [pre] + decs]
+
+
+def run_smoke():
+    import asyncio
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving import slo, tracing
+    from paddle_tpu.serving.distributed import ReplicaRouter
+
+    paddle.seed(1234)
+    model = GPTForGeneration(vocab_size=193, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+    model.eval()
+    events = _workload()
+    failures = []
+
+    # warm compiles happen here, with recording OFF
+    fes = _fleet(model)
+    router = ReplicaRouter(fes, roles=["prefill", "decode", "decode"],
+                           probe_interval=0.02)
+
+    # recording ON only now: every histogram observation from here has
+    # a span twin, so counts must match exactly
+    pm.enable()
+    tracing.TRACER.reset()
+    monitor = slo.SLOMonitor({
+        # relaxed defaults: the CPU harness is slow, and this smoke
+        # asserts the PLUMBING (exactly one engineered breach), not
+        # production latency targets
+        "default": {"ttft_p95": 30.0, "inter_token_p99": 30.0,
+                    "deadline_miss_rate": 0.5},
+        "tenants": {BREACH_TENANT: {"ttft_p95": 1e-9}},  # must breach
+    }).attach()                                  # attach() enables tracing
+    breach_log = []
+    monitor.on_breach(lambda tenant, obj, burn, value, target:
+                      breach_log.append((tenant, obj)))
+
+    async def run():
+        async def fire(ev, t0):
+            t, tenant, prompt = ev
+            delay = t - (asyncio.get_event_loop().time() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return await router.submit(prompt, max_new_tokens=MAX_NEW,
+                                       tenant=tenant)
+
+        async def shed_once(t0):
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                busiest = max((1, 2), key=router.queue_depth)
+                if router.shed(busiest, 1):
+                    return
+
+        async with router:
+            t0 = asyncio.get_event_loop().time()
+            outs, _ = await asyncio.gather(
+                asyncio.gather(*[fire(ev, t0) for ev in events]),
+                shed_once(t0))
+        return outs
+
+    outs = asyncio.run(run())
+    if any(not o for o in outs):
+        failures.append("some request produced no tokens")
+
+    # ---- one stitched trace per request, spanning the migration
+    traces = tracing.TRACER.traces()
+    if len(traces) != N_REQUESTS:
+        failures.append(f"expected exactly {N_REQUESTS} traces, "
+                        f"got {len(traces)}")
+    orphans = tracing.TRACER.active()
+    if orphans:
+        failures.append(f"{len(orphans)} orphan (open) trace(s) after "
+                        f"drain: {[t.trace_id for t in orphans]}")
+    derived = []
+    for tr in traces:
+        names = [e.name for e in tr.events]
+        if tr.outcome != "finished":
+            failures.append(f"{tr.trace_id}: outcome {tr.outcome!r}")
+        if not tr.monotone():
+            failures.append(f"{tr.trace_id}: non-monotone timestamps")
+        if tr.dropped_events:
+            failures.append(f"{tr.trace_id}: dropped "
+                            f"{tr.dropped_events} events")
+        for needed in ("dispatched", "enqueued", "admitted",
+                       "first_token", "handoff_export",
+                       "migration_transport", "decode_admission",
+                       "finished"):
+            if needed not in names:
+                failures.append(f"{tr.trace_id}: missing {needed!r} "
+                                f"(events: {names})")
+        # the stitch: source engine + destination engine both appear
+        engines = [r for r in tr.replicas if "->" not in r]
+        if len(engines) < 2:
+            failures.append(f"{tr.trace_id}: events from "
+                            f"{tr.replicas}, expected both sides of "
+                            "the migration")
+        d = tr.derive()
+        if d["ttft"] is None or d["queue_wait"] is None:
+            failures.append(f"{tr.trace_id}: TTFT/queue-wait not "
+                            "derivable from spans")
+        else:
+            derived.append(d)
+
+    # ---- span-derived latencies == registry histograms, exactly
+    from paddle_tpu.serving import metrics as sm
+    if sm.SERVING_TTFT_SECONDS.count != N_REQUESTS:
+        failures.append(f"TTFT histogram count "
+                        f"{sm.SERVING_TTFT_SECONDS.count} != "
+                        f"{N_REQUESTS}")
+    span_ttft = sum(d["ttft"] for d in derived)
+    if derived and abs(sm.SERVING_TTFT_SECONDS.sum - span_ttft) > 1e-6:
+        failures.append(f"TTFT histogram sum "
+                        f"{sm.SERVING_TTFT_SECONDS.sum:.6f} != "
+                        f"span-derived {span_ttft:.6f}")
+    n_gaps = sum(len(d["inter_token"]) for d in derived)
+    if sm.SERVING_INTER_TOKEN_SECONDS.count != n_gaps:
+        failures.append(f"inter-token histogram count "
+                        f"{sm.SERVING_INTER_TOKEN_SECONDS.count} != "
+                        f"{n_gaps} span gaps")
+    if sm.SERVING_TRACE_QUEUE_WAIT.count != N_REQUESTS:
+        failures.append(f"queue-wait histogram count "
+                        f"{sm.SERVING_TRACE_QUEUE_WAIT.count} != "
+                        f"{N_REQUESTS}")
+
+    # ---- flight recorders saw every traced step
+    flights = {r.engine_name: r for r in tracing.flight_recorders()}
+    for fe in fes:
+        rec = flights.get(fe.engine.name)
+        if rec is None or rec.steps == 0:
+            failures.append(f"no flight records for {fe.engine.name}")
+
+    # ---- SLO plane: impossible tenant burns, sane tenants stay ok
+    report = monitor.evaluate()
+    bad = report.get(BREACH_TENANT, {}).get("ttft_p95")
+    if not bad or bad["ok"]:
+        failures.append(f"{BREACH_TENANT} ttft_p95=1e-9 did not "
+                        f"breach: {bad}")
+    if (BREACH_TENANT, "ttft_p95") not in breach_log:
+        failures.append("breach callback never fired")
+    if monitor.evaluate() and breach_log.count(
+            (BREACH_TENANT, "ttft_p95")) != 1:
+        failures.append("breach callback is not edge-triggered "
+                        f"({breach_log})")
+    for tenant, entry in report.items():
+        if tenant == BREACH_TENANT:
+            continue
+        for obj, r in entry.items():
+            if not r["ok"]:
+                failures.append(f"unexpected SLO breach: "
+                                f"{tenant}/{obj} = {r}")
+
+    # ---- drain hygiene
+    for i, fe in enumerate(fes):
+        eng = fe.engine
+        if eng.scheduler.num_active or eng.scheduler.queue:
+            failures.append(f"replica {eng.name} not drained")
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.evict_all()
+        if eng.kv.blocks_in_use != 0:
+            failures.append(f"replica {eng.name} leaked "
+                            f"{eng.kv.blocks_in_use} KV blocks")
+        if not eng.kv.allocator.invariant_ok:
+            failures.append(f"replica {eng.name} allocator corrupt")
+
+    monitor.detach()
+    stats = {
+        "traces": len(traces),
+        "events": sum(len(t.events) for t in traces),
+        "span_ttft_mean_ms": (span_ttft / len(derived) * 1e3
+                              if derived else 0.0),
+        "sheds": router.stats()["migrations"]["shed"],
+        "breaches": monitor.breaches,
+    }
+    return stats, failures
+
+
+def main():
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.metrics import CONTRACT_METRICS
+
+    # runtime sanitizers (ISSUE 12): the tracing/SLO plane must not add
+    # a single compile or device transfer to the serving hot path
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        stats, failures = run_smoke()
+    failures += [f"compile watchdog: {v}" for v in wd.violations]
+    text = pm.REGISTRY.to_prometheus()
+    print(text)
+    for name in CONTRACT_METRICS:
+        if name not in text:
+            failures.append(f"MISSING serving metric: {name}")
+    from paddle_tpu.serving import metrics as sm
+    outcomes = dict(sm.SERVING_TRACES.samples())
+    fin = outcomes.get(("finished",))
+    if not fin or fin.value != N_REQUESTS:
+        failures.append(
+            f"trace_requests_total{{finished}} != {N_REQUESTS} "
+            f"(saw {[(k, c.value) for k, c in outcomes.items()]})")
+    ev_names = {lv[0] for lv, _c in sm.SERVING_TRACE_EVENTS.samples()}
+    for needed in ("enqueued", "first_token", "migration_transport"):
+        if needed not in ev_names:
+            failures.append(f"trace_events_total recorded no "
+                            f"{needed!r} events (saw "
+                            f"{sorted(ev_names)})")
+    breaches = dict(sm.SERVING_SLO_BREACHES.samples())
+    if not any(c.value > 0 for c in breaches.values()):
+        failures.append("slo_breaches_total recorded nothing")
+    if sm.SERVING_TRACE_ACTIVE.value != 0:
+        failures.append(f"trace_active gauge nonzero after drain: "
+                        f"{sm.SERVING_TRACE_ACTIVE.value}")
+    from paddle_tpu.serving import tracing
+    tracing.disable()
+    tracing.TRACER.reset()
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAILURE: {f}", file=sys.stderr)
+        return 1
+    print(f"trace smoke OK: {stats['traces']} stitched traces / "
+          f"{stats['events']} events, span TTFT mean "
+          f"{stats['span_ttft_mean_ms']:.2f} ms, "
+          f"{stats['sheds']} shed migration(s), "
+          f"{stats['breaches']} SLO breach(es)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
