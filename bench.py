@@ -1162,6 +1162,161 @@ def bench_serving_long_context():
     }
 
 
+def bench_serving_fleet_ops():
+    """Fleet control plane extra (ISSUE 17, every platform): cold-start
+    seconds from ONE exported bundle for jit-boot vs AOT-boot vs
+    AOT+warm-prefix (engine construction through the first
+    shared-prefix batch), aggregate tokens/sec through a live
+    rolling-upgrade window on a 2-replica fleet (every mid-stream
+    request must land on exactly the old or the new checkpoint, never
+    a token mix), and autoscaler reaction: simulated burn-to-decision
+    seconds (the sustain_s hysteresis floor) plus the real wall
+    seconds the applied AOT scale-up boot costs."""
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+    import time as _time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.serving.distributed import ReplicaRouter
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.fleet import (AutoscalerPolicy, FleetBundle,
+                                          FleetController, SLOAutoscaler,
+                                          boot_engine_from_bundle,
+                                          export_bundle,
+                                          weights_from_model)
+    from paddle_tpu.serving.frontend import ServingFrontend
+    from paddle_tpu.serving.slo import SLOMonitor
+
+    rng = np.random.RandomState(3)
+    V, T_new, N = 193, 8, 12
+    kw = dict(max_slots=4, block_size=4, num_blocks=64, max_seq_len=64,
+              token_budget=64, cache_dtype="float32", seed=0,
+              prefix_caching=True)
+
+    def _model(seed):
+        paddle.seed(seed)
+        m = GPTForGeneration(vocab_size=V, hidden_size=32, num_layers=2,
+                             num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+        m.eval()
+        return m
+
+    head = rng.randint(1, V, 12).tolist()
+    prompts = [head + rng.randint(1, V, int(n)).tolist()
+               for n in rng.randint(3, 9, N)]
+
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_bench_fleet_")
+    try:
+        exporter = ServingEngine(_model(1234), name="exporter", **kw)
+        exporter.generate_batch(prompts[:4], max_new_tokens=T_new)
+        bundle = FleetBundle(export_bundle(exporter, tmp, version="v1"))
+        spill = os.path.join(tmp, "prefix.pkl")
+        exporter.close(spill_prefix=spill)
+
+        def _boot(kind):
+            t0 = _time.perf_counter()
+            if kind == "jit":
+                eng = boot_engine_from_bundle(bundle, aot=False,
+                                              name="b_jit")
+            elif kind == "aot":
+                eng = boot_engine_from_bundle(bundle, name="b_aot")
+            else:
+                eng = boot_engine_from_bundle(bundle, warm_prefix=spill,
+                                              name="b_warm")
+            eng.generate_batch(prompts[:2], max_new_tokens=1)
+            return eng, _time.perf_counter() - t0
+
+        jit_eng, jit_s = _boot("jit")
+        aot_eng, aot_s = _boot("aot")
+        warm_eng, warm_s = _boot("warm")
+        aot_eng.close()
+        warm_eng.close()
+
+        # v1/v2 greedy references from the already-booted jit engine:
+        # a mid-upgrade request is valid iff its tokens match exactly
+        # one of the two (version purity, never a mix)
+        w2 = weights_from_model(_model(777))
+        ref1 = jit_eng.generate_batch(prompts, max_new_tokens=T_new)
+        jit_eng.swap_weights(w2, "v2")
+        ref2 = jit_eng.generate_batch(prompts, max_new_tokens=T_new)
+        jit_eng.close()
+
+        fes = [ServingFrontend(
+            boot_engine_from_bundle(bundle, name=f"fleet{i}"),
+            max_pending=32) for i in range(2)]
+        router = ReplicaRouter(fes, probe_interval=0.02)
+        ctl = FleetController(router, bundle, spill_dir=tmp)
+
+        clk = [1000.0]
+        monitor = SLOMonitor({"default": {"ttft_p95": 0.1},
+                              "window_s": 30.0}, clock=lambda: clk[0])
+        scaler = SLOAutoscaler(
+            ctl, monitor, clock=lambda: clk[0],
+            policy=AutoscalerPolicy(min_replicas=2, max_replicas=3,
+                                    sustain_s=1.0, recovery_s=2.0,
+                                    cooldown_s=3.0))
+
+        async def drive():
+            async with router:
+                t0 = _time.perf_counter()
+                tasks = [asyncio.create_task(
+                    router.submit(list(p), max_new_tokens=T_new))
+                    for p in prompts]
+                await asyncio.sleep(0.01)
+                flipped = await ctl.rolling_upgrade(w2, "v2")
+                outs = await asyncio.gather(*tasks)
+                wall = _time.perf_counter() - t0
+
+                # engineered burn: advance the fake clock until the
+                # sustained-burn decision fires, then time the real
+                # AOT boot the applied scale-up performs
+                burn_t0 = clk[0]
+                d = None
+                while d is None and clk[0] - burn_t0 < 10.0:
+                    monitor.on_ttft("t", 5.0, clk[0])
+                    s0 = _time.perf_counter()
+                    d = await scaler.step()
+                    boot_wall = _time.perf_counter() - s0
+                    clk[0] += 0.25
+                return flipped, outs, wall, d, burn_t0, boot_wall
+
+        flipped, outs, wall, d, burn_t0, boot_wall = asyncio.run(drive())
+        served = sum(len(o) for o in outs)
+        n_v2 = sum(o == r2 for o, r2 in zip(outs, ref2))
+        pure = all(o in (r1, r2)
+                   for o, r1, r2 in zip(outs, ref1, ref2))
+        return {
+            "metric": "serving_fleet_ops",
+            "value": round(served / wall, 1), "unit": "tokens/sec",
+            "cold_start_seconds": {
+                "jit_boot": round(jit_s, 2),
+                "aot_boot": round(aot_s, 2),
+                "aot_warm_prefix": round(warm_s, 2),
+            },
+            "aot_boot_speedup": round(jit_s / max(aot_s, 1e-9), 2),
+            "upgrade": {
+                "replicas": 2, "requests": N,
+                "served_tokens": int(served),
+                "flipped": list(flipped),
+                "on_new_version": int(n_v2),
+                "version_pure_outputs": bool(pure),
+            },
+            "autoscaler": {
+                "reaction_seconds_simulated": round(
+                    (d["ts"] - burn_t0) if d else -1.0, 2),
+                "sustain_s": 1.0,
+                "scale_up_boot_wall_seconds": round(boot_wall, 2),
+                "replicas_after": len(ctl.active_replicas()),
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_gpt_moe(on_tpu):
     """ISSUE 10 extra: the MoE GPT lane — hybrid-trainer tokens/sec
     (top-k capacity router, fixed [E, C, d] dispatch einsums) and MoE
@@ -1576,6 +1731,20 @@ def main():
         result["extras"].append(
             {"metric": "serving_multi_lora",
              "error": f"{type(e).__name__}: {e}"})
+
+    # fleet control plane lane (ISSUE 17): every-platform — jit vs AOT
+    # vs AOT+warm-prefix cold-start seconds from one bundle, tokens/sec
+    # through a live rolling-upgrade window, autoscaler reaction record
+    if _budget_left() > 120:
+        try:
+            result["extras"].append(bench_serving_fleet_ops())
+        except Exception as e:  # noqa: BLE001
+            result["extras"].append(
+                {"metric": "serving_fleet_ops",
+                 "error": f"{type(e).__name__}: {e}"})
+    else:
+        result["extras"].append(
+            {"metric": "serving_fleet_ops", "skipped": "time budget"})
 
     # long-context lane (ISSUE 15): 8k-token prompts migrated onto
     # decode-role engines — dense vs block-sparse decode tok/s +

@@ -60,6 +60,9 @@ DEFAULT_BUDGETS: Dict[str, int] = {
     # one fixed-shape slot write per AdapterCache — every LoRA load/
     # evict-reload reuses it (tools/lora_smoke.py's contract)
     "serving_adapter_load": 1,
+    # one fixed-shape checkpoint cast per engine — every rolling-
+    # upgrade flip reuses it (tools/fleet_smoke.py's contract)
+    "serving_weight_swap": 1,
 }
 
 _id_counter = itertools.count(1)

@@ -392,3 +392,37 @@ def create_serving_frontend(config: Config, model, sampling=None,
     if config._max_pending is not None:
         kw["max_pending"] = int(config._max_pending)
     return ServingFrontend(engine, **kw)
+
+
+def create_fleet_controller(config: Config, model, sampling=None,
+                            seed=0, *, bundle=None, bundle_root=None,
+                            version="v1", spill_dir=None,
+                            export=True):
+    """Build the fleet control plane (ISSUE 17) over a
+    `create_serving_router` fleet: a `serving.fleet.FleetController`
+    that can AOT-boot replicas from a versioned bundle with zero
+    mixed-step compiles, roll weight upgrades through the router's
+    quiesce plane, and actuate the SLO autoscaler's decisions.
+
+    `bundle` names an existing bundle directory (or passes a loaded
+    `FleetBundle`); otherwise, with `export=True`, a bundle for
+    `version` is exported under `bundle_root` (default: next to the
+    persistent kernel-autotune cache) from replica 0's engine.
+    Returns `(router, controller)` — boot the fleet with
+    `async with router:`, then drive `controller.boot_replica()` /
+    `rolling_upgrade()` / an attached `SLOAutoscaler`
+    (docs/DEPLOYMENT.md)."""
+    from .serving.fleet import (FleetBundle, FleetController,
+                                export_bundle)
+    router = create_serving_router(config, model, sampling=sampling,
+                                   seed=seed)
+    if bundle is None and export:
+        bdir = export_bundle(router.frontends[0].engine,
+                             bundle_root, version=str(version),
+                             seed=seed)
+        bundle = FleetBundle(bdir)
+    kw = {}
+    if config._max_pending is not None:
+        kw["max_pending"] = int(config._max_pending)
+    return router, FleetController(router, bundle,
+                                   spill_dir=spill_dir, **kw)

@@ -8,8 +8,11 @@ queue (`batcher`), serving metrics (`metrics`), the single-compile
 mixed-step `ServingEngine` (`engine`), the asyncio multi-tenant
 ingress `ServingFrontend` (`frontend`), and the distributed layer
 (`distributed`): the tensor-parallel `TPServingEngine` and the
-multi-replica prefix-affinity `ReplicaRouter`. See docs/SERVING.md
-for the slot protocol, prefix-cache and distributed semantics.
+multi-replica prefix-affinity `ReplicaRouter`, plus the fleet
+control plane (`fleet`): versioned AOT boot bundles, rolling weight
+upgrades and the SLO-burn autoscaler. See docs/SERVING.md for the
+slot protocol, prefix-cache and distributed semantics and
+docs/DEPLOYMENT.md for the fleet lifecycle.
 
 `engine`/`frontend` (and their model deps) load lazily so the light
 modules here can be imported from `incubate/nn/generation.py` without
@@ -36,8 +39,9 @@ __all__ = [
     "Scheduler", "ServingEngine", "ServingFrontend", "FairQueue",
     "RadixPrefixCache", "AdapterCache", "adapters", "batcher",
     "kv_cache", "metrics", "scheduler",
-    "prefix_cache", "engine", "frontend", "distributed",
-    "TPServingEngine", "ReplicaRouter",
+    "prefix_cache", "engine", "frontend", "distributed", "fleet",
+    "sparse_budget", "TPServingEngine", "ReplicaRouter",
+    "FleetController",
     "tracing", "slo", "RequestTracer", "StepFlightRecorder",
     "SLOConfig", "SLOMonitor",
 ]
@@ -50,6 +54,9 @@ _LAZY = {
     "distributed": ("distributed", None),
     "TPServingEngine": ("distributed", "TPServingEngine"),
     "ReplicaRouter": ("distributed", "ReplicaRouter"),
+    "fleet": ("fleet", None),
+    "FleetController": ("fleet", "FleetController"),
+    "sparse_budget": ("sparse_budget", None),
 }
 
 

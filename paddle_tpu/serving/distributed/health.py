@@ -38,6 +38,17 @@ class ReplicaHealth:
     def __len__(self):
         return len(self.frontends)
 
+    def add(self, frontend):
+        """Track one more replica (fleet scale-up, ISSUE 17): indices
+        are append-only — a retired replica keeps its slot marked down
+        forever, so in-flight streams' down-event watchers stay valid.
+        Returns the new replica's index."""
+        self.frontends.append(frontend)
+        self._down.append(False)
+        self._events.append(None)
+        self._export()
+        return len(self.frontends) - 1
+
     def probe(self, i):
         """True when replica `i`'s step loop is running right now."""
         self.probes += 1

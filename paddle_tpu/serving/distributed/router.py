@@ -315,6 +315,12 @@ class ReplicaRouter:
         self.clock = self.frontends[0].engine.clock
         self.probe_interval = float(probe_interval)
         self._inflight = [0] * len(self.frontends)
+        # quiesced replicas (fleet drain, ISSUE 17): excluded from NEW
+        # dispatch/placement decisions but NOT marked down — in-flight
+        # requests keep streaming to completion on their old replica
+        # (mark_down would fire the down event and force a failover,
+        # which is exactly what a graceful drain must not do)
+        self._quiesced = set()
         self._rr = itertools.count()
         self._rr_decode = itertools.count()
         self._mseq = itertools.count()
@@ -416,11 +422,11 @@ class ReplicaRouter:
         full ladder decides and the landing replica loads the adapter
         cold at admission."""
         live = [i for i in self._dispatch_targets
-                if self.health.alive(i)]
+                if i not in self._quiesced and self.health.alive(i)]
         if not live:
             raise NoReplicaAvailable(
                 f"all {len(self._dispatch_targets)} prompt-dispatch "
-                "replicas are down")
+                "replicas are down or quiesced")
         self.dispatches += 1
         if self.policy == "round_robin":
             idx = live[next(self._rr) % len(live)]
@@ -466,7 +472,8 @@ class ReplicaRouter:
         least-loaded otherwise. Raises NoReplicaAvailable when no
         decode-capable replica (outside `exclude`) is up."""
         live = [i for i in self._decode_targets
-                if i not in exclude and self.health.alive(i)]
+                if i not in exclude and i not in self._quiesced
+                and self.health.alive(i)]
         if not live:
             raise NoReplicaAvailable(
                 "no decode-capable replica available "
@@ -495,7 +502,8 @@ class ReplicaRouter:
         requests flagged."""
         if not self.migration:
             return 0
-        live = [i for i in self._decode_targets if self.health.alive(i)]
+        live = [i for i in self._decode_targets
+                if i not in self._quiesced and self.health.alive(i)]
         if len(live) < 2:
             return 0
         depths = {i: self.queue_depth(i) for i in live}
@@ -504,6 +512,67 @@ class ReplicaRouter:
         if depths[hi] - depths[lo] < self.migration["imbalance"]:
             return 0
         return self.frontends[hi].shed(self.migration["max_per_tick"])
+
+    # --------------------------------------- fleet lifecycle (ISSUE 17)
+    def quiesce(self, idx):
+        """Exclude replica `idx` from NEW dispatch/placement decisions
+        while its in-flight requests stream to completion — the
+        graceful half of a drain (health stays up; `mark_down` would
+        failover the very requests a drain promises to finish)."""
+        self._quiesced.add(idx)
+
+    def unquiesce(self, idx):
+        """Return a quiesced replica to rotation (upgrade flip done)."""
+        self._quiesced.discard(idx)
+
+    def is_drained(self, idx):
+        """True when a quiesced replica holds NO work anywhere on its
+        path: no router dispatches in flight, nothing in its
+        frontend's fair queue or live set, and an idle engine."""
+        fe = self.frontends[idx]
+        sch = fe.engine.scheduler
+        return (self._inflight[idx] == 0 and len(fe._fair) == 0
+                and not fe._live and not sch.has_work)
+
+    async def add_replica(self, frontend, role="mixed"):
+        """Append one replica to the running fleet (fleet scale-up /
+        rolling replacement). Indices are append-only — retirement
+        quiesces + stops a replica but never reindexes, so in-flight
+        streams and metric labels stay coherent. Validates the same
+        invariants as construction (block size, role pairing, KV
+        geometry for migrating fleets); starts the frontend when the
+        router is already running. Returns the new index."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        if frontend.engine.block_size != \
+                self.frontends[0].engine.block_size:
+            raise ValueError(
+                f"replica block_size {frontend.engine.block_size} != "
+                f"fleet's {self.frontends[0].engine.block_size}")
+        er = getattr(frontend.engine, "role", "mixed")
+        if (role == "prefill") != (er == "prefill"):
+            raise ValueError(
+                f"router role {role!r} but engine role {er!r}")
+        if self.disagg or self.migration:
+            meta = tuple(sorted(frontend.engine.kv.kv_meta().items()))
+            have = tuple(sorted(
+                self.frontends[0].engine.kv.kv_meta().items()))
+            if meta != have:
+                raise ValueError(
+                    "migration needs identical KV geometry on every "
+                    "replica — new replica's kv_meta differs")
+        idx = len(self.frontends)
+        self.frontends.append(frontend)
+        self.roles.append(str(role))
+        self._inflight.append(0)
+        if role in ("prefill", "mixed"):
+            self._dispatch_targets.append(idx)
+        if role in ("decode", "mixed"):
+            self._decode_targets.append(idx)
+        self.health.add(frontend)
+        if self._prober is not None:
+            await frontend.start()
+        return idx
 
     async def _balance_loop(self):
         while True:
@@ -641,7 +710,8 @@ class ReplicaRouter:
                 if _tracing._enabled:
                     _tracing.TRACER.event(trace_id, "dispatched",
                                           replica=self._rname(idx),
-                                          role="mixed", tenant=tenant)
+                                          role="mixed", tenant=tenant,
+                                          version=self._version(idx))
                 remaining = self._remaining(idx, deadline)
                 on_admitted, release = self._hold(idx)
                 attempt_out = []
@@ -744,7 +814,8 @@ class ReplicaRouter:
                     _tracing.TRACER.event(trace_id, "dispatched",
                                           replica=self._rname(pidx),
                                           role=self.roles[pidx],
-                                          tenant=tenant)
+                                          tenant=tenant,
+                                          version=self._version(pidx))
                 on_blocks = None
                 didx = key = None
                 if self.roles[pidx] == "prefill":
@@ -838,7 +909,8 @@ class ReplicaRouter:
                         _tracing.TRACER.event(trace_id, "dispatched",
                                               replica=self._rname(didx),
                                               role="decode",
-                                              tenant=tenant)
+                                              tenant=tenant,
+                                              version=self._version(didx))
                     # placement bookkeeping: the KV now lives on didx
                     history = (list(assembled.prompt)
                                + list(assembled.output))
@@ -969,9 +1041,17 @@ class ReplicaRouter:
                 t.cancel()
 
     # ------------------------------------------------------------ helpers
+    def _version(self, idx):
+        """Replica `idx`'s checkpoint version label (ISSUE 17: rides
+        router_requests_total and the dispatch trace spans, so a
+        rolling upgrade is observable as the label migrating)."""
+        return getattr(self.frontends[idx].engine, "weights_version",
+                       "v0")
+
     def _count(self, idx, outcome):
         if _pmetrics._enabled:
-            smetrics.ROUTER_REQUESTS.labels(str(idx), outcome).inc()
+            smetrics.ROUTER_REQUESTS.labels(
+                str(idx), outcome, self._version(idx)).inc()
 
     def stats(self):
         """Router-side counters (always on, registry-independent)."""
@@ -980,6 +1060,9 @@ class ReplicaRouter:
                "adapter_affinity_hits": self.adapter_affinity_hits,
                "failovers": self.failovers,
                "roles": list(self.roles),
+               "quiesced": sorted(self._quiesced),
+               "versions": [self._version(i)
+                            for i in range(len(self.frontends))],
                "migrations": dict(self.migrations),
                "role_dispatches": dict(self.role_dispatches),
                "health": self.health.snapshot(),

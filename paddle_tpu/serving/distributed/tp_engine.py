@@ -240,6 +240,42 @@ class TPServingEngine(ServingEngine):
             self.adapters.prepare = _prepare
             self.adapters.place = _place_adapters
 
+    # ------------------------------------------------- fleet weight swap
+    def _prep_swap_arrays(self, arrays):
+        """TP staging for `swap_weights` (ISSUE 17): the canonical
+        model-order checkpoint gets the SAME host-side shard-major QKV
+        permute `_shard_state` applies, so a plain "mp" split of the
+        swapped arrays is still a head split. Shapes are unchanged —
+        the shape gate in `swap_weights` still compares canonically."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        dec = self.model.decoder
+        H, Dh = dec.num_heads, dec.head_dim
+        moe = self.num_experts > 0
+        permute = ([False, False]
+                   + [serving_tp_spec(n, moe=moe)[1]
+                      for n in self._names]
+                   + [False, False, False])
+        out = []
+        for arr, perm in zip(arrays, permute):
+            if perm:
+                arr = np.asarray(shard_major_qkv(
+                    jnp.asarray(arr), self.tensor_parallel, H, Dh))
+            out.append(np.asarray(arr))
+        return out
+
+    def _swap_jit_kwargs(self):
+        """Pin the swap cast's outputs to the step's param shardings:
+        the jit cache keys on input shardings, so swapped arrays must
+        come out byte-identical to what `_shard_state` placed — or the
+        next mixed step would pay a silent full recompile (the PR 8
+        lesson, applied to upgrades)."""
+        from jax.sharding import NamedSharding
+        return {"out_shardings": [
+            NamedSharding(self.mesh, spec)
+            for spec in self._array_specs()]}
+
     # ------------------------------------------------------ mixed step
     def _step_cfg(self):
         """Per-shard decoder config: local head count + the psum axis

@@ -63,6 +63,7 @@ from .kv_cache import PagedKVCache
 from .scheduler import Scheduler
 
 STEP_FN_NAME = "serving_mixed_step"
+SWAP_FN_NAME = "serving_weight_swap"
 
 # default replica names (`role` + sequence): stable labels for trace
 # span events and flight-recorder tracks when the caller names nothing
@@ -149,6 +150,7 @@ class ServingEngine:
         self.name = (str(name) if name is not None
                      else f"{role}{next(_ENGINE_SEQ)}")
         self.draft_k = int(draft_k)
+        self.draft_ngram = int(draft_ngram)
         self.sampling = sampling or SamplingConfig()
         self.speculation_disabled = False
         if self.draft_k > 0 and batcher.needs_history(self.sampling):
@@ -177,6 +179,20 @@ class ServingEngine:
         # group's own writes are always resident). Fixed width means
         # fixed shapes: sparsity never recompiles, and `sparse_blocks
         # >= allocated blocks` is token-identical to the dense engine.
+        if sparse_blocks == "auto":
+            # tuned sparse budget (ISSUE 17 satellite): the smallest
+            # block budget that met the >=99% needle-agreement floor
+            # under `serving.sparse_budget.tune_sparse_budget`, keyed
+            # by head geometry; a cold cache falls back to the
+            # hand-picked 8 of docs/SERVING.md
+            from ..ops.pallas import autotune as _kt
+            tuned = _kt.ensure(
+                "sparse_budget", _kt.shape_bucket(H, Dh),
+                np.dtype(np.float32),
+                {"sparse_blocks": 8,
+                 "sparse_recent": int(sparse_recent)})
+            sparse_blocks = tuned["sparse_blocks"]
+            sparse_recent = tuned.get("sparse_recent", sparse_recent)
         self.sparse_blocks = (None if sparse_blocks is None
                               else int(sparse_blocks))
         self._sparse = self.sparse_blocks is not None
@@ -280,6 +296,12 @@ class ServingEngine:
         donate = tuple(range(1, 1 + len(self.kv._pools())))
         self._step_fn = instrumented_jit(
             self._build_step(), STEP_FN_NAME, donate_argnums=donate)
+        # fleet control plane (ISSUE 17): checkpoint version label
+        # (rides router_requests_total + trace spans) and the ONE
+        # jitted budget-1 weight-swap cast shared by every rolling-
+        # upgrade flip on this engine (built lazily on first swap)
+        self.weights_version = "v0"
+        self._swap_fn = None
         # register this engine's paged-kernel shape buckets with the
         # autotuner (ISSUE 11): keys derive from the token budget /
         # slot count / per-shard head slice, so the tuner-cache audit
@@ -1295,3 +1317,112 @@ class ServingEngine:
         reqs = [self.submit(p, max_new_tokens) for p in prompts]
         self.run()
         return [list(r.output) for r in reqs]
+
+    # ------------------------------------------- fleet control plane
+    def example_step_args(self):
+        """Zero-filled arguments matching the compiled mixed step's
+        call signature exactly: an EMPTY StepPlan packs to the same
+        fixed shapes every real step uses, so `fleet/export.py` can
+        lower + AOT-compile the step against these without the engine
+        ever serving a request (and without advancing `self._rng` —
+        boot stays deterministic)."""
+        import jax
+        import jax.numpy as jnp
+        sp = pack_step(self.token_budget, self.kv.max_slots, [], [],
+                       verify_width=self.draft_k + 1,
+                       reserve_region=self._sparse)
+        _, sub = jax.random.split(self._rng)
+        args = [self._arrays] + self.kv._pools()
+        if self.adapters is not None:
+            args += self.adapters.device_arrays()
+        args += [jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
+                 jnp.asarray(sp.positions),
+                 jnp.asarray(self.kv.block_tables),
+                 jnp.asarray(sp.sample_index)]
+        if self.adapters is not None:
+            args.append(jnp.asarray(self._adapter_token_ids(sp)))
+        if batcher.needs_history(self.sampling):
+            args.append(jnp.asarray(self._penalty_history()))
+        args.append(sub)
+        return args
+
+    def install_aot_step(self, fn):
+        """Replace the instrumented mixed-step wrapper with a
+        deserialized AOT executable (fleet/export.py). The replica
+        then performs ZERO `serving_mixed_step` jit compiles — the
+        property tools/fleet_smoke.py asserts with a budget-0
+        watchdog. The flight recorder's compile-cache probe degrades
+        to -1 (the AOT callable has no jit cache), which is the
+        truthful reading for an executable that can never compile."""
+        self._step_fn = fn
+
+    def _prep_swap_arrays(self, arrays):
+        """Host-side staging for `swap_weights`. The base engine takes
+        the canonical model-order checkpoint as-is; TPServingEngine
+        overrides this with the shard-major QKV permute + sharded
+        placement its step layout requires."""
+        return [np.asarray(a) for a in arrays]
+
+    def _swap_jit_kwargs(self):
+        """Extra jit kwargs for the swap cast (TP: out_shardings)."""
+        return {}
+
+    def swap_weights(self, arrays, version):
+        """Live weight swap between steps (fleet/upgrade.py): replace
+        the parameter set with a new same-architecture checkpoint
+        through ONE jitted budget-1 `serving_weight_swap` cast — the
+        exact compute-dtype transform `__init__` applies, so a swapped
+        engine is bit-identical to one constructed from the new
+        checkpoint. Same shapes/dtypes out means the mixed step's
+        compiled executable keys unchanged: no recompile, one
+        `serving_mixed_step` compile per engine holds across any
+        number of upgrades. Must be called with the engine idle
+        (drained) — in-flight requests would otherwise mix versions
+        mid-sequence."""
+        import jax.numpy as jnp
+        if self._moe_weight_bits:
+            raise ValueError(
+                "live weight swap on an engine-side quantized MoE "
+                "stack is unsupported: the quantization transform is "
+                "not shape-preserving per tensor — export a new "
+                "bundle and boot a fresh replica instead")
+        if len(arrays) != len(self._arrays):
+            raise ValueError(
+                f"checkpoint has {len(arrays)} tensors, engine holds "
+                f"{len(self._arrays)} — not the same architecture")
+        prep = self._prep_swap_arrays(arrays)
+        for new, old in zip(prep, self._arrays):
+            if tuple(new.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"weight shape {tuple(new.shape)} != engine "
+                    f"shape {tuple(old.shape)}: live swap requires "
+                    "an architecture-identical checkpoint")
+        if self._swap_fn is None:
+            dts = tuple(jnp.dtype(a.dtype) for a in self._arrays)
+
+            def _load(new):
+                return [a.astype(dt) for a, dt in zip(new, dts)]
+
+            self._swap_fn = instrumented_jit(
+                _load, SWAP_FN_NAME, **self._swap_jit_kwargs())
+        self._arrays = list(self._swap_fn(prep))
+        # cached prefix KV was computed under the OLD weights — serving
+        # it to post-swap requests would silently mix versions
+        if self.prefix_cache is not None:
+            self.prefix_cache.evict_all()
+        self.weights_version = str(version)
+
+    def close(self, *, spill_prefix=None):
+        """Release the engine's cached KV state; optionally spill the
+        radix prefix cache (tree + exported block payloads) to
+        `spill_prefix` first, so a future replica can warm-boot with a
+        non-empty cache (`RadixPrefixCache.spill`/`restore`;
+        docs/DEPLOYMENT.md). Returns the number of blocks spilled.
+        Idempotent; the engine must be drained (no resident
+        requests)."""
+        spilled = 0
+        if self.prefix_cache is not None:
+            if spill_prefix is not None:
+                spilled = self.prefix_cache.spill(spill_prefix)
+            self.prefix_cache.evict_all()
+        return spilled

@@ -1,0 +1,39 @@
+"""paddle_tpu.serving.fleet — the fleet control plane (ISSUE 17).
+
+Replicas as cattle, not pets. Three coupled pieces on top of the
+serving engine / router / SLO plane:
+
+* `export` — versioned AOT boot bundles: model config, weight
+  manifest, kv_meta, engine knobs and the mixed step's SERIALIZED
+  compiled executable per (role, tensor_parallel), written next to
+  the persistent kernel-autotune cache. `boot_engine_from_bundle`
+  brings a ServingEngine up with ZERO `serving_mixed_step` jit
+  compiles (watchdog-asserted by tools/fleet_smoke.py).
+* `upgrade` — live weight swap: one jitted budget-1
+  `serving_weight_swap` cast per engine flips a drained replica to a
+  new checkpoint version between steps; the controller rolls the
+  fleet version-by-version through the router's quiesce plane.
+* `autoscaler` — SLO-burn-driven replica count re-planning with the
+  calibrated-cost-model discipline of `parallel.auto_tuner`
+  (predicted TTFT/inter-token from queue depth, token budgets and
+  measured step times; sustained-burn + cooldown hysteresis).
+
+`controller.FleetController` ties them to a live `ReplicaRouter`.
+See docs/DEPLOYMENT.md for the bundle format and lifecycle contract.
+"""
+from . import autoscaler  # noqa: F401
+from . import controller  # noqa: F401
+from . import export  # noqa: F401
+from . import upgrade  # noqa: F401
+from .autoscaler import AutoscalerPolicy, SLOAutoscaler  # noqa: F401
+from .controller import FleetController  # noqa: F401
+from .export import (FleetBundle, boot_engine_from_bundle,  # noqa: F401
+                     export_bundle)
+from .upgrade import weights_from_model  # noqa: F401
+
+__all__ = [
+    "FleetBundle", "export_bundle", "boot_engine_from_bundle",
+    "FleetController", "SLOAutoscaler", "AutoscalerPolicy",
+    "weights_from_model", "export", "upgrade", "autoscaler",
+    "controller",
+]

@@ -1,0 +1,179 @@
+"""SLO-burn-driven autoscaling (ISSUE 17 (c)).
+
+The decision loop consumes ONLY host-side registry state — the SLO
+monitor's sliding windows (PR 16), the router's queue depths, and the
+flight recorders' measured step times. No device readback sits on the
+decision path (the smoke runs it under `guards.sanitize`).
+
+Discipline borrowed from `parallel.auto_tuner.tune()`: decisions are
+gated by a CALIBRATED COST MODEL, not raw threshold crossings —
+
+* `predict_ttft(extra)` — queued work per replica x measured mean
+  step seconds: the admission-to-first-token latency the fleet would
+  see with `extra` more (or fewer) replicas at current load;
+* `predict_inter_token()` — the measured step time itself (a decode
+  emits at most one token per resident slot per step, so the step
+  period IS the inter-token floor);
+
+and hysteresis keeps the fleet from flapping:
+
+* **scale-up** only on SUSTAINED burn: some objective's burn rate
+  must exceed `burn_threshold` continuously for `sustain_s`;
+* **scale-down** only after `recovery_s` of every objective healthy
+  AND only when the cost model predicts the post-removal TTFT still
+  meets the strictest tenant target;
+* a global `cooldown_s` separates consecutive decisions in either
+  direction.
+
+`SLOAutoscaler.step()` evaluates once and applies at most one
+decision through the controller's boot/retire plane; `run()` loops
+it. Every decision (and its model inputs) lands in `.decisions` for
+the smoke's exactly-one-scale-up assertion.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class AutoscalerPolicy:
+    """Hysteresis + bounds contract (documented in
+    docs/DEPLOYMENT.md; the smoke pins the semantics)."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    burn_threshold: float = 1.0   # burn rate above this = burning
+    sustain_s: float = 0.1        # burn must persist this long
+    recovery_s: float = 0.3       # all-ok this long before scale-down
+    cooldown_s: float = 0.5       # min gap between applied decisions
+
+
+class SLOAutoscaler:
+    def __init__(self, controller, monitor, *, policy=None,
+                 clock=None):
+        self.controller = controller
+        self.monitor = monitor
+        self.policy = policy or AutoscalerPolicy()
+        self.clock = clock or controller.clock
+        self._burn_since: Optional[float] = None
+        self._ok_since: Optional[float] = None
+        self._last_applied: Optional[float] = None
+        #: applied decisions: dicts with ts/direction/reason/replicas/
+        #: predicted_ttft — the smoke's convergence evidence
+        self.decisions = []
+
+    # ------------------------------------------------------ cost model
+    def mean_step_seconds(self):
+        """Measured mean mixed-step wall time across the fleet's
+        flight recorders (host floats the engines already noted);
+        0.0 when tracing has recorded nothing yet."""
+        durs = []
+        for idx in self.controller.active_replicas():
+            rec = getattr(
+                self.controller.router.frontends[idx].engine,
+                "flight", None)
+            if rec is not None:
+                durs.extend(r.get("dur", 0.0) for r in rec.records)
+        return sum(durs) / len(durs) if durs else 0.0
+
+    def queued_requests(self):
+        r = self.controller.router
+        return sum(r.queue_depth(i)
+                   for i in self.controller.active_replicas())
+
+    def predict_ttft(self, extra_replicas=0):
+        """Queue-depth x step-time TTFT estimate with
+        `extra_replicas` more (negative: fewer) replicas sharing the
+        same load."""
+        n = len(self.controller.active_replicas()) + extra_replicas
+        if n <= 0:
+            return float("inf")
+        return (self.queued_requests() / n) * self.mean_step_seconds()
+
+    def predict_inter_token(self):
+        return self.mean_step_seconds()
+
+    def _strictest_ttft_target(self):
+        """Tightest configured ttft_p95 target across tenants — the
+        bar a scale-down's predicted TTFT must clear."""
+        cfg = self.monitor.config
+        vals = [cfg.default.get("ttft_p95")]
+        vals += [t.get("ttft_p95") for t in cfg.tenants.values()]
+        vals = [v for v in vals if v is not None]
+        return min(vals) if vals else 0.5
+
+    # ------------------------------------------------------- decisions
+    def _burning(self, report):
+        """(tenant, objective, burn) triples above threshold."""
+        out = []
+        for tenant, objs in report.items():
+            for obj, d in objs.items():
+                if d.get("burn_rate", 0.0) > self.policy.burn_threshold:
+                    out.append((tenant, obj, d["burn_rate"]))
+        return out
+
+    def evaluate(self, now=None):
+        """One decision or None — PURE policy arithmetic over the
+        monitor's report + router depths (callable from tests without
+        applying anything)."""
+        now = self.clock() if now is None else now
+        pol = self.policy
+        report = self.monitor.evaluate(now)
+        burning = self._burning(report)
+        n = len(self.controller.active_replicas())
+        cooled = (self._last_applied is None
+                  or now - self._last_applied >= pol.cooldown_s)
+        if burning:
+            self._ok_since = None
+            if self._burn_since is None:
+                self._burn_since = now
+            sustained = now - self._burn_since >= pol.sustain_s
+            if sustained and cooled and n < pol.max_replicas:
+                tenant, obj, burn = max(burning, key=lambda t: t[2])
+                return {"ts": now, "direction": "up", "reason": obj,
+                        "tenant": tenant, "burn": burn, "replicas": n,
+                        "predicted_ttft": self.predict_ttft(+1),
+                        "predicted_inter_token":
+                            self.predict_inter_token()}
+            return None
+        self._burn_since = None
+        if self._ok_since is None:
+            self._ok_since = now
+        recovered = now - self._ok_since >= pol.recovery_s
+        if recovered and cooled and n > pol.min_replicas:
+            after = self.predict_ttft(-1)
+            if after <= self._strictest_ttft_target():
+                return {"ts": now, "direction": "down",
+                        "reason": "recovered", "replicas": n,
+                        "predicted_ttft": after,
+                        "predicted_inter_token":
+                            self.predict_inter_token()}
+        return None
+
+    async def step(self):
+        """Evaluate once; apply at most one decision. Returns the
+        applied decision (or None)."""
+        decision = self.evaluate()
+        if decision is None:
+            return None
+        if decision["direction"] == "up":
+            idx = await self.controller.scale_up(decision["reason"])
+            decision["replica"] = idx
+        else:
+            idx = await self.controller.scale_down(decision["reason"])
+            decision["replica"] = idx
+        self._last_applied = decision["ts"]
+        # both hysteresis clocks restart: the new census must re-earn
+        # its next decision from scratch
+        self._burn_since = None
+        self._ok_since = None
+        self.decisions.append(decision)
+        return decision
+
+    async def run(self, interval=0.05):
+        """Background loop (cancelled by the owner, like the router's
+        prober)."""
+        import asyncio
+        while True:
+            await self.step()
+            await asyncio.sleep(interval)

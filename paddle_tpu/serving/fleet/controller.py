@@ -1,0 +1,178 @@
+"""FleetController: boot / drain / upgrade / retire through the
+router's quiesce plane (ISSUE 17 tentpole glue).
+
+One controller operates one `ReplicaRouter` against one (current)
+`FleetBundle`. Replica indices are append-only — retirement stops a
+replica and marks it down but never reindexes, so in-flight streams,
+metric labels and the health plane stay coherent for the fleet's
+whole life.
+
+Lifecycle verbs:
+
+* `boot_replica()` — AOT boot from the bundle (zero mixed-step
+  compiles), optional warm prefix spill, optional probe prompt whose
+  first token closes the measured cold-start window
+  (`paddle_tpu_serving_fleet_cold_start_seconds`), then
+  `router.add_replica` puts it in rotation.
+* `drain(idx)` — quiesce + wait until the replica holds no work
+  anywhere on its path (router in-flight, fair queue, live set,
+  engine scheduler).
+* `retire(idx)` — drain, stop the frontend, spill the prefix cache
+  (when a spill_dir is configured), close the engine, mark down.
+* `rolling_upgrade(weights, version)` — `upgrade.rolling_upgrade`
+  over this router, then a census refresh (the version label
+  migrates on `paddle_tpu_serving_fleet_replicas`).
+* `scale_up(reason)` / `scale_down(reason)` — the autoscaler's
+  actuators; each ticks `fleet_scale_events_total{direction,reason}`.
+"""
+from __future__ import annotations
+
+import os
+
+from .. import metrics as smetrics
+from ...profiler import metrics as _pmetrics
+from .export import FleetBundle, boot_engine_from_bundle
+
+
+class FleetController:
+    def __init__(self, router, bundle=None, *, spill_dir=None,
+                 clock=None, max_pending=256):
+        self.router = router
+        self.bundle = (FleetBundle(bundle) if isinstance(bundle, str)
+                       else bundle)
+        self.spill_dir = spill_dir
+        self.clock = clock if clock is not None else router.clock
+        self.max_pending = int(max_pending)
+        self.retired = set()
+        self.booted = []          # indices this controller booted
+        self._census_seen = set()
+        self._census()
+
+    # ------------------------------------------------------------ state
+    def active_replicas(self):
+        """Indices in rotation: not retired, not marked down. Reads
+        the health plane's down flags rather than probing — a probe
+        would misread hand-built fleets whose frontends start lazily,
+        and `alive()` marks down as a side effect."""
+        return [i for i in range(len(self.router.frontends))
+                if i not in self.retired
+                and not self.router.health._down[i]]
+
+    def _census(self):
+        """Refresh `fleet_replicas{role,version}` from the live fleet;
+        label pairs that emptied out are zeroed, not dropped."""
+        if not _pmetrics._enabled:
+            return
+        counts = {}
+        for i in self.active_replicas():
+            key = (self.router.roles[i], self.router._version(i))
+            counts[key] = counts.get(key, 0) + 1
+        for key in self._census_seen - set(counts):
+            smetrics.FLEET_REPLICAS.labels(*key).set(0)
+        for key, n in counts.items():
+            smetrics.FLEET_REPLICAS.labels(*key).set(n)
+        self._census_seen |= set(counts)
+
+    def _spill_path(self, engine):
+        if self.spill_dir is None or engine.prefix_cache is None:
+            return None
+        os.makedirs(self.spill_dir, exist_ok=True)
+        return os.path.join(self.spill_dir,
+                            f"prefix_{engine.name}.pkl")
+
+    # ------------------------------------------------------------- boot
+    async def boot_replica(self, *, aot=True, warm_prefix=None,
+                           name=None, probe_prompt=None,
+                           probe_tokens=1, **overrides):
+        """Boot one replica from the bundle and add it to rotation.
+        Returns its index. `aot=True` installs the bundle's
+        deserialized step executable: ZERO mixed-step jit compiles.
+        `warm_prefix` re-adopts a prefix spill (warm boot). A
+        `probe_prompt` serves `probe_tokens` through the fresh engine
+        before rotation so the recorded cold-start spans
+        boot-to-first-token (the bench lane's definition)."""
+        from ..frontend import ServingFrontend
+        if self.bundle is None:
+            raise ValueError("boot_replica needs a FleetBundle")
+        t0 = self.clock()
+        engine = boot_engine_from_bundle(
+            self.bundle, aot=aot, warm_prefix=warm_prefix, name=name,
+            **overrides)
+        if probe_prompt is not None:
+            engine.generate_batch([list(probe_prompt)],
+                                  max_new_tokens=int(probe_tokens))
+        dt = self.clock() - t0
+        warm = (warm_prefix is not None
+                and engine.prefix_cache is not None
+                and engine.prefix_cache.cached_blocks > 0)
+        if _pmetrics._enabled:
+            smetrics.FLEET_BOOTS.labels("warm" if warm
+                                        else "cold").inc()
+            smetrics.FLEET_COLD_START.observe(dt)
+        fe = ServingFrontend(engine, max_pending=self.max_pending)
+        idx = await self.router.add_replica(fe, engine.role)
+        self.booted.append(idx)
+        self._census()
+        return idx
+
+    # ------------------------------------------------------ drain/retire
+    async def drain(self, idx, *, poll_s=0.005, timeout_s=30.0):
+        """Quiesce replica `idx` and wait for its in-flight work to
+        finish on its current weights. The replica stays healthy and
+        stays quiesced — callers flip weights or retire next."""
+        import asyncio
+        self.router.quiesce(idx)
+        deadline = self.clock() + float(timeout_s)
+        while not self.router.is_drained(idx):
+            if self.clock() > deadline:
+                raise TimeoutError(
+                    f"replica {idx} did not drain within "
+                    f"{timeout_s}s")
+            await asyncio.sleep(poll_s)
+
+    async def retire(self, idx, *, spill_prefix=None):
+        """Drain + stop + close replica `idx` (spilling its prefix
+        cache when configured). Its index stays allocated and marked
+        down forever. Returns blocks spilled."""
+        await self.drain(idx)
+        fe = self.router.frontends[idx]
+        await fe.stop()
+        spill = (spill_prefix if spill_prefix is not None
+                 else self._spill_path(fe.engine))
+        spilled = fe.engine.close(spill_prefix=spill)
+        self.retired.add(idx)
+        self.router.health.mark_down(idx)
+        self._census()
+        return spilled
+
+    # ---------------------------------------------------------- upgrade
+    async def rolling_upgrade(self, weights, version, **kw):
+        """Flip the fleet to (`weights`, `version`) one drained
+        replica at a time (`upgrade.rolling_upgrade`); returns flipped
+        indices. The bundle reference is NOT rewritten — export a new
+        bundle per version for future boots."""
+        from .upgrade import rolling_upgrade
+        flipped = await rolling_upgrade(self.router, weights, version,
+                                        **kw)
+        self._census()
+        return flipped
+
+    # ------------------------------------------------------------ scale
+    async def scale_up(self, reason, **boot_kw):
+        idx = await self.boot_replica(**boot_kw)
+        if _pmetrics._enabled:
+            smetrics.FLEET_SCALE_EVENTS.labels("up",
+                                               str(reason)).inc()
+        return idx
+
+    async def scale_down(self, reason):
+        """Retire the most recently booted active replica (LIFO keeps
+        the original hand-built fleet intact at min scale)."""
+        active = set(self.active_replicas())
+        cands = [i for i in self.booted if i in active]
+        idx = cands[-1] if cands else max(active)
+        await self.retire(idx)
+        if _pmetrics._enabled:
+            smetrics.FLEET_SCALE_EVENTS.labels("down",
+                                               str(reason)).inc()
+        return idx

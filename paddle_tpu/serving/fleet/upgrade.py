@@ -1,0 +1,94 @@
+"""Live weight swap / rolling upgrade (ISSUE 17 (b)).
+
+The mechanism lives in `ServingEngine.swap_weights`: ONE jitted
+budget-1 `serving_weight_swap` cast per engine (the same discipline as
+`serving_adapter_load`) replaces the parameter set between steps with
+a new same-architecture checkpoint — the exact compute-dtype transform
+engine construction applies, so a swapped engine is bit-identical to
+one built from the new checkpoint, and the mixed step's compiled
+executable keys unchanged (no recompile, ever).
+
+This module holds the checkpoint plumbing and the fleet-level rolling
+policy the controller drives:
+
+    for each replica, one at a time:
+        router.quiesce(idx)        # no NEW dispatches land here
+        wait until router.is_drained(idx)   # in-flight finish on OLD
+        engine.swap_weights(new, version)   # idle engine, one cast
+        router.unquiesce(idx)      # back in rotation on NEW weights
+
+In-flight requests complete on their original weights; post-flip
+requests see the new version; with >= 2 replicas the fleet never
+stops serving. Mid-upgrade the fleet's aggregate output is
+token-identical to a same-version fleet because every request runs
+start-to-finish on exactly one version (tools/fleet_smoke.py asserts
+this against static v1/v2 reference outputs).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def weights_from_model(model):
+    """Canonical checkpoint arrays from a (new-version) model:
+    `model._gen_tensors()` order, host-side — exactly what
+    `ServingEngine.swap_weights` and bundle export consume."""
+    return [np.asarray(t._data) for t in model._gen_tensors()]
+
+
+def weights_from_bundle(bundle):
+    """Canonical checkpoint arrays from a `FleetBundle` (or path)."""
+    from .export import FleetBundle
+    if isinstance(bundle, str):
+        bundle = FleetBundle(bundle)
+    return bundle.weights(), bundle.version
+
+
+async def rolling_upgrade(router, weights, version, *,
+                          drain_poll_s=0.005, drain_timeout_s=30.0,
+                          replicas=None, on_flip=None):
+    """Flip every live replica of `router` to (`weights`, `version`),
+    one at a time, through the quiesce/drain protocol above. Returns
+    the list of replica indices flipped. `replicas` restricts the roll
+    (default: every non-quiesced live replica); `on_flip(idx)` fires
+    after each replica returns to rotation.
+
+    Single-replica fleets are refused: with nothing left in rotation
+    during the drain, new requests would fail instead of landing on a
+    not-yet-flipped sibling — boot a second replica first (the
+    controller's scale path does exactly that)."""
+    import asyncio
+
+    from .. import metrics as smetrics
+    from ...profiler import metrics as _pmetrics
+
+    targets = [i for i in range(len(router.frontends))
+               if i not in router._quiesced and router.health.alive(i)
+               ] if replicas is None else list(replicas)
+    if len(targets) < 2 and replicas is None:
+        raise ValueError(
+            "rolling upgrade needs >= 2 replicas in rotation so the "
+            "fleet keeps serving through each drain")
+    flipped = []
+    for idx in targets:
+        router.quiesce(idx)
+        try:
+            deadline = router.clock() + float(drain_timeout_s)
+            while not router.is_drained(idx):
+                if router.clock() > deadline:
+                    raise TimeoutError(
+                        f"replica {idx} did not drain within "
+                        f"{drain_timeout_s}s")
+                await asyncio.sleep(drain_poll_s)
+            # drained: the frontend's step loop only touches the
+            # engine when the scheduler has work, so the swap runs
+            # race-free from here
+            router.frontends[idx].engine.swap_weights(weights, version)
+        finally:
+            router.unquiesce(idx)
+        flipped.append(idx)
+        if _pmetrics._enabled:
+            smetrics.FLEET_UPGRADES.inc()
+        if on_flip is not None:
+            on_flip(idx)
+    return flipped

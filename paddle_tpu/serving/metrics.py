@@ -122,8 +122,10 @@ SERVING_ADAPTERS_RESIDENT = REGISTRY.gauge(
 # ---- multi-replica router (serving.distributed.router) -----------------
 ROUTER_REQUESTS = REGISTRY.counter(
     "paddle_tpu_serving_router_requests_total",
-    "Router dispatches by replica and outcome",
-    ("replica", "outcome"))
+    "Router dispatches by replica, outcome and the serving replica's "
+    "checkpoint version (ISSUE 17: a rolling upgrade is observable "
+    "as the version label migrating across the fleet)",
+    ("replica", "outcome", "version"))
 # outcomes: finished|failover|expired|cancelled|error|migrated
 ROUTER_MIGRATIONS = REGISTRY.counter(
     "paddle_tpu_serving_router_migrations_total",
@@ -215,6 +217,34 @@ SERVING_SLO_BREACHES = REGISTRY.counter(
     "observed by SLOMonitor.evaluate)",
     ("tenant", "objective"))
 
+# ---- fleet control plane (serving.fleet, ISSUE 17) ---------------------
+FLEET_REPLICAS = REGISTRY.gauge(
+    "paddle_tpu_serving_fleet_replicas",
+    "Replicas the fleet controller currently operates, by role and "
+    "checkpoint version (a rolling upgrade is the old version's count "
+    "draining to zero while the new one's rises)",
+    ("role", "version"))
+FLEET_BOOTS = REGISTRY.counter(
+    "paddle_tpu_serving_fleet_boots_total",
+    "Replica boots by kind: cold (fresh engine, empty caches) vs "
+    "warm (AOT bundle + restored prefix spill)",
+    ("kind",))   # cold|warm
+FLEET_UPGRADES = REGISTRY.counter(
+    "paddle_tpu_serving_fleet_upgrades_total",
+    "Per-replica weight-version flips completed by rolling upgrades "
+    "(one drained jitted serving_weight_swap load each)")
+FLEET_SCALE_EVENTS = REGISTRY.counter(
+    "paddle_tpu_serving_fleet_scale_events_total",
+    "Autoscaler decisions applied, by direction and the objective "
+    "(or recovery) that drove them",
+    ("direction", "reason"))   # up|down x objective|recovered
+FLEET_COLD_START = REGISTRY.histogram(
+    "paddle_tpu_serving_fleet_cold_start_seconds",
+    "Boot-to-ready latency of controller-booted replicas (through "
+    "first probe token when the boot carries a probe prompt): the "
+    "AOT-vs-jit A/B bench.py's serving_fleet_ops lane measures",
+    buckets=exponential_buckets(1e-3, 4.0, 10))
+
 #: every name above, for the smoke-tool contract check
 CONTRACT_METRICS = (
     "paddle_tpu_serving_ttft_seconds",
@@ -286,6 +316,14 @@ CONTRACT_METRICS = (
     # serving one-compile contract's runtime tripwire
     "paddle_tpu_compile_watchdog_budget_exceeded_total",
     "paddle_tpu_compile_watchdog_transfer_guard_trips_total",
+    # fleet control plane (ISSUE 17): replica census by role/version,
+    # boot kinds, upgrade flips, autoscaler decisions, and the
+    # cold-start lane the AOT-boot A/B is judged on
+    "paddle_tpu_serving_fleet_replicas",
+    "paddle_tpu_serving_fleet_boots_total",
+    "paddle_tpu_serving_fleet_upgrades_total",
+    "paddle_tpu_serving_fleet_scale_events_total",
+    "paddle_tpu_serving_fleet_cold_start_seconds",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

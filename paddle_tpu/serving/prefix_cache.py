@@ -319,3 +319,75 @@ class RadixPrefixCache:
             total += freed
             if freed == 0:
                 return total
+
+    # ------------------------------------------------------ persistence
+    def spill(self, path):
+        """Serialize the radix tree + its cached KV payloads to a host
+        file (ISSUE 17 satellite: prefix persistence across engine
+        restarts). Each node spills its token run plus
+        `kv.export_blocks` payloads — the SAME host representation the
+        disaggregated-serving codec ships — prefixed with `kv_meta()`
+        so `restore` can refuse a mismatched pool instead of
+        corrupting one. Read-only on the tree; parents precede
+        children in the record list so restore can rebuild edges in
+        one pass. Returns the number of blocks spilled."""
+        import pickle
+        order = []
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            if n is not self.root:
+                order.append(n)
+            stack.extend(n.children.values())
+        index = {self.root: -1}
+        for i, n in enumerate(order):
+            index[n] = i
+        records = []
+        blocks = 0
+        for n in order:
+            records.append({
+                "parent": index[n.parent],
+                "tokens": tuple(n.tokens),
+                "arrays": self.kv.export_blocks(n.blocks),
+            })
+            blocks += len(n.blocks)
+        with open(path, "wb") as f:
+            pickle.dump({"format": 1, "kv_meta": self.kv.kv_meta(),
+                         "nodes": records}, f)
+        return blocks
+
+    def restore(self, path):
+        """Re-adopt a spilled tree into THIS cache's pool: allocate
+        fresh blocks (the allocation's refcount-1 is exactly the
+        tree's reference), scatter the payloads back with
+        `kv.import_blocks`, and rebuild the radix edges in spill
+        order. All-or-nothing: a `kv_meta` mismatch raises, and a pool
+        too small for the whole spill restores NOTHING (a partial tree
+        would orphan subtrees). Only valid on an empty tree (warm
+        boot). Returns the number of blocks restored."""
+        import pickle
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        if payload.get("kv_meta") != self.kv.kv_meta():
+            raise ValueError(
+                f"prefix spill kv_meta {payload.get('kv_meta')} does "
+                f"not match this pool's {self.kv.kv_meta()}")
+        if self.root.children:
+            raise ValueError(
+                "restore() needs an empty prefix tree (warm boot)")
+        records = payload["nodes"]
+        need = sum(len(r["arrays"][0]) for r in records)
+        if need == 0 or need > self.kv.allocator.num_free:
+            return 0
+        built = []
+        for rec in records:
+            n_blocks = len(rec["arrays"][0])
+            ids = self.kv.allocator.alloc(n_blocks)
+            self.kv.import_blocks(ids, rec["arrays"])
+            parent = (self.root if rec["parent"] < 0
+                      else built[rec["parent"]])
+            node = RadixNode(parent, rec["tokens"], ids)
+            self._touch(node)
+            parent.children[self._key(node.tokens, 0)] = node
+            built.append(node)
+        return need

@@ -1,0 +1,330 @@
+"""Fleet control plane smoke run + contract check (ISSUE 17).
+
+CI contract (tests/test_fleet.py runs this in-process, the same way
+tests/test_tracing.py runs tools/trace_smoke.py):
+
+* **Zero-compile AOT boot** — a replica booted from an exported
+  bundle serves its first tokens under
+  `guards.sanitize(budgets={"serving_mixed_step": 0})`: the compile
+  watchdog proves the deserialized executable never jit-compiles the
+  mixed step. A warm boot additionally re-adopts a prefix-cache
+  spill (restored blocks > 0) and stays token-identical.
+* **Lossless rolling upgrade** — a 2-replica fleet flips v1 -> v2
+  while a request stream is in flight: every output must be
+  token-identical to the SAME request on a static v1 fleet or a
+  static v2 fleet (each request runs start-to-finish on exactly one
+  version), post-upgrade outputs must all be v2, and the version
+  label must ride `router_requests_total` and the dispatch trace
+  spans. One `serving_mixed_step` compile per engine holds across
+  the whole roll (per-instance watchdog budget).
+* **Autoscaler convergence** — an engineered SLO burn must produce
+  EXACTLY one scale-up (a real AOT boot through the controller),
+  then sustained recovery exactly one scale-down (retiring the
+  booted replica), then silence: no flapping. Decisions consume only
+  registry state — the whole run sits under `guards.sanitize()`, so
+  a device readback on the decision path fails the smoke.
+* **Drain hygiene** — after the fleet quiesces, every engine
+  (including the retired one) holds zero KV blocks and an intact
+  allocator free list.
+* **Metric contract** — every `paddle_tpu_serving_fleet_*` name in
+  `serving.metrics.CONTRACT_METRICS` must appear in the Prometheus
+  dump with real activity (boots, upgrades, scale events, cold-start
+  observations).
+
+Exit status is non-zero on any violation.
+
+Usage: JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_REQUESTS = 12
+MAX_NEW = 8
+ENG_KW = dict(max_slots=4, block_size=4, num_blocks=64, max_seq_len=64,
+              token_budget=64, cache_dtype="float32", seed=0,
+              prefix_caching=True)
+
+
+def _model(seed):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    paddle.seed(seed)
+    model = GPTForGeneration(vocab_size=193, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+    model.eval()
+    return model
+
+
+def _prompts(vocab=193):
+    import numpy as np
+    rng = np.random.RandomState(11)
+    return [rng.randint(1, vocab, int(n)).tolist()
+            for n in rng.randint(3, 9, N_REQUESTS)]
+
+
+def run_smoke():
+    import asyncio
+    import tempfile
+
+    from paddle_tpu.analysis import guards
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving import metrics as sm
+    from paddle_tpu.serving import tracing
+    from paddle_tpu.serving.distributed import ReplicaRouter
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.fleet import (AutoscalerPolicy, FleetBundle,
+                                          FleetController, SLOAutoscaler,
+                                          boot_engine_from_bundle,
+                                          export_bundle,
+                                          weights_from_model)
+    from paddle_tpu.serving.frontend import ServingFrontend
+    from paddle_tpu.serving.slo import SLOMonitor
+
+    pm.enable()
+    m1, m2 = _model(1234), _model(777)   # same arch, two checkpoints
+    prompts = _prompts()
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="fleet_smoke_")
+
+    # static single-version references: the token-identity oracles
+    ref1 = ServingEngine(m1, **ENG_KW).generate_batch(
+        [list(p) for p in prompts], max_new_tokens=MAX_NEW)
+    ref2 = ServingEngine(m2, **ENG_KW).generate_batch(
+        [list(p) for p in prompts], max_new_tokens=MAX_NEW)
+    if ref1 == ref2:
+        failures.append("v1 and v2 references are identical — the "
+                        "upgrade phase can prove nothing")
+
+    # ---- phase A: export + zero-compile AOT boot -------------------
+    exporter = ServingEngine(m1, **ENG_KW)
+    bundle = FleetBundle(export_bundle(exporter, tmp, version="v1"))
+    if not bundle.has_executable():
+        failures.append("bundle carries no serialized step executable")
+    spill = os.path.join(tmp, "prefix_spill.pkl")
+    with guards.sanitize(budgets={"serving_mixed_step": 0}) as wd0:
+        cold = boot_engine_from_bundle(bundle, name="aot-cold")
+        out_cold = cold.generate_batch([list(p) for p in prompts],
+                                       max_new_tokens=MAX_NEW)
+    if wd0.violations:
+        failures.append(f"AOT cold boot compiled the mixed step: "
+                        f"{wd0.violations}")
+    if out_cold != ref1:
+        failures.append("AOT-booted replica diverges from the "
+                        "exporting engine's tokens")
+    spilled = cold.close(spill_prefix=spill)
+    if spilled <= 0:
+        failures.append(f"prefix spill wrote {spilled} blocks, "
+                        "expected > 0")
+    with guards.sanitize(budgets={"serving_mixed_step": 0}) as wd1:
+        warm = boot_engine_from_bundle(bundle, name="aot-warm",
+                                       warm_prefix=spill)
+        restored = warm.prefix_cache.cached_blocks
+        out_warm = warm.generate_batch([list(p) for p in prompts],
+                                       max_new_tokens=MAX_NEW)
+    if wd1.violations:
+        failures.append(f"AOT warm boot compiled the mixed step: "
+                        f"{wd1.violations}")
+    if restored != spilled:
+        failures.append(f"warm boot re-adopted {restored} blocks, "
+                        f"spilled {spilled}")
+    if out_warm != ref1:
+        failures.append("warm-booted replica diverges from v1 tokens")
+    warm.close()
+
+    # ---- phase B: rolling upgrade under live traffic ---------------
+    w2 = weights_from_model(m2)
+    fes = [ServingFrontend(ServingEngine(_model(1234), name=f"r{i}",
+                                         **ENG_KW), max_pending=16)
+           for i in range(2)]
+    for fe in fes:
+        fe.engine.generate_batch([[7, 7]], max_new_tokens=1)  # warm
+    router = ReplicaRouter(fes, probe_interval=0.02)
+    ctl = FleetController(router, bundle,
+                          spill_dir=os.path.join(tmp, "spill"))
+    tracing.enable()
+    tracing.TRACER.reset()
+
+    async def phase_b():
+        async def fire(i, p):
+            await asyncio.sleep(0.01 * i)
+            return await router.submit(list(p), max_new_tokens=MAX_NEW)
+
+        tasks = [asyncio.create_task(fire(i, p))
+                 for i, p in enumerate(prompts)]
+        await asyncio.sleep(0.02)       # let the stream get in flight
+        flipped = await ctl.rolling_upgrade(w2, "v2")
+        outs = await asyncio.gather(*tasks)
+        post = await asyncio.gather(
+            *[router.submit(list(p), max_new_tokens=MAX_NEW)
+              for p in prompts])
+        return flipped, outs, post
+
+    async def run_all():
+        async with router:
+            flipped, outs, post = await phase_b()
+            await phase_c()
+            return flipped, outs, post
+
+    # ---- phase C: engineered burn -> one scale-up, recovery -> one
+    # scale-down, then silence (defined before run_all executes)
+    clk = [1000.0]
+    monitor = SLOMonitor({"default": {"ttft_p95": 0.1},
+                          "window_s": 30.0}, clock=lambda: clk[0])
+    scaler = SLOAutoscaler(
+        ctl, monitor, clock=lambda: clk[0],
+        policy=AutoscalerPolicy(min_replicas=2, max_replicas=3,
+                                sustain_s=1.0, recovery_s=2.0,
+                                cooldown_s=3.0))
+
+    async def phase_c():
+        monitor.on_ttft("t", 5.0, clk[0])       # burn begins
+        if await scaler.step() is not None:
+            failures.append("autoscaler scaled before the burn "
+                            "sustained (no hysteresis)")
+        clk[0] += 1.5                            # sustained now
+        monitor.on_ttft("t", 5.0, clk[0])
+        d = await scaler.step()
+        if not d or d["direction"] != "up":
+            failures.append(f"sustained burn produced {d!r}, "
+                            "expected a scale-up")
+        clk[0] += 1.0                            # still burning + cooldown
+        monitor.on_ttft("t", 5.0, clk[0])
+        if await scaler.step() is not None:
+            failures.append("autoscaler flapped: second scale-up "
+                            "inside cooldown")
+        clk[0] += 35.0                           # burn ages out of window
+        monitor.on_ttft("t", 0.01, clk[0])       # healthy traffic
+        if await scaler.step() is not None:
+            failures.append("scale-down before recovery_s sustained")
+        clk[0] += 2.5                            # recovered + cooled
+        d = await scaler.step()
+        if not d or d["direction"] != "down":
+            failures.append(f"recovery produced {d!r}, expected a "
+                            "scale-down")
+        for _ in range(5):                       # converged: silence
+            clk[0] += 1.0
+            monitor.on_ttft("t", 0.01, clk[0])
+            if await scaler.step() is not None:
+                failures.append("autoscaler did not converge "
+                                "(flapping after recovery)")
+                break
+
+    flipped, outs, post = asyncio.run(run_all())
+    tracing.disable()
+
+    if sorted(flipped) != [0, 1]:
+        failures.append(f"rolling upgrade flipped {flipped}, "
+                        "expected both replicas")
+    for i, (o, r1, r2) in enumerate(zip(outs, ref1, ref2)):
+        if o != r1 and o != r2:
+            failures.append(f"mid-upgrade request {i} matches "
+                            "NEITHER the static v1 nor the static "
+                            "v2 fleet — a version mixed mid-request")
+    if post != ref2:
+        failures.append("post-upgrade fleet is not token-identical "
+                        "to the static v2 fleet")
+    versions = router.stats()["versions"]
+    if versions[:2] != ["v2", "v2"]:
+        failures.append(f"router reports versions {versions}, "
+                        "expected both original replicas on v2")
+
+    # version label rides router_requests_total + dispatch spans
+    labels = {lv for lv, _c in sm.ROUTER_REQUESTS.samples()}
+    if not any(len(lv) == 3 and lv[2] == "v2" for lv in labels):
+        failures.append(f"router_requests_total carries no version="
+                        f"'v2' label (saw {sorted(labels)})")
+    ev_versions = {e.attrs.get("version")
+                   for tr in tracing.TRACER.traces()
+                   for e in tr.events if e.name == "dispatched"}
+    if not ev_versions - {None}:
+        failures.append("no dispatched trace span carries a weights "
+                        "version attribute")
+    tracing.TRACER.reset()
+
+    # exactly one up + one down, and the up was a real AOT boot
+    dirs = [d["direction"] for d in scaler.decisions]
+    if dirs != ["up", "down"]:
+        failures.append(f"autoscaler decisions {dirs}, expected "
+                        "exactly ['up', 'down']")
+    if len(router.frontends) != 3:
+        failures.append(f"fleet has {len(router.frontends)} replica "
+                        "slots, expected 3 (2 static + 1 scaled)")
+    if ctl.active_replicas() != [0, 1]:
+        failures.append(f"active replicas {ctl.active_replicas()} "
+                        "after convergence, expected [0, 1]")
+
+    # ---- drain hygiene: zero leaked blocks everywhere --------------
+    for i, fe in enumerate(router.frontends):
+        eng = fe.engine
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.evict_all()
+        if eng.kv.blocks_in_use != 0:
+            failures.append(f"replica {eng.name} leaked "
+                            f"{eng.kv.blocks_in_use} KV blocks")
+        if not eng.kv.allocator.invariant_ok:
+            failures.append(f"replica {eng.name} allocator corrupt")
+
+    stats = {
+        "spilled_blocks": spilled,
+        "flipped": flipped,
+        "mid_upgrade_v2": sum(o == r2 for o, r2 in zip(outs, ref2)),
+        "decisions": [(d["direction"], d["reason"])
+                      for d in scaler.decisions],
+    }
+    return stats, failures
+
+
+def main():
+    from paddle_tpu.analysis import guards
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.metrics import CONTRACT_METRICS
+
+    # the whole lifecycle — boot, upgrade, autoscale, retire — must
+    # stay compile-clean and transfer-clean (ISSUE 12 sanitizers)
+    with guards.sanitize() as wd:
+        stats, failures = run_smoke()
+    failures += [f"compile watchdog: {v}" for v in wd.violations]
+    text = pm.REGISTRY.to_prometheus()
+    print(text)
+    for name in CONTRACT_METRICS:
+        if name not in text:
+            failures.append(f"MISSING serving metric: {name}")
+
+    from paddle_tpu.serving import metrics as sm
+    boots = dict(sm.FLEET_BOOTS.samples())
+    if not boots.get(("cold",)) or boots[("cold",)].value < 1:
+        failures.append(f"fleet_boots_total{{cold}} recorded nothing "
+                        f"(saw {[(k, c.value) for k, c in boots.items()]})")
+    if sm.FLEET_UPGRADES.value < 2:
+        failures.append(f"fleet_upgrades_total = "
+                        f"{sm.FLEET_UPGRADES.value}, expected >= 2 "
+                        "(one per flipped replica)")
+    scale = {lv: c.value for lv, c in sm.FLEET_SCALE_EVENTS.samples()}
+    ups = sum(v for lv, v in scale.items() if lv[0] == "up")
+    downs = sum(v for lv, v in scale.items() if lv[0] == "down")
+    if ups != 1 or downs != 1:
+        failures.append(f"fleet_scale_events_total: {ups} up / "
+                        f"{downs} down, expected exactly 1 / 1 "
+                        f"({scale})")
+    if sm.FLEET_COLD_START.count < 1:
+        failures.append("fleet_cold_start_seconds observed nothing")
+
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAILURE: {f}", file=sys.stderr)
+        return 1
+    print(f"fleet smoke OK: AOT boot zero-compile, "
+          f"{stats['spilled_blocks']} prefix blocks spilled+restored, "
+          f"upgrade flipped {stats['flipped']} "
+          f"({stats['mid_upgrade_v2']}/{N_REQUESTS} mid-stream on v2), "
+          f"autoscaler decisions {stats['decisions']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,15 @@ EXPECTED_METRICS = (
     "paddle_tpu_serving_trace_events_total",
     "paddle_tpu_serving_slo_ttft_p95_seconds",
     "paddle_tpu_serving_slo_breaches_total",
+    # Fleet control plane (ISSUE 17): registered by importing
+    # serving.metrics; activity is exercised by tools/fleet_smoke.py
+    # and tests/test_fleet.py (AOT boots, rolling upgrades, SLO-driven
+    # scale events)
+    "paddle_tpu_serving_fleet_replicas",
+    "paddle_tpu_serving_fleet_boots_total",
+    "paddle_tpu_serving_fleet_upgrades_total",
+    "paddle_tpu_serving_fleet_scale_events_total",
+    "paddle_tpu_serving_fleet_cold_start_seconds",
 )
 
 
