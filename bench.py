@@ -558,6 +558,143 @@ def bench_serving():
     }
 
 
+def bench_serving_multitick(n_requests=16, t_new=65):
+    """Device-resident multi-tick decode (ISSUE 18): the SAME Poisson
+    stream served at ticks_per_dispatch 1, 4 and 8 — decode tokens/sec
+    and inter-token p50/p99 vs N — plus a host-stall-share record for
+    the async-device_get runtime (sync readback vs overlapped) at N=8.
+    Driver contract: decode tok/s strictly improves N=1 -> N=8 (the
+    host dispatch wall is the inter-token floor the while_loop
+    removes), every engine compiles its mixed step exactly once, and
+    outputs stay token-identical across N."""
+    import time as _time
+
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.engine import ServingEngine, STEP_FN_NAME
+
+    rng = np.random.RandomState(0)
+    V = 1024
+    m = GPTForGeneration(vocab_size=V, hidden_size=128, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=512,
+                         compute_dtype="float32")
+    m.eval()
+    lens = rng.randint(4, 40, n_requests)
+    prompts = [rng.randint(1, V, int(n)).astype(np.int32)
+               for n in lens]
+    arrivals = np.cumsum(rng.exponential(0.002, n_requests))
+    arrivals -= arrivals[0]
+
+    def stream(eng):
+        pending = list(zip(prompts, arrivals))
+        reqs, seen, gaps = [], {}, []
+        stall0 = eng.host_stall_total
+        t0 = _time.perf_counter()
+        while pending or eng.scheduler.has_work:
+            now = _time.perf_counter() - t0
+            while pending and pending[0][1] <= now:
+                p, _ = pending.pop(0)
+                reqs.append(eng.submit(p, t_new))
+            if not eng.step() and pending:
+                _time.sleep(max(0.0, pending[0][1]
+                                 - (_time.perf_counter() - t0)))
+                continue
+            now = _time.perf_counter() - t0
+            # inter-token gaps, dispatch-granular: a k-token harvest
+            # contributes k gaps of (now - last)/k — the stream rate a
+            # client consuming the staging buffer actually sees
+            for r in reqs:
+                i = id(r)
+                have = len(r.output)
+                last_n, last_t = seen.get(i, (0, None))
+                if have > last_n:
+                    if last_t is not None:
+                        gaps += [(now - last_t) / (have - last_n)] \
+                            * (have - last_n)
+                    seen[i] = (have, now)
+        wall = _time.perf_counter() - t0
+        toks = sum(len(r.output) for r in reqs)
+        gaps.sort()
+        return {
+            "outputs": [list(r.output) for r in reqs],
+            "tok_s": toks / wall, "wall": wall,
+            "itl_p50_ms": gaps[len(gaps) // 2] * 1e3 if gaps else 0.0,
+            "itl_p99_ms": gaps[min(len(gaps) - 1,
+                                   int(len(gaps) * 0.99))] * 1e3
+            if gaps else 0.0,
+            "stall_share": (eng.host_stall_total - stall0) / wall,
+        }
+
+    def build(n_ticks, multitick_async=True):
+        eng = ServingEngine(m, max_slots=8, block_size=16,
+                            max_seq_len=128, cache_dtype="float32",
+                            seed=0, ticks_per_dispatch=n_ticks,
+                            multitick_async=multitick_async)
+        c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+        # warm: compiles the ONE mixed step (while_loop included);
+        # the timed streams below reuse it
+        eng.generate_batch([prompts[0]], max_new_tokens=2)
+        return eng, int(pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+                        - c0)
+
+    def serve_all(keys, passes=3):
+        """Best-of-`passes` per engine, passes INTERLEAVED across the
+        engines: the single-core harness drifts by 10-20% over seconds
+        (enough to drown the dispatch-wall signal), and round-robin
+        spreads any slow window over every N instead of sinking one."""
+        engines = {k: build(*k) for k in keys}
+        runs = {k: [] for k in keys}
+        for _ in range(passes):
+            for k in keys:
+                runs[k].append(stream(engines[k][0]))
+        out = {}
+        for k in keys:
+            best = max(runs[k], key=lambda r: r["tok_s"])
+            if any(r["outputs"] != best["outputs"] for r in runs[k]):
+                best["outputs"] = None  # nondeterminism across passes
+            best["eng"], best["compiles"] = engines[k]
+            out[k] = best
+        return out
+
+    was_enabled = pm._enabled
+    pm.enable()
+    try:
+        keys = [(1, True), (4, True), (8, True), (8, False)]
+        res = serve_all(keys)
+        by_n = {n: res[(n, True)] for n in (1, 4, 8)}
+        sync8 = res[(8, False)]
+    finally:
+        if not was_enabled:
+            pm.disable()
+    identical = all(by_n[n]["outputs"] == by_n[1]["outputs"]
+                    for n in (4, 8))
+    e8 = by_n[8]["eng"]
+    return {
+        "metric": "serving_multitick",
+        "value": round(by_n[8]["tok_s"], 1), "unit": "tokens/sec",
+        "decode_tok_s_by_n": {
+            str(n): round(by_n[n]["tok_s"], 1) for n in (1, 4, 8)},
+        "itl_p50_ms_by_n": {
+            str(n): round(by_n[n]["itl_p50_ms"], 3)
+            for n in (1, 4, 8)},
+        "itl_p99_ms_by_n": {
+            str(n): round(by_n[n]["itl_p99_ms"], 3)
+            for n in (1, 4, 8)},
+        "speedup_n8_vs_n1": round(by_n[8]["tok_s"]
+                                  / by_n[1]["tok_s"], 3),
+        "host_stall_share_sync": round(sync8["stall_share"], 4),
+        "host_stall_share_async": round(by_n[8]["stall_share"], 4),
+        "ticks_per_dispatch_mean_n8": round(
+            e8.device_ticks_run / max(e8.dispatches_run, 1), 2),
+        "early_exits_n8": dict(e8.early_exit_counts),
+        "outputs_identical_across_n": bool(identical),
+        "mixed_step_compiles": max(r["compiles"]
+                                   for r in by_n.values()),
+        "requests": n_requests,
+    }
+
+
 def bench_serving_disagg():
     """ISSUE 13 extra: disaggregated prefill/decode fleet vs a
     monolithic fleet at EQUAL chip count (2 tiny-GPT engines each,
@@ -1701,6 +1838,16 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["extras"].append(
             {"metric": "serving_router",
+             "error": f"{type(e).__name__}: {e}"})
+
+    # multi-tick decode lane (ISSUE 18): every-platform — decode
+    # tok/s and inter-token p50/p99 at ticks_per_dispatch 1/4/8, plus
+    # the sync-vs-async host-stall share the overlapped readback buys
+    try:
+        result["extras"].append(bench_serving_multitick())
+    except Exception as e:  # noqa: BLE001
+        result["extras"].append(
+            {"metric": "serving_multitick",
              "error": f"{type(e).__name__}: {e}"})
 
     # disaggregated prefill/decode extra: every-platform (1 prefill +

@@ -41,12 +41,15 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 #: dotted-name suffixes that make a call a trace entry; value = index
-#: of the traced-function argument
+#: of the traced-function argument (`lax.while_loop` traces TWO
+#: arguments — cond at 0 and body at 1; `_entry_kind` returns the
+#: full index tuple)
 TRACE_ENTRIES = {
     "instrumented_jit": 0,
     "jax.jit": 0,
     "shard_map": 0,
     "lax.scan": 0,
+    "lax.while_loop": 0,
 }
 
 #: imported-module targets that count for the bare ``shard_map`` /
@@ -76,8 +79,14 @@ class FunctionInfo:
     #: leading params bound host-side by functools.partial at the
     #: trace root — NOT traced values
     partial_bound: int = 0
-    #: which trace entry made it traced ("jit" | "shard_map" | "scan")
+    #: which trace entry made it traced ("jit" | "shard_map" | "scan"
+    #: | "while_loop")
     entry_kind: Optional[str] = None
+    #: True when the function runs INSIDE a device loop — it is a
+    #: scan/while_loop body (or cond), or transitively called from
+    #: one. Host escapes here stall/fail per iteration, not per trace:
+    #: TL107's scope
+    loop_reachable: bool = False
 
     @property
     def name(self):
@@ -408,7 +417,9 @@ class Resolver:
 
     # -------------------------------------------------- root discovery
     def _entry_kind(self, call, scope, module):
-        """(kind, fn_arg_index) when `call` is a trace entry."""
+        """(kind, traced-arg index tuple) when `call` is a trace
+        entry. while_loop traces both its cond (arg 0) and body
+        (arg 1)."""
         name = _dotted(call.func)
         if name is None:
             return None
@@ -416,13 +427,16 @@ class Resolver:
         tail = resolved.rsplit(".", 1)[-1]
         if tail == "instrumented_jit" or resolved == "jax.jit" \
                 or resolved.endswith("jax.jit"):
-            return ("jit", 0)
+            return ("jit", (0,))
         if tail == "shard_map":
             if any(h in resolved for h in _SHARD_MAP_HOMES):
-                return ("shard_map", 0)
+                return ("shard_map", (0,))
             return None
         if resolved.endswith("lax.scan") or resolved == "lax.scan":
-            return ("scan", 0)
+            return ("scan", (0,))
+        if resolved.endswith("lax.while_loop") \
+                or resolved == "lax.while_loop":
+            return ("while_loop", (0, 1))
         return None
 
     def find_roots(self):
@@ -433,23 +447,28 @@ class Resolver:
                 ek = self._entry_kind(call, scope, module)
                 if ek is None:
                     continue
-                kind, argi = ek
-                if len(call.args) <= argi:
-                    continue
+                kind, arg_idx = ek
                 static = donate = ()
                 for kw in call.keywords:
                     if kw.arg == "static_argnums":
                         static = _int_tuple(kw.value)
                     elif kw.arg == "donate_argnums":
                         donate = _int_tuple(kw.value)
-                for fn in self.resolve_function_expr(
-                        call.args[argi], scope, module):
-                    fn.traced = True
-                    fn.trace_entry = True
-                    fn.entry_kind = fn.entry_kind or kind
-                    fn.static_argnums = fn.static_argnums or static
-                    fn.donate_argnums = fn.donate_argnums or donate
-                    self.roots.append(fn)
+                for argi in arg_idx:
+                    if len(call.args) <= argi:
+                        continue
+                    for fn in self.resolve_function_expr(
+                            call.args[argi], scope, module):
+                        fn.traced = True
+                        fn.trace_entry = True
+                        fn.entry_kind = fn.entry_kind or kind
+                        if kind in ("scan", "while_loop"):
+                            fn.loop_reachable = True
+                        fn.static_argnums = fn.static_argnums \
+                            or static
+                        fn.donate_argnums = fn.donate_argnums \
+                            or donate
+                        self.roots.append(fn)
                 if kind == "jit":
                     self._record_handle(call, scope, module,
                                         static, donate)
@@ -477,7 +496,11 @@ class Resolver:
     # ------------------------------------------------------ propagation
     def propagate(self):
         """Transitive closure: calls inside traced functions mark
-        their resolvable package-internal callees traced."""
+        their resolvable package-internal callees traced, and callees
+        of scan/while_loop bodies (or anything already loop-reachable)
+        additionally `loop_reachable` — a function may be revisited
+        ONCE more to push a newly-gained loop flag through callees
+        first discovered via a non-loop path."""
         work = [f for f in self.roots]
         seen = {id(f) for f in work}
         while work:
@@ -486,6 +509,8 @@ class Resolver:
                                         ast.AsyncFunctionDef,
                                         ast.Lambda)):
                 continue
+            in_loop = (fn.loop_reachable
+                       or fn.entry_kind in ("scan", "while_loop"))
             body = fn.node.body if isinstance(fn.node, ast.Lambda) \
                 else fn.node
             for node in ast.walk(body):
@@ -496,7 +521,10 @@ class Resolver:
                     # only package-internal, non-builder targets
                     if callee.module.dotted.startswith("jax"):
                         continue
-                    if id(callee) in seen:
+                    gained_loop = in_loop and not callee.loop_reachable
+                    if gained_loop:
+                        callee.loop_reachable = True
+                    if id(callee) in seen and not gained_loop:
                         continue
                     callee.traced = True
                     seen.add(id(callee))

@@ -53,7 +53,10 @@ from ..profiler.metrics import (COMPILE_WATCHDOG_BUDGET_EXCEEDED,
 #: instances legitimately compile per shape signature (gen_prefill,
 #: HybridGPT.train_many's static k, ...) stay unbudgeted.
 DEFAULT_BUDGETS: Dict[str, int] = {
-    # one mixed step per engine — tests/test_serving.py's contract
+    # one mixed step per engine — tests/test_serving.py's contract.
+    # The multi-tick while_loop wrapper (ISSUE 18) shares this name
+    # and this budget: n_ticks is a traced scalar, so 1-tick mixed
+    # and N-tick pure-decode dispatches run the same executable
     "serving_mixed_step": 1,
     # one fixed-shape pool copy per PagedKVCache (prefix-cache CoW)
     "serving_prefix_cow": 1,

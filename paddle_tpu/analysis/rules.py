@@ -45,6 +45,9 @@ RULES = {
     "TL105": "unhashable (list/dict/set) static argument to a jitted "
              "callable",
     "TL106": "donated buffer read after the donating call",
+    "TL107": "host escape (host call, jax.device_get, .item(), "
+             ".block_until_ready(), .copy_to_host_async()) inside a "
+             "lax.scan/while_loop body or a function it calls",
     "RH201": "non-canonical PartitionSpec (trailing None / singleton "
              "tuple) in a jit-boundary sharding",
     "RH202": "all-None PartitionSpec where jax's cache key wants P()",
@@ -264,6 +267,12 @@ def check_traced_function(fn: FunctionInfo) -> Iterator[Finding]:
     local = _local_names(fn)
     traced = _traced_params(fn)
     cm_exempt = _is_contextmanager(fn)
+    # TL107 scope: the function IS a scan/while_loop cond/body, or is
+    # (transitively) called from one — a host escape here isn't one
+    # frozen value at trace time, it's a per-iteration stall or an
+    # outright tracer error inside the device loop
+    in_loop = (fn.loop_reachable
+               or fn.entry_kind in ("scan", "while_loop"))
 
     # pre-pass: TL104 candidate mutation counts per free name, for the
     # memo-idiom exemption
@@ -293,6 +302,32 @@ def check_traced_function(fn: FunctionInfo) -> Iterator[Finding]:
                     ".item() on a traced value is a host sync and a "
                     "tracer error under jit — return the array and "
                     "read it host-side")
+            # ---- TL107: host escapes inside a device-loop body.
+            # Deliberately NOT np.asarray/np.array — those have
+            # legitimate trace-time static-shape uses in kernel code;
+            # the loop-specific hazards are true syncs
+            if in_loop:
+                what = None
+                if host:
+                    what = f"host call `{host}(...)`"
+                rname = _resolved(module, node.func)
+                if rname and (rname == "jax.device_get"
+                              or rname.endswith(".device_get")):
+                    what = "`jax.device_get(...)`"
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("item",
+                                               "block_until_ready",
+                                               "copy_to_host_async") \
+                        and not node.args:
+                    what = f"`.{node.func.attr}()`"
+                if what:
+                    yield finding(
+                        "TL107", node,
+                        f"{what} inside a lax.scan/while_loop body "
+                        "(reached from the traced graph): the loop "
+                        "runs ON DEVICE — surface per-iteration "
+                        "state through the carry and read it on the "
+                        "host after the loop returns")
             # ---- TL102: float()/int()/bool() on traced params
             if isinstance(node.func, ast.Name) \
                     and node.func.id in ("float", "int", "bool") \

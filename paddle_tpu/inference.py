@@ -55,7 +55,8 @@ class Config:
                                    lora_alpha=None,
                                    moe_weight_dtype=None,
                                    sparse_blocks=None,
-                                   sparse_recent=None):
+                                   sparse_recent=None,
+                                   ticks_per_dispatch=None):
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
@@ -119,7 +120,17 @@ class Config:
         sparsity never recompiles. `kv_dtype="fp8_e4m3"` stores the
         pools as e4m3 bytes under the int8 scale plumbing — half of
         int8's fp32-baseline bytes again, composable with sparsity,
-        TP sharding, transport and the prefix cache."""
+        TP sharding, transport and the prefix cache.
+
+        Device-resident decode (docs/SERVING.md "Device-resident
+        decode", ISSUE 18): `ticks_per_dispatch=N` runs up to N decode
+        ticks per host dispatch inside ONE on-device `lax.while_loop`
+        (token-identical to N=1; still exactly one compiled mixed
+        step), `"auto"` lets the engine pace N from its measured
+        host-gap/tick-time ratio. Speculative decoding (`draft_k > 0`)
+        and history-dependent sampling fall back to single-tick
+        dispatches. In a disaggregated fleet, prefill replicas are
+        pinned to 1 tick and decode replicas default to 4."""
         # validate BEFORE any assignment: a raising call must leave the
         # config exactly as it was (callers catch and retry)
         if kv_dtype is not None:
@@ -137,6 +148,13 @@ class Config:
                 "pass either num_replicas (monolithic fleet) or "
                 "prefill_replicas/decode_replicas (disaggregated), "
                 "not both")
+        if ticks_per_dispatch is not None and ticks_per_dispatch != "auto":
+            if not isinstance(ticks_per_dispatch, int) \
+                    or isinstance(ticks_per_dispatch, bool) \
+                    or ticks_per_dispatch < 1:
+                raise ValueError(
+                    f"ticks_per_dispatch={ticks_per_dispatch!r} must be "
+                    "an int >= 1 or 'auto'")
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
@@ -145,7 +163,8 @@ class Config:
             draft_ngram=draft_ngram, prefix_caching=prefix_caching,
             max_adapters=max_adapters, lora_rank=lora_rank,
             lora_alpha=lora_alpha, moe_weight_dtype=moe_weight_dtype,
-            sparse_blocks=sparse_blocks, sparse_recent=sparse_recent)
+            sparse_blocks=sparse_blocks, sparse_recent=sparse_recent,
+            ticks_per_dispatch=ticks_per_dispatch)
         self._max_pending = max_pending
         self._tensor_parallel = tensor_parallel
         self._expert_parallel = expert_parallel
@@ -356,11 +375,21 @@ def create_serving_router(config: Config, model, sampling=None, seed=0):
             # sparse decode region while still MAINTAINING the block
             # summaries (track_summaries), so their exported blocks
             # match a sparse decode replica's kv_meta geometry
-            ov = {"role": "prefill", "draft_k": 0}
+            # ... and a chunked-prefill-only replica never has a
+            # pure-decode plan, so multi-tick dispatches would just
+            # stage dead control tensors: pin it to 1 tick
+            ov = {"role": "prefill", "draft_k": 0,
+                  "ticks_per_dispatch": 1}
             if (config.serving_config() or {}).get("sparse_blocks"):
                 ov.update(sparse_blocks=None, track_summaries=True)
             return ov
-        return {"role": "decode"}
+        ov = {"role": "decode"}
+        if (config.serving_config() or {}).get(
+                "ticks_per_dispatch") is None:
+            # decode replicas are where the host-dispatch gap lives —
+            # default them onto the device-resident loop
+            ov["ticks_per_dispatch"] = 4
+        return ov
 
     frontends = [ServingFrontend(
         create_serving_engine(config, model, sampling=sampling,

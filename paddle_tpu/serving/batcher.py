@@ -297,8 +297,32 @@ class StepPlan:
     #                         verify logits to compute accept lengths
 
 
+class PlanBuffers:
+    """Reusable numpy backing for `pack_step`'s fixed-shape tensors.
+
+    The multi-tick engine (docs/SERVING.md, "Device-resident decode")
+    keeps TWO of these and ping-pongs between dispatches: dispatch k's
+    arrays may still be feeding an async host→device transfer while
+    the host packs dispatch k+1 into the other buffer, so packing
+    never scribbles over an in-flight plan (the PR 6 double-buffer
+    prefetch discipline applied to the engine's plan tensors)."""
+
+    def __init__(self, token_budget, max_slots):
+        self.token_ids = np.zeros(token_budget, np.int32)
+        self.slot_ids = np.full(token_budget, -1, np.int32)
+        self.positions = np.zeros(token_budget, np.int32)
+        self.sample_index = np.full(max_slots, -1, np.int32)
+
+    def reset(self):
+        self.token_ids[:] = 0
+        self.slot_ids[:] = -1
+        self.positions[:] = 0
+        self.sample_index[:] = -1
+
+
 def pack_step(token_budget, max_slots, decode, prefills,
-              verify_width=1, reserve_region=False) -> StepPlan:
+              verify_width=1, reserve_region=False,
+              buffers: PlanBuffers = None) -> StepPlan:
     """Pack decode entries + prefill chunks into the flat-token layout.
 
     decode: [(slot, token_or_tokens, position)] — one entry per running
@@ -320,14 +344,24 @@ def pack_step(token_budget, max_slots, decode, prefills,
     region. `reserve_region=True` applies the same fixed per-slot
     layout at `verify_width == 1` (block-sparse decode, ISSUE 15:
     decode token of slot s sits at flat index s, and its hidden state
-    still samples through `sample_index` like the dense layout)."""
+    still samples through `sample_index` like the dense layout).
+
+    `buffers` (a `PlanBuffers`) reuses preallocated arrays instead of
+    allocating fresh ones — same layout, same contents."""
     vw = int(verify_width)
     region_on = vw > 1 or reserve_region
     region = max_slots * vw if region_on else 0
-    token_ids = np.zeros(token_budget, np.int32)
-    slot_ids = np.full(token_budget, -1, np.int32)
-    positions = np.zeros(token_budget, np.int32)
-    sample_index = np.full(max_slots, -1, np.int32)
+    if buffers is not None:
+        buffers.reset()
+        token_ids = buffers.token_ids
+        slot_ids = buffers.slot_ids
+        positions = buffers.positions
+        sample_index = buffers.sample_index
+    else:
+        token_ids = np.zeros(token_budget, np.int32)
+        slot_ids = np.full(token_budget, -1, np.int32)
+        positions = np.zeros(token_budget, np.int32)
+        sample_index = np.full(max_slots, -1, np.int32)
     i = 0
     decode_slots = []
     decode_entries = []

@@ -58,6 +58,14 @@ class TPServingEngine(ServingEngine):
     exercising the shard_map plumbing without parallelism);
     `expert_parallel > 1` shards a MoE stack's experts over the extra
     `ep` mesh rows. The host API is identical to the base engine.
+
+    Device-resident multi-tick decode (ISSUE 18) composes for free:
+    the base engine wraps the RESULT of `_build_step()` — here the
+    shard_map'ed body — in its `lax.while_loop`, so the loop sits
+    OUTSIDE the mesh partitioning and the control tail (n_ticks/eos/
+    remain/cap) rides as replicated host inputs like the flat-token
+    data args. Token identity vs N=1 at TP=2 and the one-compile
+    budget are asserted by tests/test_multitick.py.
     """
 
     def __init__(self, model, *, tensor_parallel=2, expert_parallel=1,

@@ -80,7 +80,8 @@ class ServingEngine:
                  role="mixed", max_adapters=0, lora_rank=8,
                  lora_alpha=None, moe_weight_dtype=None,
                  sparse_blocks=None, sparse_recent=2,
-                 track_summaries=None, name=None):
+                 track_summaries=None, name=None,
+                 ticks_per_dispatch=1, multitick_async=None):
         import functools
 
         import jax
@@ -168,6 +169,39 @@ class ServingEngine:
         # token-identity verify)
         self.spec_sampling = (self.draft_k > 0
                               and self.sampling.strategy != "greedy")
+        # device-resident multi-tick decode (docs/SERVING.md "Device-
+        # resident decode"): with ticks_per_dispatch=N>1, pure-decode
+        # dispatches run N ticks inside ONE lax.while_loop around the
+        # mixed step — the host regains control only on per-slot
+        # events (finish/overflow) or when the tick budget runs out.
+        # "auto" sizes N per dispatch from measured step/host times
+        # (staging width stays the fixed maximum, 8). N=1 keeps the
+        # legacy single-tick path byte-for-byte.
+        self._ticks_auto = ticks_per_dispatch == "auto"
+        tp = 8 if self._ticks_auto else int(ticks_per_dispatch)
+        if tp < 1:
+            raise ValueError(
+                f"ticks_per_dispatch={ticks_per_dispatch!r} must be "
+                ">= 1 (or 'auto')")
+        self.ticks_per_dispatch = tp
+        self.multitick_disabled = False
+        if tp > 1 and (self.draft_k > 0
+                       or batcher.needs_history(self.sampling)):
+            # speculation drafts on the host (ngram proposer walks the
+            # request's token history) and penalty sampling rebuilds
+            # the [S, W] history tensor host-side per step — neither
+            # can advance inside a device loop, so the engine falls
+            # back to 1 tick per dispatch rather than refuse the
+            # config (the speculation_disabled precedent). Spec
+            # engines still surface draft rejections as the "reject"
+            # early-exit reason.
+            self.multitick_disabled = True
+        self._multitick = tp > 1 and not self.multitick_disabled
+        if multitick_async is None:
+            import os
+            multitick_async = os.environ.get(
+                "PADDLE_TPU_MULTITICK_ASYNC", "1") != "0"
+        self._multitick_async = bool(multitick_async)
         # block-sparse paged decode attention (ISSUE 15, docs/
         # SERVING.md "Long-context serving"): with `sparse_blocks=B`,
         # every decode/verify query scores the slot's candidate blocks
@@ -294,8 +328,37 @@ class ServingEngine:
         # tracking pools their min/max rows alongside the K/V pools,
         # so every in-step pool write aliases in place
         donate = tuple(range(1, 1 + len(self.kv._pools())))
+        step_fn = self._build_step()
+        if self._multitick:
+            # the while_loop wraps the RESULT of _build_step (for the
+            # TP engine that's the shard_map'ed body, so the loop sits
+            # OUTSIDE the mesh partitioning) and shares the single
+            # serving_mixed_step compile budget: n_ticks is a traced
+            # scalar, so mixed 1-tick and pure-decode N-tick
+            # dispatches run the same executable
+            step_fn = self._build_multitick(step_fn)
         self._step_fn = instrumented_jit(
-            self._build_step(), STEP_FN_NAME, donate_argnums=donate)
+            step_fn, STEP_FN_NAME, donate_argnums=donate)
+        # multi-tick host runtime state: double-buffered plan tensors
+        # (pack k+1 while k's may still be in flight), the deferred
+        # observability lane (dispatch k's metrics/flight flush after
+        # dispatch k+1 launches), and the measured-time EMAs the
+        # "auto" tick heuristic sizes dispatches from
+        self._plan_buffers = None
+        if self._multitick:
+            self._plan_buffers = (
+                batcher.PlanBuffers(self.token_budget, max_slots),
+                batcher.PlanBuffers(self.token_budget, max_slots))
+        self._plan_flip = 0
+        self._deferred = None
+        self._tick_ema = None        # seconds per device tick
+        self._gap_ema = None         # host seconds between dispatches
+        self._last_harvest = None
+        self.dispatches_run = 0
+        self.device_ticks_run = 0
+        self.host_stall_total = 0.0
+        self.early_exit_counts = {"finish": 0, "overflow": 0,
+                                  "reject": 0}
         # fleet control plane (ISSUE 17): checkpoint version label
         # (rides router_requests_total + trace spans) and the ONE
         # jitted budget-1 weight-swap cast shared by every rolling-
@@ -837,6 +900,152 @@ class ServingEngine:
 
         return step
 
+    def _build_multitick(self, base_step):
+        """Wrap the one-tick mixed step in a `lax.while_loop` that runs
+        up to `n_ticks` decode ticks per host dispatch (docs/SERVING.md
+        "Device-resident decode").
+
+        Call signature = the legacy step's, with the control tail
+        appended AFTER the rng (params stay arg 0, donated pools stay
+        1..n, so donation and the AOT export path are untouched):
+
+            ..., rng, n_ticks, eos [S], remain [S], cap [S][, slot_ad]
+
+        `rng` is now the CHAIN key — the loop performs the exact
+        `rng, sub = split(rng)` the legacy host loop does before each
+        step, once per executed tick, and returns the advanced chain,
+        so an N-tick dispatch consumes the identical subkey sequence N
+        legacy steps would (seeded-sampling token identity).
+
+        Tick 0 consumes the host-packed plan arrays verbatim (bit-
+        identity with the single-tick dispatch); ticks >= 1 rebuild
+        the pure-decode inputs by scattering each live slot's previous
+        token at its pack-time anchor (`sample_index` — the dense
+        layout's packed index, the sparse region's own slot index),
+        which reproduces exactly what the host packer would have built
+        for the next step. The loop exits at the FIRST per-slot event
+        so scheduling decisions (admission, preemption, expiry) happen
+        at the same sequence boundaries a 1-tick engine would see.
+
+        Outputs replace the token head with the control block
+        `(staged [S, N], counts [S], events [S], ticks, rng)`:
+        `staged` is the -1-padded token staging buffer, `events` the
+        per-slot bitmask (1 = finish: EOS or horizon; 2 = overflow:
+        next tick would exceed the preallocated block capacity `cap`).
+        Pools (and summed MoE stats) follow as before."""
+        import jax
+        import jax.numpy as jnp
+
+        S = self.kv.max_slots
+        T = self.token_budget
+        N = self.ticks_per_dispatch
+        lora = self.adapters is not None
+        moe = self.num_experts > 0
+        n_pools = len(self.kv._pools())
+        n_ad = len(self.adapters.array_names) if lora else 0
+        E = self.num_experts
+
+        def multitick(arrays, *rest):
+            rest = list(rest)
+            pools0 = tuple(rest[:n_pools])
+            rest = rest[n_pools:]
+            ad_arrays = tuple(rest[:n_ad])
+            rest = rest[n_ad:]
+            (token_ids, slot_ids, positions, block_tables,
+             sample_index) = rest[:5]
+            rest = rest[5:]
+            adapter_ids = rest.pop(0) if lora else None
+            rng0 = rest.pop(0)
+            n_ticks = rest.pop(0)
+            eos = rest.pop(0)
+            remain = rest.pop(0)
+            cap = rest.pop(0)
+            slot_ad = rest.pop(0) if lora else None
+
+            anchors = sample_index                       # [S]
+            live0 = anchors >= 0
+            slot_iota = jnp.arange(S, dtype=jnp.int32)
+            pos0 = jnp.where(
+                live0, positions[jnp.clip(anchors, 0, T - 1)], 0)
+            mstats0 = None
+            if moe:
+                mstats0 = {"counts": jnp.zeros((E,), jnp.float32),
+                           "dropped": jnp.zeros((), jnp.float32),
+                           "aux": jnp.zeros((), jnp.float32)}
+
+            def cond(state):
+                t, _rng, _pools, _staged, _counts, events, live = \
+                    state[:7]
+                return (t < n_ticks) & (
+                    (t == 0)
+                    | (~jnp.any(events > 0) & jnp.any(live)))
+
+            def tick(state):
+                (t, rng, pools_c, staged, counts, events, live,
+                 prev_tok, cur_pos, mstats) = state
+                first = t == 0
+                # scatter rebuild at the pack-time anchors; dead slots
+                # aim at T and are dropped
+                sa = jnp.where(live, anchors, T).astype(jnp.int32)
+                tid = jnp.where(
+                    first, token_ids,
+                    jnp.zeros((T,), jnp.int32)
+                    .at[sa].set(prev_tok, mode="drop"))
+                sid = jnp.where(
+                    first, slot_ids,
+                    jnp.full((T,), -1, jnp.int32)
+                    .at[sa].set(slot_iota, mode="drop"))
+                pid = jnp.where(
+                    first, positions,
+                    jnp.zeros((T,), jnp.int32)
+                    .at[sa].set(cur_pos, mode="drop"))
+                si = jnp.where(first, sample_index,
+                               jnp.where(live, anchors, -1))
+                rng, sub = jax.random.split(rng)
+                call = [arrays] + list(pools_c) + list(ad_arrays)
+                call += [tid, sid, pid, block_tables, si]
+                if lora:
+                    call.append(jnp.where(
+                        first, adapter_ids,
+                        jnp.zeros((T,), jnp.int32)
+                        .at[sa].set(slot_ad, mode="drop")))
+                call.append(sub)
+                res = base_step(*call)
+                tok = res[0]                             # [S] (K == 1)
+                new_pools = res[1:]
+                if moe:
+                    mstats = jax.tree.map(jnp.add, mstats,
+                                          new_pools[-1])
+                    new_pools = new_pools[:-1]
+                staged = staged.at[:, t].set(
+                    jnp.where(live, tok, -1))
+                counts = counts + live.astype(jnp.int32)
+                finish = live & (((eos >= 0) & (tok == eos))
+                                 | (counts >= remain))
+                nxt = cur_pos + 1
+                overflow = live & ~finish & (nxt >= cap)
+                events = (events
+                          | jnp.where(finish, 1, 0)
+                          | jnp.where(overflow, 2, 0))
+                live = live & ~finish & ~overflow
+                return (t + 1, rng, tuple(new_pools), staged, counts,
+                        events, live, tok, nxt, mstats)
+
+            state = (jnp.zeros((), jnp.int32), rng0, pools0,
+                     jnp.full((S, N), -1, jnp.int32),
+                     jnp.zeros((S,), jnp.int32),
+                     jnp.zeros((S,), jnp.int32), live0,
+                     jnp.zeros((S,), jnp.int32), pos0, mstats0)
+            state = jax.lax.while_loop(cond, tick, state)
+            (t, rng, pools_f, staged, counts, events, _live, _tok,
+             _pos, mstats) = state
+            out = ((staged, counts, events, t, rng),) + tuple(pools_f)
+            if moe:
+                out += (mstats,)
+            return out
+
+        return multitick
+
     # ------------------------------------------------------------ intake
     def register_adapter(self, adapter_id, weights):
         """Register a LoRA finetune's host weights (see
@@ -1060,7 +1269,10 @@ class ServingEngine:
             for _ in plan.expired:
                 smetrics.SERVING_REQUESTS.labels("expired").inc()
         if plan.empty:
+            self._flush_deferred()
             return bool(plan.expired)
+        if self._multitick:
+            return self._step_multitick(plan, trace_on, t0)
         sp = pack_step(self.token_budget, self.kv.max_slots,
                        plan.decode, plan.prefills,
                        verify_width=self.draft_k + 1,
@@ -1196,6 +1408,16 @@ class ServingEngine:
                 else:
                     m = accept_length(toks, g)
                     emitted = [int(t) for t in g[:m + 1]]
+                if self.multitick_disabled and m < len(toks) - 1:
+                    # a spec engine asked to multi-tick runs 1-tick
+                    # (drafting is host-side) but still surfaces draft
+                    # rejections under the early-exit taxonomy: this
+                    # is the control-return reason a device-resident
+                    # verify loop would have reported
+                    self.early_exit_counts["reject"] += 1
+                    if _pmetrics._enabled:
+                        smetrics.SERVING_EARLY_EXITS.labels(
+                            "reject").inc()
                 if _pmetrics._enabled:
                     smetrics.SERVING_ACCEPT_LENGTH.observe(m + 1)
                     if len(toks) > 1:
@@ -1294,6 +1516,337 @@ class ServingEngine:
                 **self._flight_extra())
         return True
 
+    # ------------------------------------- multi-tick dispatch (ISSUE 18)
+    def _flush_deferred(self):
+        cb, self._deferred = self._deferred, None
+        if cb is not None:
+            cb()
+
+    def flush_observability(self):
+        """Flush the deferred observability of the LAST multi-tick
+        dispatch (its metrics/flight record normally publish after the
+        NEXT dispatch launches, overlapping device execution). No-op on
+        single-tick engines; the frontend calls this when going idle."""
+        self._flush_deferred()
+
+    def _auto_ticks(self, n_max):
+        """ticks_per_dispatch='auto': size the next dispatch from the
+        measured per-tick device time `d` and inter-dispatch host time
+        `h` (EMAs) — the smallest n that keeps the amortized host share
+        under ~10% of a tick, ceil(h / (0.1 d)), clamped to the staging
+        width. Cold EMAs run the full budget (the measurement itself)."""
+        d, h = self._tick_ema, self._gap_ema
+        if not d or not h:
+            return n_max
+        import math
+        return max(1, min(n_max, math.ceil(h / max(0.1 * d, 1e-9))))
+
+    def _step_multitick(self, plan, trace_on, t0):
+        """The multi-tick twin of `step()`'s post-plan body: preallocate
+        tick capacity, launch the while_loop dispatch, harvest the
+        staging buffer, and replay the emitted tokens through the same
+        host bookkeeping a 1-tick engine runs per step."""
+        import jax.numpy as jnp
+        sch = self.scheduler
+        S = self.kv.max_slots
+        t_launch = self.clock()
+        if self._gap_ema is not None or self._last_harvest is not None:
+            gap = max(t_launch - (self._last_harvest or t_launch), 0.0)
+            self._gap_ema = (gap if self._gap_ema is None
+                             else 0.7 * self._gap_ema + 0.3 * gap)
+        buf = self._plan_buffers[self._plan_flip]
+        self._plan_flip ^= 1
+        sp = pack_step(self.token_budget, S, plan.decode,
+                       plan.prefills, verify_width=1,
+                       reserve_region=self._sparse, buffers=buf)
+        # multi-tick only on pure-decode dispatches: a prefill chunk
+        # needs the host packer next step anyway, and a prefill-role
+        # engine's completions park in "handoff" — both pin n to 1
+        n = self.ticks_per_dispatch if not plan.prefills else 1
+        if n > 1 and self._ticks_auto:
+            n = self._auto_ticks(self.ticks_per_dispatch)
+        eos = np.full(S, -1, np.int32)
+        remain = np.zeros(S, np.int32)
+        cap = np.zeros(S, np.int32)
+        for slot, _tok, pos in plan.decode:
+            req = sch.slots[slot]
+            if req is None:
+                continue
+            if req.eos_token_id is not None:
+                eos[slot] = int(req.eos_token_id)
+            remain[slot] = req.max_new_tokens - len(req.output)
+            # FREE-block tick preallocation (scheduler.extend_for_ticks)
+            # — block_tables below is snapshotted AFTER, so in-device
+            # appends of later ticks land in already-mapped blocks
+            cap[slot] = (sch.extend_for_ticks(slot, pos, n)
+                         if n > 1 else pos + 1)
+        args = [self._arrays] + self.kv._pools()
+        if self.adapters is not None:
+            args += self.adapters.device_arrays()
+        args += [jnp.asarray(sp.token_ids), jnp.asarray(sp.slot_ids),
+                 jnp.asarray(sp.positions),
+                 jnp.asarray(self.kv.block_tables),
+                 jnp.asarray(sp.sample_index)]
+        if self.adapters is not None:
+            args.append(jnp.asarray(self._adapter_token_ids(sp)))
+        # CHAIN key, always as a HOST array: the loop splits per tick
+        # and returns the advanced chain, which harvest materializes
+        # back to host — a device-resident key would flip the arg's
+        # sharding between dispatch 1 and 2 and recompile the step
+        args.append(np.asarray(self._rng))
+        args += [jnp.asarray(np.int32(n)), jnp.asarray(eos),
+                 jnp.asarray(remain), jnp.asarray(cap)]
+        if self.adapters is not None:
+            slot_ad = np.zeros(S, np.int32)
+            for s, req in enumerate(sch.slots):
+                if req is not None:
+                    slot_ad[s] = req.adapter_slot
+            args.append(jnp.asarray(slot_ad))
+        res = self._step_fn(*args)
+        moe_stats = None
+        if self.num_experts:
+            res, moe_stats = res[:-1], res[-1]
+        staged_d, counts_d, events_d, ticks_d, new_rng = res[0]
+        self.kv._set_pools(res[1:])
+        if self._multitick_async:
+            # async device_get: start the control-output copies and
+            # flush the PREVIOUS dispatch's deferred observability
+            # while this dispatch still runs on device
+            for a in (staged_d, counts_d, events_d, ticks_d, new_rng):
+                try:
+                    a.copy_to_host_async()
+                except Exception:
+                    pass
+            self._flush_deferred()
+        hs0 = self.clock()
+        counts_np = np.asarray(counts_d)
+        events_np = np.asarray(events_d)
+        staged_np = np.asarray(staged_d)
+        ticks_run = int(ticks_d)
+        # the advanced CHAIN key comes back to host: next dispatch then
+        # passes the same uncommitted-host-key signature as the first
+        # (under the TP mesh a device-resident sharded key would change
+        # the arg sharding and force a second compile)
+        self._rng = np.asarray(new_rng)
+        host_stall = self.clock() - hs0
+        self._last_harvest = self.clock()
+        self.host_stall_total += host_stall
+        if not self._multitick_async:
+            # sync mode (the bench's "before" arm): block on readback
+            # first, do last dispatch's bookkeeping after — the legacy
+            # ordering the async lane exists to beat
+            self._flush_deferred()
+        if ticks_run > 0:
+            d = (self._last_harvest - t_launch) / ticks_run
+            self._tick_ema = (d if self._tick_ema is None
+                              else 0.7 * self._tick_ema + 0.3 * d)
+            if self._gap_ema is None:
+                self._gap_ema = 0.0    # arm the gap EMA from now on
+        sch.note_fed(plan)
+        self.steps_run += 1
+        self.dispatches_run += 1
+        self.device_ticks_run += ticks_run
+        decode_emitted = 0
+        if n > 1:
+            # advance each decode slot to what the device actually
+            # emitted and release the preallocated tail — dispatch-
+            # boundary block state matches a 1-tick engine's exactly
+            for slot, _tok, pos in plan.decode:
+                c = max(int(counts_np[slot]), 1)
+                self.kv.slot_lens[slot] = pos + c
+                self.kv.truncate_slot(slot, pos + c)
+        if self._sparse and plan.decode:
+            for slot, _tok, pos in plan.decode:
+                c = max(int(counts_np[slot]), 1)
+                for j in range(c):
+                    n_blk = (pos + j) // self.block_size + 1
+                    self.sparse_candidate_blocks += n_blk
+                    self.sparse_selected_blocks += min(
+                        n_blk, self.sparse_table_width)
+        now = self.clock()
+        if trace_on:
+            for slot, chunk, start, completes in plan.prefills:
+                req = sch.slots[slot]
+                if req is not None:
+                    _tracing.TRACER.event(
+                        req.trace_id, "prefill_chunk",
+                        replica=self.name, ts=now, start=int(start),
+                        tokens=len(chunk), completes=bool(completes))
+
+        def emit(req, tokens):
+            """Same terminal bookkeeping as the 1-tick `emit`: TTFT /
+            inter-token metrics, EOS + horizon replay (which lands on
+            exactly the token the device's finish event flagged)."""
+            if req.state == "prefill":
+                req.state = "decode"
+            first = req.first_token_time is None
+            gap = None
+            if first:
+                req.first_token_time = now
+                if _pmetrics._enabled:
+                    smetrics.SERVING_TTFT_SECONDS.observe(
+                        now - req.submit_time)
+            elif req._last_token_time is not None:
+                gap = now - req._last_token_time
+                if _pmetrics._enabled:
+                    smetrics.SERVING_INTER_TOKEN_SECONDS.observe(gap)
+            req._last_token_time = now
+            if trace_on:
+                if first:
+                    _tracing.on_first_token(req, self.name, ts=now)
+                else:
+                    _tracing.on_tokens(req, self.name, ts=now,
+                                       n=len(tokens), gap=gap,
+                                       verify=False)
+            for t in tokens:
+                req.output.append(t)
+                if len(req.output) >= req.max_new_tokens or \
+                        (req.eos_token_id is not None
+                         and t == req.eos_token_id):
+                    sch.finish(req, now)
+                    if _pmetrics._enabled:
+                        smetrics.SERVING_REQUESTS.labels(
+                            "finished").inc()
+                    return True
+            return False
+
+        for slot in sp.prefill_done:
+            req = sch.slots[slot]
+            if req is not None:
+                done = emit(req, [int(staged_np[slot, 0])])
+                if not done and self.role == "prefill":
+                    req.state = "handoff"
+                    if trace_on:
+                        _tracing.TRACER.event(
+                            req.trace_id, "handoff",
+                            replica=self.name, ts=now)
+        for slot in sp.decode_slots:
+            req = sch.slots[slot]
+            if req is not None:
+                c = max(int(counts_np[slot]), 1)
+                decode_emitted += c
+                emit(req, [int(t) for t in staged_np[slot, :c]])
+        ev_finish = ev_over = 0
+        if n > 1:
+            ev_finish = int(np.sum((events_np & 1) > 0))
+            ev_over = int(np.sum((events_np & 2) > 0))
+            self.early_exit_counts["finish"] += ev_finish
+            self.early_exit_counts["overflow"] += ev_over
+        if moe_stats is not None:
+            # counts/dropped are per-tick sums; aux reports the mean
+            # balance loss over the executed ticks
+            moe_stats = dict(
+                moe_stats,
+                aux=moe_stats["aux"] / max(ticks_run, 1))
+            self._note_moe_stats(moe_stats)
+        # deferred observability: capture every value NOW, publish
+        # after the next dispatch launches (or at idle/flush points)
+        snap = dict(
+            prefill_tokens=int(sp.prefill_tokens),
+            decode_tokens=int(decode_emitted),
+            queue_depth=len(sch.queue),
+            active_slots=int(sch.num_active),
+            blocks_in_use=int(self.kv.blocks_in_use),
+            utilization=float(self.kv.utilization),
+            bytes_per_token=float(self.kv.kv_bytes_per_token),
+            new_preempt=sch.preemption_count - self._preempt_seen,
+            new_imported=(self.kv.blocks_imported
+                          - self._imported_seen),
+            sparse_sel=self.sparse_selected_blocks,
+            sparse_cand=self.sparse_candidate_blocks,
+            blocks_imported=int(self.kv.blocks_imported),
+            ticks=ticks_run, host_stall=float(host_stall),
+            ev_finish=ev_finish, ev_over=ev_over,
+            dur=self.clock() - t0 if trace_on else 0.0)
+        self._preempt_seen = sch.preemption_count
+        self._imported_seen = self.kv.blocks_imported
+        prefix_deltas = None
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            h0, m0, e0 = self._prefix_seen
+            prefix_deltas = (pc.hit_tokens - h0, pc.miss_tokens - m0,
+                             pc.evictions - e0)
+            self._prefix_seen = (pc.hit_tokens, pc.miss_tokens,
+                                 pc.evictions)
+        try:
+            compiled = int(self._step_fn._jitted._cache_size())
+        except Exception:
+            compiled = -1
+
+        def observe():
+            if _pmetrics._enabled:
+                smetrics.SERVING_STEPS.inc()
+                smetrics.SERVING_TOKENS.labels("prefill").inc(
+                    snap["prefill_tokens"])
+                smetrics.SERVING_TOKENS.labels("decode").inc(
+                    snap["decode_tokens"])
+                smetrics.SERVING_QUEUE_DEPTH.set(snap["queue_depth"])
+                smetrics.SERVING_ACTIVE_SLOTS.set(snap["active_slots"])
+                smetrics.SERVING_KV_BLOCKS_IN_USE.set(
+                    snap["blocks_in_use"])
+                smetrics.SERVING_KV_BLOCK_UTILIZATION.set(
+                    snap["utilization"])
+                smetrics.SERVING_KV_BYTES_PER_TOKEN.set(
+                    snap["bytes_per_token"])
+                smetrics.SERVING_TICKS_PER_DISPATCH.observe(
+                    snap["ticks"])
+                smetrics.SERVING_HOST_STALL_SECONDS.inc(
+                    snap["host_stall"])
+                if snap["ev_finish"]:
+                    smetrics.SERVING_EARLY_EXITS.labels("finish").inc(
+                        snap["ev_finish"])
+                if snap["ev_over"]:
+                    smetrics.SERVING_EARLY_EXITS.labels(
+                        "overflow").inc(snap["ev_over"])
+                if self._sparse and snap["sparse_cand"]:
+                    skipped = snap["sparse_cand"] - snap["sparse_sel"]
+                    if skipped > self._sparse_skip_seen:
+                        smetrics.SERVING_KV_BLOCKS_SKIPPED.inc(
+                            skipped - self._sparse_skip_seen)
+                        self._sparse_skip_seen = skipped
+                    smetrics.SERVING_SPARSE_ATTENTION_RATIO.set(
+                        snap["sparse_sel"] / snap["sparse_cand"])
+                if snap["new_preempt"]:
+                    smetrics.SERVING_PREEMPTIONS.inc(
+                        snap["new_preempt"])
+                if snap["new_imported"]:
+                    smetrics.SERVING_KV_BLOCKS_MIGRATED.inc(
+                        snap["new_imported"])
+                if prefix_deltas is not None:
+                    dh, dm, de = prefix_deltas
+                    if dh:
+                        smetrics.SERVING_PREFIX_HIT_TOKENS.inc(dh)
+                    if dm:
+                        smetrics.SERVING_PREFIX_MISS_TOKENS.inc(dm)
+                    if de:
+                        smetrics.SERVING_PREFIX_EVICTIONS.inc(de)
+            if trace_on:
+                self.flight.note(
+                    ts=t0, dur=snap["dur"],
+                    prefill_tokens=snap["prefill_tokens"],
+                    decode_tokens=snap["decode_tokens"],
+                    active_slots=snap["active_slots"],
+                    queue_depth=snap["queue_depth"],
+                    spec_accept_tokens=0, spec_groups=0,
+                    sparse_skip_ratio=(
+                        1.0 - snap["sparse_sel"] / snap["sparse_cand"]
+                        if self._sparse and snap["sparse_cand"]
+                        else 0.0),
+                    blocks_imported=snap["blocks_imported"],
+                    compile_cache_size=compiled,
+                    ticks=snap["ticks"],
+                    host_stall=snap["host_stall"],
+                    early_exit_finish=snap["ev_finish"],
+                    early_exit_overflow=snap["ev_over"],
+                    **self._flight_extra())
+
+        if sch.has_work:
+            self._deferred = observe
+        else:
+            # drain point: nothing will launch next, publish now
+            observe()
+        return True
+
     def run(self, max_steps=None):
         """Drive until every submitted request reaches a terminal
         state (or max_steps)."""
@@ -1309,6 +1862,9 @@ class ServingEngine:
                     f"{self.block_size}) cannot cover the resident "
                     "working set; raise num_blocks or lower max_slots")
             steps += 1
+        # the last dispatch's observability may still be parked in the
+        # deferred lane — publish before handing control back
+        self._flush_deferred()
         return steps
 
     def generate_batch(self, prompts, max_new_tokens=32):
@@ -1344,6 +1900,20 @@ class ServingEngine:
         if batcher.needs_history(self.sampling):
             args.append(jnp.asarray(self._penalty_history()))
         args.append(sub)
+        if self._multitick:
+            # the while_loop wrapper's control tail (n_ticks / eos /
+            # remain / cap [/ per-slot adapter ids]) — same fixed
+            # shapes every live dispatch passes
+            S = self.kv.max_slots
+            # the loop takes the CHAIN key (as a host array, like every
+            # live dispatch), not the split sub
+            args[-1] = np.asarray(self._rng)
+            args += [jnp.asarray(np.int32(1)),
+                     jnp.asarray(np.full(S, -1, np.int32)),
+                     jnp.asarray(np.zeros(S, np.int32)),
+                     jnp.asarray(np.zeros(S, np.int32))]
+            if self.adapters is not None:
+                args.append(jnp.asarray(np.zeros(S, np.int32)))
         return args
 
     def install_aot_step(self, fn):
@@ -1420,6 +1990,7 @@ class ServingEngine:
         docs/DEPLOYMENT.md). Returns the number of blocks spilled.
         Idempotent; the engine must be drained (no resident
         requests)."""
+        self._flush_deferred()
         spilled = 0
         if self.prefix_cache is not None:
             if spill_prefix is not None:

@@ -487,7 +487,13 @@ class ServingFrontend:
             # drained the fair queue (engine FIFO empty => depth free),
             # so sleep until a submission or cancel wakes us — or the
             # soonest frontend-held deadline passes (those handles
-            # never reach the scheduler's expiry sweep)
+            # never reach the scheduler's expiry sweep). A multi-tick
+            # engine may still hold the last dispatch's deferred
+            # metrics/flight record — publish before sleeping so
+            # scrapes during idle see the drained totals.
+            flush = getattr(self.engine, "flush_observability", None)
+            if flush is not None:
+                flush()
             self._wake.clear()
             soonest = self._next_pending_deadline()
             try:

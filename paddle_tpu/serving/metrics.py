@@ -245,6 +245,24 @@ FLEET_COLD_START = REGISTRY.histogram(
     "AOT-vs-jit A/B bench.py's serving_fleet_ops lane measures",
     buckets=exponential_buckets(1e-3, 4.0, 10))
 
+# ---- device-resident multi-tick decode (ISSUE 18) ----------------------
+SERVING_TICKS_PER_DISPATCH = REGISTRY.histogram(
+    "paddle_tpu_serving_ticks_per_dispatch",
+    "Decode ticks the device ran per host dispatch (the lax.while_loop "
+    "trip count: ticks_per_dispatch unless an early-exit event — "
+    "finish/overflow — returned control to the scheduler sooner)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+SERVING_HOST_STALL_SECONDS = REGISTRY.counter(
+    "paddle_tpu_serving_host_stall_seconds_total",
+    "Wall seconds the host loop spent blocked on device readback of a "
+    "tick batch (staging buffer + event bitmask): the dispatch-wall "
+    "share the async device_get path is meant to hide")
+SERVING_EARLY_EXITS = REGISTRY.counter(
+    "paddle_tpu_serving_early_exits_total",
+    "Per-slot events that returned control to the scheduler before the "
+    "dispatch's tick budget ran out",
+    ("reason",))   # finish (EOS/horizon) | overflow (blocks) | reject (draft)
+
 #: every name above, for the smoke-tool contract check
 CONTRACT_METRICS = (
     "paddle_tpu_serving_ttft_seconds",
@@ -324,6 +342,12 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_fleet_upgrades_total",
     "paddle_tpu_serving_fleet_scale_events_total",
     "paddle_tpu_serving_fleet_cold_start_seconds",
+    # device-resident multi-tick decode (ISSUE 18): while_loop trip
+    # counts per dispatch, the readback stall the async host runtime
+    # hides, and the per-slot events that hand control back early
+    "paddle_tpu_serving_ticks_per_dispatch",
+    "paddle_tpu_serving_host_stall_seconds_total",
+    "paddle_tpu_serving_early_exits_total",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

@@ -377,6 +377,27 @@ class Scheduler:
         draft = self.draft_fn(req.prompt + req.output)
         return [req.output[-1]] + [int(t) for t in draft[:k]]
 
+    # ------------------------------------------- multi-tick preallocation
+    def extend_for_ticks(self, slot, pos, n_ticks):
+        """Pre-extend one decode slot's block tables so a multi-tick
+        dispatch (engine `ticks_per_dispatch`, docs/SERVING.md) can
+        append up to `n_ticks` tokens starting at `pos` without host
+        intervention. The first tick's block is already guaranteed by
+        `plan()` (with preemption); the extra ticks extend with FREE
+        blocks only — exactly the draft/prefill discipline — so a tick
+        burst can never evict a neighbour's resident KV. Returns the
+        capacity in tokens the dispatch may fill (`cap`, with
+        pos + 1 <= cap <= pos + n_ticks); the engine truncates back to
+        what was actually emitted at harvest, so the block accounting
+        at every dispatch boundary matches a 1-tick engine's."""
+        k = min(int(n_ticks) - 1, self.kv.max_slot_tokens - (pos + 1))
+        while k > 0 and not self.kv.ensure_capacity(slot, pos + 1 + k):
+            fit = (self.kv.slot_num_blocks(slot)
+                   + self.kv.allocator.num_free) \
+                * self.kv.block_size - (pos + 1)
+            k = min(k - 1, fit) if fit > 0 else 0
+        return pos + 1 + max(k, 0)
+
     # ------------------------------------------------------------ plan
     def plan(self) -> Plan:
         """One engine iteration's work. Mutates scheduler/cache state

@@ -546,6 +546,22 @@ class StepFlightRecorder:
             durs = [r.get("dur", 0.0) for r in recs]
             agg["step_ms_mean"] = sum(durs) / len(durs) * 1e3
             agg["step_ms_max"] = max(durs) * 1e3
+            # device-resident multi-tick dispatches (ISSUE 18): ticks
+            # the while_loop ran per dispatch plus the event-bitmask
+            # exit taxonomy — absent on single-tick engines, whose
+            # records carry no tick fields
+            ticks = [r["ticks"] for r in recs if "ticks" in r]
+            if ticks:
+                agg["dispatches"] = len(ticks)
+                agg["ticks_total"] = sum(ticks)
+                agg["ticks_per_dispatch_mean"] = (
+                    sum(ticks) / len(ticks))
+                agg["early_exit_finish"] = sum(
+                    r.get("early_exit_finish", 0) for r in recs)
+                agg["early_exit_overflow"] = sum(
+                    r.get("early_exit_overflow", 0) for r in recs)
+                agg["host_stall_s"] = sum(
+                    r.get("host_stall", 0.0) for r in recs)
         return agg
 
 

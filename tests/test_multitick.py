@@ -1,0 +1,328 @@
+"""Device-resident multi-tick decode tests (ISSUE 18 tentpole).
+
+Contracts: an engine with `ticks_per_dispatch=N` runs up to N decode
+ticks per host dispatch inside ONE on-device `lax.while_loop` and is
+token-identical to the N=1 engine across the whole feature matrix —
+greedy, seeded sampling, preemption under block pressure, block-sparse
++ fp8 KV, LoRA adapters, TP=2 — while still compiling the mixed step
+exactly ONCE (n_ticks is a traced scalar, so 1-tick and N-tick
+dispatches share the executable; the suite-wide compile watchdog
+backstops every test here). Speculation and history-dependent sampling
+fall back to single-tick dispatches. The `inference.Config` knob
+validates before mutating and the disaggregated router pins prefill
+replicas to 1 tick.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForGeneration
+from paddle_tpu.profiler import metrics as pm
+from paddle_tpu.serving.batcher import SamplingConfig
+from paddle_tpu.serving.engine import STEP_FN_NAME, ServingEngine
+
+
+def _model(vocab=193):
+    paddle.seed(1234)
+    m = GPTForGeneration(vocab_size=vocab, hidden_size=32, num_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=128,
+                         compute_dtype="float32")
+    m.eval()
+    return m
+
+
+def _prompts(vocab=193, lens=(5, 9, 3, 12)):
+    rng = np.random.RandomState(0)
+    return [rng.randint(1, vocab, n).tolist() for n in lens]
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", "float32")
+    kw.setdefault("seed", 0)
+    return ServingEngine(m, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+def _run_pair(mk, prompts, n, max_new_tokens=8):
+    """Build the N=1 reference and the N=n engine from the same
+    factory; return (ref_outputs, outputs, engine, mixed-step
+    compiles of the N=n engine)."""
+    ref = mk(1).generate_batch(prompts, max_new_tokens=max_new_tokens)
+    pm.enable()
+    pm.REGISTRY.reset()
+    try:
+        eng = mk(n)
+        c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+        out = eng.generate_batch(prompts, max_new_tokens=max_new_tokens)
+        compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0
+    finally:
+        pm.REGISTRY.reset()
+        pm.disable()
+    return ref, out, eng, compiles
+
+
+# ------------------------------------------------- identity matrix
+
+
+class TestMultitickIdentity:
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_greedy_token_identical(self, model, n):
+        ref, out, eng, compiles = _run_pair(
+            lambda k: _engine(model, ticks_per_dispatch=k),
+            _prompts(), n)
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+        # the loop really multi-ticked: more device ticks than host
+        # dispatches, and the early-exit taxonomy recorded events
+        assert eng.device_ticks_run > eng.dispatches_run
+        ee = eng.early_exit_counts
+        assert ee["finish"] + ee["overflow"] > 0
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_seeded_sampling_token_identical(self, model, n):
+        """The carry threads the PRNG chain through the loop: per-tick
+        `random.split` on device must reproduce the host-loop chain
+        bit-exactly."""
+        sc = SamplingConfig(strategy="sampling", temperature=1.2,
+                            top_k=40, top_p=0.9)
+        ref, out, eng, compiles = _run_pair(
+            lambda k: _engine(model, sampling=sc, seed=7,
+                              ticks_per_dispatch=k),
+            _prompts(), n)
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_preemption_token_identical(self, model, n):
+        """Block pressure (num_blocks=14) forces preempt/resume cycles;
+        the per-slot cap lane must stop a preempted slot's ticks at its
+        preallocated frontier, never past it."""
+        ref, out, eng, compiles = _run_pair(
+            lambda k: _engine(model, num_blocks=14, ticks_per_dispatch=k),
+            _prompts(), n)
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_sparse_fp8_token_identical(self, model, n):
+        """Block-sparse decode attention + fp8 pools: the in-loop block
+        count must grow per tick exactly as the host loop's width-1
+        formula does."""
+        ref, out, eng, compiles = _run_pair(
+            lambda k: _engine(model, kv_dtype="fp8_e4m3",
+                              sparse_blocks=12, ticks_per_dispatch=k),
+            _prompts(), n)
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+
+    def test_auto_mode_token_identical(self, model):
+        """`ticks_per_dispatch="auto"` paces N from the host-gap/tick
+        EMAs; whatever N it picks, tokens cannot move."""
+        ref, out, eng, compiles = _run_pair(
+            lambda k: _engine(
+                model,
+                ticks_per_dispatch="auto" if k != 1 else 1),
+            _prompts(), 8)
+        assert out == ref
+        assert compiles == 1
+        assert eng._ticks_auto and eng.ticks_per_dispatch == 8
+
+
+class TestMultitickAdapters:
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_lora_slots_token_identical(self, model, n):
+        """Per-slot adapter ids ride the control tail: rebuilt ticks
+        must keep each slot on its own adapter."""
+        from tests.test_adapters import make_random_adapter
+        ad = make_random_adapter(model.decoder, 4, seed=1, scale=0.3)
+        prompts = _prompts()
+
+        def run(k):
+            pm.enable()
+            pm.REGISTRY.reset()
+            try:
+                eng = _engine(model, max_adapters=3, lora_rank=4,
+                              ticks_per_dispatch=k)
+                eng.register_adapter("t1", ad)
+                c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+                reqs = [eng.submit(p, 8,
+                                   adapter_id="t1" if i % 2 else None)
+                        for i, p in enumerate(prompts)]
+                eng.run()
+                c = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0
+                return [list(r.output) for r in reqs], eng, c
+            finally:
+                pm.REGISTRY.reset()
+                pm.disable()
+
+        ref, _, _ = run(1)
+        out, eng, compiles = run(n)
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+
+
+class TestMultitickTP:
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_tp2_token_identical_one_compile(self, model, n):
+        """The while_loop wraps the shard_map'ed step body, so the loop
+        sits OUTSIDE the mesh partitioning and the control tail stays
+        replicated — including the PRNG chain, which the host must
+        round-trip as a host array or the second dispatch sees a
+        sharded key and recompiles."""
+        import jax
+
+        from paddle_tpu.serving.distributed import TPServingEngine
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        prompts = _prompts()
+        ref = _engine(model).generate_batch(prompts, max_new_tokens=8)
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            eng = TPServingEngine(model, tensor_parallel=2,
+                                  max_slots=4, block_size=4,
+                                  max_seq_len=64,
+                                  cache_dtype="float32", seed=0,
+                                  ticks_per_dispatch=n)
+            c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+            out = eng.generate_batch(prompts, max_new_tokens=8)
+            compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+        assert eng.device_ticks_run > eng.dispatches_run
+
+
+# ------------------------------------------------- fallback + plumbing
+
+
+class TestMultitickFallbacks:
+    def test_speculation_disables_multitick(self, model):
+        """draft_k > 0 needs the host-side verify loop every step, so
+        the engine silently falls back to single-tick dispatches and
+        stays token-identical."""
+        prompts = _prompts()
+        ref = _engine(model).generate_batch(prompts, max_new_tokens=8)
+        eng = _engine(model, draft_k=3, ticks_per_dispatch=4)
+        assert eng.multitick_disabled and not eng._multitick
+        assert eng.generate_batch(prompts, max_new_tokens=8) == ref
+
+    def test_bad_ticks_rejected(self, model):
+        for bad in (0, -1, "fast"):
+            with pytest.raises((ValueError, TypeError)):
+                _engine(model, ticks_per_dispatch=bad)
+
+    def test_flight_recorder_dispatch_fields(self, model):
+        """Multi-tick dispatches land ticks/early-exit/host-stall
+        fields in the per-engine flight recorder summary."""
+        from paddle_tpu.serving import tracing
+        eng = _engine(model, ticks_per_dispatch=4)
+        tracing.enable()
+        try:
+            eng.generate_batch(_prompts(), max_new_tokens=8)
+        finally:
+            tracing.disable()
+        agg = eng.flight.summary()
+        assert agg["dispatches"] > 0
+        assert agg["ticks_total"] == eng.device_ticks_run
+        assert agg["ticks_per_dispatch_mean"] > 1.0
+        assert agg["host_stall_s"] >= 0.0
+
+
+class TestConfigPlumbing:
+    def test_knob_validates_before_mutating(self):
+        from paddle_tpu.inference import Config
+        c = Config()
+        for bad in (0, -2, 1.5, True, "fast"):
+            with pytest.raises(ValueError):
+                c.enable_continuous_batching(ticks_per_dispatch=bad)
+            assert c.serving_config() is None
+        c.enable_continuous_batching(max_slots=2, ticks_per_dispatch=8)
+        assert c.serving_config()["ticks_per_dispatch"] == 8
+        c2 = Config()
+        c2.enable_continuous_batching(ticks_per_dispatch="auto")
+        assert c2.serving_config()["ticks_per_dispatch"] == "auto"
+
+    def test_create_engine_passthrough(self, model):
+        from paddle_tpu.inference import Config, create_serving_engine
+        c = Config()
+        c.enable_continuous_batching(
+            max_slots=4, block_size=4, max_seq_len=64,
+            cache_dtype="float32", ticks_per_dispatch=4)
+        eng = create_serving_engine(c, model)
+        assert eng.ticks_per_dispatch == 4 and eng._multitick
+
+    def test_disagg_roles_pin_prefill_default_decode(self, model):
+        """Prefill replicas are pinned to 1 tick; decode replicas
+        default onto the device-resident loop when the config leaves
+        the knob unset."""
+        from paddle_tpu.inference import Config, create_serving_router
+        c = Config()
+        c.enable_continuous_batching(
+            max_slots=4, block_size=4, max_seq_len=64,
+            cache_dtype="float32", prefill_replicas=1,
+            decode_replicas=1)
+        router = create_serving_router(c, model)
+        engines = [f.engine for f in router.frontends]
+        assert engines[0].role == "prefill"
+        assert engines[0].ticks_per_dispatch == 1
+        assert engines[1].role == "decode"
+        assert engines[1].ticks_per_dispatch == 4
+        # an explicit config value overrides the decode default
+        c2 = Config()
+        c2.enable_continuous_batching(
+            max_slots=4, block_size=4, max_seq_len=64,
+            cache_dtype="float32", prefill_replicas=1,
+            decode_replicas=1, ticks_per_dispatch=2)
+        router2 = create_serving_router(c2, model)
+        engines2 = [f.engine for f in router2.frontends]
+        assert engines2[0].ticks_per_dispatch == 1
+        assert engines2[1].ticks_per_dispatch == 2
+
+
+# ------------------------------------------------- smoke-tool wiring
+
+
+def test_multitick_smoke_tool(capsys):
+    """tools/multitick_smoke.py is the multi-tick CI contract: one
+    Poisson stream through N=1/4/8 engines, token-identical, one
+    compile each, early exits recorded, every serving metric name
+    present."""
+    import importlib.util
+    import os
+
+    pm.REGISTRY.reset()
+    was = pm._enabled
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "multitick_smoke.py")
+    spec = importlib.util.spec_from_file_location("multitick_smoke",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        rc = mod.main()
+        out = capsys.readouterr().out
+        assert rc == 0
+        from paddle_tpu.serving.metrics import CONTRACT_METRICS
+        for name in CONTRACT_METRICS:
+            assert name in out
+    finally:
+        pm.REGISTRY.reset()
+        if not was:
+            pm.disable()

@@ -261,6 +261,100 @@ class TestTraceRules:
         """})
         assert [f.rule for f in fs] == ["RH203"]
 
+    # ------------------------------------------ TL107: device loops
+    def test_host_call_in_while_loop_body_flagged(self, tmp_path):
+        fs = lint_pkg(tmp_path, {"m.py": """
+            import time
+            import jax
+            from jax import lax
+
+            def cond(s):
+                return s[0] < 4
+
+            def body(s):
+                t = time.time()
+                return (s[0] + 1, s[1] * t)
+
+            def run(x):
+                return lax.while_loop(cond, body, (0, x))
+        """})
+        # the host call draws TL101 (traced fn) AND TL107 (loop body)
+        assert rules_of(fs) == ["TL101", "TL107"]
+        tl107 = [f for f in fs if f.rule == "TL107"]
+        assert [f.qualname for f in tl107] == ["body"]
+
+    def test_device_get_in_scan_body_flagged(self, tmp_path):
+        fs = lint_pkg(tmp_path, {"m.py": """
+            import jax
+            from jax import lax
+
+            def body(carry, x):
+                y = carry + x
+                jax.device_get(y)
+                return y, y
+
+            def run(xs):
+                return lax.scan(body, 0.0, xs)
+        """})
+        assert [f.rule for f in fs] == ["TL107"]
+
+    def test_item_in_loop_reachable_callee_flagged(self, tmp_path):
+        """The hazard propagates: a helper CALLED from a while_loop
+        body is loop-reachable even though it is not the direct
+        trace-entry argument."""
+        fs = lint_pkg(tmp_path, {"m.py": """
+            import jax
+            from jax import lax
+
+            def helper(y):
+                return y.item()
+
+            def body(s):
+                return s + helper(s)
+
+            def run(x):
+                return lax.while_loop(lambda s: s < 9, body, x)
+        """})
+        assert {(f.rule, f.qualname) for f in fs} >= {
+            ("TL107", "helper"), ("TL102", "helper")}
+
+    def test_block_until_ready_in_scan_flagged(self, tmp_path):
+        fs = lint_pkg(tmp_path, {"m.py": """
+            import jax
+            from jax import lax
+
+            def body(c, x):
+                y = (c + x).block_until_ready()
+                return y, y
+
+            def run(xs):
+                return lax.scan(body, 0.0, xs)
+        """})
+        assert [f.rule for f in fs] == ["TL107"]
+
+    def test_clean_loop_body_and_jit_only_fn_pass(self, tmp_path):
+        """A pure loop body is clean, and host-ish attribute calls in
+        a plain jitted function (NOT loop-reachable) stay out of
+        TL107's scope."""
+        fs = lint_pkg(tmp_path, {"m.py": """
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def body(s):
+                return (s[0] + 1, jnp.where(s[1] > 0, s[1], 0.0))
+
+            def run(x):
+                return lax.while_loop(lambda s: s[0] < 4, body,
+                                      (0, x))
+
+            def f(x):
+                return x.copy_to_host_async()
+
+            g = jax.jit(f)
+        """})
+        assert [f.rule for f in fs if f.rule == "TL107"] == []
+
 
 class TestRecompileHazards:
     def test_trailing_none_out_sharding_flagged(self, tmp_path):
@@ -367,7 +461,9 @@ class TestCallGraphResolution:
                     return carry + x, os.getenv("HOME")
                 return jax.lax.scan(body, 0.0, xs)
         """})
-        assert [f.rule for f in fs] == ["TL101"]
+        # the scan body's host call is both a TL101 (traced fn) and,
+        # since ISSUE 18, a TL107 (device-loop body)
+        assert [f.rule for f in fs] == ["TL101", "TL107"]
 
     def test_cross_module_propagation(self, tmp_path):
         fs = lint_pkg(tmp_path, {

@@ -56,6 +56,14 @@ EXPECTED_METRICS = (
     "paddle_tpu_serving_fleet_upgrades_total",
     "paddle_tpu_serving_fleet_scale_events_total",
     "paddle_tpu_serving_fleet_cold_start_seconds",
+    # Device-resident multi-tick decode (ISSUE 18): registered by
+    # importing serving.metrics; activity is exercised by
+    # tools/multitick_smoke.py and tests/test_multitick.py (while_loop
+    # trip counts, control-readback stalls, finish/overflow/reject
+    # early-exit taxonomy)
+    "paddle_tpu_serving_ticks_per_dispatch",
+    "paddle_tpu_serving_host_stall_seconds_total",
+    "paddle_tpu_serving_early_exits_total",
 )
 
 

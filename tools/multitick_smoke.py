@@ -1,0 +1,151 @@
+"""Device-resident multi-tick decode smoke run (ISSUE 18).
+
+CI contract (tests/test_multitick.py runs this the same way
+tests/test_serving.py runs tools/serving_smoke.py): a tiny GPT serves
+the SAME Poisson arrival stream (fake clock, seeded inter-arrival
+gaps) through three engines at `ticks_per_dispatch` 1, 4 and 8.
+Per-request outputs must be identical across all three — greedy
+decode under continuous batching is prompt-determined, so the
+device-resident while_loop must not perturb a single token — while
+each engine compiles its mixed step exactly ONCE under
+`guards.sanitize` (the N-tick dispatch is the same executable as the
+1-tick one: n_ticks is a traced scalar). The multi-tick engines must
+record nonzero early-exit events (max_new_tokens is deliberately not
+a multiple of N, so horizon finishes cut dispatches short), leak zero
+KV blocks once drained, and every serving metric name in
+`serving.metrics.CONTRACT_METRICS` — including the three ISSUE 18
+names — must appear in the Prometheus-text dump. Exit status is
+non-zero on any violation.
+
+Usage: JAX_PLATFORMS=cpu python tools/multitick_smoke.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def serve_poisson(model, n_ticks, prompts, arrivals, compiles_before):
+    """Serve `prompts` arriving at `arrivals` (fake-clock seconds)
+    through one engine; returns (outputs, engine, failures)."""
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.engine import ServingEngine, STEP_FN_NAME
+
+    clk = {"t": 0.0}
+    engine = ServingEngine(model, max_slots=4, block_size=4,
+                           num_blocks=24, max_seq_len=64,
+                           cache_dtype="float32", seed=0,
+                           clock=lambda: clk["t"],
+                           ticks_per_dispatch=n_ticks)
+    failures = []
+    reqs = [None] * len(prompts)
+    nxt = 0
+    while nxt < len(prompts) or engine.scheduler.has_work:
+        # admit every arrival whose Poisson timestamp has passed; when
+        # idle, jump the fake clock to the next arrival
+        while nxt < len(prompts) and arrivals[nxt] <= clk["t"]:
+            reqs[nxt] = engine.submit(prompts[nxt], 7)
+            nxt += 1
+        if not engine.scheduler.has_work:
+            clk["t"] = arrivals[nxt]
+            continue
+        engine.step()
+        clk["t"] += 1e-3
+    outputs = [list(r.output) for r in reqs]
+    compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value \
+        - compiles_before
+    if compiles != 1:
+        failures.append(f"N={n_ticks} engine compiled {compiles} "
+                        "mixed steps, want 1")
+    if engine.kv.blocks_in_use != 0:
+        failures.append(f"N={n_ticks} engine leaked "
+                        f"{engine.kv.blocks_in_use} blocks")
+    if any(len(o) != 7 for o in outputs):
+        failures.append(f"N={n_ticks} short outputs: "
+                        f"{[len(o) for o in outputs]}")
+    return outputs, engine, failures
+
+
+def run_smoke():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForGeneration
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.engine import STEP_FN_NAME
+
+    pm.enable()
+    paddle.seed(0)
+    model = GPTForGeneration(vocab_size=211, hidden_size=32,
+                             num_layers=2, num_attention_heads=4,
+                             max_position_embeddings=128,
+                             compute_dtype="float32")
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 211, n).tolist()
+               for n in (3, 9, 17, 5, 12, 7, 21, 4)]
+    # Poisson arrivals: exponential inter-arrival gaps, mean 4 ms of
+    # fake-clock time — staggers admission across dispatches
+    arrivals = np.cumsum(rng.exponential(0.004, len(prompts)))
+    failures = []
+    outs = {}
+    engines = {}
+    for n in (1, 4, 8):
+        before = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+        outs[n], engines[n], fs = serve_poisson(
+            model, n, prompts, arrivals, before)
+        failures += fs
+    for n in (4, 8):
+        if outs[n] != outs[1]:
+            failures.append(
+                f"N={n} outputs diverge from N=1 (multi-tick decode "
+                "must be token-identical)")
+        ee = engines[n].early_exit_counts
+        if ee["finish"] + ee["overflow"] <= 0:
+            failures.append(f"N={n} recorded no early-exit events "
+                            f"(got {ee}) — the while_loop never "
+                            "returned control early")
+        if engines[n].device_ticks_run <= engines[n].dispatches_run:
+            failures.append(
+                f"N={n} ran {engines[n].device_ticks_run} ticks over "
+                f"{engines[n].dispatches_run} dispatches — no "
+                "dispatch ever multi-ticked")
+    return outs, engines, failures
+
+
+def main():
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.metrics import CONTRACT_METRICS
+
+    # runtime sanitizers ON for the whole smoke (ISSUE 12): transfer
+    # guard + compile-count watchdog — a second compile of any
+    # one-compile entry is a smoke failure, not a review finding
+    from paddle_tpu.analysis import guards
+    with guards.sanitize() as wd:
+        outs, engines, failures = run_smoke()
+    failures += [f"compile watchdog: {v}" for v in wd.violations]
+    text = pm.REGISTRY.to_prometheus()
+    print(text)
+    for name in CONTRACT_METRICS:
+        if name not in text:
+            failures.append(f"MISSING serving metric: {name}")
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAILURE: {f}", file=sys.stderr)
+        return 1
+    e4, e8 = engines[4], engines[8]
+    print(f"multitick smoke OK: {len(outs[1])} Poisson requests "
+          "token-identical at N=1/4/8; "
+          f"N=4: {e4.device_ticks_run} ticks / "
+          f"{e4.dispatches_run} dispatches, early exits "
+          f"{e4.early_exit_counts}; "
+          f"N=8: {e8.device_ticks_run} ticks / "
+          f"{e8.dispatches_run} dispatches, early exits "
+          f"{e8.early_exit_counts}; host stall "
+          f"{e8.host_stall_total * 1e3:.2f} ms",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
