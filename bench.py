@@ -695,6 +695,123 @@ def bench_serving_multitick(n_requests=16, t_new=65):
     }
 
 
+def bench_serving_spec_multitick(n_requests=8, t_new=64):
+    """On-device speculation lane (ISSUE 19): draft_k=3 speculative
+    decode INSIDE the ticks_per_dispatch=8 while_loop vs BOTH
+    baselines it must beat — the same speculation at N=1 (host
+    drafter, dispatch wall back) and no speculation at N=8 (loop
+    without drafts). The tiny GPT is first fit for a few epochs on a
+    synthetic copy corpus (repeated short motifs): prompt-lookup
+    drafting pays off exactly when the model's own continuations copy
+    local context (induction), and a random-weight model has none of
+    that — its ~10% accept rate measures nothing but verify overhead.
+    Prompts are the same short repeating motifs, so the n-gram
+    drafter lands accepts; greedy decode keeps all three
+    configurations token-identical, which the record asserts.
+    Best-of-3 per engine, passes interleaved (same drift discipline
+    as bench_serving_multitick). Driver contract: spec-N8 tok/s
+    strictly above spec-N1 AND above nospec-N8, one mixed-step
+    compile per engine, accept rate recorded."""
+    import time as _time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.models.gpt import (GPTForGeneration, GPTModel,
+                                       GPTForPretraining,
+                                       GPTPretrainingCriterion)
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.serving.engine import ServingEngine, STEP_FN_NAME
+
+    rng = np.random.RandomState(0)
+    V = 1024
+    paddle.seed(0)
+    net = GPTForPretraining(GPTModel(vocab_size=V, hidden_size=128,
+                                     num_layers=2,
+                                     num_attention_heads=4,
+                                     max_position_embeddings=512))
+    trainer = paddle.Model(net)
+    trainer.prepare(paddle.optimizer.AdamW(
+        3e-3, parameters=trainer.parameters()),
+        GPTPretrainingCriterion())
+    crng = np.random.RandomState(1)
+    seqs = []
+    for _ in range(256):
+        motif = crng.randint(1, V, int(crng.randint(2, 5)))
+        seqs.append(np.tile(motif, 65 // len(motif) + 1)[:65])
+    seqs = np.stack(seqs).astype(np.int32)
+    trainer.fit(TensorDataset([seqs[:, :-1], seqs[:, 1:]]), epochs=4,
+                batch_size=32, verbose=0)
+    m = GPTForGeneration.from_pretraining(net)
+    m.eval()
+    prompts = []
+    for _ in range(n_requests):
+        motif = rng.randint(1, V, int(rng.randint(2, 5))).tolist()
+        prompts.append((motif * (24 // len(motif) + 1))[:24])
+
+    def build(draft_k, n_ticks):
+        eng = ServingEngine(m, max_slots=8, block_size=16,
+                            max_seq_len=128, cache_dtype="float32",
+                            seed=0, draft_k=draft_k,
+                            ticks_per_dispatch=n_ticks)
+        c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+        eng.generate_batch([prompts[0]], max_new_tokens=2)  # warm
+        return eng, int(pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+                        - c0)
+
+    def run(eng):
+        p0, a0 = eng.spec_proposed_total, eng.spec_accepted_total
+        t0 = _time.perf_counter()
+        outs = eng.generate_batch(prompts, max_new_tokens=t_new)
+        wall = _time.perf_counter() - t0
+        toks = sum(len(o) for o in outs)
+        return {"outputs": outs, "tok_s": toks / wall, "wall": wall,
+                "proposed": eng.spec_proposed_total - p0,
+                "accepted": eng.spec_accepted_total - a0}
+
+    was_enabled = pm._enabled
+    pm.enable()
+    try:
+        keys = {"spec_n8": (3, 8), "spec_n1": (3, 1),
+                "nospec_n8": (0, 8)}
+        engines = {k: build(*v) for k, v in keys.items()}
+        runs = {k: [] for k in keys}
+        for _ in range(3):
+            for k in keys:
+                runs[k].append(run(engines[k][0]))
+        best = {k: max(runs[k], key=lambda r: r["tok_s"])
+                for k in keys}
+    finally:
+        if not was_enabled:
+            pm.disable()
+    identical = all(best[k]["outputs"] == best["spec_n1"]["outputs"]
+                    for k in keys)
+    # accept rate over ALL passes of the spec-N8 engine (per-pass
+    # counts are small enough to be noisy)
+    prop = sum(r["proposed"] for r in runs["spec_n8"])
+    acc = sum(r["accepted"] for r in runs["spec_n8"])
+    e8 = engines["spec_n8"][0]
+    return {
+        "metric": "serving_spec_multitick",
+        "value": round(best["spec_n8"]["tok_s"], 1),
+        "unit": "tokens/sec",
+        "decode_tok_s": {k: round(best[k]["tok_s"], 1) for k in keys},
+        "speedup_vs_spec_n1": round(best["spec_n8"]["tok_s"]
+                                    / best["spec_n1"]["tok_s"], 3),
+        "speedup_vs_nospec_n8": round(best["spec_n8"]["tok_s"]
+                                      / best["nospec_n8"]["tok_s"],
+                                      3),
+        "accept_rate": round(acc / max(prop, 1), 4),
+        "drafts_proposed": int(prop), "drafts_accepted": int(acc),
+        "draft_k": 3,
+        "speculation_mode_n8": e8.speculation_mode,
+        "ticks_per_dispatch_mean_n8": round(
+            e8.device_ticks_run / max(e8.dispatches_run, 1), 2),
+        "outputs_identical": bool(identical),
+        "mixed_step_compiles": max(c for _, c in engines.values()),
+        "requests": n_requests,
+    }
+
+
 def bench_serving_disagg():
     """ISSUE 13 extra: disaggregated prefill/decode fleet vs a
     monolithic fleet at EQUAL chip count (2 tiny-GPT engines each,
@@ -1848,6 +1965,16 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["extras"].append(
             {"metric": "serving_multitick",
+             "error": f"{type(e).__name__}: {e}"})
+
+    # on-device speculation lane (ISSUE 19): every-platform — draft_k=3
+    # inside the N=8 while_loop vs the spec-N1 and nospec-N8 baselines,
+    # accept rate on drafter-friendly prompts, token-identity record
+    try:
+        result["extras"].append(bench_serving_spec_multitick())
+    except Exception as e:  # noqa: BLE001
+        result["extras"].append(
+            {"metric": "serving_spec_multitick",
              "error": f"{type(e).__name__}: {e}"})
 
     # disaggregated prefill/decode extra: every-platform (1 prefill +

@@ -42,7 +42,9 @@ class Config:
                                    token_budget=None, eos_token_id=None,
                                    cache_dtype=None, kv_dtype=None,
                                    draft_k=None,
-                                   draft_ngram=None, prefix_caching=None,
+                                   draft_ngram=None, draft_ring=None,
+                                   penalty_vocab_bins=None,
+                                   prefix_caching=None,
                                    max_pending=None, sampling=None,
                                    tensor_parallel=None,
                                    expert_parallel=None,
@@ -60,9 +62,10 @@ class Config:
         """Opt the predictor surface into the paged-KV continuous
         batching engine (docs/SERVING.md). The knobs mirror
         `serving.ServingEngine`; None keeps the engine default.
-        `draft_k > 0` turns on speculative multi-token decoding (greedy
-        only): an n-gram prompt-lookup draft proposes up to `draft_k`
-        tokens per decode and one verify pass scores them all.
+        `draft_k > 0` turns on speculative multi-token decoding: an
+        n-gram prompt-lookup draft proposes up to `draft_k` tokens per
+        decode and one verify pass scores them all (greedy verifies by
+        token identity, sampling by the rejection rule).
         `prefix_caching=True` enables the radix-tree prefix KV cache
         (cross-request reuse of shared prompt heads).
         `kv_dtype="int8"` stores the paged KV pools quantized with
@@ -74,8 +77,8 @@ class Config:
 
         Distributed serving (docs/SERVING.md "Distributed serving"):
         `sampling` is a `serving.SamplingConfig` (or a dict of its
-        fields — strategy/temperature/top_k/top_p; speculation
-        auto-disables for non-greedy strategies). `tensor_parallel > 1`
+        fields — strategy/temperature/top_k/top_p/penalties; every
+        strategy composes with speculation). `tensor_parallel > 1`
         shards the mixed step + KV pools over an `mp` mesh
         (`serving.distributed.TPServingEngine`); for MoE decoder
         stacks `expert_parallel > 1` additionally shards the experts
@@ -123,14 +126,21 @@ class Config:
         TP sharding, transport and the prefix cache.
 
         Device-resident decode (docs/SERVING.md "Device-resident
-        decode", ISSUE 18): `ticks_per_dispatch=N` runs up to N decode
-        ticks per host dispatch inside ONE on-device `lax.while_loop`
-        (token-identical to N=1; still exactly one compiled mixed
-        step), `"auto"` lets the engine pace N from its measured
-        host-gap/tick-time ratio. Speculative decoding (`draft_k > 0`)
-        and history-dependent sampling fall back to single-tick
-        dispatches. In a disaggregated fleet, prefill replicas are
-        pinned to 1 tick and decode replicas default to 4."""
+        decode", ISSUE 18/19): `ticks_per_dispatch=N` runs up to N
+        decode ticks per host dispatch inside ONE on-device
+        `lax.while_loop` (token-identical to N=1; still exactly one
+        compiled mixed step), `"auto"` lets the engine pace N from its
+        measured host-gap/tick-time ratio. Speculation and penalized
+        sampling ride INSIDE the loop: `draft_ring=W` sizes the
+        per-slot device token ring the in-loop n-gram drafter scans
+        (default 64; >= 2 when drafting), and `penalty_vocab_bins=Vb`
+        sizes the per-slot token-count histogram the repetition/
+        presence penalties read (default: full vocab = exact HF
+        semantics; smaller Vb trades penalty precision for state via
+        `token % Vb` binning). Impossible combos raise ValueError at
+        engine build rather than silently degrading. In a
+        disaggregated fleet, prefill replicas are pinned to 1 tick and
+        decode replicas default to 4."""
         # validate BEFORE any assignment: a raising call must leave the
         # config exactly as it was (callers catch and retry)
         if kv_dtype is not None:
@@ -155,12 +165,32 @@ class Config:
                 raise ValueError(
                     f"ticks_per_dispatch={ticks_per_dispatch!r} must be "
                     "an int >= 1 or 'auto'")
+        if draft_k is not None and (not isinstance(draft_k, int)
+                                    or isinstance(draft_k, bool)
+                                    or draft_k < 0):
+            raise ValueError(f"draft_k={draft_k!r} must be an int >= 0")
+        if draft_ring is not None and (not isinstance(draft_ring, int)
+                                       or isinstance(draft_ring, bool)
+                                       or draft_ring < 2):
+            raise ValueError(
+                f"draft_ring={draft_ring!r} must be an int >= 2 (the "
+                "n-gram scan needs at least one earlier token besides "
+                "the tail)")
+        if penalty_vocab_bins is not None \
+                and (not isinstance(penalty_vocab_bins, int)
+                     or isinstance(penalty_vocab_bins, bool)
+                     or penalty_vocab_bins < 1):
+            raise ValueError(
+                f"penalty_vocab_bins={penalty_vocab_bins!r} must be "
+                "an int >= 1")
         self._serving = dict(
             max_slots=max_slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=max_seq_len,
             token_budget=token_budget, eos_token_id=eos_token_id,
             cache_dtype=cache_dtype, kv_dtype=kv_dtype, draft_k=draft_k,
-            draft_ngram=draft_ngram, prefix_caching=prefix_caching,
+            draft_ngram=draft_ngram, draft_ring=draft_ring,
+            penalty_vocab_bins=penalty_vocab_bins,
+            prefix_caching=prefix_caching,
             max_adapters=max_adapters, lora_rank=lora_rank,
             lora_alpha=lora_alpha, moe_weight_dtype=moe_weight_dtype,
             sparse_blocks=sparse_blocks, sparse_recent=sparse_recent,

@@ -54,15 +54,18 @@ def needs_history(sc: SamplingConfig) -> bool:
             or sc.frequency_penalty != 0.0)
 
 
-def apply_logit_penalties(logits, history, sc: SamplingConfig):
-    """Repetition / presence logit processors, fixed-shape.
+def apply_count_penalties(logits, counts, sc: SamplingConfig):
+    """Repetition / presence / frequency processors from a token-count
+    histogram (ISSUE 19 device-resident form).
 
-    logits [B, V]; history [B, W] int32 — each row the last W context
-    tokens (prompt + generated) of its slot, -1-padded. Seen-token
-    membership is ONE scatter-add into a [B, V] mask (duplicates
-    coalesce; -1 padding scatters weight 0), so the processors ride
-    inside the compiled mixed step without any shape that depends on
-    how much each request has generated.
+    logits [..., V]; counts [..., Vb] — per-context occurrence counts
+    over `Vb` vocab bins (bin of token t is t % Vb; Vb == V is exact,
+    smaller Vb trades penalty precision for state size —
+    docs/SERVING.md). The count tensor is what the multi-tick engine
+    keeps resident on device and updates per accepted token, so the
+    processors advance inside the decode while_loop without a host
+    history rebuild. Any leading batch shape works: the speculative
+    verify path passes per-position [S, K, Vb] prior counts.
 
     * repetition (HF semantics): seen tokens' logits are divided by
       the penalty when positive, multiplied when negative.
@@ -72,12 +75,12 @@ def apply_logit_penalties(logits, history, sc: SamplingConfig):
       are pushed down harder than one-off mentions (the OpenAI-style
       companion of the one-shot presence penalty)."""
     import jax.numpy as jnp
-    valid = history >= 0
-    idx = jnp.where(valid, history, 0)
-    counts = jnp.zeros_like(logits).at[
-        jnp.arange(logits.shape[0])[:, None], idx].add(
-        valid.astype(logits.dtype))
-    seen = counts > 0
+    V = logits.shape[-1]
+    Vb = counts.shape[-1]
+    cnt = counts.astype(logits.dtype)
+    if Vb != V:
+        cnt = cnt[..., jnp.arange(V, dtype=jnp.int32) % Vb]
+    seen = cnt > 0
     if sc.repetition_penalty != 1.0:
         rp = float(sc.repetition_penalty)
         logits = jnp.where(
@@ -87,8 +90,34 @@ def apply_logit_penalties(logits, history, sc: SamplingConfig):
         logits = logits - float(sc.presence_penalty) * seen.astype(
             logits.dtype)
     if sc.frequency_penalty != 0.0:
-        logits = logits - float(sc.frequency_penalty) * counts
+        logits = logits - float(sc.frequency_penalty) * cnt
     return logits
+
+
+def history_to_counts(history, vocab_bins, dtype=None):
+    """[B, W] -1-padded token history -> [B, vocab_bins] float counts:
+    ONE scatter-add (duplicates coalesce; -1 padding scatters weight
+    0). The bridge between the host-rebuilt history tensor and the
+    count-histogram form `apply_count_penalties` consumes."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    valid = history >= 0
+    idx = jnp.where(valid, history % int(vocab_bins), 0)
+    return jnp.zeros((history.shape[0], int(vocab_bins)), dtype).at[
+        jnp.arange(history.shape[0])[:, None], idx].add(
+        valid.astype(dtype))
+
+
+def apply_logit_penalties(logits, history, sc: SamplingConfig):
+    """Repetition / presence / frequency processors from a [B, W]
+    -1-padded token-history window (the host-rebuilt form
+    `incubate/nn/generation.py` feeds). Exactly
+    `apply_count_penalties` over the history's exact-vocab count
+    histogram — one scatter, then the shared count math, so the two
+    entry points can never disagree on penalty semantics."""
+    return apply_count_penalties(
+        logits, history_to_counts(history, logits.shape[-1],
+                                  dtype=logits.dtype), sc)
 
 
 def filter_logits(logits, sc: SamplingConfig):
@@ -117,16 +146,21 @@ def filter_logits(logits, sc: SamplingConfig):
     return logits
 
 
-def select_token(logits, key, sc: SamplingConfig, history=None):
+def select_token(logits, key, sc: SamplingConfig, history=None,
+                 counts=None):
     """logits [B, V] -> token [B] int32 (device-side sampling).
 
-    `history` [B, W] int32 (-1 pad) feeds the repetition/presence
-    logit processors; they compose with greedy AND the top-k/top-p/
-    temperature path (penalties first, then the strategy)."""
+    `history` [B, W] int32 (-1 pad) or `counts` [B, Vb] (the
+    device-resident histogram form, ISSUE 19) feeds the repetition/
+    presence/frequency logit processors; they compose with greedy AND
+    the top-k/top-p/temperature path (penalties first, then the
+    strategy)."""
     import jax
     import jax.numpy as jnp
     logits = logits.astype(jnp.float32)
-    if history is not None and needs_history(sc):
+    if counts is not None and needs_history(sc):
+        logits = apply_count_penalties(logits, counts, sc)
+    elif history is not None and needs_history(sc):
         logits = apply_logit_penalties(logits, history, sc)
     if sc.strategy == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

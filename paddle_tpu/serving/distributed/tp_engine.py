@@ -63,9 +63,13 @@ class TPServingEngine(ServingEngine):
     the base engine wraps the RESULT of `_build_step()` — here the
     shard_map'ed body — in its `lax.while_loop`, so the loop sits
     OUTSIDE the mesh partitioning and the control tail (n_ticks/eos/
-    remain/cap) rides as replicated host inputs like the flat-token
-    data args. Token identity vs N=1 at TP=2 and the one-compile
-    budget are asserted by tests/test_multitick.py.
+    remain/cap[/slot_ad][/draft ring + counts]) rides as replicated
+    host inputs like the flat-token data args. On-device speculation
+    (ISSUE 19) inherits the same way: the loop's drafter/accept/ring
+    math runs on replicated inputs outside shard_map, so a TP=2 spec
+    engine traces the IDENTICAL drafter as TP=1. Token identity vs
+    N=1 at TP=2 and the one-compile budget are asserted by
+    tests/test_multitick.py.
     """
 
     def __init__(self, model, *, tensor_parallel=2, expert_parallel=1,
@@ -327,10 +331,11 @@ class TPServingEngine(ServingEngine):
             for s in self._adapter_specs()) \
             if self.adapters is not None else ()
         # flat-token inputs, block tables, the optional logit-processor
-        # history and the rng key replicate; sampled tokens come off
-        # the replicated post-psum hidden state so the token outputs
-        # replicate too (check_vma=False: 0.4.x's checker can't see
-        # through the scanned psum)
+        # count histogram (ISSUE 19: the [S, Vb] device-updatable form
+        # of the old history window) and the rng key replicate; sampled
+        # tokens come off the replicated post-psum hidden state so the
+        # token outputs replicate too (check_vma=False: 0.4.x's checker
+        # can't see through the scanned psum)
         n_data = 6 + (1 if self.adapters is not None else 0) \
             + (1 if batcher.needs_history(self.sampling) else 0)
         data_in = (rep,) * n_data

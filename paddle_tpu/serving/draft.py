@@ -12,6 +12,20 @@ Correctness never depends on draft quality: the verify step
 mixed step) scores every proposed token against the real model and
 emits only the sequential-greedy prefix, so a bad draft costs speed,
 not output fidelity.
+
+Two implementations of the same proposer live here (ISSUE 19):
+
+* `ngram_propose` — the host reference, a plain python scan over the
+  request's token list. The 1-tick engine drafts with it between
+  steps.
+* `ngram_propose_device` — the `jnp` twin the multi-tick engine
+  traces INTO the mixed step's while_loop body: a fixed
+  `[max_slots, k]` proposal batch computed from the per-slot token
+  ring buffer (`ring_chronological`), so drafting advances on device
+  without a host round-trip. Given the same trailing window the two
+  produce IDENTICAL proposals (tests/test_speculative.py asserts
+  this), which is what keeps an N-tick speculative engine
+  token-identical to the N=1 host-drafting reference.
 """
 from __future__ import annotations
 
@@ -77,3 +91,65 @@ def ngram_propose(tokens, k, max_ngram=3, min_ngram=1):
     while len(out) < k:
         out.append(pad)
     return out
+
+
+def ring_chronological(ring, count):
+    """Circular per-slot token ring -> right-aligned chronological view.
+
+    `ring` [S, W] int32 holds each slot's last (up to) W tokens with
+    token t of the sequence stored at column t % W; `count` [S] is the
+    TOTAL sequence length so far. Returns `view` [S, W] where
+    view[:, -1] is each slot's most recent token and only the last
+    min(count, W) columns are meaningful — the layout
+    `ngram_propose_device` scans. One gather, fixed shape."""
+    import jax.numpy as jnp
+    W = ring.shape[1]
+    idx = (count[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]) % W
+    return jnp.take_along_axis(ring, idx, axis=1)
+
+
+def ngram_propose_device(view, length, k, max_ngram=3, min_ngram=1):
+    """`jnp` twin of `ngram_propose`, batched over slots.
+
+    `view` [S, W] is the chronological window (`ring_chronological`),
+    `length` [S] the true sequence length (columns before W -
+    min(length, W) are garbage and never matched). Returns [S, k]
+    int32 proposals, identical to running the host proposer on each
+    slot's trailing W-token window.
+
+    The scan is O(W * max_ngram) fixed-shape work: ml[j] = the length
+    of the suffix match between the window ending at column j and the
+    window's own tail (capped at max_ngram, never crossing the valid
+    region). The host picks the LONGEST tail n-gram first and the MOST
+    RECENT occurrence within it, which is exactly the lexicographic
+    argmax of (ml[j], j) — encoded as one argmax over ml[j] * W + j.
+    The continuation (clamped at the window end) repeats the last
+    available token, reproducing the host's truncate-then-pad."""
+    import jax.numpy as jnp
+    k = int(k)
+    S, W = view.shape
+    j = jnp.arange(W, dtype=jnp.int32)[None, :]           # [1, W]
+    L = jnp.minimum(length, W).astype(jnp.int32)[:, None]  # [S, 1]
+    run = jnp.ones((S, W), bool)
+    ml = jnp.zeros((S, W), jnp.int32)
+    for i in range(int(max_ngram)):
+        # compare column j - i against the tail token at W - 1 - i;
+        # out-of-window positions (j - i < W - L) can never match, so
+        # ml is automatically capped at min(max_ngram, L - 1) for any
+        # candidate end column — the host's n <= n_t - 1 bound
+        shifted = jnp.pad(view, ((0, 0), (i, 0)))[:, :W]
+        run = run & (j - i >= W - L) & (shifted == view[:, W - 1 - i,
+                                                        None])
+        ml = ml + run.astype(jnp.int32)
+    # a candidate end column must close a match of at least min_ngram
+    # and sit strictly before the last column (the host's earlier-
+    # occurrence constraint); scores are unique per (ml, j) pair
+    cand = (ml >= int(min_ngram)) & (j <= W - 2)
+    score = jnp.where(cand, ml * W + j, -1)
+    best = jnp.argmax(score, axis=1).astype(jnp.int32)     # [S]
+    has = jnp.max(score, axis=1) >= 0
+    end = jnp.where(has, best, W - 1)
+    cont = jnp.minimum(end[:, None] + 1
+                       + jnp.arange(k, dtype=jnp.int32)[None, :],
+                       W - 1)
+    return jnp.take_along_axis(view, cont, axis=1).astype(jnp.int32)

@@ -76,7 +76,8 @@ class ServingEngine:
                  num_blocks=None, max_seq_len=None, token_budget=None,
                  sampling=None, eos_token_id=None, cache_dtype=None,
                  kv_dtype=None, seed=0, clock=time.monotonic,
-                 draft_k=0, draft_ngram=3, prefix_caching=False,
+                 draft_k=0, draft_ngram=3, draft_ring=128,
+                 penalty_vocab_bins=None, prefix_caching=False,
                  role="mixed", max_adapters=0, lora_rank=8,
                  lora_alpha=None, moe_weight_dtype=None,
                  sparse_blocks=None, sparse_recent=2,
@@ -153,22 +154,50 @@ class ServingEngine:
         self.draft_k = int(draft_k)
         self.draft_ngram = int(draft_ngram)
         self.sampling = sampling or SamplingConfig()
-        self.speculation_disabled = False
-        if self.draft_k > 0 and batcher.needs_history(self.sampling):
-            # penalized sampling would need a per-draft-position
-            # history tensor (each verify position sees a different
-            # context window), so the engine auto-disables the draft
-            # path rather than refuse the config (docs/SERVING.md)
-            self.draft_k = 0
-            self.speculation_disabled = True
-        # plain sampling (temperature/top-k/top-p, no penalties) keeps
-        # speculation: drafts are accepted by the standard REJECTION
-        # rule against the filtered target distribution, so the output
-        # DISTRIBUTION matches non-speculative sampling exactly
-        # (ISSUE 11 satellite; the greedy path keeps its exact
-        # token-identity verify)
+        # config validation is LOUD (ISSUE 19): the silent draft_k
+        # zeroing under penalized sampling is gone — penalties now
+        # compose with speculation through per-position count priors
+        # (docs/SERVING.md "Feature matrix"), so what remains invalid
+        # is refused up front instead of quietly degraded
+        if self.draft_k < 0:
+            raise ValueError(f"draft_k={draft_k} must be >= 0")
+        if self.draft_k > 0 and int(draft_ngram) < 1:
+            raise ValueError(
+                f"draft_ngram={draft_ngram} must be >= 1 with "
+                "speculation on")
+        self.draft_ring = int(draft_ring)
+        if self.draft_k > 0 and self.draft_ring < 2:
+            raise ValueError(
+                f"draft_ring={draft_ring} must be >= 2 with "
+                "speculation on (the n-gram scan needs at least one "
+                "earlier token besides the tail)")
+        # penalty count-histogram bins (ISSUE 19): the device-resident
+        # [max_slots, Vb] token-count tensor the in-step logit
+        # processors read; Vb defaults to the full vocab (exact HF
+        # semantics), smaller Vb trades penalty precision for state
+        # size via t % Vb binning (docs/SERVING.md)
+        vocab = int(getattr(model, "vocab_size", 0) or 0)
+        self._penalty_bins = (vocab if penalty_vocab_bins is None
+                              else int(penalty_vocab_bins))
+        if batcher.needs_history(self.sampling) \
+                and self._penalty_bins < 1:
+            raise ValueError(
+                f"penalty_vocab_bins={penalty_vocab_bins} must be "
+                ">= 1 with penalized sampling")
+        # plain sampling (temperature/top-k/top-p) keeps speculation
+        # via the standard REJECTION rule against the filtered target
+        # distribution; penalized sampling composes too — the verify
+        # head rebuilds each draft position's count prior from the
+        # fed tokens, so every position is penalized by exactly the
+        # context a 1-token-at-a-time engine would have seen. The
+        # output DISTRIBUTION therefore matches draft_k=0 sampling,
+        # and the greedy path keeps its exact token-identity verify.
         self.spec_sampling = (self.draft_k > 0
                               and self.sampling.strategy != "greedy")
+        # retired fallback flag (pre-ISSUE 19 engines zeroed draft_k
+        # under penalized sampling); kept as a constant for operators'
+        # dashboards — `speculation_mode` below is the live signal
+        self.speculation_disabled = False
         # device-resident multi-tick decode (docs/SERVING.md "Device-
         # resident decode"): with ticks_per_dispatch=N>1, pure-decode
         # dispatches run N ticks inside ONE lax.while_loop around the
@@ -184,19 +213,18 @@ class ServingEngine:
                 f"ticks_per_dispatch={ticks_per_dispatch!r} must be "
                 ">= 1 (or 'auto')")
         self.ticks_per_dispatch = tp
-        self.multitick_disabled = False
-        if tp > 1 and (self.draft_k > 0
-                       or batcher.needs_history(self.sampling)):
-            # speculation drafts on the host (ngram proposer walks the
-            # request's token history) and penalty sampling rebuilds
-            # the [S, W] history tensor host-side per step — neither
-            # can advance inside a device loop, so the engine falls
-            # back to 1 tick per dispatch rather than refuse the
-            # config (the speculation_disabled precedent). Spec
-            # engines still surface draft rejections as the "reject"
-            # early-exit reason.
-            self.multitick_disabled = True
-        self._multitick = tp > 1 and not self.multitick_disabled
+        # ISSUE 19: speculation and penalized sampling now run INSIDE
+        # the device loop (on-device n-gram drafting from the token
+        # ring + count-histogram penalties), so the PR 18 single-tick
+        # fallbacks are gone — ticks_per_dispatch > 1 always takes
+        # the while_loop path
+        self._multitick = tp > 1
+        # operator-visible speculation state (tools/metrics_dump.py):
+        # off (draft_k=0) / host (1-tick host n-gram drafting) /
+        # device (drafting traced into the multi-tick loop body)
+        self.speculation_mode = (
+            "off" if self.draft_k == 0
+            else "device" if self._multitick else "host")
         if multitick_async is None:
             import os
             multitick_async = os.environ.get(
@@ -291,12 +319,21 @@ class ServingEngine:
                 rank=int(lora_rank), alpha=lora_alpha,
                 dtype=cdt_name, clock=clock)
         from .draft import ngram_propose
+
+        def _windowed_draft(tokens, _k=self.draft_k,
+                            _ng=int(draft_ngram), _w=self.draft_ring):
+            # the host proposer scans the SAME trailing window the
+            # device ring holds, so a 1-tick host-drafting engine and
+            # an N-tick device-drafting one propose identically —
+            # the token-identity contract of the spec matrix tests
+            return ngram_propose(tokens[-_w:], _k, max_ngram=_ng)
+
         self.scheduler = Scheduler(
             self.kv, max_slots=max_slots,
             token_budget=self.token_budget, clock=clock,
             draft_k=self.draft_k,
-            draft_fn=functools.partial(ngram_propose, k=self.draft_k,
-                                       max_ngram=int(draft_ngram)),
+            draft_fn=_windowed_draft,
+            device_draft=self._multitick and self.draft_k > 0,
             prefix_cache=self.prefix_cache,
             adapter_cache=self.adapters,
             reserve_region=self._sparse)
@@ -359,6 +396,17 @@ class ServingEngine:
         self.host_stall_total = 0.0
         self.early_exit_counts = {"finish": 0, "overflow": 0,
                                   "reject": 0}
+        # host mirrors of the cumulative draft economics (both the
+        # host-drafting 1-tick path and the device loop's spec stats
+        # fold in here; bench/smoke contracts read them directly)
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        if _pmetrics._enabled:
+            # operators see WHY a replica is or isn't speculating:
+            # exactly one mode label reads 1 (tools/metrics_dump.py)
+            for m in ("off", "host", "device"):
+                smetrics.SERVING_SPECULATION_STATE.labels(m).set(
+                    1.0 if m == self.speculation_mode else 0.0)
         # fleet control plane (ISSUE 17): checkpoint version label
         # (rides router_requests_total + trace spans) and the ONE
         # jitted budget-1 weight-swap cast shared by every rolling-
@@ -541,6 +589,7 @@ class ServingEngine:
         quant = self.kv.quantized
         fp8 = self.kv.kv_dtype == "fp8_e4m3"
         use_hist = batcher.needs_history(sc)
+        Vb = self._penalty_bins       # penalty count-histogram bins
         moe = cfg.num_experts > 0
         spec_sampling = self.spec_sampling
         lora = self.adapters is not None
@@ -649,9 +698,11 @@ class ServingEngine:
             # those — the kv_cache._pools() order; adapter slot
             # tensors follow them, with the per-token adapter ids
             # after sample_index; active logit processors add the
-            # [S, W] history before the rng
+            # [S, Vb] token-count histogram before the rng (ISSUE 19:
+            # the count form replaces the [S, W] history tensor so
+            # the multi-tick loop can advance it per accepted token)
             rest = list(rest)
-            k_scale = v_scale = history = None
+            k_scale = v_scale = counts = None
             k_sum_min = k_sum_max = None
             if quant:
                 k_scale, v_scale = rest[:2]
@@ -668,7 +719,7 @@ class ServingEngine:
             rest = rest[5:]
             adapter_ids = rest.pop(0) if lora else None
             if use_hist:
-                history = rest.pop(0)
+                counts = rest.pop(0)
             (rng,) = rest
             n_dec = len(names)
             we, pe = arrays[0], arrays[1]
@@ -851,18 +902,33 @@ class ServingEngine:
             if spec_sampling:
                 rng, rng_u, rng_res, rng_bonus = jax.random.split(
                     rng, 4)
-            tok = select_token(logits, rng, sc, history)
+            tok = select_token(logits, rng, sc, counts=counts)
             if K == 1:
                 return (tok,) + pools
             hv = xf[:R].reshape(S, K, -1)
             logits_v = jnp.matmul(hv, head.astype(hv.dtype))
+            lv = logits_v.astype(jnp.float32)
+            fed = token_ids[:R].reshape(S, K)
+            if use_hist:
+                # per-position count PRIORS (ISSUE 19): verify
+                # position j scores the context [.., fed[0..j]];
+                # fed[0] (the last accepted token) is already in the
+                # base histogram, so the prior adds the running count
+                # of fed[1..j] — each draft position is penalized by
+                # exactly the context a 1-token engine would have seen
+                inc = jax.nn.one_hot(fed[:, 1:] % Vb, Vb,
+                                     dtype=jnp.float32)
+                prior = counts.astype(jnp.float32)[:, None, :] \
+                    + jnp.concatenate(
+                        [jnp.zeros((S, 1, Vb), jnp.float32),
+                         jnp.cumsum(inc, axis=1)], axis=1)
+                lv = batcher.apply_count_penalties(lv, prior, sc)
             if not spec_sampling:
                 # greedy scores for EVERY verify-region position:
                 # tok_v[s, j] is the model's next token after slot s's
                 # j-th fed token — the host accepts the longest draft
                 # prefix matching it
-                tok_v = jnp.argmax(logits_v.astype(jnp.float32),
-                                   axis=-1).astype(jnp.int32)
+                tok_v = jnp.argmax(lv, axis=-1).astype(jnp.int32)
                 return ((tok, tok_v),) + pools
             # REJECTION-SAMPLING verify (ISSUE 11 satellite): the
             # n-gram proposer is deterministic (a point-mass draft
@@ -876,9 +942,7 @@ class ServingEngine:
             # the last fed position. Emitted tokens are therefore
             # p-distributed at every position — the output
             # DISTRIBUTION matches draft_k=0 sampling.
-            fl = batcher.filter_logits(
-                logits_v.astype(jnp.float32), sc)       # [S, K, V]
-            fed = token_ids[:R].reshape(S, K)
+            fl = batcher.filter_logits(lv, sc)          # [S, K, V]
             # fed token at position j+1, scored by position j (last
             # column pads with 0 — the host never reads its verdict)
             nxt = jnp.concatenate(
@@ -927,8 +991,21 @@ class ServingEngine:
         so scheduling decisions (admission, preemption, expiry) happen
         at the same sequence boundaries a 1-tick engine would see.
 
+        With speculation (`draft_k > 0`, ISSUE 19) the tail further
+        appends the per-slot token RING (`ring [S, draft_ring]`,
+        `rcnt [S]` — circular, token t at column t % draft_ring) and
+        every tick widens to a verify group: the `jnp` n-gram drafter
+        (`serving.draft.ngram_propose_device`) proposes from the ring,
+        the verify head scores the group, the accept-length roll +
+        bonus/residual token and the ring/count updates all happen
+        in-loop — the multiplicative win (accept length x ticks per
+        host round-trip) without a single host escape. Penalized
+        sampling threads its `[S, penalty_vocab_bins]` count histogram
+        through the carry the same way.
+
         Outputs replace the token head with the control block
-        `(staged [S, N], counts [S], events [S], ticks, rng)`:
+        `(staged [S, N*K], counts [S], events [S], ticks, rng[,
+        spec_proposed, spec_accepted, accept_hist [K]])`:
         `staged` is the -1-padded token staging buffer, `events` the
         per-slot bitmask (1 = finish: EOS or horizon; 2 = overflow:
         next tick would exceed the preallocated block capacity `cap`).
@@ -936,9 +1013,17 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
+        from .draft import ngram_propose_device, ring_chronological
+
         S = self.kv.max_slots
         T = self.token_budget
         N = self.ticks_per_dispatch
+        K = self.draft_k + 1
+        NG = self.draft_ngram
+        Wr = self.draft_ring
+        Vb = self._penalty_bins
+        use_hist = batcher.needs_history(self.sampling)
+        spec_sampling = self.spec_sampling
         lora = self.adapters is not None
         moe = self.num_experts > 0
         n_pools = len(self.kv._pools())
@@ -955,18 +1040,37 @@ class ServingEngine:
              sample_index) = rest[:5]
             rest = rest[5:]
             adapter_ids = rest.pop(0) if lora else None
+            cnt0 = rest.pop(0) if use_hist else None
             rng0 = rest.pop(0)
             n_ticks = rest.pop(0)
             eos = rest.pop(0)
             remain = rest.pop(0)
             cap = rest.pop(0)
             slot_ad = rest.pop(0) if lora else None
+            ring0 = rest.pop(0) if K > 1 else None
+            rcnt0 = rest.pop(0) if K > 1 else None
 
-            anchors = sample_index                       # [S]
-            live0 = anchors >= 0
             slot_iota = jnp.arange(S, dtype=jnp.int32)
-            pos0 = jnp.where(
-                live0, positions[jnp.clip(anchors, 0, T - 1)], 0)
+            iota_k = jnp.arange(K, dtype=jnp.int32)[None, :]
+            anchors = sample_index                       # [S]
+            if K == 1:
+                live0 = anchors >= 0
+                dec0 = live0
+                pos0 = jnp.where(
+                    live0, positions[jnp.clip(anchors, 0, T - 1)], 0)
+                last0 = jnp.zeros((S,), jnp.int32)
+            else:
+                # region layout: slot s owns flat [s*K, (s+1)*K); the
+                # host packs only [last] there — decode membership,
+                # last token and position read straight off the base
+                # column. Prefill completions sample through the tok
+                # head (anchors) and carry exactly one token.
+                base_idx = slot_iota * K
+                dec0 = slot_ids[base_idx] == slot_iota
+                live0 = dec0 | (anchors >= 0)
+                pos0 = jnp.where(dec0, positions[base_idx], 0)
+                last0 = token_ids[base_idx]
+                rows2d = base_idx[:, None] + iota_k      # [S, K]
             mstats0 = None
             if moe:
                 mstats0 = {"counts": jnp.zeros((E,), jnp.float32),
@@ -982,64 +1086,220 @@ class ServingEngine:
 
             def tick(state):
                 (t, rng, pools_c, staged, counts, events, live,
-                 prev_tok, cur_pos, mstats) = state
+                 prev_tok, cur_pos, mstats, cnt, ring, rcnt,
+                 spec_prop, spec_acc, spec_hist) = state
                 first = t == 0
-                # scatter rebuild at the pack-time anchors; dead slots
-                # aim at T and are dropped
-                sa = jnp.where(live, anchors, T).astype(jnp.int32)
-                tid = jnp.where(
-                    first, token_ids,
-                    jnp.zeros((T,), jnp.int32)
-                    .at[sa].set(prev_tok, mode="drop"))
-                sid = jnp.where(
-                    first, slot_ids,
-                    jnp.full((T,), -1, jnp.int32)
-                    .at[sa].set(slot_iota, mode="drop"))
-                pid = jnp.where(
-                    first, positions,
-                    jnp.zeros((T,), jnp.int32)
-                    .at[sa].set(cur_pos, mode="drop"))
-                si = jnp.where(first, sample_index,
-                               jnp.where(live, anchors, -1))
+                live_dec = live & dec0
+                if K == 1:
+                    # scatter rebuild at the pack-time anchors; dead
+                    # slots aim at T and are dropped
+                    sa = jnp.where(live, anchors, T).astype(jnp.int32)
+                    tid = jnp.where(
+                        first, token_ids,
+                        jnp.zeros((T,), jnp.int32)
+                        .at[sa].set(prev_tok, mode="drop"))
+                    sid = jnp.where(
+                        first, slot_ids,
+                        jnp.full((T,), -1, jnp.int32)
+                        .at[sa].set(slot_iota, mode="drop"))
+                    pid = jnp.where(
+                        first, positions,
+                        jnp.zeros((T,), jnp.int32)
+                        .at[sa].set(cur_pos, mode="drop"))
+                    si = jnp.where(first, sample_index,
+                                   jnp.where(live, anchors, -1))
+                    aid = None
+                    if lora:
+                        aid = jnp.where(
+                            first, adapter_ids,
+                            jnp.zeros((T,), jnp.int32)
+                            .at[sa].set(slot_ad, mode="drop"))
+                    fed = None
+                    k_eff = None
+                else:
+                    # ---- on-device draft: widen each live decode to
+                    # a verify group [last, d_1..d_{K-1}] proposed by
+                    # the traced n-gram scan over the token ring.
+                    # EVERY tick rebuilds the region (tick 0 included:
+                    # the host packed only the base column), while
+                    # tick 0 keeps the packed prefill chunks past it.
+                    view = ring_chronological(ring, rcnt)
+                    drafts = ngram_propose_device(view, rcnt, K - 1,
+                                                  max_ngram=NG)
+                    fed = jnp.concatenate(
+                        [prev_tok[:, None], drafts], axis=1)  # [S, K]
+                    rows = jnp.where(live_dec[:, None], rows2d, T)
+                    tid = jnp.where(first, token_ids,
+                                    jnp.zeros((T,), jnp.int32))
+                    tid = tid.at[rows].set(fed, mode="drop")
+                    sid = jnp.where(first, slot_ids,
+                                    jnp.full((T,), -1, jnp.int32))
+                    sid = sid.at[rows].set(
+                        jnp.broadcast_to(slot_iota[:, None], (S, K)),
+                        mode="drop")
+                    pid = jnp.where(first, positions,
+                                    jnp.zeros((T,), jnp.int32))
+                    pid = pid.at[rows].set(
+                        cur_pos[:, None] + iota_k, mode="drop")
+                    si = jnp.where(first, sample_index,
+                                   jnp.full((S,), -1, jnp.int32))
+                    aid = None
+                    if lora:
+                        aid = jnp.where(first, adapter_ids,
+                                        jnp.zeros((T,), jnp.int32))
+                        aid = aid.at[rows].set(
+                            jnp.broadcast_to(slot_ad[:, None],
+                                             (S, K)), mode="drop")
+                    # per-tick draft clamp, mirroring the host
+                    # drafter's horizon/capacity shrink: never past
+                    # the request's remaining budget, never past the
+                    # preallocated block frontier
+                    k_eff = jnp.clip(
+                        jnp.minimum(jnp.minimum(K - 1,
+                                                remain - counts - 1),
+                                    cap - cur_pos - 1), 0, K - 1)
                 rng, sub = jax.random.split(rng)
                 call = [arrays] + list(pools_c) + list(ad_arrays)
                 call += [tid, sid, pid, block_tables, si]
                 if lora:
-                    call.append(jnp.where(
-                        first, adapter_ids,
-                        jnp.zeros((T,), jnp.int32)
-                        .at[sa].set(slot_ad, mode="drop")))
+                    call.append(aid)
+                if use_hist:
+                    call.append(cnt)
                 call.append(sub)
                 res = base_step(*call)
-                tok = res[0]                             # [S] (K == 1)
+                out0 = res[0]
                 new_pools = res[1:]
                 if moe:
                     mstats = jax.tree.map(jnp.add, mstats,
                                           new_pools[-1])
                     new_pools = new_pools[:-1]
-                staged = staged.at[:, t].set(
-                    jnp.where(live, tok, -1))
-                counts = counts + live.astype(jnp.int32)
-                finish = live & (((eos >= 0) & (tok == eos))
-                                 | (counts >= remain))
-                nxt = cur_pos + 1
+                if K == 1:
+                    tok = out0
+                    emitted = tok[:, None]               # [S, 1]
+                    e = jnp.where(live, 1, 0)
+                    m = jnp.zeros((S,), jnp.int32)
+                else:
+                    if spec_sampling:
+                        tok, tok_v, tok_res, acc = out0
+                        flags = acc[:, :K - 1] & (
+                            iota_k[:, :K - 1] < k_eff[:, None])
+                        m = jnp.sum(jnp.cumprod(
+                            flags.astype(jnp.int32), axis=1), axis=1)
+                        # accepted drafts re-emit the fed tokens, then
+                        # the bonus sample (all k_eff accepted) or the
+                        # residual resample at the rejection
+                        fin = jnp.where(
+                            (m == k_eff)[:, None],
+                            jnp.take_along_axis(tok_v, m[:, None], 1),
+                            jnp.take_along_axis(tok_res, m[:, None],
+                                                1))[:, 0]
+                        emitted = jnp.concatenate(
+                            [fed[:, 1:], jnp.zeros((S, 1), jnp.int32)],
+                            axis=1)
+                        emitted = jnp.where(iota_k == m[:, None],
+                                            fin[:, None], emitted)
+                    else:
+                        tok, tok_v = out0
+                        eq = (fed[:, 1:] == tok_v[:, :K - 1]) & (
+                            iota_k[:, :K - 1] < k_eff[:, None])
+                        m = jnp.sum(jnp.cumprod(
+                            eq.astype(jnp.int32), axis=1), axis=1)
+                        emitted = tok_v
+                    e = m + 1
+                    # prefill completions emit their single sampled
+                    # token through the tok head, like a 1-wide group
+                    is_anch = live & ~dec0
+                    e = jnp.where(is_anch, 1,
+                                  jnp.where(live, e, 0))
+                    emitted = jnp.where(
+                        is_anch[:, None],
+                        jnp.where(iota_k == 0, tok[:, None], -1),
+                        emitted)
+                # EOS cut: the FIRST matching token inside the
+                # emitted prefix truncates it and finishes the slot —
+                # the host emit() replay lands on the same token
+                val = iota_k < e[:, None]
+                hit = val & (eos[:, None] >= 0) & (
+                    emitted == eos[:, None])
+                any_hit = jnp.any(hit, axis=1)
+                e = jnp.where(any_hit,
+                              jnp.argmax(hit, axis=1).astype(
+                                  jnp.int32) + 1, e)
+                if K == 1:
+                    staged = staged.at[:, t].set(
+                        jnp.where(live, emitted[:, 0], -1))
+                else:
+                    cols = jnp.where(
+                        live[:, None] & (iota_k < e[:, None]),
+                        counts[:, None] + iota_k, N * K)
+                    staged = staged.at[
+                        slot_iota[:, None], cols].set(
+                        emitted, mode="drop")
+                counts = counts + jnp.where(live, e, 0)
+                finish = live & (any_hit | (counts >= remain))
+                nxt = cur_pos + jnp.where(live_dec, e, 0)
                 overflow = live & ~finish & (nxt >= cap)
                 events = (events
                           | jnp.where(finish, 1, 0)
                           | jnp.where(overflow, 2, 0))
+                if use_hist:
+                    # fold the emitted tokens into the count
+                    # histogram so the NEXT tick's penalties see them
+                    # (exactly the host's per-step history rebuild)
+                    if K == 1:
+                        brow = jnp.where(live, slot_iota, S)
+                        cnt = cnt.at[brow, emitted[:, 0] % Vb].add(
+                            1.0, mode="drop")
+                    else:
+                        bcol = jnp.where(
+                            live[:, None] & (iota_k < e[:, None]),
+                            emitted % Vb, Vb)
+                        cnt = cnt.at[slot_iota[:, None], bcol].add(
+                            1.0, mode="drop")
+                if K == 1:
+                    prev_tok = emitted[:, 0]
+                else:
+                    prev_tok = jnp.where(
+                        live_dec,
+                        jnp.take_along_axis(
+                            emitted,
+                            jnp.maximum(e - 1, 0)[:, None],
+                            axis=1)[:, 0],
+                        prev_tok)
+                    ridx = jnp.where(
+                        live_dec[:, None] & (iota_k < e[:, None]),
+                        (rcnt[:, None] + iota_k) % Wr, Wr)
+                    ring = ring.at[slot_iota[:, None], ridx].set(
+                        emitted, mode="drop")
+                    rcnt = rcnt + jnp.where(live_dec, e, 0)
+                    ld = live_dec.astype(jnp.int32)
+                    spec_prop = spec_prop + jnp.sum(k_eff * ld)
+                    spec_acc = spec_acc + jnp.sum(m * ld)
+                    spec_hist = spec_hist + jnp.sum(
+                        jax.nn.one_hot(jnp.clip(m, 0, K - 1), K,
+                                       dtype=jnp.int32)
+                        * ld[:, None], axis=0)
                 live = live & ~finish & ~overflow
                 return (t + 1, rng, tuple(new_pools), staged, counts,
-                        events, live, tok, nxt, mstats)
+                        events, live, prev_tok, nxt, mstats, cnt,
+                        ring, rcnt, spec_prop, spec_acc, spec_hist)
 
-            state = (jnp.zeros((), jnp.int32), rng0, pools0,
-                     jnp.full((S, N), -1, jnp.int32),
+            zi = jnp.zeros((), jnp.int32)
+            state = (zi, rng0, pools0,
+                     jnp.full((S, N * K), -1, jnp.int32),
                      jnp.zeros((S,), jnp.int32),
                      jnp.zeros((S,), jnp.int32), live0,
-                     jnp.zeros((S,), jnp.int32), pos0, mstats0)
+                     last0, pos0, mstats0, cnt0, ring0, rcnt0,
+                     zi, zi,
+                     jnp.zeros((K,), jnp.int32) if K > 1 else zi)
             state = jax.lax.while_loop(cond, tick, state)
             (t, rng, pools_f, staged, counts, events, _live, _tok,
-             _pos, mstats) = state
-            out = ((staged, counts, events, t, rng),) + tuple(pools_f)
+             _pos, mstats, _cnt, _ring, _rcnt, spec_prop, spec_acc,
+             spec_hist) = state
+            ctrl = (staged, counts, events, t, rng)
+            if K > 1:
+                ctrl += (spec_prop, spec_acc, spec_hist)
+            out = (ctrl,) + tuple(pools_f)
             if moe:
                 out += (mstats,)
             return out
@@ -1208,21 +1468,50 @@ class ServingEngine:
                         slot_ad[np.clip(sp.slot_ids, 0, None)],
                         0).astype(np.int32)
 
-    def _penalty_history(self):
-        """Fixed `[max_slots, penalty_window]` int32 context window for
-        the in-step logit processors: each resident slot's last W
-        (prompt + generated) tokens, -1-padded — rebuilt host-side per
-        step so the compiled shapes never depend on generation
-        progress."""
+    def _penalty_counts(self):
+        """Fixed `[max_slots, penalty_vocab_bins]` float32 token-count
+        histogram for the in-step logit processors: each resident
+        slot's last W (prompt + generated) tokens bucketed by
+        `token % bins` — the device-updatable form of the old per-step
+        history window (ISSUE 19). Rebuilt host-side per dispatch so
+        the compiled shapes never depend on generation progress; the
+        multi-tick loop then scatter-adds each accepted token in-loop
+        so later ticks penalize earlier ticks' output without a host
+        round-trip."""
         W = int(self.sampling.penalty_window)
-        hist = np.full((self.kv.max_slots, W), -1, np.int32)
+        Vb = self._penalty_bins
+        cnt = np.zeros((self.kv.max_slots, Vb), np.float32)
         for slot, req in enumerate(self.scheduler.slots):
             if req is None:
                 continue
             toks = req.runtime_prompt[-W:]
             if toks:
-                hist[slot, :len(toks)] = toks
-        return hist
+                np.add.at(cnt[slot],
+                          np.asarray(toks, np.int64) % Vb, 1.0)
+        return cnt
+
+    def _draft_ring_state(self):
+        """Per-slot device token ring feeding the in-loop n-gram
+        drafter: `ring [max_slots, draft_ring]` int32 with token t of
+        each resident sequence at column t % draft_ring, plus
+        `rcnt [max_slots]` total sequence lengths
+        (`serving.draft.ring_chronological` layout). Reseeded host-side
+        per dispatch — cheap, it is one window copy per resident slot —
+        and advanced ON DEVICE inside the dispatch as ticks emit."""
+        Wr = self.draft_ring
+        S = self.kv.max_slots
+        ring = np.zeros((S, Wr), np.int32)
+        rcnt = np.zeros(S, np.int32)
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            toks = req.runtime_prompt
+            L = len(toks)
+            w = min(L, Wr)
+            if w:
+                ring[slot, np.arange(L - w, L) % Wr] = toks[-w:]
+            rcnt[slot] = L
+        return ring, rcnt
 
     def sparse_skip_ratio(self):
         """Fraction of candidate KV blocks the sparse decode path
@@ -1288,7 +1577,7 @@ class ServingEngine:
         if self.adapters is not None:
             args.append(jnp.asarray(self._adapter_token_ids(sp)))
         if batcher.needs_history(self.sampling):
-            args.append(jnp.asarray(self._penalty_history()))
+            args.append(jnp.asarray(self._penalty_counts()))
         args.append(sub)
         res = self._step_fn(*args)
         moe_stats = None
@@ -1408,16 +1697,8 @@ class ServingEngine:
                 else:
                     m = accept_length(toks, g)
                     emitted = [int(t) for t in g[:m + 1]]
-                if self.multitick_disabled and m < len(toks) - 1:
-                    # a spec engine asked to multi-tick runs 1-tick
-                    # (drafting is host-side) but still surfaces draft
-                    # rejections under the early-exit taxonomy: this
-                    # is the control-return reason a device-resident
-                    # verify loop would have reported
-                    self.early_exit_counts["reject"] += 1
-                    if _pmetrics._enabled:
-                        smetrics.SERVING_EARLY_EXITS.labels(
-                            "reject").inc()
+                self.spec_proposed_total += len(toks) - 1
+                self.spec_accepted_total += m
                 if _pmetrics._enabled:
                     smetrics.SERVING_ACCEPT_LENGTH.observe(m + 1)
                     if len(toks) > 1:
@@ -1556,8 +1837,9 @@ class ServingEngine:
                              else 0.7 * self._gap_ema + 0.3 * gap)
         buf = self._plan_buffers[self._plan_flip]
         self._plan_flip ^= 1
+        K = self.draft_k + 1
         sp = pack_step(self.token_budget, S, plan.decode,
-                       plan.prefills, verify_width=1,
+                       plan.prefills, verify_width=K,
                        reserve_region=self._sparse, buffers=buf)
         # multi-tick only on pure-decode dispatches: a prefill chunk
         # needs the host packer next step anyway, and a prefill-role
@@ -1577,9 +1859,15 @@ class ServingEngine:
             remain[slot] = req.max_new_tokens - len(req.output)
             # FREE-block tick preallocation (scheduler.extend_for_ticks)
             # — block_tables below is snapshotted AFTER, so in-device
-            # appends of later ticks land in already-mapped blocks
-            cap[slot] = (sch.extend_for_ticks(slot, pos, n)
-                         if n > 1 else pos + 1)
+            # appends of later ticks land in already-mapped blocks.
+            # With speculation each tick may write up to K tokens, so
+            # the preallocation horizon is n * K; the in-loop draft
+            # clamp (k_eff <= cap - pos - 1) keeps accepted tokens
+            # inside it, and anything past it lands in the reserved
+            # null block and is never read back (attention stops at
+            # cap, harvest truncates to the emitted count).
+            cap[slot] = (sch.extend_for_ticks(slot, pos, n * K)
+                         if n * K > 1 else pos + 1)
         args = [self._arrays] + self.kv._pools()
         if self.adapters is not None:
             args += self.adapters.device_arrays()
@@ -1589,6 +1877,8 @@ class ServingEngine:
                  jnp.asarray(sp.sample_index)]
         if self.adapters is not None:
             args.append(jnp.asarray(self._adapter_token_ids(sp)))
+        if batcher.needs_history(self.sampling):
+            args.append(jnp.asarray(self._penalty_counts()))
         # CHAIN key, always as a HOST array: the loop splits per tick
         # and returns the advanced chain, which harvest materializes
         # back to host — a device-resident key would flip the arg's
@@ -1602,17 +1892,26 @@ class ServingEngine:
                 if req is not None:
                     slot_ad[s] = req.adapter_slot
             args.append(jnp.asarray(slot_ad))
+        if K > 1:
+            ring, rcnt = self._draft_ring_state()
+            args += [jnp.asarray(ring), jnp.asarray(rcnt)]
         res = self._step_fn(*args)
         moe_stats = None
         if self.num_experts:
             res, moe_stats = res[:-1], res[-1]
-        staged_d, counts_d, events_d, ticks_d, new_rng = res[0]
+        ctrl = res[0]
+        sp_prop_d = sp_acc_d = sp_hist_d = None
+        if K > 1:
+            (staged_d, counts_d, events_d, ticks_d, new_rng,
+             sp_prop_d, sp_acc_d, sp_hist_d) = ctrl
+        else:
+            staged_d, counts_d, events_d, ticks_d, new_rng = ctrl
         self.kv._set_pools(res[1:])
         if self._multitick_async:
             # async device_get: start the control-output copies and
             # flush the PREVIOUS dispatch's deferred observability
             # while this dispatch still runs on device
-            for a in (staged_d, counts_d, events_d, ticks_d, new_rng):
+            for a in ctrl:
                 try:
                     a.copy_to_host_async()
                 except Exception:
@@ -1623,6 +1922,14 @@ class ServingEngine:
         events_np = np.asarray(events_d)
         staged_np = np.asarray(staged_d)
         ticks_run = int(ticks_d)
+        spec_prop = spec_acc = 0
+        spec_hist = None
+        if K > 1:
+            spec_prop = int(sp_prop_d)
+            spec_acc = int(sp_acc_d)
+            spec_hist = np.asarray(sp_hist_d)
+            self.spec_proposed_total += spec_prop
+            self.spec_accepted_total += spec_acc
         # the advanced CHAIN key comes back to host: next dispatch then
         # passes the same uncommitted-host-key signature as the first
         # (under the TP mesh a device-resident sharded key would change
@@ -1647,14 +1954,19 @@ class ServingEngine:
         self.dispatches_run += 1
         self.device_ticks_run += ticks_run
         decode_emitted = 0
-        if n > 1:
+        if n > 1 or K > 1:
             # advance each decode slot to what the device actually
             # emitted and release the preallocated tail — dispatch-
-            # boundary block state matches a 1-tick engine's exactly
+            # boundary block state matches a 1-tick engine's exactly.
+            # With speculation the freed tail includes blocks whose
+            # only contents were rejected-draft K/V: those count as
+            # spec rollbacks, same taxonomy as the 1-tick host path.
             for slot, _tok, pos in plan.decode:
                 c = max(int(counts_np[slot]), 1)
-                self.kv.slot_lens[slot] = pos + c
-                self.kv.truncate_slot(slot, pos + c)
+                freed = sch.note_accept(slot, pos + c)
+                if freed and K > 1 and _pmetrics._enabled:
+                    smetrics.SERVING_SPEC_ROLLBACKS.inc()
+                    smetrics.SERVING_SPEC_ROLLBACK_BLOCKS.inc(freed)
         if self._sparse and plan.decode:
             for slot, _tok, pos in plan.decode:
                 c = max(int(counts_np[slot]), 1)
@@ -1757,6 +2069,9 @@ class ServingEngine:
             blocks_imported=int(self.kv.blocks_imported),
             ticks=ticks_run, host_stall=float(host_stall),
             ev_finish=ev_finish, ev_over=ev_over,
+            spec_prop=spec_prop, spec_acc=spec_acc,
+            spec_hist=(None if spec_hist is None
+                       else [int(x) for x in spec_hist]),
             dur=self.clock() - t0 if trace_on else 0.0)
         self._preempt_seen = sch.preemption_count
         self._imported_seen = self.kv.blocks_imported
@@ -1798,6 +2113,20 @@ class ServingEngine:
                 if snap["ev_over"]:
                     smetrics.SERVING_EARLY_EXITS.labels(
                         "overflow").inc(snap["ev_over"])
+                if snap["spec_prop"]:
+                    smetrics.SERVING_DRAFT_TOKENS.labels(
+                        "proposed").inc(snap["spec_prop"])
+                    smetrics.SERVING_DRAFT_TOKENS.labels(
+                        "accepted").inc(snap["spec_acc"])
+                if snap["spec_hist"]:
+                    # accept-length histogram bin b holds the number
+                    # of verify groups that accepted exactly b drafts
+                    # (device one_hot sum) — replay as m + 1 observes,
+                    # the 1-tick host path's exact semantics
+                    for b, cnt in enumerate(snap["spec_hist"]):
+                        for _ in range(cnt):
+                            smetrics.SERVING_ACCEPT_LENGTH.observe(
+                                b + 1)
                 if self._sparse and snap["sparse_cand"]:
                     skipped = snap["sparse_cand"] - snap["sparse_sel"]
                     if skipped > self._sparse_skip_seen:
@@ -1827,7 +2156,11 @@ class ServingEngine:
                     decode_tokens=snap["decode_tokens"],
                     active_slots=snap["active_slots"],
                     queue_depth=snap["queue_depth"],
-                    spec_accept_tokens=0, spec_groups=0,
+                    spec_accept_tokens=(
+                        snap["spec_acc"] + sum(snap["spec_hist"])
+                        if snap["spec_hist"] else 0),
+                    spec_groups=(sum(snap["spec_hist"])
+                                 if snap["spec_hist"] else 0),
                     sparse_skip_ratio=(
                         1.0 - snap["sparse_sel"] / snap["sparse_cand"]
                         if self._sparse and snap["sparse_cand"]
@@ -1898,12 +2231,13 @@ class ServingEngine:
         if self.adapters is not None:
             args.append(jnp.asarray(self._adapter_token_ids(sp)))
         if batcher.needs_history(self.sampling):
-            args.append(jnp.asarray(self._penalty_history()))
+            args.append(jnp.asarray(self._penalty_counts()))
         args.append(sub)
         if self._multitick:
             # the while_loop wrapper's control tail (n_ticks / eos /
-            # remain / cap [/ per-slot adapter ids]) — same fixed
-            # shapes every live dispatch passes
+            # remain / cap [/ per-slot adapter ids] [/ draft ring +
+            # ring counts]) — same fixed shapes every live dispatch
+            # passes
             S = self.kv.max_slots
             # the loop takes the CHAIN key (as a host array, like every
             # live dispatch), not the split sub
@@ -1914,6 +2248,10 @@ class ServingEngine:
                      jnp.asarray(np.zeros(S, np.int32))]
             if self.adapters is not None:
                 args.append(jnp.asarray(np.zeros(S, np.int32)))
+            if self.draft_k:
+                args += [jnp.asarray(
+                    np.zeros((S, self.draft_ring), np.int32)),
+                    jnp.asarray(np.zeros(S, np.int32))]
         return args
 
     def install_aot_step(self, fn):

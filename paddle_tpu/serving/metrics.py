@@ -263,6 +263,15 @@ SERVING_EARLY_EXITS = REGISTRY.counter(
     "dispatch's tick budget ran out",
     ("reason",))   # finish (EOS/horizon) | overflow (blocks) | reject (draft)
 
+# ---- on-device speculation (ISSUE 19) ----------------------------------
+SERVING_SPECULATION_STATE = REGISTRY.gauge(
+    "paddle_tpu_serving_speculation_state",
+    "Why this replica is or isn't speculating: 1 on exactly one mode — "
+    "off (draft_k=0), host (1-tick host n-gram drafting), device "
+    "(drafting + verify + sampling history resident in the multi-tick "
+    "while_loop; composes with TP and penalized sampling)",
+    ("mode",))   # off|host|device
+
 #: every name above, for the smoke-tool contract check
 CONTRACT_METRICS = (
     "paddle_tpu_serving_ttft_seconds",
@@ -348,6 +357,10 @@ CONTRACT_METRICS = (
     "paddle_tpu_serving_ticks_per_dispatch",
     "paddle_tpu_serving_host_stall_seconds_total",
     "paddle_tpu_serving_early_exits_total",
+    # on-device speculation (ISSUE 19): which speculation mode each
+    # replica runs — the operator-facing answer to "why is this
+    # replica (not) speculating"
+    "paddle_tpu_serving_speculation_state",
 )
 
 #: draft-hit ratio = accepted / proposed from SERVING_DRAFT_TOKENS —

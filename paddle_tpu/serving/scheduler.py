@@ -105,8 +105,8 @@ class Plan:
 class Scheduler:
     def __init__(self, kv_cache, *, max_slots, token_budget,
                  clock=time.monotonic, draft_k=0, draft_fn=None,
-                 prefix_cache=None, adapter_cache=None,
-                 reserve_region=False):
+                 device_draft=False, prefix_cache=None,
+                 adapter_cache=None, reserve_region=False):
         self.kv = kv_cache
         self.max_slots = max_slots
         self.token_budget = token_budget
@@ -121,6 +121,13 @@ class Scheduler:
         # note_fed leaves decode lengths alone when draft_k > 0
         self.draft_k = int(draft_k)
         self.draft_fn = draft_fn
+        # device-resident drafting (ISSUE 19): the multi-tick engine
+        # proposes drafts INSIDE the while_loop from the on-device
+        # token ring, so plan() emits plain single-token decode groups
+        # ([last] only — the device widens them) while the reserved-
+        # region budget and note_fed/note_accept bookkeeping keep the
+        # full draft_k treatment
+        self.device_draft = bool(device_draft)
         # radix prefix cache (serving.prefix_cache): admission skips
         # cached prompt heads, prefill completion / finish publish the
         # written blocks for later requests
@@ -427,9 +434,14 @@ class Scheduler:
             if req.slot < 0:
                 continue
             protected.add(req)
-            if self.draft_k > 0:
+            if self.draft_k > 0 and not self.device_draft:
                 decode.append((req.slot,
                                self._draft_tokens(req, pos), pos))
+            elif self.draft_k > 0:
+                # device drafting: feed only the last accepted token —
+                # the engine's extend_for_ticks preallocation covers
+                # the verify burst, and the loop body widens the group
+                decode.append((req.slot, [req.output[-1]], pos))
             else:
                 decode.append((req.slot, req.output[-1], pos))
 

@@ -8,7 +8,12 @@ greedy, seeded sampling, preemption under block pressure, block-sparse
 exactly ONCE (n_ticks is a traced scalar, so 1-tick and N-tick
 dispatches share the executable; the suite-wide compile watchdog
 backstops every test here). Speculation and history-dependent sampling
-fall back to single-tick dispatches. The `inference.Config` knob
+ride INSIDE the loop since ISSUE 19: a per-slot device ring buffer
+feeds `ngram_propose_device` and a `[max_slots, penalty_vocab_bins]`
+count tensor feeds the penalty processors, so `draft_k > 0` and
+repetition/presence penalties compose with `ticks_per_dispatch=N`
+(token-identical to the N=1 host-drafter engine for greedy, same
+sampling distribution otherwise). The `inference.Config` knob
 validates before mutating and the disaggregated router pins prefill
 replicas to 1 tick.
 """
@@ -209,19 +214,127 @@ class TestMultitickTP:
         assert eng.device_ticks_run > eng.dispatches_run
 
 
+# ------------------------------------------------- on-device speculation
+
+
+def _spec_sampling(name):
+    return {
+        "greedy": None,
+        "top-p": SamplingConfig(strategy="sampling", temperature=0.8,
+                                top_p=0.9),
+        "rep-pen": SamplingConfig(strategy="sampling", temperature=0.9,
+                                  repetition_penalty=1.3),
+        "rep-pen-greedy": SamplingConfig(repetition_penalty=1.3,
+                                         presence_penalty=0.2),
+    }[name]
+
+
+class TestSpeculativeMultitick:
+    """ISSUE 19 identity matrix: the N-tick engine with the TRACED
+    drafter/verify/ring/count math must reproduce the N=1 engine —
+    host n-gram drafter, host accept loop, host-rebuilt penalty counts
+    — bit-exactly, in one compile, for every sampling family and for
+    draft_k=0 (penalties-in-the-loop is new here too)."""
+
+    @pytest.mark.parametrize("n", [4, "auto"])
+    @pytest.mark.parametrize("draft_k", [0, 3])
+    @pytest.mark.parametrize("name", ["greedy", "top-p", "rep-pen",
+                                      "rep-pen-greedy"])
+    def test_token_identical_one_compile(self, model, n, draft_k,
+                                         name):
+        sc = _spec_sampling(name)
+        kw = dict(draft_k=draft_k)
+        if sc is not None:
+            kw["sampling"] = sc
+        ref, out, eng, compiles = _run_pair(
+            lambda k: _engine(model,
+                              ticks_per_dispatch=n if k != 1 else 1,
+                              **kw),
+            _prompts(), n, max_new_tokens=8)
+        assert out == ref
+        assert compiles == 1
+        assert eng.kv.blocks_in_use == 0
+        want = "device" if draft_k else "off"
+        assert eng.speculation_mode == want
+
+    def test_repetitive_prompts_accept_on_device(self, model):
+        """A prompt the n-gram drafter can actually predict: the
+        in-loop accept roll must land multi-token groups and the host
+        mirrors of the device counters must agree with the metrics."""
+        prompts = [[7, 8, 9] * 6, [3, 4] * 8]
+        ref = _engine(model, draft_k=3).generate_batch(
+            prompts, max_new_tokens=12)
+        eng = _engine(model, draft_k=3, ticks_per_dispatch=4)
+        out = eng.generate_batch(prompts, max_new_tokens=12)
+        assert out == ref
+        assert eng.spec_accepted_total > 0
+        assert eng.spec_proposed_total >= eng.spec_accepted_total
+
+    def test_tp2_spec_token_identical_one_compile(self, model):
+        """TP=2 shares the identical traced drafter: the loop (and its
+        ring/drafter/accept math) sits OUTSIDE shard_map on replicated
+        control arrays, so a TP=2 speculative engine matches the
+        1-chip N=1 host-drafter reference in one compile."""
+        import jax
+
+        from paddle_tpu.serving.distributed import TPServingEngine
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        prompts = _prompts()
+        ref = _engine(model, draft_k=3).generate_batch(
+            prompts, max_new_tokens=8)
+        pm.enable()
+        pm.REGISTRY.reset()
+        try:
+            eng = TPServingEngine(model, tensor_parallel=2,
+                                  max_slots=4, block_size=4,
+                                  max_seq_len=64,
+                                  cache_dtype="float32", seed=0,
+                                  draft_k=3, ticks_per_dispatch=4)
+            c0 = pm.JIT_COMPILES.labels(STEP_FN_NAME).value
+            out = eng.generate_batch(prompts, max_new_tokens=8)
+            compiles = pm.JIT_COMPILES.labels(STEP_FN_NAME).value - c0
+        finally:
+            pm.REGISTRY.reset()
+            pm.disable()
+        assert out == ref
+        assert compiles == 1
+        assert eng.speculation_mode == "device"
+        assert eng.kv.blocks_in_use == 0
+
+
 # ------------------------------------------------- fallback + plumbing
 
 
 class TestMultitickFallbacks:
-    def test_speculation_disables_multitick(self, model):
-        """draft_k > 0 needs the host-side verify loop every step, so
-        the engine silently falls back to single-tick dispatches and
-        stays token-identical."""
+    def test_speculation_rides_multitick(self, model):
+        """draft_k > 0 no longer falls back to single-tick dispatches
+        (ISSUE 19): the n-gram drafter runs inside the while_loop on a
+        device token-history ring, and the N-tick engine is
+        token-identical to the N=1 engine running the HOST drafter."""
         prompts = _prompts()
-        ref = _engine(model).generate_batch(prompts, max_new_tokens=8)
+        ref = _engine(model, draft_k=3).generate_batch(
+            prompts, max_new_tokens=8)
         eng = _engine(model, draft_k=3, ticks_per_dispatch=4)
-        assert eng.multitick_disabled and not eng._multitick
+        assert eng._multitick and eng.speculation_mode == "device"
         assert eng.generate_batch(prompts, max_new_tokens=8) == ref
+        # the drafter really proposed on device and the readback
+        # mirrored the totals
+        assert eng.spec_proposed_total > 0
+        assert 0 <= eng.spec_accepted_total <= eng.spec_proposed_total
+
+    def test_bad_spec_configs_raise_loudly(self, model):
+        """Impossible speculation combos are a loud ValueError at
+        construction, never a silent draft_k zeroing (ISSUE 19
+        satellite)."""
+        for kw in (dict(draft_k=-1),
+                   dict(draft_k=2, draft_ngram=0),
+                   dict(draft_k=2, draft_ring=1)):
+            with pytest.raises(ValueError):
+                _engine(model, **kw)
+        with pytest.raises(ValueError):
+            _engine(model, penalty_vocab_bins=0,
+                    sampling=SamplingConfig(repetition_penalty=1.3))
 
     def test_bad_ticks_rejected(self, model):
         for bad in (0, -1, "fast"):

@@ -432,7 +432,7 @@ class TestServingSampling:
         sc = SamplingConfig(strategy="sampling", temperature=1.5)
         eng = self._engine(m, sampling=sc, seed=3, draft_k=3)
         assert eng.draft_k == 3
-        assert eng.spec_sampling and not eng.speculation_disabled
+        assert eng.spec_sampling and eng.speculation_mode != "off"
         out = eng.generate_batch(self._prompts(), max_new_tokens=6)
         again = self._engine(m, sampling=sc, seed=3,
                              draft_k=3).generate_batch(
@@ -442,7 +442,7 @@ class TestServingSampling:
             assert len(o) == 6
         # greedy engines keep the exact token-identity verify
         spec = self._engine(m, seed=0, draft_k=3)
-        assert spec.draft_k == 3 and not spec.speculation_disabled
+        assert spec.draft_k == 3 and spec.speculation_mode != "off"
         assert not spec.spec_sampling
 
     def test_spec_sampling_top_k_one_matches_greedy(self):
@@ -479,10 +479,11 @@ class TestServingSampling:
 
 class TestLogitProcessors:
     """Repetition / presence penalties inside the one mixed step
-    (ISSUE 9 satellite): fixed-shape (a [max_slots, penalty_window]
-    history tensor, rebuilt host-side per step), composable with the
-    PR 8 top-k/top-p/temperature path AND with greedy,
-    seed-deterministic, speculation auto-disabled."""
+    (ISSUE 9 satellite, reshaped by ISSUE 19): a fixed-shape
+    [max_slots, penalty_vocab_bins] token-count tensor feeds the
+    processors, composable with the PR 8 top-k/top-p/temperature path
+    AND with greedy; seed-deterministic, and since ISSUE 19 it
+    composes with speculation instead of auto-disabling it."""
 
     def _model(self, vocab=97):
         paddle.seed(1234)
@@ -644,11 +645,15 @@ class TestLogitProcessors:
             pm.REGISTRY.reset()
             pm.disable()
 
-    def test_speculation_auto_disables_for_penalties(self):
+    def test_speculation_composes_with_penalties(self):
+        """Penalized GREEDY speculation no longer auto-disables
+        (ISSUE 19): the verify head rebuilds each draft position's
+        count prior from the fed tokens, so the speculative engine is
+        token-identical to the draft_k=0 penalized engine."""
         m = self._model()
         sc = SamplingConfig(repetition_penalty=2.0)
         eng = self._engine(m, seed=0, draft_k=3, sampling=sc)
-        assert eng.draft_k == 0 and eng.speculation_disabled
+        assert eng.draft_k == 3 and eng.speculation_mode != "off"
         ref = self._engine(m, seed=0, sampling=sc).generate_batch(
             self._prompts(), max_new_tokens=6)
         assert eng.generate_batch(self._prompts(),

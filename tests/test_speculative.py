@@ -46,6 +46,32 @@ def test_ngram_propose_prompt_lookup():
     assert ngram_propose([5], 0) == []
 
 
+def test_ngram_propose_device_twin_matches_host():
+    """The `jnp` drafter the multi-tick loop traces (ISSUE 19) must
+    propose EXACTLY what the host drafter proposes on the same
+    trailing window — this equivalence is what makes the N-tick
+    speculative engine token-identical to the N=1 reference. Fuzz a
+    small alphabet (dense with repeats) across ring wrap-around."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.draft import (ngram_propose_device,
+                                          ring_chronological)
+    W, k = 16, 3
+    rng = np.random.RandomState(42)
+    for trial in range(200):
+        L = int(rng.randint(1, 41))
+        toks = rng.randint(1, 7, L).astype(np.int32)
+        ring = np.zeros((1, W), np.int32)
+        w = min(L, W)
+        ring[0, np.arange(L - w, L) % W] = toks[-w:]
+        view = ring_chronological(jnp.asarray(ring),
+                                  jnp.asarray([L], np.int32))
+        got = np.asarray(ngram_propose_device(
+            view, jnp.asarray([L], np.int32), k))[0].tolist()
+        want = ngram_propose(toks[-w:].tolist(), k)
+        assert got == want, (trial, toks.tolist(), got, want)
+
+
 # ------------------------------------------------- generate() parity
 
 
@@ -261,9 +287,10 @@ class TestServingSpeculative:
         """Speculation used to verify the GREEDY continuation only
         (non-greedy configs auto-disabled the draft path since
         ISSUE 8); ISSUE 11 accepts drafts by the rejection-sampling
-        rule instead, so a plain sampling config keeps draft_k —
-        only PENALIZED sampling still auto-disables (each verify
-        position would need its own history window)."""
+        rule instead, so a plain sampling config keeps draft_k — and
+        since ISSUE 19 PENALIZED sampling keeps it too: the verify
+        head rebuilds each draft position's count prior from the fed
+        tokens, so no fallback remains."""
         from paddle_tpu.serving.batcher import SamplingConfig
         m = _model()
         eng = ServingEngine(m, max_slots=2, block_size=8,
@@ -271,13 +298,16 @@ class TestServingSpeculative:
                             draft_k=2,
                             sampling=SamplingConfig("sampling"))
         assert eng.draft_k == 2
-        assert eng.spec_sampling and not eng.speculation_disabled
+        assert eng.spec_sampling and eng.speculation_mode == "host"
         pen = ServingEngine(m, max_slots=2, block_size=8,
                             max_seq_len=64, cache_dtype="float32",
                             draft_k=2,
                             sampling=SamplingConfig(
                                 "sampling", presence_penalty=0.5))
-        assert pen.draft_k == 0 and pen.speculation_disabled
+        assert pen.draft_k == 2 and pen.speculation_mode == "host"
+        # penalized speculation really generates (and is seed-stable)
+        out = pen.generate_batch([[1, 2, 3, 1, 2]], max_new_tokens=5)
+        assert len(out[0]) == 5
 
     def test_inference_config_passthrough(self):
         import paddle_tpu.inference as infer

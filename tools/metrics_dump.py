@@ -64,6 +64,11 @@ EXPECTED_METRICS = (
     "paddle_tpu_serving_ticks_per_dispatch",
     "paddle_tpu_serving_host_stall_seconds_total",
     "paddle_tpu_serving_early_exits_total",
+    # On-device speculation (ISSUE 19): mode gauge (off/host/device)
+    # registered by importing serving.metrics; activity is exercised
+    # by tools/multitick_smoke.py's speculative burst and
+    # tests/test_multitick.py's identity matrix
+    "paddle_tpu_serving_speculation_state",
 )
 
 

@@ -14,8 +14,13 @@ record nonzero early-exit events (max_new_tokens is deliberately not
 a multiple of N, so horizon finishes cut dispatches short), leak zero
 KV blocks once drained, and every serving metric name in
 `serving.metrics.CONTRACT_METRICS` — including the three ISSUE 18
-names — must appear in the Prometheus-text dump. Exit status is
-non-zero on any violation.
+names — must appear in the Prometheus-text dump. Two ISSUE 19 bursts
+ride along: a SPECULATIVE burst (draft_k=3 multi-tick, device-resident
+n-gram drafting, token-identical to the N=1 host drafter with nonzero
+accepts on repetitive prompts) and a PENALIZED-sampling burst (count-
+histogram penalties inside the loop composing with speculation,
+token-identical to the draft_k=0 single-tick penalized engine). Exit
+status is non-zero on any violation.
 
 Usage: JAX_PLATFORMS=cpu python tools/multitick_smoke.py
 """
@@ -110,7 +115,55 @@ def run_smoke():
                 f"N={n} ran {engines[n].device_ticks_run} ticks over "
                 f"{engines[n].dispatches_run} dispatches — no "
                 "dispatch ever multi-ticked")
+    failures += run_spec_bursts(model)
     return outs, engines, failures
+
+
+def run_spec_bursts(model):
+    """ISSUE 19 bursts: (a) speculative — the in-loop device drafter
+    must match the N=1 host drafter token-for-token and actually
+    accept on drafter-friendly prompts; (b) penalized sampling — the
+    count-histogram penalties inside the loop must compose with
+    speculation and stay identical to the draft_k=0 single-tick
+    penalized engine."""
+    from paddle_tpu.serving.batcher import SamplingConfig
+    from paddle_tpu.serving.engine import ServingEngine
+
+    def eng(**kw):
+        kw.setdefault("max_slots", 4)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("num_blocks", 24)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("cache_dtype", "float32")
+        kw.setdefault("seed", 0)
+        return ServingEngine(model, **kw)
+
+    failures = []
+    # (a) speculative burst: repetitive prompts so n-gram lookup lands
+    prompts = [[7, 8, 9] * 5, [3, 4] * 7, [11, 12, 13, 11, 12, 13]]
+    ref = eng(draft_k=3).generate_batch(prompts, max_new_tokens=10)
+    spec = eng(draft_k=3, ticks_per_dispatch=4)
+    out = spec.generate_batch(prompts, max_new_tokens=10)
+    if out != ref:
+        failures.append("speculative burst: N=4 device drafter "
+                        "diverges from N=1 host drafter")
+    if spec.speculation_mode != "device":
+        failures.append("speculative burst: engine not in device "
+                        f"speculation mode ({spec.speculation_mode})")
+    if spec.spec_accepted_total <= 0:
+        failures.append("speculative burst: device drafter accepted "
+                        "nothing on repetitive prompts")
+    # (b) penalized-sampling burst: greedy + repetition/presence
+    # penalties keeps exact token identity through spec + multi-tick
+    sc = SamplingConfig(repetition_penalty=1.3, presence_penalty=0.2)
+    pref = eng(sampling=sc).generate_batch(prompts, max_new_tokens=10)
+    pen = eng(sampling=sc, draft_k=3, ticks_per_dispatch=4)
+    pout = pen.generate_batch(prompts, max_new_tokens=10)
+    if pout != pref:
+        failures.append("penalized burst: speculative multi-tick "
+                        "penalized decode diverges from draft_k=0 "
+                        "single-tick penalized engine")
+    return failures
 
 
 def main():
