@@ -66,6 +66,10 @@ DEFAULT_BUDGETS: Dict[str, int] = {
     # one fixed-shape checkpoint cast per engine — every rolling-
     # upgrade flip reuses it (tools/fleet_smoke.py's contract)
     "serving_weight_swap": 1,
+    # one fixed-shape SAGE train step per trainer — the GraphEngine's
+    # [B, fanout] bundle contract keeps every batch the same shape
+    # (tools/graph_smoke.py's contract)
+    "graph_sage_step": 1,
 }
 
 _id_counter = itertools.count(1)

@@ -16,13 +16,23 @@ from ..core.tensor import Tensor
 from ..ops._helpers import as_tensor
 
 
-def _segment(name, jfn, data, segment_ids):
+def _segment(name, jfn, data, segment_ids, fill_empty_zero=False):
     data, segment_ids = as_tensor(data), as_tensor(segment_ids)
     n_seg = int(np.asarray(segment_ids.numpy()).max()) + 1 \
         if segment_ids.size else 0
 
     def _fn(d, s):
-        return jfn(d, s, num_segments=n_seg)
+        res = jfn(d, s, num_segments=n_seg)
+        if fill_empty_zero:
+            # paddle's segment_pool writes 0 for segments with no
+            # members; jax's segment_max/min fill with -inf/+inf
+            counts = jax.ops.segment_sum(
+                jnp.ones((d.shape[0],), jnp.int32), s,
+                num_segments=n_seg)
+            occupied = (counts > 0).reshape(
+                (-1,) + (1,) * (d.ndim - 1))
+            res = jnp.where(occupied, res, jnp.zeros((), res.dtype))
+        return res
     return dispatch.apply(name, _fn, (data, segment_ids))
 
 
@@ -44,11 +54,49 @@ def segment_mean(data, segment_ids, name=None):
 
 
 def segment_max(data, segment_ids, name=None):
-    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+    return _segment("segment_max", jax.ops.segment_max, data,
+                    segment_ids, fill_empty_zero=True)
 
 
 def segment_min(data, segment_ids, name=None):
-    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)
+    return _segment("segment_min", jax.ops.segment_min, data,
+                    segment_ids, fill_empty_zero=True)
+
+
+def _n_out(dst_index, out_size):
+    """Output-row count for message passing: `out_size` wins; otherwise
+    max(dst)+1 — and 0 for an empty edge list (the old host `max()`
+    crashed on zero-size input). An `out_size` SMALLER than max(dst)+1
+    drops the out-of-range messages (XLA scatter semantics, matching
+    the reference kernel's bounds check)."""
+    if out_size is not None:
+        n = int(out_size)
+        if n < 0:
+            raise ValueError(f"out_size={n} must be >= 0")
+        return n
+    return int(np.asarray(dst_index.numpy()).max()) + 1 \
+        if dst_index.size else 0
+
+
+def _seg_reduce(msgs, dst, n_out, reduce_op):
+    """Segment-reduce edge messages with paddle's vacant-row semantics:
+    rows receiving no message are 0 (incl. max/min, where jax fills
+    with -+inf)."""
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+    counts = jax.ops.segment_sum(
+        jnp.ones((msgs.shape[0],), jnp.int32), dst,
+        num_segments=n_out)
+    shape = (-1,) + (1,) * (msgs.ndim - 1)
+    if reduce_op == "mean":
+        sums = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+        return sums / jnp.maximum(counts, 1).astype(
+            sums.dtype).reshape(shape)
+    red = {"max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}[reduce_op]
+    res = red(msgs, dst, num_segments=n_out)
+    return jnp.where((counts > 0).reshape(shape), res,
+                     jnp.zeros((), res.dtype))
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
@@ -57,21 +105,11 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     (graph_send_recv parity)."""
     x, src_index, dst_index = (as_tensor(x), as_tensor(src_index),
                                as_tensor(dst_index))
-    n_out = int(out_size) if out_size is not None else \
-        int(np.asarray(dst_index.numpy()).max()) + 1
-    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
-           "min": jax.ops.segment_min}.get(reduce_op)
+    n_out = _n_out(dst_index, out_size)
 
     def _fn(xa, src, dst):
-        msgs = jnp.take(xa, src, axis=0)
-        if reduce_op == "mean":
-            sums = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
-            counts = jax.ops.segment_sum(
-                jnp.ones((msgs.shape[0],), xa.dtype), dst,
-                num_segments=n_out)
-            return sums / jnp.maximum(counts, 1.0).reshape(
-                (-1,) + (1,) * (xa.ndim - 1))
-        return red(msgs, dst, num_segments=n_out)
+        return _seg_reduce(jnp.take(xa, src, axis=0), dst, n_out,
+                           reduce_op)
     return dispatch.apply("send_u_recv", _fn, (x, src_index, dst_index))
 
 
@@ -80,8 +118,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     """Node+edge message passing (graph_send_ue_recv parity)."""
     x, y = as_tensor(x), as_tensor(y)
     src_index, dst_index = as_tensor(src_index), as_tensor(dst_index)
-    n_out = int(out_size) if out_size is not None else \
-        int(np.asarray(dst_index.numpy()).max()) + 1
+    n_out = _n_out(dst_index, out_size)
 
     def _fn(xa, ya, src, dst):
         msgs = jnp.take(xa, src, axis=0)
@@ -89,18 +126,9 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
             msgs = msgs + ya
         elif message_op == "mul":
             msgs = msgs * ya
-        if reduce_op == "sum":
-            return jax.ops.segment_sum(msgs, dst, num_segments=n_out)
-        if reduce_op == "max":
-            return jax.ops.segment_max(msgs, dst, num_segments=n_out)
-        if reduce_op == "min":
-            return jax.ops.segment_min(msgs, dst, num_segments=n_out)
-        sums = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
-        counts = jax.ops.segment_sum(
-            jnp.ones((msgs.shape[0],), msgs.dtype), dst,
-            num_segments=n_out)
-        return sums / jnp.maximum(counts, 1.0).reshape(
-            (-1,) + (1,) * (msgs.ndim - 1))
+        if reduce_op in ("max", "min", "mean"):
+            return _seg_reduce(msgs, dst, n_out, reduce_op)
+        return jax.ops.segment_sum(msgs, dst, num_segments=n_out)
     return dispatch.apply("send_ue_recv", _fn,
                           (x, y, src_index, dst_index))
 
@@ -138,8 +166,9 @@ def reindex_graph(x, neighbors, count, value_buffer=None,
     reindex_src = np.asarray([keep[v] for v in nb], np.int64)
     # dst of edge j is the center node whose count covers j
     reindex_dst = np.repeat(np.arange(len(ct)), ct).astype(np.int64)
-    out_nodes = np.asarray(list(keep.keys()),
-                           xs.dtype if xs.size else np.int64)
+    out_dtype = xs.dtype if xs.size else \
+        (nb.dtype if nb.size else np.int64)
+    out_nodes = np.asarray(list(keep.keys()), out_dtype)
     from ..core.tensor import Tensor as _T
     return (_T(jnp.asarray(reindex_src)), _T(jnp.asarray(reindex_dst)),
             _T(jnp.asarray(out_nodes)))
@@ -147,15 +176,17 @@ def reindex_graph(x, neighbors, count, value_buffer=None,
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                      eids=None, return_eids=False, perm_buffer=None,
-                     name=None):
+                     name=None, rng=None):
     """`graph_sample_neighbors_kernel.h` — uniform neighbor sampling
     from CSC (row, colptr) for the given nodes (host-side, like the
-    reference's CPU path; the PS GraphTable covers the distributed
-    case)."""
+    reference's CPU path; the PS ShardedGraphTable covers the
+    distributed case). `rng` injects a seeded `np.random.Generator`
+    (or an int seed) for reproducible draws."""
     rows = np.asarray(as_tensor(row).numpy()).reshape(-1)
     cp = np.asarray(as_tensor(colptr).numpy()).reshape(-1)
     nodes = np.asarray(as_tensor(input_nodes).numpy()).reshape(-1)
-    rng = np.random.default_rng()
+    if not isinstance(rng, np.random.Generator):
+        rng = np.random.default_rng(rng)
     out, cnt, oeids = [], [], []
     ei = np.asarray(as_tensor(eids).numpy()).reshape(-1) \
         if eids is not None else None
@@ -175,5 +206,7 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                           np.zeros(0, rows.dtype))),
            _T(jnp.asarray(np.asarray(cnt, np.int32))))
     if return_eids and ei is not None:
-        return res + (_T(jnp.asarray(np.concatenate(oeids))),)
+        return res + (_T(jnp.asarray(
+            np.concatenate(oeids) if oeids else
+            np.zeros(0, ei.dtype))),)
     return res

@@ -1,0 +1,90 @@
+"""geometric.fixed — jit-safe fixed-shape twins of the eager graph ops.
+
+The eager API in `geometric/__init__` sizes its outputs from host reads
+(`segment_ids.max()+1`, ragged reindex) — fine for eager parity, fatal
+inside jit (every new graph would recompile). These twins take every
+output size statically and carry validity MASKS instead of ragged
+shapes, which is the contract the GraphEngine's `[B, fanout]` bundles
+feed: masked slots are routed to a dropped out-of-range segment, and
+empty segments produce 0 (paddle's vacant-row semantics, matching the
+eager fixes).
+
+Everything here is pure jax.numpy on raw arrays (no Tensor wrapper, no
+host calls) so the SAGE stack can close over it inside ONE compiled
+step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_ids(segment_ids, num_segments, mask):
+    """Masked entries get segment id `num_segments` — XLA drops
+    out-of-range scatter indices, so they simply never land."""
+    if mask is None:
+        return segment_ids
+    return jnp.where(mask, segment_ids, num_segments)
+
+
+def masked_segment_sum(data, segment_ids, num_segments, mask=None):
+    ids = _masked_ids(segment_ids, num_segments, mask)
+    return jax.ops.segment_sum(data, ids, num_segments=num_segments)
+
+
+def masked_segment_mean(data, segment_ids, num_segments, mask=None):
+    """Mean over the VALID members of each segment; segments with no
+    valid member are 0."""
+    ids = _masked_ids(segment_ids, num_segments, mask)
+    sums = jax.ops.segment_sum(data, ids, num_segments=num_segments)
+    counts = jax.ops.segment_sum(
+        jnp.ones((data.shape[0],), jnp.int32), ids,
+        num_segments=num_segments)
+    return sums / jnp.maximum(counts, 1).astype(sums.dtype).reshape(
+        (-1,) + (1,) * (data.ndim - 1))
+
+
+def masked_segment_max(data, segment_ids, num_segments, mask=None):
+    """Max over the valid members; empty segments are 0, not -inf."""
+    ids = _masked_ids(segment_ids, num_segments, mask)
+    res = jax.ops.segment_max(data, ids, num_segments=num_segments)
+    counts = jax.ops.segment_sum(
+        jnp.ones((data.shape[0],), jnp.int32), ids,
+        num_segments=num_segments)
+    occupied = (counts > 0).reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(occupied, res, jnp.zeros((), res.dtype))
+
+
+def unique_fixed(keys, size, fill_value=0):
+    """Jit-safe reindex twin: `(uniq [size], inv [len(keys)])` with the
+    output size STATIC (`jnp.unique(size=...)`); surplus uniq slots
+    carry `fill_value`. `inv` maps every key to its compact local id —
+    the same contract as `reindex_graph`, without the ragged output."""
+    uniq, inv = jnp.unique(keys, return_inverse=True, size=size,
+                           fill_value=fill_value)
+    return uniq, inv.reshape(keys.shape)
+
+
+def mask_from_counts(counts, fanout):
+    """[N] valid-neighbor counts -> [N, fanout] bool slot mask (the
+    fixed-shape sampler's mask contract: slot j valid iff j < count)."""
+    return jnp.arange(fanout)[None, :] < counts[:, None]
+
+
+def mean_aggregate(neigh_feats, mask):
+    """[N, f, d] neighbor features + [N, f] mask -> [N, d] mean over
+    valid slots (0 for isolated nodes) — the SAGE mean aggregator,
+    phrased as a masked segment reduction over the flattened edges."""
+    n, f, d = neigh_feats.shape
+    seg = jnp.repeat(jnp.arange(n), f)
+    return masked_segment_mean(neigh_feats.reshape(n * f, d), seg, n,
+                               mask=mask.reshape(n * f))
+
+
+def max_aggregate(neigh_feats, mask):
+    """[N, f, d] + [N, f] -> [N, d] max over valid slots (0 when
+    none) — the SAGE max-pool aggregator."""
+    n, f, d = neigh_feats.shape
+    seg = jnp.repeat(jnp.arange(n), f)
+    return masked_segment_max(neigh_feats.reshape(n * f, d), seg, n,
+                              mask=mask.reshape(n * f))

@@ -82,6 +82,16 @@ def merge_with_inverse(inv: np.ndarray, values: np.ndarray,
     is u. Callers that already dedup'd their keys (the embedding
     engine's push path) skip the redundant O(n log n) re-sort."""
     values = np.asarray(values)
+    # np.unique(return_inverse=True) keeps the INPUT shape on numpy
+    # >= 2.1 — flatten so both numpy generations land here, and fail
+    # loudly on a row-count mismatch instead of scattering garbage
+    inv = np.asarray(inv).reshape(-1)
+    if inv.size != values.shape[0]:
+        raise ValueError(
+            f"inverse index has {inv.size} entries for "
+            f"{values.shape[0]} value rows")
+    if values.size == 0:
+        return np.zeros((num_uniq,) + values.shape[1:], values.dtype)
     if values.ndim == 2 and values.shape[1] <= 256 and \
             np.issubdtype(values.dtype, np.floating):
         # segment-sum via per-column bincount: ~3x faster than

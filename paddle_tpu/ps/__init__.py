@@ -11,7 +11,8 @@ from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
 from .trainer import (HogwildTrainer, MultiTrainer,  # noqa: F401
                       DistMultiTrainer)
 from .pass_cache import PassCache, PassCacheEmbedding  # noqa: F401
-from .graph import GraphTable  # noqa: F401
+from .graph import (GraphTable, ShardedGraphTable,  # noqa: F401
+                    GraphEngine, SageTrainer)
 from .pipeline import PullPushPipeline  # noqa: F401
 from .data_generator import (DataGenerator,  # noqa: F401
                              MultiSlotDataGenerator,

@@ -1,0 +1,21 @@
+"""paddle_tpu.ps.graph — the sharded graph engine on the embedding
+substrate (reference: `fleet/heter_ps/graph_gpu_ps_table.h`,
+`gpu_graph_node.h`).
+
+Layers:
+
+* `native.GraphTable` — the original single-process ctypes adjacency
+  store (walks, node features), kept for the eager examples.
+* `sharded.ShardedGraphTable` — splitmix64-hash-partitioned adjacency
+  with deterministic fixed-shape neighbor sampling; co-partitions with
+  a `ShardedSparseTable` via its public `partition_fn`.
+* `engine.GraphEngine` — multi-hop dedup + bundle prefetch + streaming
+  mutations, composed with `HeterEmbeddingEngine` feature pulls.
+* `sage.SageTrainer` — the jitted GraphSAGE training lane.
+"""
+from .native import GraphTable  # noqa: F401
+from .sharded import ShardedGraphTable  # noqa: F401
+from .engine import GraphEngine, GraphBatch  # noqa: F401
+from .sage import (SageTrainer, sage_encode,  # noqa: F401
+                   init_sage_params, make_power_law_graph,
+                   contrastive_batches)

@@ -1,0 +1,391 @@
+"""GraphEngine: multi-hop neighbor sampling composed with the embedding
+engine's feature pull, pipelined exactly like `ps/heter/engine.py`.
+
+The pull/push cycle of the reference GPU graph engine
+(`fleet/heter_ps/graph_gpu_ps_table.h` + `ps_gpu_wrapper` pull/push)
+rebuilt on the PR 6 substrate:
+
+* **Multi-hop frontier expansion with per-hop dedup.** Hop h's frontier
+  collapses to unique nodes (`np.unique` + inverse gather) before the
+  sharded sample — a power-law hub is sampled once per hop no matter
+  how many frontier slots point at it, and every slot gets the SAME
+  neighborhood (the dedup is semantics, not just traffic: it is what
+  makes the bundle a pure function of (graph, seed)).
+* **Fixed-shape bundles.** For fanouts (f0, f1, ...) the bundle arrays
+  are `[B, f0]`, `[B*f0, f1]`, ... plus masks — shapes depend only on
+  (B, fanouts), so the consuming jitted SAGE step compiles once.
+* **Deterministic seeds from a sample clock.** Batch N's sampling seed
+  is `splitmix64(base_seed + N)` where N counts *consumed* batches.
+  A prefetch for batch N+1 predicts clock N+1; the pipelined and the
+  sequential schedule therefore draw the SAME neighborhoods, which is
+  what the bit-identity parity contract rests on.
+* **Double-buffered bundle prefetch.** `prefetch(next_seeds)` samples
+  batch N+1's hops on a background thread and hands the resulting key
+  block to `features.prefetch(...)` — so batch N+1's adjacency AND
+  feature traffic both overlap batch N's dense step. Consume-time
+  coherence (strict mode): if any streamed mutation that landed after
+  the prefetch snapshot touches any frontier node of the pending
+  bundle, the whole bundle is resampled with the SAME seed (counted as
+  a repair); the feature block then re-pulls through the embedding
+  engine's own consume/repair machinery (a key mismatch after a graph
+  repair retires the feature prefetch automatically).
+* **Streaming mutations.** `add_edges`/`remove_edges` ride a bounded
+  background queue (backpressure, not loss). ``strict`` mode makes
+  `sample_batch` barrier on every mutation enqueued before the call —
+  sample-after-update coherence for tests and the parity oracle;
+  ``stream`` mode lets samples race the queue (online training: the
+  staleness window is the queue depth).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..heter.sharded import splitmix64
+from ...profiler import metrics as _pm
+from . import metrics as _m
+
+
+def _seed_for(base_seed: int, clock: int) -> int:
+    return int(splitmix64(np.asarray(
+        [(int(base_seed) + int(clock)) & 0xFFFFFFFFFFFFFFFF],
+        np.uint64))[0])
+
+
+class GraphBatch:
+    """One fixed-shape multi-hop bundle.
+
+    `keys` is the concatenation [seeds, neighbors[0].ravel(), ...] —
+    the exact array to `features.push(...)` gradients against (the
+    embedding engine's dedup memo recognizes it and skips the re-sort).
+    """
+    __slots__ = ("seeds", "neighbors", "masks", "keys", "features",
+                 "seed", "clock")
+
+    def __init__(self, seeds, neighbors, masks, keys, features, seed,
+                 clock):
+        self.seeds = seeds          # [B] uint64
+        self.neighbors = neighbors  # tuple: [B,f0], [B*f0,f1], ...
+        self.masks = masks          # same shapes, bool
+        self.keys = keys            # [B + B*f0 + ...] uint64
+        self.features = features    # [len(keys), dim] f32 | None
+        self.seed = seed
+        self.clock = clock
+
+    def level_sizes(self):
+        sizes = [self.seeds.size]
+        for nb in self.neighbors:
+            sizes.append(nb.size)
+        return sizes
+
+
+class GraphEngine:
+    """Sharded adjacency + embedding features behind one pipelined,
+    coherence-checked sampling front end."""
+
+    def __init__(self, graph, features=None, fanouts=(10, 5),
+                 mode="strict", base_seed=0, prefetch=True,
+                 update_queue=16):
+        if mode not in ("strict", "stream"):
+            raise ValueError(f"mode={mode!r} not in ('strict','stream')")
+        fanouts = tuple(int(f) for f in fanouts)
+        if not fanouts or any(f < 1 for f in fanouts):
+            raise ValueError(f"fanouts={fanouts} must be >=1 each")
+        self.graph = graph
+        self.features = features
+        self.fanouts = fanouts
+        self.mode = mode
+        self.base_seed = int(base_seed)
+        self._clock = 0            # consumed batches (the seed source)
+        # streaming-mutation lane
+        self._cv = threading.Condition()
+        self._submitted_seq = 0
+        self._applied_seq = 0
+        self._version = 0          # bumps once per applied mutation
+        self._touched = deque()    # (version, unique src nodes)
+        self._touched_floor = 0
+        self._upd_q = queue.Queue(maxsize=max(1, int(update_queue)))
+        self._upd_errors = []
+        self._upd_thread = threading.Thread(target=self._update_loop,
+                                            daemon=True)
+        self._upd_thread.start()
+        # one pending bundle prefetch (double buffering)
+        self._pf_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="graph-prefetch") \
+            if prefetch else None
+        self._pf_pending = None
+        # raw counters (bench/tests read these without the registry)
+        self.raw_frontier = 0
+        self.uniq_frontier = 0
+        self.prefetch_hits = 0
+        self.prefetch_repairs = 0
+        self.prefetch_unused = 0
+        self.stream_adds = 0
+        self.stream_removes = 0
+        self.sample_batches = 0
+
+    # ================================================= streaming updates
+    def add_edges(self, src, dst, weights=None):
+        """Enqueue a directed-edge batch (applied in order by the
+        background worker; blocks only when the queue is full)."""
+        return self._enqueue("add", src, dst, weights)
+
+    def remove_edges(self, src, dst):
+        return self._enqueue("remove", src, dst, None)
+
+    def _enqueue(self, op, src, dst, weights):
+        src = np.ascontiguousarray(np.asarray(src).reshape(-1),
+                                   np.uint64).copy()
+        dst = np.ascontiguousarray(np.asarray(dst).reshape(-1),
+                                   np.uint64).copy()
+        w = None if weights is None else \
+            np.asarray(weights, np.float32).reshape(-1).copy()
+        with self._cv:
+            self._submitted_seq += 1
+            seq = self._submitted_seq
+        self._upd_q.put((seq, op, src, dst, w))
+        return seq
+
+    def _update_loop(self):
+        while True:
+            item = self._upd_q.get()
+            if item is None:
+                return
+            seq, op, src, dst, w = item
+            touched = np.unique(src)
+            try:
+                if op == "add":
+                    self.graph.add_edges(src, dst, w)
+                    self.stream_adds += 1
+                else:
+                    self.graph.remove_edges(src, dst)
+                    self.stream_removes += 1
+                if _pm._enabled:
+                    _m.GRAPH_STREAM_UPDATES.labels(op).inc()
+            except Exception as e:  # noqa: BLE001 — surface on flush
+                self._upd_errors.append(e)
+            finally:
+                with self._cv:
+                    self._version += 1
+                    self._touched.append((self._version, touched))
+                    while len(self._touched) > 64:
+                        self._touched_floor = \
+                            self._touched.popleft()[0]
+                    self._applied_seq = seq
+                    self._cv.notify_all()
+
+    def _barrier(self, upto_seq, timeout=60):
+        with self._cv:
+            done = self._cv.wait_for(
+                lambda: self._applied_seq >= upto_seq
+                or self._upd_errors, timeout=timeout)
+        if not done:
+            raise TimeoutError("graph update lane stalled")
+        if self._upd_errors:
+            raise self._upd_errors.pop(0)
+
+    def _conflicts(self, version, node_union):
+        """True when a mutation applied after `version` touches any
+        node of the pending bundle's frontier union. A snapshot older
+        than the retained history conservatively conflicts."""
+        with self._cv:
+            if version < self._touched_floor:
+                return True
+            touched = [ks for v, ks in self._touched if v > version]
+        if not touched:
+            return False
+        return bool(np.isin(np.concatenate(touched), node_union,
+                            assume_unique=False).any())
+
+    # ========================================================= sampling
+    def _sample_hops(self, seeds, batch_seed):
+        """Pure multi-hop expansion: (neighbors, masks, node_union,
+        raw, uniq). Deterministic in (graph state, batch_seed)."""
+        neighbors, masks = [], []
+        uniqs = [np.unique(seeds)]
+        frontier = seeds
+        raw = uniq_n = 0
+        for h, f in enumerate(self.fanouts):
+            raw += frontier.size
+            uniq, inv = np.unique(frontier, return_inverse=True)
+            uniq_n += uniq.size
+            nb_u, mk_u = self.graph.sample_neighbors(
+                uniq, f, seed=(batch_seed + h) & 0xFFFFFFFFFFFFFFFF)
+            neighbors.append(nb_u[inv])
+            masks.append(mk_u[inv])
+            frontier = neighbors[-1].reshape(-1)
+            uniqs.append(np.unique(frontier))
+        node_union = np.unique(np.concatenate(uniqs))
+        return (tuple(neighbors), tuple(masks), node_union, raw,
+                uniq_n)
+
+    @staticmethod
+    def _bundle_keys(seeds, neighbors):
+        return np.concatenate(
+            [seeds] + [nb.reshape(-1) for nb in neighbors])
+
+    def sample_batch(self, seeds, train=False):
+        """seeds: uint64 [B] -> GraphBatch. In strict mode the sample
+        reflects every mutation enqueued before this call (barrier +
+        prefetch repair); in stream mode it reflects whatever the
+        update worker has applied so far."""
+        t0 = time.perf_counter()
+        seeds = np.ascontiguousarray(np.asarray(seeds).reshape(-1),
+                                     np.uint64)
+        if self.mode == "strict":
+            with self._cv:
+                upto = self._submitted_seq
+            self._barrier(upto)
+        clock = self._clock
+        batch_seed = _seed_for(self.base_seed, clock)
+        got = self._consume_prefetch(seeds, clock)
+        if got is None:
+            neighbors, masks, node_union, raw, uniq_n = \
+                self._sample_hops(seeds, batch_seed)
+        else:
+            neighbors, masks, node_union, raw, uniq_n = got
+        self._clock = clock + 1
+        self.sample_batches += 1
+        self.raw_frontier += raw
+        self.uniq_frontier += uniq_n
+        keys = self._bundle_keys(seeds, neighbors)
+        feats = None
+        if self.features is not None:
+            feats = self.features.pull(keys, train=train,
+                                       use_prefetch=True)
+        if _pm._enabled:
+            _m.GRAPH_SAMPLE_SECONDS.observe(time.perf_counter() - t0)
+            _m.GRAPH_FRONTIER_NODES.labels("raw").inc(int(raw))
+            _m.GRAPH_FRONTIER_NODES.labels("unique").inc(int(uniq_n))
+            _m.GRAPH_DEDUP_RATIO.set(self.dedup_ratio())
+        return GraphBatch(seeds, neighbors, masks, keys, feats,
+                          batch_seed, clock)
+
+    # ---------------------------------------------------------- prefetch
+    def prefetch(self, next_seeds):
+        """Sample batch N+1's bundle (and prefetch its feature block)
+        on the background thread while the current dense step runs."""
+        if self._pf_pool is None:
+            return
+        seeds = np.ascontiguousarray(
+            np.asarray(next_seeds).reshape(-1), np.uint64).copy()
+        self._retire_prefetch()
+        with self._cv:
+            version = self._version
+        clock = self._clock           # the NEXT consume's clock
+        self._pf_pending = {
+            "seeds": seeds, "clock": clock, "version": version,
+            "future": self._pf_pool.submit(self._pf_job, seeds, clock),
+        }
+
+    def _pf_job(self, seeds, clock):
+        batch_seed = _seed_for(self.base_seed, clock)
+        out = self._sample_hops(seeds, batch_seed)
+        if self.features is not None:
+            # hand the key block to the embedding engine's own
+            # double-buffered prefetch: features overlap the dense step
+            # too, and its strict-mode repair machinery owns value
+            # coherence (a graph repair changes the keys, which retires
+            # this feature prefetch automatically at pull time)
+            self.features.prefetch(
+                self._bundle_keys(seeds, out[0]))
+        return out
+
+    def _consume_prefetch(self, seeds, clock):
+        pf = self._pf_pending
+        if pf is None:
+            return None
+        if pf["clock"] != clock or pf["seeds"].size != seeds.size or \
+                not np.array_equal(pf["seeds"], seeds):
+            self._retire_prefetch()
+            return None
+        self._pf_pending = None
+        out = pf["future"].result()
+        if self.mode == "strict" and self._conflicts(pf["version"],
+                                                     out[2]):
+            # a streamed mutation touched this bundle's frontier: the
+            # deterministic seed makes a full resample land exactly
+            # where the sequential oracle would
+            out = self._sample_hops(seeds,
+                                    _seed_for(self.base_seed, clock))
+            self.prefetch_repairs += 1
+            if _pm._enabled:
+                _m.GRAPH_PREFETCH.labels("repair").inc()
+        else:
+            self.prefetch_hits += 1
+            if _pm._enabled:
+                _m.GRAPH_PREFETCH.labels("hit").inc()
+        return out
+
+    def _retire_prefetch(self):
+        """Drop an unconsumed bundle prefetch. Sampling is pure (no
+        graph-side state to repair); the feature block it may have
+        prefetched is retired by the embedding engine at its next
+        pull/flush."""
+        pf = self._pf_pending
+        if pf is None:
+            return
+        self._pf_pending = None
+        pf["future"].result()
+        self.prefetch_unused += 1
+        if _pm._enabled:
+            _m.GRAPH_PREFETCH.labels("unused").inc()
+
+    # ======================================================== push side
+    def push_feature_grads(self, batch: GraphBatch, grads):
+        """Push the SAGE step's per-position feature grads back through
+        the embedding engine (dedup-merged there; strict mode applies
+        synchronously — the grad-flow parity seam)."""
+        if self.features is None:
+            raise ValueError("engine has no feature store")
+        return self.features.push(batch.keys, grads)
+
+    # ========================================================== control
+    def flush(self):
+        """Barrier: drain the mutation queue, retire the bundle
+        prefetch, flush the feature engine (its cache writes back and
+        unpins). After flush() the adjacency holds every enqueued edge
+        and no prefetched state is live."""
+        with self._cv:
+            upto = self._submitted_seq
+        self._barrier(upto)
+        self._retire_prefetch()
+        if self.features is not None:
+            self.features.flush()
+        if self._upd_errors:
+            raise self._upd_errors.pop(0)
+        return self
+
+    def close(self):
+        self.flush()
+        self._upd_q.put(None)
+        self._upd_thread.join(timeout=10)
+        if self._pf_pool is not None:
+            self._pf_pool.shutdown(wait=True)
+            self._pf_pool = None
+
+    # ------------------------------------------------------------ stats
+    def dedup_ratio(self):
+        return 1.0 - self.uniq_frontier / self.raw_frontier \
+            if self.raw_frontier else 0.0
+
+    def state(self):
+        s = {"mode": self.mode,
+             "fanouts": list(self.fanouts),
+             "batches": self.sample_batches,
+             "dedup_ratio": round(self.dedup_ratio(), 4),
+             "graph_nodes": self.graph.num_nodes(),
+             "graph_edges": self.graph.num_edges(),
+             "stream": {"adds": self.stream_adds,
+                        "removes": self.stream_removes},
+             "prefetch": {"hits": self.prefetch_hits,
+                          "repairs": self.prefetch_repairs,
+                          "unused": self.prefetch_unused}}
+        if self.features is not None:
+            s["features"] = self.features.state()
+        return s

@@ -1,0 +1,60 @@
+"""Graph-engine metrics — registered in the framework-wide PR 1
+registry.
+
+Exported names are part of the observability contract (docs/GRAPH.md,
+tools/graph_smoke.py greps them, tools/metrics_dump.py greps the
+CONTRACT tuple). Same hot-path discipline as `ps/heter/metrics.py`:
+the engine keeps raw python counters always on and mirrors them into
+the registry only when `profiler.metrics._enabled` is set.
+"""
+from __future__ import annotations
+
+from ...profiler.metrics import REGISTRY, exponential_buckets
+
+# 10us .. ~2.6s in x4 steps: a one-shard uniform sample is a numpy
+# lexsort (~100us), a multi-hop frontier fans out per shard, a strict
+# sample may barrier on the streaming-update queue first
+_LATENCY_BUCKETS = exponential_buckets(1e-5, 4.0, 9)
+
+GRAPH_SAMPLE_SECONDS = REGISTRY.histogram(
+    "paddle_tpu_graph_sample_seconds",
+    "Latency of one multi-hop sample_batch (barrier + per-hop dedup + "
+    "shard fan-out + feature pull)", buckets=_LATENCY_BUCKETS)
+GRAPH_FRONTIER_NODES = REGISTRY.counter(
+    "paddle_tpu_graph_frontier_nodes_total",
+    "Frontier nodes per hop before/after np.unique dedup",
+    ("kind",))   # raw|unique
+GRAPH_DEDUP_RATIO = REGISTRY.gauge(
+    "paddle_tpu_graph_dedup_ratio",
+    "1 - unique/raw over the engine lifetime (power-law graphs "
+    "re-visit hubs, so this climbs with fanout and hop count)")
+GRAPH_STREAM_UPDATES = REGISTRY.counter(
+    "paddle_tpu_graph_stream_updates_total",
+    "Streaming adjacency mutations applied by the background worker",
+    ("op",))     # add|remove
+GRAPH_PREFETCH = REGISTRY.counter(
+    "paddle_tpu_graph_prefetch_total",
+    "Bundle-prefetch consumption by outcome",
+    ("result",))  # hit|repair|unused
+GRAPH_EDGES = REGISTRY.gauge(
+    "paddle_tpu_graph_edges",
+    "Directed edges resident across all adjacency shards")
+
+#: every name above, for the smoke-tool / metrics_dump contract check
+CONTRACT_METRICS = (
+    "paddle_tpu_graph_sample_seconds",
+    "paddle_tpu_graph_frontier_nodes_total",
+    "paddle_tpu_graph_dedup_ratio",
+    "paddle_tpu_graph_stream_updates_total",
+    "paddle_tpu_graph_prefetch_total",
+    "paddle_tpu_graph_edges",
+)
+
+
+def dedup_ratio():
+    """1 - unique/raw frontier traffic removed by per-hop dedup."""
+    ch = dict(GRAPH_FRONTIER_NODES.samples())
+    raw = ch.get(("raw",))
+    uniq = ch.get(("unique",))
+    r = raw.value if raw else 0.0
+    return 1.0 - (uniq.value if uniq else 0.0) / r if r else 0.0

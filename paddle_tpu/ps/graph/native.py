@@ -14,7 +14,7 @@ import ctypes
 
 import numpy as np
 
-from ._native import get_lib, u64_ptr, f32_ptr, i32_ptr
+from .._native import get_lib, u64_ptr, f32_ptr, i32_ptr
 
 
 def _bind_graph(lib):

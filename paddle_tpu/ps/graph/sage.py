@@ -1,0 +1,210 @@
+"""GraphSAGE training lane over the GraphEngine.
+
+A jitted, fixed-shape mean/max-pool SAGE stack (Hamilton et al.) whose
+inputs are exactly the engine's `[B, fanout]` bundles: per-level feature
+blocks plus slot masks, aggregated with the `geometric.fixed` masked
+segment ops. Because every batch has the same (B, fanouts, dim) shape,
+the train step — forward, unsupervised edge-contrastive loss, grads for
+BOTH the dense SAGE weights and the per-position input features, SGD on
+the dense weights — is ONE `instrumented_jit` instance with a hard
+one-compile budget (`graph_sage_step` in `analysis/guards`).
+
+Feature gradients leave the jit as a `[len(bundle.keys), dim]` block
+and ride `engine.push_feature_grads(...)` back into the embedding
+engine, which dedup-merges duplicate keys (hubs, padding slots) through
+SelectedRows and applies the in-table SGD rule — the same sparse push
+path the wide&deep lane uses, now fed by a graph workload.
+
+Determinism: the trainer owns no RNG. Batches come from
+`contrastive_batches` (seeded numpy), neighborhoods from the engine's
+clock-seeded sampler, and the jit is pure — so a pipelined
+(prefetch-on) run and a sequential oracle produce bit-identical losses
+and table state in strict mode, which tests/tools assert.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...geometric import fixed as gfixed
+from ...jit.functional import instrumented_jit
+
+SAGE_STEP_NAME = "graph_sage_step"
+
+
+def make_power_law_graph(num_nodes=2000, avg_degree=8, alpha=1.1,
+                         seed=0, node_base=1, weighted=False):
+    """Synthetic undirected power-law graph: endpoints drawn with
+    p(rank r) ~ (r+1)^-alpha, self-loops dropped, both directions
+    returned. Node ids are `node_base .. node_base+num_nodes-1`
+    (uint64). Returns (src, dst[, weights])."""
+    rng = np.random.default_rng(seed)
+    n_draw = max(1, num_nodes * avg_degree // 2)
+    p = (np.arange(num_nodes) + 1.0) ** -float(alpha)
+    p /= p.sum()
+    a = rng.choice(num_nodes, n_draw, p=p)
+    b = rng.choice(num_nodes, n_draw, p=p)
+    keep = a != b
+    a, b = a[keep], b[keep]
+    ids = np.arange(node_base, node_base + num_nodes, dtype=np.uint64)
+    src = np.concatenate([ids[a], ids[b]])
+    dst = np.concatenate([ids[b], ids[a]])
+    if not weighted:
+        return src, dst
+    w_half = rng.uniform(0.1, 1.0, a.size).astype(np.float32)
+    return src, dst, np.concatenate([w_half, w_half])
+
+
+def contrastive_batches(src, dst, node_ids, batch_size, steps, seed=0):
+    """Deterministic (center, positive, negative) triples: a positive
+    is the far end of a uniformly drawn edge, a negative a uniformly
+    drawn node. Both parity lanes must iterate the SAME generator
+    output, so this is a seeded pure function of the INITIAL edge
+    list."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(int(steps)):
+        e = rng.integers(0, src.size, batch_size)
+        n = rng.integers(0, node_ids.size, batch_size)
+        out.append((src[e].astype(np.uint64),
+                    dst[e].astype(np.uint64),
+                    node_ids[n].astype(np.uint64)))
+    return out
+
+
+def init_sage_params(in_dim, hidden_dims, seed=0):
+    """Dense SAGE weights: per layer {w_self, w_neigh, b}. Plain
+    pytree (list of dicts) so it jits/greps without nn.Layer
+    machinery."""
+    rng = np.random.default_rng(seed)
+    params = []
+    d = int(in_dim)
+    for h in hidden_dims:
+        h = int(h)
+        scale = float(np.sqrt(2.0 / (d + h)))
+        params.append({
+            "w_self": jnp.asarray(
+                rng.normal(0, scale, (d, h)).astype(np.float32)),
+            "w_neigh": jnp.asarray(
+                rng.normal(0, scale, (d, h)).astype(np.float32)),
+            "b": jnp.zeros((h,), jnp.float32),
+        })
+        d = h
+    return params
+
+
+def sage_encode(params, feats, masks, fanouts, aggregator="mean"):
+    """feats: tuple of [N_l, d] per level (N_0 = B, N_{l+1} =
+    N_l * f_l); masks: tuple of [N_l, f_l]. One SAGE layer consumes one
+    level, so len(feats) == len(params) + 1 == len(fanouts) + 1.
+    Returns l2-normalized embeddings [B, out_dim]."""
+    agg = gfixed.mean_aggregate if aggregator == "mean" \
+        else gfixed.max_aggregate
+    hs = list(feats)
+    for li, layer in enumerate(params):
+        nxt = []
+        for lvl in range(len(hs) - 1):
+            n = hs[lvl].shape[0]
+            f = int(fanouts[lvl])
+            neigh = hs[lvl + 1].reshape(n, f, hs[lvl + 1].shape[-1])
+            a = agg(neigh, masks[lvl])
+            h = (hs[lvl] @ layer["w_self"] + a @ layer["w_neigh"]
+                 + layer["b"])
+            if li < len(params) - 1:
+                h = jax.nn.relu(h)
+            nxt.append(h)
+        hs = nxt
+    # raw (unnormalized) embeddings: under l2 normalization the
+    # collapsed state (every z the same unit vector) is a fixed point
+    # of the edge-contrastive loss — the away-from-negative gradient is
+    # purely radial and gets normalized out
+    return hs[0]
+
+
+class SageTrainer:
+    """End-to-end unsupervised SAGE over a GraphEngine.
+
+    `train_step(centers, positives, negatives)` runs one contrastive
+    step on the 3B-seed bundle; `prefetch(...)` pipelines the next
+    triple's bundle + features behind the current dense step."""
+
+    def __init__(self, engine, hidden_dims=(16, 8), lr=0.5,
+                 aggregator="mean", param_seed=0):
+        if engine.features is None:
+            raise ValueError("SageTrainer needs an engine with features")
+        if len(hidden_dims) != len(engine.fanouts):
+            raise ValueError(
+                f"hidden_dims {hidden_dims} must have one entry per "
+                f"fanout {engine.fanouts}")
+        if aggregator not in ("mean", "max"):
+            raise ValueError(f"aggregator={aggregator!r}")
+        self.engine = engine
+        self.dim = engine.features.dim
+        self.fanouts = engine.fanouts
+        self.aggregator = aggregator
+        self.lr = float(lr)
+        self.params = init_sage_params(self.dim, hidden_dims,
+                                       seed=param_seed)
+        self.steps = 0
+        self._jit_step = instrumented_jit(self._step, SAGE_STEP_NAME)
+
+    # ------------------------------------------------------- pure step
+    def _loss(self, params, feats, masks):
+        z = sage_encode(params, feats, masks, self.fanouts,
+                        self.aggregator)
+        b = z.shape[0] // 3
+        zu, zv, zn = z[:b], z[b:2 * b], z[2 * b:]
+        pos = -jax.nn.log_sigmoid(jnp.sum(zu * zv, axis=-1))
+        neg = -jax.nn.log_sigmoid(-jnp.sum(zu * zn, axis=-1))
+        return jnp.mean(pos + neg)
+
+    def _step(self, params, feats, masks):
+        loss, (pgrads, fgrads) = jax.value_and_grad(
+            self._loss, argnums=(0, 1))(params, feats, masks)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - self.lr * g, params, pgrads)
+        return new_params, loss, fgrads
+
+    # ----------------------------------------------------- engine glue
+    def _split_features(self, batch):
+        sizes = batch.level_sizes()
+        offs = np.cumsum([0] + sizes)
+        return tuple(
+            jnp.asarray(batch.features[offs[i]:offs[i + 1]])
+            for i in range(len(sizes)))
+
+    def train_step(self, centers, positives, negatives):
+        seeds = np.concatenate([
+            np.asarray(centers, np.uint64).reshape(-1),
+            np.asarray(positives, np.uint64).reshape(-1),
+            np.asarray(negatives, np.uint64).reshape(-1)])
+        batch = self.engine.sample_batch(seeds, train=True)
+        feats = self._split_features(batch)
+        masks = tuple(jnp.asarray(m) for m in batch.masks)
+        self.params, loss, fgrads = self._jit_step(
+            self.params, feats, masks)
+        # explicit host readbacks (the sanitize transfer guard allows
+        # device_get, not implicit np coercion)
+        loss, fgrads = jax.device_get((loss, fgrads))
+        grad_full = np.concatenate(
+            [np.asarray(g).reshape(-1, self.dim) for g in fgrads])
+        self.engine.push_feature_grads(batch, grad_full)
+        self.steps += 1
+        return float(loss)
+
+    def prefetch(self, centers, positives, negatives):
+        self.engine.prefetch(np.concatenate([
+            np.asarray(centers, np.uint64).reshape(-1),
+            np.asarray(positives, np.uint64).reshape(-1),
+            np.asarray(negatives, np.uint64).reshape(-1)]))
+
+    def embed(self, nodes):
+        """Inference embeddings for `nodes` (no pins, no push)."""
+        batch = self.engine.sample_batch(
+            np.asarray(nodes, np.uint64).reshape(-1), train=False)
+        z = sage_encode(self.params, self._split_features(batch),
+                        tuple(jnp.asarray(m) for m in batch.masks),
+                        self.fanouts, self.aggregator)
+        return np.asarray(jax.device_get(z))

@@ -1,0 +1,369 @@
+"""Sharded adjacency store: one logical graph over N hash-partitioned
+shards.
+
+Parity: the HeterPS GPU graph table (`fleet/heter_ps/
+graph_gpu_ps_table.h`, `gpu_graph_node.h`) — node ids are
+hash-partitioned over shards exactly like the sparse feature tables, and
+neighbor sampling is a batched pull that fans out per shard. Routing
+reuses `ps/heter/sharded.hash_partition` (splitmix64 % num_shards), and
+the constructor accepts a foreign `partition_fn` so adjacency can
+co-partition with a `ShardedSparseTable`'s feature rows: one node, one
+shard index, for both stores.
+
+Sampling is **deterministic and counter-based**: the sort key for a
+neighbor is `splitmix64(splitmix64(node ^ seed) + slot)` where `slot` is
+the neighbor's position in the node's stored (sorted, deduped) list.
+A node's sample therefore depends only on (its adjacency, the seed) —
+never on batch composition, shard count, or thread interleaving — which
+is what lets the engine's pipelined prefetch be bit-identical to a
+sequential oracle. Uniform sampling takes the fanout largest hash keys;
+weighted sampling exponentiates them Efraimidis-Spirakis style
+(`u ** (1/w)`), which draws without replacement proportional to edge
+weight. Padded slots carry the *center node's own id* (mask False), so a
+consumer that blindly pulls features for the `[B, fanout]` block never
+fabricates phantom keys in an auto-creating feature table.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..heter.sharded import hash_partition, splitmix64
+from ...profiler import metrics as _pm
+from . import metrics as _m
+
+_INV_2POW53 = 1.0 / float(1 << 53)
+
+
+def _u64(x) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(x).reshape(-1), np.uint64)
+
+
+def _hash_slots(nodes: np.ndarray, slots: np.ndarray,
+                seed: int) -> np.ndarray:
+    """Uniform (0,1) float64 per (node, slot, seed) — the counter-based
+    sampling key."""
+    base = splitmix64(nodes ^ np.uint64(int(seed) & 0xFFFFFFFFFFFFFFFF))
+    h = splitmix64(base + slots.astype(np.uint64) + np.uint64(1))
+    return (h >> np.uint64(11)).astype(np.float64) * _INV_2POW53
+
+
+class _GraphShard:
+    """One shard: python dict adjacency + a lazily rebuilt CSR snapshot.
+
+    Mutations build fresh arrays (never write in place), so a CSR
+    snapshot taken under the lock stays valid for lock-free sampling
+    even while a later mutation swaps in new lists.
+    """
+
+    def __init__(self, weighted: bool):
+        self.adj: dict = {}                  # int(node) -> sorted uint64
+        self.wts = {} if weighted else None  # int(node) -> float32
+        self._csr = None
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- mutation
+    def add(self, src, dst, w):
+        """-> net new directed edges. Duplicate (src, dst) keeps the
+        newest weight (last-wins)."""
+        delta = 0
+        with self._lock:
+            order = np.argsort(src, kind="stable")
+            src, dst = src[order], dst[order]
+            if w is not None:
+                w = w[order]
+            uniq, starts = np.unique(src, return_index=True)
+            bounds = np.append(starts, src.size)
+            for i, s in enumerate(uniq):
+                node = int(s)
+                new_nb = dst[bounds[i]:bounds[i + 1]]
+                new_w = w[bounds[i]:bounds[i + 1]] if w is not None \
+                    else None
+                old_nb = self.adj.get(node)
+                before = old_nb.size if old_nb is not None else 0
+                if old_nb is not None:
+                    new_nb = np.concatenate([old_nb, new_nb])
+                    if new_w is not None:
+                        new_w = np.concatenate([self.wts[node], new_w])
+                # keep the LAST occurrence of a duplicated neighbor so a
+                # re-added edge updates its weight
+                rev = new_nb[::-1]
+                merged, first = np.unique(rev, return_index=True)
+                self.adj[node] = merged
+                if new_w is not None:
+                    self.wts[node] = np.ascontiguousarray(
+                        new_w[::-1][first], np.float32)
+                delta += merged.size - before
+            self._csr = None
+        return delta
+
+    def remove(self, src, dst):
+        """-> directed edges actually removed (missing pairs no-op)."""
+        delta = 0
+        with self._lock:
+            order = np.argsort(src, kind="stable")
+            src, dst = src[order], dst[order]
+            uniq, starts = np.unique(src, return_index=True)
+            bounds = np.append(starts, src.size)
+            for i, s in enumerate(uniq):
+                node = int(s)
+                old_nb = self.adj.get(node)
+                if old_nb is None:
+                    continue
+                keep = ~np.isin(old_nb, dst[bounds[i]:bounds[i + 1]])
+                kept = old_nb[keep]
+                delta += old_nb.size - kept.size
+                if kept.size:
+                    self.adj[node] = kept
+                    if self.wts is not None:
+                        self.wts[node] = self.wts[node][keep]
+                else:
+                    del self.adj[node]
+                    if self.wts is not None:
+                        del self.wts[node]
+            self._csr = None
+        return delta
+
+    # ---------------------------------------------------------- snapshot
+    def csr(self):
+        """(nodes_sorted, indptr, flat_neighbors, flat_weights|None) —
+        immutable snapshot, rebuilt lazily after mutations."""
+        with self._lock:
+            if self._csr is None:
+                if not self.adj:
+                    self._csr = (np.empty(0, np.uint64),
+                                 np.zeros(1, np.int64),
+                                 np.empty(0, np.uint64), None)
+                else:
+                    nodes = np.sort(np.fromiter(
+                        self.adj.keys(), np.uint64, len(self.adj)))
+                    lists = [self.adj[int(n)] for n in nodes]
+                    deg = np.fromiter((a.size for a in lists), np.int64,
+                                      nodes.size)
+                    indptr = np.zeros(nodes.size + 1, np.int64)
+                    np.cumsum(deg, out=indptr[1:])
+                    flat = np.concatenate(lists) if lists else \
+                        np.empty(0, np.uint64)
+                    fw = None
+                    if self.wts is not None:
+                        fw = np.concatenate(
+                            [self.wts[int(n)] for n in nodes]) \
+                            if lists else np.empty(0, np.float32)
+                    self._csr = (nodes, indptr, flat, fw)
+            return self._csr
+
+    def num_nodes(self):
+        with self._lock:
+            return len(self.adj)
+
+    def num_edges(self):
+        with self._lock:
+            return sum(a.size for a in self.adj.values())
+
+
+class ShardedGraphTable:
+    """Hash-partitioned adjacency with batched, deterministic,
+    fixed-shape neighbor sampling.
+
+    `sample_neighbors(ids, fanout, seed)` returns `(neighbors, mask)`
+    of shape `[B, fanout]` (uint64 / bool) — never ragged, so the
+    consumer jit compiles once per fanout. Slots past a node's degree
+    are padded with the node's own id and masked False; isolated or
+    unknown nodes come back fully masked.
+    """
+
+    def __init__(self, num_shards=2, weighted=False, partition_fn=None,
+                 parallel=True):
+        if num_shards < 1:
+            raise ValueError(f"num_shards={num_shards} must be >= 1")
+        self.num_shards = int(num_shards)
+        self.weighted = bool(weighted)
+        self._route = partition_fn if partition_fn is not None else \
+            (lambda keys: hash_partition(keys, self.num_shards))
+        self.shards = [_GraphShard(self.weighted)
+                       for _ in range(self.num_shards)]
+        self._edges = 0
+        self._edges_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.num_shards,
+            thread_name_prefix="graph-shard") \
+            if parallel and self.num_shards > 1 else None
+
+    # ------------------------------------------------------------ routing
+    def route(self, flat_keys: np.ndarray) -> np.ndarray:
+        """Shard id per node key (the injected/co-partitioned fn)."""
+        sid = np.asarray(self._route(_u64(flat_keys)), np.int64)
+        return sid
+
+    @property
+    def partition_fn(self):
+        """Mirror of `ShardedSparseTable.partition_fn` — this table's
+        routing seam, exposable onward."""
+        return self.route
+
+    def _partition(self, flat_keys):
+        sid = self.route(flat_keys)
+        if sid.size and (sid.min() < 0 or sid.max() >= self.num_shards):
+            raise ValueError("partition_fn produced shard ids outside "
+                             f"[0, {self.num_shards})")
+        return [np.nonzero(sid == s)[0] for s in range(self.num_shards)]
+
+    def _fan_out(self, jobs):
+        if self._pool is None:
+            return [fn(*args) for fn, args in jobs]
+        futs = [self._pool.submit(fn, *args) for fn, args in jobs]
+        return [f.result() for f in futs]
+
+    # ----------------------------------------------------------- mutation
+    def add_edges(self, src, dst, weights=None):
+        """Directed edges src -> dst (batched). For an undirected graph
+        add both directions. Returns net new edge count."""
+        src, dst = _u64(src), _u64(dst)
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        if self.weighted:
+            w = np.ones(src.size, np.float32) if weights is None else \
+                np.ascontiguousarray(
+                    np.asarray(weights, np.float32).reshape(-1))
+            if w.size != src.size:
+                raise ValueError("weights length mismatch")
+        else:
+            w = None
+        jobs = []
+        for s, idx in enumerate(self._partition(src)):
+            if idx.size:
+                jobs.append((self.shards[s].add,
+                             (src[idx], dst[idx],
+                              w[idx] if w is not None else None)))
+        delta = sum(self._fan_out(jobs))
+        with self._edges_lock:
+            self._edges += delta
+            total = self._edges
+        if _pm._enabled:
+            _m.GRAPH_EDGES.set(total)
+        return delta
+
+    def remove_edges(self, src, dst):
+        """Remove directed edges (missing pairs are ignored)."""
+        src, dst = _u64(src), _u64(dst)
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        jobs = []
+        for s, idx in enumerate(self._partition(src)):
+            if idx.size:
+                jobs.append((self.shards[s].remove,
+                             (src[idx], dst[idx])))
+        delta = sum(self._fan_out(jobs))
+        with self._edges_lock:
+            self._edges -= delta
+            total = self._edges
+        if _pm._enabled:
+            _m.GRAPH_EDGES.set(total)
+        return delta
+
+    # ----------------------------------------------------------- sampling
+    def sample_neighbors(self, ids, fanout: int, seed: int = 0):
+        """ids: uint64 [B] -> (neighbors [B, fanout] uint64,
+        mask [B, fanout] bool). Deterministic in (adjacency, seed)."""
+        ids = _u64(ids)
+        fanout = int(fanout)
+        if fanout < 0:
+            raise ValueError(f"fanout={fanout} must be >= 0")
+        out = np.repeat(ids[:, None], fanout, axis=1) if fanout else \
+            np.empty((ids.size, 0), np.uint64)
+        mask = np.zeros((ids.size, fanout), bool)
+        if not ids.size or not fanout:
+            return out, mask
+        jobs, targets = [], []
+        for s, idx in enumerate(self._partition(ids)):
+            if idx.size:
+                jobs.append((self._sample_shard,
+                             (self.shards[s], ids[idx], fanout, seed)))
+                targets.append(idx)
+        for idx, (nb, mk) in zip(targets, self._fan_out(jobs)):
+            out[idx] = nb
+            mask[idx] = mk
+        return out, mask
+
+    def _sample_shard(self, shard, ids, fanout, seed):
+        nodes, indptr, flat, flat_w = shard.csr()
+        n = ids.size
+        out = np.repeat(ids[:, None], fanout, axis=1)
+        mask = np.zeros((n, fanout), bool)
+        pos = np.searchsorted(nodes, ids)
+        found = pos < nodes.size
+        found[found] = nodes[pos[found]] == ids[found]
+        deg = np.zeros(n, np.int64)
+        deg[found] = (indptr[pos[found] + 1] - indptr[pos[found]])
+        total = int(deg.sum())
+        if not total:
+            return out, mask
+        # flatten every queried node's full neighbor list, then keep the
+        # fanout best-ranked slots per row — one vectorized pass, no
+        # per-node python loop
+        row = np.repeat(np.arange(n, dtype=np.int64), deg)
+        starts = np.zeros(n, np.int64)
+        np.cumsum(deg[:-1], out=starts[1:])
+        slot = np.arange(total, dtype=np.int64) - np.repeat(starts, deg)
+        edge_pos = np.repeat(
+            np.where(found, indptr[np.minimum(pos, nodes.size - 1)], 0),
+            deg) + slot
+        neigh = flat[edge_pos]
+        key = _hash_slots(np.repeat(ids, deg), slot, seed)
+        if flat_w is not None:
+            # Efraimidis-Spirakis: k largest u**(1/w) ~ weighted
+            # sampling without replacement
+            w = np.maximum(flat_w[edge_pos].astype(np.float64), 1e-30)
+            key = key ** (1.0 / w)
+        order = np.lexsort((-key, row))
+        rank = np.arange(total, dtype=np.int64) - np.repeat(starts, deg)
+        sel = rank < fanout
+        rows_sel = row[order][sel]
+        rank_sel = rank[sel]
+        out[rows_sel, rank_sel] = neigh[order][sel]
+        mask[rows_sel, rank_sel] = True
+        return out, mask
+
+    # -------------------------------------------------------------- reads
+    def degree(self, ids) -> np.ndarray:
+        ids = _u64(ids)
+        deg = np.zeros(ids.size, np.int64)
+        for s, idx in enumerate(self._partition(ids)):
+            if idx.size:
+                nodes, indptr, _, _ = self.shards[s].csr()
+                pos = np.searchsorted(nodes, ids[idx])
+                ok = pos < nodes.size
+                ok[ok] = nodes[pos[ok]] == ids[idx][ok]
+                d = np.zeros(idx.size, np.int64)
+                d[ok] = indptr[pos[ok] + 1] - indptr[pos[ok]]
+                deg[idx] = d
+        return deg
+
+    def neighbors(self, node):
+        """Exact adjacency of one node: (sorted uint64 neighbors,
+        float32 weights | None) — the test/oracle seam."""
+        node_arr = _u64([node])
+        shard = self.shards[int(self.route(node_arr)[0])]
+        with shard._lock:
+            nb = shard.adj.get(int(node_arr[0]))
+            if nb is None:
+                return (np.empty(0, np.uint64),
+                        np.empty(0, np.float32) if self.weighted
+                        else None)
+            w = shard.wts[int(node_arr[0])].copy() \
+                if shard.wts is not None else None
+            return nb.copy(), w
+
+    # -------------------------------------------------------------- state
+    def num_nodes(self):
+        return sum(s.num_nodes() for s in self.shards)
+
+    def num_edges(self):
+        with self._edges_lock:
+            return self._edges
+
+    def shard_sizes(self):
+        """Nodes resident per shard."""
+        return [s.num_nodes() for s in self.shards]

@@ -34,6 +34,17 @@ def splitmix64(keys: np.ndarray) -> np.ndarray:
     return z
 
 
+def hash_partition(keys: np.ndarray, num_shards: int) -> np.ndarray:
+    """The canonical shard routing: `splitmix64(key) % num_shards`.
+
+    Public so other sharded stores (the graph adjacency table) can
+    co-partition with a feature table instead of re-deriving the hash
+    scheme; `ShardedSparseTable.partition_fn` hands out a bound form.
+    """
+    return (splitmix64(np.asarray(keys, np.uint64))
+            % np.uint64(num_shards)).astype(np.int64)
+
+
 class ShardedSparseTable:
     """Key-hash-partitioned logical table, duck-compatible with
     `MemorySparseTable` (pull/push/__len__/save/load/row_width), so it
@@ -62,8 +73,16 @@ class ShardedSparseTable:
     # ------------------------------------------------------------ routing
     def route(self, flat_keys: np.ndarray) -> np.ndarray:
         """Shard id per key."""
-        return (splitmix64(flat_keys)
-                % np.uint64(self.num_shards)).astype(np.int64)
+        return hash_partition(flat_keys, self.num_shards)
+
+    @property
+    def partition_fn(self):
+        """`keys -> shard ids`, the public co-partitioning seam: hand
+        this (plus `num_shards`) to another sharded store — e.g.
+        `ShardedGraphTable(partition_fn=table.partition_fn, ...)` — so a
+        node's adjacency lands on the same shard index as its feature
+        row and one fan-out covers both."""
+        return self.route
 
     def _partition(self, flat_keys):
         """-> list of index arrays, one per shard (empty allowed)."""

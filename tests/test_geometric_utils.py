@@ -119,3 +119,219 @@ def test_model_amp_o1_casts_matmuls():
     ys = np.random.randint(0, 2, (8, 1))
     model.fit(TensorDataset([xs, ys]), epochs=1, batch_size=4, verbose=0)
     assert seen["dtype"] == paddle.bfloat16  # matmul ran in bf16 (O1)
+
+
+# ---------------------------------------------------------------------------
+# fuzz: geometric primitives vs numpy oracles (ISSUE 20 satellite) —
+# empty segments, duplicate edges, int32/int64 indices, out-of-range
+# out_size
+# ---------------------------------------------------------------------------
+
+def _seg_oracle(data, seg, n_seg, op):
+    out = np.zeros((n_seg,) + data.shape[1:], data.dtype)
+    for s in range(n_seg):
+        rows = data[seg == s]
+        if rows.size == 0:
+            continue  # paddle semantics: vacant segment stays 0
+        if op == "sum":
+            out[s] = rows.sum(0)
+        elif op == "mean":
+            out[s] = rows.mean(0)
+        elif op == "max":
+            out[s] = rows.max(0)
+        else:
+            out[s] = rows.min(0)
+    return out
+
+
+@pytest.mark.parametrize("idx_dtype", [np.int32, np.int64])
+@pytest.mark.parametrize("op", ["sum", "mean", "max", "min"])
+def test_fuzz_segment_ops(op, idx_dtype):
+    rng = np.random.default_rng(hash((op, idx_dtype.__name__)) % 2**32)
+    fn = getattr(paddle.geometric, f"segment_{op}")
+    for _ in range(6):
+        n = int(rng.integers(1, 40))
+        d = int(rng.integers(1, 5))
+        n_seg = int(rng.integers(1, 12))
+        # sorted ids with gaps -> some segments are empty (the jax
+        # max/min fill bug this suite pinned down)
+        seg = np.sort(rng.integers(0, n_seg, n)).astype(idx_dtype)
+        seg[-1] = n_seg - 1  # pin the output size
+        data = rng.normal(size=(n, d)).astype(np.float32)
+        got = fn(paddle.to_tensor(data), paddle.to_tensor(seg)).numpy()
+        np.testing.assert_allclose(
+            got, _seg_oracle(data, seg, n_seg, op), rtol=1e-5,
+            atol=1e-6)
+
+
+def test_segment_max_empty_segment_is_zero():
+    # segment 1 of 3 is vacant: paddle writes 0, jax would write -inf
+    data = paddle.to_tensor(np.array([[1., -2.], [3., 4.]], np.float32))
+    seg = paddle.to_tensor(np.array([0, 2], np.int64))
+    got = paddle.geometric.segment_max(data, seg).numpy()
+    np.testing.assert_allclose(got, [[1, -2], [0, 0], [3, 4]])
+    got = paddle.geometric.segment_min(data, seg).numpy()
+    np.testing.assert_allclose(got, [[1, -2], [0, 0], [3, 4]])
+
+
+def _send_oracle(x, src, dst, n_out, op):
+    msgs = x[src]
+    keep = dst < n_out  # out-of-range messages drop
+    return _seg_oracle(msgs[keep], dst[keep], n_out, op)
+
+
+@pytest.mark.parametrize("idx_dtype", [np.int32, np.int64])
+@pytest.mark.parametrize("op", ["sum", "mean", "max", "min"])
+def test_fuzz_send_u_recv(op, idx_dtype):
+    rng = np.random.default_rng(hash((op, "su")) % 2**32)
+    for trial in range(6):
+        n_nodes = int(rng.integers(2, 12))
+        n_edges = int(rng.integers(1, 30))
+        x = rng.normal(size=(n_nodes, 3)).astype(np.float32)
+        # duplicate edges on purpose
+        src = rng.integers(0, n_nodes, n_edges).astype(idx_dtype)
+        dst = rng.integers(0, n_nodes, n_edges).astype(idx_dtype)
+        for out_size in (None, n_nodes + 2, max(1, n_nodes - 3)):
+            n_out = out_size if out_size is not None \
+                else int(dst.max()) + 1
+            got = paddle.geometric.send_u_recv(
+                paddle.to_tensor(x), paddle.to_tensor(src),
+                paddle.to_tensor(dst), reduce_op=op,
+                out_size=out_size).numpy()
+            np.testing.assert_allclose(
+                got, _send_oracle(x, src, dst, n_out, op), rtol=1e-5,
+                atol=1e-6)
+
+
+def test_send_u_recv_empty_edges():
+    # zero edges used to crash the host max() output sizing
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    e = paddle.to_tensor(np.zeros(0, np.int64))
+    out = paddle.geometric.send_u_recv(x, e, e, reduce_op="sum")
+    assert out.shape == [0, 2]
+    out = paddle.geometric.send_u_recv(x, e, e, reduce_op="max",
+                                       out_size=4)
+    np.testing.assert_allclose(out.numpy(), np.zeros((4, 2)))
+
+
+def test_send_ue_recv_vacant_rows_zero():
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    y = paddle.to_tensor(np.full((2, 2), 2.0, np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([0, 0], np.int32))
+    out = paddle.geometric.send_ue_recv(x, y, src, dst, "mul", "max",
+                                        out_size=3).numpy()
+    np.testing.assert_allclose(out, [[2, 2], [0, 0], [0, 0]])
+
+
+@pytest.mark.parametrize("idx_dtype", [np.int32, np.int64])
+def test_fuzz_reindex_graph(idx_dtype):
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        n_center = int(rng.integers(1, 6))
+        x = rng.choice(100, n_center, replace=False).astype(idx_dtype)
+        counts = rng.integers(0, 5, n_center)
+        nb = rng.integers(0, 100, int(counts.sum())).astype(idx_dtype)
+        r_src, r_dst, out_nodes = paddle.geometric.reindex_graph(
+            paddle.to_tensor(x), paddle.to_tensor(nb),
+            paddle.to_tensor(counts.astype(np.int32)))
+        out_nodes = out_nodes.numpy()
+        r_src, r_dst = r_src.numpy(), r_dst.numpy()
+        # first-seen order: x first, then unseen neighbors
+        seen, order = set(), []
+        for v in list(x) + list(nb):
+            if int(v) not in seen:
+                seen.add(int(v))
+                order.append(int(v))
+        assert out_nodes.tolist() == order
+        # dtype rides the Tensor round-trip (jax x64-off truncates
+        # int64 -> int32 repo-wide; the index dtype must match x's)
+        assert out_nodes.dtype == paddle.to_tensor(x).numpy().dtype
+        # local ids map back to the original neighbor values
+        np.testing.assert_array_equal(out_nodes[r_src], nb)
+        np.testing.assert_array_equal(
+            r_dst, np.repeat(np.arange(n_center), counts))
+
+
+def test_sample_neighbors_seeded_and_empty():
+    # CSC: node 0 -> {10, 11, 12}, node 1 -> {}, node 2 -> {13}
+    row = paddle.to_tensor(np.array([10, 11, 12, 13], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 3, 3, 4], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    out1, cnt1 = paddle.geometric.sample_neighbors(
+        row, colptr, nodes, sample_size=2, rng=7)
+    out2, cnt2 = paddle.geometric.sample_neighbors(
+        row, colptr, nodes, sample_size=2, rng=7)
+    np.testing.assert_array_equal(out1.numpy(), out2.numpy())
+    np.testing.assert_array_equal(cnt1.numpy(), [2, 0, 1])
+    assert set(out1.numpy().tolist()) <= {10, 11, 12, 13}
+    # empty node list + return_eids used to crash on concatenate
+    eids = paddle.to_tensor(np.arange(4, dtype=np.int64))
+    empty = paddle.to_tensor(np.zeros(0, np.int64))
+    o, c, e = paddle.geometric.sample_neighbors(
+        row, colptr, empty, sample_size=2, eids=eids, return_eids=True)
+    assert o.numpy().size == 0 and c.numpy().size == 0 \
+        and e.numpy().size == 0
+
+
+def test_fixed_twins_match_oracles():
+    from paddle_tpu.geometric import fixed as gfixed
+    import jax.numpy as jnp
+    rng = np.random.default_rng(9)
+    for _ in range(4):
+        n, f, d = (int(rng.integers(1, 6)), int(rng.integers(1, 5)),
+                   int(rng.integers(1, 4)))
+        feats = rng.normal(size=(n, f, d)).astype(np.float32)
+        mask = rng.random((n, f)) < 0.6
+        mean = np.asarray(gfixed.mean_aggregate(jnp.asarray(feats),
+                                                jnp.asarray(mask)))
+        mx = np.asarray(gfixed.max_aggregate(jnp.asarray(feats),
+                                             jnp.asarray(mask)))
+        for i in range(n):
+            rows = feats[i][mask[i]]
+            exp_mean = rows.mean(0) if rows.size else np.zeros(d)
+            exp_max = rows.max(0) if rows.size else np.zeros(d)
+            np.testing.assert_allclose(mean[i], exp_mean, rtol=1e-5,
+                                       atol=1e-6)
+            np.testing.assert_allclose(mx[i], exp_max, rtol=1e-5,
+                                       atol=1e-6)
+
+
+def test_unique_fixed_static_size():
+    import jax
+    from paddle_tpu.geometric import fixed as gfixed
+
+    @jax.jit
+    def f(keys):
+        return gfixed.unique_fixed(keys, size=6, fill_value=0)
+
+    uniq, inv = f(np.array([7, 3, 7, 9, 3], np.int64))
+    uniq, inv = np.asarray(uniq), np.asarray(inv)
+    assert uniq.shape == (6,)  # static regardless of true uniques
+    np.testing.assert_array_equal(uniq[:3], [3, 7, 9])
+    np.testing.assert_array_equal(uniq[inv],
+                                  [7, 3, 7, 9, 3])
+
+
+def test_merge_with_inverse_edge_cases():
+    from paddle_tpu.ops.selected_rows import merge_with_inverse
+    rng = np.random.default_rng(4)
+    # fuzz vs np.add.at oracle incl. int32 inverse
+    for _ in range(5):
+        n, u, d = (int(rng.integers(1, 50)), int(rng.integers(1, 10)),
+                   int(rng.integers(1, 6)))
+        inv = rng.integers(0, u, n).astype(
+            np.int32 if rng.random() < 0.5 else np.int64)
+        vals = rng.normal(size=(n, d)).astype(np.float32)
+        exp = np.zeros((u, d), np.float32)
+        np.add.at(exp, inv, vals)
+        np.testing.assert_allclose(merge_with_inverse(inv, vals, u),
+                                   exp, rtol=1e-5, atol=1e-6)
+    # empty rows -> zeros, not a crash
+    out = merge_with_inverse(np.zeros(0, np.int64),
+                             np.zeros((0, 4), np.float32), 3)
+    np.testing.assert_array_equal(out, np.zeros((3, 4)))
+    # row-count mismatch fails loudly
+    with pytest.raises(ValueError):
+        merge_with_inverse(np.array([0, 1]),
+                           np.zeros((3, 2), np.float32), 2)

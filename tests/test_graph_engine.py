@@ -1,0 +1,418 @@
+"""Sharded graph engine + GraphSAGE lane (paddle_tpu.ps.graph).
+
+Contract (docs/GRAPH.md): adjacency shards ride the SAME splitmix64
+partition as the embedding shards (co-location); `sample_neighbors` is
+a pure function of (adjacency, seed) with fixed `[B, fanout]` output —
+masked slots carry the center id, never phantom keys; the strict-mode
+engine is BIT-IDENTICAL between a prefetch-pipelined run and a
+sequential no-prefetch oracle, even with streaming add/remove_edges
+interleaved into training; after flush() no cache row leaks.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps import (GraphEngine, HeterEmbeddingEngine,
+                           ShardedGraphTable, ShardedSparseTable)
+from paddle_tpu.ps.graph import (SageTrainer, contrastive_batches,
+                                 make_power_law_graph)
+from paddle_tpu.ps.heter.sharded import hash_partition, splitmix64
+
+
+def _ring(n=12, base=1):
+    """Ring graph: node i <-> i+1 (mod n), every degree exactly 2."""
+    ids = np.arange(base, base + n, dtype=np.uint64)
+    nxt = np.roll(ids, -1)
+    return (np.concatenate([ids, nxt]),
+            np.concatenate([nxt, ids]), ids)
+
+
+# ------------------------------------------------------- sharded table
+
+
+class TestShardedGraphTable:
+    def test_route_is_splitmix_partition(self):
+        g = ShardedGraphTable(num_shards=5)
+        keys = np.arange(1, 200, dtype=np.uint64)
+        np.testing.assert_array_equal(g.route(keys),
+                                      hash_partition(keys, 5))
+        np.testing.assert_array_equal(
+            g.route(keys), (splitmix64(keys) % np.uint64(5)).astype(
+                np.int64))
+
+    def test_colocates_with_sparse_table(self):
+        """Satellite: a graph built over ShardedSparseTable.partition_fn
+        stores node u's adjacency in the SAME shard index that holds
+        u's embedding row."""
+        table = ShardedSparseTable(num_shards=4, dim=4,
+                                   initial_range=0.0)
+        g = ShardedGraphTable(num_shards=4,
+                              partition_fn=table.partition_fn)
+        src, dst, ids = _ring(40)
+        g.add_edges(src, dst)
+        emb_shard = table.partition_fn(ids)
+        for node, s in zip(ids, emb_shard):
+            shard = g.shards[int(s)]
+            assert int(node) in shard.adj, \
+                f"node {node} adjacency not in its embedding shard {s}"
+        # and the embedding row really lives in the same shard: a push
+        # through the sharded table mutates exactly shard s's row
+        table.push(ids[:1], np.ones((1, 4), np.float32))
+        for s in range(4):
+            row = table.shards[s].pull(ids[:1])
+            if s == int(emb_shard[0]):
+                assert not np.allclose(row, 0.0), \
+                    "pushed row missing from its partition shard"
+            else:
+                assert np.allclose(row, 0.0)
+
+    def test_partition_fn_roundtrip(self):
+        g = ShardedGraphTable(num_shards=3)
+        keys = np.array([7, 9, 11], np.uint64)
+        np.testing.assert_array_equal(g.partition_fn(keys),
+                                      g.route(keys))
+
+    def test_fixed_shape_and_mask_semantics(self):
+        src, dst, ids = _ring(10)
+        g = ShardedGraphTable(num_shards=3)
+        g.add_edges(src, dst)
+        q = np.array([1, 5, 999], np.uint64)  # 999 has degree 0
+        nb, mask = g.sample_neighbors(q, fanout=4, seed=1)
+        assert nb.shape == (3, 4) and mask.shape == (3, 4)
+        assert nb.dtype == np.uint64 and mask.dtype == np.bool_
+        # ring degree is 2 -> exactly 2 valid slots, unknown node 0
+        np.testing.assert_array_equal(mask.sum(1), [2, 2, 0])
+        # masked slots hold the CENTER id (safe to pull, no phantoms)
+        np.testing.assert_array_equal(nb[~mask],
+                                      np.repeat(q, 4)[~mask.ravel()])
+        # valid slots are true neighbors
+        for i, node in enumerate(q[:2]):
+            assert set(nb[i][mask[i]].tolist()) <= \
+                set(g.neighbors(int(node))[0].tolist())
+
+    def test_sample_is_pure_in_batch_composition(self):
+        """The determinism keystone: a node's draw depends only on
+        (adjacency, seed) — not on which other ids share the batch,
+        batch order, or a previous query."""
+        g = ShardedGraphTable(num_shards=2)
+        src, dst, ids = _ring(30)
+        g.add_edges(src, dst)
+        solo, solo_m = g.sample_neighbors(ids[:1], 2, seed=9)
+        full, full_m = g.sample_neighbors(ids, 2, seed=9)
+        rev, rev_m = g.sample_neighbors(ids[::-1].copy(), 2, seed=9)
+        np.testing.assert_array_equal(solo[0], full[0])
+        np.testing.assert_array_equal(full, rev[::-1])
+        np.testing.assert_array_equal(full_m, rev_m[::-1])
+        # a different seed redraws
+        other, _ = g.sample_neighbors(ids, 2, seed=10)
+        assert not np.array_equal(full, other)
+
+    def test_duplicate_edges_dedup(self):
+        g = ShardedGraphTable(num_shards=2)
+        g.add_edges(np.array([1, 1, 1], np.uint64),
+                    np.array([2, 2, 3], np.uint64))
+        assert g.num_edges() == 2
+        np.testing.assert_array_equal(g.neighbors(1)[0], [2, 3])
+
+    def test_remove_edges(self):
+        g = ShardedGraphTable(num_shards=2)
+        src, dst, ids = _ring(8)
+        g.add_edges(src, dst)
+        before = g.num_edges()
+        g.remove_edges(np.array([1], np.uint64),
+                       np.array([2], np.uint64))
+        assert g.num_edges() == before - 1
+        assert 2 not in g.neighbors(1)[0].tolist()
+        _, mask = g.sample_neighbors(np.array([1], np.uint64), 4)
+        assert mask.sum() == 1
+
+    def test_weighted_last_wins_and_bias(self):
+        g = ShardedGraphTable(num_shards=2, weighted=True)
+        # heavy weight on neighbor 10, feather on 11..13; duplicate
+        # (1,10) rows: last weight wins
+        g.add_edges(np.full(5, 1, np.uint64),
+                    np.array([10, 11, 12, 13, 10], np.uint64),
+                    np.array([0.01, 0.01, 0.01, 0.01, 50.0],
+                             np.float32))
+        counts = {10: 0, 11: 0, 12: 0, 13: 0}
+        for s in range(300):
+            nb, m = g.sample_neighbors(np.array([1], np.uint64), 1,
+                                       seed=s)
+            counts[int(nb[0, 0])] += 1
+        assert counts[10] > 200, counts  # w=50 dominates w=0.01 peers
+
+    def test_shard_count_validation(self):
+        table = ShardedSparseTable(num_shards=3, dim=2,
+                                   initial_range=0.0)
+
+        def bad_fn(keys):
+            return np.full(np.asarray(keys).size, 7, np.int64)
+
+        g = ShardedGraphTable(num_shards=3, partition_fn=bad_fn)
+        with pytest.raises(ValueError):
+            g.add_edges(np.array([1], np.uint64),
+                        np.array([2], np.uint64))
+        del table
+
+
+# -------------------------------------------------------- graph engine
+
+
+class TestGraphEngine:
+    def _engine(self, **kw):
+        g = ShardedGraphTable(num_shards=2)
+        src, dst, ids = _ring(20)
+        g.add_edges(src, dst)
+        kw.setdefault("fanouts", (3, 2))
+        eng = GraphEngine(g, **kw)
+        return eng, ids
+
+    def test_batch_shapes_and_level_sizes(self):
+        eng, ids = self._engine()
+        b = eng.sample_batch(ids[:5])
+        assert b.level_sizes() == [5, 15, 30]
+        assert b.neighbors[0].shape == (5, 3)
+        assert b.neighbors[1].shape == (15, 2)
+        assert b.keys.shape == (50,)
+        assert b.features is None
+        eng.close()
+
+    def test_multi_hop_dedup_counts(self):
+        """Frontier dedup: hop h samples each unique node once. On a
+        ring queried with duplicated seeds the raw/unique gap is
+        exact and dedup_ratio reflects it."""
+        eng, ids = self._engine()
+        seeds = np.repeat(ids[:4], 3)  # 12 raw, 4 unique
+        b = eng.sample_batch(seeds)
+        # hop0: 12 raw / 4 uniq. hop1 frontier: 12*3=36 raw slots
+        assert eng.raw_frontier == 12 + 36
+        assert eng.uniq_frontier <= 4 + 36
+        assert eng.dedup_ratio() > 0.0
+        # duplicated seeds sample identically (purity again)
+        np.testing.assert_array_equal(b.neighbors[0][0],
+                                      b.neighbors[0][1])
+        eng.close()
+
+    def test_clock_advances_seeds(self):
+        eng, ids = self._engine(base_seed=3)
+        b0 = eng.sample_batch(ids[:4])
+        b1 = eng.sample_batch(ids[:4])
+        assert b0.clock == 0 and b1.clock == 1
+        assert b0.seed != b1.seed
+        assert not np.array_equal(b0.neighbors[0], b1.neighbors[0])
+        eng.close()
+
+    def test_strict_sample_after_update_coherent(self):
+        """Strict mode: a sample_batch issued after add_edges returns
+        sees those edges even though application is asynchronous."""
+        eng, ids = self._engine(fanouts=(4,))
+        fresh = np.uint64(777)
+        for i in range(6):
+            eng.add_edges(np.array([fresh], np.uint64),
+                          np.array([7000 + i], np.uint64))
+        b = eng.sample_batch(np.array([fresh], np.uint64))
+        assert b.masks[0].sum() == 4  # degree 6 >= fanout 4
+        assert eng.stream_adds == 6
+        eng.close()
+
+    def test_remove_then_sample_coherent(self):
+        eng, ids = self._engine(fanouts=(4,))
+        src, dst, _ = _ring(20)
+        eng.remove_edges(src, dst)  # empty the graph
+        b = eng.sample_batch(ids[:3])
+        assert b.masks[0].sum() == 0
+        assert eng.stream_removes == 1  # one streamed remove op
+        eng.close()
+
+    def test_prefetch_hit_and_unused(self):
+        eng, ids = self._engine(base_seed=1)
+        eng.prefetch(ids[:4])
+        b = eng.sample_batch(ids[:4])
+        assert eng.prefetch_hits == 1
+        # wrong seeds -> retired unused, live sample still correct
+        eng.prefetch(ids[4:8])
+        b2 = eng.sample_batch(ids[8:12])
+        assert eng.prefetch_unused == 1
+        assert b2.seeds.size == 4 and b.clock == 0
+        eng.close()
+
+    def test_prefetch_repair_on_conflict(self):
+        """A streaming update that touches the prefetched node set
+        forces a full deterministic resample (repair) — the repaired
+        bundle must equal a sequential oracle's."""
+        eng, ids = self._engine(base_seed=5)
+        oracle, _ = self._engine(base_seed=5, prefetch=False)
+        eng.prefetch(ids[:4])
+        # ids[:4] are in the sampled union -> conflict
+        eng.add_edges(np.array([ids[0]], np.uint64),
+                      np.array([4242], np.uint64))
+        oracle.add_edges(np.array([ids[0]], np.uint64),
+                         np.array([4242], np.uint64))
+        b = eng.sample_batch(ids[:4])
+        ob = oracle.sample_batch(ids[:4])
+        assert eng.prefetch_repairs == 1
+        for x, y in zip(b.neighbors, ob.neighbors):
+            np.testing.assert_array_equal(x, y)
+        for x, y in zip(b.masks, ob.masks):
+            np.testing.assert_array_equal(x, y)
+        eng.close()
+        oracle.close()
+
+    def test_flush_surfaces_update_errors(self):
+        eng, ids = self._engine()
+        with pytest.raises(Exception):
+            eng.add_edges(np.array([1, 2], np.uint64),
+                          np.array([3], np.uint64))  # length mismatch
+            eng.flush()
+        eng.close()
+
+    def test_state_shape(self):
+        eng, ids = self._engine()
+        eng.sample_batch(ids[:2])
+        st = eng.state()
+        assert st["mode"] == "strict" and st["batches"] == 1
+        assert st["graph_edges"] == 40
+        assert set(st["prefetch"]) == {"hits", "repairs", "unused"}
+        eng.close()
+
+
+# ------------------------------------- parity: pipelined vs sequential
+
+
+def _sage_lane(prefetch, steps=8, updates=True):
+    """One full training lane over the verified harness; returns
+    (losses, final table pull, engine state, embedding cache)."""
+    table = ShardedSparseTable(num_shards=3, dim=8, sgd_rule="sgd",
+                              learning_rate=1.0, initial_range=0.5)
+    feats = HeterEmbeddingEngine(table, cache_capacity=512,
+                                 mode="strict", prefetch=prefetch)
+    graph = ShardedGraphTable(num_shards=3,
+                              partition_fn=table.partition_fn)
+    src, dst = make_power_law_graph(num_nodes=300, avg_degree=6,
+                                    seed=3)
+    graph.add_edges(src, dst)
+    eng = GraphEngine(graph, features=feats, fanouts=(4, 3),
+                      mode="strict", base_seed=7, prefetch=prefetch)
+    tr = SageTrainer(eng, hidden_dims=(16, 8), lr=1.0, param_seed=0)
+    ids = np.arange(1, 301, dtype=np.uint64)
+    batches = contrastive_batches(src, dst, ids, batch_size=32,
+                                  steps=steps, seed=5)
+    # interleaved streaming updates: even steps touch a disjoint id
+    # range (prefetch survives -> hits), odd steps rewire live seed
+    # nodes (prefetch conflicts -> repairs)
+    upds = []
+    for i in range(steps):
+        if i % 2 == 0:
+            upds.append((np.arange(10000 + i * 10, 10005 + i * 10,
+                                   dtype=np.uint64),
+                         np.arange(20000 + i * 10, 20005 + i * 10,
+                                   dtype=np.uint64)))
+        else:
+            c = batches[i][0][:3]
+            upds.append((c, c[::-1].copy()))
+    losses = []
+    for i, (c, p, n) in enumerate(batches):
+        losses.append(tr.train_step(c, p, n))
+        if prefetch and i + 1 < steps:
+            tr.prefetch(*batches[i + 1])
+        if updates:
+            eng.add_edges(*upds[i])
+    eng.flush()
+    state = eng.state()
+    nodes = np.concatenate([ids, np.arange(10000, 10100,
+                                           dtype=np.uint64)])
+    final = table.pull(nodes)
+    cache = feats.cache
+    eng.close()
+    return losses, final, state, cache
+
+
+@pytest.mark.slow
+def test_pipelined_bit_identical_to_sequential():
+    """THE acceptance contract: prefetch-pipelined strict run vs the
+    sequential no-prefetch oracle, with streaming updates interleaved —
+    bit-identical per-step losses AND final table state, and the
+    pipelined run must have exercised both hits and repairs."""
+    l_seq, t_seq, st_seq, _ = _sage_lane(prefetch=False)
+    l_pipe, t_pipe, st_pipe, cache = _sage_lane(prefetch=True)
+    bits = [struct.pack("d", x) for x in l_pipe]
+    assert bits == [struct.pack("d", x) for x in l_seq], \
+        f"losses diverged: {l_pipe} vs {l_seq}"
+    assert np.array_equal(t_pipe, t_seq), "final table state diverged"
+    assert st_pipe["prefetch"]["hits"] > 0, st_pipe
+    assert st_pipe["prefetch"]["repairs"] > 0, st_pipe
+    # zero leaked cache rows after flush
+    assert cache.num_pinned == 0 and cache.num_dirty == 0
+    assert cache.invariant_ok
+
+
+@pytest.mark.slow
+def test_sage_loss_decreases_and_grads_flow():
+    """Unsupervised SAGE on the synthetic power-law graph: loss drops
+    and sparse feature grads actually mutate the embedding table."""
+    table = ShardedSparseTable(num_shards=3, dim=8, sgd_rule="sgd",
+                              learning_rate=1.0, initial_range=0.5)
+    feats = HeterEmbeddingEngine(table, cache_capacity=512,
+                                 mode="strict")
+    graph = ShardedGraphTable(num_shards=3,
+                              partition_fn=table.partition_fn)
+    src, dst = make_power_law_graph(num_nodes=300, avg_degree=6,
+                                    seed=3)
+    graph.add_edges(src, dst)
+    eng = GraphEngine(graph, features=feats, fanouts=(4, 3),
+                      mode="strict", base_seed=7)
+    tr = SageTrainer(eng, hidden_dims=(16, 8), lr=0.5, param_seed=0)
+    ids = np.arange(1, 301, dtype=np.uint64)
+    before = table.pull(ids).copy()
+    batches = contrastive_batches(src, dst, ids, batch_size=32,
+                                  steps=40, seed=5)
+    losses = [tr.train_step(c, p, n) for c, p, n in batches]
+    eng.flush()
+    after = table.pull(ids)
+    assert not np.array_equal(before, after), \
+        "feature grads never reached the table"
+    head, tail = np.mean(losses[:3]), np.mean(losses[-3:])
+    assert tail < head - 1e-3, \
+        f"loss did not decrease: {head:.4f} -> {tail:.4f}"
+    emb = tr.embed(ids[:10])
+    assert emb.shape == (10, 8) and np.isfinite(emb).all()
+    eng.close()
+
+
+def test_trainer_validation():
+    g = ShardedGraphTable(num_shards=2)
+    g.add_edges(*_ring(6)[:2])
+    eng = GraphEngine(g, fanouts=(2, 2))
+    with pytest.raises(ValueError):
+        SageTrainer(eng)  # no features
+    eng.close()
+    feats = HeterEmbeddingEngine(
+        ShardedSparseTable(num_shards=2, dim=4, initial_range=0.0),
+        cache_capacity=16)
+    eng2 = GraphEngine(g, features=feats, fanouts=(2, 2))
+    with pytest.raises(ValueError):
+        SageTrainer(eng2, hidden_dims=(4,))  # len mismatch
+    with pytest.raises(ValueError):
+        SageTrainer(eng2, hidden_dims=(4, 4), aggregator="median")
+    eng2.close()
+
+
+# ------------------------------------------------------ smoke contract
+
+
+def test_graph_smoke_tool(capsys):
+    """tools/graph_smoke.py is the graph-lane CI contract: pipelined
+    parity, nonzero prefetch hits, loss decrease, one jit compile for
+    the SAGE step, zero leaked cache rows, every CONTRACT_METRICS name
+    exported."""
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "graph_smoke.py")
+    spec = importlib.util.spec_from_file_location("graph_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main()
+    out = capsys.readouterr()
+    assert rc == 0, f"smoke failed:\n{out.err}"

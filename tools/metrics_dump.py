@@ -69,6 +69,15 @@ EXPECTED_METRICS = (
     # by tools/multitick_smoke.py's speculative burst and
     # tests/test_multitick.py's identity matrix
     "paddle_tpu_serving_speculation_state",
+    # Sharded graph engine + GraphSAGE lane (ISSUE 20): registered by
+    # importing ps.graph.metrics (the grep below pulls the full
+    # ps.graph.metrics.CONTRACT_METRICS set; activity is exercised by
+    # tools/graph_smoke.py and tests/test_graph_engine.py —
+    # sample-time histogram, frontier raw/unique counters, dedup
+    # gauge, streaming add/remove counters, prefetch hit/repair/unused
+    # taxonomy, edge-count gauge)
+    "paddle_tpu_graph_sample_seconds",
+    "paddle_tpu_graph_frontier_nodes_total",
 )
 
 
@@ -128,6 +137,10 @@ def main(argv=None):
     # (registration prints their TYPE lines; activity is the smokes'
     # job)
     from paddle_tpu.serving.metrics import CONTRACT_METRICS
+    # same registration-by-import contract for the graph lane (ISSUE
+    # 20): tools/graph_smoke.py greps activity, this dump greps names
+    from paddle_tpu.ps.graph.metrics import (
+        CONTRACT_METRICS as GRAPH_CONTRACT_METRICS)
 
     metrics.enable()
     try:
@@ -136,7 +149,9 @@ def main(argv=None):
     finally:
         metrics.disable()
     print(text)
-    missing = [name for name in EXPECTED_METRICS + tuple(CONTRACT_METRICS)
+    missing = [name for name in EXPECTED_METRICS
+               + tuple(CONTRACT_METRICS)
+               + tuple(GRAPH_CONTRACT_METRICS)
                if name not in text]
     if missing:
         print(f"MISSING METRICS: {missing}", file=sys.stderr)
